@@ -1,0 +1,22 @@
+"""Test harness config.
+
+Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
+run without TPU hardware (the tony-mini / MiniYARNCluster analogue for the
+compute plane — SURVEY.md §4 takeaway). Must run before the first jax import
+anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Child processes spawned by e2e tests inherit these via os.environ.
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)

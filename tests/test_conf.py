@@ -1,0 +1,126 @@
+"""Config system tests, incl. the defaults↔keys drift check.
+
+Reference test model: TestTonyConfigurationFields.java:13-66 (drift),
+TestUtils.java memory parsing, TonyClient.initTonyConf merge order
+(TonyClient.java:483-517).
+"""
+
+import json
+import os
+
+import pytest
+
+from tony_tpu.conf import TonyConfiguration, keys as K, parse_memory_mb, parse_time_ms
+from tony_tpu.conf.defaults import DEFAULTS, NO_DEFAULT_KEYS
+
+
+def _static_keys():
+    """Every static key constant declared in tony_tpu.conf.keys."""
+    out = set()
+    for name in dir(K):
+        if name.isupper() and not name.endswith("_RE") and name not in (
+                "TONY_PREFIX", "MULTI_VALUE_CONF", "RESERVED_SEGMENTS",
+                "MAX_TOTAL_RESOURCES_PREFIX"):
+            val = getattr(K, name)
+            if isinstance(val, str) and val.startswith("tony."):
+                out.add(val)
+    return out
+
+
+def test_defaults_drift():
+    """Every static key has a default or is explicitly default-free, and every
+    default maps to a declared key — the TestTonyConfigurationFields analogue."""
+    declared = _static_keys()
+    missing = declared - set(DEFAULTS) - NO_DEFAULT_KEYS
+    assert not missing, f"keys with neither default nor NO_DEFAULT entry: {missing}"
+    unknown = set(DEFAULTS) - declared
+    assert not unknown, f"defaults for undeclared keys: {unknown}"
+    overlap = set(DEFAULTS) & NO_DEFAULT_KEYS
+    assert not overlap, f"keys both defaulted and NO_DEFAULT: {overlap}"
+
+
+def test_merge_order(tmp_path):
+    conf = TonyConfiguration()
+    job = tmp_path / "tony.json"
+    job.write_text(json.dumps({
+        "tony.application.name": "from-file",
+        "tony.worker.instances": 4,
+    }))
+    conf.merge_file(str(job))
+    assert conf.get_str(K.APPLICATION_NAME) == "from-file"
+    conf.merge_cli(["tony.application.name=from-cli"])
+    assert conf.get_str(K.APPLICATION_NAME) == "from-cli"
+    assert conf.source_of(K.APPLICATION_NAME) == "cli"
+    assert conf.get_int("tony.worker.instances") == 4
+
+
+def test_properties_file(tmp_path):
+    props = tmp_path / "tony.properties"
+    props.write_text("# comment\ntony.worker.instances=2\ntony.application.queue=ml\n")
+    conf = TonyConfiguration()
+    conf.merge_file(str(props))
+    assert conf.get_int("tony.worker.instances") == 2
+    assert conf.get_str(K.APPLICATION_QUEUE) == "ml"
+
+
+def test_site_file_merged_last(tmp_path, monkeypatch):
+    site_dir = tmp_path / "confdir"
+    site_dir.mkdir()
+    (site_dir / "tony-site.json").write_text(json.dumps(
+        {"tony.application.queue": "site-queue"}))
+    monkeypatch.setenv("TONY_CONF_DIR", str(site_dir))
+    conf = TonyConfiguration()
+    conf.merge_cli(["tony.application.queue=cli-queue"])
+    conf.merge_site()
+    assert conf.get_str(K.APPLICATION_QUEUE) == "site-queue"
+
+
+def test_multi_value_append():
+    conf = TonyConfiguration()
+    conf.set(K.CONTAINERS_RESOURCES, "a.zip,b.txt", source="file")
+    conf.set(K.CONTAINERS_RESOURCES, "c.txt,a.zip", source="cli")
+    assert conf.get_strings(K.CONTAINERS_RESOURCES) == ["a.zip", "b.txt", "c.txt"]
+
+
+def test_job_types_discovery():
+    conf = TonyConfiguration()
+    conf.set("tony.worker.instances", 2)
+    conf.set("tony.ps.instances", 1)
+    conf.set("tony.evaluator.instances", 0)
+    # reserved segments never parse as jobtypes
+    conf.set("tony.task.heartbeat-interval-ms", 500)
+    assert conf.job_types() == ["evaluator", "ps", "worker"]
+
+
+def test_typed_getters():
+    conf = TonyConfiguration()
+    conf.set("x.time", "5s")
+    conf.set("x.mem", "2g")
+    conf.set("x.bool", "TRUE")
+    assert conf.get_time_ms("x.time") == 5000
+    assert conf.get_memory_mb("x.mem") == 2048
+    assert conf.get_bool("x.bool") is True
+    assert conf.get_bool("x.unset", True) is True
+
+
+@pytest.mark.parametrize("raw,ms", [
+    ("500ms", 500), ("2m", 120000), (1500, 1500), ("1h", 3600000), ("0.5s", 500)])
+def test_parse_time(raw, ms):
+    assert parse_time_ms(raw) == ms
+
+
+@pytest.mark.parametrize("raw,mb", [
+    ("2g", 2048), ("512m", 512), ("512", 512), (1024, 1024), ("1t", 1048576)])
+def test_parse_memory(raw, mb):
+    assert parse_memory_mb(raw) == mb
+
+
+def test_final_conf_roundtrip(tmp_path):
+    conf = TonyConfiguration()
+    conf.set("tony.worker.instances", 3, source="file")
+    path = str(tmp_path / "sub" / "tony-final.json")
+    conf.write(path)
+    loaded = TonyConfiguration.read(path)
+    assert loaded.get_int("tony.worker.instances") == 3
+    assert loaded.source_of("tony.worker.instances") == "file"
+    assert loaded.get_int(K.TASK_HEARTBEAT_INTERVAL_MS) == 1000

@@ -1,0 +1,51 @@
+"""Utils tests (reference model: util/TestUtils.java)."""
+
+import os
+import time
+
+from tony_tpu.utils import common, fs
+from tony_tpu.utils.shell import execute_shell
+
+
+def test_poll_till_non_null():
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        return "ready" if state["n"] >= 3 else None
+
+    assert common.poll_till_non_null(fn, 0.01, 5) == "ready"
+    assert common.poll_till_non_null(lambda: None, 0.01, 0.05) is None
+
+
+def test_parse_env_list():
+    assert common.parse_env_list(["A=1", "B=x=y", "C="]) == \
+        {"A": "1", "B": "x=y", "C": ""}
+
+
+def test_zip_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.txt").write_text("hello")
+    (src / "sub" / "b.txt").write_text("world")
+    z = fs.zip_dir(str(src), str(tmp_path / "out.zip"))
+    dest = fs.unzip(z, str(tmp_path / "dest"))
+    assert open(os.path.join(dest, "a.txt")).read() == "hello"
+    assert open(os.path.join(dest, "sub", "b.txt")).read() == "world"
+
+
+def test_execute_shell_exit_codes(tmp_path):
+    assert execute_shell("exit 0") == 0
+    assert execute_shell("exit 3") == 3
+    out = tmp_path / "o.txt"
+    with open(out, "w") as f:
+        assert execute_shell("echo -n $MY_VAR", extra_env={"MY_VAR": "v1"},
+                             stdout=f) == 0
+    assert out.read_text() == "v1"
+
+
+def test_execute_shell_timeout():
+    start = time.monotonic()
+    rc = execute_shell("sleep 30", timeout_sec=0.5)
+    assert rc == 124
+    assert time.monotonic() - start < 5

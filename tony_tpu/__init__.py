@@ -1,0 +1,34 @@
+"""tony_tpu — a TPU-native distributed ML job orchestrator + training runtime.
+
+A ground-up rebuild of the capability set of LinkedIn TonY (reference:
+/root/reference, v0.3.35) re-targeted at TPU pods:
+
+- **Control plane** (client / application master / task executor) that submits,
+  gang-schedules, and supervises distributed training jobs: cluster-spec
+  rendezvous, heartbeats, liveliness monitoring, DAG-staged scheduling,
+  session-level retry, event history, and a metrics plane.
+  (Reference: tony-core/src/main/java/com/linkedin/tony/{TonyClient,
+  ApplicationMaster,TaskExecutor}.java)
+- **Compute plane** that is idiomatic JAX/XLA: models sharded with
+  jax.sharding over a device Mesh, pallas TPU kernels for attention,
+  ring-attention sequence parallelism, and a pjit training loop — where the
+  reference delegated the data plane to TF-PS/NCCL/MPI inside user processes,
+  this package ships a first-class JAX runtime whose collectives ride ICI/DCN.
+
+Subpackages:
+    conf       -- cascading configuration system (TonyConfigurationKeys.java equiv)
+    rpc        -- gRPC control-plane protocol (TensorFlowClusterService equiv)
+    events     -- event history log (tony avro jhist equiv)
+    session    -- job session state machine + DAG scheduler (TonySession/TaskScheduler)
+    am         -- application master (ApplicationMaster.java equiv)
+    executor   -- per-task executor + framework runtimes (TaskExecutor.java equiv)
+    client     -- submission client + CLI (TonyClient/tony-cli equiv)
+    cluster    -- local process-based resource manager (tony-mini equiv)
+    models     -- flagship JAX models (Llama-style transformer, MNIST)
+    ops        -- pallas TPU kernels (flash attention, ring attention)
+    parallel   -- mesh axes, sharding rules, sequence/tensor/pipeline parallelism
+    train      -- training loop, optimizer, checkpoint/restore
+    utils      -- shared helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Configuration subsystem (reference: TonyConfigurationKeys.java + tony-default.xml)."""
+
+from tony_tpu.conf.configuration import (
+    TonyConfiguration,
+    parse_memory_mb,
+    parse_time_ms,
+)
+from tony_tpu.conf import keys
+
+__all__ = ["TonyConfiguration", "parse_memory_mb", "parse_time_ms", "keys"]

@@ -1,0 +1,91 @@
+"""Default values for every static configuration key.
+
+Equivalent of the reference's tony-default.xml
+(tony-core/src/main/resources/tony-default.xml). The drift test
+(tests/test_conf.py::test_defaults_drift) asserts — like the reference's
+TestTonyConfigurationFields.java:13-66 — that every static key declared in
+`tony_tpu.conf.keys` has a default here and vice versa.
+"""
+
+from tony_tpu.conf import keys as K
+
+# Keys that intentionally have NO default (user- or system-supplied only).
+# Mirrors the reference's configurationPropsToSkipCompare set.
+NO_DEFAULT_KEYS = frozenset({
+    K.APPLICATION_NODE_LABEL,
+    K.APPLICATION_HDFS_CONF_LOCATION,
+    K.APPLICATION_YARN_CONF_LOCATION,
+    K.APPLICATION_PREPARE_STAGE,
+    K.APPLICATION_TRAINING_STAGE,
+    K.APPLICATION_UNTRACKED_JOBTYPES,
+    K.APPLICATION_STOP_ON_FAILURE_JOBTYPES,
+    K.CONTAINERS_RESOURCES,
+    K.DOCKER_IMAGE,
+    K.DOCKER_MOUNTS,
+    K.KEYTAB_USER,
+    K.KEYTAB_LOCATION,
+    K.PORTAL_URL,
+    K.SRC_DIR,
+    K.PYTHON_VENV,
+    K.EXECUTION_ENV,
+    K.APPLICATION_TAGS,
+    K.TPU_MESH_SHAPE,
+    K.TPU_MESH_AXES,
+    K.HISTORY_LOCATION,
+    K.HISTORY_INTERMEDIATE,
+    K.HISTORY_FINISHED,
+})
+
+DEFAULTS = {
+    # application
+    K.APPLICATION_NAME: "tony_tpu",
+    K.APPLICATION_QUEUE: "default",
+    K.APPLICATION_TIMEOUT: 0,
+    K.APPLICATION_SECURITY_ENABLED: False,
+    K.APPLICATION_FRAMEWORK: "jax",
+    K.APPLICATION_SINGLE_NODE: False,
+    K.APPLICATION_ENABLE_PREPROCESS: False,
+    K.APPLICATION_FAIL_ON_WORKER_FAILURE: False,
+
+    # am (reference defaults: tony-default.xml am section)
+    K.AM_RETRY_COUNT: 0,
+    K.AM_MEMORY: "2g",
+    K.AM_VCORES: 1,
+    K.AM_GANG_MAX_WAIT_MS: 0,
+
+    # task cadences (reference: TonyConfigurationKeys.java:143-150)
+    K.TASK_HEARTBEAT_INTERVAL_MS: 1000,
+    K.TASK_MAX_MISSED_HEARTBEATS: 25,
+    K.TASK_METRICS_INTERVAL_MS: 5000,
+    K.TASK_EXECUTOR_JVM_OPTS: "",
+    # reference default constant 15 min (TonyConfigurationKeys.java:243-244)
+    K.CONTAINER_ALLOCATION_TIMEOUT: 15 * 60 * 1000,
+    K.TASK_REGISTRATION_TIMEOUT_SEC: 300,
+    K.TASK_REGISTRATION_RETRY_COUNT: 0,
+
+    # limits: -1 = unlimited (reference: TonyClient.java:598-667)
+    K.MAX_TOTAL_INSTANCES: -1,
+    K.MAX_TOTAL_TPUS: -1,
+    K.MAX_TOTAL_GPUS: -1,
+
+    # history
+    K.HISTORY_RETENTION_SEC: 30 * 24 * 3600,
+    K.HISTORY_MOVER_INTERVAL_MS: 5 * 60 * 1000,
+
+    # portal
+    K.PORTAL_CACHE_MAX_ENTRIES: 1000,
+
+    # docker
+    K.DOCKER_ENABLED: False,
+
+    # tpu
+    K.TPU_NUM_SLICES: 1,
+    K.TPU_COORDINATOR_PORT: 0,   # 0 = pick ephemeral
+
+    # cluster backend
+    K.CLUSTER_BACKEND: "local",
+    K.CLUSTER_WORKDIR: "",       # "" = tempdir
+
+    # misc
+    K.PYTHON_BINARY_PATH: "",
+}

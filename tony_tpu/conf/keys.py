@@ -1,0 +1,157 @@
+"""Configuration key names + dynamic per-jobtype key builders.
+
+Equivalent of the reference's TonyConfigurationKeys.java
+(tony-core/src/main/java/com/linkedin/tony/TonyConfigurationKeys.java).
+Static keys live here; their defaults live in `tony_tpu.conf.defaults`.
+Dynamic keys follow the reference's `tony.<jobtype>.<attr>` scheme
+(TonyConfigurationKeys.java:171-239) with `tpus` added as a first-class
+resource type per the TPU re-target.
+"""
+
+import re
+
+TONY_PREFIX = "tony."
+
+# --- application ---------------------------------------------------------
+APPLICATION_NAME = "tony.application.name"
+APPLICATION_NODE_LABEL = "tony.application.node-label"
+APPLICATION_QUEUE = "tony.application.queue"
+APPLICATION_TIMEOUT = "tony.application.timeout"          # ms; 0 = none
+APPLICATION_SECURITY_ENABLED = "tony.application.security.enabled"
+APPLICATION_FRAMEWORK = "tony.application.framework"      # tensorflow|pytorch|mxnet|horovod|jax
+APPLICATION_SINGLE_NODE = "tony.application.single-node"  # run everything on the AM
+APPLICATION_ENABLE_PREPROCESS = "tony.application.enable-preprocess"
+APPLICATION_PREPARE_STAGE = "tony.application.prepare-stage"
+APPLICATION_TRAINING_STAGE = "tony.application.training-stage"
+APPLICATION_UNTRACKED_JOBTYPES = "tony.application.untracked.jobtypes"
+APPLICATION_STOP_ON_FAILURE_JOBTYPES = "tony.application.stop-on-failure.jobtypes"
+APPLICATION_FAIL_ON_WORKER_FAILURE = "tony.application.fail-on-worker-failure-enabled"
+APPLICATION_HDFS_CONF_LOCATION = "tony.application.hdfs-conf-path"
+APPLICATION_YARN_CONF_LOCATION = "tony.application.yarn-conf-path"
+
+# --- am ------------------------------------------------------------------
+AM_RETRY_COUNT = "tony.am.retry-count"
+AM_MEMORY = "tony.am.memory"
+AM_VCORES = "tony.am.vcores"
+AM_GANG_MAX_WAIT_MS = "tony.am.gang-allocation-timeout-ms"
+
+# --- task / containers ---------------------------------------------------
+TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
+TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
+TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
+TASK_EXECUTOR_JVM_OPTS = "tony.task.executor.jvm.opts"    # kept for parity; unused
+CONTAINER_ALLOCATION_TIMEOUT = "tony.container.allocation.timeout"  # ms
+CONTAINERS_RESOURCES = "tony.containers.resources"        # multi-value append key
+TASK_REGISTRATION_TIMEOUT_SEC = "tony.task.registration-timeout-sec"
+TASK_REGISTRATION_RETRY_COUNT = "tony.task.registration-retry-count"
+
+# --- limits (reference: TonyClient.validateTonyConf, TonyClient.java:598-667)
+MAX_TOTAL_INSTANCES = "tony.application.max-total-instances"
+MAX_TOTAL_RESOURCES_PREFIX = "tony.application.max-total-"  # e.g. ...max-total-tpus
+MAX_TOTAL_TPUS = "tony.application.max-total-tpus"
+MAX_TOTAL_GPUS = "tony.application.max-total-gpus"
+
+# --- history / events ----------------------------------------------------
+HISTORY_LOCATION = "tony.history.location"
+HISTORY_INTERMEDIATE = "tony.history.intermediate"
+HISTORY_FINISHED = "tony.history.finished"
+HISTORY_RETENTION_SEC = "tony.history.retention-sec"
+HISTORY_MOVER_INTERVAL_MS = "tony.history.mover-interval-ms"
+KEYTAB_USER = "tony.keytab.user"
+KEYTAB_LOCATION = "tony.keytab.location"
+
+# --- portal --------------------------------------------------------------
+PORTAL_URL = "tony.portal.url"
+PORTAL_CACHE_MAX_ENTRIES = "tony.portal.cache-max-entries"
+
+# --- docker (reference: TonyConfigurationKeys.java:227-239,266-268) ------
+DOCKER_ENABLED = "tony.docker.enabled"
+DOCKER_IMAGE = "tony.docker.containers.image"
+DOCKER_MOUNTS = "tony.docker.containers.mounts"
+
+# --- TPU (new) -----------------------------------------------------------
+TPU_MESH_SHAPE = "tony.tpu.mesh-shape"   # e.g. "2,2" per-job requested mesh
+TPU_MESH_AXES = "tony.tpu.mesh-axes"     # e.g. "dp,tp"
+TPU_NUM_SLICES = "tony.tpu.num-slices"   # multi-slice (DCN) count
+TPU_COORDINATOR_PORT = "tony.tpu.coordinator-port"
+
+# --- cluster backend -----------------------------------------------------
+CLUSTER_BACKEND = "tony.cluster.backend"      # "local" (in-process) | future: gke
+CLUSTER_WORKDIR = "tony.cluster.workdir"      # staging root for local backend
+
+# --- misc ----------------------------------------------------------------
+SRC_DIR = "tony.srcdir"
+PYTHON_VENV = "tony.python.venv"
+PYTHON_BINARY_PATH = "tony.python.binary.path"
+EXECUTION_ENV = "tony.execution.env"          # multi-value append key k=v pairs
+APPLICATION_TAGS = "tony.application.tags"
+
+# Keys whose values append across conf layers instead of replacing
+# (reference: TonyConfigurationKeys.java:285-287 MULTI_VALUE_CONF).
+MULTI_VALUE_CONF = frozenset({
+    CONTAINERS_RESOURCES,
+    EXECUTION_ENV,
+    APPLICATION_UNTRACKED_JOBTYPES,
+})
+
+# --- dynamic per-jobtype keys -------------------------------------------
+# reference: regex `tony\.([a-z]+)\.instances` (TonyConfigurationKeys.java:171)
+JOBTYPE_INSTANCES_RE = re.compile(r"^tony\.([a-z][a-z0-9_\-]*)\.instances$")
+
+# Attributes reserved as non-jobtype key segments (so tony.task.* etc. never
+# parse as a jobtype called "task").
+RESERVED_SEGMENTS = frozenset({
+    "application", "am", "task", "containers", "container", "history",
+    "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
+    "execution", "other",
+})
+
+
+def jobtype_key(jobtype: str, attr: str) -> str:
+    """Build `tony.<jobtype>.<attr>` (reference: TonyConfigurationKeys.java:178-239)."""
+    return f"{TONY_PREFIX}{jobtype}.{attr}"
+
+
+def instances_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "instances")
+
+
+def max_instances_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "max-instances")
+
+
+def memory_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "memory")
+
+
+def vcores_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "vcores")
+
+
+def gpus_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "gpus")
+
+
+def tpus_key(jobtype: str) -> str:
+    """New resource type: TPU chips per task (BASELINE north star: tony.worker.tpus)."""
+    return jobtype_key(jobtype, "tpus")
+
+
+def command_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "command")
+
+
+def resources_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "resources")
+
+
+def depends_on_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "depends-on")
+
+
+def node_label_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "node-label")
+
+
+def docker_image_key(jobtype: str) -> str:
+    return jobtype_key(jobtype, "docker.image")

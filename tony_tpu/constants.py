@@ -1,0 +1,118 @@
+"""Shared constants: env var names, file names, framework ids, test hooks.
+
+Equivalent of the reference's Constants.java
+(tony-core/src/main/java/com/linkedin/tony/Constants.java) with TPU/JAX
+additions. Values are stable wire/env contract — do not rename casually.
+"""
+
+# ---------------------------------------------------------------------------
+# Core env vars injected into every task container
+# (reference: ApplicationMaster.java:1109-1121, Constants.java)
+# ---------------------------------------------------------------------------
+JOB_NAME = "JOB_NAME"                # task type, e.g. "worker", "ps", "chief"
+TASK_INDEX = "TASK_INDEX"            # index within the task type
+TASK_NUM = "TASK_NUM"                # total number of tasks in this type
+IS_CHIEF = "IS_CHIEF"                # "true" if this task is the chief
+SESSION_ID = "SESSION_ID"            # AM session generation (bumped on retry)
+AM_HOST = "AM_HOST"
+AM_PORT = "AM_PORT"
+METRICS_RPC_PORT = "METRICS_RPC_PORT"
+CONTAINER_ID = "CONTAINER_ID"
+APP_ID = "APP_ID"
+ATTEMPT_NUMBER = "ATTEMPT_NUMBER"    # reference: ApplicationMaster.java:369
+NUM_AM_RETRIES = "NUM_AM_RETRIES"    # reference: Constants.java:113-114
+TASK_COMMAND = "TASK_COMMAND"        # the user command this executor runs
+
+# ---------------------------------------------------------------------------
+# Framework bootstrap env (reference: TaskExecutor.java:161-207)
+# ---------------------------------------------------------------------------
+CLUSTER_SPEC = "CLUSTER_SPEC"        # JSON {jobtype: ["host:port", ...]}
+TF_CONFIG = "TF_CONFIG"              # TF_CONFIG JSON (TFConfig.java:13-74)
+TB_PORT = "TB_PORT"                  # TensorBoard port, chief only
+
+# PyTorch (reference: Constants.java:50-54, Utils.parseClusterSpecForPytorch)
+INIT_METHOD = "INIT_METHOD"          # tcp://<worker0 host:port>
+RANK = "RANK"
+WORLD = "WORLD"
+MASTER_ADDR = "MASTER_ADDR"
+MASTER_PORT = "MASTER_PORT"
+
+# MXNet (reference: TaskExecutor.java:180-200)
+DMLC_ROLE = "DMLC_ROLE"
+DMLC_PS_ROOT_URI = "DMLC_PS_ROOT_URI"
+DMLC_PS_ROOT_PORT = "DMLC_PS_ROOT_PORT"
+DMLC_NUM_SERVER = "DMLC_NUM_SERVER"
+DMLC_NUM_WORKER = "DMLC_NUM_WORKER"
+
+# JAX / TPU (new in this build — no reference equivalent; renders the env
+# consumed by jax.distributed.initialize and TPU topology discovery)
+JAX_COORDINATOR_ADDRESS = "JAX_COORDINATOR_ADDRESS"   # host:port of process 0
+JAX_PROCESS_ID = "JAX_PROCESS_ID"
+JAX_NUM_PROCESSES = "JAX_NUM_PROCESSES"
+TPU_MESH_SHAPE = "TPU_MESH_SHAPE"    # e.g. "2,2,1" — job-requested mesh axes
+TPU_MESH_AXES = "TPU_MESH_AXES"      # e.g. "dp,fsdp,tp"
+TPU_SLICE_ID = "TPU_SLICE_ID"        # multi-slice (DCN) slice index
+TPU_NUM_SLICES = "TPU_NUM_SLICES"
+
+# ---------------------------------------------------------------------------
+# File names / layout
+# ---------------------------------------------------------------------------
+TONY_FINAL_CONF = "tony-final.json"  # frozen merged conf shipped to every process
+TONY_DEFAULT_CONF = "tony-default.json"
+TONY_SITE_CONF = "tony-site.json"
+TONY_CONF_DIR_ENV = "TONY_CONF_DIR"
+TONY_APP_STAGING_PREFIX = ".tony"    # per-app staging dir (reference: .tony/<appId>)
+TONY_SRC_ZIP = "tony_src.zip"
+HISTORY_SUFFIX = "jhist"
+HISTORY_INPROGRESS_SUFFIX = "jhist.inprogress"
+CORE_SITE_CONF = "core-site.xml"
+
+# ---------------------------------------------------------------------------
+# Task / job type names with special semantics
+# (reference: TonySession.java:364-367 chief semantics)
+# ---------------------------------------------------------------------------
+CHIEF_JOB_NAME = "chief"
+WORKER_JOB_NAME = "worker"
+PS_JOB_NAME = "ps"
+EVALUATOR_JOB_NAME = "evaluator"
+SCHEDULER_JOB_NAME = "scheduler"     # MXNet
+SERVER_JOB_NAME = "server"           # MXNet
+NOTEBOOK_JOB_NAME = "notebook"
+DRIVER_JOB_NAME = "driver"
+AM_NAME = "am"
+
+# ---------------------------------------------------------------------------
+# ML framework ids (reference: TonyConfigurationKeys.java:12-17 MLFramework)
+# ---------------------------------------------------------------------------
+FRAMEWORK_TENSORFLOW = "tensorflow"
+FRAMEWORK_PYTORCH = "pytorch"
+FRAMEWORK_MXNET = "mxnet"
+FRAMEWORK_HOROVOD = "horovod"
+FRAMEWORK_JAX = "jax"                # new: first-class TPU runtime
+SUPPORTED_FRAMEWORKS = (
+    FRAMEWORK_TENSORFLOW,
+    FRAMEWORK_PYTORCH,
+    FRAMEWORK_MXNET,
+    FRAMEWORK_HOROVOD,
+    FRAMEWORK_JAX,
+)
+
+# ---------------------------------------------------------------------------
+# Fault-injection test hooks compiled into prod code
+# (reference: Constants.java:116-121; ApplicationMaster.java:337-342,1204-1215;
+#  TaskExecutor.java:334-344,372-392)
+# ---------------------------------------------------------------------------
+TEST_AM_CRASH = "TEST_AM_CRASH"
+TEST_WORKER_TERMINATION = "TEST_WORKER_TERMINATION"
+TEST_TASK_COMPLETION_NOTIFICATION_DELAYED = "TEST_TASK_COMPLETION_NOTIFICATION_DELAYED"
+TEST_TASK_EXECUTOR_NUM_HB_MISS = "TEST_TASK_EXECUTOR_NUM_HB_MISS"
+TEST_TASK_EXECUTOR_SKEW = "TEST_TASK_EXECUTOR_SKEW"  # format: "type#index#sleep_ms"
+
+# Executor self-destructs after this many consecutive failed heartbeats
+# (reference: TaskExecutor.java:36 MAX_CONSECUTIVE_FAILED_HEARTBEATS)
+MAX_CONSECUTIVE_FAILED_HEARTBEATS = 5
+
+# Exit codes
+EXIT_SUCCESS = 0
+EXIT_FAILURE = 1
+EXIT_HEARTBEAT_FAILURE = 9  # executor killed itself after missed heartbeats

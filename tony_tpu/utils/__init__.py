@@ -1,0 +1,17 @@
+"""Shared helpers (reference: tony-core util/Utils.java grab-bag, split up)."""
+
+from tony_tpu.utils.common import (
+    poll,
+    poll_till_non_null,
+    parse_env_list,
+    current_host,
+    pick_free_port,
+)
+
+__all__ = [
+    "poll",
+    "poll_till_non_null",
+    "parse_env_list",
+    "current_host",
+    "pick_free_port",
+]

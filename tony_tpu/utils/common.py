@@ -1,0 +1,71 @@
+"""Polling, env parsing, host/port helpers.
+
+Reference: util/Utils.java polling helpers (:89-143), env kv parsing
+(:243-263); EphemeralPort.java:30-56.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def poll(func: Callable[[], bool], interval_sec: float, timeout_sec: float) -> bool:
+    """Call `func` every `interval_sec` until it returns True or timeout.
+    Returns whether it ever returned True (Utils.poll, util/Utils.java:89-109)."""
+    deadline = time.monotonic() + timeout_sec
+    while True:
+        if func():
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(interval_sec)
+
+
+def poll_till_non_null(func: Callable[[], Optional[T]], interval_sec: float,
+                       timeout_sec: float) -> Optional[T]:
+    """Call `func` until it returns non-None or timeout; returns the value or
+    None (Utils.pollTillNonNull, util/Utils.java:111-143)."""
+    deadline = time.monotonic() + timeout_sec
+    while True:
+        result = func()
+        if result is not None:
+            return result
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(interval_sec)
+
+
+def parse_env_list(entries: list[str]) -> dict[str, str]:
+    """Parse ['A=1', 'B=x=y'] → {'A': '1', 'B': 'x=y'}
+    (Utils.parseKeyValue, util/Utils.java:243-263)."""
+    out: dict[str, str] = {}
+    for entry in entries:
+        if not entry:
+            continue
+        k, sep, v = entry.partition("=")
+        out[k.strip()] = v if sep else ""
+    return out
+
+
+def current_host() -> str:
+    """Best-effort resolvable hostname for rendezvous registration."""
+    host = socket.gethostname()
+    try:
+        socket.gethostbyname(host)
+        return host
+    except OSError:
+        return "127.0.0.1"
+
+
+def pick_free_port(host: str = "") -> int:
+    """Bind an ephemeral port, return it (EphemeralPort.java:30-56). The tiny
+    close-to-use race is closed for gRPC servers by binding port 0 directly;
+    this helper is for pre-announcing ports to peers."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]

@@ -1,0 +1,58 @@
+"""User-process execution.
+
+Reference: Utils.executeShell (util/Utils.java:292-321) — runs the user
+command under `bash -c`, merges extra env, enforces an optional timeout,
+streams output to this process's stdout/stderr (YARN-style container logs),
+returns the exit code. The reference unset MALLOC_ARENA_MAX and prefixed
+`hadoop classpath`; the TPU equivalent scrubs inherited JAX/TPU coordination
+env that would conflict with what the runtime renders.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from typing import Mapping, Optional
+
+from tony_tpu import constants as C
+
+# Coordination env that must never leak from the launcher into the user
+# process: the runtime re-renders these per task; stale inherited values
+# would misdirect jax.distributed.initialize / torch rendezvous.
+_SCRUBBED_ENV = (
+    C.JAX_COORDINATOR_ADDRESS, C.JAX_PROCESS_ID, C.JAX_NUM_PROCESSES,
+    C.TPU_SLICE_ID, C.TPU_NUM_SLICES, C.TF_CONFIG, C.CLUSTER_SPEC,
+    C.INIT_METHOD, C.RANK, C.WORLD, C.MASTER_ADDR, C.MASTER_PORT,
+)
+
+
+def execute_shell(command: str, timeout_sec: float = 0,
+                  extra_env: Optional[Mapping[str, str]] = None,
+                  cwd: Optional[str] = None,
+                  stdout=None, stderr=None) -> int:
+    """Run `command` via bash; return its exit code. timeout 0 = unlimited.
+    On timeout the whole process group is killed and exit code 124 returned."""
+    env = dict(os.environ)
+    for var in _SCRUBBED_ENV:
+        env.pop(var, None)
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
+    proc = subprocess.Popen(
+        ["bash", "-c", command],
+        env=env,
+        cwd=cwd,
+        stdout=stdout if stdout is not None else sys.stdout,
+        stderr=stderr if stderr is not None else sys.stderr,
+        start_new_session=True,  # own process group so we can kill the tree
+    )
+    try:
+        return proc.wait(timeout=timeout_sec if timeout_sec > 0 else None)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return 124
