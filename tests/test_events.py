@@ -1,0 +1,66 @@
+"""Event history tests (reference model: events/TestEventHandler.java:76-136,
+util/TestHistoryFileUtils.java)."""
+
+import os
+
+from tony_tpu.events import (
+    Event, EventType, ApplicationInited, ApplicationFinished,
+    TaskStarted, TaskFinished, EventHandler, JobMetadata,
+    history_file_name, parse_history_file_name,
+)
+from tony_tpu.events.handler import parse_events
+from tony_tpu.events.history import inprogress_file_name
+
+
+def test_filename_codec_roundtrip():
+    md = JobMetadata(application_id="application_123_456", started=1000,
+                     completed=2000, user="alice", status="SUCCEEDED")
+    name = history_file_name(md)
+    assert name == "application_123_456-1000-2000-alice-SUCCEEDED.jhist"
+    back = parse_history_file_name(name)
+    assert back == md
+
+
+def test_inprogress_filename_roundtrip():
+    md = JobMetadata(application_id="app_1", started=5, user="bob")
+    name = inprogress_file_name(md)
+    assert name == "app_1-5-bob.jhist.inprogress"
+    back = parse_history_file_name(name)
+    assert back.application_id == "app_1"
+    assert back.started == 5
+    assert back.user == "bob"
+    assert back.status == "RUNNING"
+
+
+def test_event_handler_e2e(tmp_path):
+    md = JobMetadata(application_id="app_42", started=100, user="carol")
+    handler = EventHandler(str(tmp_path), md)
+    handler.start()
+    handler.emit(Event(EventType.APPLICATION_INITED,
+                       ApplicationInited("app_42", 2, "amhost")))
+    handler.emit(Event(EventType.TASK_STARTED, TaskStarted("worker", 0, "h0")))
+    handler.emit(Event(EventType.TASK_FINISHED,
+                       TaskFinished("worker", 0, "SUCCEEDED",
+                                    [{"name": "m", "value": 1.0}])))
+    handler.emit(Event(EventType.APPLICATION_FINISHED,
+                       ApplicationFinished("app_42", "SUCCEEDED")))
+    final = handler.stop("SUCCEEDED")
+
+    assert os.path.basename(final).startswith("app_42-100-")
+    assert final.endswith("-carol-SUCCEEDED.jhist")
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           inprogress_file_name(md)))
+    events = parse_events(final)
+    assert [e.type for e in events] == [
+        EventType.APPLICATION_INITED, EventType.TASK_STARTED,
+        EventType.TASK_FINISHED, EventType.APPLICATION_FINISHED]
+    assert events[2].payload.metrics == [{"name": "m", "value": 1.0}]
+
+
+def test_emit_after_stop_drops(tmp_path):
+    md = JobMetadata(application_id="app_9", started=1, user="d")
+    handler = EventHandler(str(tmp_path), md)
+    handler.start()
+    handler.stop("FAILED")
+    # must not raise
+    handler.emit(Event(EventType.TASK_STARTED, TaskStarted("w", 0, "h")))

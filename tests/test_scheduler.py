@@ -1,0 +1,88 @@
+"""DAG scheduler tests (reference model: TestTaskScheduler.java:32+)."""
+
+from tony_tpu.conf import TonyConfiguration
+from tony_tpu.session import (
+    TonySession, TaskScheduler, ResourceRequestor, FinalStatus,
+    JobContainerRequest,
+)
+from tony_tpu.session.scheduler import is_dag
+
+
+class RecordingRequestor(ResourceRequestor):
+    def __init__(self):
+        self.requested = []
+
+    def request_containers(self, request):
+        self.requested.append(request.job_name)
+
+
+def make_session(**jobs_and_deps):
+    conf = TonyConfiguration()
+    for job, (n, deps) in jobs_and_deps.items():
+        conf.set(f"tony.{job}.instances", n)
+        if deps:
+            conf.set(f"tony.{job}.depends-on", deps)
+    return TonySession(conf)
+
+
+def test_is_dag_detects_cycle():
+    a = JobContainerRequest("a", 1, depends_on=["b"])
+    b = JobContainerRequest("b", 1, depends_on=["a"])
+    assert not is_dag([a, b])
+    assert is_dag([JobContainerRequest("a", 1, depends_on=[]),
+                   JobContainerRequest("b", 1, depends_on=["a"])])
+    assert not is_dag([JobContainerRequest("x", 1, depends_on=["x"])])
+
+
+def test_cycle_fails_session():
+    s = make_session(a=(1, "b"), b=(1, "a"))
+    req = RecordingRequestor()
+    sched = TaskScheduler(s, req)
+    sched.schedule_tasks()
+    assert not sched.dependency_check_passed
+    assert s.final_status == FinalStatus.FAILED
+    assert req.requested == []
+
+
+def test_independent_jobs_all_scheduled_immediately():
+    s = make_session(worker=(2, ""), ps=(1, ""))
+    req = RecordingRequestor()
+    TaskScheduler(s, req).schedule_tasks()
+    assert sorted(req.requested) == ["ps", "worker"]
+    assert s.num_expected_tasks == 3
+
+
+def test_dependency_release_chain():
+    """prep(2) -> train(1) -> eval(1): released one level at a time as
+    instances complete (TaskScheduler.registerDependencyCompleted)."""
+    s = make_session(prep=(2, ""), train=(1, "prep"), evaluate=(1, "train"))
+    req = RecordingRequestor()
+    sched = TaskScheduler(s, req)
+    sched.schedule_tasks()
+    assert req.requested == ["prep"]
+    assert s.num_expected_tasks == 2
+
+    sched.register_dependency_completed("prep")
+    assert "train" not in req.requested          # 1 of 2 preps done
+    sched.register_dependency_completed("prep")
+    assert req.requested == ["prep", "train"]    # both done -> train released
+    assert s.num_expected_tasks == 3
+
+    sched.register_dependency_completed("train")
+    assert req.requested == ["prep", "train", "evaluate"]
+    assert s.num_expected_tasks == 4
+
+
+def test_diamond_dependency():
+    s = make_session(src=(1, ""), left=(1, "src"), right=(1, "src"),
+                     sink=(1, "left,right"))
+    req = RecordingRequestor()
+    sched = TaskScheduler(s, req)
+    sched.schedule_tasks()
+    assert req.requested == ["src"]
+    sched.register_dependency_completed("src")
+    assert sorted(req.requested[1:]) == ["left", "right"]
+    sched.register_dependency_completed("left")
+    assert "sink" not in req.requested
+    sched.register_dependency_completed("right")
+    assert req.requested[-1] == "sink"

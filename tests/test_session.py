@@ -1,0 +1,176 @@
+"""Session state machine tests (reference model: TonySession semantics,
+TestUtils.testParseContainerRequests)."""
+
+import json
+
+import pytest
+
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.rpc.messages import TaskStatus
+from tony_tpu.session import (
+    TonySession, FinalStatus, EXIT_KILLED_BY_AM, parse_container_requests,
+)
+
+
+def make_conf(**jobs):
+    """make_conf(worker=2, ps=1, **extra_flat_keys)"""
+    conf = TonyConfiguration()
+    for job, n in jobs.items():
+        if job.startswith("tony_"):
+            conf.set(job[5:].replace("_", "."), n)
+        else:
+            conf.set(f"tony.{job}.instances", n)
+    return conf
+
+
+def test_parse_container_requests_unique_priorities():
+    conf = make_conf(worker=2, ps=1, evaluator=1)
+    conf.set("tony.worker.memory", "4g")
+    conf.set("tony.worker.tpus", 4)
+    reqs = parse_container_requests(conf)
+    assert set(reqs) == {"worker", "ps", "evaluator"}
+    assert len({r.priority for r in reqs.values()}) == 3
+    assert reqs["worker"].memory_mb == 4096
+    assert reqs["worker"].tpus == 4
+    assert reqs["ps"].num_instances == 1
+
+
+def test_parse_requests_zero_instances_skipped():
+    conf = make_conf(worker=2, ps=0)
+    assert set(parse_container_requests(conf)) == {"worker"}
+
+
+def test_parse_requests_unknown_dependency_rejected():
+    conf = make_conf(worker=1)
+    conf.set("tony.worker.depends-on", "ghost")
+    with pytest.raises(ValueError, match="unknown"):
+        parse_container_requests(conf)
+
+
+def test_stage_autofill_and_deps():
+    """prepare/training stages fold into depends_on
+    (Utils.ensureStagedTasksIntegrity, util/Utils.java:408-426)."""
+    conf = make_conf(prep=1, worker=2)
+    conf.set(K.APPLICATION_TRAINING_STAGE, "worker")
+    reqs = parse_container_requests(conf)
+    assert reqs["worker"].depends_on == ["prep"]
+    assert reqs["prep"].depends_on == []
+
+
+def test_stage_integrity_violation():
+    conf = make_conf(a=1, b=1, c=1)
+    conf.set(K.APPLICATION_PREPARE_STAGE, "a")
+    conf.set(K.APPLICATION_TRAINING_STAGE, "b")
+    with pytest.raises(ValueError, match="stages"):
+        parse_container_requests(conf)
+
+
+def test_rendezvous_barrier_and_cluster_spec():
+    session = TonySession(make_conf(worker=2, ps=1))
+    session.num_expected_tasks = 3
+    assert session.register_worker_spec("worker:0", "h0:1000") is None
+    assert session.register_worker_spec("ps:0", "h2:3000") is None
+    spec = session.register_worker_spec("worker:1", "h1:2000")
+    assert json.loads(spec) == {"worker": ["h0:1000", "h1:2000"],
+                                "ps": ["h2:3000"]}
+    # re-registration is idempotent
+    assert json.loads(session.register_worker_spec("worker:0", "h0:1000")) \
+        == json.loads(spec)
+
+
+def test_match_allocation_by_priority():
+    session = TonySession(make_conf(worker=2, ps=1))
+    prio = session.requests["worker"].priority
+    t1 = session.match_allocation(prio, "c1", "hostA")
+    t2 = session.match_allocation(prio, "c2", "hostB")
+    t3 = session.match_allocation(prio, "c3", "hostC")  # no third worker slot
+    assert t1.task_id == "worker:0" and t1.status == TaskStatus.RUNNING
+    assert t2.task_id == "worker:1"
+    assert t3 is None
+    assert session.match_allocation(999, "c4", "hostD") is None
+
+
+def test_chief_semantics():
+    s = TonySession(make_conf(worker=2, ps=1))
+    assert s.is_chief("worker", 0)
+    assert not s.is_chief("worker", 1)
+    assert not s.is_chief("ps", 0)
+    s2 = TonySession(make_conf(chief=1, worker=2))
+    assert s2.is_chief("chief", 0)
+    assert not s2.is_chief("worker", 0)
+
+
+def test_chief_failure_short_circuits():
+    s = TonySession(make_conf(worker=2))
+    s.on_task_completed("worker", 0, 1)
+    assert s.training_finished
+    assert s.final_status == FinalStatus.FAILED
+
+
+def test_nonchief_failure_tolerated_by_default():
+    """'succeeded with some worker failures' (TonySession.java:312-325)."""
+    s = TonySession(make_conf(worker=3))
+    s.on_task_completed("worker", 1, 1)
+    assert not s.training_finished
+    s.on_task_completed("worker", 0, 0)
+    s.on_task_completed("worker", 2, 0)
+    s.update_session_status()
+    assert s.final_status == FinalStatus.SUCCEEDED
+    assert "failedCnt=1" in s.final_message
+
+
+def test_all_workers_failed_fails():
+    s = TonySession(make_conf(worker=2))
+    s.on_task_completed("worker", 1, 1)
+    # worker:0 is chief — avoid short-circuit by failing only via index 1;
+    # complete chief with AM-kill then fail the other
+    s.on_task_completed("worker", 0, EXIT_KILLED_BY_AM)
+    s.update_session_status()
+    # killed-by-AM counts as non-zero exit in aggregation: 2 failures >= 2 tracked
+    assert s.final_status == FinalStatus.FAILED
+
+
+def test_fail_on_worker_failure_enabled():
+    conf = make_conf(worker=3)
+    conf.set(K.APPLICATION_FAIL_ON_WORKER_FAILURE, True)
+    s = TonySession(conf)
+    s.on_task_completed("worker", 2, 7)
+    assert s.training_finished
+    assert s.final_status == FinalStatus.FAILED
+
+
+def test_stop_on_failure_jobtypes():
+    conf = make_conf(worker=2, ps=1)
+    conf.set(K.APPLICATION_STOP_ON_FAILURE_JOBTYPES, "ps")
+    s = TonySession(conf)
+    s.on_task_completed("ps", 0, 3)
+    assert s.training_finished
+    assert s.final_status == FinalStatus.FAILED
+
+
+def test_untracked_jobtypes_excluded_from_aggregation():
+    conf = make_conf(worker=1, tb=1)
+    conf.set(K.APPLICATION_UNTRACKED_JOBTYPES, "tb")
+    s = TonySession(conf)
+    assert s.total_tracked_tasks() == 1
+    s.on_task_completed("worker", 0, 0)
+    assert s.all_tracked_tasks_completed()
+    s.update_session_status()
+    assert s.final_status == FinalStatus.SUCCEEDED
+
+
+def test_exit_status_set_once():
+    s = TonySession(make_conf(worker=1))
+    t = s.get_task("worker", 0)
+    t.set_exit_status(0)
+    t.set_exit_status(5)  # delayed container-completion callback must not win
+    assert t.exit_status == 0
+    assert t.status == TaskStatus.SUCCEEDED
+
+
+def test_incomplete_session_is_failed():
+    s = TonySession(make_conf(worker=2))
+    s.on_task_completed("worker", 0, 0)
+    s.update_session_status()
+    assert s.final_status == FinalStatus.FAILED
+    assert "hasn't finished" in s.final_message

@@ -1,0 +1,16 @@
+"""Event history subsystem (reference: tony-core avro schemas + events/EventHandler.java)."""
+
+from tony_tpu.events.schema import (
+    Event, EventType, ApplicationInited, ApplicationFinished,
+    TaskStarted, TaskFinished,
+)
+from tony_tpu.events.handler import EventHandler
+from tony_tpu.events.history import (
+    JobMetadata, history_file_name, parse_history_file_name,
+)
+
+__all__ = [
+    "Event", "EventType", "ApplicationInited", "ApplicationFinished",
+    "TaskStarted", "TaskFinished", "EventHandler",
+    "JobMetadata", "history_file_name", "parse_history_file_name",
+]

@@ -1,0 +1,62 @@
+"""History-file naming + job metadata.
+
+Equivalent of the reference's util/HistoryFileUtils.java:12-32 filename codec
+and models/JobMetadata.java:35-45: final history files are named
+`<appId>-<started>-<completed>-<user>-<STATUS>.jhist`; in-flight files are
+`<appId>-<started>-<user>.jhist.inprogress`.
+"""
+
+from __future__ import annotations
+
+import getpass
+import re
+from dataclasses import dataclass, field
+
+from tony_tpu import constants as C
+
+
+@dataclass
+class JobMetadata:
+    application_id: str
+    started: int = 0           # epoch ms
+    completed: int = 0         # epoch ms
+    user: str = field(default_factory=getpass.getuser)
+    status: str = "RUNNING"
+
+
+def inprogress_file_name(md: JobMetadata) -> str:
+    return f"{md.application_id}-{md.started}-{md.user}.{C.HISTORY_INPROGRESS_SUFFIX}"
+
+
+def history_file_name(md: JobMetadata) -> str:
+    """reference: HistoryFileUtils.generateFileName (HistoryFileUtils.java:12-32)."""
+    return (f"{md.application_id}-{md.started}-{md.completed}-{md.user}"
+            f"-{md.status}.{C.HISTORY_SUFFIX}")
+
+
+# Anchored on the numeric timestamp fields so hyphenated usernames parse
+# correctly (app ids use underscores, so the non-greedy app group is safe).
+_FINAL_RE = re.compile(
+    r"^(?P<app>.+?)-(?P<started>\d+)-(?P<completed>\d+)-(?P<user>.+)"
+    r"-(?P<status>[A-Z_]+)\." + re.escape(C.HISTORY_SUFFIX) + r"$")
+_INPROGRESS_RE = re.compile(
+    r"^(?P<app>.+?)-(?P<started>\d+)-(?P<user>.+)\."
+    + re.escape(C.HISTORY_INPROGRESS_SUFFIX) + r"$")
+
+
+def parse_history_file_name(name: str) -> JobMetadata:
+    """Parse either a final or an in-progress history file name back into
+    JobMetadata (reference: JobMetadata constructor parsing,
+    models/JobMetadata.java:35-45)."""
+    m = _INPROGRESS_RE.match(name)
+    if m:
+        return JobMetadata(application_id=m.group("app"),
+                           started=int(m.group("started")),
+                           user=m.group("user"), status="RUNNING")
+    m = _FINAL_RE.match(name)
+    if m:
+        return JobMetadata(application_id=m.group("app"),
+                           started=int(m.group("started")),
+                           completed=int(m.group("completed")),
+                           user=m.group("user"), status=m.group("status"))
+    raise ValueError(f"not a history file name: {name!r}")

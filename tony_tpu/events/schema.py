@@ -1,0 +1,90 @@
+"""Event log schema.
+
+Equivalent of the reference's Avro union
+(tony-core/src/main/avro/{Event,EventType,ApplicationInited,
+ApplicationFinished,TaskStarted,TaskFinished,Metric}.avsc) as dataclasses
+serialized to JSON lines. The union tag travels as `type`; `payload` holds
+the per-type record; `timestamp` is epoch millis, matching the reference's
+Event record shape.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Any, Union
+
+
+class EventType(str, enum.Enum):
+    APPLICATION_INITED = "APPLICATION_INITED"
+    APPLICATION_FINISHED = "APPLICATION_FINISHED"
+    TASK_STARTED = "TASK_STARTED"
+    TASK_FINISHED = "TASK_FINISHED"
+
+
+@dataclass
+class ApplicationInited:
+    """reference: ApplicationInited.avsc (appId, numTasks, host, containerId)."""
+    application_id: str
+    num_tasks: int
+    host: str
+    container_id: str = ""
+
+
+@dataclass
+class TaskStarted:
+    """reference: TaskStarted.avsc (taskType, taskIndex, host)."""
+    task_type: str
+    task_index: int
+    host: str
+    container_id: str = ""
+
+
+@dataclass
+class TaskFinished:
+    """reference: TaskFinished.avsc (taskType, taskIndex, status, metrics)."""
+    task_type: str
+    task_index: int
+    status: str
+    metrics: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class ApplicationFinished:
+    """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
+    application_id: str
+    status: str
+    num_failed_tasks: int = 0
+    metrics: list[dict] = field(default_factory=list)
+
+
+_PAYLOADS = {
+    EventType.APPLICATION_INITED: ApplicationInited,
+    EventType.APPLICATION_FINISHED: ApplicationFinished,
+    EventType.TASK_STARTED: TaskStarted,
+    EventType.TASK_FINISHED: TaskFinished,
+}
+
+Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted, TaskFinished]
+
+
+@dataclass
+class Event:
+    type: EventType
+    payload: Payload
+    timestamp: int = 0  # epoch ms; 0 = stamp at construction
+
+    def __post_init__(self):
+        if self.timestamp == 0:
+            self.timestamp = int(time.time() * 1000)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"type": self.type.value, "payload": asdict(self.payload),
+                "timestamp": self.timestamp}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Event":
+        etype = EventType(d["type"])
+        payload = _PAYLOADS[etype](**d["payload"])
+        return cls(type=etype, payload=payload, timestamp=int(d["timestamp"]))

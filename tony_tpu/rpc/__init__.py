@@ -1,0 +1,25 @@
+"""Control-plane RPC (reference: tony-core rpc/ + proto/).
+
+The reference ran two Hadoop-IPC/protobuf-2 protocols
+(`TensorFlowClusterService`, proto/tensorflow_cluster_service_protos.proto:11-20,
+and `MetricsRpc`). This build keeps the exact same method surface but carries
+it over gRPC with JSON-encoded dataclass messages — ~2,000 lines of PBImpl
+translator boilerplate in the reference collapse into `messages.py`.
+"""
+
+from tony_tpu.rpc.messages import TaskInfo, TaskStatus, Metric
+from tony_tpu.rpc.service import (
+    CLUSTER_SERVICE,
+    METRICS_SERVICE,
+    ClusterServiceHandler,
+    MetricsServiceHandler,
+    serve,
+)
+from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
+
+__all__ = [
+    "TaskInfo", "TaskStatus", "Metric",
+    "CLUSTER_SERVICE", "METRICS_SERVICE",
+    "ClusterServiceHandler", "MetricsServiceHandler", "serve",
+    "ClusterServiceClient", "MetricsServiceClient",
+]

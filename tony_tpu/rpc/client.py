@@ -1,0 +1,112 @@
+"""Retrying RPC clients for the control plane.
+
+Reference: rpc/impl/ApplicationRpcClient.java:47-76 — singleton client with a
+retry proxy (10 tries, 2 s sleep) so executors tolerate the AM's listen-socket
+arriving slightly after container launch. gRPC equivalent: per-call retry with
+configurable attempts/backoff + wait_for_ready.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+import grpc
+
+from tony_tpu.rpc.service import (
+    CLUSTER_SERVICE, METRICS_SERVICE, CLUSTER_METHODS, METRICS_METHODS,
+    _ser, _deser,
+)
+
+DEFAULT_RETRIES = 10
+DEFAULT_RETRY_SLEEP_SEC = 2.0
+
+
+class _JsonRpcClient:
+    def __init__(self, service: str, methods: tuple[str, ...],
+                 host: str, port: int,
+                 retries: int = DEFAULT_RETRIES,
+                 retry_sleep_sec: float = DEFAULT_RETRY_SLEEP_SEC,
+                 timeout_sec: float = 30.0):
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._retries = retries
+        self._retry_sleep_sec = retry_sleep_sec
+        self._timeout_sec = timeout_sec
+        self._stubs = {
+            m: self._channel.unary_unary(
+                f"/{service}/{m}",
+                request_serializer=_ser,
+                response_deserializer=_deser,
+            )
+            for m in methods
+        }
+
+    # Only transient transport statuses are worth retrying; anything else
+    # (UNKNOWN from a handler exception, INVALID_ARGUMENT, ...) is a real
+    # error that retrying would only mask.
+    _RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+
+    def call(self, method: str, req: Optional[dict] = None) -> Any:
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                return self._stubs[method](req or {}, timeout=self._timeout_sec,
+                                           wait_for_ready=True)
+            except grpc.RpcError as e:
+                if e.code() not in self._RETRYABLE:
+                    raise
+                last_err = e
+                if attempt + 1 < self._retries:
+                    time.sleep(self._retry_sleep_sec)
+        raise ConnectionError(
+            f"RPC {method} failed after {self._retries} attempts: {last_err}")
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class ClusterServiceClient(_JsonRpcClient):
+    """Client for the 7-method cluster control plane."""
+
+    def __init__(self, host: str, port: int, **kw):
+        super().__init__(CLUSTER_SERVICE, CLUSTER_METHODS, host, port, **kw)
+
+    def get_task_infos(self) -> list[dict]:
+        return self.call("get_task_infos", {})
+
+    def get_cluster_spec(self, task_id: str) -> Optional[dict]:
+        spec = self.call("get_cluster_spec", {"task_id": task_id}).get("spec")
+        return json.loads(spec) if spec else None
+
+    def register_worker_spec(self, task_id: str, spec: str) -> Optional[dict]:
+        """Gang barrier: returns the full cluster spec once everyone has
+        registered, else None (reference: TaskExecutor.java:295-309 poll)."""
+        resp = self.call("register_worker_spec", {"task_id": task_id, "spec": spec})
+        spec_json = resp.get("spec")
+        return json.loads(spec_json) if spec_json else None
+
+    def register_tensorboard_url(self, task_id: str, url: str) -> None:
+        self.call("register_tensorboard_url", {"task_id": task_id, "url": url})
+
+    def register_execution_result(self, exit_code: int, job_name: str,
+                                  job_index: int, session_id: int) -> None:
+        self.call("register_execution_result", {
+            "exit_code": exit_code, "job_name": job_name,
+            "job_index": job_index, "session_id": session_id})
+
+    def finish_application(self) -> None:
+        self.call("finish_application", {})
+
+    def task_executor_heartbeat(self, task_id: str) -> None:
+        self.call("task_executor_heartbeat", {"task_id": task_id})
+
+
+class MetricsServiceClient(_JsonRpcClient):
+    def __init__(self, host: str, port: int, **kw):
+        super().__init__(METRICS_SERVICE, METRICS_METHODS, host, port, **kw)
+
+    def update_metrics(self, task_type: str, index: int,
+                       metrics: list[dict]) -> None:
+        self.call("update_metrics", {
+            "task_type": task_type, "index": index, "metrics": metrics})

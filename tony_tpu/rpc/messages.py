@@ -1,0 +1,70 @@
+"""Control-plane message types.
+
+Equivalent of the reference's rpc/TaskInfo.java:15-80, rpc/impl/TaskStatus.java,
+and the proto messages in proto/yarn_tensorflow_cluster_protos.proto, as plain
+dataclasses with dict codecs (the gRPC layer carries them as JSON).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, asdict
+from typing import Any
+
+
+class TaskStatus(str, enum.Enum):
+    """Task lifecycle (reference: rpc/impl/TaskStatus.java)."""
+    NEW = "NEW"
+    SCHEDULED = "SCHEDULED"
+    REQUESTED = "REQUESTED"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    FINISHED = "FINISHED"  # killed by the AM; completed but not a failure
+
+    def is_terminal(self) -> bool:
+        return self in (TaskStatus.SUCCEEDED, TaskStatus.FAILED,
+                        TaskStatus.FINISHED)
+
+
+@dataclass
+class TaskInfo:
+    """Mirrors rpc/TaskInfo.java:15-80: (name, index, url, status)."""
+    name: str
+    index: int
+    url: str = ""
+    status: TaskStatus = TaskStatus.NEW
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.name}:{self.index}"
+
+    def to_dict(self) -> dict[str, Any]:
+        d = asdict(self)
+        d["status"] = self.status.value
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TaskInfo":
+        return cls(name=d["name"], index=int(d["index"]), url=d.get("url", ""),
+                   status=TaskStatus(d.get("status", "NEW")))
+
+
+@dataclass
+class Metric:
+    """One sampled metric (reference: rpc/MetricWritable.java)."""
+    name: str
+    value: float
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"name": self.name, "value": self.value}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Metric":
+        return cls(name=d["name"], value=float(d["value"]))
+
+
+def parse_task_id(task_id: str) -> tuple[str, int]:
+    """'worker:1' -> ('worker', 1)."""
+    name, _, idx = task_id.rpartition(":")
+    return name, int(idx)

@@ -1,0 +1,120 @@
+"""gRPC service definitions for the two control-plane protocols.
+
+The reference defined `TensorFlowClusterService` with exactly 7 RPCs
+(proto/tensorflow_cluster_service_protos.proto:11-20) served over Hadoop IPC
+(rpc/ApplicationRpcServer.java:118-136) plus a second `MetricsRpc` protocol
+(rpc/impl/MetricsRpcServer.java:22-56). This module keeps that method surface
+verbatim but registers the handlers through grpc's generic-handler API with
+JSON payloads — no protoc codegen needed, and the messages stay inspectable.
+
+Handlers are plain Python objects implementing the abstract interfaces below;
+the AM wires its session state into them (ApplicationMaster.RpcForClient,
+ApplicationMaster.java:787-932 equivalent).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+from concurrent import futures
+from typing import Any, Optional
+
+import grpc
+
+CLUSTER_SERVICE = "tony.ClusterService"
+METRICS_SERVICE = "tony.MetricsService"
+
+# The 7 methods of the reference's TensorFlowClusterService, same names
+# modulo snake_case (proto/tensorflow_cluster_service_protos.proto:11-20).
+CLUSTER_METHODS = (
+    "get_task_infos",
+    "get_cluster_spec",
+    "register_worker_spec",
+    "register_tensorboard_url",
+    "register_execution_result",
+    "finish_application",
+    "task_executor_heartbeat",
+)
+METRICS_METHODS = ("update_metrics",)
+
+
+def _ser(obj: Any) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+def _deser(data: bytes) -> Any:
+    return json.loads(data.decode("utf-8")) if data else {}
+
+
+class ClusterServiceHandler(abc.ABC):
+    """AM-side implementation surface for the cluster control plane."""
+
+    @abc.abstractmethod
+    def get_task_infos(self, req: dict) -> list[dict]:
+        """-> [TaskInfo dict] (reference: getTaskInfos)."""
+
+    @abc.abstractmethod
+    def get_cluster_spec(self, req: dict) -> dict:
+        """req: {task_id} -> {"spec": json-str|None} (reference: getClusterSpec)."""
+
+    @abc.abstractmethod
+    def register_worker_spec(self, req: dict) -> dict:
+        """req: {task_id, spec} -> {"spec": json-str|None}. Returns None spec
+        until ALL expected tasks have registered — the gang-rendezvous barrier
+        (reference: ApplicationMaster.java:840-888)."""
+
+    @abc.abstractmethod
+    def register_tensorboard_url(self, req: dict) -> dict:
+        """req: {task_id, url} -> {}."""
+
+    @abc.abstractmethod
+    def register_execution_result(self, req: dict) -> dict:
+        """req: {exit_code, job_name, job_index, session_id} -> {}."""
+
+    @abc.abstractmethod
+    def finish_application(self, req: dict) -> dict:
+        """client tells AM to shut down -> {}."""
+
+    @abc.abstractmethod
+    def task_executor_heartbeat(self, req: dict) -> dict:
+        """req: {task_id} -> {}."""
+
+
+class MetricsServiceHandler(abc.ABC):
+    @abc.abstractmethod
+    def update_metrics(self, req: dict) -> dict:
+        """req: {task_type, index, metrics: [Metric dict]} -> {}."""
+
+
+def _generic_handler(service_name: str, handler: Any, methods: tuple[str, ...]):
+    rpc_handlers = {}
+    for method in methods:
+        fn = getattr(handler, method)
+
+        def unary(req, ctx, _fn=fn):
+            return _fn(req)
+
+        rpc_handlers[method] = grpc.unary_unary_rpc_method_handler(
+            unary, request_deserializer=_deser, response_serializer=_ser)
+    return grpc.method_handlers_generic_handler(service_name, rpc_handlers)
+
+
+def serve(cluster_handler: Optional[ClusterServiceHandler] = None,
+          metrics_handler: Optional[MetricsServiceHandler] = None,
+          host: str = "0.0.0.0", port: int = 0,
+          max_workers: int = 16) -> tuple[grpc.Server, int]:
+    """Start a gRPC server hosting either or both services on `port`
+    (0 = ephemeral, the reference's random-port behavior,
+    ApplicationRpcServer.java:118-127). Returns (server, bound_port)."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    if cluster_handler is not None:
+        server.add_generic_rpc_handlers(
+            (_generic_handler(CLUSTER_SERVICE, cluster_handler, CLUSTER_METHODS),))
+    if metrics_handler is not None:
+        server.add_generic_rpc_handlers(
+            (_generic_handler(METRICS_SERVICE, metrics_handler, METRICS_METHODS),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise RuntimeError(f"failed to bind RPC server on {host}:{port}")
+    server.start()
+    return server, bound

@@ -1,0 +1,290 @@
+"""TonySession: in-AM job state machine.
+
+Equivalent of the reference's tensorflow/TonySession.java:43-561 —
+task table per jobtype, allocation→task matching by priority, cluster-spec
+construction, chief semantics (:364-367), exit-code→status transitions
+(:480-497), failure short-circuit policy (:251-271), final-status aggregation
+including "succeed despite some worker failures" (:276-330), and
+tracked/untracked accounting.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import logging
+import threading
+from typing import Optional
+
+from tony_tpu import constants as C
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.rpc.messages import TaskInfo, TaskStatus
+from tony_tpu.session.requests import JobContainerRequest, parse_container_requests
+
+LOG = logging.getLogger(__name__)
+
+# Exit code the AM uses when it kills a container itself. Such exits get
+# status FINISHED (not FAILED) and never trigger the failure short-circuit,
+# but they DO count as failures in the final aggregation when
+# fail-on-worker-failure is enabled — the reference deliberately counts them
+# there "to capture any worker task that was killed by the application master
+# which was not short circuited" (TonySession.java:316-320, 485-488).
+# YARN's value is -105; kept for parity.
+EXIT_KILLED_BY_AM = -105
+
+
+class FinalStatus(str, enum.Enum):
+    UNDEFINED = "UNDEFINED"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+
+class Task:
+    """One task slot (reference: TonySession.TonyTask, TonySession.java:440+)."""
+
+    def __init__(self, job_name: str, index: int, session_id: int):
+        self.job_name = job_name
+        self.index = index
+        self.session_id = session_id
+        self.host: str = ""
+        self.port: int = -1
+        self.container_id: str = ""
+        self.url: str = ""
+        self.completed = False
+        self._exit_status: Optional[int] = None
+        self.status = TaskStatus.NEW
+        self._lock = threading.Lock()
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.job_name}:{self.index}"
+
+    @property
+    def host_port(self) -> str:
+        return f"{self.host}:{self.port if self.port >= 0 else 0}"
+
+    @property
+    def exit_status(self) -> Optional[int]:
+        return self._exit_status
+
+    def set_host_port(self, host_port: str) -> None:
+        host, _, port = host_port.rpartition(":")
+        self.host, self.port = host, int(port)
+
+    def set_exit_status(self, status: int) -> None:
+        """Settable exactly once — late container-completion callbacks must not
+        overwrite the executor-registered result (TonySession.java:480-497)."""
+        with self._lock:
+            if self._exit_status is not None:
+                return
+            self._exit_status = status
+            if status == 0:
+                self.status = TaskStatus.SUCCEEDED
+            elif status == EXIT_KILLED_BY_AM:
+                self.status = TaskStatus.FINISHED
+            else:
+                self.status = TaskStatus.FAILED
+            self.completed = True
+
+    def to_task_info(self) -> TaskInfo:
+        return TaskInfo(self.job_name, self.index, self.url, self.status)
+
+    def __repr__(self):
+        return f"Task({self.task_id}, {self.status.value})"
+
+
+class TonySession:
+    """Session state machine; one per AM attempt (new instance on AM retry,
+    reference: ApplicationMaster.reset, ApplicationMaster.java:558-574)."""
+
+    def __init__(self, conf: TonyConfiguration, session_id: int = 0):
+        self.conf = conf
+        self.session_id = session_id
+        self.requests: dict[str, JobContainerRequest] = parse_container_requests(conf)
+        self.job_tasks: dict[str, list[Task]] = {
+            job: [Task(job, i, session_id) for i in range(req.num_instances)]
+            for job, req in self.requests.items()
+        }
+        self._untracked = set(conf.get_strings(K.APPLICATION_UNTRACKED_JOBTYPES))
+        self._stop_on_failure = set(
+            conf.get_strings(K.APPLICATION_STOP_ON_FAILURE_JOBTYPES))
+        self._fail_on_worker_failure = conf.get_bool(
+            K.APPLICATION_FAIL_ON_WORKER_FAILURE, False)
+        self.num_expected_tasks = 0       # bumped as the scheduler submits jobs
+        self.training_finished = False    # failure short-circuit flag
+        self.final_status = FinalStatus.UNDEFINED
+        self.final_message: Optional[str] = None
+        self._registered: dict[str, str] = {}   # task_id -> host:port
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    # task lookup / allocation matching
+    # ------------------------------------------------------------------
+    def get_task(self, job_name: str, index: int) -> Optional[Task]:
+        tasks = self.job_tasks.get(job_name)
+        if tasks is None or not (0 <= index < len(tasks)):
+            return None
+        return tasks[index]
+
+    def get_task_by_id(self, task_id: str) -> Optional[Task]:
+        name, _, idx = task_id.rpartition(":")
+        try:
+            return self.get_task(name, int(idx))
+        except ValueError:
+            return None
+
+    def match_allocation(self, priority: int, container_id: str,
+                         host: str) -> Optional[Task]:
+        """Match an allocated container to the next unassigned task of the
+        jobtype carrying `priority` (reference: getAndInitMatchingTaskByPriority,
+        TonySession.java:208-224 — priorities are unique per jobtype)."""
+        with self._lock:
+            for job, req in self.requests.items():
+                if req.priority != priority:
+                    continue
+                for task in self.job_tasks[job]:
+                    if not task.container_id:
+                        task.container_id = container_id
+                        task.host = host
+                        task.status = TaskStatus.RUNNING
+                        return task
+            return None
+
+    # ------------------------------------------------------------------
+    # rendezvous
+    # ------------------------------------------------------------------
+    def register_worker_spec(self, task_id: str, host_port: str) -> Optional[str]:
+        """Record a worker's host:port. Returns the full cluster-spec JSON once
+        ALL expected tasks have registered, else None — the gang barrier
+        (reference: ApplicationMaster.java:840-888)."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                LOG.warning("registration from unknown task %s", task_id)
+                return None
+            task.set_host_port(host_port)
+            if task_id not in self._registered:
+                LOG.info("registered %s at %s (%d/%d)", task_id, host_port,
+                         len(self._registered) + 1, self.num_expected_tasks)
+            elif self._registered[task_id] != task.host_port:
+                # executor restarted and rebound: refresh the address so the
+                # spec never points peers at a dead port
+                LOG.warning("task %s re-registered at %s (was %s)", task_id,
+                            task.host_port, self._registered[task_id])
+            self._registered[task_id] = task.host_port
+            return self.cluster_spec_json()
+
+    def all_tasks_registered(self) -> bool:
+        with self._lock:
+            return (self.num_expected_tasks > 0
+                    and len(self._registered) >= self.num_expected_tasks)
+
+    def cluster_spec_json(self) -> Optional[str]:
+        """JSON {jobtype: ["host:port", ...]} over registered tasks, or None
+        while the barrier is open (TonySession.getClusterSpec,
+        TonySession.java:226-246)."""
+        with self._lock:
+            if not self.all_tasks_registered():
+                return None
+            spec: dict[str, list[str]] = {}
+            for job, tasks in self.job_tasks.items():
+                entries = [t.host_port for t in tasks if t.task_id in self._registered]
+                if entries:
+                    spec[job] = entries
+            return json.dumps(spec)
+
+    # ------------------------------------------------------------------
+    # policy predicates
+    # ------------------------------------------------------------------
+    def is_chief(self, job_name: str, index: int) -> bool:
+        """chief:* is chief; else worker:0 when no chief jobtype exists
+        (TonySession.java:364-367)."""
+        if job_name == C.CHIEF_JOB_NAME:
+            return True
+        return (C.CHIEF_JOB_NAME not in self.job_tasks
+                and job_name == C.WORKER_JOB_NAME and index == 0)
+
+    def is_tracked(self, job_name: str) -> bool:
+        return job_name not in self._untracked
+
+    def total_tracked_tasks(self) -> int:
+        return sum(len(t) for j, t in self.job_tasks.items() if self.is_tracked(j))
+
+    def num_completed_tracked_tasks(self) -> int:
+        return sum(1 for j, tasks in self.job_tasks.items() if self.is_tracked(j)
+                   for t in tasks if t.completed)
+
+    def all_tracked_tasks_completed(self) -> bool:
+        return self.num_completed_tracked_tasks() >= self.total_tracked_tasks()
+
+    # ------------------------------------------------------------------
+    # completion + final status
+    # ------------------------------------------------------------------
+    def on_task_completed(self, job_name: str, index: int, exit_code: int) -> None:
+        """Record an exit code; short-circuit the session on chief failure,
+        stop-on-failure jobtypes, or fail-on-worker-failure
+        (TonySession.onTaskCompleted, TonySession.java:251-271)."""
+        task = self.get_task(job_name, index)
+        if task is None:
+            LOG.error("completion for unknown task %s:%s", job_name, index)
+            return
+        LOG.info("task %s exited with %d", task.task_id, exit_code)
+        task.set_exit_status(exit_code)
+        if exit_code not in (0, EXIT_KILLED_BY_AM):
+            if (self.is_chief(job_name, index)
+                    or job_name in self._stop_on_failure
+                    or self._fail_on_worker_failure):
+                self.training_finished = True
+                self.set_final_status(FinalStatus.FAILED,
+                                      f"Exit status: {exit_code}")
+
+    def update_session_status(self) -> None:
+        """Aggregate the final status over tracked tasks
+        (TonySession.updateSessionStatus, TonySession.java:276-330)."""
+        if self.final_status == FinalStatus.FAILED:
+            return
+        failure_count = 0
+        for job, tasks in self.job_tasks.items():
+            if not self.is_tracked(job):
+                continue
+            for task in tasks:
+                if not task.completed:
+                    self.set_final_status(
+                        FinalStatus.FAILED,
+                        f"Task {task.task_id} hasn't finished yet.")
+                    return
+                if task.exit_status != 0:
+                    failure_count += 1
+        if failure_count > 0:
+            if (self._fail_on_worker_failure
+                    or failure_count >= self.total_tracked_tasks()):
+                self.set_final_status(
+                    FinalStatus.FAILED,
+                    f"At least one task exited non-zero, failedCnt={failure_count}")
+            else:
+                # "succeeded with some worker failures"
+                self.set_final_status(
+                    FinalStatus.SUCCEEDED,
+                    f"Completed with some failed tasks, failedCnt={failure_count}")
+        else:
+            self.set_final_status(FinalStatus.SUCCEEDED, None)
+
+    def set_final_status(self, status: FinalStatus, message: Optional[str]) -> None:
+        self.final_status = status
+        self.final_message = message
+
+    # ------------------------------------------------------------------
+    # views
+    # ------------------------------------------------------------------
+    def get_task_infos(self) -> list[TaskInfo]:
+        return [t.to_task_info() for tasks in self.job_tasks.values()
+                for t in tasks]
+
+    def num_failed_tasks(self) -> int:
+        return sum(1 for tasks in self.job_tasks.values()
+                   for t in tasks if t.status == TaskStatus.FAILED)
+
+    def running_tasks(self) -> list[Task]:
+        return [t for tasks in self.job_tasks.values() for t in tasks
+                if t.container_id and not t.completed]
