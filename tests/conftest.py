@@ -4,18 +4,26 @@ Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
 run without TPU hardware (the tony-mini / MiniYARNCluster analogue for the
 compute plane — SURVEY.md §4 takeaway). Must run before the first jax import
 anywhere in the test process.
+
+Also scrubs single-claim accelerator-tunnel env (PALLAS_AXON_POOL_IPS-style):
+the orchestrator E2E suite spawns many python processes (AM, executors, user
+scripts), and a single-claim TPU tunnel hangs every process after the first
+at interpreter start. Control-plane processes must never claim an
+accelerator; test user-processes run on CPU.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Control-plane subprocesses must not touch accelerators (children inherit).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Child processes spawned by e2e tests inherit these via os.environ.
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

@@ -1,0 +1,16 @@
+"""Fixture: assert the TF runtime env was rendered
+(reference: scripts/check_env_and_venv.py)."""
+import json
+import os
+import sys
+
+spec = json.loads(os.environ["CLUSTER_SPEC"])
+tf_config = json.loads(os.environ["TF_CONFIG"])
+assert "worker" in spec and len(spec["worker"]) >= 1, spec
+assert tf_config["task"]["type"] == os.environ["JOB_NAME"]
+assert tf_config["task"]["index"] == int(os.environ["TASK_INDEX"])
+assert tf_config["cluster"] == spec
+for entry in spec["worker"]:
+    host, _, port = entry.rpartition(":")
+    assert host and int(port) > 0, entry
+sys.exit(0)

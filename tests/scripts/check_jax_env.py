@@ -1,0 +1,13 @@
+"""Fixture: assert the JAX/TPU runtime env was rendered (new capability —
+no reference equivalent; consumed by jax.distributed.initialize)."""
+import os
+import sys
+
+addr = os.environ["JAX_COORDINATOR_ADDRESS"]
+host, _, port = addr.rpartition(":")
+assert host and int(port) > 0, addr
+pid = int(os.environ["JAX_PROCESS_ID"])
+n = int(os.environ["JAX_NUM_PROCESSES"])
+assert 0 <= pid < n, (pid, n)
+assert int(os.environ["TPU_NUM_SLICES"]) >= 1
+sys.exit(0)

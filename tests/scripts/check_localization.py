@@ -1,0 +1,9 @@
+"""Fixture: staged resources must be materialized in the task cwd
+(reference: scripts/check_archive_file_localization.py)."""
+import os
+import sys
+
+assert os.path.isfile("common.txt"), os.listdir(".")
+assert os.path.isdir("archive_dir"), os.listdir(".")
+assert os.path.isfile(os.path.join("archive_dir", "inner.txt"))
+sys.exit(0)

@@ -1,0 +1,12 @@
+"""Fixture: assert the PyTorch runtime env was rendered
+(reference: scripts/exit_0_check_pytorchenv.py)."""
+import os
+import sys
+
+assert os.environ["INIT_METHOD"].startswith("tcp://"), os.environ["INIT_METHOD"]
+rank = int(os.environ["RANK"])
+world = int(os.environ["WORLD"])
+assert 0 <= rank < world, (rank, world)
+assert os.environ["MASTER_ADDR"]
+assert int(os.environ["MASTER_PORT"]) > 0
+sys.exit(0)

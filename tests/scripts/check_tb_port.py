@@ -1,0 +1,8 @@
+"""Fixture: TB_PORT must be set on the chief only
+(reference: scripts/check_tb_port_set_in_chief_only.py)."""
+import os
+import sys
+
+is_chief = os.environ.get("IS_CHIEF", "false") == "true"
+has_tb = "TB_PORT" in os.environ
+sys.exit(0 if is_chief == has_tb else 1)

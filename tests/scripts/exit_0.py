@@ -1,0 +1,3 @@
+"""Fixture: succeed immediately (reference: src/test/resources/scripts/exit_0.py)."""
+import sys
+sys.exit(0)

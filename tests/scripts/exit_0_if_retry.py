@@ -1,0 +1,5 @@
+"""Fixture: fail on the first AM session, succeed on retry — exercises the
+session retry loop (reference: AM retry E2E scenarios)."""
+import os
+import sys
+sys.exit(1 if os.environ.get("ATTEMPT_NUMBER", "0") == "0" else 0)

@@ -1,0 +1,3 @@
+"""Fixture: fail immediately (reference: scripts/exit_1.py)."""
+import sys
+sys.exit(1)

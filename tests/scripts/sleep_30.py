@@ -1,0 +1,3 @@
+"""Fixture: long-running task the AM must manage (reference: scripts/sleep_30.py)."""
+import time
+time.sleep(30)

@@ -1,0 +1,10 @@
+"""Fixture: write a per-task marker file so tests can assert execution order/env."""
+import os
+import sys
+
+marker_dir = os.environ["MARKER_DIR"]
+os.makedirs(marker_dir, exist_ok=True)
+name = f"{os.environ['JOB_NAME']}_{os.environ['TASK_INDEX']}"
+with open(os.path.join(marker_dir, name), "w") as f:
+    f.write(str(os.times()[4]))
+sys.exit(0)

@@ -1,0 +1,357 @@
+"""Full-stack E2E suite: real client → AM → executor → user python processes.
+
+Equivalent of the reference's crown jewel TestTonyE2E.java:89-484, which ran
+real TonyClient→AM→TaskExecutor→python chains on an in-process MiniCluster
+(3 NodeManagers). Here the LocalClusterBackend plays MiniCluster: every test
+spawns the genuine AM and executor processes and a real user script from
+tests/scripts/. Fault injection uses the same env hooks the reference
+compiled into prod code (Constants.java:116-121).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.client.tony_client import TonyClient
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.events.handler import parse_events
+from tony_tpu.events.schema import EventType
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+def script(name: str) -> str:
+    return os.path.join(SCRIPTS, name)
+
+
+def fast_conf(tmp_path, **overrides) -> TonyConfiguration:
+    """Test-scale cadences: the reference's 1s/5s/25-missed defaults shrunk so
+    the suite stays fast; expiry window = 0.2s * 25 = 5s."""
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_WORKDIR, str(tmp_path), "test")
+    conf.set(K.AM_MONITOR_INTERVAL_MS, 100, "test")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "test")
+    conf.set(K.TASK_MAX_MISSED_HEARTBEATS, 25, "test")
+    conf.set(K.TASK_METRICS_INTERVAL_MS, 500, "test")
+    conf.set(K.TASK_REGISTRATION_TIMEOUT_SEC, 60, "test")
+    conf.set(K.CONTAINER_ALLOCATION_TIMEOUT, 60_000, "test")
+    conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 3000, "test")
+    for k, v in overrides.items():
+        conf.set(k, v, "test")
+    return conf
+
+
+def run_job(tmp_path, argv: list[str], conf_overrides=None,
+            listeners=None) -> TonyClient:
+    conf = fast_conf(tmp_path, **(conf_overrides or {}))
+    client = TonyClient(conf)
+    for listener in listeners or []:
+        client.add_listener(listener)
+    client.init(argv)
+    client.run()
+    return client
+
+
+def history_events(client: TonyClient):
+    hist_dir = os.path.join(client.app_dir, C.HISTORY_DIR_NAME)
+    finals = [f for f in os.listdir(hist_dir) if f.endswith(".jhist")]
+    assert len(finals) == 1, os.listdir(hist_dir)
+    return finals[0], parse_events(os.path.join(hist_dir, finals[0]))
+
+
+# ---------------------------------------------------------------------------
+# happy paths (TestTonyE2E single/ps-worker pass cases)
+# ---------------------------------------------------------------------------
+
+def test_worker_training_should_pass(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=2"])
+    assert client.final_status == "SUCCEEDED"
+
+
+def test_tf_env_rendered(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_env.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.framework=tensorflow"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_pytorch_env_rendered(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_pytorch_env.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.framework=pytorch"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_jax_env_rendered(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_jax_env.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_tb_port_set_in_chief_only(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_tb_port.py"),
+         "--conf", "tony.chief.instances=1",
+         "--conf", "tony.worker.instances=2"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_worker_training_should_fail(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_1.py"),
+         "--conf", "tony.worker.instances=1"])
+    assert client.final_status == "FAILED"
+
+
+def test_succeed_despite_some_worker_failures(tmp_path):
+    """Non-chief worker failure tolerated when fail-on-worker-failure is off
+    (TonySession.java:276-330 'succeeded with some failed tasks')."""
+    client = run_job(
+        tmp_path,
+        ["--conf", "tony.chief.instances=1",
+         "--conf", "tony.worker.instances=2",
+         "--conf", f"tony.chief.command=python {script('exit_0.py')}",
+         "--conf", f"tony.worker.command=bash -c 'exit $TASK_INDEX'"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    assert "failedCnt=1" in (client.final_message or "")
+
+
+def test_fail_on_worker_failure_enabled(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--conf", "tony.chief.instances=1",
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.fail-on-worker-failure-enabled=true",
+         "--conf", f"tony.chief.command=python {script('sleep_30.py')}",
+         "--conf", f"tony.worker.command=bash -c 'exit $TASK_INDEX'"])
+    assert client.final_status == "FAILED"
+
+
+# ---------------------------------------------------------------------------
+# fault injection (TestTonyE2E tiers 3)
+# ---------------------------------------------------------------------------
+
+def test_missed_heartbeats_should_fail(tmp_path, monkeypatch):
+    """(reference: testPSWorkerTrainingShouldFailMissedHeartbeat,
+    TestTonyE2E.java:142-158)."""
+    monkeypatch.setenv(C.TEST_TASK_EXECUTOR_NUM_HB_MISS, "100")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("sleep_30.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.task.max-missed-heartbeats=5"])
+    assert client.final_status == "FAILED"
+    assert "missed" in (client.final_message or "")
+
+
+def test_skewed_worker_should_pass(tmp_path, monkeypatch):
+    """(reference: testPSSkewedWorkerTrainingShouldPass,
+    TestTonyE2E.java:161-176)."""
+    monkeypatch.setenv(C.TEST_TASK_EXECUTOR_SKEW, "worker#0#2000")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=2"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_am_crash_should_fail(tmp_path, monkeypatch):
+    """(reference: testAMCrashTonyShouldFail, TestTonyE2E.java:240-252)."""
+    monkeypatch.setenv(C.TEST_AM_CRASH, "1")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=1"])
+    assert client.final_status == "FAILED"
+
+
+def test_workers_killed_should_fail(tmp_path, monkeypatch):
+    """(reference: testAMStopsJobAfterWorker0Killed, TestTonyE2E.java:282-288)."""
+    monkeypatch.setenv(C.TEST_WORKER_TERMINATION, "1")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("sleep_30.py"),
+         "--conf", "tony.worker.instances=2"])
+    assert client.final_status == "FAILED"
+
+
+def test_delayed_completion_notification(tmp_path, monkeypatch):
+    """Clean executor exit + delayed container-completion callback must NOT
+    turn into a failure (reference: testTaskCompletionNotificationDelayed,
+    TestTonyE2E.java:362-378; race rationale ApplicationMaster.java:890-918)."""
+    monkeypatch.setenv(C.TEST_TASK_COMPLETION_NOTIFICATION_DELAYED, "2")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=1"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_untracked_jobtype_crash_fails_app(tmp_path):
+    """(reference: untracked-task crash detection prevents hangups,
+    ApplicationMaster.java:1192-1195, TestTonyE2E.java:418-447)."""
+    client = run_job(
+        tmp_path,
+        ["--conf", "tony.worker.instances=1",
+         "--conf", "tony.sidecar.instances=1",
+         "--conf", "tony.application.untracked.jobtypes=sidecar",
+         "--conf", f"tony.worker.command=python {script('sleep_30.py')}",
+         "--conf", f"tony.sidecar.command=python {script('exit_1.py')}"])
+    assert client.final_status == "FAILED"
+    assert "untracked" in (client.final_message or "")
+
+
+def test_am_retry_recovers(tmp_path):
+    """Whole-session retry (ApplicationMaster.java:336-370,558-574): first
+    session fails, second succeeds because ATTEMPT_NUMBER advanced."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0_if_retry.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.am.retry-count=2"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+# ---------------------------------------------------------------------------
+# scheduling / DAG (reference: testTonyAMSchedulerShouldPass)
+# ---------------------------------------------------------------------------
+
+def test_dag_scheduling_order(tmp_path):
+    marker_dir = str(tmp_path / "markers")
+    client = run_job(
+        tmp_path,
+        ["--conf", "tony.prep.instances=1",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.worker.depends-on=prep",
+         "--conf", f"tony.prep.command=python {script('write_marker.py')}",
+         "--conf", f"tony.worker.command=python {script('write_marker.py')}",
+         "--conf", f"tony.execution.env=MARKER_DIR={marker_dir}"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    markers = sorted(os.listdir(marker_dir))
+    assert markers == ["prep_0", "worker_0"]
+
+
+def test_cyclic_dag_fails(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--conf", "tony.a.instances=1",
+         "--conf", "tony.b.instances=1",
+         "--conf", "tony.a.depends-on=b",
+         "--conf", "tony.b.depends-on=a",
+         "--conf", f"tony.a.command=python {script('exit_0.py')}",
+         "--conf", f"tony.b.command=python {script('exit_0.py')}"])
+    assert client.final_status == "FAILED"
+
+
+# ---------------------------------------------------------------------------
+# localization, events, listeners, single-node
+# ---------------------------------------------------------------------------
+
+def test_resource_localization_formats(tmp_path):
+    """(reference: testLocalizationFormats, TestTonyE2E.java:323-340)."""
+    res_dir = tmp_path / "resources"
+    res_dir.mkdir()
+    (res_dir / "common.txt").write_text("hello")
+    archive = tmp_path / "archive_dir"
+    archive.mkdir()
+    (archive / "inner.txt").write_text("inner")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_localization.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", f"tony.worker.resources={res_dir / 'common.txt'},"
+                   f"{archive}"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_history_events_written(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=2"])
+    name, events = history_events(client)
+    assert "SUCCEEDED" in name
+    types = [e.type for e in events]
+    assert types[0] == EventType.APPLICATION_INITED
+    assert types.count(EventType.TASK_STARTED) == 2
+    assert types.count(EventType.TASK_FINISHED) == 2
+    assert types[-1] == EventType.APPLICATION_FINISHED
+
+
+def test_client_listener_callbacks(tmp_path):
+    """(reference: client callbacks/listeners, TestTonyE2E.java:381-415)."""
+    seen = []
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=1"],
+        listeners=[lambda infos: seen.append(
+            {i.task_id: i.status.value for i in infos})])
+    assert client.final_status == "SUCCEEDED"
+    assert seen, "listener never invoked"
+    assert any("worker:0" in snap for snap in seen)
+
+
+def test_single_node_mode(tmp_path):
+    """AM runs the command itself (doPreprocessingJob/single-node,
+    ApplicationMaster.java:713-765)."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.application.single-node=true"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_final_conf_artifact(tmp_path):
+    """The frozen conf must ship every layer merged
+    (reference: testTonyFinalConf, TestTonyE2E.java:457-482)."""
+    conf_file = tmp_path / "job.json"
+    conf_file.write_text(json.dumps({
+        "tony.worker.instances": 1,
+        "tony.application.name": "from-file",
+    }))
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf_file", str(conf_file),
+         "--conf", "tony.application.name=from-cli"])
+    final = TonyConfiguration.read(
+        os.path.join(client.app_dir, C.TONY_FINAL_CONF))
+    assert final.get_str(K.APPLICATION_NAME) == "from-cli"
+    assert final.get_int("tony.worker.instances") == 1
+    assert client.final_status == "SUCCEEDED"
+
+
+# ---------------------------------------------------------------------------
+def _dump_logs(client: TonyClient) -> str:
+    """Collect AM + container logs for assertion messages."""
+    chunks = []
+    for root, _dirs, files in os.walk(client.app_dir):
+        for f in files:
+            if f in ("stdout", "stderr", C.AM_STDOUT, C.AM_STDERR):
+                path = os.path.join(root, f)
+                try:
+                    with open(path, "r", errors="replace") as fh:
+                        content = fh.read().strip()
+                    if content:
+                        chunks.append(f"==== {path} ====\n{content}")
+                except OSError:
+                    pass
+    return "\n".join(chunks)[-8000:]

@@ -1,0 +1,47 @@
+"""AM process entry: `python -m tony_tpu.am --app_id X --app_dir D`.
+
+Equivalent of ApplicationMaster.main (ApplicationMaster.java:299-309): reads
+the frozen tony-final.json from the app dir, runs the AM, exits 0 on overall
+success, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from tony_tpu import constants as C
+from tony_tpu.am.application_master import ApplicationMaster
+from tony_tpu.conf import TonyConfiguration
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tony_tpu.am")
+    parser.add_argument("--app_id", required=True)
+    parser.add_argument("--app_dir", required=True)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    conf_path = os.path.join(args.app_dir, C.TONY_FINAL_CONF)
+    conf = TonyConfiguration.read(conf_path)
+    am = ApplicationMaster(conf, app_id=args.app_id, app_dir=args.app_dir)
+
+    # Graceful shutdown on SIGTERM: behave as if the client signaled finish so
+    # the monitor loop exits, containers are stopped by _teardown, and the
+    # history/status artifacts are still written (the reference relied on
+    # YARN to reap containers; the local substrate must do it itself).
+    import signal
+
+    def _on_sigterm(signum, frame):
+        am.finish_application({})
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    ok = am.run()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

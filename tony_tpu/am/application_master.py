@@ -1,0 +1,568 @@
+"""ApplicationMaster: per-job controller process.
+
+Equivalent of the reference's ApplicationMaster.java (tony-core, 1218 LoC):
+
+- `init`/`prepare` — read the frozen conf, start the control-plane +
+  metrics RPC server, start the cluster backend, announce the AM address
+  (ApplicationMaster.java:214-281,391-475).
+- session retry loop — build a TonySession, schedule via TaskScheduler,
+  monitor; on failure with retries left, stop this session's containers,
+  bump the session id, and go again (ApplicationMaster.java:311-386,558-574).
+- allocation handling — match containers to tasks by unique priority,
+  render executor env, launch (`RMCallbackHandler`/`ContainerLauncher`,
+  ApplicationMaster.java:1002-1073,1078-1156).
+- heartbeat liveliness, registration timeout, untracked-failure detection,
+  client stop signal — the monitor loop conditions of
+  ApplicationMaster.java:580-658.
+- Avro-equivalent event history (ApplicationMaster.java:330-384 wiring).
+
+Fault-injection env hooks (TEST_AM_CRASH, TEST_WORKER_TERMINATION,
+TEST_TASK_COMPLETION_NOTIFICATION_DELAYED) are compiled in, exactly like the
+reference (ApplicationMaster.java:337-342,1028-1037,1204-1215).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from tony_tpu import constants as C
+from tony_tpu.cluster import Container, LocalClusterBackend
+from tony_tpu.cluster.backend import ClusterBackend
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.events.handler import EventHandler
+from tony_tpu.events.history import JobMetadata
+from tony_tpu.events.schema import (
+    ApplicationFinished, ApplicationInited, Event, EventType, TaskFinished,
+    TaskStarted,
+)
+from tony_tpu.am.liveliness import LivelinessMonitor
+from tony_tpu.rpc.service import (
+    ClusterServiceHandler, MetricsServiceHandler, serve,
+)
+from tony_tpu.session.scheduler import ResourceRequestor, TaskScheduler
+from tony_tpu.session.session import FinalStatus, Task, TonySession
+from tony_tpu.session.requests import JobContainerRequest
+from tony_tpu.utils.common import current_host, framework_pythonpath
+from tony_tpu.utils.shell import execute_shell
+
+LOG = logging.getLogger(__name__)
+
+
+class MetricsStore(MetricsServiceHandler):
+    """AM-side metrics map (rpc/impl/MetricsRpcServer.java:22-56 equivalent):
+    {task_type: {index: [metric dicts]}} holding the latest sample."""
+
+    def __init__(self):
+        self._metrics: dict[str, dict[int, list[dict]]] = {}
+        self._lock = threading.Lock()
+
+    def update_metrics(self, req: dict) -> dict:
+        with self._lock:
+            self._metrics.setdefault(req["task_type"], {})[
+                int(req["index"])] = req.get("metrics", [])
+        return {}
+
+    def get_metrics(self, task_type: str, index: int) -> list[dict]:
+        with self._lock:
+            return list(self._metrics.get(task_type, {}).get(index, []))
+
+
+class ApplicationMaster(ClusterServiceHandler):
+    def __init__(self, conf: TonyConfiguration, app_id: str, app_dir: str,
+                 backend: Optional[ClusterBackend] = None):
+        self.conf = conf
+        self.app_id = app_id
+        self.app_dir = os.path.abspath(app_dir)
+        self.backend = backend or LocalClusterBackend(app_id=app_id)
+        self.session: Optional[TonySession] = None
+        self.scheduler: Optional[TaskScheduler] = None
+        self.metrics_store = MetricsStore()
+        self._session_id = 0
+        self._rpc_server = None
+        self.rpc_port = 0
+        self.host = current_host()
+        # monitor-loop condition flags (ApplicationMaster.java fields)
+        self._client_signal_stop = threading.Event()
+        self._killed_by_client = False
+        self._task_missed_hb = False
+        self._untracked_task_failed = False
+        self._registration_deadline: Optional[float] = None
+        self._preprocess_exit_code = 0
+        self._preprocess_finished = False
+        self._single_node = conf.get_bool(K.APPLICATION_SINGLE_NODE, False)
+        # container bookkeeping: container_id -> (task, session_id at launch)
+        self._launched: dict[str, tuple[Task, int]] = {}
+        self._finished_containers: set[str] = set()
+        self._session_containers: dict[int, list[str]] = {}
+        self._lock = threading.RLock()
+        self._tb_url = ""
+        self._wake = threading.Event()   # kick the monitor loop early
+        # timings (reference cadences, TonyConfigurationKeys.java:143-150)
+        self._hb_interval_ms = conf.get_time_ms(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
+        self._max_missed_hb = conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS, 25)
+        self._monitor_interval = conf.get_time_ms(K.AM_MONITOR_INTERVAL_MS, 5000) / 1000.0
+        self.hb_monitor = LivelinessMonitor(
+            self._hb_interval_ms, self._max_missed_hb, self._on_task_deemed_dead)
+        # event history → per-app intermediate dir; the history mover later
+        # relocates finals (reference: tony.history.intermediate)
+        hist_dir = conf.get_str(K.HISTORY_INTERMEDIATE) or os.path.join(
+            self.app_dir, C.HISTORY_DIR_NAME)
+        self.metadata = JobMetadata(application_id=app_id,
+                                    started=int(time.time() * 1000))
+        self.event_handler = EventHandler(hist_dir, self.metadata)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def prepare(self) -> None:
+        """Start RPC + backend and announce the AM address
+        (ApplicationMaster.prepare, ApplicationMaster.java:391-475)."""
+        self._rpc_server, self.rpc_port = serve(
+            cluster_handler=self, metrics_handler=self.metrics_store)
+        self.backend.set_callbacks(self._on_container_allocated,
+                                   self._on_container_completed)
+        self.backend.start()
+        self.hb_monitor.start()
+        self.event_handler.start()
+        hostport_path = os.path.join(self.app_dir, C.AM_HOSTPORT_FILE)
+        tmp = hostport_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(f"{self.host}:{self.rpc_port}")
+        os.replace(tmp, hostport_path)
+        LOG.info("AM RPC serving at %s:%d", self.host, self.rpc_port)
+
+    def run(self) -> bool:
+        """Full AM lifecycle incl. the session retry loop
+        (ApplicationMaster.run, ApplicationMaster.java:311-386).
+        Returns overall success."""
+        self.prepare()
+        # TEST_AM_CRASH: die before doing anything useful, simulating an AM
+        # container crash (reference: ApplicationMaster.java:337-342)
+        if os.environ.get(C.TEST_AM_CRASH):
+            LOG.error("TEST_AM_CRASH set — simulating AM crash")
+            self._write_status("FAILED", "TEST_AM_CRASH")
+            os._exit(1)
+        max_retries = self.conf.get_int(K.AM_RETRY_COUNT, 0)
+        succeeded = False
+        attempt = 0
+        try:
+            while True:
+                succeeded = self._run_session(attempt)
+                if succeeded or attempt >= max_retries:
+                    break
+                if self._client_signal_stop.is_set():
+                    break
+                attempt += 1
+                LOG.warning("session failed; AM retry %d/%d", attempt, max_retries)
+                self._reset()
+            self._finish(succeeded)
+        finally:
+            self._teardown()
+        return succeeded
+
+    def _run_session(self, attempt: int) -> bool:
+        """One session generation: build, preprocess, schedule, monitor."""
+        self._task_missed_hb = False
+        self._untracked_task_failed = False
+        self._killed_by_client = False
+        self._preprocess_exit_code = 0
+        self._preprocess_finished = False
+        self.session = TonySession(self.conf, session_id=self._session_id)
+        self._session_containers.setdefault(self._session_id, [])
+        self.scheduler = TaskScheduler(self.session, _Requestor(self.backend))
+
+        if attempt == 0:
+            self.event_handler.emit(Event(
+                EventType.APPLICATION_INITED,
+                ApplicationInited(self.app_id,
+                                  sum(r.num_instances
+                                      for r in self.session.requests.values()),
+                                  self.host)))
+
+        if self._single_node or self.conf.get_bool(
+                K.APPLICATION_ENABLE_PREPROCESS, False):
+            self._do_preprocessing_job(attempt)
+            if self._single_node:
+                ok = self._preprocess_exit_code == 0
+                if ok:
+                    self.session.set_final_status(FinalStatus.SUCCEEDED, None)
+                else:
+                    self.session.set_final_status(
+                        FinalStatus.FAILED,
+                        f"preprocess exit {self._preprocess_exit_code}")
+                return ok
+
+        self.scheduler.schedule_tasks()
+        if not self.scheduler.dependency_check_passed:
+            return False
+        # registration timeout clock starts at scheduling time (reference:
+        # tony.container.allocation.timeout, ApplicationMaster.java:790-791)
+        alloc_timeout_ms = self.conf.get_time_ms(K.CONTAINER_ALLOCATION_TIMEOUT,
+                                                 15 * 60 * 1000)
+        self._registration_deadline = (
+            time.monotonic() + alloc_timeout_ms / 1000.0
+            if alloc_timeout_ms > 0 else None)
+        return self._monitor()
+
+    def _monitor(self) -> bool:
+        """The monitor loop (ApplicationMaster.monitor,
+        ApplicationMaster.java:580-658): same break conditions, same
+        end-of-loop final-status aggregation."""
+        timeout_ms = self.conf.get_time_ms(K.APPLICATION_TIMEOUT, 0)
+        expire_at = (time.monotonic() + timeout_ms / 1000.0
+                     if timeout_ms > 0 else None)
+        session = self.session
+        while True:
+            if expire_at is not None and time.monotonic() > expire_at:
+                LOG.error("application timed out")
+                session.set_final_status(FinalStatus.FAILED,
+                                         "Application times out.")
+                break
+            if self._client_signal_stop.is_set():
+                LOG.info("client signaled AM to exit")
+                if not session.all_tracked_tasks_completed():
+                    self._killed_by_client = True
+                break
+            if session.training_finished:
+                LOG.info("training finished (short-circuit)")
+                break
+            if self._preprocess_exit_code != 0:
+                session.set_final_status(
+                    FinalStatus.FAILED,
+                    f"Preprocess failed with exit code: {self._preprocess_exit_code}")
+                break
+            if self._task_missed_hb:
+                break
+            if self._untracked_task_failed:
+                session.set_final_status(
+                    FinalStatus.FAILED,
+                    "An untracked task failed with a non-zero exit code.")
+                break
+            if (self._registration_deadline is not None
+                    and not session.all_tasks_registered()
+                    and time.monotonic() > self._registration_deadline):
+                session.set_final_status(
+                    FinalStatus.FAILED,
+                    "Tasks failed to register within the allocation timeout.")
+                break
+            if session.all_tasks_registered():
+                # all gang members arrived: stop the registration clock
+                self._registration_deadline = None
+            total = session.total_tracked_tasks()
+            if total > 0 and session.num_completed_tracked_tasks() >= total:
+                LOG.info("all %d tracked tasks completed", total)
+                break
+            self._wake.wait(self._monitor_interval)
+            self._wake.clear()
+        if self._killed_by_client:
+            session.set_final_status(FinalStatus.KILLED,
+                                     "Application killed by client.")
+        else:
+            session.update_session_status()
+        ok = session.final_status == FinalStatus.SUCCEEDED
+        if not ok:
+            LOG.info("session failed: %s", session.final_message)
+        return ok
+
+    def _reset(self) -> None:
+        """Stop this session's containers and bump the session id so stale
+        completion callbacks are ignored (ApplicationMaster.reset,
+        ApplicationMaster.java:558-574)."""
+        with self._lock:
+            cids = list(self._session_containers.get(self._session_id, []))
+        for cid in cids:
+            self.backend.stop_container(cid)
+        self.hb_monitor.clear()
+        self._session_id += 1
+
+    def _drain_completion_callbacks(self, timeout_sec: float = 5.0) -> None:
+        """Wait (bounded) for container-completion callbacks of tasks whose
+        executors already registered their result, so their TASK_FINISHED
+        events land in the history before it closes. Containers still running
+        (short-circuited session) are not waited on."""
+        deadline = time.monotonic() + timeout_sec
+        while time.monotonic() < deadline:
+            with self._lock:
+                session = self.session
+                if session is None:
+                    return
+                pending = [
+                    cid for cid, (task, sid) in self._launched.items()
+                    if sid == session.session_id and task.completed
+                    and cid not in self._finished_containers]
+            if not pending:
+                return
+            time.sleep(0.05)
+
+    def _finish(self, succeeded: bool) -> None:
+        self._drain_completion_callbacks()
+        if succeeded:
+            status = "SUCCEEDED"
+        elif (self.session is not None
+              and self.session.final_status == FinalStatus.KILLED):
+            status = "KILLED"
+        else:
+            status = "FAILED"
+        if self.session is not None:
+            all_metrics = []
+            for infos in (self.session.get_task_infos() or []):
+                all_metrics.extend(
+                    self.metrics_store.get_metrics(infos.name, infos.index))
+            self.event_handler.emit(Event(
+                EventType.APPLICATION_FINISHED,
+                ApplicationFinished(self.app_id, status,
+                                    self.session.num_failed_tasks(),
+                                    all_metrics)))
+        final_hist = self.event_handler.stop(status)
+        LOG.info("history written to %s", final_hist)
+        self._write_status(
+            status,
+            self.session.final_message if self.session else None)
+        # give the client a moment to observe the terminal state and send
+        # finish_application (ApplicationMaster.stop poll,
+        # ApplicationMaster.java:669-710)
+        stop_wait = self.conf.get_time_ms(K.AM_STOP_POLL_TIMEOUT_MS, 30_000) / 1000.0
+        self._client_signal_stop.wait(timeout=stop_wait)
+
+    def _write_status(self, status: str, message: Optional[str]) -> None:
+        path = os.path.join(self.app_dir, C.AM_STATUS_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"status": status, "message": message,
+                       "app_id": self.app_id,
+                       "tb_url": self._tb_url,
+                       "completed": int(time.time() * 1000)}, f)
+        os.replace(tmp, path)
+
+    def _teardown(self) -> None:
+        self.backend.stop()
+        self.hb_monitor.stop()
+        if self._rpc_server is not None:
+            self._rpc_server.stop(grace=0.5)
+
+    # ------------------------------------------------------------------
+    # preprocessing / single-node (ApplicationMaster.doPreprocessingJob,
+    # ApplicationMaster.java:713-765): run the user command ON the AM host.
+    # ------------------------------------------------------------------
+    def _do_preprocessing_job(self, attempt: int) -> None:
+        command = self.conf.get_str("tony.task.command") or os.environ.get(
+            C.TASK_COMMAND, "")
+        if not command:
+            LOG.warning("single-node/preprocess mode with no task command")
+            self._preprocess_finished = True
+            return
+        LOG.info("running preprocess/single-node command on AM: %s", command)
+        log_dir = os.path.join(self.app_dir, C.CONTAINERS_DIR_NAME, "am")
+        os.makedirs(log_dir, exist_ok=True)
+        env = {
+            C.JOB_NAME: C.NOTEBOOK_JOB_NAME if self._single_node else C.AM_NAME,
+            C.TASK_INDEX: "0",
+            C.IS_CHIEF: "true",
+            C.ATTEMPT_NUMBER: str(attempt),
+            C.APP_ID: self.app_id,
+            C.TONY_APP_DIR: self.app_dir,
+        }
+        with open(os.path.join(log_dir, "stdout"), "ab") as out, \
+                open(os.path.join(log_dir, "stderr"), "ab") as err:
+            self._preprocess_exit_code = execute_shell(
+                command, extra_env=env, cwd=self.app_dir,
+                stdout=out, stderr=err)
+        self._preprocess_finished = True
+
+    # ------------------------------------------------------------------
+    # backend callbacks
+    # ------------------------------------------------------------------
+    def _on_container_allocated(self, container: Container) -> None:
+        """RMCallbackHandler.onContainersAllocated + ContainerLauncher
+        (ApplicationMaster.java:1040-1050,1088-1155)."""
+        with self._lock:
+            session = self.session
+            if session is None:
+                self.backend.release_container(container.container_id)
+                return
+            task = session.match_allocation(
+                container.priority, container.container_id, container.host)
+            if task is None:
+                LOG.info("no matching task for %s (priority %d) — releasing",
+                         container.container_id, container.priority)
+                self.backend.release_container(container.container_id)
+                return
+            self._launched[container.container_id] = (task, session.session_id)
+            self._session_containers.setdefault(
+                session.session_id, []).append(container.container_id)
+        req = session.requests[task.job_name]
+        env = self._container_env(task, req, container)
+        cmd = [sys.executable, "-m", "tony_tpu.executor"]
+        cwd = os.path.join(self.app_dir, C.CONTAINERS_DIR_NAME,
+                           f"{task.job_name}_{task.index}_s{task.session_id}")
+        task.url = os.path.join(cwd, "stdout")
+        self.backend.launch_container(container, cmd, env, cwd)
+        self.hb_monitor.register(task.task_id)
+        self.event_handler.emit(Event(
+            EventType.TASK_STARTED,
+            TaskStarted(task.job_name, task.index, container.host,
+                        container.container_id)))
+
+    def _container_env(self, task: Task, req: JobContainerRequest,
+                       container: Container) -> dict[str, str]:
+        """Executor env block (ApplicationMaster.java:1109-1121)."""
+        session = self.session
+        env = {
+            C.JOB_NAME: task.job_name,
+            C.TASK_INDEX: str(task.index),
+            C.TASK_NUM: str(req.num_instances),
+            C.IS_CHIEF: str(session.is_chief(task.job_name, task.index)).lower(),
+            C.SESSION_ID: str(session.session_id),
+            C.AM_HOST: self.host,
+            C.AM_PORT: str(self.rpc_port),
+            C.METRICS_RPC_PORT: str(self.rpc_port),
+            C.CONTAINER_ID: container.container_id,
+            C.APP_ID: self.app_id,
+            C.ATTEMPT_NUMBER: str(self._session_id),
+            C.NUM_AM_RETRIES: str(self.conf.get_int(K.AM_RETRY_COUNT, 0)),
+            C.TONY_APP_DIR: self.app_dir,
+            C.TONY_CONF_PATH: os.path.join(self.app_dir, C.TONY_FINAL_CONF),
+            "PYTHONPATH": framework_pythonpath(),
+        }
+        # per-jobtype command override, else the global task command
+        command = req.command or self.conf.get_str("tony.task.command") \
+            or os.environ.get(C.TASK_COMMAND, "")
+        env[C.TASK_COMMAND] = command
+        # user-supplied pass-through env (tony.execution.env k=v list)
+        for entry in self.conf.get_strings(K.EXECUTION_ENV):
+            k, _, v = entry.partition("=")
+            env[k] = v
+        return env
+
+    def _on_container_completed(self, container_id: str, exit_code: int) -> None:
+        """RMCallbackHandler.onContainersCompleted → processFinishedContainer
+        (ApplicationMaster.java:1004-1023,1167-1200)."""
+        # TEST hook: delay the completion notification to exercise the
+        # executor-result-before-container-callback race
+        # (reference: ApplicationMaster.java:1028-1037)
+        delay = os.environ.get(C.TEST_TASK_COMPLETION_NOTIFICATION_DELAYED)
+        if delay:
+            time.sleep(float(delay) if delay.replace(".", "").isdigit() else 1.0)
+        with self._lock:
+            self._finished_containers.add(container_id)
+            entry = self._launched.get(container_id)
+            session = self.session
+            if entry is None or session is None:
+                LOG.warning("completion for unknown container %s", container_id)
+                return
+            task, launch_session = entry
+            if launch_session != session.session_id:
+                LOG.info("ignoring completion from stale session %d (now %d)",
+                         launch_session, session.session_id)
+                return
+        # a task that crashed without registering its result must not linger
+        # in the liveliness monitor and expire later
+        self.hb_monitor.unregister(task.task_id)
+        session.on_task_completed(task.job_name, task.index, exit_code)
+        self.scheduler.register_dependency_completed(task.job_name)
+        self.event_handler.emit(Event(
+            EventType.TASK_FINISHED,
+            TaskFinished(task.job_name, task.index, task.status.value,
+                         self.metrics_store.get_metrics(task.job_name,
+                                                        task.index))))
+        # untracked-crash detection prevents application hang-ups
+        # (ApplicationMaster.java:1192-1195)
+        if not session.is_tracked(task.job_name) and exit_code not in (
+                0, C.EXIT_KILLED_BY_AM):
+            self._untracked_task_failed = True
+        self._wake.set()
+
+    def _on_task_deemed_dead(self, task_id: str) -> None:
+        """(ApplicationMaster.onTaskDeemedDead, ApplicationMaster.java:1158-1165)."""
+        msg = (f"Task with id [{task_id}] has missed "
+               f"[{self._max_missed_hb}] heartbeats. Ending application!")
+        LOG.error(msg)
+        self._task_missed_hb = True
+        if self.session is not None:
+            self.session.set_final_status(FinalStatus.FAILED, msg)
+        self._wake.set()
+
+    # ------------------------------------------------------------------
+    # ClusterServiceHandler: the 7-RPC control plane
+    # (inner class RpcForClient, ApplicationMaster.java:787-932)
+    # ------------------------------------------------------------------
+    def get_task_infos(self, req: dict) -> list[dict]:
+        if self.session is None:
+            return []
+        infos = [i.to_dict() for i in self.session.get_task_infos()]
+        if self._tb_url:
+            infos.append({"name": "tensorboard", "index": 0,
+                          "url": self._tb_url, "status": "RUNNING"})
+        return infos
+
+    def get_cluster_spec(self, req: dict) -> dict:
+        if self.session is None:
+            return {"spec": None}
+        return {"spec": self.session.cluster_spec_json()}
+
+    def register_worker_spec(self, req: dict) -> dict:
+        if self.session is None:
+            return {"spec": None}
+        spec = self.session.register_worker_spec(req["task_id"], req["spec"])
+        # TEST hook: simulate chief-worker termination once the chief shows up
+        # (reference: killChiefWorkerIfTesting, ApplicationMaster.java:1204-1215)
+        if (os.environ.get(C.TEST_WORKER_TERMINATION)
+                and req["task_id"] == f"{C.WORKER_JOB_NAME}:0"):
+            threading.Thread(target=self._kill_workers_for_test,
+                             daemon=True).start()
+        return {"spec": spec}
+
+    def _kill_workers_for_test(self) -> None:
+        time.sleep(0.5)
+        with self._lock:
+            cids = [cid for cid, (task, sid) in self._launched.items()
+                    if task.job_name == C.WORKER_JOB_NAME
+                    and sid == self.session.session_id]
+        LOG.warning("TEST_WORKER_TERMINATION: killing %d workers", len(cids))
+        for cid in cids:
+            self.backend.stop_container(cid)
+
+    def register_tensorboard_url(self, req: dict) -> dict:
+        self._tb_url = req.get("url", "")
+        LOG.info("TensorBoard registered at %s", self._tb_url)
+        return {}
+
+    def register_execution_result(self, req: dict) -> dict:
+        """Executor-reported exit code. Unregisters the task from the HB
+        monitor FIRST so a delayed container-completion callback can't
+        race a clean exit into a missed-heartbeat failure
+        (reference rationale: ApplicationMaster.java:890-918)."""
+        task_id = f"{req['job_name']}:{req['job_index']}"
+        self.hb_monitor.unregister(task_id)
+        session = self.session
+        if session is None or int(req.get("session_id", -1)) != session.session_id:
+            return {}
+        session.on_task_completed(req["job_name"], int(req["job_index"]),
+                                  int(req["exit_code"]))
+        self._wake.set()
+        return {}
+
+    def finish_application(self, req: dict) -> dict:
+        self._client_signal_stop.set()
+        self._wake.set()
+        return {}
+
+    def task_executor_heartbeat(self, req: dict) -> dict:
+        self.hb_monitor.ping(req["task_id"])
+        return {}
+
+
+class _Requestor(ResourceRequestor):
+    def __init__(self, backend: ClusterBackend):
+        self.backend = backend
+
+    def request_containers(self, request: JobContainerRequest) -> None:
+        self.backend.request_containers(
+            request.num_instances, request.priority, request.memory_mb,
+            request.vcores, request.gpus, request.tpus, request.node_label)

@@ -1,0 +1,77 @@
+"""Heartbeat liveliness monitor.
+
+Equivalent of the reference's use of YARN's AbstractLivelinessMonitor
+(ApplicationMaster.java:183-208): tasks ping on every heartbeat RPC; a
+monitor thread sweeps registered tasks and fires an expiry callback for any
+task whose last ping is older than `hb_interval * max(3, max_missed)` —
+the reference's exact expiry formula (ApplicationMaster.java:197-204).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+LOG = logging.getLogger(__name__)
+
+
+class LivelinessMonitor:
+    def __init__(self, hb_interval_ms: int, max_missed: int,
+                 on_expired: Callable[[str], None]):
+        self._expiry_sec = hb_interval_ms * max(3, max_missed) / 1000.0
+        # sweep frequently relative to the expiry window so detection latency
+        # stays a fraction of the window even with test-scale intervals
+        self._sweep_sec = max(0.05, min(1.0, self._expiry_sec / 10))
+        self._on_expired = on_expired
+        self._last_ping: dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="hb-monitor",
+                                        daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
+
+    def register(self, task_id: str) -> None:
+        with self._lock:
+            self._last_ping[task_id] = time.monotonic()
+
+    def unregister(self, task_id: str) -> None:
+        """Must be called when an executor registers its result, BEFORE the
+        container-completion callback arrives — otherwise a task that exited
+        cleanly but whose completion notification is delayed would be deemed
+        dead (reference rationale: ApplicationMaster.java:890-902)."""
+        with self._lock:
+            self._last_ping.pop(task_id, None)
+
+    def ping(self, task_id: str) -> None:
+        with self._lock:
+            if task_id in self._last_ping:
+                self._last_ping[task_id] = time.monotonic()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._last_ping.clear()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._sweep_sec):
+            now = time.monotonic()
+            with self._lock:
+                expired = [tid for tid, last in self._last_ping.items()
+                           if now - last > self._expiry_sec]
+                for tid in expired:
+                    del self._last_ping[tid]
+            for tid in expired:
+                LOG.error("task %s missed heartbeats for %.1fs — expired",
+                          tid, self._expiry_sec)
+                try:
+                    self._on_expired(tid)
+                except Exception:  # noqa: BLE001
+                    LOG.exception("expiry callback failed for %s", tid)

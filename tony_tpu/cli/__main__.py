@@ -1,0 +1,44 @@
+"""`python -m tony_tpu.cli {submit|local|notebook} ...`
+
+- submit   — ClusterSubmitter equivalent (cli/ClusterSubmitter.java:41-94):
+             run against the configured cluster workdir; app artifacts
+             persist for the history server.
+- local    — LocalSubmitter equivalent (cli/LocalSubmitter.java:33-71):
+             ephemeral workdir, removed after the run.
+- notebook — NotebookSubmitter equivalent (cli/NotebookSubmitter.java:46-146):
+             single-node app on the AM + local TCP proxy to it.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from tony_tpu.cli.cluster_submitter import submit as cluster_submit
+from tony_tpu.cli.local_submitter import submit as local_submit
+from tony_tpu.cli.notebook_submitter import submit as notebook_submit
+
+USAGE = "usage: python -m tony_tpu.cli {submit|local|notebook} [args...]"
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    if not argv:
+        print(USAGE, file=sys.stderr)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "submit":
+        return cluster_submit(rest)
+    if cmd == "local":
+        return local_submit(rest)
+    if cmd == "notebook":
+        return notebook_submit(rest)
+    print(USAGE, file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""NotebookSubmitter: interactive single-node app behind a local proxy.
+
+Equivalent of cli/NotebookSubmitter.java:46-146: submit a single-node app
+(the AM runs the user command itself as a "preprocessing job",
+ApplicationMaster.java:531-545,713-765), wait for the notebook task URL to
+appear in TaskInfos, then start a local TCP proxy so the user can reach the
+in-cluster notebook from the gateway host.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from tony_tpu.client.tony_client import TonyClient
+from tony_tpu.conf import keys as K
+from tony_tpu.proxy import ProxyServer
+
+LOG = logging.getLogger(__name__)
+
+DEFAULT_TIMEOUT = "24h"  # reference appended a 24h timeout (:89-93)
+
+
+def submit(argv: list[str]) -> int:
+    client = TonyClient()
+    client.init(argv)
+    client.conf.set(K.APPLICATION_SINGLE_NODE, True, "notebook")
+    if not client.conf.get_time_ms(K.APPLICATION_TIMEOUT, 0):
+        client.conf.set(K.APPLICATION_TIMEOUT, DEFAULT_TIMEOUT, "notebook")
+
+    result = {"ok": False}
+
+    def _run():
+        result["ok"] = client.run()
+
+    runner = threading.Thread(target=_run, name="notebook-client", daemon=True)
+    runner.start()
+
+    proxy = None
+    try:
+        # poll TaskInfos until a registered URL appears, then proxy to it
+        # (NotebookSubmitter.java:107-130)
+        while runner.is_alive() and proxy is None:
+            for info in client.get_task_infos():
+                if info.url.startswith("http://"):
+                    hostport = info.url[len("http://"):].split("/", 1)[0]
+                    host, _, port = hostport.rpartition(":")
+                    if host and port.isdigit():
+                        proxy = ProxyServer(host, int(port))
+                        proxy.start()
+                        print(f"notebook available at "
+                              f"http://127.0.0.1:{proxy.local_port}")
+                        break
+            time.sleep(1)
+        runner.join()
+    except KeyboardInterrupt:
+        LOG.info("interrupted — killing notebook app")
+        client.kill()
+    finally:
+        if proxy is not None:
+            proxy.stop()
+    return 0 if result["ok"] else -1

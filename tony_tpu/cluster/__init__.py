@@ -1,0 +1,16 @@
+"""Cluster substrate: resource-manager backends that hand out containers.
+
+Equivalent of the reference's L0 (YARN RM/NM, consumed through
+AMRMClientAsync/NMClientAsync) plus tony-mini's in-process MiniCluster
+(tony-mini/src/main/java/com/linkedin/tony/MiniCluster.java:24-84). The
+`ClusterBackend` interface is what the ApplicationMaster programs against;
+`LocalClusterBackend` implements it with local subprocesses so the full
+client→AM→executor→user-process chain runs on one host (dev, tests, single
+TPU VM). A real multi-host backend (GKE/GCE TPU pods) plugs in behind the
+same interface.
+"""
+
+from tony_tpu.cluster.backend import ClusterBackend, Container
+from tony_tpu.cluster.local import LocalClusterBackend
+
+__all__ = ["ClusterBackend", "Container", "LocalClusterBackend"]

@@ -1,0 +1,84 @@
+"""ClusterBackend interface + Container record.
+
+The reference talked to YARN through two async clients: AMRMClientAsync
+(container allocation, ApplicationMaster.java:1002-1073) and NMClientAsync
+(container launch/stop, ApplicationMaster.java:970-1000). This interface
+merges both roles: the AM requests containers, gets allocation callbacks,
+launches commands into allocated containers, and gets completion callbacks.
+
+The allocation→task matching contract is the same as the reference's: each
+jobtype's containers are requested at a **unique priority**, and allocations
+echo that priority back (util/Utils.java:392-398, TonySession.java:208-224).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Optional
+
+from tony_tpu import constants as C
+
+
+@dataclass
+class Container:
+    """An allocated execution slot (YARN Container equivalent)."""
+    container_id: str
+    host: str
+    priority: int
+    memory_mb: int = 0
+    vcores: int = 0
+    gpus: int = 0
+    tpus: int = 0
+    node_label: str = ""
+    # populated at launch time
+    log_dir: str = ""
+    extra: dict = field(default_factory=dict)
+
+
+EXIT_KILLED_BY_AM = C.EXIT_KILLED_BY_AM
+
+
+AllocatedCallback = Callable[[Container], None]
+CompletedCallback = Callable[[str, int], None]  # (container_id, exit_code)
+
+
+class ClusterBackend(abc.ABC):
+    """What the ApplicationMaster needs from a cluster substrate."""
+
+    def set_callbacks(self, on_allocated: AllocatedCallback,
+                      on_completed: CompletedCallback) -> None:
+        self._on_allocated: Optional[AllocatedCallback] = on_allocated
+        self._on_completed: Optional[CompletedCallback] = on_completed
+
+    @abc.abstractmethod
+    def start(self) -> None:
+        """Bring up the backend (NMClientAsync.start equivalent)."""
+
+    @abc.abstractmethod
+    def request_containers(self, num: int, priority: int, memory_mb: int,
+                           vcores: int, gpus: int, tpus: int,
+                           node_label: str = "") -> None:
+        """Ask for `num` containers at `priority`; answers arrive via the
+        on_allocated callback (AMRMClientAsync.addContainerRequest equiv)."""
+
+    @abc.abstractmethod
+    def launch_container(self, container: Container, command: list[str],
+                         env: Mapping[str, str], cwd: str) -> None:
+        """Start `command` inside an allocated container
+        (NMClientAsync.startContainerAsync equivalent). Exit is reported via
+        the on_completed callback."""
+
+    @abc.abstractmethod
+    def stop_container(self, container_id: str) -> None:
+        """Kill a running container; its completion callback reports
+        EXIT_KILLED_BY_AM (NMClientAsync.stopContainerAsync equivalent)."""
+
+    @abc.abstractmethod
+    def release_container(self, container_id: str) -> None:
+        """Return an allocated-but-unlaunched container
+        (amRMClient.releaseAssignedContainer equivalent)."""
+
+    @abc.abstractmethod
+    def stop(self) -> None:
+        """Tear everything down; kill any still-running containers."""

@@ -52,6 +52,12 @@ DEFAULTS = {
     K.AM_MEMORY: "2g",
     K.AM_VCORES: 1,
     K.AM_GANG_MAX_WAIT_MS: 0,
+    # reference AM monitor cadence: 5 s (ApplicationMaster.java:643-648);
+    # tests dial this down to keep the E2E suite fast
+    K.AM_MONITOR_INTERVAL_MS: 5000,
+    # how long the AM waits for the client's finish signal before
+    # unregistering (ApplicationMaster.stop poll, ApplicationMaster.java:669-710)
+    K.AM_STOP_POLL_TIMEOUT_MS: 30_000,
 
     # task cadences (reference: TonyConfigurationKeys.java:143-150)
     K.TASK_HEARTBEAT_INTERVAL_MS: 1000,

@@ -34,6 +34,8 @@ AM_RETRY_COUNT = "tony.am.retry-count"
 AM_MEMORY = "tony.am.memory"
 AM_VCORES = "tony.am.vcores"
 AM_GANG_MAX_WAIT_MS = "tony.am.gang-allocation-timeout-ms"
+AM_MONITOR_INTERVAL_MS = "tony.am.monitor-interval-ms"
+AM_STOP_POLL_TIMEOUT_MS = "tony.am.stop-poll-timeout-ms"
 
 # --- task / containers ---------------------------------------------------
 TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"

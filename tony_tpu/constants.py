@@ -54,10 +54,20 @@ TPU_MESH_AXES = "TPU_MESH_AXES"      # e.g. "dp,fsdp,tp"
 TPU_SLICE_ID = "TPU_SLICE_ID"        # multi-slice (DCN) slice index
 TPU_NUM_SLICES = "TPU_NUM_SLICES"
 
+# Paths handed to AM / executor processes via env
+TONY_CONF_PATH = "TONY_CONF_PATH"    # abs path of the frozen tony-final.json
+TONY_APP_DIR = "TONY_APP_DIR"        # per-app staging/work dir
+
 # ---------------------------------------------------------------------------
 # File names / layout
 # ---------------------------------------------------------------------------
 TONY_FINAL_CONF = "tony-final.json"  # frozen merged conf shipped to every process
+AM_HOSTPORT_FILE = "amhostport"      # written by AM once its RPC server is up
+AM_STATUS_FILE = "status.json"       # final {status, message}, written at AM exit
+HISTORY_DIR_NAME = "history"         # per-app intermediate history dir
+CONTAINERS_DIR_NAME = "containers"   # per-app container log dirs
+AM_STDOUT = "am.stdout"
+AM_STDERR = "am.stderr"
 TONY_DEFAULT_CONF = "tony-default.json"
 TONY_SITE_CONF = "tony-site.json"
 TONY_CONF_DIR_ENV = "TONY_CONF_DIR"
@@ -116,3 +126,7 @@ MAX_CONSECUTIVE_FAILED_HEARTBEATS = 5
 EXIT_SUCCESS = 0
 EXIT_FAILURE = 1
 EXIT_HEARTBEAT_FAILURE = 9  # executor killed itself after missed heartbeats
+# Exit code reported when the AM itself stops a container; matches YARN's
+# ContainerExitStatus.KILLED_BY_APPMASTER used by the reference
+# (TonySession.java:485-488). Single source of truth for all modules.
+EXIT_KILLED_BY_AM = -105

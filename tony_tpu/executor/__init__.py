@@ -1,0 +1,12 @@
+"""Task executor: per-container supervisor for one training task.
+
+Equivalent of the reference's TaskExecutor.java (tony-core): registers with
+the AM, blocks on the gang-rendezvous barrier, renders per-framework
+bootstrap env (TF_CONFIG / torch RANK+WORLD / DMLC_* / JAX coordinator),
+heartbeats, samples metrics, execs the user command, and reports the exit
+code back to the AM.
+"""
+
+from tony_tpu.executor.task_executor import TaskExecutor
+
+__all__ = ["TaskExecutor"]

@@ -1,0 +1,25 @@
+"""Executor process entry: `python -m tony_tpu.executor`.
+
+Equivalent of TaskExecutor.main (TaskExecutor.java:211-253): everything it
+needs arrives via env vars set by the AM's container launcher. Exits with
+the user process's exit code.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from tony_tpu.executor.task_executor import TaskExecutor
+
+
+def main() -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    executor = TaskExecutor()
+    return executor.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,266 @@
+"""TaskExecutor: runs inside each container, wraps the user training process.
+
+Equivalent of the reference's TaskExecutor.java:135-393:
+
+- `init_configs` — read the env block set by the AM + the frozen conf
+  (TaskExecutor.java:255-293).
+- port setup — pre-announce this task's rendezvous port; the chief also
+  reserves a TensorBoard port and registers its URL with the AM
+  (TaskExecutor.java:83-95,311-319).
+- heartbeater thread @1 s with self-destruct after 5 consecutive failures
+  (TaskExecutor.java:300-302,330-370, MAX_CONSECUTIVE_FAILED_HEARTBEATS=5).
+- `register_and_get_cluster_spec` — the gang barrier: poll
+  register_worker_spec until the AM returns the full spec
+  (TaskExecutor.java:295-309).
+- framework env switch → runtimes.render_framework_env
+  (TaskExecutor.java:161-207).
+- exec the user command, register the exit code, exit with it
+  (TaskExecutor.java:239-252).
+
+Fault-injection hooks TEST_TASK_EXECUTOR_NUM_HB_MISS and
+TEST_TASK_EXECUTOR_SKEW are compiled in like the reference
+(TaskExecutor.java:334-344,372-392).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from tony_tpu import constants as C
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.executor.runtimes import render_framework_env
+from tony_tpu.executor.task_monitor import TaskMonitor
+from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
+from tony_tpu.utils.common import current_host, pick_free_port, poll_till_non_null
+from tony_tpu.utils.fs import unzip
+from tony_tpu.utils.localization import localize_resource
+from tony_tpu.utils.ports import reserve_port
+from tony_tpu.utils.shell import launch_shell, wait_or_kill
+
+LOG = logging.getLogger(__name__)
+
+
+class Heartbeater(threading.Thread):
+    """(reference: TaskExecutor.Heartbeater, TaskExecutor.java:330-370)."""
+
+    def __init__(self, client: ClusterServiceClient, task_id: str,
+                 interval_sec: float, on_fatal=None):
+        super().__init__(name="heartbeater", daemon=True)
+        self._client = client
+        self._task_id = task_id
+        self._interval = interval_sec
+        self._on_fatal = on_fatal  # kill the user process before we die
+        self._stop = threading.Event()
+        # TEST hook: skip the first N heartbeats to simulate missed HBs
+        # (TaskExecutor.java:334-344)
+        self._skip_remaining = int(
+            os.environ.get(C.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
+        self._consecutive_failures = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.wait(self._interval):
+            if self._skip_remaining > 0:
+                self._skip_remaining -= 1
+                LOG.warning("TEST hook: skipping heartbeat (%d more)",
+                            self._skip_remaining)
+                continue
+            try:
+                self._client.task_executor_heartbeat(self._task_id)
+                self._consecutive_failures = 0
+            except Exception:  # noqa: BLE001
+                self._consecutive_failures += 1
+                LOG.warning("heartbeat failed (%d consecutive)",
+                            self._consecutive_failures)
+                if (self._consecutive_failures
+                        >= C.MAX_CONSECUTIVE_FAILED_HEARTBEATS):
+                    # the AM is unreachable: take the user process down with
+                    # us — there is no NodeManager to reap the tree here —
+                    # then exit (TaskExecutor.java:358-368)
+                    LOG.error("%d consecutive heartbeat failures — exiting",
+                              self._consecutive_failures)
+                    if self._on_fatal is not None:
+                        try:
+                            self._on_fatal()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    os._exit(C.EXIT_HEARTBEAT_FAILURE)
+
+
+class TaskExecutor:
+    def __init__(self, env: Optional[dict] = None):
+        e = env if env is not None else os.environ
+        # -- init_configs (TaskExecutor.java:255-293) ----------------------
+        self.job_name = e[C.JOB_NAME]
+        self.task_index = int(e[C.TASK_INDEX])
+        self.task_num = int(e.get(C.TASK_NUM, "1"))
+        self.is_chief = e.get(C.IS_CHIEF, "false").lower() == "true"
+        self.session_id = int(e.get(C.SESSION_ID, "0"))
+        self.am_host = e[C.AM_HOST]
+        self.am_port = int(e[C.AM_PORT])
+        self.metrics_port = int(e.get(C.METRICS_RPC_PORT, self.am_port))
+        self.task_command = e.get(C.TASK_COMMAND, "")
+        self.app_dir = e.get(C.TONY_APP_DIR, ".")
+        conf_path = e.get(C.TONY_CONF_PATH, "")
+        self.conf = (TonyConfiguration.read(conf_path)
+                     if conf_path and os.path.exists(conf_path)
+                     else TonyConfiguration())
+        self.framework = self.conf.get_str(K.APPLICATION_FRAMEWORK, "jax")
+        self.hb_interval_sec = self.conf.get_time_ms(
+            K.TASK_HEARTBEAT_INTERVAL_MS, 1000) / 1000.0
+        self.metrics_interval_sec = self.conf.get_time_ms(
+            K.TASK_METRICS_INTERVAL_MS, 5000) / 1000.0
+        self.registration_timeout_sec = self.conf.get_int(
+            K.TASK_REGISTRATION_TIMEOUT_SEC, 300)
+        self.host = current_host()
+        self.port = 0
+        self.tb_port: Optional[int] = None
+        self._port_reservation = None
+        self.client = ClusterServiceClient(self.am_host, self.am_port)
+        self.metrics_client = MetricsServiceClient(self.am_host,
+                                                   self.metrics_port)
+        self.heartbeater: Optional[Heartbeater] = None
+        self.monitor: Optional[TaskMonitor] = None
+        self._user_proc = None
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.job_name}:{self.task_index}"
+
+    # ------------------------------------------------------------------
+    def setup_ports(self) -> None:
+        """Reserve this task's rendezvous port before registering it with the
+        AM. The reference needed an SO_REUSEPORT helper so TF could rebind
+        the pre-announced port (ReusablePort.java:149-235,
+        reserve_reusable_port.py); `reserve_port` is the native equivalent —
+        it holds the port with SO_REUSEPORT until the user process binds.
+        Chief additionally reserves a TensorBoard port and registers its URL
+        (TaskExecutor.java:83-95,311-319)."""
+        self._port_reservation = reserve_port()
+        self.port = self._port_reservation.port
+        if self.is_chief:
+            self.tb_port = pick_free_port()
+            self.client.register_tensorboard_url(
+                self.task_id, f"http://{self.host}:{self.tb_port}")
+
+    def register_and_get_cluster_spec(self) -> Optional[dict]:
+        """Gang barrier (TaskExecutor.java:295-309): start heartbeating, then
+        poll register_worker_spec until every expected task has registered."""
+        self.heartbeater = Heartbeater(self.client, self.task_id,
+                                       self.hb_interval_sec,
+                                       on_fatal=self._kill_user_proc)
+        self.heartbeater.start()
+        host_port = f"{self.host}:{self.port}"
+        LOG.info("registering %s at %s", self.task_id, host_port)
+        return poll_till_non_null(
+            lambda: self.client.register_worker_spec(self.task_id, host_port),
+            interval_sec=0.2,
+            timeout_sec=self.registration_timeout_sec)
+
+    def _skew_if_testing(self) -> None:
+        """TEST_TASK_EXECUTOR_SKEW='type#index#ms': delay this specific task
+        after the barrier, before exec (TaskExecutor.java:372-392)."""
+        spec = os.environ.get(C.TEST_TASK_EXECUTOR_SKEW)
+        if not spec:
+            return
+        try:
+            jtype, idx, ms = spec.split("#")
+            if jtype == self.job_name and int(idx) == self.task_index:
+                LOG.warning("TEST hook: skewing %s by %s ms", self.task_id, ms)
+                time.sleep(int(ms) / 1000.0)
+        except ValueError:
+            LOG.error("bad TEST_TASK_EXECUTOR_SKEW spec: %r", spec)
+
+    # ------------------------------------------------------------------
+    def localize_resources(self) -> None:
+        """Materialize staged src/venv/resources into this container's cwd
+        (Utils.extractResources + addResources, util/Utils.java:506-550,
+        699-712): the src zip unpacks in place so `python train.py` resolves,
+        the venv unpacks under ./venv, archives expand, files copy in."""
+        src_zip = self.conf.get_str(K.SRC_DIR)
+        if src_zip and src_zip.endswith(".zip") and os.path.exists(src_zip):
+            unzip(src_zip, os.getcwd())
+        venv = self.conf.get_str(K.PYTHON_VENV)
+        if venv and os.path.exists(venv.split("#", 1)[0]):
+            path = venv.split("#", 1)[0]
+            if path.endswith(".zip"):
+                unzip(path, os.path.join(os.getcwd(), "venv"))
+        specs = (self.conf.get_strings(K.resources_key(self.job_name))
+                 + self.conf.get_strings(K.CONTAINERS_RESOURCES))
+        for spec in specs:
+            try:
+                localize_resource(spec, os.getcwd())
+            except FileNotFoundError:
+                LOG.error("resource missing at localization time: %s", spec)
+                raise
+
+    def run(self) -> int:
+        """Full executor lifecycle; returns the user process exit code
+        (TaskExecutor.main, TaskExecutor.java:211-253)."""
+        self.localize_resources()
+        self.setup_ports()
+        cluster_spec = self.register_and_get_cluster_spec()
+        if cluster_spec is None:
+            LOG.error("gang rendezvous timed out after %ds",
+                      self.registration_timeout_sec)
+            self._report(C.EXIT_FAILURE)
+            return C.EXIT_FAILURE
+        LOG.info("cluster spec: %s", cluster_spec)
+        env = render_framework_env(self.framework, cluster_spec,
+                                   self.job_name, self.task_index, self.conf)
+        env[C.JOB_NAME] = self.job_name
+        env[C.TASK_INDEX] = str(self.task_index)
+        env[C.TASK_NUM] = str(self.task_num)
+        env[C.IS_CHIEF] = str(self.is_chief).lower()
+        if self.tb_port is not None:
+            env[C.TB_PORT] = str(self.tb_port)
+        self._skew_if_testing()
+        # hand the reserved port over to the user process right before exec
+        # (TaskExecutor.java:227-235 release-or-keep logic)
+        if self._port_reservation is not None:
+            self._port_reservation.release()
+        timeout_ms = self.conf.get_time_ms(K.APPLICATION_TIMEOUT, 0)
+        exit_code = self._execute(env, timeout_ms / 1000.0)
+        LOG.info("user process exited with %d", exit_code)
+        self._report(exit_code)
+        return exit_code
+
+    def _execute(self, env: dict[str, str], timeout_sec: float) -> int:
+        if not self.task_command:
+            LOG.error("no task command configured")
+            return C.EXIT_FAILURE
+        self._user_proc = launch_shell(self.task_command, extra_env=env,
+                                       cwd=os.getcwd())
+        self.monitor = TaskMonitor(
+            self.metrics_client, self.job_name, self.task_index,
+            pid_fn=lambda: (self._user_proc.pid
+                            if self._user_proc.poll() is None else None),
+            interval_sec=self.metrics_interval_sec)
+        self.monitor.start()
+        rc = wait_or_kill(self._user_proc, timeout_sec)
+        self.monitor.stop()
+        return rc
+
+    def _kill_user_proc(self) -> None:
+        proc = self._user_proc
+        if proc is not None and proc.poll() is None:
+            import signal
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+
+    def _report(self, exit_code: int) -> None:
+        if self.heartbeater is not None:
+            self.heartbeater.stop()
+        try:
+            self.client.register_execution_result(
+                exit_code, self.job_name, self.task_index, self.session_id)
+        except Exception:  # noqa: BLE001
+            LOG.exception("failed to register execution result")

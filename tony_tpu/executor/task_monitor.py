@@ -1,0 +1,149 @@
+"""TaskMonitor: per-task resource metrics sampler.
+
+Equivalent of the reference's TaskMonitor.java:25-192, which sampled process
+RSS via YARN's ResourceCalculatorProcessTree and GPU util/memory via
+nvidia-smi, kept max + running-average, and pushed the array to the AM every
+`tony.task.metrics-interval-ms`.
+
+TPU re-target: RSS comes from /proc/<pid>/status summed over the user
+process tree; the accelerator plane samples TPU runtime metrics through a
+pluggable callable (on TPU VMs, libtpu exposes duty-cycle/HBM via its
+monitoring socket — wire `tpu_sampler` to that; tests inject a fake).
+Metric names keep the reference's MAX_/AVG_ convention (TaskMonitor.java:34-46).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+from tony_tpu.rpc.client import MetricsServiceClient
+
+LOG = logging.getLogger(__name__)
+
+# reference metric names (TaskMonitor.java:34-46), GPU → TPU re-target
+MAX_MEMORY_BYTES = "MAX_MEMORY_BYTES"
+AVG_MEMORY_BYTES = "AVG_MEMORY_BYTES"
+MAX_TPU_UTILIZATION = "MAX_TPU_UTILIZATION"
+AVG_TPU_UTILIZATION = "AVG_TPU_UTILIZATION"
+MAX_TPU_HBM_BYTES = "MAX_TPU_HBM_BYTES"
+AVG_TPU_HBM_BYTES = "AVG_TPU_HBM_BYTES"
+
+
+def _proc_tree_rss_bytes(root_pid: int) -> int:
+    """Sum VmRSS over `root_pid` and its descendants (the reference's
+    ResourceCalculatorProcessTree equivalent, built on /proc)."""
+    children: dict[int, list[int]] = {}
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat", "r") as f:
+                    fields = f.read().rsplit(")", 1)[-1].split()
+                ppid = int(fields[1])
+                children.setdefault(ppid, []).append(int(entry))
+            except (OSError, IndexError, ValueError):
+                continue
+    except OSError:
+        return 0
+    total = 0
+    stack = [root_pid]
+    seen = set()
+    while stack:
+        pid = stack.pop()
+        if pid in seen:
+            continue
+        seen.add(pid)
+        try:
+            with open(f"/proc/{pid}/status", "r") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        total += int(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        stack.extend(children.get(pid, []))
+    return total
+
+
+class _Stat:
+    def __init__(self):
+        self.max = 0.0
+        self.avg = 0.0
+        self.n = 0
+
+    def update(self, value: float) -> None:
+        self.max = max(self.max, value)
+        self.n += 1
+        self.avg += (value - self.avg) / self.n
+
+
+class TaskMonitor:
+    """Samples every `interval_sec` and pushes to the AM's metrics RPC."""
+
+    def __init__(self, client: MetricsServiceClient, task_type: str,
+                 index: int, pid_fn: Callable[[], Optional[int]],
+                 interval_sec: float = 5.0,
+                 tpu_sampler: Optional[Callable[[], dict[str, float]]] = None):
+        self._client = client
+        self._task_type = task_type
+        self._index = index
+        self._pid_fn = pid_fn
+        self._interval = interval_sec
+        self._tpu_sampler = tpu_sampler
+        self._mem = _Stat()
+        self._tpu_util = _Stat()
+        self._tpu_hbm = _Stat()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="task-monitor",
+                                        daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def snapshot(self) -> list[dict]:
+        metrics = [
+            {"name": MAX_MEMORY_BYTES, "value": self._mem.max},
+            {"name": AVG_MEMORY_BYTES, "value": self._mem.avg},
+        ]
+        if self._tpu_util.n:
+            metrics += [
+                {"name": MAX_TPU_UTILIZATION, "value": self._tpu_util.max},
+                {"name": AVG_TPU_UTILIZATION, "value": self._tpu_util.avg},
+                {"name": MAX_TPU_HBM_BYTES, "value": self._tpu_hbm.max},
+                {"name": AVG_TPU_HBM_BYTES, "value": self._tpu_hbm.avg},
+            ]
+        return metrics
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._sample_and_push()
+        # final push so the AM's TASK_FINISHED event carries the last numbers
+        self._sample_and_push()
+
+    def _sample_and_push(self) -> None:
+        pid = self._pid_fn()
+        if pid is not None:
+            rss = _proc_tree_rss_bytes(pid)
+            if rss > 0:
+                self._mem.update(float(rss))
+        if self._tpu_sampler is not None:
+            try:
+                sample = self._tpu_sampler()
+                if "duty_cycle" in sample:
+                    self._tpu_util.update(sample["duty_cycle"])
+                if "hbm_bytes" in sample:
+                    self._tpu_hbm.update(sample["hbm_bytes"])
+            except Exception:  # noqa: BLE001 — metrics must never kill a task
+                LOG.exception("tpu sampler failed")
+        try:
+            self._client.update_metrics(self._task_type, self._index,
+                                        self.snapshot())
+        except Exception:  # noqa: BLE001
+            LOG.warning("metrics push failed", exc_info=True)

@@ -1,0 +1,108 @@
+"""TCP proxy: local port → cluster host relay.
+
+Equivalent of the reference's tony-proxy module
+(tony-proxy/src/main/java/com/linkedin/tony/ProxyServer.java:21-91): a
+blocking relay with two pump threads per connection, used by the notebook
+path to expose an in-cluster notebook/TensorBoard port on the gateway host.
+
+A native C++ implementation (src/native/tony_proxy.cc) provides the
+production relay; this module is the pure-Python equivalent and the
+launcher/fallback. Both speak plain TCP — nothing protocol-specific.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+
+LOG = logging.getLogger(__name__)
+
+_BUF = 64 * 1024
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    try:
+        while True:
+            data = src.recv(_BUF)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s in (src, dst):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+class ProxyServer:
+    """Listen on (local_host, local_port) and relay every connection to
+    (remote_host, remote_port)."""
+
+    def __init__(self, remote_host: str, remote_port: int,
+                 local_port: int = 0, local_host: str = "127.0.0.1"):
+        self._remote = (remote_host, remote_port)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((local_host, local_port))
+        self._listener.listen(16)
+        self.local_port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, name="proxy",
+                                        daemon=True)
+
+    def start(self) -> None:
+        LOG.info("proxy 127.0.0.1:%d -> %s:%d", self.local_port,
+                 self._remote[0], self._remote[1])
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return
+            try:
+                upstream = socket.create_connection(self._remote, timeout=10)
+            except OSError:
+                LOG.warning("cannot reach %s:%d", *self._remote)
+                conn.close()
+                continue
+            threading.Thread(target=_pump, args=(conn, upstream),
+                             daemon=True).start()
+            threading.Thread(target=_pump, args=(upstream, conn),
+                             daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def main(argv: list[str] | None = None) -> int:
+    import sys
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) not in (2, 3):
+        print("usage: python -m tony_tpu.proxy <remote_host> <remote_port> "
+              "[local_port]", file=sys.stderr)
+        return 2
+    logging.basicConfig(level=logging.INFO)
+    proxy = ProxyServer(args[0], int(args[1]),
+                        int(args[2]) if len(args) == 3 else 0)
+    proxy.start()
+    print(f"proxying 127.0.0.1:{proxy.local_port} -> {args[0]}:{args[1]}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -29,8 +29,7 @@ LOG = logging.getLogger(__name__)
 # fail-on-worker-failure is enabled — the reference deliberately counts them
 # there "to capture any worker task that was killed by the application master
 # which was not short circuited" (TonySession.java:316-320, 485-488).
-# YARN's value is -105; kept for parity.
-EXIT_KILLED_BY_AM = -105
+EXIT_KILLED_BY_AM = C.EXIT_KILLED_BY_AM
 
 
 class FinalStatus(str, enum.Enum):

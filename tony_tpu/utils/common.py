@@ -51,6 +51,21 @@ def parse_env_list(entries: list[str]) -> dict[str, str]:
     return out
 
 
+def framework_pythonpath() -> str:
+    """PYTHONPATH value that makes `tony_tpu` importable in child processes
+    regardless of their cwd (the reference shipped its fat jar into every
+    container's classpath, ClusterSubmitter.java:59-62; our equivalent is the
+    package's parent dir on PYTHONPATH)."""
+    import os
+    import tony_tpu
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.abspath(tony_tpu.__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    if existing and pkg_parent not in existing.split(os.pathsep):
+        return pkg_parent + os.pathsep + existing
+    return existing if pkg_parent in existing.split(os.pathsep) else pkg_parent
+
+
 def current_host() -> str:
     """Best-effort resolvable hostname for rendezvous registration."""
     host = socket.gethostname()

@@ -1,0 +1,71 @@
+"""Resource localization: ship user files into the per-app staging dir.
+
+Equivalent of the reference's LocalizableResource.java:20-102 spec parsing
+(`path[::newName][#archive]`) + TonyClient.processTonyConfResources
+(TonyClient.java:519-590), which uploaded local files/dirs to the per-app
+HDFS dir and rewrote the conf to remote URIs, and Utils.addResources /
+extractResources on the container side (util/Utils.java:506-550,699-712).
+
+The local backend's "remote store" is the shared app dir; the functions take
+plain paths so an object-store backend (GCS for TPU pods) can wrap them.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from tony_tpu.utils.fs import copy_into, unzip, zip_dir
+
+ARCHIVE_SUFFIX = "#archive"
+NAME_SEP = "::"
+
+
+@dataclass
+class LocalizableResource:
+    """Parsed `path[::newName][#archive]` spec (LocalizableResource.java:20-102)."""
+    source_path: str
+    local_name: str
+    is_archive: bool
+
+    @classmethod
+    def parse(cls, spec: str) -> "LocalizableResource":
+        is_archive = spec.endswith(ARCHIVE_SUFFIX)
+        if is_archive:
+            spec = spec[: -len(ARCHIVE_SUFFIX)]
+        if NAME_SEP in spec:
+            path, _, name = spec.partition(NAME_SEP)
+        else:
+            path, name = spec, os.path.basename(spec.rstrip("/"))
+        if not path:
+            raise ValueError(f"empty path in resource spec {spec!r}")
+        return cls(source_path=path, local_name=name, is_archive=is_archive)
+
+
+def stage_resource(spec: str, staging_dir: str) -> str:
+    """Copy one resource into the staging dir (dirs are zipped, like
+    TonyClient.java:539-551). Returns the staged spec string (path
+    [+#archive]) to write back into the conf."""
+    res = LocalizableResource.parse(spec)
+    src = res.source_path
+    if not os.path.exists(src):
+        raise FileNotFoundError(f"resource not found: {src}")
+    if os.path.isdir(src):
+        staged = os.path.join(staging_dir, res.local_name + ".zip")
+        zip_dir(src, staged)
+        return staged + ARCHIVE_SUFFIX
+    staged = copy_into(src, staging_dir, new_name=res.local_name)
+    return staged + (ARCHIVE_SUFFIX if res.is_archive else "")
+
+
+def localize_resource(spec: str, dest_dir: str) -> str:
+    """Container-side: materialize a staged resource into the task workdir —
+    archives are unzipped, plain files symlinked/copied
+    (Utils.addResources + extractResources, util/Utils.java:506-550,699-712)."""
+    res = LocalizableResource.parse(spec)
+    if res.is_archive or res.source_path.endswith(".zip"):
+        name = res.local_name
+        if name.endswith(".zip"):
+            name = name[:-4]
+        return unzip(res.source_path, os.path.join(dest_dir, name))
+    return copy_into(res.source_path, dest_dir, new_name=res.local_name)

@@ -28,25 +28,27 @@ _SCRUBBED_ENV = (
 )
 
 
-def execute_shell(command: str, timeout_sec: float = 0,
-                  extra_env: Optional[Mapping[str, str]] = None,
-                  cwd: Optional[str] = None,
-                  stdout=None, stderr=None) -> int:
-    """Run `command` via bash; return its exit code. timeout 0 = unlimited.
-    On timeout the whole process group is killed and exit code 124 returned."""
+def launch_shell(command: str, extra_env: Optional[Mapping[str, str]] = None,
+                 cwd: Optional[str] = None, stdout=None, stderr=None
+                 ) -> subprocess.Popen:
+    """Start `command` via bash and return the Popen (caller waits). Used by
+    the TaskExecutor so the metrics monitor can sample the live process."""
     env = dict(os.environ)
     for var in _SCRUBBED_ENV:
         env.pop(var, None)
     if extra_env:
         env.update({k: str(v) for k, v in extra_env.items()})
-    proc = subprocess.Popen(
+    return subprocess.Popen(
         ["bash", "-c", command],
-        env=env,
-        cwd=cwd,
+        env=env, cwd=cwd,
         stdout=stdout if stdout is not None else sys.stdout,
         stderr=stderr if stderr is not None else sys.stderr,
-        start_new_session=True,  # own process group so we can kill the tree
+        start_new_session=True,
     )
+
+
+def wait_or_kill(proc: subprocess.Popen, timeout_sec: float = 0) -> int:
+    """Wait for `proc`; on timeout kill its process group and return 124."""
     try:
         return proc.wait(timeout=timeout_sec if timeout_sec > 0 else None)
     except subprocess.TimeoutExpired:
@@ -56,3 +58,14 @@ def execute_shell(command: str, timeout_sec: float = 0,
             pass
         proc.wait()
         return 124
+
+
+def execute_shell(command: str, timeout_sec: float = 0,
+                  extra_env: Optional[Mapping[str, str]] = None,
+                  cwd: Optional[str] = None,
+                  stdout=None, stderr=None) -> int:
+    """Run `command` via bash; return its exit code. timeout 0 = unlimited.
+    On timeout the whole process group is killed and exit code 124 returned."""
+    proc = launch_shell(command, extra_env=extra_env, cwd=cwd,
+                        stdout=stdout, stderr=stderr)
+    return wait_or_kill(proc, timeout_sec)
