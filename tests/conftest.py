@@ -28,3 +28,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+# A TPU-tunnel sitecustomize may have imported jax at interpreter start, in
+# which case jax.config already captured JAX_PLATFORMS from the pre-scrub
+# env — force the platform through the config API too.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

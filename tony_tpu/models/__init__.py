@@ -1,0 +1,21 @@
+"""Flagship JAX models for the framework's example/benchmark jobs.
+
+The reference shipped user-side example models (tony-examples/: distributed
+MNIST for TF/PyTorch/Keras, MXNet linear regression — SURVEY.md §2.2); this
+package is their TPU-native counterpart plus the Llama-family transformer
+the BASELINE targets (Llama-3-8B pretrain on a TPU pod). Models are pure
+pytrees + functions: init(cfg, key) -> params, forward(params, batch) ->
+logits, with logical sharding axes declared next to the params.
+"""
+
+from tony_tpu.models.llama import (
+    LlamaConfig, llama_forward, llama_init, llama_loss, llama_param_axes,
+)
+from tony_tpu.models.mnist import mnist_forward, mnist_init, mnist_loss
+from tony_tpu.models.linear import linreg_forward, linreg_init, linreg_loss
+
+__all__ = [
+    "LlamaConfig", "llama_forward", "llama_init", "llama_loss",
+    "llama_param_axes", "mnist_forward", "mnist_init", "mnist_loss",
+    "linreg_forward", "linreg_init", "linreg_loss",
+]

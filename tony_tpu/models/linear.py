@@ -1,0 +1,26 @@
+"""Linear regression: parity model for tony-examples/linearregression-mxnet.
+
+The reference's MXNet example fit a linear model through KVStore parameter
+servers (SURVEY.md §2.2); here it is a two-parameter JAX model trained
+data-parallel through the same framework runtime as every other model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def linreg_init(key: jax.Array, num_features: int = 10) -> dict:
+    kw, kb = jax.random.split(key)
+    return {"w": jax.random.normal(kw, (num_features,)) * 0.01,
+            "b": jnp.zeros(())}
+
+
+def linreg_forward(params: dict, x: jax.Array) -> jax.Array:
+    return x @ params["w"] + params["b"]
+
+
+def linreg_loss(params: dict, batch: dict[str, jax.Array]) -> jax.Array:
+    pred = linreg_forward(params, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)

@@ -1,0 +1,242 @@
+"""Llama-family transformer, TPU-first.
+
+Design choices (and why they differ from a GPU/torch translation):
+- **Stacked layers + lax.scan**: all L layers' weights are stacked on a
+  leading axis and the block runs under `lax.scan` — one trace, one compile,
+  regardless of depth (no Python-loop unrolling; XLA-friendly control flow).
+- **jax.checkpoint on the block**: rematerialize activations per layer,
+  trading MXU FLOPs for HBM — the standard TPU memory lever.
+- **bf16 params / f32 stats**: matmuls run on the MXU in bf16 with f32
+  accumulation (`preferred_element_type` inside the ops package); norms and
+  softmax statistics stay f32.
+- **logical sharding axes** declared next to the params
+  (`llama_param_axes`): embed/mlp dims shard over fsdp+tp, batch over
+  (dp, fsdp), sequence over sp; `parallel.sharding.constrain` applies them
+  against whatever mesh is ambient, so the same code runs single-chip or on
+  a pod.
+- **GQA + RoPE + SwiGLU + RMSNorm** matching the Llama-3 architecture; the
+  8B preset mirrors the BASELINE target config.
+- Attention dispatch: ring attention over the `sp` axis when the ambient
+  mesh shards sequence (long-context), pallas flash attention otherwise.
+
+Equivalent role in the reference: tony-examples' model zoo (SURVEY.md §2.2),
+re-targeted at the Llama-3-8B JAX pretrain named in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.ops.attention import flash_attention
+from tony_tpu.ops.rmsnorm import rms_norm
+from tony_tpu.ops.rope import apply_rope, rope_frequencies
+from tony_tpu.parallel.ring import ring_attention
+from tony_tpu.parallel.sharding import constrain, logical_to_mesh_axes
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self, seq_len: Optional[int] = None) -> float:
+        """Approx training FLOPs/token (fwd+bwd ≈ 6N + attention term)."""
+        n = self.num_params()
+        s = seq_len or self.max_seq
+        attn = 12 * self.n_layers * self.dim * s  # causal: ~half of 2*2*3
+        return 6.0 * n + attn
+
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        hd = self.head_dim
+        attn = d * (self.n_heads * hd) + 2 * d * (self.n_kv_heads * hd) \
+            + (self.n_heads * hd) * d
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        return v * d + self.n_layers * per_layer + d + d * v
+
+
+# Presets. llama3_8b mirrors BASELINE.json's target model; the tiny/bench
+# configs scale it down for tests and single-chip benchmarking.
+PRESETS = {
+    "llama3_8b": LlamaConfig(),
+    "llama3_1b_proxy": LlamaConfig(vocab_size=32_000, dim=2048, n_layers=16,
+                                   n_heads=16, n_kv_heads=8, ffn_dim=8192,
+                                   max_seq=4096),
+    "bench_350m": LlamaConfig(vocab_size=32_000, dim=1024, n_layers=16,
+                              n_heads=16, n_kv_heads=8, ffn_dim=4096,
+                              max_seq=2048),
+    "tiny": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, ffn_dim=128, max_seq=128,
+                        dtype=jnp.float32, remat=False),
+}
+
+
+def get_config(name: str, **overrides) -> LlamaConfig:
+    return replace(PRESETS[name], **overrides)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def llama_init(config: LlamaConfig, key: jax.Array) -> Params:
+    """Scaled-normal init; per-layer weights stacked on a leading axis."""
+    d, f = config.dim, config.ffn_dim
+    hd, nh, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    L = config.n_layers
+    k_embed, k_out, k_layers = jax.random.split(key, 3)
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            config.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    scale_in = d ** -0.5
+    scale_ffn = f ** -0.5
+    return {
+        "embed": normal(k_embed, (config.vocab_size, d), 1.0),
+        "layers": {
+            "wq": normal(ks[0], (L, d, nh * hd), scale_in),
+            "wk": normal(ks[1], (L, d, nkv * hd), scale_in),
+            "wv": normal(ks[2], (L, d, nkv * hd), scale_in),
+            "wo": normal(ks[3], (L, nh * hd, d), scale_in),
+            "w_gate": normal(ks[4], (L, d, f), scale_in),
+            "w_up": normal(ks[5], (L, d, f), scale_in),
+            "w_down": normal(ks[6], (L, f, d), scale_ffn),
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "output": normal(k_out, (d, config.vocab_size), scale_in),
+    }
+
+
+def llama_param_axes(config: LlamaConfig) -> Params:
+    """Logical sharding axes, same tree shape as the params."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "attn_norm": ("layers", "norm"),
+            "mlp_norm": ("layers", "norm"),
+        },
+        "final_norm": ("norm",),
+        "output": ("embed", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _attention_dispatch(q, k, v, config: LlamaConfig):
+    """Ring attention when the ambient mesh shards the sequence axis, flash
+    attention otherwise."""
+    mesh = jax.sharding.get_abstract_mesh()
+    sp = mesh.shape.get("sp", 1) if mesh is not None and mesh.axis_names else 1
+    if sp > 1:
+        spec = logical_to_mesh_axes(("batch", "heads", "seq", None),
+                                    mesh=mesh)
+        f = jax.shard_map(
+            partial(ring_attention, axis_name="sp", causal=True),
+            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        return f(q, k, v)
+    return flash_attention(q, k, v, True)
+
+
+def _block(config: LlamaConfig, cos, sin, x, layer: Params):
+    b, s, d = x.shape
+    nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    q = jnp.einsum("bsd,dh->bsh", h, layer["wq"])
+    k = jnp.einsum("bsd,dh->bsh", h, layer["wk"])
+    v = jnp.einsum("bsd,dh->bsh", h, layer["wv"])
+    q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)      # (B,H,S,hd)
+    k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if nkv != nh:                                          # GQA broadcast
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    q = constrain(q, ("batch", "heads", "seq", None))
+    k = constrain(k, ("batch", "heads", "seq", None))
+    v = constrain(v, ("batch", "heads", "seq", None))
+    attn = _attention_dispatch(q, k, v, config)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
+    x = constrain(x, ("batch", "seq", None))
+
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+    ff = jax.nn.silu(gate) * up
+    ff = constrain(ff, ("batch", "seq", "mlp"))
+    x = x + jnp.einsum("bsf,fd->bsd", ff, layer["w_down"])
+    return constrain(x, ("batch", "seq", None))
+
+
+def llama_forward(params: Params, tokens: jax.Array,
+                  config: LlamaConfig) -> jax.Array:
+    """tokens: (B, S) int32 -> logits (B, S, vocab) in f32."""
+    s = tokens.shape[1]
+    cos, sin = rope_frequencies(config.head_dim, s, config.rope_theta)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
+    x = constrain(x, ("batch", "seq", None))
+
+    block = partial(_block, config, cos, sin)
+    if config.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                        params["output"].astype(jnp.float32))
+    return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def llama_loss(params: Params, batch: dict[str, jax.Array],
+               config: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy. batch: {'tokens': (B, S+1)} or
+    {'inputs': (B,S), 'targets': (B,S)}."""
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = llama_forward(params, inputs, config)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,51 @@
+"""MNIST MLP: parity model for the reference's flagship example.
+
+The reference's canonical E2E workload is
+tony-examples/mnist-tensorflow/mnist_distributed.py (SURVEY.md §2.2): a
+784-300-100-10 MLP trained data-parallel. Same architecture here as pure
+JAX, trained via the framework's JAX runtime instead of TF parameter
+servers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+LAYER_SIZES = (784, 300, 100, 10)
+
+
+def mnist_init(key: jax.Array, dtype=jnp.float32) -> dict[str, Any]:
+    params = {}
+    keys = jax.random.split(key, len(LAYER_SIZES) - 1)
+    for i, (fan_in, fan_out) in enumerate(zip(LAYER_SIZES, LAYER_SIZES[1:])):
+        params[f"w{i}"] = (jax.random.normal(keys[i], (fan_in, fan_out))
+                           * (2.0 / fan_in) ** 0.5).astype(dtype)
+        params[f"b{i}"] = jnp.zeros((fan_out,), dtype)
+    return params
+
+
+def mnist_forward(params: dict[str, Any], x: jax.Array) -> jax.Array:
+    """x: (B, 784) -> logits (B, 10)."""
+    n = len(LAYER_SIZES) - 1
+    for i in range(n):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mnist_loss(params: dict[str, Any], batch: dict[str, jax.Array]) -> jax.Array:
+    logits = mnist_forward(params, batch["images"])
+    labels = batch["labels"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def mnist_accuracy(params: dict[str, Any],
+                   batch: dict[str, jax.Array]) -> jax.Array:
+    logits = mnist_forward(params, batch["images"])
+    return jnp.mean(jnp.argmax(logits, axis=-1) == batch["labels"])

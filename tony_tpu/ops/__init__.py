@@ -1,0 +1,15 @@
+"""TPU kernels for the hot ops.
+
+The reference has no compute kernels (it is an orchestrator; SURVEY.md §2) —
+this package is the TPU-native compute substrate its scheduled jobs run on:
+a pallas flash-attention kernel (MXU-tiled, online softmax, causal-block
+skipping), a fused RMSNorm kernel, and rotary embeddings. Every op has a
+pure-jnp reference implementation used for CPU fallback and parity tests.
+"""
+
+from tony_tpu.ops.attention import flash_attention, reference_attention
+from tony_tpu.ops.rmsnorm import rms_norm
+from tony_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["flash_attention", "reference_attention", "rms_norm",
+           "apply_rope", "rope_frequencies"]

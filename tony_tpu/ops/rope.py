@@ -1,0 +1,49 @@
+"""Rotary position embeddings (RoPE).
+
+Pure jnp: RoPE is elementwise and XLA fuses it into the surrounding QK
+projections — a hand kernel would buy nothing (pallas_guide: let the
+compiler fuse elementwise chains). Uses the half-rotation formulation
+(rotate pairs (x1, x2) -> (x1 cos - x2 sin, x1 sin + x2 cos)) with
+f32 trig tables.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int,
+                     theta: float = 10_000.0) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape (max_seq, head_dim//2), f32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)                  # (S, D/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array | None = None) -> jax.Array:
+    """x: (B, H, S, D). cos/sin: (max_seq, D/2). positions: (S,) or (B, S)
+    absolute positions (defaults to arange) — sequence-parallel shards pass
+    their global offsets here."""
+    b, h, s, d = x.shape
+    if positions is None:
+        cos_s, sin_s = cos[:s], sin[:s]             # (S, D/2)
+        cos_s = cos_s[None, None]
+        sin_s = sin_s[None, None]
+    elif positions.ndim == 1:                        # (S,) shared positions
+        cos_s = cos[positions][None, None]           # (1, 1, S, D/2)
+        sin_s = sin[positions][None, None]
+    elif positions.ndim == 2:                        # (B, S) per-batch
+        cos_s = cos[positions][:, None]              # (B, 1, S, D/2)
+        sin_s = sin[positions][:, None]
+    else:
+        raise ValueError(f"positions must be (S,) or (B, S); "
+                         f"got shape {positions.shape}")
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    rotated = jnp.concatenate(
+        (x1 * cos_s - x2 * sin_s, x1 * sin_s + x2 * cos_s), axis=-1)
+    return rotated.astype(x.dtype)

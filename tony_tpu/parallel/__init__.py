@@ -1,0 +1,30 @@
+"""Parallelism: device meshes, sharding rules, and sequence parallelism.
+
+The reference is an orchestrator and implements no tensor math — its
+parallelism support ends at gang-scheduling topologies and rendering
+rendezvous env (SURVEY.md §2.3). This package is the greenfield TPU-native
+compute-plane counterpart: a named `jax.sharding.Mesh` over ICI/DCN axes,
+partition-spec rules for model parameters, and ring-attention sequence
+parallelism — so the jobs this framework schedules have a first-class
+distributed runtime instead of delegating to PS/NCCL inside user code.
+
+Axes convention (scaling-book style):
+    dp    data parallel (pure replication of params, batch split)
+    fsdp  fully-sharded data parallel (params sharded along it, batch split)
+    tp    tensor parallel (attention heads / mlp hidden split)
+    sp    sequence/context parallel (ring attention over ICI neighbors)
+    pp    pipeline parallel (layer stages)
+    ep    expert parallel (MoE experts)
+"""
+
+from tony_tpu.parallel.mesh import (
+    MESH_AXES, MeshPlan, make_mesh, mesh_from_env, plan_mesh,
+)
+from tony_tpu.parallel.sharding import (
+    logical_to_mesh_axes, make_partition_spec, shard_pytree,
+)
+
+__all__ = [
+    "MESH_AXES", "MeshPlan", "make_mesh", "mesh_from_env", "plan_mesh",
+    "logical_to_mesh_axes", "make_partition_spec", "shard_pytree",
+]

@@ -1,0 +1,107 @@
+"""Device mesh construction over ICI/DCN axes.
+
+TPU-first design: intra-slice axes (fsdp/tp/sp) map onto ICI neighbors where
+collectives are cheapest; the outermost dp axis is the one that crosses
+slices over DCN in multi-slice jobs, matching the scaling-book recipe (data
+parallel over DCN, everything bandwidth-hungry inside the slice). The
+orchestrator renders TPU_MESH_SHAPE/TPU_MESH_AXES env per task
+(tony_tpu/executor/runtimes.py `_jax_env`); `mesh_from_env` turns that into
+a live `jax.sharding.Mesh` inside the training process.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tony_tpu import constants as C
+
+# canonical axis order: DCN-crossing axes first (outer), ICI axes inner
+MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+
+@dataclass
+class MeshPlan:
+    """A named mesh shape; axes of size 1 are kept so PartitionSpecs can
+    reference every canonical axis unconditionally."""
+    shape: dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for axis in self.shape:
+            if axis not in MESH_AXES:
+                raise ValueError(f"unknown mesh axis {axis!r}; "
+                                 f"expected subset of {MESH_AXES}")
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(a for a in MESH_AXES if a in self.shape)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape.values()) if self.shape else 1
+
+    def dims(self) -> tuple[int, ...]:
+        return tuple(self.shape[a] for a in self.axis_names)
+
+
+def plan_mesh(num_devices: int, *, tp: int = 1, sp: int = 1, pp: int = 1,
+              ep: int = 1, fsdp: int = 0, dp: int = 0) -> MeshPlan:
+    """Factor `num_devices` into a mesh plan. Explicit tp/sp/pp/ep are taken
+    as given; the remainder goes to fsdp (default) and dp. Pass fsdp/dp
+    explicitly to pin them; 0 means 'absorb the remainder' (fsdp wins)."""
+    fixed = tp * sp * pp * ep
+    if num_devices % fixed != 0:
+        raise ValueError(
+            f"{num_devices} devices not divisible by tp*sp*pp*ep={fixed}")
+    remainder = num_devices // fixed
+    if fsdp and dp:
+        if dp * fsdp != remainder:
+            raise ValueError(
+                f"dp*fsdp={dp * fsdp} != remaining device count {remainder}")
+    elif fsdp:
+        if remainder % fsdp != 0:
+            raise ValueError(f"fsdp={fsdp} does not divide {remainder}")
+        dp = remainder // fsdp
+    elif dp:
+        if remainder % dp != 0:
+            raise ValueError(f"dp={dp} does not divide {remainder}")
+        fsdp = remainder // dp
+    else:
+        dp, fsdp = 1, remainder
+    return MeshPlan({"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp,
+                     "pp": pp, "ep": ep})
+
+
+def make_mesh(plan: MeshPlan, devices=None) -> Mesh:
+    """Build the jax Mesh. Device order is preserved from `jax.devices()`,
+    which on TPU enumerates ICI-contiguous devices — keeping inner axes
+    (tp/sp) on ICI neighbors."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < plan.num_devices:
+        raise ValueError(
+            f"mesh needs {plan.num_devices} devices, have {len(devices)}")
+    grid = np.array(devices[: plan.num_devices]).reshape(plan.dims())
+    return Mesh(grid, plan.axis_names)
+
+
+def mesh_from_env(devices=None) -> Mesh:
+    """Build the mesh from the env the TaskExecutor's JAX runtime rendered
+    (TPU_MESH_SHAPE='2,2,2' + TPU_MESH_AXES='dp,fsdp,tp'); falls back to a
+    pure-fsdp mesh over all local devices when unset."""
+    shape_s = os.environ.get(C.TPU_MESH_SHAPE, "")
+    axes_s = os.environ.get(C.TPU_MESH_AXES, "")
+    devices = list(devices if devices is not None else jax.devices())
+    if not shape_s:
+        return make_mesh(plan_mesh(len(devices)), devices)
+    dims = [int(x) for x in shape_s.split(",") if x.strip()]
+    axes = [a.strip() for a in axes_s.split(",") if a.strip()]
+    if len(dims) != len(axes):
+        raise ValueError(
+            f"TPU_MESH_SHAPE {shape_s!r} / TPU_MESH_AXES {axes_s!r} mismatch")
+    plan = MeshPlan(dict(zip(axes, dims)))
+    return make_mesh(plan, devices)

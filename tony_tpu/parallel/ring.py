@@ -1,0 +1,90 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+Long-context design (first-class per the build goals; absent from the
+reference, SURVEY.md §5): the sequence dim is sharded over `sp`, each device
+holds its local Q/K/V chunk, and K/V chunks rotate around the ring via
+`lax.ppermute` — ICI neighbor traffic only, overlapping the blockwise
+attention compute. Online-softmax accumulators (m, l, acc) merge the chunks
+exactly, so the result matches full attention bit-for-mathematically.
+
+Causality uses *global* positions (chunk_index * chunk_len + local offset):
+a K/V chunk that is entirely in this Q chunk's future contributes nothing
+(masked), chunks on the diagonal get the triangular mask, past chunks attend
+fully. Everything is pure differentiable jnp + ppermute, so gradients flow
+through the ring for training (blockwise-parallel-transformer style).
+
+Use inside shard_map, or via `ring_attention_sharded` which wraps the
+shard_map with the canonical activation specs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tony_tpu.ops.attention import NEG_INF
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp", causal: bool = False,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Call inside shard_map. q,k,v: local shards (B, H, S_local, D); the
+    global sequence is the concatenation over `axis_name` in ring order."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    qf = q.astype(jnp.float32) * sm_scale
+    rows = my_idx * s_local + lax.broadcasted_iota(
+        jnp.int32, (s_local, s_local), 0)
+
+    def step(t, carry):
+        m_prev, l_prev, acc, k_cur, v_cur = carry
+        src_idx = (my_idx - t) % n           # who produced the chunk we hold
+        s_blk = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                           k_cur.astype(jnp.float32),
+                           preferred_element_type=jnp.float32)
+        if causal:
+            cols = src_idx * s_local + lax.broadcasted_iota(
+                jnp.int32, (s_local, s_local), 1)
+            s_blk = jnp.where((rows >= cols)[None, None], s_blk, NEG_INF)
+        m_cur = jnp.max(s_blk, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s_blk - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        # rotate K/V to the next neighbor; the last rotation is wasted but
+        # keeps the loop body uniform (and XLA overlaps it with compute)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, acc, k_nxt, v_nxt
+
+    init = (jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s_local, 1), jnp.float32),
+            jnp.zeros((b, h, s_local, d), jnp.float32),
+            k, v)
+    m, l, acc, _, _ = lax.fori_loop(0, n, step, init)
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l).astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, causal: bool = False,
+                           sm_scale: Optional[float] = None) -> jax.Array:
+    """Standalone wrapper: shards batch over (dp, fsdp), heads over tp, and
+    sequence over sp, then runs the ring."""
+    spec = P(("dp", "fsdp"), "tp", "sp", None)
+    f = jax.shard_map(
+        lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="sp",
+                                          causal=causal, sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)

@@ -1,0 +1,21 @@
+"""Training runtime: pjit train step, checkpointing, data, trainer loop.
+
+The reference deliberately owned no training loop — checkpoint/resume was
+delegated to user frameworks and its contribution was restartability context
+(ATTEMPT_NUMBER env + AM retry; SURVEY.md §5). This package is the JAX
+runtime those orchestrated jobs run: a sharded train step, orbax-style
+checkpoint save/restore keyed by step, and a Trainer that wires
+`jax.distributed` bootstrap env (rendered by the TaskExecutor) to a mesh and
+resumes from the latest checkpoint after an AM retry.
+"""
+
+from tony_tpu.train.step import make_train_step
+from tony_tpu.train.checkpoint import (
+    latest_step, restore_checkpoint, save_checkpoint,
+)
+from tony_tpu.train.trainer import Trainer, TrainerConfig
+
+__all__ = [
+    "make_train_step", "latest_step", "restore_checkpoint",
+    "save_checkpoint", "Trainer", "TrainerConfig",
+]

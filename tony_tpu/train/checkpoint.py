@@ -1,0 +1,82 @@
+"""Step-keyed checkpoint save/restore.
+
+Orbax-style layout without the dependency surface: each step writes
+`<dir>/step_<N>/` containing one .npy per leaf plus a pickled treedef, via a
+tmp-dir + atomic rename so a preempted write never leaves a half checkpoint
+(the same .inprogress->final discipline as the event history). Only process
+0 writes in multi-host jobs; every process reads.
+
+This is the model-state half of the restart story: the orchestrator supplies
+attempt identity + AM retry (SURVEY.md §5 'checkpoint/resume'), the Trainer
+calls `latest_step` on boot and resumes.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import shutil
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_TREE_FILE = "tree.pkl"
+
+
+def _gather_leaf(leaf: Any) -> np.ndarray:
+    """Make a leaf host-readable. Cross-process sharded arrays are gathered
+    collectively (all processes must call this — it is a collective)."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    return np.asarray(leaf)
+
+
+def save_checkpoint(ckpt_dir: str, step: int, state: Any) -> Optional[str]:
+    """Write `state` (any pytree of arrays) as step `step`. All processes
+    must call this (gathering sharded leaves is collective); only process 0
+    writes. Returns the final path, or None on non-zero processes."""
+    leaves, treedef = jax.tree.flatten(state)
+    leaves = [_gather_leaf(leaf) for leaf in leaves]
+    if jax.process_index() != 0:
+        return None
+    final = os.path.join(ckpt_dir, f"step_{step}")
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    for i, leaf in enumerate(leaves):
+        np.save(os.path.join(tmp, f"leaf_{i}.npy"), leaf)
+    with open(os.path.join(tmp, _TREE_FILE), "wb") as f:
+        pickle.dump(treedef, f)
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    return final
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [int(m.group(1)) for name in os.listdir(ckpt_dir)
+             if (m := _STEP_RE.match(name))]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None) -> Any:
+    """Read a checkpoint back as a pytree of numpy arrays (callers re-shard
+    with parallel.shard_pytree / device_put)."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, f"step_{step}")
+    with open(os.path.join(path, _TREE_FILE), "rb") as f:
+        treedef = pickle.load(f)
+    num_leaves = treedef.num_leaves
+    leaves = [np.load(os.path.join(path, f"leaf_{i}.npy"))
+              for i in range(num_leaves)]
+    return jax.tree.unflatten(treedef, leaves)

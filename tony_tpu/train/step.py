@@ -1,0 +1,40 @@
+"""Sharded train step.
+
+One jitted function does forward, backward, and optimizer update; under an
+ambient mesh (jax.set_mesh) XLA inserts the data-parallel gradient
+reduce-scatters and FSDP all-gathers from the shardings alone — no explicit
+collectives, per the scaling-book recipe. Buffers are donated so params and
+optimizer state update in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+
+
+def make_train_step(loss_fn: Callable[..., jax.Array],
+                    optimizer: optax.GradientTransformation,
+                    jit: bool = True) -> Callable:
+    """loss_fn(params, batch) -> scalar. Returns
+    train_step(params, opt_state, batch) -> (params, opt_state, loss)."""
+
+    def train_step(params: Any, opt_state: Any, batch: Any):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if jit:
+        train_step = jax.jit(train_step, donate_argnums=(0, 1))
+    return train_step
+
+
+def make_eval_step(loss_fn: Callable[..., jax.Array],
+                   jit: bool = True) -> Callable:
+    def eval_step(params: Any, batch: Any) -> jax.Array:
+        return loss_fn(params, batch)
+
+    return jax.jit(eval_step) if jit else eval_step

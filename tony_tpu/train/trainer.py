@@ -1,0 +1,167 @@
+"""Trainer: the user-process entry the orchestrator's JAX runtime launches.
+
+Boot sequence inside a task container:
+1. `jax.distributed.initialize` from the env the TaskExecutor rendered
+   (JAX_COORDINATOR_ADDRESS / JAX_PROCESS_ID / JAX_NUM_PROCESSES —
+   tony_tpu/executor/runtimes.py `_jax_env`), the TPU-native analogue of
+   the reference examples reading TF_CONFIG/RANK (SURVEY.md §3.3).
+2. Build the mesh from TPU_MESH_SHAPE/TPU_MESH_AXES (mesh_from_env), shard
+   params with the model's logical axes, and jit the train step under the
+   ambient mesh.
+3. Resume from the latest checkpoint if one exists (AM-retry survival:
+   ATTEMPT_NUMBER advances, model state comes back from disk), then step,
+   log, and checkpoint on the configured cadence.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import optax
+
+from tony_tpu import constants as C
+from tony_tpu.parallel import mesh_from_env, shard_pytree
+from tony_tpu.train.checkpoint import (
+    latest_step, restore_checkpoint, save_checkpoint,
+)
+from tony_tpu.train.data import global_batch_iterator
+from tony_tpu.train.step import make_train_step
+
+LOG = logging.getLogger(__name__)
+
+
+def maybe_initialize_distributed() -> None:
+    """Call jax.distributed.initialize iff the orchestrator rendered a
+    multi-process env; single-process runs skip it."""
+    num = int(os.environ.get(C.JAX_NUM_PROCESSES, "1"))
+    if num <= 1:
+        return
+    coordinator = os.environ[C.JAX_COORDINATOR_ADDRESS]
+    process_id = int(os.environ[C.JAX_PROCESS_ID])
+    LOG.info("jax.distributed.initialize(%s, num=%d, id=%d)",
+             coordinator, num, process_id)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num, process_id=process_id)
+
+
+@dataclass
+class TrainerConfig:
+    num_steps: int = 100
+    log_every: int = 10
+    checkpoint_every: int = 0            # 0 = only at the end
+    checkpoint_dir: str = ""             # "" = no checkpointing
+    learning_rate: float = 3e-4
+    warmup_steps: int = 10
+    weight_decay: float = 0.01
+    seed: int = 0
+    optimizer: Optional[optax.GradientTransformation] = None
+    extra: dict = field(default_factory=dict)
+
+
+class Trainer:
+    def __init__(self, loss_fn: Callable[[Any, Any], jax.Array],
+                 init_fn: Callable[[jax.Array], Any],
+                 data_iter: Iterator[Any],
+                 config: TrainerConfig,
+                 param_axes: Optional[Any] = None):
+        self.loss_fn = loss_fn
+        self.init_fn = init_fn
+        self.data_iter = data_iter
+        self.config = config
+        self.param_axes = param_axes
+        self.mesh = None
+        self.step = 0
+        self.params = None
+        self.opt_state = None
+        self.last_loss: Optional[float] = None
+        self.metrics_history: list[dict] = []
+
+    # ------------------------------------------------------------------
+    def setup(self) -> None:
+        maybe_initialize_distributed()
+        self.mesh = mesh_from_env()
+        LOG.info("mesh: %s over %d devices", dict(self.mesh.shape),
+                 self.mesh.devices.size)
+        cfg = self.config
+        if cfg.optimizer is not None:
+            self.optimizer = cfg.optimizer
+        else:
+            schedule = optax.warmup_cosine_decay_schedule(
+                0.0, cfg.learning_rate, max(1, cfg.warmup_steps),
+                max(cfg.num_steps, cfg.warmup_steps + 1))
+            self.optimizer = optax.adamw(schedule,
+                                         weight_decay=cfg.weight_decay)
+        self.train_step = make_train_step(self.loss_fn, self.optimizer)
+
+        resume = (latest_step(cfg.checkpoint_dir)
+                  if cfg.checkpoint_dir else None)
+        restored_opt = None
+        if resume is not None:
+            LOG.info("resuming from checkpoint step %d", resume)
+            state = restore_checkpoint(cfg.checkpoint_dir, resume)
+            params, restored_opt, self.step = (
+                state["params"], state["opt_state"], int(state["step"]))
+        else:
+            params = self.init_fn(jax.random.PRNGKey(cfg.seed))
+        if self.param_axes is not None:
+            params = shard_pytree(params, self.param_axes, self.mesh)
+        else:
+            params = jax.device_put(params)
+        self.params = params
+        # jit the optimizer init so the Adam moments inherit the params'
+        # shardings (zeros_like propagates sharding) instead of landing
+        # replicated — at 8B that's the difference between fitting and OOM
+        with jax.set_mesh(self.mesh):
+            opt_state = jax.jit(self.optimizer.init)(self.params)
+            if restored_opt is not None:
+                # place restored host arrays with the freshly-derived shardings
+                opt_state = jax.tree.map(
+                    lambda ref, x: jax.device_put(
+                        x, ref.sharding) if isinstance(ref, jax.Array) else x,
+                    opt_state, restored_opt)
+        self.opt_state = opt_state
+        # multi-process data parallelism: assemble global arrays from each
+        # process's local shard
+        self.data_iter = global_batch_iterator(self.data_iter, self.mesh)
+
+    # ------------------------------------------------------------------
+    def run(self) -> float:
+        """Train to num_steps; returns the final loss."""
+        if self.params is None:
+            self.setup()
+        cfg = self.config
+        loss = None
+        with jax.set_mesh(self.mesh):
+            t0 = time.monotonic()
+            while self.step < cfg.num_steps:
+                batch = next(self.data_iter)
+                self.params, self.opt_state, loss = self.train_step(
+                    self.params, self.opt_state, batch)
+                self.step += 1
+                if cfg.log_every and self.step % cfg.log_every == 0:
+                    loss_f = float(loss)
+                    dt = time.monotonic() - t0
+                    self.last_loss = loss_f
+                    self.metrics_history.append(
+                        {"step": self.step, "loss": loss_f, "elapsed_s": dt})
+                    LOG.info("step %d loss %.4f (%.1fs)", self.step, loss_f,
+                             dt)
+                if (cfg.checkpoint_dir and cfg.checkpoint_every
+                        and self.step % cfg.checkpoint_every == 0):
+                    self._checkpoint()
+            if loss is not None:       # loop may no-op on an exact resume
+                self.last_loss = float(loss)
+            if cfg.checkpoint_dir and loss is not None:
+                self._checkpoint()
+        return self.last_loss
+
+    def _checkpoint(self) -> None:
+        save_checkpoint(self.config.checkpoint_dir, self.step,
+                        {"params": self.params, "opt_state": self.opt_state,
+                         "step": self.step})
+        LOG.info("checkpointed step %d", self.step)
