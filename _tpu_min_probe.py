@@ -1,0 +1,21 @@
+import time, sys, jax, jax.numpy as jnp
+def log(m):
+    print(f"[{time.strftime('%H:%M:%S')}] {m}", flush=True)
+log(f"devices {jax.devices()}")
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+f = jax.jit(lambda a: a @ a)
+t=time.monotonic(); y = f(x); log(f"dispatch1 {time.monotonic()-t:.3f}")
+t=time.monotonic(); v=float(y[0,0]); log(f"sync1 {time.monotonic()-t:.3f} v={v}")
+t=time.monotonic()
+for i in range(10): y = f(y)
+log(f"dispatch10 {time.monotonic()-t:.3f}")
+t=time.monotonic(); v=float(y[0,0]); log(f"sync10 {time.monotonic()-t:.3f}")
+# bigger matmul: 8192^3*2 = 1.1e12 flops/iter
+x = jnp.ones((8192, 8192), jnp.bfloat16)
+g = jax.jit(lambda a: a @ a)
+t=time.monotonic(); y = g(x); v=float(y[0,0]); log(f"big compile+run {time.monotonic()-t:.3f}")
+t=time.monotonic()
+for i in range(20): y = g(y)
+v=float(y[0,0])
+dt=time.monotonic()-t
+log(f"big 20 iters {dt:.3f}s -> {20*2*8192**3/dt/1e12:.1f} TFLOP/s")

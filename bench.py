@@ -1,0 +1,105 @@
+"""Flagship benchmark: Llama pretrain throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no benchmark numbers (BASELINE.md); the driver's
+north star is >=40% MFU on the Llama JAX pretrain, so `vs_baseline` is
+MFU / 40%. On TPU this runs the llama3_1b_proxy config in bf16 (pallas
+flash attention, remat, donated buffers); on CPU (dev machines / CI) it
+falls back to the tiny config so the script still completes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# bf16 peak FLOPs/s per chip by device kind substring (public specs).
+PEAK_FLOPS = (
+    ("v6", 918e12),        # Trillium
+    ("v5p", 459e12),
+    ("v5", 197e12),        # v5e / "v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+DEFAULT_PEAK = 459e12
+CPU_PEAK = 1e11            # nominal, keeps MFU finite on dev machines
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    if device.platform != "tpu":
+        return CPU_PEAK
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return DEFAULT_PEAK
+
+
+def main() -> None:
+    from tony_tpu.models.llama import (
+        get_config, llama_init, llama_loss,
+    )
+    from tony_tpu.train.step import make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        config = get_config("llama3_1b_proxy")
+        batch_size, seq, steps, warmup = 4, 4096, 10, 2
+    else:
+        config = get_config("tiny")
+        batch_size, seq, steps, warmup = 4, 128, 4, 1
+
+    params = llama_init(config, jax.random.PRNGKey(0))
+    optimizer = optax.adamw(3e-4)
+    train_step = make_train_step(partial(llama_loss, config=config),
+                                 optimizer)
+    opt_state = jax.jit(optimizer.init)(params)
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch_size, seq), 0, config.vocab_size,
+        jnp.int32)
+    batch = {"inputs": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+    # End each timed region with a device->host transfer of the loss: on
+    # tunneled/experimental platforms block_until_ready alone may return
+    # before the computation finishes, but a host read cannot.
+    for _ in range(warmup):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    float(loss)
+
+    t0 = time.monotonic()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    final_loss = float(loss)
+    dt = time.monotonic() - t0
+
+    tokens_per_step = batch_size * seq
+    tok_s = tokens_per_step * steps / dt
+    flops_s = tok_s * config.flops_per_token(seq)
+    mfu_pct = 100.0 * flops_s / peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu_single_chip",
+        "value": round(mfu_pct, 2),
+        "unit": "%MFU",
+        "vs_baseline": round(mfu_pct / 40.0, 3),
+        "tokens_per_sec_per_chip": round(tok_s, 1),
+        "step_time_s": round(dt / steps, 4),
+        "model": "llama3_1b_proxy" if on_tpu else "tiny",
+        "batch_tokens": tokens_per_step,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "final_loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Linear regression via the MXNET (DMLC) runtime env.
+
+Parity workload for tony-examples/linearregression-mxnet: the TaskExecutor's
+mxnet runtime renders DMLC_ROLE / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT /
+DMLC_NUM_SERVER / DMLC_NUM_WORKER (tony_tpu/executor/runtimes.py
+_mxnet_env, reference TaskExecutor.java:180-200). MXNet is not in the
+image, so scheduler/server roles validate their env and idle out, while
+workers run the regression in JAX — the KVStore's job is XLA's now.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    role = os.environ.get("DMLC_ROLE")
+    root_uri = os.environ.get("DMLC_PS_ROOT_URI")
+    root_port = os.environ.get("DMLC_PS_ROOT_PORT")
+    n_server = os.environ.get("DMLC_NUM_SERVER")
+    n_worker = os.environ.get("DMLC_NUM_WORKER")
+    if not all([role, root_uri, root_port, n_server, n_worker]):
+        print("missing DMLC env", file=sys.stderr)
+        return 1
+    print(f"DMLC env ok: role={role} root={root_uri}:{root_port} "
+          f"servers={n_server} workers={n_worker}")
+    if role in ("scheduler", "server"):
+        return 0  # env validated; real MXNet daemons would serve here
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.environ.get(
+        "TONY_REPO_ROOT",
+        os.path.join(os.path.dirname(__file__), "..", "..")))
+    from tony_tpu.train.data import synthetic_linreg
+
+    data = synthetic_linreg(256)
+    w = jnp.zeros((10,))
+
+    @jax.jit
+    def step(w, batch):
+        def loss_fn(w):
+            pred = batch["x"] @ w
+            return jnp.mean((pred - batch["y"]) ** 2)
+        loss, grad = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * grad, loss
+
+    for i in range(100):
+        w, loss = step(w, {k: jnp.asarray(v)
+                           for k, v in next(data).items()})
+    print(f"final mse {float(loss):.6f}")
+    return 0 if float(loss) < 0.01 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

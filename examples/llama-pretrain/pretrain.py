@@ -1,0 +1,73 @@
+"""Llama pretrain — the BASELINE.json north-star workload.
+
+Reference target: "Llama-3 8B JAX/Flax pretrain via new JAXRuntime
+(v5p-32, tony.worker.tpus=4)". The orchestrator gang-schedules the worker
+processes, renders the JAX coordinator + TPU_MESH_* env, and this script
+brings up the mesh (fsdp/tp/sp per conf), shards the params with the
+model's logical axes, and trains with checkpoint/resume — surviving AM
+retries via the checkpoint dir (ATTEMPT_NUMBER advances, state resumes).
+
+Submit (v5p-32 shape):
+  python -m tony_tpu.cli submit --executes examples/llama-pretrain/pretrain.py \
+      --task_params "--config llama3_8b --steps 1000" \
+      --conf tony.worker.instances=4 --conf tony.worker.tpus=4 \
+      --conf tony.tpu.mesh-shape=4,4 --conf tony.tpu.mesh-axes=fsdp,tp
+"""
+
+import argparse
+import logging
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.environ.get("TONY_REPO_ROOT",
+                                  os.path.join(os.path.dirname(__file__),
+                                               "..", "..")))
+
+from tony_tpu.models.llama import (  # noqa: E402
+    get_config, llama_init, llama_loss, llama_param_axes,
+)
+from tony_tpu.train.data import synthetic_tokens  # noqa: E402
+from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="tiny",
+                        help="preset: tiny|bench_350m|llama3_1b_proxy|llama3_8b")
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=0,
+                        help="0 = the preset's max_seq")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--checkpoint-every", type=int, default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    config = get_config(args.config)
+    seq = args.seq_len or config.max_seq
+    process_index = int(os.environ.get("JAX_PROCESS_ID", "0"))
+
+    def clipped_tokens():
+        for batch in synthetic_tokens(args.batch_size, seq,
+                                      config.vocab_size,
+                                      process_index=process_index):
+            yield batch
+
+    trainer = Trainer(
+        loss_fn=partial(llama_loss, config=config),
+        init_fn=partial(llama_init, config),
+        data_iter=clipped_tokens(),
+        config=TrainerConfig(
+            num_steps=args.steps, log_every=10,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every),
+        param_axes=llama_param_axes(config),
+    )
+    final_loss = trainer.run()
+    print(f"final loss {final_loss:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

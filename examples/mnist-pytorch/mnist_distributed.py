@@ -1,0 +1,62 @@
+"""Distributed MNIST in PyTorch via the PYTORCH runtime env.
+
+Parity workload for tony-examples/mnist-pytorch/mnist_distributed.py
+(:199-216 reads INIT_METHOD/RANK/WORLD → init_process_group): the
+TaskExecutor's pytorch runtime renders the same env here
+(tony_tpu/executor/runtimes.py _pytorch_env). CPU gloo in dev; on TPU pods
+the same wiring serves torch-xla's xla:// init.
+"""
+
+import os
+import sys
+
+import torch
+import torch.distributed as dist
+import torch.nn as nn
+
+
+def main() -> int:
+    rank = int(os.environ.get("RANK", "0"))
+    world = int(os.environ.get("WORLD", "1"))
+    init_method = os.environ.get("INIT_METHOD", "")
+    if world > 1:
+        if not init_method:
+            print("INIT_METHOD not set by the runtime", file=sys.stderr)
+            return 1
+        dist.init_process_group("gloo", init_method=init_method,
+                                rank=rank, world_size=world)
+
+    torch.manual_seed(1234)  # same init on every rank
+    model = nn.Sequential(nn.Linear(784, 300), nn.ReLU(),
+                          nn.Linear(300, 100), nn.ReLU(),
+                          nn.Linear(100, 10))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = nn.CrossEntropyLoss()
+
+    gen = torch.Generator().manual_seed(4242 + rank)
+    protos = torch.randn(10, 784, generator=torch.Generator().manual_seed(42))
+    for step in range(200):
+        labels = torch.randint(0, 10, (128,), generator=gen)
+        images = protos[labels] + 0.5 * torch.randn(128, 784, generator=gen)
+        opt.zero_grad()
+        loss = loss_fn(model(images), labels)
+        loss.backward()
+        if world > 1:  # DDP-style gradient all-reduce
+            for p in model.parameters():
+                dist.all_reduce(p.grad)
+                p.grad /= world
+        opt.step()
+        if rank == 0 and step % 50 == 0:
+            print(f"step {step} loss {loss.item():.4f}")
+
+    if world > 1:
+        dist.barrier()
+        dist.destroy_process_group()
+    if rank == 0:
+        print(f"final loss {loss.item():.4f}")
+        return 0 if loss.item() < 1.0 else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

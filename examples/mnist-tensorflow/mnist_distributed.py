@@ -1,0 +1,105 @@
+"""Distributed MNIST via the TENSORFLOW runtime env (TF_CONFIG).
+
+Parity workload for tony-examples/mnist-tensorflow/mnist_distributed.py
+(:188-202 reads CLUSTER_SPEC/JOB_NAME/TASK_INDEX; the keras variant reads
+TF_CONFIG). The TaskExecutor's tensorflow runtime renders both
+(tony_tpu/executor/runtimes.py _tf_env). On TPU the same TF_CONFIG drives
+tf.distribute.TPUStrategy.
+
+TensorFlow is not in the zero-egress image, so when `import tensorflow`
+fails this script still VALIDATES the rendered env and exits 0 — the
+orchestration contract is what the E2E suite asserts.
+"""
+
+import json
+import os
+import sys
+
+
+def validate_env() -> int:
+    tf_config = os.environ.get("TF_CONFIG")
+    cluster_spec = os.environ.get("CLUSTER_SPEC")
+    job_name = os.environ.get("JOB_NAME")
+    task_index = os.environ.get("TASK_INDEX")
+    if not all([tf_config, cluster_spec, job_name, task_index]):
+        print("missing TF runtime env", file=sys.stderr)
+        return 1
+    parsed = json.loads(tf_config)
+    if parsed["task"]["type"] != job_name:
+        print(f"TF_CONFIG task.type {parsed['task']['type']} != {job_name}",
+              file=sys.stderr)
+        return 1
+    if int(parsed["task"]["index"]) != int(task_index):
+        print("TF_CONFIG task.index mismatch", file=sys.stderr)
+        return 1
+    if job_name not in parsed["cluster"]:
+        print(f"{job_name} missing from cluster spec", file=sys.stderr)
+        return 1
+    print(f"TF env ok: {job_name}:{task_index} in "
+          f"{sorted(parsed['cluster'])}")
+    return 0
+
+
+def main() -> int:
+    rc = validate_env()
+    if rc != 0:
+        return rc
+    try:
+        import tensorflow as tf  # noqa: F401
+    except ImportError:
+        print("tensorflow not installed — env validated only")
+        return 0
+
+    import numpy as np
+
+    # custom training loop on raw tf.Variables: robust across keras
+    # versions (keras 3's fit() rejects MWMS PerReplica batches)
+    strategy = tf.distribute.MultiWorkerMirroredStrategy()
+    sizes = (784, 300, 100, 10)
+    with strategy.scope():
+        rng_init = np.random.default_rng(0)
+        params = []
+        for fan_in, fan_out in zip(sizes, sizes[1:]):
+            params.append(tf.Variable(
+                rng_init.normal(scale=(2.0 / fan_in) ** 0.5,
+                                size=(fan_in, fan_out)).astype("float32")))
+            params.append(tf.Variable(tf.zeros((fan_out,))))
+        opt = tf.keras.optimizers.Adam(1e-3)
+
+    def forward(x):
+        for i in range(0, len(params) - 2, 2):
+            x = tf.nn.relu(x @ params[i] + params[i + 1])
+        return x @ params[-2] + params[-1]
+
+    @tf.function
+    def train_step(images, labels):
+        def step_fn(images, labels):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean(
+                    tf.nn.sparse_softmax_cross_entropy_with_logits(
+                        labels=labels, logits=forward(images)))
+            grads = tape.gradient(loss, params)
+            opt.apply_gradients(zip(grads, params))
+            return loss
+        per_replica = strategy.run(step_fn, args=(images, labels))
+        return strategy.reduce(tf.distribute.ReduceOp.MEAN, per_replica,
+                               axis=None)
+
+    rng = np.random.default_rng(42)
+    protos = rng.normal(size=(10, 784)).astype("float32")
+    labels = rng.integers(0, 10, 8192)
+    images = protos[labels] + 0.5 * rng.normal(size=(8192, 784)).astype(
+        "float32")
+    ds = tf.data.Dataset.from_tensor_slices(
+        (images, labels.astype("int32"))).shuffle(8192).batch(128)
+    dist_ds = strategy.experimental_distribute_dataset(ds)
+    loss = None
+    for epoch in range(2):
+        for batch_images, batch_labels in dist_ds:
+            loss = train_step(batch_images, batch_labels)
+        print(f"epoch {epoch} loss {float(loss):.4f}")
+    return 0 if loss is not None and float(loss) < 1.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

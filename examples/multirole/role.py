@@ -1,0 +1,30 @@
+"""Generic role task: prints its identity and verifies gang visibility.
+
+Stands in for ray head/worker processes (tony-examples/ray-on-tony): every
+member of the gang can see every other member via CLUSTER_SPEC before its
+command runs — which is exactly the property ray bring-up needs.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--role", required=True)
+    args = parser.parse_args()
+
+    spec = json.loads(os.environ.get("CLUSTER_SPEC", "{}"))
+    job = os.environ.get("JOB_NAME", "?")
+    idx = os.environ.get("TASK_INDEX", "?")
+    print(f"{args.role} task {job}:{idx} sees cluster {spec}")
+    if args.role == "worker" and not spec.get("head"):
+        print("worker cannot see the head jobtype", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,265 @@
+// tony_proxy: TCP relay, gateway-host port -> in-cluster host:port.
+//
+// Native production equivalent of the reference's tony-proxy module
+// (tony-proxy/src/main/java/com/linkedin/tony/ProxyServer.java:21-91). The
+// reference relays with two blocking threads per connection; this is a
+// single-threaded epoll event loop — one process handles every notebook /
+// TensorBoard tunnel with no thread-per-connection overhead. The pure-Python
+// fallback lives in tony_tpu/proxy.py and both print the same
+// "proxying 127.0.0.1:<port> -> <host>:<port>" line so launchers can parse
+// the bound port.
+//
+// usage: tony_proxy <remote_host> <remote_port> [local_port]
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+constexpr size_t kBufSize = 64 * 1024;
+constexpr int kMaxEvents = 256;
+
+struct Pipe {           // one direction of a relay
+  char buf[kBufSize];
+  size_t len = 0;       // bytes buffered
+  size_t off = 0;       // write offset into buf
+  bool eof = false;     // source half-closed
+  bool shut = false;    // already propagated shutdown to sink
+};
+
+struct Relay {
+  int client = -1;
+  int upstream = -1;
+  bool connecting = true;   // upstream connect() in flight
+  Pipe c2u, u2c;            // client->upstream, upstream->client
+};
+
+int SetNonBlocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags < 0 ? -1 : fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+class Proxy {
+ public:
+  Proxy(std::string host, int port) : remote_host_(std::move(host)),
+                                      remote_port_(port) {}
+
+  int Listen(int local_port) {
+    listener_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listener_ < 0) return -1;
+    int one = 1;
+    setsockopt(listener_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(static_cast<uint16_t>(local_port));
+    if (bind(listener_, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+        listen(listener_, 64) < 0 || SetNonBlocking(listener_) < 0) {
+      return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listener_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    return ntohs(addr.sin_port);
+  }
+
+  int Run() {
+    epfd_ = epoll_create1(0);
+    if (epfd_ < 0) return 1;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listener_;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, listener_, &ev);
+
+    epoll_event events[kMaxEvents];
+    for (;;) {
+      int n = epoll_wait(epfd_, events, kMaxEvents, -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        return 1;
+      }
+      for (int i = 0; i < n; ++i) {
+        int fd = events[i].data.fd;
+        if (fd == listener_) {
+          Accept();
+          continue;
+        }
+        auto it = relays_.find(fd);
+        if (it == relays_.end()) continue;
+        Relay* r = it->second;
+        if (!Service(r, fd, events[i].events)) CloseRelay(r);
+      }
+    }
+  }
+
+ private:
+  void Accept() {
+    for (;;) {
+      int cfd = accept(listener_, nullptr, nullptr);
+      if (cfd < 0) return;  // EAGAIN or error: back to the loop
+      SetNonBlocking(cfd);
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+      int ufd = ConnectUpstream();
+      if (ufd < 0) {
+        close(cfd);
+        continue;
+      }
+      auto* r = new Relay();
+      r->client = cfd;
+      r->upstream = ufd;
+      relays_[cfd] = r;
+      relays_[ufd] = r;
+      Register(cfd);
+      Register(ufd);
+      Rearm(r);
+    }
+  }
+
+  int ConnectUpstream() {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port_s = std::to_string(remote_port_);
+    if (getaddrinfo(remote_host_.c_str(), port_s.c_str(), &hints, &res) != 0)
+      return -1;
+    int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd >= 0) {
+      SetNonBlocking(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      if (connect(fd, res->ai_addr, res->ai_addrlen) < 0 &&
+          errno != EINPROGRESS) {
+        close(fd);
+        fd = -1;
+      }
+    }
+    freeaddrinfo(res);
+    return fd;
+  }
+
+  void Register(int fd) {
+    epoll_event ev{};
+    ev.events = 0;
+    ev.data.fd = fd;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  // Recompute epoll interest from buffer state (level-triggered).
+  void Rearm(Relay* r) {
+    epoll_event ev{};
+    ev.data.fd = r->client;
+    ev.events = (r->c2u.eof || r->c2u.len ? 0u : unsigned(EPOLLIN)) |
+                (r->u2c.len ? unsigned(EPOLLOUT) : 0u);
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, r->client, &ev);
+    ev.data.fd = r->upstream;
+    ev.events = (r->u2c.eof || r->u2c.len ? 0u : unsigned(EPOLLIN)) |
+                (r->c2u.len || r->connecting ? unsigned(EPOLLOUT) : 0u);
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, r->upstream, &ev);
+  }
+
+  // Move bytes for one pipe; false = fatal error on this relay.
+  static bool Pump(Pipe* p, int src, int dst, bool readable, bool writable) {
+    if (readable && !p->eof && p->len == 0) {
+      ssize_t got = read(src, p->buf, kBufSize);
+      if (got == 0) {
+        p->eof = true;
+      } else if (got < 0) {
+        if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+          return false;
+      } else {
+        p->len = static_cast<size_t>(got);
+        p->off = 0;
+      }
+    }
+    while (p->len > 0) {
+      ssize_t put = write(dst, p->buf + p->off, p->len);
+      if (put < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) break;
+        return false;
+      }
+      p->off += static_cast<size_t>(put);
+      p->len -= static_cast<size_t>(put);
+    }
+    if (p->eof && p->len == 0 && !p->shut) {
+      shutdown(dst, SHUT_WR);
+      p->shut = true;
+    }
+    return true;
+  }
+
+  bool Service(Relay* r, int fd, uint32_t evmask) {
+    if (evmask & (EPOLLERR | EPOLLHUP)) {
+      // HUP with pending readable data still needs draining; only bail on
+      // hard errors or HUP with nothing left to move.
+      if ((evmask & EPOLLERR) || !(evmask & EPOLLIN)) return false;
+    }
+    if (r->connecting && fd == r->upstream && (evmask & EPOLLOUT)) {
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0) return false;
+      r->connecting = false;
+    }
+    bool on_client = fd == r->client;
+    Pipe* read_pipe = on_client ? &r->c2u : &r->u2c;   // fd is source
+    Pipe* write_pipe = on_client ? &r->u2c : &r->c2u;  // fd is sink
+    int peer = on_client ? r->upstream : r->client;
+    if (!Pump(read_pipe, fd, peer, evmask & EPOLLIN, true)) return false;
+    if (!Pump(write_pipe, peer, fd, false, evmask & EPOLLOUT)) return false;
+    if (read_pipe->shut && write_pipe->shut) return false;  // both done
+    Rearm(r);
+    return true;
+  }
+
+  void CloseRelay(Relay* r) {
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, r->client, nullptr);
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, r->upstream, nullptr);
+    relays_.erase(r->client);
+    relays_.erase(r->upstream);
+    close(r->client);
+    close(r->upstream);
+    delete r;
+  }
+
+  std::string remote_host_;
+  int remote_port_;
+  int listener_ = -1;
+  int epfd_ = -1;
+  std::unordered_map<int, Relay*> relays_;  // both fds -> relay
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 3 && argc != 4) {
+    fprintf(stderr, "usage: %s <remote_host> <remote_port> [local_port]\n",
+            argv[0]);
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  Proxy proxy(argv[1], atoi(argv[2]));
+  int port = proxy.Listen(argc == 4 ? atoi(argv[3]) : 0);
+  if (port < 0) {
+    perror("listen");
+    return 1;
+  }
+  printf("proxying 127.0.0.1:%d -> %s:%s\n", port, argv[1], argv[2]);
+  fflush(stdout);
+  return proxy.Run();
+}

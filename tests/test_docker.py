@@ -1,0 +1,53 @@
+"""Docker runtime opt-in tests (reference model: TestUtils docker env case,
+util/TestUtils.java:291)."""
+
+from tony_tpu.cluster.docker import (
+    ENV_CONTAINER_TYPE, ENV_DOCKER_IMAGE, ENV_DOCKER_MOUNTS,
+    docker_env, docker_wrap_command,
+)
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.configuration import TonyConfiguration
+
+
+def conf_with(**kv):
+    conf = TonyConfiguration()
+    for k, v in kv.items():
+        conf.set(k, v, "test")
+    return conf
+
+
+def test_disabled_renders_nothing():
+    conf = conf_with(**{K.DOCKER_IMAGE: "img:1"})
+    assert docker_env(conf, "worker") is None
+
+
+def test_global_image():
+    conf = conf_with(**{K.DOCKER_ENABLED: True, K.DOCKER_IMAGE: "img:1",
+                        K.DOCKER_MOUNTS: "/data:/data"})
+    env = docker_env(conf, "worker")
+    assert env[ENV_CONTAINER_TYPE] == "docker"
+    assert env[ENV_DOCKER_IMAGE] == "img:1"
+    assert env[ENV_DOCKER_MOUNTS] == "/data:/data"
+
+
+def test_per_jobtype_image_override():
+    conf = conf_with(**{K.DOCKER_ENABLED: True, K.DOCKER_IMAGE: "base:1",
+                        K.jobtype_key("ps", "docker.image"): "ps-img:2"})
+    assert docker_env(conf, "ps")[ENV_DOCKER_IMAGE] == "ps-img:2"
+    assert docker_env(conf, "worker")[ENV_DOCKER_IMAGE] == "base:1"
+
+
+def test_enabled_without_image_is_noop():
+    conf = conf_with(**{K.DOCKER_ENABLED: True})
+    assert docker_env(conf, "worker") is None
+
+
+def test_wrap_command():
+    argv = docker_wrap_command(
+        "img:1", ["python", "train.py"], {"RANK": "0"},
+        mounts="/data:/mnt,/tmp", workdir="/job")
+    assert argv[:4] == ["docker", "run", "--rm", "--network=host"]
+    assert "-w" in argv and "/job" in argv
+    assert "-v" in argv and "/data:/mnt" in argv and "/tmp:/tmp" in argv
+    assert "-e" in argv and "RANK=0" in argv
+    assert argv[-3:] == ["img:1", "python", "train.py"]

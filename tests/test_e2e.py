@@ -58,10 +58,13 @@ def run_job(tmp_path, argv: list[str], conf_overrides=None,
 
 
 def history_events(client: TonyClient):
-    hist_dir = os.path.join(client.app_dir, C.HISTORY_DIR_NAME)
-    finals = [f for f in os.listdir(hist_dir) if f.endswith(".jhist")]
-    assert len(finals) == 1, os.listdir(hist_dir)
-    return finals[0], parse_events(os.path.join(hist_dir, finals[0]))
+    # history lives in a per-app subdir of the intermediate dir
+    hist_base = os.path.join(client.app_dir, C.HISTORY_DIR_NAME)
+    finals = [os.path.join(d, f)
+              for d, _, files in os.walk(hist_base)
+              for f in files if f.endswith(".jhist")]
+    assert len(finals) == 1, finals
+    return os.path.basename(finals[0]), parse_events(finals[0])
 
 
 # ---------------------------------------------------------------------------

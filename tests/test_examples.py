@@ -1,0 +1,110 @@
+"""Example smoke tests through the real client→AM→executor chain.
+
+The reference's E2E suite ran its example-shaped scripts on the
+MiniCluster (TestTonyE2E.java:89-484); same pattern: each example submits
+through TonyClient on the local backend with a trimmed workload.
+"""
+
+import os
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.client.tony_client import TonyClient
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.configuration import TonyConfiguration
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def run_example(tmp_path, argv, extra_conf=()):
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_WORKDIR, str(tmp_path / "cluster"), "test")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "test")
+    conf.set(K.AM_MONITOR_INTERVAL_MS, 200, "test")
+    conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 2000, "test")
+    for k, v in extra_conf:
+        conf.set(k, v, "test")
+    client = TonyClient(conf)
+    client.init(argv)
+    client.run()
+    return client
+
+
+def _logs(client):
+    out = []
+    croot = os.path.join(client.app_dir, C.CONTAINERS_DIR_NAME)
+    for d, _, files in os.walk(croot):
+        for f in files:
+            if f in ("stdout", "stderr"):
+                p = os.path.join(d, f)
+                out.append(f"==== {p}\n" + open(p).read()[-2000:])
+    return "\n".join(out)
+
+
+def test_mnist_jax_example_two_workers(tmp_path):
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "mnist-jax",
+                                    "mnist_distributed.py"),
+         "--task_params", "--steps 60",
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+
+def test_mnist_pytorch_example_two_workers(tmp_path):
+    pytest.importorskip("torch")
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "mnist-pytorch",
+                                    "mnist_distributed.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.framework=pytorch"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+
+def test_mnist_tensorflow_example_env_only(tmp_path):
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "mnist-tensorflow",
+                                    "mnist_distributed.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.framework=tensorflow"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+
+def test_mxnet_linreg_example(tmp_path):
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "linearregression-mxnet",
+                                    "linreg_dmlc.py"),
+         "--conf", "tony.scheduler.instances=1",
+         "--conf", "tony.server.instances=1",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=mxnet"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+
+def test_multirole_example(tmp_path):
+    role = os.path.join(EXAMPLES, "multirole", "role.py")
+    client = run_example(
+        tmp_path,
+        ["--conf", "tony.head.instances=1",
+         "--conf", f"tony.head.command=python {role} --role head",
+         "--conf", "tony.worker.instances=2",
+         "--conf", f"tony.worker.command=python {role} --role worker"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+
+def test_llama_pretrain_example_tiny(tmp_path):
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "llama-pretrain",
+                                    "pretrain.py"),
+         "--task_params",
+         "--config tiny --steps 4 --batch-size 2 --seq-len 64",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _logs(client)

@@ -1,0 +1,199 @@
+"""Portal tests: mover, purger, cache, and HTTP routes.
+
+Reference models: HistoryFileMoverTest / HistoryFilePurgerTest and the
+tony-portal controller tests (SURVEY.md §4 tier 4), re-targeted at the
+local-filesystem history tree.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.events.handler import EventHandler
+from tony_tpu.events.history import JobMetadata, history_file_name
+from tony_tpu.events.schema import (
+    ApplicationFinished, Event, EventType, TaskStarted,
+)
+from tony_tpu.portal.cache import PortalCache
+from tony_tpu.portal.mover import (
+    HistoryFileMover, ensure_history_dirs, finished_subdir,
+)
+from tony_tpu.portal.purger import HistoryFilePurger
+from tony_tpu.portal.server import PortalServer
+
+
+def make_app_history(intermediate, app_id, status="SUCCEEDED",
+                     started=1000, completed=2000, user="alice",
+                     final=True, config=None):
+    """Lay down a per-app history dir the way the AM does."""
+    app_dir = os.path.join(intermediate, app_id)
+    os.makedirs(app_dir, exist_ok=True)
+    md = JobMetadata(application_id=app_id, started=started,
+                     completed=completed, user=user, status=status)
+    handler = EventHandler(app_dir, JobMetadata(
+        application_id=app_id, started=started, user=user))
+    handler.start()
+    handler.emit(Event(EventType.TASK_STARTED,
+                       TaskStarted("worker", 0, "hostA", "container_1"),
+                       timestamp=started + 1))
+    handler.emit(Event(EventType.APPLICATION_FINISHED,
+                       ApplicationFinished(app_id, status),
+                       timestamp=completed))
+    if final:
+        path = handler.stop(status)
+        # pin the filename's completed stamp for deterministic asserts
+        want = os.path.join(app_dir, history_file_name(md))
+        os.replace(path, want)
+    if config is not None:
+        with open(os.path.join(app_dir, C.PORTAL_CONFIG_FILE), "w") as f:
+            json.dump(config, f)
+    return app_dir
+
+
+# ---------------------------------------------------------------------------
+# mover
+# ---------------------------------------------------------------------------
+
+def test_mover_moves_final_dirs(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_1", completed=2000)
+    mover = HistoryFileMover(inter, fin)
+    moved = mover.move_once()
+    assert len(moved) == 1
+    assert not os.path.exists(os.path.join(inter, "app_1"))
+    # completed=2000ms epoch → 1970/01/01
+    assert moved[0] == os.path.join(fin, "1970", "01", "01", "app_1")
+    assert any(f.endswith(".jhist") for f in os.listdir(moved[0]))
+
+
+def test_mover_leaves_running_apps(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_run", final=False)  # inprogress, fresh mtime
+    mover = HistoryFileMover(inter, fin, stale_sec=3600)
+    assert mover.move_once() == []
+    assert os.path.isdir(os.path.join(inter, "app_run"))
+
+
+def test_mover_finalizes_stale_inprogress_as_killed(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    app_dir = make_app_history(inter, "app_dead", final=False)
+    inprog = [f for f in os.listdir(app_dir)
+              if f.endswith(".jhist.inprogress")]
+    assert inprog
+    old = time.time() - 7200
+    os.utime(os.path.join(app_dir, inprog[0]), (old, old))
+    mover = HistoryFileMover(inter, fin, stale_sec=3600)
+    moved = mover.move_once()
+    assert len(moved) == 1
+    jhists = [f for f in os.listdir(moved[0]) if f.endswith(".jhist")]
+    assert len(jhists) == 1 and "-KILLED." in jhists[0]
+
+
+# ---------------------------------------------------------------------------
+# purger
+# ---------------------------------------------------------------------------
+
+def test_purger_deletes_expired_and_prunes_empty_dirs(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_old", completed=2000)
+    now_ms = int(time.time() * 1000)
+    make_app_history(inter, "app_new", completed=now_ms)
+    HistoryFileMover(inter, fin).move_once()
+
+    purger = HistoryFilePurger(fin, retention_sec=24 * 3600)
+    removed = purger.purge_once()
+    assert len(removed) == 1 and removed[0].endswith("app_old")
+    assert not os.path.exists(os.path.join(fin, "1970"))  # pruned
+    # recent app survives
+    sub = finished_subdir(fin, now_ms)
+    assert os.path.isdir(os.path.join(sub, "app_new"))
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+def test_cache_lists_both_trees_and_serves_entries(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_done", completed=2000,
+                     config={"tony.worker.instances": 2})
+    make_app_history(inter, "app_live", final=False, started=3000)
+    HistoryFileMover(inter, fin, stale_sec=3600).move_once()
+
+    cache = PortalCache(inter, fin)
+    mds = cache.list_metadata()
+    assert [m.application_id for m in mds] == ["app_live", "app_done"]
+    assert cache.get_metadata("app_live").status == "RUNNING"
+    assert cache.get_metadata("app_done").status == "SUCCEEDED"
+
+    events = cache.get_events("app_done")
+    assert [e["type"] for e in events] == ["TASK_STARTED",
+                                           "APPLICATION_FINISHED"]
+    assert cache.get_config("app_done") == {"tony.worker.instances": 2}
+    assert cache.get_config("app_live") == {}
+    links = cache.get_log_links("app_done")
+    assert links[0]["task"] == "worker:0"
+    assert links[0]["host"] == "hostA"
+    assert "container_1" in links[0]["url"]
+    assert cache.get_metadata("nope") is None
+    assert cache.get_events("nope") == []
+
+
+# ---------------------------------------------------------------------------
+# HTTP server (routes of tony-portal/conf/routes:1-5)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def portal(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_x", completed=2000,
+                     config={"tony.am.memory": "2g"})
+    server = PortalServer(PortalCache(inter, fin), port=0, host="127.0.0.1")
+    server.start()
+    yield server
+    server.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}") as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_portal_pages(portal):
+    status, body = _get(portal, "/")
+    assert status == 200 and "app_x" in body
+    status, body = _get(portal, "/jobs/app_x")
+    assert status == 200 and "TASK_STARTED" in body
+    status, body = _get(portal, "/config/app_x")
+    assert status == 200 and "tony.am.memory" in body
+    status, body = _get(portal, "/logs/app_x")
+    assert status == 200 and "hostA" in body
+
+
+def test_portal_api(portal):
+    status, body = _get(portal, "/api/jobs")
+    jobs = json.loads(body)
+    assert status == 200 and jobs[0]["application_id"] == "app_x"
+    status, body = _get(portal, "/api/jobs/app_x/events")
+    assert status == 200 and json.loads(body)[0]["type"] == "TASK_STARTED"
+    status, body = _get(portal, "/api/jobs/app_x/config")
+    assert json.loads(body) == {"tony.am.memory": "2g"}
+    status, body = _get(portal, "/api/jobs/app_x/logs")
+    assert json.loads(body)[0]["host"] == "hostA"
+
+
+def test_portal_404(portal):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(portal, "/jobs/missing")
+    assert exc.value.code == 404

@@ -145,3 +145,17 @@ def test_client_gives_up_when_no_server():
     with pytest.raises(ConnectionError):
         c.task_executor_heartbeat("worker:0")
     c.close()
+
+
+def test_heartbeat_fails_fast_against_dead_am():
+    """Liveness-critical: a heartbeat against a dead AM must fail within
+    seconds (one attempt, 5s deadline, no wait_for_ready), NOT sit in the
+    default retry proxy — the Heartbeater's consecutive-failure counter is
+    the real retry loop (TaskExecutor.java:358-368 semantics)."""
+    from tony_tpu.utils.common import pick_free_port
+    c = ClusterServiceClient("localhost", pick_free_port())  # default opts
+    start = time.monotonic()
+    with pytest.raises(ConnectionError):
+        c.task_executor_heartbeat("worker:0")
+    assert time.monotonic() - start < 6.0
+    c.close()

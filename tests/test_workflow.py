@@ -1,0 +1,57 @@
+"""Workflow adapter tests (reference model: tony-azkaban TestTonyJob-style
+prop→conf mapping plus an end-to-end run on the local backend)."""
+
+import json
+import os
+
+from tony_tpu.workflow import TonyWorkflowJob
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+def test_tony_props_pass_through_and_specials_become_argv(tmp_path):
+    job = TonyWorkflowJob({
+        "tony.worker.instances": "2",
+        "tony.am.memory": "1g",
+        "type": "tony",                      # engine-internal, dropped
+        "executes": "python train.py",
+        "task_params": "--epochs 1",
+        "src_dir": "/src",
+    }, working_dir=str(tmp_path))
+    assert job.tony_conf_entries() == {
+        "tony.worker.instances": "2", "tony.am.memory": "1g"}
+    argv = job.build_argv()
+    conf_path = os.path.join(str(tmp_path), "tony.json")
+    assert argv[:2] == ["--conf_file", conf_path]
+    with open(conf_path) as f:
+        assert json.load(f)["tony.worker.instances"] == "2"
+    assert argv[argv.index("--executes") + 1] == "python train.py"
+    assert argv[argv.index("--task_params") + 1] == "--epochs 1"
+    assert argv[argv.index("--src_dir") + 1] == "/src"
+
+
+def test_workflow_job_runs_end_to_end(tmp_path):
+    workdir = tmp_path / "wd"
+    job = TonyWorkflowJob({
+        "tony.worker.instances": "1",
+        "tony.cluster.workdir": str(tmp_path / "cluster"),
+        "tony.task.heartbeat-interval-ms": "200",
+        "tony.am.monitor-interval-ms": "200",
+        "tony.am.stop-poll-timeout-ms": "2000",
+        "executes": os.path.join(SCRIPTS, "exit_0.py"),
+    }, working_dir=str(workdir))
+    assert job.run() == 0
+    assert job.client.final_status == "SUCCEEDED"
+
+
+def test_workflow_job_propagates_failure(tmp_path):
+    job = TonyWorkflowJob({
+        "tony.worker.instances": "1",
+        "tony.cluster.workdir": str(tmp_path / "cluster"),
+        "tony.task.heartbeat-interval-ms": "200",
+        "tony.am.monitor-interval-ms": "200",
+        "tony.am.stop-poll-timeout-ms": "2000",
+        "executes": os.path.join(SCRIPTS, "exit_1.py"),
+    }, working_dir=str(tmp_path / "wd"))
+    assert job.run() == 1
+    assert job.client.final_status == "FAILED"

@@ -34,6 +34,7 @@ from typing import Optional
 from tony_tpu import constants as C
 from tony_tpu.cluster import Container, LocalClusterBackend
 from tony_tpu.cluster.backend import ClusterBackend
+from tony_tpu.cluster.docker import docker_env
 from tony_tpu.conf import TonyConfiguration, keys as K
 from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
@@ -109,13 +110,16 @@ class ApplicationMaster(ClusterServiceHandler):
         self._monitor_interval = conf.get_time_ms(K.AM_MONITOR_INTERVAL_MS, 5000) / 1000.0
         self.hb_monitor = LivelinessMonitor(
             self._hb_interval_ms, self._max_missed_hb, self._on_task_deemed_dead)
-        # event history → per-app intermediate dir; the history mover later
-        # relocates finals (reference: tony.history.intermediate)
-        hist_dir = conf.get_str(K.HISTORY_INTERMEDIATE) or os.path.join(
+        # event history → per-app subdir of the intermediate dir; the
+        # portal's mover later relocates finished apps into finished/y/M/d
+        # (reference: tony.history.intermediate + setupJobDir,
+        # ApplicationMaster.java:454-460)
+        hist_base = conf.get_str(K.HISTORY_INTERMEDIATE) or os.path.join(
             self.app_dir, C.HISTORY_DIR_NAME)
+        self.history_dir = os.path.join(hist_base, app_id)
         self.metadata = JobMetadata(application_id=app_id,
                                     started=int(time.time() * 1000))
-        self.event_handler = EventHandler(hist_dir, self.metadata)
+        self.event_handler = EventHandler(self.history_dir, self.metadata)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -130,12 +134,26 @@ class ApplicationMaster(ClusterServiceHandler):
         self.backend.start()
         self.hb_monitor.start()
         self.event_handler.start()
+        self._write_history_config()
         hostport_path = os.path.join(self.app_dir, C.AM_HOSTPORT_FILE)
         tmp = hostport_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(f"{self.host}:{self.rpc_port}")
         os.replace(tmp, hostport_path)
         LOG.info("AM RPC serving at %s:%d", self.host, self.rpc_port)
+
+    def _write_history_config(self) -> None:
+        """Snapshot the frozen conf into the history dir so the portal can
+        serve /config/:jobId (reference: writeConfigFile,
+        ApplicationMaster.java:454-460)."""
+        try:
+            path = os.path.join(self.history_dir, C.PORTAL_CONFIG_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self.conf.to_dict(), f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except Exception:  # noqa: BLE001 — observability must not kill the job
+            LOG.exception("failed to write history config snapshot")
 
     def run(self) -> bool:
         """Full AM lifecycle incl. the session retry loop
@@ -438,6 +456,10 @@ class ApplicationMaster(ClusterServiceHandler):
         for entry in self.conf.get_strings(K.EXECUTION_ENV):
             k, _, v = entry.partition("=")
             env[k] = v
+        # docker runtime opt-in (util/Utils.java:718-765 equivalent)
+        docker = docker_env(self.conf, task.job_name)
+        if docker:
+            env.update(docker)
         return env
 
     def _on_container_completed(self, container_id: str, exit_code: int) -> None:

@@ -16,10 +16,33 @@ import time
 from tony_tpu.client.tony_client import TonyClient
 from tony_tpu.conf import keys as K
 from tony_tpu.proxy import ProxyServer
+from tony_tpu.utils.native import launch_native_proxy
 
 LOG = logging.getLogger(__name__)
 
 DEFAULT_TIMEOUT = "24h"  # reference appended a 24h timeout (:89-93)
+
+
+class _Proxy:
+    """Prefer the native epoll relay; fall back to the Python one."""
+
+    def __init__(self, host: str, port: int):
+        self._proc = None
+        self._pyproxy = None
+        launched = launch_native_proxy(host, port)
+        if launched is not None:
+            self._proc, self.local_port = launched
+        else:
+            self._pyproxy = ProxyServer(host, port)
+            self._pyproxy.start()
+            self.local_port = self._pyproxy.local_port
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            self._proc.kill()
+            self._proc.wait()
+        if self._pyproxy is not None:
+            self._pyproxy.stop()
 
 
 def submit(argv: list[str]) -> int:
@@ -47,8 +70,7 @@ def submit(argv: list[str]) -> int:
                     hostport = info.url[len("http://"):].split("/", 1)[0]
                     host, _, port = hostport.rpartition(":")
                     if host and port.isdigit():
-                        proxy = ProxyServer(host, int(port))
-                        proxy.start()
+                        proxy = _Proxy(host, int(port))
                         print(f"notebook available at "
                               f"http://127.0.0.1:{proxy.local_port}")
                         break

@@ -111,6 +111,12 @@ class TonyClient:
         if not args.executes:
             return ""
         executes = args.executes
+        # A relative script path is resolved at submission time when the
+        # file exists locally and no src_dir (flag or conf) will localize it
+        # into the container cwd (containers run in their own scratch dirs).
+        if (not os.path.isabs(executes) and os.path.isfile(executes)
+                and not args.src_dir and not self.conf.get_str(K.SRC_DIR)):
+            executes = os.path.abspath(executes)
         is_python_file = executes.endswith(".py")
         if is_python_file:
             python = (args.python_binary_path

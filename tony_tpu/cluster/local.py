@@ -39,6 +39,7 @@ class LocalClusterBackend(ClusterBackend):
         self._host = current_host()
         self._procs: dict[str, subprocess.Popen] = {}
         self._killed: set[str] = set()
+        self._docker_cids: set[str] = set()   # containers run via docker
         self._allocated: dict[str, Container] = {}
         self._pending: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
@@ -98,6 +99,8 @@ class LocalClusterBackend(ClusterBackend):
         stderr = open(os.path.join(cwd, "stderr"), "ab")
         full_env = dict(os.environ)
         full_env.update({k: str(v) for k, v in env.items()})
+        command = self._maybe_docker_wrap(container.container_id, command,
+                                          env, cwd)
         proc = subprocess.Popen(
             command, env=full_env, cwd=cwd, stdout=stdout, stderr=stderr,
             start_new_session=True)  # own pgid → we can kill the whole tree
@@ -111,6 +114,29 @@ class LocalClusterBackend(ClusterBackend):
         self._waiters.append(waiter)
         LOG.info("launched %s pid=%d cmd=%s", container.container_id,
                  proc.pid, " ".join(command[:4]))
+
+    def _maybe_docker_wrap(self, cid: str, command: list[str],
+                           env: Mapping[str, str], cwd: str) -> list[str]:
+        """Honor the docker opt-in env (the YARN NodeManager's
+        DockerLinuxContainerRuntime role). Degrades to a plain subprocess
+        with a loud warning when no docker binary is on the host."""
+        from tony_tpu.cluster.docker import (
+            ENV_CONTAINER_TYPE, ENV_DOCKER_IMAGE, ENV_DOCKER_MOUNTS,
+            docker_wrap_command,
+        )
+        import shutil as _shutil
+
+        if env.get(ENV_CONTAINER_TYPE) != "docker":
+            return command
+        if _shutil.which("docker") is None:
+            LOG.warning("tony.docker.enabled set but no docker binary on "
+                        "this host — launching as a plain subprocess")
+            return command
+        with self._lock:
+            self._docker_cids.add(cid)
+        return docker_wrap_command(
+            env[ENV_DOCKER_IMAGE], command, env,
+            mounts=env.get(ENV_DOCKER_MOUNTS, ""), workdir=cwd, name=cid)
 
     def _wait_container(self, cid: str, proc: subprocess.Popen,
                         stdout, stderr) -> None:
@@ -133,7 +159,20 @@ class LocalClusterBackend(ClusterBackend):
             if proc is None or proc.poll() is not None:
                 return
             self._killed.add(container_id)
+        self._docker_kill(container_id)
         self._kill_tree(proc)
+
+    def _docker_kill(self, container_id: str) -> None:
+        """Killing the `docker run` client does not kill the daemon-side
+        container — docker-wrapped containers need `docker kill <name>`."""
+        with self._lock:
+            if container_id not in self._docker_cids:
+                return
+        try:
+            subprocess.run(["docker", "kill", container_id],
+                           capture_output=True, timeout=20)
+        except (OSError, subprocess.TimeoutExpired):
+            LOG.exception("docker kill %s failed", container_id)
 
     def release_container(self, container_id: str) -> None:
         with self._lock:
@@ -153,6 +192,9 @@ class LocalClusterBackend(ClusterBackend):
         self._stopping = True
         with self._lock:
             procs = list(self._procs.values())
+            cids = list(self._procs)
+        for cid in cids:
+            self._docker_kill(cid)
         for proc in procs:
             if proc.poll() is None:
                 self._kill_tree(proc)

@@ -77,8 +77,11 @@ DEFAULTS = {
     # history
     K.HISTORY_RETENTION_SEC: 30 * 24 * 3600,
     K.HISTORY_MOVER_INTERVAL_MS: 5 * 60 * 1000,
+    K.HISTORY_PURGER_INTERVAL_MS: 6 * 3600 * 1000,
+    K.HISTORY_STALE_INPROGRESS_SEC: 24 * 3600,
 
     # portal
+    K.PORTAL_PORT: 19886,
     K.PORTAL_CACHE_MAX_ENTRIES: 1000,
 
     # docker

@@ -59,11 +59,15 @@ HISTORY_INTERMEDIATE = "tony.history.intermediate"
 HISTORY_FINISHED = "tony.history.finished"
 HISTORY_RETENTION_SEC = "tony.history.retention-sec"
 HISTORY_MOVER_INTERVAL_MS = "tony.history.mover-interval-ms"
+HISTORY_PURGER_INTERVAL_MS = "tony.history.purger-interval-ms"
+# inprogress files older than this are finalized as KILLED by the mover
+HISTORY_STALE_INPROGRESS_SEC = "tony.history.stale-inprogress-sec"
 KEYTAB_USER = "tony.keytab.user"
 KEYTAB_LOCATION = "tony.keytab.location"
 
 # --- portal --------------------------------------------------------------
 PORTAL_URL = "tony.portal.url"
+PORTAL_PORT = "tony.portal.port"
 PORTAL_CACHE_MAX_ENTRIES = "tony.portal.cache-max-entries"
 
 # --- docker (reference: TonyConfigurationKeys.java:227-239,266-268) ------

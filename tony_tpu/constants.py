@@ -75,6 +75,7 @@ TONY_APP_STAGING_PREFIX = ".tony"    # per-app staging dir (reference: .tony/<ap
 TONY_SRC_ZIP = "tony_src.zip"
 HISTORY_SUFFIX = "jhist"
 HISTORY_INPROGRESS_SUFFIX = "jhist.inprogress"
+PORTAL_CONFIG_FILE = "config.json"   # frozen conf copy in each history dir
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------

@@ -12,8 +12,8 @@ plus the cluster-spec parsers in util/Utils.java:480-598:
   plus `MASTER_ADDR`/`MASTER_PORT` for torch-xla's `xla://` init.
 - MXNET → `DMLC_*` (TaskExecutor.java:180-200,
   Utils.parseClusterSpecForMXNet:576-598).
-- HOROVOD → intentionally empty: `horovodrun` owns its own rendezvous
-  (TaskExecutor.java:201-204).
+- HOROVOD → no framework-specific keys: `horovodrun` owns its own
+  rendezvous (TaskExecutor.java:201-204).
 - JAX (new, no reference equivalent) → coordinator bootstrap for
   `jax.distributed.initialize`: coordinator = global process 0's registered
   address; plus mesh-shape/axes and multi-slice hints so the training runtime
@@ -21,6 +21,9 @@ plus the cluster-spec parsers in util/Utils.java:480-598:
   axis across slices.
 
 All renderers are pure: (cluster_spec, job_name, index, conf) → env dict.
+Unlike the reference (TF-only), `CLUSTER_SPEC` is added for EVERY framework
+by `render_framework_env`, so role-based gangs (ray-style head/worker) get
+gang visibility regardless of framework.
 """
 
 from __future__ import annotations
@@ -153,4 +156,9 @@ def render_framework_env(framework: str, cluster_spec: ClusterSpec,
         raise ValueError(
             f"unsupported framework {framework!r}; expected one of "
             f"{sorted(_RENDERERS)}") from None
-    return renderer(cluster_spec, job_name, index, conf)
+    env = renderer(cluster_spec, job_name, index, conf)
+    # CLUSTER_SPEC is universal here (the reference rendered it TF-only,
+    # TaskExecutor.java:161-167): role-based gangs (ray-style head/worker)
+    # need gang visibility regardless of framework.
+    env.setdefault(C.CLUSTER_SPEC, json.dumps(cluster_spec))
+    return env

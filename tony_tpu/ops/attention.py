@@ -98,7 +98,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     m, l, acc = lax.fori_loop(0, num_kb_live, body, init)
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lse block is (1, 1, Bq): TPU tiling needs the second-to-minor block
+    # dim equal to the array dim, hence the singleton middle axis.
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
 def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
@@ -129,11 +131,11 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -272,8 +274,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     s = q.shape[2]
-    block = min(DEFAULT_BLOCK_Q, block_q, block_k)
-    pad = (-s) % block if s > block else 0
+    if s <= min(block_q, block_k):
+        pad = 0   # kernels clamp both block sizes down to s
+    else:
+        # padded length must divide by BOTH block sizes after the kernels'
+        # min(block, s) clamps; a multiple of lcm(bq, bk) >= max(bq, bk)
+        # satisfies every case (each original block then divides it)
+        import math
+        pad = (-s) % math.lcm(block_q, block_k)
     if pad == 0:
         return _flash_core(q, k, v, causal, sm_scale, block_q, block_k, s)
     widths = ((0, 0), (0, 0), (0, pad), (0, 0))

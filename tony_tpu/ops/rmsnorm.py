@@ -37,21 +37,28 @@ def _rms_pallas(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     d = orig_shape[-1]
     rows = x.size // d
     x2 = x.reshape(rows, d)
-    # largest divisor of rows <= 256 keeps blocks big for this
-    # bandwidth-bound op instead of degrading to row-at-a-time
-    block_rows = next(br for br in range(min(rows, 256), 0, -1)
-                      if rows % br == 0)
+    # TPU tiling: the second-to-minor block dim must be 8-divisible or
+    # equal the array dim. rows < 256 → one block equal to the array dim;
+    # otherwise fixed 256-row blocks with rows padded up to a multiple
+    # (rows are independent, so padding is sliced off harmlessly).
+    if rows < 256:
+        block_rows, padded = rows, rows
+    else:
+        block_rows = 256
+        padded = rows + ((-rows) % block_rows)
+        if padded != rows:
+            x2 = jnp.pad(x2, ((0, padded - rows), (0, 0)))
     out = pl.pallas_call(
         functools.partial(_rms_kernel, eps=eps),
-        grid=(rows // block_rows,),
+        grid=(padded // block_rows,),
         in_specs=[
             pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
             pl.BlockSpec((d,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((padded, d), x.dtype),
     )(x2, weight)
-    return out.reshape(orig_shape)
+    return out[:rows].reshape(orig_shape)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))

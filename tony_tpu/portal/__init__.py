@@ -1,0 +1,22 @@
+"""History portal: web UI + history-dir lifecycle daemons.
+
+Equivalent of the reference's tony-portal Play application (SURVEY.md §2.2):
+`Requirements` (dir bring-up) → `ensure_history_dirs`, `CacheWrapper` →
+`PortalCache`, `HistoryFileMover`/`HistoryFilePurger` → `mover`/`purger`,
+and the four page controllers (routes /, /config/:jobId, /jobs/:jobId,
+/logs/:jobId — tony-portal/conf/routes:1-5) → `server.PortalServer`, which
+also exposes the same data as a JSON API.
+"""
+
+from tony_tpu.portal.cache import PortalCache
+from tony_tpu.portal.mover import HistoryFileMover, ensure_history_dirs
+from tony_tpu.portal.purger import HistoryFilePurger
+from tony_tpu.portal.server import PortalServer
+
+__all__ = [
+    "PortalCache",
+    "HistoryFileMover",
+    "HistoryFilePurger",
+    "PortalServer",
+    "ensure_history_dirs",
+]

@@ -1,0 +1,72 @@
+"""Portal daemon entry: `python -m tony_tpu.portal [--conf file] [--port N]`.
+
+Equivalent of booting the reference's Play portal (tony-portal): brings up
+the history dirs, the cache, the mover + purger daemons, and the HTTP
+server, then blocks until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.portal.cache import PortalCache
+from tony_tpu.portal.mover import HistoryFileMover, ensure_history_dirs
+from tony_tpu.portal.purger import HistoryFilePurger
+from tony_tpu.portal.server import PortalServer
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tony-portal")
+    parser.add_argument("--conf", default=None, help="tony conf file (json)")
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--history-location", default=None,
+                        help="overrides tony.history.location")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    conf = TonyConfiguration.read(args.conf) if args.conf \
+        else TonyConfiguration()
+    location = (args.history_location or conf.get_str(K.HISTORY_LOCATION)
+                or os.path.expanduser("~/.tony_tpu/history"))
+    intermediate = conf.get_str(K.HISTORY_INTERMEDIATE) or os.path.join(
+        location, "intermediate")
+    finished = conf.get_str(K.HISTORY_FINISHED) or os.path.join(
+        location, "finished")
+    ensure_history_dirs(intermediate, finished)
+
+    cache = PortalCache(intermediate, finished,
+                        conf.get_int(K.PORTAL_CACHE_MAX_ENTRIES, 1000))
+    mover = HistoryFileMover(
+        intermediate, finished,
+        conf.get_time_ms(K.HISTORY_MOVER_INTERVAL_MS, 5 * 60 * 1000),
+        conf.get_int(K.HISTORY_STALE_INPROGRESS_SEC, 24 * 3600))
+    purger = HistoryFilePurger(
+        finished, conf.get_int(K.HISTORY_RETENTION_SEC, 30 * 24 * 3600),
+        conf.get_time_ms(K.HISTORY_PURGER_INTERVAL_MS, 6 * 3600 * 1000))
+    port = args.port if args.port is not None else conf.get_int(
+        K.PORTAL_PORT, 19886)
+    server = PortalServer(cache, port=port)
+
+    mover.start()
+    purger.start()
+    server.start()
+    print(f"tony-tpu portal: http://localhost:{server.port}/")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        mover.stop()
+        purger.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

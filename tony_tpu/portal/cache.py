@@ -1,0 +1,180 @@
+"""PortalCache: job metadata/config/event/log caches over the history tree.
+
+Equivalent of the reference's app/cache/CacheWrapper.java:28-132 (four Guava
+caches warmed from HDFS). Finished history files are immutable, so entries
+are cached by path; in-progress apps are re-read when their file mtime
+changes. Eviction is LRU with a max entry count
+(tony.portal.cache-max-entries).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+from dataclasses import asdict
+from typing import Any, Optional
+
+from tony_tpu import constants as C
+from tony_tpu.events.handler import parse_events
+from tony_tpu.events.history import JobMetadata, parse_history_file_name
+from tony_tpu.events.schema import EventType
+
+LOG = logging.getLogger(__name__)
+
+
+class _LRU:
+    def __init__(self, max_entries: int):
+        self.max_entries = max_entries
+        self._d: collections.OrderedDict = collections.OrderedDict()
+
+    def get(self, key):
+        if key in self._d:
+            self._d.move_to_end(key)
+            return self._d[key]
+        return None
+
+    def put(self, key, value) -> None:
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.max_entries:
+            self._d.popitem(last=False)
+
+
+class PortalCache:
+    def __init__(self, intermediate: str, finished: str,
+                 max_entries: int = 1000):
+        self.intermediate = intermediate
+        self.finished = finished
+        self._lock = threading.Lock()
+        # path -> (mtime, parsed events); immutable finals hit by path
+        self._events = _LRU(max_entries)
+        self._configs = _LRU(max_entries)
+
+    # -- directory scan ----------------------------------------------------
+    def _app_dirs(self):
+        """Yield (app_id, app_dir) across intermediate + finished trees."""
+        if os.path.isdir(self.intermediate):
+            for name in sorted(os.listdir(self.intermediate)):
+                d = os.path.join(self.intermediate, name)
+                if os.path.isdir(d):
+                    yield name, d
+        if os.path.isdir(self.finished):
+            for dirpath, dirnames, filenames in os.walk(self.finished):
+                if any(f.endswith("." + C.HISTORY_SUFFIX) for f in filenames):
+                    yield os.path.basename(dirpath), dirpath
+                    dirnames[:] = []
+
+    def _find_app_dir(self, job_id: str) -> Optional[str]:
+        for name, d in self._app_dirs():
+            if name == job_id:
+                return d
+        return None
+
+    @staticmethod
+    def _history_file(app_dir: str) -> Optional[str]:
+        """The jhist (final preferred over inprogress) in an app dir."""
+        final, inprog = None, None
+        for f in os.listdir(app_dir):
+            if f.endswith("." + C.HISTORY_SUFFIX):
+                final = os.path.join(app_dir, f)
+            elif f.endswith("." + C.HISTORY_INPROGRESS_SUFFIX):
+                inprog = os.path.join(app_dir, f)
+        return final or inprog
+
+    # -- public API (the four caches) -------------------------------------
+    def list_metadata(self) -> list[JobMetadata]:
+        """All known jobs, newest first (reference: metadata cache)."""
+        out = []
+        for name, d in self._app_dirs():
+            hist = self._history_file(d)
+            if hist is None:
+                continue
+            try:
+                out.append(parse_history_file_name(os.path.basename(hist)))
+            except ValueError:
+                continue
+        out.sort(key=lambda m: m.started, reverse=True)
+        return out
+
+    def get_metadata(self, job_id: str) -> Optional[JobMetadata]:
+        d = self._find_app_dir(job_id)
+        if d is None:
+            return None
+        hist = self._history_file(d)
+        if hist is None:
+            return None
+        try:
+            return parse_history_file_name(os.path.basename(hist))
+        except ValueError:
+            return None
+
+    def get_events(self, job_id: str) -> list[dict[str, Any]]:
+        """Parsed event dicts for a job (reference: event cache)."""
+        d = self._find_app_dir(job_id)
+        if d is None:
+            return []
+        hist = self._history_file(d)
+        if hist is None:
+            return []
+        mtime = os.path.getmtime(hist)
+        with self._lock:
+            cached = self._events.get(hist)
+            if cached is not None and cached[0] == mtime:
+                return cached[1]
+        try:
+            events = [e.to_dict() for e in parse_events(hist)]
+        except Exception:  # noqa: BLE001 — damaged file, serve empty
+            LOG.exception("failed to parse %s", hist)
+            return []
+        with self._lock:
+            self._events.put(hist, (mtime, events))
+        return events
+
+    def get_config(self, job_id: str) -> dict[str, Any]:
+        """The frozen per-job config (reference: config cache reading the
+        config.xml the AM wrote into the history dir)."""
+        d = self._find_app_dir(job_id)
+        if d is None:
+            return {}
+        path = os.path.join(d, C.PORTAL_CONFIG_FILE)
+        if not os.path.isfile(path):
+            return {}
+        mtime = os.path.getmtime(path)
+        with self._lock:
+            cached = self._configs.get(path)
+            if cached is not None and cached[0] == mtime:
+                return cached[1]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                conf = json.load(f)
+        except Exception:  # noqa: BLE001
+            LOG.exception("failed to read %s", path)
+            return {}
+        with self._lock:
+            self._configs.put(path, (mtime, conf))
+        return conf
+
+    def get_log_links(self, job_id: str) -> list[dict[str, Any]]:
+        """Per-task log locations synthesized from TASK_STARTED events
+        (reference: models/JobLog.java:27-60 builds NM containerlogs URLs)."""
+        md = self.get_metadata(job_id)
+        user = md.user if md else "unknown"
+        links = []
+        for ev in self.get_events(job_id):
+            if ev["type"] != EventType.TASK_STARTED.value:
+                continue
+            p = ev["payload"]
+            links.append({
+                "task": f'{p["task_type"]}:{p["task_index"]}',
+                "host": p["host"],
+                "container_id": p.get("container_id", ""),
+                "url": (f'http://{p["host"]}/node/containerlogs/'
+                        f'{p.get("container_id", "")}/{user}'),
+            })
+        return links
+
+    def metadata_dicts(self) -> list[dict[str, Any]]:
+        return [asdict(m) for m in self.list_metadata()]

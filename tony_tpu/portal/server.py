@@ -1,0 +1,174 @@
+"""Portal HTTP server: job list, per-job config/events/logs.
+
+Equivalent of the reference's tony-portal Play routes (tony-portal/conf/
+routes:1-5): `/`, `/config/:jobId`, `/jobs/:jobId`, `/logs/:jobId` rendered
+as HTML, plus the same data under `/api/...` as JSON (the idiomatic
+replacement for Play's scala.html templates). Runs on the stdlib threading
+HTTP server — the portal is read-only observability, off the training path.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+from urllib.parse import urlparse
+
+from tony_tpu.portal.cache import PortalCache
+
+LOG = logging.getLogger(__name__)
+
+_PAGE = """<!doctype html><html><head><title>TonY-TPU portal</title>
+<style>
+body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
+th{{background:#eee}}a{{text-decoration:none}}
+.RUNNING{{color:#b8860b}}.SUCCEEDED{{color:green}}.FAILED{{color:red}}
+.KILLED{{color:#555}}
+</style></head><body><h2>{title}</h2>{body}</body></html>"""
+
+
+def _table(headers: list[str], rows: list[list[str]]) -> str:
+    head = "".join(f"<th>{h}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{c}</td>" for c in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _fmt_ts(ms: int) -> str:
+    import datetime
+    if not ms:
+        return "-"
+    return datetime.datetime.fromtimestamp(
+        ms / 1000.0).strftime("%Y-%m-%d %H:%M:%S")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    cache: PortalCache  # injected by PortalServer
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        LOG.debug("portal: " + fmt, *args)
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype + "; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _html(self, title: str, body: str, code: int = 200) -> None:
+        self._send(code, _PAGE.format(title=html.escape(title), body=body),
+                   "text/html")
+
+    def _json(self, obj: Any, code: int = 200) -> None:
+        self._send(code, json.dumps(obj, indent=1), "application/json")
+
+    # -- routing -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        path = urlparse(self.path).path.rstrip("/") or "/"
+        parts = [p for p in path.split("/") if p]
+        try:
+            if path == "/":
+                return self._index()
+            if path == "/healthz":
+                return self._json({"ok": True})
+            if parts[0] == "api":
+                return self._api(parts[1:])
+            if len(parts) == 2 and parts[0] in ("jobs", "config", "logs"):
+                job_id = parts[1]
+                if self.cache.get_metadata(job_id) is None:
+                    return self._html("not found",
+                                      f"<p>no such job {html.escape(job_id)}</p>",
+                                      404)
+                return getattr(self, "_" + parts[0])(job_id)
+            self._html("not found", "<p>404</p>", 404)
+        except Exception:  # noqa: BLE001
+            LOG.exception("portal request failed: %s", self.path)
+            self._html("error", "<p>internal error</p>", 500)
+
+    def _api(self, parts: list[str]) -> None:
+        if parts == ["jobs"]:
+            return self._json(self.cache.metadata_dicts())
+        if len(parts) == 3 and parts[0] == "jobs":
+            job_id, what = parts[1], parts[2]
+            if what == "events":
+                return self._json(self.cache.get_events(job_id))
+            if what == "config":
+                return self._json(self.cache.get_config(job_id))
+            if what == "logs":
+                return self._json(self.cache.get_log_links(job_id))
+        self._json({"error": "not found"}, 404)
+
+    # -- pages (reference: 4 page controllers) -----------------------------
+    def _index(self) -> None:
+        rows = []
+        for m in self.cache.list_metadata():
+            app = html.escape(m.application_id)
+            rows.append([
+                f'<a href="/jobs/{app}">{app}</a>',
+                html.escape(m.user),
+                _fmt_ts(m.started), _fmt_ts(m.completed),
+                f'<span class="{html.escape(m.status)}">'
+                f'{html.escape(m.status)}</span>',
+                f'<a href="/config/{app}">config</a> '
+                f'<a href="/logs/{app}">logs</a>',
+            ])
+        self._html("TonY-TPU jobs",
+                   _table(["Job", "User", "Started", "Completed", "Status",
+                           ""], rows))
+
+    def _jobs(self, job_id: str) -> None:
+        rows = []
+        for ev in self.cache.get_events(job_id):
+            rows.append([
+                _fmt_ts(ev["timestamp"]),
+                html.escape(ev["type"]),
+                html.escape(json.dumps(ev["payload"])),
+            ])
+        self._html(f"events — {job_id}",
+                   _table(["Time", "Event", "Payload"], rows))
+
+    def _config(self, job_id: str) -> None:
+        conf = self.cache.get_config(job_id)
+        rows = [[html.escape(k), html.escape(str(v))]
+                for k, v in sorted(conf.items())]
+        self._html(f"config — {job_id}", _table(["Key", "Value"], rows))
+
+    def _logs(self, job_id: str) -> None:
+        rows = []
+        for link in self.cache.get_log_links(job_id):
+            url = html.escape(link["url"])
+            rows.append([
+                html.escape(link["task"]), html.escape(link["host"]),
+                html.escape(link["container_id"]),
+                f'<a href="{url}">{url}</a>',
+            ])
+        self._html(f"logs — {job_id}",
+                   _table(["Task", "Host", "Container", "Log"], rows))
+
+
+class PortalServer:
+    """Owns the HTTP server plus the mover/purger daemons."""
+
+    def __init__(self, cache: PortalCache, port: int = 0,
+                 host: str = "0.0.0.0"):
+        self.cache = cache
+        handler = type("BoundHandler", (_Handler,), {"cache": cache})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="portal-http", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+        LOG.info("portal serving on port %d", self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -47,20 +47,29 @@ class _JsonRpcClient:
     # error that retrying would only mask.
     _RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
 
-    def call(self, method: str, req: Optional[dict] = None) -> Any:
+    def call(self, method: str, req: Optional[dict] = None,
+             retries: Optional[int] = None,
+             timeout_sec: Optional[float] = None,
+             wait_for_ready: bool = True) -> Any:
+        """Per-call overrides exist for liveness-critical paths (heartbeats)
+        that must fail FAST — the caller is its own retry loop there, and
+        wait_for_ready would otherwise stall a call against a dead AM for
+        the full deadline."""
+        retries = self._retries if retries is None else retries
+        timeout_sec = self._timeout_sec if timeout_sec is None else timeout_sec
         last_err: Optional[Exception] = None
-        for attempt in range(self._retries):
+        for attempt in range(retries):
             try:
-                return self._stubs[method](req or {}, timeout=self._timeout_sec,
-                                           wait_for_ready=True)
+                return self._stubs[method](req or {}, timeout=timeout_sec,
+                                           wait_for_ready=wait_for_ready)
             except grpc.RpcError as e:
                 if e.code() not in self._RETRYABLE:
                     raise
                 last_err = e
-                if attempt + 1 < self._retries:
+                if attempt + 1 < retries:
                     time.sleep(self._retry_sleep_sec)
         raise ConnectionError(
-            f"RPC {method} failed after {self._retries} attempts: {last_err}")
+            f"RPC {method} failed after {retries} attempts: {last_err}")
 
     def close(self) -> None:
         self._channel.close()
@@ -99,7 +108,12 @@ class ClusterServiceClient(_JsonRpcClient):
         self.call("finish_application", {})
 
     def task_executor_heartbeat(self, task_id: str) -> None:
-        self.call("task_executor_heartbeat", {"task_id": task_id})
+        # liveness signal: one attempt, short deadline, no wait_for_ready —
+        # the Heartbeater counts consecutive failures and kills the executor
+        # when the AM is gone (reference: TaskExecutor.java:358-368; with
+        # the default retry proxy a dead AM would take ~27 min to detect)
+        self.call("task_executor_heartbeat", {"task_id": task_id},
+                  retries=1, timeout_sec=5.0, wait_for_ready=False)
 
 
 class MetricsServiceClient(_JsonRpcClient):

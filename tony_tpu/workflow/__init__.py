@@ -1,0 +1,5 @@
+"""Workflow-engine adapters (tony-azkaban equivalent)."""
+
+from tony_tpu.workflow.adapter import TonyWorkflowJob
+
+__all__ = ["TonyWorkflowJob"]
