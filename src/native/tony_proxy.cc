@@ -27,6 +27,7 @@
 
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 namespace {
 
@@ -45,6 +46,7 @@ struct Relay {
   int client = -1;
   int upstream = -1;
   bool connecting = true;   // upstream connect() in flight
+  bool doomed = false;      // close deferred to end of event batch
   Pipe c2u, u2c;            // client->upstream, upstream->client
 };
 
@@ -86,12 +88,17 @@ class Proxy {
     epoll_ctl(epfd_, EPOLL_CTL_ADD, listener_, &ev);
 
     epoll_event events[kMaxEvents];
+    std::vector<Relay*> doomed;
     for (;;) {
       int n = epoll_wait(epfd_, events, kMaxEvents, -1);
       if (n < 0) {
         if (errno == EINTR) continue;
         return 1;
       }
+      // Closes are deferred to the end of the batch: closing mid-batch
+      // frees fd numbers that a same-batch Accept() could reuse, making a
+      // stale queued event hit the wrong (healthy) relay.
+      doomed.clear();
       for (int i = 0; i < n; ++i) {
         int fd = events[i].data.fd;
         if (fd == listener_) {
@@ -101,8 +108,13 @@ class Proxy {
         auto it = relays_.find(fd);
         if (it == relays_.end()) continue;
         Relay* r = it->second;
-        if (!Service(r, fd, events[i].events)) CloseRelay(r);
+        if (r->doomed) continue;
+        if (!Service(r, fd, events[i].events)) {
+          r->doomed = true;
+          doomed.push_back(r);
+        }
       }
+      for (Relay* r : doomed) CloseRelay(r);
     }
   }
 

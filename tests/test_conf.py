@@ -124,3 +124,16 @@ def test_final_conf_roundtrip(tmp_path):
     assert loaded.get_int("tony.worker.instances") == 3
     assert loaded.source_of("tony.worker.instances") == "file"
     assert loaded.get_int(K.TASK_HEARTBEAT_INTERVAL_MS) == 1000
+
+
+def test_version_stamping():
+    """Build metadata injected at submission (reference: VersionInfo,
+    TonyClient.java:152)."""
+    from tony_tpu.version import VERSION, stamp_conf
+    conf = TonyConfiguration()
+    stamp_conf(conf)
+    assert conf.get_str("tony.version") == VERSION
+    assert conf.get_str("tony.version.git-ref")
+    assert conf.get_str("tony.version.user")
+    # version keys must never parse as jobtypes
+    assert "version" not in conf.job_types()

@@ -150,3 +150,23 @@ def test_rope_properties():
         kn = apply_rope(k, cos, sin, positions=jnp.array([n]))
         qk.append(float(jnp.sum(qm * kn)))
     assert abs(qk[0] - qk[1]) < 1e-3
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_backward_kernels_match_blockwise(causal):
+    """The TPU backward kernels (interpret mode here) must match the
+    blockwise-jnp backward, including padded kv_len masking."""
+    from tony_tpu.ops import attention as A
+
+    s, d, kv_len = 256, 32, 200   # kv_len < s exercises the pad mask
+    ks = jax.random.split(jax.random.PRNGKey(7 + causal), 4)
+    q, k, v, g = (jax.random.normal(kk, (1, 2, s, d)) for kk in ks)
+    out, lse = A._blockwise_forward(q, k, v, causal, d ** -0.5, 128,
+                                    kv_len=kv_len)
+    want = A._blockwise_backward(q, k, v, out, lse, g, causal, d ** -0.5,
+                                 128, kv_len=kv_len)
+    got = A._pallas_backward(q, k, v, out, lse, g, causal, d ** -0.5,
+                             128, 128, kv_len, interpret=True)
+    for name, w, got_g in zip(("dq", "dk", "dv"), want, got):
+        np.testing.assert_allclose(np.asarray(got_g), np.asarray(w),
+                                   atol=2e-4, rtol=2e-4, err_msg=name)

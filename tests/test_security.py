@@ -1,0 +1,101 @@
+"""Control-plane token-auth tests (reference model: secure-mode wiring,
+ApplicationMaster.java:432-452 + TonyClient token plumbing)."""
+
+import os
+import stat
+
+import grpc
+import pytest
+
+from tony_tpu.rpc.client import ClusterServiceClient
+from tony_tpu.rpc.service import serve
+from tony_tpu.security import (
+    generate_token, read_token_file, write_token_file,
+)
+
+
+class FakeHandler:
+    def __init__(self):
+        self.heartbeats = 0
+
+    def get_task_infos(self, req):
+        return []
+
+    def get_cluster_spec(self, req):
+        return {"spec": None}
+
+    def register_worker_spec(self, req):
+        return {"spec": None}
+
+    def register_tensorboard_url(self, req):
+        return {}
+
+    def register_execution_result(self, req):
+        return {}
+
+    def finish_application(self, req):
+        return {}
+
+    def task_executor_heartbeat(self, req):
+        self.heartbeats += 1
+        return {}
+
+
+def test_token_file_roundtrip_and_mode(tmp_path):
+    token = generate_token()
+    path = write_token_file(str(tmp_path), token)
+    assert read_token_file(str(tmp_path)) == token
+    mode = stat.S_IMODE(os.stat(path).st_mode)
+    assert mode == 0o600
+
+
+def test_server_rejects_missing_and_wrong_token():
+    token = generate_token()
+    handler = FakeHandler()
+    server, port = serve(cluster_handler=handler, auth_token=token)
+    try:
+        no_token = ClusterServiceClient("localhost", port, retries=1,
+                                        timeout_sec=5.0)
+        with pytest.raises(grpc.RpcError) as exc:
+            no_token.get_task_infos()
+        assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        no_token.close()
+
+        wrong = ClusterServiceClient("localhost", port, retries=1,
+                                     timeout_sec=5.0, auth_token="nope")
+        with pytest.raises(grpc.RpcError):
+            wrong.get_task_infos()
+        wrong.close()
+
+        good = ClusterServiceClient("localhost", port, retries=1,
+                                    timeout_sec=5.0, auth_token=token)
+        assert good.get_task_infos() == []
+        good.task_executor_heartbeat("worker:0")
+        assert handler.heartbeats == 1
+        good.close()
+    finally:
+        server.stop(grace=None)
+
+
+def test_secure_job_end_to_end(tmp_path):
+    """Full chain with security on: client mints token, AM requires it,
+    executors authenticate through env (TestTonyE2E secure-mode analogue)."""
+    from tony_tpu.client.tony_client import TonyClient
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.configuration import TonyConfiguration
+
+    script = os.path.join(os.path.dirname(__file__), "scripts", "exit_0.py")
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_WORKDIR, str(tmp_path / "cluster"), "test")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "test")
+    conf.set(K.AM_MONITOR_INTERVAL_MS, 200, "test")
+    conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 2000, "test")
+    conf.set(K.APPLICATION_SECURITY_ENABLED, True, "test")
+    client = TonyClient(conf)
+    client.init(["--executes", script, "--conf", "tony.worker.instances=2"])
+    assert client.run() is True
+    assert client.final_status == "SUCCEEDED"
+    # token file exists, owner-only
+    token_path = os.path.join(client.app_dir, ".tony-token")
+    assert os.path.isfile(token_path)
+    assert stat.S_IMODE(os.stat(token_path).st_mode) == 0o600

@@ -127,8 +127,18 @@ class ApplicationMaster(ClusterServiceHandler):
     def prepare(self) -> None:
         """Start RPC + backend and announce the AM address
         (ApplicationMaster.prepare, ApplicationMaster.java:391-475)."""
+        # security: require the client-minted app secret on every RPC
+        # (reference secret-manager wiring, ApplicationMaster.java:432-452)
+        self._auth_token = None
+        if self.conf.get_bool(K.APPLICATION_SECURITY_ENABLED, False):
+            from tony_tpu.security import read_token_file
+            self._auth_token = read_token_file(self.app_dir)
+            if not self._auth_token:
+                raise RuntimeError(
+                    "security enabled but no token file in app dir")
         self._rpc_server, self.rpc_port = serve(
-            cluster_handler=self, metrics_handler=self.metrics_store)
+            cluster_handler=self, metrics_handler=self.metrics_store,
+            auth_token=self._auth_token)
         self.backend.set_callbacks(self._on_container_allocated,
                                    self._on_container_completed)
         self.backend.start()
@@ -460,6 +470,11 @@ class ApplicationMaster(ClusterServiceHandler):
         docker = docker_env(self.conf, task.job_name)
         if docker:
             env.update(docker)
+        # security: containers inherit the app secret (reference duplicated
+        # credentials into every launch context, ApplicationMaster.java:1137-1140)
+        if self._auth_token:
+            from tony_tpu.security.tokens import TOKEN_ENV
+            env[TOKEN_ENV] = self._auth_token
         return env
 
     def _on_container_completed(self, container_id: str, exit_code: int) -> None:

@@ -70,6 +70,7 @@ class TonyClient:
         self.task_command = ""
         self._am_proc: Optional[subprocess.Popen] = None
         self._rpc: Optional[ClusterServiceClient] = None
+        self._auth_token: Optional[str] = None
         self._listeners: list[ClientListener] = []
         self._last_infos: dict[str, str] = {}
         self.final_status = "UNDEFINED"
@@ -89,6 +90,9 @@ class TonyClient:
             self.conf.merge_file(args.conf_file)
         self.conf.merge_cli(args.conf)
         self.conf.merge_site()
+        # build stamping (reference: VersionInfo injection, TonyClient.java:152)
+        from tony_tpu.version import stamp_conf
+        stamp_conf(self.conf)
         if args.app_name:
             self.conf.set(K.APPLICATION_NAME, args.app_name, "cli")
         if args.queue:
@@ -179,6 +183,13 @@ class TonyClient:
             tempfile.gettempdir(), "tony_tpu")
         self.app_dir = os.path.join(workdir, self.app_id)
         os.makedirs(self.app_dir, exist_ok=True)
+        # security: mint the per-app secret BEFORE the AM starts so it can
+        # require it on its RPC servers (reference: RM-issued AM master key,
+        # ApplicationMaster.java:432-452; here the client is the issuer)
+        if self.conf.get_bool(K.APPLICATION_SECURITY_ENABLED, False):
+            from tony_tpu.security import generate_token, write_token_file
+            self._auth_token = generate_token()
+            write_token_file(self.app_dir, self._auth_token)
         self._process_final_conf()
         am_stdout = open(os.path.join(self.app_dir, C.AM_STDOUT), "ab")
         am_stderr = open(os.path.join(self.app_dir, C.AM_STDERR), "ab")
@@ -275,7 +286,8 @@ class TonyClient:
             host, _, port = hostport.rpartition(":")
             self._rpc = ClusterServiceClient(host, int(port), retries=2,
                                              retry_sleep_sec=0.2,
-                                             timeout_sec=5.0)
+                                             timeout_sec=5.0,
+                                             auth_token=self._auth_token)
             LOG.info("AM RPC at %s", hostport)
         except (OSError, ValueError):
             LOG.warning("could not read AM hostport yet")

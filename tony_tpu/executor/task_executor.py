@@ -122,9 +122,15 @@ class TaskExecutor:
         self.port = 0
         self.tb_port: Optional[int] = None
         self._port_reservation = None
-        self.client = ClusterServiceClient(self.am_host, self.am_port)
+        # security: the AM passes the app secret via env (launch-context
+        # credential duplication, ApplicationMaster.java:1137-1140)
+        from tony_tpu.security.tokens import TOKEN_ENV
+        token = e.get(TOKEN_ENV) or None
+        self.client = ClusterServiceClient(self.am_host, self.am_port,
+                                           auth_token=token)
         self.metrics_client = MetricsServiceClient(self.am_host,
-                                                   self.metrics_port)
+                                                   self.metrics_port,
+                                                   auth_token=token)
         self.heartbeater: Optional[Heartbeater] = None
         self.monitor: Optional[TaskMonitor] = None
         self._user_proc = None

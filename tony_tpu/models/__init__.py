@@ -13,9 +13,13 @@ from tony_tpu.models.llama import (
 )
 from tony_tpu.models.mnist import mnist_forward, mnist_init, mnist_loss
 from tony_tpu.models.linear import linreg_forward, linreg_init, linreg_loss
+from tony_tpu.models.moe import (
+    MoEConfig, moe_forward, moe_init, moe_loss, moe_param_axes,
+)
 
 __all__ = [
     "LlamaConfig", "llama_forward", "llama_init", "llama_loss",
     "llama_param_axes", "mnist_forward", "mnist_init", "mnist_loss",
     "linreg_forward", "linreg_init", "linreg_loss",
+    "MoEConfig", "moe_forward", "moe_init", "moe_loss", "moe_param_axes",
 ]

@@ -172,11 +172,12 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
     return flash_attention(q, k, v, True)
 
 
-def _block(config: LlamaConfig, cos, sin, x, layer: Params):
-    b, s, d = x.shape
+def attention_sublayer(h: jax.Array, layer: Params, config: LlamaConfig,
+                       cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """QKV + RoPE + GQA broadcast + (ring|flash) attention + output proj.
+    Shared by the dense block here and the MoE block (models/moe.py)."""
+    b, s, _ = h.shape
     nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
-
-    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
     q = jnp.einsum("bsd,dh->bsh", h, layer["wq"])
     k = jnp.einsum("bsd,dh->bsh", h, layer["wk"])
     v = jnp.einsum("bsd,dh->bsh", h, layer["wv"])
@@ -194,7 +195,12 @@ def _block(config: LlamaConfig, cos, sin, x, layer: Params):
     v = constrain(v, ("batch", "heads", "seq", None))
     attn = _attention_dispatch(q, k, v, config)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
-    x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
+    return jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
+
+
+def _block(config: LlamaConfig, cos, sin, x, layer: Params):
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    x = x + attention_sublayer(h, layer, config, cos, sin)
     x = constrain(x, ("batch", "seq", None))
 
     h = rms_norm(x, layer["mlp_norm"], config.norm_eps)

@@ -1,0 +1,202 @@
+"""Mixture-of-Experts Llama variant with expert parallelism.
+
+No reference equivalent (the reference is an orchestrator; SURVEY.md §2.3
+lists expert parallelism as absent) — this is the TPU-first extension that
+makes the mesh's `ep` axis real. Design:
+
+- **Dense dispatch, static shapes**: top-k routing is expressed as one-hot
+  dispatch/combine einsums (GShard/Switch pattern) — no gather/scatter with
+  data-dependent shapes, so XLA tiles everything onto the MXU and inserts
+  the expert all-to-alls from the shardings alone.
+- **Capacity factor**: each expert processes a fixed `capacity` of tokens
+  per batch; overflow tokens are dropped by the dispatch mask (standard
+  Switch behavior) which keeps every tensor static.
+- **Sharding**: expert weight dim maps to the `ep` mesh axis (sharding
+  rule "expert" → "ep"); token batch stays on (dp, fsdp). XLA turns the
+  dispatch einsum into an all-to-all over ep.
+- **Aux load-balancing loss** (Switch §2.2): mean(fraction_tokens *
+  fraction_router_prob) * n_experts², returned alongside the output.
+
+The MoE block replaces the dense SwiGLU MLP in the Llama block; attention,
+RoPE, norms are shared with models/llama.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.models.llama import LlamaConfig, llama_init, llama_param_axes
+from tony_tpu.ops.rmsnorm import rms_norm
+from tony_tpu.parallel.sharding import constrain
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+
+PRESETS = {
+    "moe_tiny": MoEConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, ffn_dim=128, max_seq=128,
+                          dtype=jnp.float32, remat=False, n_experts=4,
+                          top_k=2),
+    "mixtral_proxy": MoEConfig(vocab_size=32_000, dim=2048, n_layers=16,
+                               n_heads=16, n_kv_heads=8, ffn_dim=4096,
+                               max_seq=4096, n_experts=8, top_k=2),
+}
+
+
+def get_moe_config(name: str, **overrides) -> MoEConfig:
+    return replace(PRESETS[name], **overrides)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def moe_init(config: MoEConfig, key: jax.Array) -> Params:
+    """Llama params with the dense MLP swapped for router + expert banks."""
+    k_base, k_router, k_experts = jax.random.split(key, 3)
+    params = llama_init(config, k_base)
+    d, f, L, E = config.dim, config.ffn_dim, config.n_layers, config.n_experts
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            config.dtype)
+
+    ks = jax.random.split(k_experts, 3)
+    layers = dict(params["layers"])
+    for dense_key in ("w_gate", "w_up", "w_down"):
+        del layers[dense_key]
+    layers["router"] = normal(k_router, (L, d, E), d ** -0.5)
+    layers["we_gate"] = normal(ks[0], (L, E, d, f), d ** -0.5)
+    layers["we_up"] = normal(ks[1], (L, E, d, f), d ** -0.5)
+    layers["we_down"] = normal(ks[2], (L, E, f, d), f ** -0.5)
+    params["layers"] = layers
+    return params
+
+
+def moe_param_axes(config: MoEConfig) -> Params:
+    axes = llama_param_axes(config)
+    layers = dict(axes["layers"])
+    for dense_key in ("w_gate", "w_up", "w_down"):
+        del layers[dense_key]
+    layers["router"] = ("layers", "embed", None)
+    layers["we_gate"] = ("layers", "expert", "embed", "mlp")
+    layers["we_up"] = ("layers", "expert", "embed", "mlp")
+    layers["we_down"] = ("layers", "expert", "mlp", "embed")
+    axes["layers"] = layers
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# MoE layer (dense dispatch)
+# ---------------------------------------------------------------------------
+
+def moe_mlp(x: jax.Array, layer: Params, config: MoEConfig
+            ) -> tuple[jax.Array, jax.Array]:
+    """x: (B, S, D) -> (out, aux_loss). Top-k one-hot dispatch/combine."""
+    b, s, d = x.shape
+    E, k = config.n_experts, config.top_k
+    n_tokens = b * s
+    capacity = max(1, int(config.capacity_factor * n_tokens * k / E))
+
+    xt = x.reshape(n_tokens, d)
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                        layer["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                       # (T, E)
+
+    # top-k expert choice per token, one expert at a time so every
+    # intermediate stays static-shaped
+    gates = jnp.zeros_like(probs)
+    masked = probs
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                         # (T,)
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        gates = gates + onehot * probs
+        masked = masked * (1.0 - onehot)
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)             # renorm
+
+    # capacity assignment: position of each token within its expert queue
+    chosen = gates > 0.0                                          # (T, E)
+    position = jnp.cumsum(chosen, axis=0) - 1                     # (T, E)
+    keep = chosen & (position < capacity)
+    # dispatch tensor (T, E, C): one-hot over capacity slots
+    slot = jnp.where(keep, position, 0)
+    dispatch = (keep[..., None]
+                * jax.nn.one_hot(slot, capacity, dtype=x.dtype))  # (T,E,C)
+    combine = dispatch * gates[..., None].astype(x.dtype)         # (T,E,C)
+
+    # route tokens to experts: (E, C, D)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)
+    expert_in = constrain(expert_in, ("expert", None, None))
+    gate = jnp.einsum("ecd,edf->ecf", expert_in, layer["we_gate"])
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["we_up"])
+    act = jax.nn.silu(gate) * up
+    expert_out = jnp.einsum("ecf,efd->ecd", act, layer["we_down"])
+    expert_out = constrain(expert_out, ("expert", None, None))
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # Switch-style load-balance aux loss
+    frac_tokens = jnp.mean(chosen.astype(jnp.float32), axis=0)    # (E,)
+    frac_probs = jnp.mean(probs, axis=0)                          # (E,)
+    aux = jnp.sum(frac_tokens * frac_probs) * (E / k)
+
+    return out.reshape(b, s, d).astype(x.dtype), aux
+
+
+# ---------------------------------------------------------------------------
+# forward/loss (Llama block with MoE MLP)
+# ---------------------------------------------------------------------------
+
+def moe_forward(params: Params, tokens: jax.Array, config: MoEConfig
+                ) -> tuple[jax.Array, jax.Array]:
+    """-> (logits (B,S,V) f32, total aux loss)."""
+    from tony_tpu.models.llama import attention_sublayer
+    from tony_tpu.ops.rope import rope_frequencies
+
+    s = tokens.shape[1]
+    cos, sin = rope_frequencies(config.head_dim, s, config.rope_theta)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
+    x = constrain(x, ("batch", "seq", None))
+
+    def block(x, layer):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        x = x + attention_sublayer(h, layer, config, cos, sin)
+        x = constrain(x, ("batch", "seq", None))
+        h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        moe_out, aux = moe_mlp(h, layer, config)
+        return constrain(x + moe_out, ("batch", "seq", None)), aux
+
+    if config.remat:
+        block = jax.checkpoint(block)
+
+    x, aux_losses = lax.scan(lambda x, layer: block(x, layer), x,
+                             params["layers"])
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                        params["output"].astype(jnp.float32))
+    return constrain(logits, ("batch", "seq", "vocab")), jnp.sum(aux_losses)
+
+
+def moe_loss(params: Params, batch: dict[str, jax.Array],
+             config: MoEConfig) -> jax.Array:
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits, aux = moe_forward(params, inputs, config)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold) + config.aux_loss_weight * aux

@@ -9,9 +9,11 @@ Design (pallas_guide.md patterns):
 - causal masking skips fully-masked K blocks: the K-loop upper bound is
   derived from the Q block index, so the kernel does ~half the FLOPs of the
   dense version at long context.
-- backward: flash-style recompute in blockwise jnp (lax.scan over K blocks,
-  O(S*Bk) memory). XLA fuses it well on TPU; a hand-written pallas backward
-  can swap in later without touching callers (custom_vjp boundary).
+- backward on TPU: two pallas kernels (dQ over K blocks; dK/dV over Q
+  blocks) with flash-style recompute from the saved lse — causal skipping
+  bounds each loop at/after the diagonal. CPU path: the same math as a
+  blockwise lax.scan (O(S*Bk) memory), also the parity oracle for the
+  kernels in interpret mode.
 
 Dispatch: TPU -> compiled pallas; other platforms -> the same blockwise math
 in pure jnp (CPU tests, virtual-device meshes). `reference_attention` is the
@@ -230,6 +232,161 @@ def _blockwise_backward(q, k, v, out, lse, g, causal, sm_scale, block_k,
 
 
 # ---------------------------------------------------------------------------
+# pallas backward kernels (flash-style recompute; dQ and dKV separately so
+# each accumulator lives in registers with a clean parallel grid)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, seq_len: int, kv_len: int,
+                         causal: bool, sm_scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    q_offset = qi * block_q
+    q = q_ref[0].astype(jnp.float32)                      # (Bq, D)
+    g = g_ref[0].astype(jnp.float32)                      # (Bq, D)
+    lse = lse_ref[0, 0][:, None]                          # (Bq, 1)
+    delta = delta_ref[0, 0][:, None]                      # (Bq, 1)
+
+    num_kb = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_kb_live = jnp.minimum(
+            lax.div(q_offset + block_q + block_k - 1, block_k), num_kb)
+    else:
+        num_kb_live = num_kb
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        cols = kb * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if causal:
+            rows = q_offset + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if kv_len < seq_len:
+            s = jnp.where(cols < kv_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # (Bq, Bk)
+        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, num_kb_live, body,
+                       jnp.zeros((block_q, q.shape[1]), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, seq_len: int,
+                          kv_len: int, causal: bool, sm_scale: float):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    k_offset = ki * block_k
+    k_blk = k_ref[0].astype(jnp.float32)                  # (Bk, D)
+    v_blk = v_ref[0].astype(jnp.float32)                  # (Bk, D)
+
+    num_qb = pl.cdiv(seq_len, block_q)
+    if causal:
+        # Q blocks strictly before this K block contribute nothing
+        qb_start = lax.div(k_offset, block_q)
+    else:
+        qb_start = 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32) * sm_scale
+        cols = k_offset + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if causal:
+            rows = qb * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if kv_len < seq_len:
+            s = jnp.where(cols < kv_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # (Bq, Bk)
+        dv = dv + jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k_blk.shape[1]
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = lax.fori_loop(qb_start, num_qb, body, init)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                     block_k, kv_len, interpret=False):
+    from jax.experimental import pallas as pl
+
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    bh = b * h
+    qf = q.reshape(bh, s, d)
+    kf = k.reshape(bh, s, d)
+    vf = v.reshape(bh, s, d)
+    gf = g.reshape(bh, s, d)
+    lse_f = lse.reshape(bh, 1, s)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, s)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, seq_len=s,
+                          kv_len=kv_len if kv_len is not None else s,
+                          causal=causal, sm_scale=sm_scale),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse_f, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_len=s,
+                          kv_len=kv_len if kv_len is not None else s,
+                          causal=causal, sm_scale=sm_scale),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse_f, delta)
+
+    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
+            dv.reshape(b, h, s, d))
+
+
+# ---------------------------------------------------------------------------
 # core op with custom VJP (always sees block-divisible shapes + kv_len mask)
 # ---------------------------------------------------------------------------
 
@@ -255,6 +412,10 @@ def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, kv_len, residuals, g):
     q, k, v, out, lse = residuals
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        return _pallas_backward(q, k, v, out, lse, g, causal, sm_scale,
+                                block_q, block_k, kv_len)
     return _blockwise_backward(q, k, v, out, lse, g, causal, sm_scale,
                                block_k, kv_len=kv_len)
 

@@ -20,6 +20,9 @@ Axes convention (scaling-book style):
 from tony_tpu.parallel.mesh import (
     MESH_AXES, MeshPlan, make_mesh, mesh_from_env, plan_mesh,
 )
+from tony_tpu.parallel.pipeline import (
+    make_pipelined_fn, pipeline_apply, stack_stage_params,
+)
 from tony_tpu.parallel.sharding import (
     logical_to_mesh_axes, make_partition_spec, shard_pytree,
 )
@@ -27,4 +30,5 @@ from tony_tpu.parallel.sharding import (
 __all__ = [
     "MESH_AXES", "MeshPlan", "make_mesh", "mesh_from_env", "plan_mesh",
     "logical_to_mesh_axes", "make_partition_spec", "shard_pytree",
+    "make_pipelined_fn", "pipeline_apply", "stack_stage_params",
 ]

@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the pp axis.
+
+No reference equivalent (SURVEY.md §2.3 lists PP as absent) — built
+TPU-first: the schedule is a `lax.scan` over time steps inside `shard_map`,
+with `lax.ppermute` moving activations to the next stage over ICI
+neighbors. Stage weights live sharded on the `pp` mesh axis (logical axis
+"stage"), so each device holds only its layers. The bubble is the standard
+(n_stages - 1) / (n_micro + n_stages - 1); gradients flow through ppermute,
+so the same function trains under `jax.grad` with no extra machinery.
+
+Usage:
+    f = make_pipelined_fn(stage_fn, mesh, n_micro=8)
+    y = f(stacked_stage_params, x)     # x: (batch, ...), y: same
+where `stacked_stage_params` has a leading stage dim sharded on pp and
+`stage_fn(stage_params, x) -> y` maps one stage (activation shapes must be
+uniform across stages).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def pipeline_apply(stage_fn: StageFn, stage_params: Any,
+                   microbatches: jax.Array,
+                   axis_name: str = "pp") -> jax.Array:
+    """Runs INSIDE shard_map over `axis_name`. microbatches: (M, mb, ...)
+    (replicated across pp); stage_params: this rank's stage weights.
+    Returns (M, mb, ...) — the last stage's outputs, broadcast to every
+    rank (psum of a one-hot mask) so callers can compute the loss anywhere.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+
+    # pad the input stream with n-1 drain steps
+    pad = jnp.zeros((n - 1,) + microbatches.shape[1:], microbatches.dtype)
+    stream = jnp.concatenate([microbatches, pad], axis=0)
+
+    def step(carry, x_t):
+        # stage 0 consumes the input stream; later stages consume what the
+        # previous stage ppermuted to them last tick
+        inp = jnp.where(idx == 0, x_t, carry)
+        y = stage_fn(stage_params, inp)
+        fwd = [(i, (i + 1) % n) for i in range(n)]
+        carry_next = lax.ppermute(y, axis_name, fwd)
+        return carry_next, y
+
+    init = jnp.zeros_like(microbatches[0])
+    _, ys = lax.scan(step, init, stream)          # (M+n-1, mb, ...)
+    # the last stage's outputs for microbatch m appear at step m + n - 1
+    out = lax.dynamic_slice_in_dim(ys, n - 1, n_micro, axis=0)
+    # broadcast the last rank's (only correct) copy to every rank
+    mask = (idx == n - 1).astype(out.dtype)
+    return lax.psum(out * mask, axis_name)
+
+
+def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:
+    """(B, ...) -> (M, B/M, ...)."""
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def merge_microbatches(y: jax.Array) -> jax.Array:
+    return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])
+
+
+def make_pipelined_fn(stage_fn: StageFn, mesh: Mesh, n_micro: int,
+                      axis_name: str = "pp") -> Callable:
+    """Wrap stage_fn into f(stacked_params, x) running the full pipeline.
+    stacked_params: leading stage dim (== mesh pp size) sharded on pp;
+    x: (B, ...) replicated."""
+
+    def stage_slot(params_stacked, x_mb):
+        # inside shard_map the pp-sharded leading dim has local size 1
+        local = jax.tree.map(lambda p: p[0], params_stacked)
+        return pipeline_apply(stage_fn, local, x_mb, axis_name)
+
+    param_specs = P(axis_name)  # leading stage dim on pp, rest replicated
+
+    def f(params_stacked, x):
+        mb = split_microbatches(x, n_micro)
+        specs_in = (jax.tree.map(lambda _: param_specs, params_stacked),
+                    P())
+        y = jax.shard_map(stage_slot, mesh=mesh, in_specs=specs_in,
+                          out_specs=P(), check_vma=False)(params_stacked, mb)
+        return merge_microbatches(y)
+
+    return f
+
+
+def stack_stage_params(per_stage_params: list[Any]) -> Any:
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0),
+                        *per_stage_params)

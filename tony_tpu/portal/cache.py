@@ -52,8 +52,27 @@ class PortalCache:
         # path -> (mtime, parsed events); immutable finals hit by path
         self._events = _LRU(max_entries)
         self._configs = _LRU(max_entries)
+        # finished app dirs are immutable once moved: job_id -> dir
+        self._finished_dirs: dict[str, str] = {}
 
     # -- directory scan ----------------------------------------------------
+    def _finished_app_dirs(self):
+        """(app_id, dir) for the finished tree, memoized — moved dirs never
+        change, so one full walk amortizes across requests (reference:
+        CacheWrapper's warmed metadata cache)."""
+        seen = dict(self._finished_dirs)
+        if os.path.isdir(self.finished):
+            for dirpath, dirnames, filenames in os.walk(self.finished):
+                if any(f.endswith("." + C.HISTORY_SUFFIX)
+                       for f in filenames):
+                    seen[os.path.basename(dirpath)] = dirpath
+                    dirnames[:] = []
+        # drop entries the purger deleted
+        seen = {k: v for k, v in seen.items() if os.path.isdir(v)}
+        with self._lock:
+            self._finished_dirs = seen
+        return seen
+
     def _app_dirs(self):
         """Yield (app_id, app_dir) across intermediate + finished trees."""
         if os.path.isdir(self.intermediate):
@@ -61,17 +80,19 @@ class PortalCache:
                 d = os.path.join(self.intermediate, name)
                 if os.path.isdir(d):
                     yield name, d
-        if os.path.isdir(self.finished):
-            for dirpath, dirnames, filenames in os.walk(self.finished):
-                if any(f.endswith("." + C.HISTORY_SUFFIX) for f in filenames):
-                    yield os.path.basename(dirpath), dirpath
-                    dirnames[:] = []
+        yield from self._finished_app_dirs().items()
 
     def _find_app_dir(self, job_id: str) -> Optional[str]:
-        for name, d in self._app_dirs():
-            if name == job_id:
-                return d
-        return None
+        # running apps first (cheap single listdir), then the memoized
+        # finished map, re-walking only on a miss (a just-moved app)
+        candidate = os.path.join(self.intermediate, job_id)
+        if os.path.isdir(candidate):
+            return candidate
+        with self._lock:
+            cached = self._finished_dirs.get(job_id)
+        if cached and os.path.isdir(cached):
+            return cached
+        return self._finished_app_dirs().get(job_id)
 
     @staticmethod
     def _history_file(app_dir: str) -> Optional[str]:

@@ -28,11 +28,14 @@ class _JsonRpcClient:
                  host: str, port: int,
                  retries: int = DEFAULT_RETRIES,
                  retry_sleep_sec: float = DEFAULT_RETRY_SLEEP_SEC,
-                 timeout_sec: float = 30.0):
+                 timeout_sec: float = 30.0,
+                 auth_token: Optional[str] = None):
+        from tony_tpu.security.tokens import token_call_creds
         self._channel = grpc.insecure_channel(f"{host}:{port}")
         self._retries = retries
         self._retry_sleep_sec = retry_sleep_sec
         self._timeout_sec = timeout_sec
+        self._metadata = token_call_creds(auth_token)
         self._stubs = {
             m: self._channel.unary_unary(
                 f"/{service}/{m}",
@@ -61,7 +64,8 @@ class _JsonRpcClient:
         for attempt in range(retries):
             try:
                 return self._stubs[method](req or {}, timeout=timeout_sec,
-                                           wait_for_ready=wait_for_ready)
+                                           wait_for_ready=wait_for_ready,
+                                           metadata=self._metadata)
             except grpc.RpcError as e:
                 if e.code() not in self._RETRYABLE:
                     raise

@@ -102,11 +102,20 @@ def _generic_handler(service_name: str, handler: Any, methods: tuple[str, ...]):
 def serve(cluster_handler: Optional[ClusterServiceHandler] = None,
           metrics_handler: Optional[MetricsServiceHandler] = None,
           host: str = "0.0.0.0", port: int = 0,
-          max_workers: int = 16) -> tuple[grpc.Server, int]:
+          max_workers: int = 16,
+          auth_token: Optional[str] = None) -> tuple[grpc.Server, int]:
     """Start a gRPC server hosting either or both services on `port`
     (0 = ephemeral, the reference's random-port behavior,
-    ApplicationRpcServer.java:118-127). Returns (server, bound_port)."""
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    ApplicationRpcServer.java:118-127). With `auth_token`, every call must
+    carry it in metadata (the reference's ClientToAMTokenSecretManager
+    check on both servers, ApplicationMaster.java:432-452).
+    Returns (server, bound_port)."""
+    interceptors = ()
+    if auth_token:
+        from tony_tpu.security.tokens import TokenAuthInterceptor
+        interceptors = (TokenAuthInterceptor(auth_token),)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
+                         interceptors=interceptors)
     if cluster_handler is not None:
         server.add_generic_rpc_handlers(
             (_generic_handler(CLUSTER_SERVICE, cluster_handler, CLUSTER_METHODS),))

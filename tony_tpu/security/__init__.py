@@ -1,0 +1,14 @@
+"""Control-plane security (token auth)."""
+
+from tony_tpu.security.tokens import (
+    TokenAuthInterceptor, generate_token, read_token_file, token_call_creds,
+    write_token_file,
+)
+
+__all__ = [
+    "TokenAuthInterceptor",
+    "generate_token",
+    "read_token_file",
+    "write_token_file",
+    "token_call_creds",
+]

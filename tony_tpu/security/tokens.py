@@ -1,0 +1,75 @@
+"""Shared-secret token auth for the control-plane RPC services.
+
+Equivalent of the reference's security plumbing (SURVEY.md §2.1 "Security"):
+the RM issued a ClientToAMTokenSecretManager master key that both of the
+AM's RPC servers verified (ApplicationMaster.java:432-452), and container
+credentials were duplicated into every launch context (:953-961,1137-1140).
+Re-targeted without Kerberos/YARN: the client mints a per-app secret, ships
+it to the AM via a 0600 file in the app dir, and the AM (a) rejects any RPC
+lacking the token in its metadata and (b) hands the token to each container
+through its env — exactly the reference's trust chain (client → AM →
+container), minus the KDC. Toggle: `tony.application.security.enabled`
+(TonyConfigurationKeys.java:277-278).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+from typing import Optional
+
+import grpc
+
+TOKEN_METADATA_KEY = "tony-token"
+TOKEN_FILE = ".tony-token"
+TOKEN_ENV = "TONY_SECURITY_TOKEN"
+
+
+def generate_token() -> str:
+    return secrets.token_hex(32)
+
+
+def write_token_file(app_dir: str, token: str) -> str:
+    """Persist the app secret with owner-only permissions."""
+    path = os.path.join(app_dir, TOKEN_FILE)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, token.encode())
+    finally:
+        os.close(fd)
+    return path
+
+
+def read_token_file(app_dir: str) -> Optional[str]:
+    path = os.path.join(app_dir, TOKEN_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read().strip() or None
+    except FileNotFoundError:
+        return None
+
+
+class TokenAuthInterceptor(grpc.ServerInterceptor):
+    """Rejects calls whose metadata lacks the app token
+    (UNAUTHENTICATED, like Hadoop IPC's SASL failure surface)."""
+
+    def __init__(self, token: str):
+        self._token = token
+
+        def deny(request, context):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "missing or invalid tony token")
+
+        self._deny = grpc.unary_unary_rpc_method_handler(deny)
+
+    def intercept_service(self, continuation, handler_call_details):
+        meta = dict(handler_call_details.invocation_metadata or ())
+        supplied = meta.get(TOKEN_METADATA_KEY, "")
+        if secrets.compare_digest(supplied, self._token):
+            return continuation(handler_call_details)
+        return self._deny
+
+
+def token_call_creds(token: Optional[str]) -> list[tuple[str, str]]:
+    """Metadata list a client attaches per call ([] when security is off)."""
+    return [(TOKEN_METADATA_KEY, token)] if token else []

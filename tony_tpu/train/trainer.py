@@ -84,6 +84,7 @@ class Trainer:
     # ------------------------------------------------------------------
     def setup(self) -> None:
         maybe_initialize_distributed()
+        self._maybe_start_profiler()
         self.mesh = mesh_from_env()
         LOG.info("mesh: %s over %d devices", dict(self.mesh.shape),
                  self.mesh.devices.size)
@@ -159,6 +160,20 @@ class Trainer:
             if cfg.checkpoint_dir and loss is not None:
                 self._checkpoint()
         return self.last_loss
+
+    def _maybe_start_profiler(self) -> None:
+        """Serve the JAX profiler on the TB port the executor reserved and
+        registered with the AM (reference TensorBoard plumbing,
+        TaskExecutor.java:87-95,311-319 → here it carries XProf traces:
+        `tensorboard --logdir ...` or xprof can attach to this port)."""
+        port = os.environ.get(C.TB_PORT)
+        if not port or os.environ.get(C.IS_CHIEF, "true") != "true":
+            return
+        try:
+            jax.profiler.start_server(int(port))
+            LOG.info("jax profiler server on port %s", port)
+        except Exception:  # noqa: BLE001 — profiling must never kill training
+            LOG.exception("could not start profiler server")
 
     def _checkpoint(self) -> None:
         save_checkpoint(self.config.checkpoint_dir, self.step,

@@ -1,0 +1,48 @@
+"""Build/version stamping.
+
+Equivalent of the reference's util/VersionInfo.java:28-130, which injected
+build metadata (version, git ref, build user/time) into the job conf at
+submission (TonyClient.java:152) so every process and the portal could
+report which build ran a job.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import subprocess
+import time
+
+VERSION = "0.1.0"
+
+_KEY_PREFIX = "tony.version"
+
+
+def _git_ref() -> str:
+    try:
+        # the framework's own checkout, not the submitter's cwd — this
+        # stamps which BUILD ran the job
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() if out.returncode == 0 else "unknown"
+    except OSError:
+        return "unknown"
+
+
+def _user() -> str:
+    try:
+        return getpass.getuser()
+    except (KeyError, OSError):  # containers with no passwd entry for UID
+        return "unknown"
+
+
+def stamp_conf(conf) -> None:
+    """Write version metadata into the conf (TonyClient.java:152 analogue);
+    lands in tony-final.json and the portal's /config page."""
+    conf.set(f"{_KEY_PREFIX}", VERSION, "version-info")
+    conf.set(f"{_KEY_PREFIX}.git-ref", _git_ref(), "version-info")
+    conf.set(f"{_KEY_PREFIX}.user", _user(), "version-info")
+    conf.set(f"{_KEY_PREFIX}.build-time",
+             time.strftime("%Y-%m-%dT%H:%M:%S"), "version-info")
