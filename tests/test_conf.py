@@ -137,3 +137,14 @@ def test_version_stamping():
     assert conf.get_str("tony.version.user")
     # version keys must never parse as jobtypes
     assert "version" not in conf.job_types()
+
+
+def test_config_docs_current():
+    """Docs drift check (reference: TestTonyConfigurationFields asserting
+    code<->tony-default.xml parity; here code<->docs/configuration.md)."""
+    import subprocess, sys, os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "gen_config_docs.py"),
+         "--check"], capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr

@@ -151,3 +151,27 @@ def test_trainer_resume(tmp_path):
     assert t2.step == 5  # resumed, not restarted
     t2.run()
     assert latest_step(str(tmp_path)) == 10
+
+
+def test_llama_ulysses_sp_mode_trains():
+    """Full llama step with ulysses SP on a seq-sharded mesh."""
+    from functools import partial
+    import optax
+    from tony_tpu.models.llama import (
+        get_config, llama_init, llama_loss, llama_param_axes,
+    )
+    from tony_tpu.parallel import make_mesh, plan_mesh, shard_pytree
+    from tony_tpu.train.step import make_train_step
+
+    mesh = make_mesh(plan_mesh(8, sp=2, tp=2))
+    config = get_config("tiny", sp_mode="ulysses")
+    params = shard_pytree(llama_init(config, jax.random.PRNGKey(0)),
+                          llama_param_axes(config), mesh)
+    opt = optax.adam(1e-3)
+    step = make_train_step(partial(llama_loss, config=config), opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 65), 0,
+                                config.vocab_size, jnp.int32)
+    with jax.set_mesh(mesh):
+        opt_state = jax.jit(opt.init)(params)
+        _, _, loss = step(params, opt_state, {"tokens": tokens})
+    assert np.isfinite(float(loss))

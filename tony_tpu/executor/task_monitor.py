@@ -69,6 +69,33 @@ def _proc_tree_rss_bytes(root_pid: int) -> int:
     return total
 
 
+def default_tpu_sampler() -> dict[str, float]:
+    """HBM occupancy via jax's per-device memory_stats (the TPU re-target of
+    nvidia-smi sampling, GpuDiscoverer.java:43-209). Only reads stats if jax
+    is ALREADY initialized in this process (single-node/preprocess jobs run
+    the model in the executor process; the monitor must never force an
+    accelerator claim). For the normal subprocess case the training process
+    reports its own accelerator metrics straight to the AM via
+    `tony_tpu.train.metrics.report_tpu_metrics` — a child's HBM is not
+    readable from here."""
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return {}
+    try:
+        devs = [d for d in jax_mod.local_devices() if d.platform == "tpu"]
+        if not devs:
+            return {}
+        hbm = 0
+        for d in devs:
+            stats = d.memory_stats() or {}
+            hbm += int(stats.get("bytes_in_use", 0))
+        return {"hbm_bytes": float(hbm)}
+    except Exception:  # noqa: BLE001 — never break metrics for stats
+        return {}
+
+
 class _Stat:
     def __init__(self):
         self.max = 0.0

@@ -55,6 +55,10 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # sequence-parallel flavor when the mesh shards seq: "ring" streams K/V
+    # chunks over ICI neighbors (long context); "ulysses" swaps to
+    # head-sharding with two all-to-alls (DCN-friendly, needs heads % sp == 0)
+    sp_mode: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -158,16 +162,23 @@ def llama_param_axes(config: LlamaConfig) -> Params:
 # ---------------------------------------------------------------------------
 
 def _attention_dispatch(q, k, v, config: LlamaConfig):
-    """Ring attention when the ambient mesh shards the sequence axis, flash
-    attention otherwise."""
+    """Sequence-parallel attention (ring or ulysses per config.sp_mode)
+    when the ambient mesh shards the sequence axis, flash attention
+    otherwise."""
     mesh = jax.sharding.get_abstract_mesh()
     sp = mesh.shape.get("sp", 1) if mesh is not None and mesh.axis_names else 1
     if sp > 1:
+        from tony_tpu.parallel.ulysses import ulysses_attention
+
         spec = logical_to_mesh_axes(("batch", "heads", "seq", None),
                                     mesh=mesh)
+        if config.sp_mode == "ulysses":
+            inner = partial(ulysses_attention, axis_name="sp", causal=True)
+        else:
+            inner = partial(ring_attention, axis_name="sp", causal=True)
         f = jax.shard_map(
-            partial(ring_attention, axis_name="sp", causal=True),
-            in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+            inner, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
         return f(q, k, v)
     return flash_attention(q, k, v, True)
 
@@ -234,15 +245,25 @@ def llama_forward(params: Params, tokens: jax.Array,
     return constrain(logits, ("batch", "seq", "vocab"))
 
 
+def unpack_lm_batch(batch: dict[str, jax.Array]
+                    ) -> tuple[jax.Array, jax.Array]:
+    """{'tokens': (B,S+1)} or {'inputs','targets'} -> (inputs, targets)."""
+    if "tokens" in batch:
+        return batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    return batch["inputs"], batch["targets"]
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean next-token CE; shared by the dense and MoE models."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
 def llama_loss(params: Params, batch: dict[str, jax.Array],
                config: LlamaConfig) -> jax.Array:
     """Next-token cross entropy. batch: {'tokens': (B, S+1)} or
     {'inputs': (B,S), 'targets': (B,S)}."""
-    if "tokens" in batch:
-        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    else:
-        inputs, targets = batch["inputs"], batch["targets"]
+    inputs, targets = unpack_lm_batch(batch)
     logits = llama_forward(params, inputs, config)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    return cross_entropy(logits, targets)

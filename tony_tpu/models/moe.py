@@ -14,8 +14,9 @@ makes the mesh's `ep` axis real. Design:
 - **Sharding**: expert weight dim maps to the `ep` mesh axis (sharding
   rule "expert" → "ep"); token batch stays on (dp, fsdp). XLA turns the
   dispatch einsum into an all-to-all over ep.
-- **Aux load-balancing loss** (Switch §2.2): mean(fraction_tokens *
-  fraction_router_prob) * n_experts², returned alongside the output.
+- **Aux load-balancing loss** (Switch-style): sum_e(fraction_tokens_e *
+  fraction_router_prob_e) * (E / k) — normalized so perfectly balanced
+  top-k routing scores ~1.0; returned alongside the output.
 
 The MoE block replaces the dense SwiGLU MLP in the Llama block; attention,
 RoPE, norms are shared with models/llama.py.
@@ -192,11 +193,8 @@ def moe_forward(params: Params, tokens: jax.Array, config: MoEConfig
 
 def moe_loss(params: Params, batch: dict[str, jax.Array],
              config: MoEConfig) -> jax.Array:
-    if "tokens" in batch:
-        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    else:
-        inputs, targets = batch["inputs"], batch["targets"]
+    from tony_tpu.models.llama import cross_entropy, unpack_lm_batch
+
+    inputs, targets = unpack_lm_batch(batch)
     logits, aux = moe_forward(params, inputs, config)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold) + config.aux_loss_weight * aux
+    return cross_entropy(logits, targets) + config.aux_loss_weight * aux

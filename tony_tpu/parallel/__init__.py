@@ -26,9 +26,13 @@ from tony_tpu.parallel.pipeline import (
 from tony_tpu.parallel.sharding import (
     logical_to_mesh_axes, make_partition_spec, shard_pytree,
 )
+from tony_tpu.parallel.ulysses import (
+    ulysses_attention, ulysses_attention_sharded,
+)
 
 __all__ = [
     "MESH_AXES", "MeshPlan", "make_mesh", "mesh_from_env", "plan_mesh",
     "logical_to_mesh_axes", "make_partition_spec", "shard_pytree",
     "make_pipelined_fn", "pipeline_apply", "stack_stage_params",
+    "ulysses_attention", "ulysses_attention_sharded",
 ]

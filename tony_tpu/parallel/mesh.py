@@ -89,6 +89,60 @@ def make_mesh(plan: MeshPlan, devices=None) -> Mesh:
     return Mesh(grid, plan.axis_names)
 
 
+def slice_index(device) -> int:
+    """A device's slice id (0 on single-slice platforms/CPU)."""
+    return getattr(device, "slice_index", 0) or 0
+
+
+def make_hybrid_mesh(plan: MeshPlan, devices=None) -> Mesh:
+    """Multi-slice layout: order devices so the OUTERMOST plan axes span
+    slices (crossing DCN) and everything inner stays within a slice (ICI) —
+    the scaling-book rule that only data parallelism should ride DCN.
+    Requires the product of the leading axes to equal the slice count times
+    an integer; falls back to `make_mesh` on single-slice platforms."""
+    devices = list(devices if devices is not None else jax.devices())
+    slices: dict[int, list] = {}
+    for d in devices:
+        slices.setdefault(slice_index(d), []).append(d)
+    if len(slices) <= 1:
+        return make_mesh(plan, devices)
+    n_slices = len(slices)
+    per_slice = min(len(v) for v in slices.values())
+    if n_slices * per_slice < plan.num_devices:
+        raise ValueError(
+            f"mesh needs {plan.num_devices} devices; have {n_slices} "
+            f"slices x {per_slice}")
+    if plan.num_devices <= per_slice:
+        # fits inside one slice: pure-ICI mesh, no DCN crossing at all
+        return make_mesh(plan, slices[sorted(slices)[0]])
+    # the plan must consume WHOLE slices: truncating mid-slice would put
+    # devices of different slices into the same inner (ICI-intended) axis
+    if plan.num_devices % per_slice != 0:
+        raise ValueError(
+            f"plan of {plan.num_devices} devices does not tile whole "
+            f"slices of {per_slice}; choose a mesh whose inner axes "
+            f"multiply to a multiple of the slice size")
+    used_slices = plan.num_devices // per_slice
+    # devices ordered slice-major: index = slice * per_slice + local
+    ordered = []
+    for s in sorted(slices)[:used_slices]:
+        ordered.extend(slices[s][:per_slice])
+    n_slices = used_slices
+    dims = plan.dims()
+    # verify the outermost axes tile exactly onto slices
+    outer = 1
+    for dim in dims:
+        if outer >= n_slices:
+            break
+        outer *= dim
+    if outer % n_slices != 0 and n_slices % outer != 0:
+        raise ValueError(
+            f"outer mesh axes {dims} do not tile {n_slices} slices; "
+            f"put the DCN-crossing axis (dp) outermost")
+    grid = np.array(ordered[: plan.num_devices]).reshape(dims)
+    return Mesh(grid, plan.axis_names)
+
+
 def mesh_from_env(devices=None) -> Mesh:
     """Build the mesh from the env the TaskExecutor's JAX runtime rendered
     (TPU_MESH_SHAPE='2,2,2' + TPU_MESH_AXES='dp,fsdp,tp'); falls back to a
@@ -104,4 +158,8 @@ def mesh_from_env(devices=None) -> Mesh:
         raise ValueError(
             f"TPU_MESH_SHAPE {shape_s!r} / TPU_MESH_AXES {axes_s!r} mismatch")
     plan = MeshPlan(dict(zip(axes, dims)))
+    # multi-slice jobs (TPU_NUM_SLICES rendered by the orchestrator) lay
+    # the outermost axis across slices over DCN
+    if int(os.environ.get(C.TPU_NUM_SLICES, "1")) > 1:
+        return make_hybrid_mesh(plan, devices)
     return make_mesh(plan, devices)

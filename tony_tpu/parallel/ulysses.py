@@ -1,0 +1,71 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head/seq swap.
+
+The second SP flavor next to ring attention (parallel/ring.py), per the
+build goals (SURVEY.md §5 lists both as greenfield). Where ring attention
+streams K/V chunks around ICI neighbors, Ulysses re-shards with two
+all-to-alls: activations arrive sequence-sharded (each rank holds S/n of
+every head), the first all-to-all exchanges them to head-sharded (each rank
+holds H/n heads with the FULL sequence), full attention runs locally per
+head, and the second all-to-all restores sequence sharding. Two collectives
+per attention call, O(S·D·H/n) bytes each — the better trade on DCN or when
+n_heads % n == 0 and sequence isn't long enough to amortize the ring.
+
+Implemented as `lax.all_to_all` inside shard_map; the local attention is
+the stack's flash/blockwise kernel, so Ulysses composes with the pallas
+path. Differentiable end-to-end (all_to_all transposes in the VJP).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tony_tpu.ops.attention import flash_attention
+from tony_tpu.parallel.sharding import logical_to_mesh_axes
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp", causal: bool = False,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """Call inside shard_map. q,k,v: (B, H, S_local, D) with the global
+    sequence sharded over `axis_name`; H must be divisible by the axis
+    size. Returns the local (B, H, S_local, D) output shard."""
+    n = lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % n != 0:
+        raise ValueError(f"n_heads {h} not divisible by sp={n} "
+                         f"(Ulysses shards heads; use ring attention)")
+
+    def seq_to_heads(x):
+        # (B, H, S/n, D) -> (B, H/n, S, D): split heads across ranks,
+        # gather the sequence
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    q_h = seq_to_heads(q)
+    k_h = seq_to_heads(k)
+    v_h = seq_to_heads(v)
+    out_h = flash_attention(q_h, k_h, v_h, causal=causal, sm_scale=sm_scale)
+    return heads_to_seq(out_h)
+
+
+def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                              mesh: Mesh, causal: bool = False,
+                              sm_scale: Optional[float] = None,
+                              axis_name: str = "sp") -> jax.Array:
+    """Global-array entry: q,k,v (B, H, S, D) sharded (or shardable) with
+    seq on `axis_name`; wraps the shard_map with canonical specs."""
+    spec = logical_to_mesh_axes(("batch", "heads", "seq", None), mesh=mesh)
+    f = jax.shard_map(
+        lambda a, b, c: ulysses_attention(a, b, c, axis_name=axis_name,
+                                          causal=causal, sm_scale=sm_scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)

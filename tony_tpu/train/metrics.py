@@ -1,0 +1,74 @@
+"""Accelerator metrics reported from INSIDE the training process.
+
+The executor's TaskMonitor samples process-tree RSS from outside, but HBM
+occupancy is only visible to the process that owns the TPU client — so the
+Trainer pushes it to the AM's metrics RPC directly, using the same task
+identity env the executor rendered (reference split: TaskMonitor sampled
+nvidia-smi host-side because CUDA exposes global device stats; TPU runtimes
+don't, hence this in-process reporter)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from tony_tpu import constants as C
+
+LOG = logging.getLogger(__name__)
+
+
+def tpu_memory_metrics() -> list[dict]:
+    """Current-process TPU HBM usage as metric dicts ([] off-TPU)."""
+    import jax
+
+    try:
+        devs = [d for d in jax.local_devices() if d.platform == "tpu"]
+    except RuntimeError:
+        return []
+    if not devs:
+        return []
+    hbm = 0
+    limit = 0
+    for d in devs:
+        stats = d.memory_stats() or {}
+        hbm += int(stats.get("bytes_in_use", 0))
+        limit += int(stats.get("bytes_limit", 0))
+    metrics = [{"name": "TPU_HBM_BYTES_IN_USE", "value": float(hbm)}]
+    if limit:
+        metrics.append({"name": "TPU_HBM_BYTES_LIMIT", "value": float(limit)})
+    return metrics
+
+
+class TpuMetricsReporter:
+    """Lazily-connected pusher; no-op when the task env is absent (direct
+    script runs outside the orchestrator)."""
+
+    def __init__(self, env: Optional[dict] = None):
+        e = env if env is not None else os.environ
+        self._host = e.get(C.AM_HOST)
+        port = e.get(C.METRICS_RPC_PORT) or e.get(C.AM_PORT)
+        self._port = int(port) if port else 0
+        self._task_type = e.get(C.JOB_NAME, "")
+        self._index = int(e.get(C.TASK_INDEX, "0"))
+        self._token = e.get("TONY_SECURITY_TOKEN") or None
+        self._client = None
+        self._enabled = bool(self._host and self._port and self._task_type)
+
+    def report(self) -> None:
+        if not self._enabled:
+            return
+        metrics = tpu_memory_metrics()
+        if not metrics:
+            return
+        try:
+            if self._client is None:
+                from tony_tpu.rpc.client import MetricsServiceClient
+                self._client = MetricsServiceClient(
+                    self._host, self._port, auth_token=self._token)
+            self._client.call("update_metrics", {
+                "task_type": self._task_type, "index": self._index,
+                "metrics": metrics}, retries=1, timeout_sec=5.0,
+                wait_for_ready=False)
+        except Exception:  # noqa: BLE001 — metrics never break training
+            LOG.debug("tpu metrics push failed", exc_info=True)

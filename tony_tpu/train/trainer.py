@@ -85,6 +85,8 @@ class Trainer:
     def setup(self) -> None:
         maybe_initialize_distributed()
         self._maybe_start_profiler()
+        from tony_tpu.train.metrics import TpuMetricsReporter
+        self._metrics_reporter = TpuMetricsReporter()
         self.mesh = mesh_from_env()
         LOG.info("mesh: %s over %d devices", dict(self.mesh.shape),
                  self.mesh.devices.size)
@@ -152,6 +154,7 @@ class Trainer:
                         {"step": self.step, "loss": loss_f, "elapsed_s": dt})
                     LOG.info("step %d loss %.4f (%.1fs)", self.step, loss_f,
                              dt)
+                    self._metrics_reporter.report()
                 if (cfg.checkpoint_dir and cfg.checkpoint_every
                         and self.step % cfg.checkpoint_every == 0):
                     self._checkpoint()

@@ -27,7 +27,7 @@ def _git_ref() -> str:
             capture_output=True, text=True, timeout=5,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         return out.stdout.strip() if out.returncode == 0 else "unknown"
-    except OSError:
+    except (OSError, subprocess.SubprocessError):  # incl. TimeoutExpired
         return "unknown"
 
 
