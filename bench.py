@@ -13,12 +13,35 @@ falls back to the tiny config so the script still completes.
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
 from functools import partial
 
-import jax
-import jax.numpy as jnp
-import optax
+# Watchdog BEFORE importing jax: a wedged TPU tunnel can hang backend init
+# indefinitely; the driver must still get one JSON line.
+WATCHDOG_SEC = float(os.environ.get("TONY_BENCH_WATCHDOG_SEC", "480"))
+
+
+def _watchdog_fire():
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu_single_chip",
+        "value": 0.0,
+        "unit": "%MFU",
+        "vs_baseline": 0.0,
+        "error": f"tpu backend/compile did not complete in {WATCHDOG_SEC:.0f}s"
+                 " (tunnel wedged?)",
+    }), flush=True)
+    os._exit(0)
+
+
+_watchdog = threading.Timer(WATCHDOG_SEC, _watchdog_fire)
+_watchdog.daemon = True
+_watchdog.start()
+
+import jax                     # noqa: E402
+import jax.numpy as jnp        # noqa: E402
+import optax                   # noqa: E402
 
 # bf16 peak FLOPs/s per chip by device kind substring (public specs).
 PEAK_FLOPS = (
@@ -87,6 +110,7 @@ def main() -> None:
     flops_s = tok_s * config.flops_per_token(seq)
     mfu_pct = 100.0 * flops_s / peak_flops(dev)
 
+    _watchdog.cancel()
     print(json.dumps({
         "metric": "llama_pretrain_mfu_single_chip",
         "value": round(mfu_pct, 2),

@@ -41,6 +41,8 @@ def main() -> int:
                         help="0 = the preset's max_seq")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=0)
+    parser.add_argument("--data", default="",
+                        help="raw int32 token shard; synthetic when empty")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -49,10 +51,15 @@ def main() -> int:
     process_index = int(os.environ.get("JAX_PROCESS_ID", "0"))
 
     def clipped_tokens():
-        for batch in synthetic_tokens(args.batch_size, seq,
-                                      config.vocab_size,
-                                      process_index=process_index):
-            yield batch
+        if args.data:
+            # native prefetching mmap loader (falls back to numpy)
+            from tony_tpu.train.native_data import token_batches
+            yield from token_batches(args.data, args.batch_size, seq,
+                                     seed=process_index)
+        else:
+            yield from synthetic_tokens(args.batch_size, seq,
+                                        config.vocab_size,
+                                        process_index=process_index)
 
     trainer = Trainer(
         loss_fn=partial(llama_loss, config=config),

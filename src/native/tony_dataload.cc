@@ -1,0 +1,185 @@
+// libtony_data: memory-mapped token-shard batch loader with prefetch.
+//
+// Native data plane for the training runtime (the reference delegated input
+// pipelines to user scripts; this is the TPU-first equivalent of a
+// host-side loader feeding the device: mmap'd int32 token shards, random
+// crops assembled into (batch, seq+1) arrays by a background thread into a
+// double buffer, so the host batch is ready before the device finishes the
+// step). Exposed as a C ABI for ctypes (no pybind11 in the image);
+// tony_tpu/train/native_data.py wraps it with a pure-numpy fallback.
+//
+// File format: raw little-endian int32 tokens, no header.
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Loader {
+  const int32_t* tokens = nullptr;
+  size_t n_tokens = 0;
+  size_t map_len = 0;
+  long batch = 0;
+  long seq = 0;          // yields rows of seq+1 tokens (inputs+shifted)
+  uint64_t rng = 0;
+  // double buffer: the worker only writes buf[i] while !filled[i]; the
+  // consumer only reads buf[i] while filled[i] — so fills and copies never
+  // touch the same buffer concurrently. Both sides walk 0,1,0,1,...
+  int32_t* buf[2] = {nullptr, nullptr};
+  bool filled[2] = {false, false};
+  int prod = 0;          // next buffer the worker fills
+  int cons = 0;          // next buffer tdl_next consumes
+  bool stop = false;
+  pthread_t worker{};
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  pthread_cond_t cv = PTHREAD_COND_INITIALIZER;
+
+  size_t row_len() const { return static_cast<size_t>(seq) + 1; }
+  size_t batch_elems() const { return static_cast<size_t>(batch) * row_len(); }
+};
+
+uint64_t NextRand(uint64_t* s) {  // xorshift64*
+  uint64_t x = *s;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  *s = x;
+  return x * 0x2545F4914F6CDD1DULL;
+}
+
+void FillBatch(Loader* l, int32_t* out) {
+  const size_t row = l->row_len();
+  const size_t max_start = l->n_tokens - row;
+  for (long b = 0; b < l->batch; ++b) {
+    size_t start = static_cast<size_t>(NextRand(&l->rng) % (max_start + 1));
+    memcpy(out + static_cast<size_t>(b) * row, l->tokens + start,
+           row * sizeof(int32_t));
+  }
+}
+
+void* WorkerMain(void* arg) {
+  Loader* l = static_cast<Loader*>(arg);
+  for (;;) {
+    pthread_mutex_lock(&l->mu);
+    while (!l->stop && l->filled[l->prod]) {
+      pthread_cond_wait(&l->cv, &l->mu);
+    }
+    if (l->stop) {
+      pthread_mutex_unlock(&l->mu);
+      return nullptr;
+    }
+    int which = l->prod;
+    pthread_mutex_unlock(&l->mu);
+
+    FillBatch(l, l->buf[which]);  // exclusive: !filled[which]
+
+    pthread_mutex_lock(&l->mu);
+    l->filled[which] = true;
+    l->prod = which ^ 1;
+    pthread_cond_broadcast(&l->cv);
+    pthread_mutex_unlock(&l->mu);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tdl_open(const char* path, long batch, long seq, long seed) {
+  if (batch <= 0 || seq <= 0) return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st{};
+  if (fstat(fd, &st) != 0 || st.st_size <= 0) {
+    close(fd);
+    return nullptr;
+  }
+  auto* l = new Loader();
+  l->map_len = static_cast<size_t>(st.st_size);
+  l->n_tokens = l->map_len / sizeof(int32_t);
+  l->batch = batch;
+  l->seq = seq;
+  l->rng = static_cast<uint64_t>(seed) * 0x9E3779B97F4A7C15ULL + 1;
+  if (l->n_tokens < l->row_len()) {
+    close(fd);
+    delete l;
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, l->map_len, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    delete l;
+    return nullptr;
+  }
+  madvise(mem, l->map_len, MADV_RANDOM);
+  l->tokens = static_cast<const int32_t*>(mem);
+  l->buf[0] = static_cast<int32_t*>(
+      malloc(l->batch_elems() * sizeof(int32_t)));
+  l->buf[1] = static_cast<int32_t*>(
+      malloc(l->batch_elems() * sizeof(int32_t)));
+  if (l->buf[0] == nullptr || l->buf[1] == nullptr) {
+    free(l->buf[0]);
+    free(l->buf[1]);
+    munmap(mem, l->map_len);
+    delete l;
+    return nullptr;
+  }
+  if (pthread_create(&l->worker, nullptr, WorkerMain, l) != 0) {
+    // no worker -> tdl_next would deadlock; fail open so the Python side
+    // falls back to the numpy loader
+    munmap(const_cast<int32_t*>(l->tokens), l->map_len);
+    free(l->buf[0]);
+    free(l->buf[1]);
+    delete l;
+    return nullptr;
+  }
+  return l;
+}
+
+// Copies the next (batch, seq+1) int32 batch into `out`; returns 0 ok.
+// Single-consumer: call from one thread.
+int tdl_next(void* handle, int32_t* out) {
+  auto* l = static_cast<Loader*>(handle);
+  if (l == nullptr) return -1;
+  pthread_mutex_lock(&l->mu);
+  int which = l->cons;
+  while (!l->filled[which]) pthread_cond_wait(&l->cv, &l->mu);
+  pthread_mutex_unlock(&l->mu);
+
+  // exclusive while filled[which]: the worker never writes a filled buffer
+  memcpy(out, l->buf[which], l->batch_elems() * sizeof(int32_t));
+
+  pthread_mutex_lock(&l->mu);
+  l->filled[which] = false;
+  l->cons = which ^ 1;
+  pthread_cond_broadcast(&l->cv);
+  pthread_mutex_unlock(&l->mu);
+  return 0;
+}
+
+long tdl_num_tokens(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  return l == nullptr ? -1 : static_cast<long>(l->n_tokens);
+}
+
+void tdl_close(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  if (l == nullptr) return;
+  pthread_mutex_lock(&l->mu);
+  l->stop = true;
+  pthread_cond_broadcast(&l->cv);
+  pthread_mutex_unlock(&l->mu);
+  pthread_join(l->worker, nullptr);
+  munmap(const_cast<int32_t*>(l->tokens), l->map_len);
+  free(l->buf[0]);
+  free(l->buf[1]);
+  delete l;
+}
+
+}  // extern "C"

@@ -11,6 +11,7 @@ compiled into prod code (Constants.java:116-121).
 from __future__ import annotations
 
 import json
+import time
 import os
 import sys
 
@@ -358,3 +359,48 @@ def _dump_logs(client: TonyClient) -> str:
                 except OSError:
                     pass
     return "\n".join(chunks)[-8000:]
+
+
+def test_notebook_path_proxies_to_single_node_app(tmp_path):
+    """Notebook flow (reference: NotebookSubmitter.java:71-133 +
+    ApplicationMaster.java:717-726): single-node app binds $TB_PORT, the
+    URL appears in TaskInfos, and a local proxy relays to it."""
+    import threading
+    import urllib.request
+
+    from tony_tpu.proxy import ProxyServer
+
+    conf = fast_conf(tmp_path)
+    conf.set(K.APPLICATION_SINGLE_NODE, True, "test")
+    client = TonyClient(conf)
+    client.init(["--executes", script("fake_notebook.py")])
+
+    result = {}
+
+    def _run():
+        result["ok"] = client.run()
+
+    t = threading.Thread(target=_run, daemon=True)
+    t.start()
+    url = None
+    for _ in range(200):
+        for info in client.get_task_infos():
+            if info.url.startswith("http://"):
+                url = info.url
+                break
+        if url:
+            break
+        time.sleep(0.1)
+    assert url, "notebook URL never appeared in TaskInfos"
+    hostport = url[len("http://"):].split("/", 1)[0]
+    host, _, port = hostport.rpartition(":")
+    proxy = ProxyServer(host, int(port))
+    proxy.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{proxy.local_port}/", timeout=10) as resp:
+            assert resp.read() == b"NOTEBOOK_OK"
+    finally:
+        proxy.stop()
+    t.join(timeout=60)
+    assert result.get("ok") is True

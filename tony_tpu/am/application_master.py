@@ -396,11 +396,27 @@ class ApplicationMaster(ClusterServiceHandler):
             C.APP_ID: self.app_id,
             C.TONY_APP_DIR: self.app_dir,
         }
-        with open(os.path.join(log_dir, "stdout"), "ab") as out, \
-                open(os.path.join(log_dir, "stderr"), "ab") as err:
-            self._preprocess_exit_code = execute_shell(
-                command, extra_env=env, cwd=self.app_dir,
-                stdout=out, stderr=err)
+        reservation = None
+        if self._single_node:
+            # notebook path: reserve the UI port on the AM host, hand it to
+            # the command via TB_PORT, and surface the URL in TaskInfos so
+            # the NotebookSubmitter can proxy to it (reference:
+            # ApplicationMaster.java:717-726 + NotebookSubmitter.java:107-130)
+            from tony_tpu.utils.ports import reserve_port
+            reservation = reserve_port()
+            env[C.TB_PORT] = str(reservation.port)
+            self._tb_url = f"http://{self.host}:{reservation.port}"
+        try:
+            with open(os.path.join(log_dir, "stdout"), "ab") as out, \
+                    open(os.path.join(log_dir, "stderr"), "ab") as err:
+                if reservation is not None:
+                    reservation.release()  # user process binds it now
+                self._preprocess_exit_code = execute_shell(
+                    command, extra_env=env, cwd=self.app_dir,
+                    stdout=out, stderr=err)
+        finally:
+            if reservation is not None:
+                reservation.release()
         self._preprocess_finished = True
 
     # ------------------------------------------------------------------

@@ -84,13 +84,16 @@ def default_tpu_sampler() -> dict[str, float]:
     if jax_mod is None:
         return {}
     try:
-        devs = [d for d in jax_mod.local_devices() if d.platform == "tpu"]
-        if not devs:
+        # guard on an ALREADY-INITIALIZED backend, not mere import:
+        # local_devices() on an uninitialized jax would claim the TPU from
+        # this monitor thread and break the training subprocess's init
+        from jax._src import xla_bridge
+        if not xla_bridge._backends:
             return {}
-        hbm = 0
-        for d in devs:
-            stats = d.memory_stats() or {}
-            hbm += int(stats.get("bytes_in_use", 0))
+        from tony_tpu.train.metrics import sum_tpu_hbm
+        hbm, _ = sum_tpu_hbm(jax_mod.local_devices())
+        if not hbm:
+            return {}
         return {"hbm_bytes": float(hbm)}
     except Exception:  # noqa: BLE001 — never break metrics for stats
         return {}

@@ -245,6 +245,54 @@ def llama_forward(params: Params, tokens: jax.Array,
     return constrain(logits, ("batch", "seq", "vocab"))
 
 
+def llama_forward_pipelined(params: Params, tokens: jax.Array,
+                            config: LlamaConfig, mesh, n_micro: int
+                            ) -> jax.Array:
+    """Pipeline-parallel forward: the L layers are split into pp stages
+    (mesh's pp axis size), microbatches flow through the GPipe schedule
+    (parallel/pipeline.py), embedding + head run replicated on every rank.
+    Requires n_layers % pp == 0 and batch % n_micro == 0. Stage weights are
+    sharded on pp only here; combining pp with tp/fsdp inside a stage is
+    future work (the specs would need the logical rules merged in)."""
+    from tony_tpu.parallel.pipeline import make_pipelined_fn
+
+    pp = dict(mesh.shape).get("pp", 1)
+    L = config.n_layers
+    if L % pp != 0:
+        raise ValueError(f"n_layers {L} not divisible by pp={pp}")
+    s = tokens.shape[1]
+    cos, sin = rope_frequencies(config.head_dim, s, config.rope_theta)
+
+    block = partial(_block, config, cos, sin)
+    if config.remat:
+        block = jax.checkpoint(block)
+
+    def stage_fn(stage_layers, x):
+        # scan this stage's L/pp layers (leading dim of stage_layers)
+        x, _ = lax.scan(lambda x, layer: (block(x, layer), None),
+                        x, stage_layers)
+        return x
+
+    # (L, ...) -> (pp, L/pp, ...): leading stage dim sharded on pp
+    staged_layers = jax.tree.map(
+        lambda p: p.reshape((pp, L // pp) + p.shape[1:]), params["layers"])
+
+    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
+    pipe = make_pipelined_fn(stage_fn, mesh, n_micro=n_micro)
+    x = pipe(staged_layers, x)
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
+                      params["output"].astype(jnp.float32))
+
+
+def llama_loss_pipelined(params: Params, batch: dict[str, jax.Array],
+                         config: LlamaConfig, mesh,
+                         n_micro: int) -> jax.Array:
+    inputs, targets = unpack_lm_batch(batch)
+    logits = llama_forward_pipelined(params, inputs, config, mesh, n_micro)
+    return cross_entropy(logits, targets)
+
+
 def unpack_lm_batch(batch: dict[str, jax.Array]
                     ) -> tuple[jax.Array, jax.Array]:
     """{'tokens': (B,S+1)} or {'inputs','targets'} -> (inputs, targets)."""

@@ -84,11 +84,29 @@ def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],
 def constrain(x, logical_axes: Sequence[Optional[str]],
               rules=DEFAULT_RULES):
     """with_sharding_constraint against the ambient (set_mesh) mesh; no-op
-    when no mesh is active so model code is mesh-agnostic."""
+    when no mesh is active so model code is mesh-agnostic. Axes the ambient
+    context holds Manually (inside shard_map) are dropped from the spec —
+    with_sharding_constraint may only reference Auto axes there."""
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return x
+    manual = {name for name, t in zip(mesh.axis_names,
+                                      getattr(mesh, "axis_types", ()))
+              if "Manual" in str(t)}
     spec = logical_to_mesh_axes(logical_axes, rules, mesh)
+    if manual:
+        cleaned = []
+        for entry in spec:
+            if isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a not in manual)
+                cleaned.append(kept if kept else None)
+            else:
+                cleaned.append(None if entry in manual else entry)
+        while cleaned and cleaned[-1] is None:
+            cleaned.pop()
+        if not any(cleaned):
+            return x
+        spec = P(*cleaned)
     return jax.lax.with_sharding_constraint(x, spec)
 
 

@@ -18,22 +18,30 @@ from tony_tpu import constants as C
 LOG = logging.getLogger(__name__)
 
 
+def sum_tpu_hbm(devices) -> tuple[int, int]:
+    """(bytes_in_use, bytes_limit) summed over the TPU devices given —
+    the single implementation shared with the executor-side sampler."""
+    hbm = 0
+    limit = 0
+    for d in devices:
+        if d.platform != "tpu":
+            continue
+        stats = d.memory_stats() or {}
+        hbm += int(stats.get("bytes_in_use", 0))
+        limit += int(stats.get("bytes_limit", 0))
+    return hbm, limit
+
+
 def tpu_memory_metrics() -> list[dict]:
     """Current-process TPU HBM usage as metric dicts ([] off-TPU)."""
     import jax
 
     try:
-        devs = [d for d in jax.local_devices() if d.platform == "tpu"]
+        hbm, limit = sum_tpu_hbm(jax.local_devices())
     except RuntimeError:
         return []
-    if not devs:
+    if not hbm and not limit:
         return []
-    hbm = 0
-    limit = 0
-    for d in devs:
-        stats = d.memory_stats() or {}
-        hbm += int(stats.get("bytes_in_use", 0))
-        limit += int(stats.get("bytes_limit", 0))
     metrics = [{"name": "TPU_HBM_BYTES_IN_USE", "value": float(hbm)}]
     if limit:
         metrics.append({"name": "TPU_HBM_BYTES_LIMIT", "value": float(limit)})
@@ -49,9 +57,10 @@ class TpuMetricsReporter:
         self._host = e.get(C.AM_HOST)
         port = e.get(C.METRICS_RPC_PORT) or e.get(C.AM_PORT)
         self._port = int(port) if port else 0
+        from tony_tpu.security.tokens import TOKEN_ENV
         self._task_type = e.get(C.JOB_NAME, "")
         self._index = int(e.get(C.TASK_INDEX, "0"))
-        self._token = e.get("TONY_SECURITY_TOKEN") or None
+        self._token = e.get(TOKEN_ENV) or None
         self._client = None
         self._enabled = bool(self._host and self._port and self._task_type)
 

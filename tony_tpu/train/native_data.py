@@ -1,0 +1,139 @@
+"""Token-shard data loader: native prefetching mmap reader + numpy fallback.
+
+The TPU-first host data plane: training batches come from raw int32 token
+shards on disk. The native path (src/native/tony_dataload.cc via ctypes —
+no pybind11 in the image) memory-maps the shard and assembles random-crop
+batches on a background thread into a double buffer, so `next()` is a
+memcpy and the host never stalls the device step. The fallback is the same
+sampling in numpy (identical distribution, different RNG stream).
+
+File format: raw little-endian int32 tokens. `write_token_file` creates
+shards; `token_batches(path, batch, seq)` yields {'tokens': (B, S+1)}
+batches compatible with the models' `unpack_lm_batch`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from tony_tpu.utils.native import native_binary
+
+LOG = logging.getLogger(__name__)
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> str:
+    arr = np.ascontiguousarray(tokens, dtype=np.int32)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        arr.tofile(f)
+    os.replace(tmp, path)
+    return path
+
+
+class _NativeLoader:
+    def __init__(self, lib: ctypes.CDLL, path: str, batch: int, seq: int,
+                 seed: int):
+        self._lib = lib
+        self._handle = lib.tdl_open(path.encode(), batch, seq, seed)
+        if not self._handle:
+            raise OSError(f"tdl_open failed for {path}")
+        self._batch, self._seq = batch, seq
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict[str, np.ndarray]:
+        out = np.empty((self._batch, self._seq + 1), np.int32)
+        rc = self._lib.tdl_next(
+            self._handle, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise RuntimeError("tdl_next failed")
+        return {"tokens": out}
+
+    def num_tokens(self) -> int:
+        return int(self._lib.tdl_num_tokens(self._handle))
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tdl_close(self._handle)
+            self._handle = None
+
+    # release the worker thread/mmap/buffers when the iterator is dropped
+    # (trainers recreate data iterators on resume)
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_lib_cache: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib_cache, _lib_failed
+    if _lib_cache is not None or _lib_failed:
+        return _lib_cache
+    path = native_binary("libtony_data.so")
+    if path is None:
+        _lib_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.tdl_open.restype = ctypes.c_void_p
+        lib.tdl_open.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                 ctypes.c_long, ctypes.c_long]
+        lib.tdl_next.restype = ctypes.c_int
+        lib.tdl_next.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_int32)]
+        lib.tdl_num_tokens.restype = ctypes.c_long
+        lib.tdl_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.tdl_close.restype = None
+        lib.tdl_close.argtypes = [ctypes.c_void_p]
+        _lib_cache = lib
+    except OSError:
+        LOG.warning("could not load libtony_data.so; numpy fallback")
+        _lib_failed = True
+    return _lib_cache
+
+
+def _numpy_batches(path: str, batch: int, seq: int, seed: int
+                   ) -> Iterator[dict[str, np.ndarray]]:
+    tokens = np.memmap(path, dtype=np.int32, mode="r")
+    row = seq + 1
+    if len(tokens) < row:
+        raise ValueError(f"{path}: {len(tokens)} tokens < seq+1={row}")
+    rng = np.random.default_rng(seed)
+    max_start = len(tokens) - row
+    while True:
+        starts = rng.integers(0, max_start + 1, batch)
+        out = np.stack([tokens[s:s + row] for s in starts])
+        yield {"tokens": np.ascontiguousarray(out, np.int32)}
+
+
+def token_batches(path: str, batch: int, seq: int, seed: int = 0,
+                  prefer_native: bool = True
+                  ) -> Iterator[dict[str, np.ndarray]]:
+    """Infinite {'tokens': (batch, seq+1)} stream from a token shard;
+    native prefetching loader when available, numpy memmap otherwise."""
+    if prefer_native:
+        lib = _load_lib()
+        if lib is not None:
+            try:
+                return iter(_NativeLoader(lib, path, batch, seq, seed))
+            except OSError:
+                LOG.warning("native loader rejected %s; numpy fallback",
+                            path)
+    return _numpy_batches(path, batch, seq, seed)
