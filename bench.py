@@ -6,42 +6,31 @@ Prints ONE JSON line:
 The reference publishes no benchmark numbers (BASELINE.md); the driver's
 north star is >=40% MFU on the Llama JAX pretrain, so `vs_baseline` is
 MFU / 40%. On TPU this runs the llama3_1b_proxy config in bf16 (pallas
-flash attention, remat, donated buffers); on CPU (dev machines / CI) it
-falls back to the tiny config so the script still completes.
+flash attention, remat, donated buffers) and additionally times one
+8B-shaped layer (VERDICT r1 item 10) so the 1B->8B extrapolation is
+grounded; on CPU it falls back to the tiny config.
+
+Round-1 failure mode: the axon TPU tunnel wedged inside PJRT backend
+init and the in-process watchdog could only report "tunnel wedged?"
+(BENCH_r01.json, VERDICT Weak #1). This version runs the measurement in
+a supervised CHILD process: the parent is pure stdlib (cannot hang on
+backend init), gives the child a deadline, captures its stderr progress
+markers + faulthandler stack dump for a precise diagnosis, retries the
+TPU attempt once, and finally falls back to a CPU-backend child so the
+driver always receives a real measurement plus a pinpointed tpu_error.
 """
 
 from __future__ import annotations
 
 import json
 import os
-import threading
+import signal
+import subprocess
+import sys
 import time
-from functools import partial
 
-# Watchdog BEFORE importing jax: a wedged TPU tunnel can hang backend init
-# indefinitely; the driver must still get one JSON line.
-WATCHDOG_SEC = float(os.environ.get("TONY_BENCH_WATCHDOG_SEC", "480"))
-
-
-def _watchdog_fire():
-    print(json.dumps({
-        "metric": "llama_pretrain_mfu_single_chip",
-        "value": 0.0,
-        "unit": "%MFU",
-        "vs_baseline": 0.0,
-        "error": f"tpu backend/compile did not complete in {WATCHDOG_SEC:.0f}s"
-                 " (tunnel wedged?)",
-    }), flush=True)
-    os._exit(0)
-
-
-_watchdog = threading.Timer(WATCHDOG_SEC, _watchdog_fire)
-_watchdog.daemon = True
-_watchdog.start()
-
-import jax                     # noqa: E402
-import jax.numpy as jnp        # noqa: E402
-import optax                   # noqa: E402
+BUDGET_SEC = float(os.environ.get("TONY_BENCH_WATCHDOG_SEC", "480"))
+METRIC = "llama_pretrain_mfu_single_chip"
 
 # bf16 peak FLOPs/s per chip by device kind substring (public specs).
 PEAK_FLOPS = (
@@ -57,23 +46,65 @@ CPU_PEAK = 1e11            # nominal, keeps MFU finite on dev machines
 
 
 def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    if device.platform != "tpu":
+    # The axon tunnel's devices report platform "axon" but are real TPU
+    # chips (canonical platform "tpu") — both must take the TPU branch or
+    # the %MFU denominator is the nominal CPU peak (2000x inflation).
+    if device.platform not in ("tpu", "axon"):
         return CPU_PEAK
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    if device.platform == "axon":
+        # tunneled devices may not expose a real device_kind; the gen the
+        # tunnel was brought up with is authoritative
+        kind = (os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+                or kind)
     for sub, peak in PEAK_FLOPS:
         if sub in kind:
             return peak
     return DEFAULT_PEAK
 
 
-def main() -> None:
-    from tony_tpu.models.llama import (
-        get_config, llama_init, llama_loss,
-    )
+# ---------------------------------------------------------------------------
+# child: the actual measurement (runs under a parent-enforced deadline)
+# ---------------------------------------------------------------------------
+
+_T0 = time.monotonic()
+
+
+def _mark(msg: str) -> None:
+    """Progress marker on stderr — the parent's diagnosis tail."""
+    print(f"[bench +{time.monotonic() - _T0:.1f}s] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def child_main(backend: str) -> None:
+    import faulthandler
+    faulthandler.enable()
+    # If the parent SIGTERMs us (deadline), dump stacks first so the
+    # parent can report WHERE init/compile wedged.
+    faulthandler.register(signal.SIGTERM, all_threads=True, chain=False)
+
+    from functools import partial
+
+    _mark("importing jax")
+    import jax
+    if backend == "cpu":
+        # See __graft_entry__._force_cpu_backend: a sitecustomize may
+        # have forced jax_platforms=axon,cpu; re-update after it.
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from tony_tpu.models.llama import get_config, llama_init, llama_loss
     from tony_tpu.train.step import make_train_step
 
+    _mark("initializing backend (first device touch)")
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
+    # The axon tunnel canonicalizes to the tpu platform but its devices
+    # may report platform "axon"; treat non-cpu as the accelerator.
+    on_tpu = dev.platform in ("tpu", "axon")
+    _mark(f"backend up: platform={dev.platform} "
+          f"kind={getattr(dev, 'device_kind', '?')}")
+
     if on_tpu:
         config = get_config("llama3_1b_proxy")
         batch_size, seq, steps, warmup = 4, 4096, 10, 2
@@ -95,10 +126,12 @@ def main() -> None:
     # End each timed region with a device->host transfer of the loss: on
     # tunneled/experimental platforms block_until_ready alone may return
     # before the computation finishes, but a host read cannot.
+    _mark("compiling + warmup")
     for _ in range(warmup):
         params, opt_state, loss = train_step(params, opt_state, batch)
     float(loss)
 
+    _mark("timing")
     t0 = time.monotonic()
     for _ in range(steps):
         params, opt_state, loss = train_step(params, opt_state, batch)
@@ -110,9 +143,8 @@ def main() -> None:
     flops_s = tok_s * config.flops_per_token(seq)
     mfu_pct = 100.0 * flops_s / peak_flops(dev)
 
-    _watchdog.cancel()
-    print(json.dumps({
-        "metric": "llama_pretrain_mfu_single_chip",
+    result = {
+        "metric": METRIC,
         "value": round(mfu_pct, 2),
         "unit": "%MFU",
         "vs_baseline": round(mfu_pct / 40.0, 3),
@@ -122,8 +154,158 @@ def main() -> None:
         "batch_tokens": tokens_per_step,
         "device": getattr(dev, "device_kind", dev.platform),
         "final_loss": round(final_loss, 4),
-    }))
+    }
+
+    if on_tpu:
+        try:
+            result.update(_bench_8b_layer(jax, jnp, optax, dev))
+        except Exception as e:  # metadata only — never sink the headline
+            _mark(f"8b layer bench failed: {type(e).__name__}: {e}")
+            result["llama3_8b_layer_error"] = f"{type(e).__name__}: {e}"
+
+    print(json.dumps(result), flush=True)
+
+
+def _bench_8b_layer(jax, jnp, optax, dev) -> dict:
+    """Time ONE 8B-shaped Llama layer's train step (VERDICT item 10).
+
+    The full 8B model (16 GB params in bf16 + optimizer state) cannot
+    fit a single v5e chip, so the grounded extrapolation is per-layer:
+    run the exact 8B layer geometry (dim 4096 / ffn 14336 / 32 heads /
+    8 kv heads, seq 4096) and report measured ms plus a x32-layers
+    estimate. Small vocab keeps the embed/head from dominating what is
+    a layer-geometry measurement.
+    """
+    from functools import partial
+
+    from tony_tpu.models.llama import get_config, llama_init, llama_loss
+    from tony_tpu.train.step import make_train_step
+
+    _mark("timing 8B-shaped single layer")
+    config = get_config("llama3_8b", n_layers=1, vocab_size=8192,
+                        max_seq=4096)
+    params = llama_init(config, jax.random.PRNGKey(2))
+    optimizer = optax.adamw(3e-4)
+    step = make_train_step(partial(llama_loss, config=config), optimizer)
+    opt_state = jax.jit(optimizer.init)(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 4096), 0,
+                                config.vocab_size, jnp.int32)
+    batch = {"inputs": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+    for _ in range(2):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)
+    t0 = time.monotonic()
+    n = 5
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, batch)
+    float(loss)
+    layer_ms = (time.monotonic() - t0) / n * 1000.0
+    flops = 4096 * config.flops_per_token(4096)  # batch 1 x seq 4096
+    return {
+        "llama3_8b_layer_step_ms": round(layer_ms, 2),
+        "llama3_8b_layer_mfu_pct": round(
+            100.0 * flops / (layer_ms / 1e3) / peak_flops(dev), 2),
+        "llama3_8b_est_32layer_step_ms": round(layer_ms * 32, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# parent: supervise, diagnose, retry, fall back
+# ---------------------------------------------------------------------------
+
+def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
+    """Run one measurement child. Returns (result_json_or_None, diag)."""
+    env = dict(os.environ)
+    if backend == "cpu":
+        # Never let a CPU child (or its jax import) claim the tunnel.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", backend],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    try:
+        out, err = proc.communicate(timeout=deadline)
+        timed_out = False
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.send_signal(signal.SIGTERM)   # triggers faulthandler dump
+        try:
+            out, err = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+    tail = "\n".join(err.strip().splitlines()[-12:])
+    if not timed_out and proc.returncode == 0:
+        for line in reversed(out.strip().splitlines()):
+            try:
+                return json.loads(line), tail
+            except ValueError:
+                continue
+        return None, f"child exited 0 without JSON; stderr tail:\n{tail}"
+    marks = [ln for ln in err.splitlines() if ln.startswith("[bench ")]
+    last = marks[-1] if marks else "(no progress marker)"
+    state = (f"timed out after {deadline:.0f}s" if timed_out
+             else f"exited rc={proc.returncode}")
+    return None, (f"{backend} child {state}; last progress: {last}; "
+                  f"stderr tail:\n{tail}")
+
+
+def main() -> None:
+    # The whole supervised run must finish INSIDE the budget even when
+    # every child eats its full deadline plus the 15s SIGTERM->SIGKILL
+    # grace: a driver enforcing the same budget externally would SIGKILL
+    # the parent mid-run and get no JSON at all (round 1's rc=124 mode).
+    t_start = time.monotonic()
+    grace = 20.0   # per-child kill grace + spawn overhead
+    reserve = 3 * grace + 15.0
+    usable = max(60.0, BUDGET_SEC - reserve)
+    diags: list[str] = []
+
+    # Attempt 1 + retry on the real accelerator.
+    for attempt, frac in ((1, 0.45), (2, 0.3)):
+        remaining = usable - (time.monotonic() - t_start)
+        if attempt > 1 and remaining < 75.0:
+            diags.append("retry skipped: budget too small")
+            break
+        deadline = max(15.0, min(frac * usable, remaining - 45.0))
+        result, diag = _run_child("tpu", deadline)
+        if result is not None:
+            if diags:
+                result["retries"] = attempt - 1
+            print(json.dumps(result), flush=True)
+            return
+        diags.append(f"attempt {attempt}: {diag}")
+        print(f"[bench parent] {diags[-1]}", file=sys.stderr, flush=True)
+
+    # TPU is wedged: measure on CPU so the driver still gets real data,
+    # and report the TPU fault precisely.
+    remaining = usable - (time.monotonic() - t_start)
+    result, diag = _run_child("cpu", max(15.0, remaining))
+    tpu_error = " || ".join(diags)[-1500:]
+    if result is not None:
+        result.update({
+            "value": 0.0, "vs_baseline": 0.0,
+            "error": "tpu backend init/compile wedged; cpu-backend "
+                     "fallback measurement in cpu_* fields",
+            "tpu_error": tpu_error,
+            "cpu_tokens_per_sec": result.pop("tokens_per_sec_per_chip",
+                                             None),
+            "cpu_step_time_s": result.pop("step_time_s", None),
+        })
+        print(json.dumps(result), flush=True)
+        return
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "%MFU",
+        "vs_baseline": 0.0,
+        "error": "tpu wedged AND cpu fallback failed",
+        "tpu_error": tpu_error, "cpu_error": diag[-800:],
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        child_main(sys.argv[2])
+    else:
+        main()

@@ -89,6 +89,23 @@ int main(int argc, char** argv) {
       fds.push_back(fd);
     }
   }
+  // Install handlers and BLOCK the stop signals BEFORE the readiness
+  // sentinel is visible: a supervisor that reacts to the sentinel with an
+  // immediate terminate() must find the handler already in place (round-1
+  // flake: default SIGTERM action killed the helper with rc -15). Blocking
+  // also closes the lost-wakeup race of `while (!g_stop) pause()` — the
+  // signal can only be delivered inside sigsuspend below.
+  struct sigaction sa{};
+  sa.sa_handler = HandleStop;
+  sigemptyset(&sa.sa_mask);
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+  sigset_t block, old;
+  sigemptyset(&block);
+  sigaddset(&block, SIGTERM);
+  sigaddset(&block, SIGINT);
+  sigprocmask(SIG_BLOCK, &block, &old);
+
   for (int fd : fds) printf("%d\n", BoundPort(fd));
   fflush(stdout);
 
@@ -101,9 +118,13 @@ int main(int argc, char** argv) {
   }
   fclose(f);
 
-  signal(SIGTERM, HandleStop);
-  signal(SIGINT, HandleStop);
-  while (!g_stop) pause();
+  // Atomically unblock + wait: a SIGTERM delivered at any point since the
+  // sigprocmask above is seen either before the loop (g_stop already 1) or
+  // by sigsuspend itself — never lost.
+  sigset_t wait_mask = old;
+  sigdelset(&wait_mask, SIGTERM);
+  sigdelset(&wait_mask, SIGINT);
+  while (!g_stop) sigsuspend(&wait_mask);
   for (int fd : fds) close(fd);
   return 0;
 }

@@ -44,10 +44,15 @@ def test_enabled_without_image_is_noop():
 
 def test_wrap_command():
     argv = docker_wrap_command(
-        "img:1", ["python", "train.py"], {"RANK": "0"},
+        "img:1", ["python", "train.py"],
+        {"RANK": "0", "TONY_SECURITY_TOKEN": "s3cret"},
         mounts="/data:/mnt,/tmp", workdir="/job")
     assert argv[:4] == ["docker", "run", "--rm", "--network=host"]
     assert "-w" in argv and "/job" in argv
     assert "-v" in argv and "/data:/mnt" in argv and "/tmp:/tmp" in argv
-    assert "-e" in argv and "RANK=0" in argv
+    # pass-through form: names only — secrets must never land in argv
+    # (world-readable /proc/<pid>/cmdline for the container's lifetime)
+    assert "RANK" in argv and "TONY_SECURITY_TOKEN" in argv
+    assert not any("s3cret" in a or "=" in a for a in argv
+                   if a.startswith(("RANK", "TONY_")))
     assert argv[-3:] == ["img:1", "python", "train.py"]

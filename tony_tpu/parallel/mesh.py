@@ -129,13 +129,25 @@ def make_hybrid_mesh(plan: MeshPlan, devices=None) -> Mesh:
         ordered.extend(slices[s][:per_slice])
     n_slices = used_slices
     dims = plan.dims()
-    # verify the outermost axes tile exactly onto slices
+    names = plan.axis_names
+    # The slice (DCN) boundary must be reached by DCN-tolerant axes alone:
+    # walking axes outermost-in, only dp (or trivial size-1 axes) may
+    # contribute to the product before it covers n_slices. A layout like
+    # (dp=1, fsdp=4, tp=2) on 2 slices would silently put half of each
+    # fsdp group on the far side of DCN — exactly the hazard this
+    # function exists to prevent (round-1 ADVICE finding).
     outer = 1
-    for dim in dims:
-        if outer >= n_slices:
+    for name, dim in zip(names, dims):
+        if outer % n_slices == 0:
             break
+        if dim > 1 and name != "dp":
+            raise ValueError(
+                f"slice boundary falls inside ICI-intended axis {name!r}: "
+                f"mesh {dict(zip(names, dims))} on {n_slices} slices needs "
+                f"dp (outermost) to cover the slice count so only data "
+                f"parallelism rides DCN")
         outer *= dim
-    if outer % n_slices != 0 and n_slices % outer != 0:
+    if outer % n_slices != 0:
         raise ValueError(
             f"outer mesh axes {dims} do not tile {n_slices} slices; "
             f"put the DCN-crossing axis (dp) outermost")

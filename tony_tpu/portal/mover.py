@@ -16,6 +16,7 @@ import logging
 import os
 import shutil
 import threading
+import time
 
 from tony_tpu import constants as C
 from tony_tpu.events.history import (
@@ -90,8 +91,21 @@ class HistoryFileMover:
             os.makedirs(dest_parent, exist_ok=True)
             dest = os.path.join(dest_parent, name)
             if os.path.exists(dest):
-                LOG.warning("destination exists, dropping duplicate: %s", dest)
-                shutil.rmtree(app_dir)
+                # An AM retry may have regenerated history after an earlier
+                # move — never destroy the newer copy; park it for manual
+                # reconciliation OUTSIDE the finished tree (PortalCache
+                # walks finished/ and would list a parked copy as a
+                # phantom application).
+                dup_parent = os.path.join(
+                    os.path.dirname(self.finished.rstrip(os.sep)),
+                    "duplicates")
+                os.makedirs(dup_parent, exist_ok=True)
+                dup = os.path.join(dup_parent,
+                                   f"{name}.dup-{int(time.time())}")
+                while os.path.exists(dup):
+                    dup += "x"
+                shutil.move(app_dir, dup)
+                LOG.warning("destination exists, kept duplicate at %s", dup)
                 continue
             shutil.move(app_dir, dest)
             LOG.info("moved history %s -> %s", app_dir, dest)
@@ -102,8 +116,6 @@ class HistoryFileMover:
         """Return final JobMetadata if the app dir is ready to move.
         Renames stale .jhist.inprogress files to -KILLED finals first
         (reference: HistoryFileMover.java:135-169)."""
-        import time
-
         for fname in os.listdir(app_dir):
             if fname.endswith("." + C.HISTORY_SUFFIX):
                 try:

@@ -18,17 +18,32 @@ VERSION = "0.1.0"
 _KEY_PREFIX = "tony.version"
 
 
-def _git_ref() -> str:
+def _git(*args: str) -> str:
     try:
         # the framework's own checkout, not the submitter's cwd — this
         # stamps which BUILD ran the job
         out = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
+            ["git", *args],
             capture_output=True, text=True, timeout=5,
             cwd=os.path.dirname(os.path.abspath(__file__)))
         return out.stdout.strip() if out.returncode == 0 else "unknown"
     except (OSError, subprocess.SubprocessError):  # incl. TimeoutExpired
         return "unknown"
+
+
+def _git_ref() -> str:
+    return _git("rev-parse", "--short", "HEAD")
+
+
+def _build_time() -> str:
+    """The commit date of the running checkout — stable across submissions
+    of the same build (round-1 ADVICE: wall-clock here made two submissions
+    of one checkout report different 'builds'). Falls back to the current
+    time (flagged as submit-time) outside a git checkout."""
+    commit_date = _git("show", "-s", "--format=%cI", "HEAD")
+    if commit_date != "unknown":
+        return commit_date
+    return time.strftime("%Y-%m-%dT%H:%M:%S") + " (submit-time)"
 
 
 def _user() -> str:
@@ -44,5 +59,4 @@ def stamp_conf(conf) -> None:
     conf.set(f"{_KEY_PREFIX}", VERSION, "version-info")
     conf.set(f"{_KEY_PREFIX}.git-ref", _git_ref(), "version-info")
     conf.set(f"{_KEY_PREFIX}.user", _user(), "version-info")
-    conf.set(f"{_KEY_PREFIX}.build-time",
-             time.strftime("%Y-%m-%dT%H:%M:%S"), "version-info")
+    conf.set(f"{_KEY_PREFIX}.build-time", _build_time(), "version-info")
