@@ -1,0 +1,123 @@
+"""Multi-host E2E: the full client → AM → executor chain over the
+RemoteClusterBackend with two simulated hosts.
+
+The VERDICT-r1 acceptance bar: gang-schedule 2 "hosts" (separate node
+root dirs via ExecTransport) and pass the barrier / heartbeat / AM-retry
+suite unchanged. Executors run in NODE-side workdirs — not the client's
+app dir — and localize the frozen conf + resources through the staging
+store, which is what proves the shared-filesystem assumption is gone
+(conf is fetched by URI into the container's own cwd)."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tony_tpu import constants as C
+
+from test_e2e import _dump_logs, run_job, script
+
+
+def remote_overrides(tmp_path, nodes="nodeA:3,nodeB:3"):
+    return {
+        "tony.cluster.backend": "remote",
+        "tony.cluster.nodes": nodes,
+        "tony.cluster.node-transport": "exec",
+        "tony.cluster.node-root": str(tmp_path / "nodes"),
+        "tony.staging.location": str(tmp_path / "shared-store"),
+    }
+
+
+def _node_workdirs(tmp_path):
+    root = tmp_path / "nodes"
+    return sorted(os.listdir(root)) if root.is_dir() else []
+
+
+def test_gang_barrier_across_two_nodes(tmp_path):
+    """2 workers spread over 2 nodes rendezvous through the AM barrier."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_jax_env.py"),
+         "--conf", "tony.worker.instances=2"],
+        conf_overrides=remote_overrides(tmp_path))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    workdirs = _node_workdirs(tmp_path)
+    assert len(workdirs) == 2, workdirs
+    # each executor fetched the frozen conf through the store into its own
+    # node-side workdir — the client's app dir was never read from there
+    for wd in workdirs:
+        fetched = tmp_path / "nodes" / wd / C.TONY_FINAL_CONF
+        assert fetched.exists(), f"conf not localized into {wd}"
+
+
+def test_node_side_cwd_is_not_app_dir(tmp_path):
+    marker = str(tmp_path / "cwds")
+    client = run_job(
+        tmp_path,
+        ["--conf", "tony.worker.instances=2",
+         "--conf", "tony.worker.command=bash -c 'mkdir -p %s && pwd > %s/$TASK_INDEX'" % (marker, marker),
+         ],
+        conf_overrides=remote_overrides(tmp_path))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    cwds = {open(os.path.join(marker, f)).read().strip()
+            for f in os.listdir(marker)}
+    assert len(cwds) == 2
+    for cwd in cwds:
+        assert cwd.startswith(str(tmp_path / "nodes")), cwd
+        assert not cwd.startswith(client.app_dir), cwd
+
+
+def test_missed_heartbeats_fail_on_remote_backend(tmp_path, monkeypatch):
+    monkeypatch.setenv(C.TEST_TASK_EXECUTOR_NUM_HB_MISS, "100")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("sleep_30.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.task.max-missed-heartbeats=5"],
+        conf_overrides=remote_overrides(tmp_path, nodes="nodeA:2"))
+    assert client.final_status == "FAILED"
+    assert "missed" in (client.final_message or "")
+
+
+def test_am_retry_recovers_on_remote_backend(tmp_path):
+    """Session retry relaunches on the node pool (stale-session containers
+    from attempt 0 are killed through the transport)."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0_if_retry.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.am.retry-count=2"],
+        conf_overrides=remote_overrides(tmp_path))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_worker_failure_fails_app_on_remote_backend(tmp_path):
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_1.py"),
+         "--conf", "tony.worker.instances=1"],
+        conf_overrides=remote_overrides(tmp_path, nodes="nodeA:1"))
+    assert client.final_status == "FAILED"
+
+
+def test_src_dir_ships_through_store_to_nodes(tmp_path):
+    """User code travels client → store → node workdir (the HDFS
+    upload/localize loop, TonyClient.java:519-590)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "train.py").write_text("print('trained-on-node')\n")
+    client = run_job(
+        tmp_path,
+        ["--executes", "train.py",
+         "--src_dir", str(src),
+         "--conf", "tony.worker.instances=2"],
+        conf_overrides=remote_overrides(tmp_path))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    stdouts = []
+    containers_dir = os.path.join(client.app_dir, "containers")
+    for d in os.listdir(containers_dir):
+        p = os.path.join(containers_dir, d, "stdout")
+        if os.path.exists(p):
+            stdouts.append(open(p).read())
+    assert sum("trained-on-node" in s for s in stdouts) == 2

@@ -1,0 +1,167 @@
+"""RemoteClusterBackend tests: node parsing, launch-script hygiene, ssh
+argv construction, and live multi-"host" scheduling over ExecTransport.
+
+The ExecTransport cases are the multi-host analogue of the reference's
+MiniCluster tier (SURVEY §4): real processes, real kill paths, separate
+per-node root dirs standing in for separate hosts. SSH itself can't run
+in the test image, so SSHTransport is covered at the argv/script layer
+(the same split the reference used for GpuDiscoverer: parse layer tested
+against fixtures, exec layer trusted to the OS)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from tony_tpu.cluster.backend import EXIT_KILLED_BY_AM
+from tony_tpu.cluster.remote import (
+    ExecTransport, NodeSpec, RemoteClusterBackend, SSHTransport,
+    build_launch_script, parse_nodes,
+)
+
+
+def test_parse_nodes():
+    nodes = parse_nodes("tpu-vm-0:4, tpu-vm-1:2,solo", default_root="/scratch")
+    assert [(n.host, n.slots, n.root) for n in nodes] == [
+        ("tpu-vm-0", 4, "/scratch"), ("tpu-vm-1", 2, "/scratch"),
+        ("solo", 1, "/scratch")]
+    with pytest.raises(ValueError):
+        NodeSpec.parse(":4")
+
+
+def test_launch_script_never_leaks_secrets_to_argv():
+    """Env values ride the script body (delivered over stdin), never argv —
+    same rule as the docker -e KEY pass-through (round-1 ADVICE)."""
+    script = build_launch_script(
+        ["python", "-m", "tony_tpu.executor"],
+        {"TONY_SECURITY_TOKEN": "s3cr3t", "A": "x y; rm -rf /"},
+        "/nodes/n1/c1", "/nodes/n1/c1/container.pid")
+    assert "export TONY_SECURITY_TOKEN=s3cr3t" in script
+    assert "export A='x y; rm -rf /'" in script           # quoted, inert
+    assert script.strip().endswith("exec python -m tony_tpu.executor")
+    ssh = SSHTransport()
+    argv = ssh.argv(NodeSpec("hostA"), "bash -s")
+    assert argv[0] == "ssh" and argv[-2:] == ["hostA", "bash -s"]
+    assert not any("s3cr3t" in a for a in argv)
+
+
+def test_ssh_transport_requires_staging_location():
+    """ssh nodes share no fs with the client: without a staging store the
+    executors would silently run on an empty conf — fail at submission."""
+    from tony_tpu.cluster import backend_from_conf
+    from tony_tpu.conf import TonyConfiguration, keys as K
+
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_BACKEND, "remote", "test")
+    conf.set(K.CLUSTER_NODES, "hostA:2", "test")
+    with pytest.raises(ValueError, match="staging.location"):
+        backend_from_conf(conf, "app1")
+    conf.set(K.STAGING_LOCATION, "gs://bkt/stage", "test")
+    backend = backend_from_conf(conf, "app1")
+    assert backend.off_host
+
+
+def _collect_backend(nodes):
+    backend = RemoteClusterBackend(nodes, ExecTransport(), app_id="t")
+    allocated, completed = [], {}
+    done = threading.Event()
+
+    def on_alloc(c):
+        allocated.append(c)
+
+    def on_done(cid, rc):
+        completed[cid] = rc
+        done.set()
+
+    backend.set_callbacks(on_alloc, on_done)
+    return backend, allocated, completed, done
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_allocation_spreads_across_nodes(tmp_path):
+    nodes = parse_nodes("nodeA:2,nodeB:2", default_root=str(tmp_path / "n"))
+    backend, allocated, _, _ = _collect_backend(nodes)
+    backend.start()
+    try:
+        backend.request_containers(4, priority=1, memory_mb=0, vcores=1,
+                                   gpus=0, tpus=0)
+        assert _wait(lambda: len(allocated) == 4)
+        hosts = sorted(c.host for c in allocated)
+        assert hosts == ["nodeA", "nodeA", "nodeB", "nodeB"]
+    finally:
+        backend.stop()
+
+
+def test_launch_runs_in_node_root_and_reports_exit(tmp_path):
+    nodes = parse_nodes("nodeA:1", default_root=str(tmp_path / "roots"))
+    backend, allocated, completed, done = _collect_backend(nodes)
+    backend.start()
+    try:
+        backend.request_containers(1, priority=1, memory_mb=0, vcores=1,
+                                   gpus=0, tpus=0)
+        assert _wait(lambda: allocated)
+        c = allocated[0]
+        cwd = str(tmp_path / "am" / c.container_id)
+        backend.launch_container(
+            c, ["bash", "-c", "pwd; echo out-line; exit 7"], {}, cwd)
+        assert done.wait(10)
+        assert completed[c.container_id] == 7
+        out = open(os.path.join(cwd, "stdout")).read()
+        # the process ran inside the NODE's root, not the AM-side cwd...
+        assert out.splitlines()[0].startswith(str(tmp_path / "roots"))
+        # ...but its stdout streamed back into the AM-side container dir
+        assert "out-line" in out
+    finally:
+        backend.stop()
+
+
+def test_stop_container_kills_remote_tree(tmp_path):
+    nodes = parse_nodes("nodeA:1", default_root=str(tmp_path / "roots"))
+    backend, allocated, completed, done = _collect_backend(nodes)
+    backend.start()
+    try:
+        backend.request_containers(1, priority=1, memory_mb=0, vcores=1,
+                                   gpus=0, tpus=0)
+        assert _wait(lambda: allocated)
+        c = allocated[0]
+        cwd = str(tmp_path / "am" / c.container_id)
+        backend.launch_container(c, ["sleep", "600"], {}, cwd)
+        pidfile = os.path.join(str(tmp_path / "roots"), c.container_id,
+                               "container.pid")
+        assert _wait(lambda: os.path.exists(pidfile))
+        backend.stop_container(c.container_id)
+        assert done.wait(10)
+        assert completed[c.container_id] == EXIT_KILLED_BY_AM
+    finally:
+        backend.stop()
+
+
+def test_slot_capacity_queues_excess_requests(tmp_path):
+    nodes = parse_nodes("nodeA:1", default_root=str(tmp_path / "n"))
+    backend, allocated, completed, _ = _collect_backend(nodes)
+    backend.start()
+    try:
+        backend.request_containers(2, priority=1, memory_mb=0, vcores=1,
+                                   gpus=0, tpus=0)
+        assert _wait(lambda: len(allocated) == 1)
+        c0 = allocated[0]
+        backend.launch_container(
+            c0, ["bash", "-c", "sleep 0.5"], {},
+            str(tmp_path / "am" / c0.container_id))
+        # second allocation only lands after the first frees the slot
+        assert _wait(lambda: len(allocated) == 2, timeout=15)
+        assert c0.container_id in completed or _wait(
+            lambda: c0.container_id in completed)
+    finally:
+        backend.stop()

@@ -1,0 +1,110 @@
+"""Staging-store tests: local dir, gs:// via a fake gsutil, URI localize.
+
+The store is the HDFS-upload seam (TonyClient.java:519-590 role); GCS is
+exercised against a PATH-shimmed `gsutil` that mirrors cp/ls onto a local
+dir — the GpuDiscoverer-style canned-fixture pattern (SURVEY §4: tests
+parse canned nvidia-smi output instead of real GPUs)."""
+
+from __future__ import annotations
+
+import os
+import stat
+
+import pytest
+
+from tony_tpu.storage import (
+    GCSStore, LocalDirStore, fetch_uri, staging_store,
+)
+from tony_tpu.utils.localization import localize_resource, stage_resource
+
+FAKE_GSUTIL = """#!/bin/bash
+# fake gsutil: maps gs://<bucket>/<key> onto $FAKE_GCS_ROOT/<bucket>/<key>
+set -e
+cmd=$1; shift
+map() { echo "$FAKE_GCS_ROOT/${1#gs://}"; }
+case "$cmd" in
+  cp)
+    src=$1; dst=$2
+    [[ $src == gs://* ]] && src=$(map "$src")
+    if [[ $dst == gs://* ]]; then dst=$(map "$dst"); mkdir -p "$(dirname "$dst")"; fi
+    cp "$src" "$dst"
+    ;;
+  ls)
+    p=$(map "$1"); [[ -e $p ]] || { echo "CommandException: no URLs matched" >&2; exit 1; }
+    ;;
+  *) echo "unsupported: $cmd" >&2; exit 2 ;;
+esac
+"""
+
+
+@pytest.fixture
+def fake_gcs(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    gsutil = bindir / "gsutil"
+    gsutil.write_text(FAKE_GSUTIL)
+    gsutil.chmod(gsutil.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    return tmp_path / "gcs"
+
+
+def test_local_store_roundtrip(tmp_path):
+    store = LocalDirStore(str(tmp_path / "stage"))
+    src = tmp_path / "a.txt"
+    src.write_text("payload")
+    uri = store.put(str(src), "a.txt")
+    assert os.path.isabs(uri) and store.exists(uri)
+    dest = store.fetch(uri, str(tmp_path / "out" / "a.txt"))
+    assert open(dest).read() == "payload"
+
+
+def test_gcs_store_roundtrip(tmp_path, fake_gcs):
+    store = GCSStore("gs://bkt/apps/app1")
+    src = tmp_path / "conf.json"
+    src.write_text("{}")
+    uri = store.put(str(src), "tony-final.json")
+    assert uri == "gs://bkt/apps/app1/tony-final.json"
+    assert store.exists(uri)
+    assert not store.exists("gs://bkt/apps/app1/nope")
+    out = fetch_uri(uri, str(tmp_path / "dl" / "conf.json"))
+    assert open(out).read() == "{}"
+
+
+def test_staging_store_selection(tmp_path, fake_gcs):
+    app_dir = str(tmp_path / "appX")
+    os.makedirs(app_dir)
+    local = staging_store("", app_dir)
+    assert isinstance(local, LocalDirStore)
+    assert local.root == os.path.join(app_dir, "staging")
+    gcs = staging_store("gs://bkt/stage", app_dir)
+    assert isinstance(gcs, GCSStore)
+    # per-app namespacing, like .tony/<appId> on HDFS
+    assert gcs.base.endswith("/appX")
+    explicit = staging_store(str(tmp_path / "shared"), app_dir)
+    assert isinstance(explicit, LocalDirStore)
+    # shared dirs are app-namespaced too: concurrent apps staging fixed
+    # keys (tony_src.zip) into one NFS dir must not clobber each other
+    assert explicit.root == str(tmp_path / "shared" / "appX")
+
+
+def test_stage_and_localize_through_gcs(tmp_path, fake_gcs):
+    """resource spec -> gs:// URI in conf -> container-side localize."""
+    src_dir = tmp_path / "data"
+    src_dir.mkdir()
+    (src_dir / "f.txt").write_text("x")
+    store = GCSStore("gs://bkt/app")
+    staged = stage_resource(str(src_dir), store)
+    assert staged.startswith("gs://bkt/app/data.zip")
+    assert staged.endswith("#archive")
+    workdir = tmp_path / "container"
+    workdir.mkdir()
+    out = localize_resource(staged, str(workdir))
+    assert open(os.path.join(out, "f.txt")).read() == "x"
+
+    plain = tmp_path / "w.txt"
+    plain.write_text("w")
+    staged_file = stage_resource(f"{plain}::weights.txt", store)
+    assert staged_file == "gs://bkt/app/weights.txt"
+    localize_resource(staged_file, str(workdir))
+    assert open(workdir / "weights.txt").read() == "w"

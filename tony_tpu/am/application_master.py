@@ -32,7 +32,7 @@ import time
 from typing import Optional
 
 from tony_tpu import constants as C
-from tony_tpu.cluster import Container, LocalClusterBackend
+from tony_tpu.cluster import Container, backend_from_conf
 from tony_tpu.cluster.backend import ClusterBackend
 from tony_tpu.cluster.docker import docker_env
 from tony_tpu.conf import TonyConfiguration, keys as K
@@ -80,7 +80,7 @@ class ApplicationMaster(ClusterServiceHandler):
         self.conf = conf
         self.app_id = app_id
         self.app_dir = os.path.abspath(app_dir)
-        self.backend = backend or LocalClusterBackend(app_id=app_id)
+        self.backend = backend or backend_from_conf(conf, app_id)
         self.session: Optional[TonySession] = None
         self.scheduler: Optional[TaskScheduler] = None
         self.metrics_store = MetricsStore()
@@ -139,6 +139,18 @@ class ApplicationMaster(ClusterServiceHandler):
         self._rpc_server, self.rpc_port = serve(
             cluster_handler=self, metrics_handler=self.metrics_store,
             auth_token=self._auth_token)
+        # off-host executors can't read the client's app dir — publish the
+        # frozen conf through the staging store and hand its URI to every
+        # container (the reference localized tony-final.xml from HDFS into
+        # each container, TonyClient.java:219-227 / TaskExecutor.java:269)
+        self._conf_uri = ""
+        staging_loc = self.conf.get_str(K.STAGING_LOCATION, "")
+        if staging_loc:
+            from tony_tpu.storage import staging_store
+            store = staging_store(staging_loc, self.app_dir)
+            conf_file = os.path.join(self.app_dir, C.TONY_FINAL_CONF)
+            if os.path.exists(conf_file):
+                self._conf_uri = store.put(conf_file, C.TONY_FINAL_CONF)
         self.backend.set_callbacks(self._on_container_allocated,
                                    self._on_container_completed)
         self.backend.start()
@@ -471,7 +483,14 @@ class ApplicationMaster(ClusterServiceHandler):
             C.ATTEMPT_NUMBER: str(self._session_id),
             C.NUM_AM_RETRIES: str(self.conf.get_int(K.AM_RETRY_COUNT, 0)),
             C.TONY_APP_DIR: self.app_dir,
-            C.TONY_CONF_PATH: os.path.join(self.app_dir, C.TONY_FINAL_CONF),
+            # off-host containers with a configured staging store get a
+            # cwd-relative conf path + fetch URI — no app-dir read at all;
+            # otherwise (shared fs) the absolute frozen-conf path
+            C.TONY_CONF_PATH: (
+                C.TONY_FINAL_CONF
+                if self.backend.off_host and self._conf_uri
+                else os.path.join(self.app_dir, C.TONY_FINAL_CONF)),
+            **({C.TONY_CONF_URI: self._conf_uri} if self._conf_uri else {}),
             "PYTHONPATH": framework_pythonpath(),
         }
         # per-jobtype command override, else the global task command

@@ -205,17 +205,22 @@ class TonyClient:
         return self.app_id
 
     def _process_final_conf(self) -> None:
-        """Stage src/venv/resources into the app dir and freeze the conf
-        (TonyClient.processFinalTonyConf, TonyClient.java:189-228)."""
-        staging = os.path.join(self.app_dir, "staging")
-        os.makedirs(staging, exist_ok=True)
+        """Stage src/venv/resources through the staging store and freeze
+        the conf (TonyClient.processFinalTonyConf, TonyClient.java:189-228).
+        The store is the HDFS-upload seam: a local dir on shared-fs
+        deployments, gs:// for multi-host TPU pods (tony.staging.location)."""
+        from tony_tpu.storage import staging_store
+        staging = staging_store(
+            self.conf.get_str(K.STAGING_LOCATION, ""), self.app_dir)
         src_dir = self.conf.get_str(K.SRC_DIR)
         if src_dir:
             if not os.path.isdir(src_dir):
                 raise FileNotFoundError(f"src_dir not found: {src_dir}")
-            zip_path = os.path.join(staging, C.TONY_SRC_ZIP)
-            zip_dir(src_dir, zip_path)
-            self.conf.set(K.SRC_DIR, zip_path, "client-staged")
+            with tempfile.TemporaryDirectory() as tmp:
+                zip_path = os.path.join(tmp, C.TONY_SRC_ZIP)
+                zip_dir(src_dir, zip_path)
+                staged_src = staging.put(zip_path, C.TONY_SRC_ZIP)
+            self.conf.set(K.SRC_DIR, staged_src, "client-staged")
         venv = self.conf.get_str(K.PYTHON_VENV)
         if venv:
             if not os.path.exists(venv):

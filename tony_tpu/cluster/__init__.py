@@ -6,11 +6,55 @@ AMRMClientAsync/NMClientAsync) plus tony-mini's in-process MiniCluster
 `ClusterBackend` interface is what the ApplicationMaster programs against;
 `LocalClusterBackend` implements it with local subprocesses so the full
 client→AM→executor→user-process chain runs on one host (dev, tests, single
-TPU VM). A real multi-host backend (GKE/GCE TPU pods) plugs in behind the
-same interface.
+TPU VM); `RemoteClusterBackend` places executors on other hosts over a
+NodeTransport (ssh in production, exec for multi-host e2e tests).
 """
 
 from tony_tpu.cluster.backend import ClusterBackend, Container
 from tony_tpu.cluster.local import LocalClusterBackend
+from tony_tpu.cluster.remote import RemoteClusterBackend
 
-__all__ = ["ClusterBackend", "Container", "LocalClusterBackend"]
+
+def backend_from_conf(conf, app_id: str) -> ClusterBackend:
+    """Build the backend `tony.cluster.backend` names (the AM-side
+    equivalent of the reference hard-wiring AMRMClientAsync+NMClientAsync;
+    here the substrate is pluggable)."""
+    from tony_tpu.conf import keys as K
+
+    kind = conf.get_str(K.CLUSTER_BACKEND, "local") or "local"
+    if kind == "local":
+        return LocalClusterBackend(app_id=app_id)
+    if kind == "remote":
+        from tony_tpu.cluster.remote import (
+            ExecTransport, SSHTransport, parse_nodes,
+        )
+
+        nodes = parse_nodes(conf.get_str(K.CLUSTER_NODES, ""),
+                            default_root=conf.get_str(K.CLUSTER_NODE_ROOT, ""))
+        transport_name = conf.get_str(K.CLUSTER_NODE_TRANSPORT, "ssh")
+        if transport_name == "exec":
+            transport = ExecTransport()
+        elif transport_name == "ssh":
+            # ssh nodes share no filesystem with the client: without a
+            # staging store the executors would silently run on an EMPTY
+            # conf (the app-dir conf path doesn't resolve there) — fail
+            # fast at submission instead of far downstream. The exec
+            # transport (test double on one host) is exempt.
+            if not conf.get_str(K.STAGING_LOCATION, ""):
+                raise ValueError(
+                    "tony.cluster.node-transport=ssh requires "
+                    "tony.staging.location (gs:// bucket or shared dir) "
+                    "so off-host executors can localize the conf and "
+                    "resources")
+            extra = conf.get_str(K.CLUSTER_SSH_OPTS, "")
+            transport = SSHTransport(
+                ssh_opts=None if not extra else extra.split())
+        else:
+            raise ValueError(
+                f"unknown node transport {transport_name!r} (ssh|exec)")
+        return RemoteClusterBackend(nodes, transport, app_id=app_id)
+    raise ValueError(f"unknown cluster backend {kind!r} (local|remote)")
+
+
+__all__ = ["ClusterBackend", "Container", "LocalClusterBackend",
+           "RemoteClusterBackend", "backend_from_conf"]

@@ -46,6 +46,11 @@ CompletedCallback = Callable[[str, int], None]  # (container_id, exit_code)
 class ClusterBackend(abc.ABC):
     """What the ApplicationMaster needs from a cluster substrate."""
 
+    # True when containers may run on hosts that do NOT share the client's
+    # filesystem; the AM then references staged artifacts by store URI
+    # instead of app-dir paths (TonyClient.java:519-590's HDFS role).
+    off_host = False
+
     def set_callbacks(self, on_allocated: AllocatedCallback,
                       on_completed: CompletedCallback) -> None:
         self._on_allocated: Optional[AllocatedCallback] = on_allocated

@@ -31,6 +31,8 @@ NO_DEFAULT_KEYS = frozenset({
     K.APPLICATION_TAGS,
     K.TPU_MESH_SHAPE,
     K.TPU_MESH_AXES,
+    K.CLUSTER_NODES,
+    K.CLUSTER_SSH_OPTS,
     K.HISTORY_LOCATION,
     K.HISTORY_INTERMEDIATE,
     K.HISTORY_FINISHED,
@@ -94,6 +96,9 @@ DEFAULTS = {
     # cluster backend
     K.CLUSTER_BACKEND: "local",
     K.CLUSTER_WORKDIR: "",       # "" = tempdir
+    K.CLUSTER_NODE_TRANSPORT: "ssh",
+    K.CLUSTER_NODE_ROOT: "",     # "" = /tmp/tony_tpu/<app_id> on each node
+    K.STAGING_LOCATION: "",      # "" = <app_dir>/staging (shared filesystem)
 
     # misc
     K.PYTHON_BINARY_PATH: "",

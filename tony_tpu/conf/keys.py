@@ -82,8 +82,17 @@ TPU_NUM_SLICES = "tony.tpu.num-slices"   # multi-slice (DCN) count
 TPU_COORDINATOR_PORT = "tony.tpu.coordinator-port"
 
 # --- cluster backend -----------------------------------------------------
-CLUSTER_BACKEND = "tony.cluster.backend"      # "local" (in-process) | future: gke
+CLUSTER_BACKEND = "tony.cluster.backend"      # "local" | "remote"
 CLUSTER_WORKDIR = "tony.cluster.workdir"      # staging root for local backend
+# remote backend (off-host executors — the YARN RM/NM role, ApplicationMaster
+# .java:1002-1156): static node pool + per-container transport channel
+CLUSTER_NODES = "tony.cluster.nodes"          # "host[:slots],host[:slots],..."
+CLUSTER_NODE_TRANSPORT = "tony.cluster.node-transport"  # "ssh" | "exec" (test)
+CLUSTER_NODE_ROOT = "tony.cluster.node-root"  # node-side container workdir base
+CLUSTER_SSH_OPTS = "tony.cluster.ssh-opts"    # extra ssh flags (spaces split)
+
+# --- staging store (HDFS upload/localize equivalent, TonyClient.java:519-590)
+STAGING_LOCATION = "tony.staging.location"    # ""=<app_dir>/staging | dir | gs://
 
 # --- misc ----------------------------------------------------------------
 SRC_DIR = "tony.srcdir"

@@ -56,6 +56,7 @@ TPU_NUM_SLICES = "TPU_NUM_SLICES"
 
 # Paths handed to AM / executor processes via env
 TONY_CONF_PATH = "TONY_CONF_PATH"    # abs path of the frozen tony-final.json
+TONY_CONF_URI = "TONY_CONF_URI"      # staged conf URI for off-host executors
 TONY_APP_DIR = "TONY_APP_DIR"        # per-app staging/work dir
 
 # ---------------------------------------------------------------------------

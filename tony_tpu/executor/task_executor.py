@@ -37,7 +37,9 @@ from tony_tpu.executor.task_monitor import TaskMonitor
 from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
 from tony_tpu.utils.common import current_host, pick_free_port, poll_till_non_null
 from tony_tpu.utils.fs import unzip
-from tony_tpu.utils.localization import localize_resource
+from tony_tpu.utils.localization import (
+    fetch_remote_spec, localize_resource,
+)
 from tony_tpu.utils.ports import reserve_port
 from tony_tpu.utils.shell import launch_shell, wait_or_kill
 
@@ -108,6 +110,16 @@ class TaskExecutor:
         self.task_command = e.get(C.TASK_COMMAND, "")
         self.app_dir = e.get(C.TONY_APP_DIR, ".")
         conf_path = e.get(C.TONY_CONF_PATH, "")
+        if conf_path and not os.path.exists(conf_path):
+            # off-host container: the client's app dir isn't mounted here —
+            # localize the frozen conf through the staging store instead
+            # (the reference localized tony-final.xml into every container,
+            # TaskExecutor.java:269)
+            conf_uri = e.get(C.TONY_CONF_URI, "")
+            if conf_uri:
+                from tony_tpu.storage import fetch_uri
+                conf_path = fetch_uri(
+                    conf_uri, os.path.join(os.getcwd(), C.TONY_FINAL_CONF))
         self.conf = (TonyConfiguration.read(conf_path)
                      if conf_path and os.path.exists(conf_path)
                      else TonyConfiguration())
@@ -189,14 +201,20 @@ class TaskExecutor:
         (Utils.extractResources + addResources, util/Utils.java:506-550,
         699-712): the src zip unpacks in place so `python train.py` resolves,
         the venv unpacks under ./venv, archives expand, files copy in."""
-        src_zip = self.conf.get_str(K.SRC_DIR)
+        src_zip, src_fetched = fetch_remote_spec(
+            self.conf.get_str(K.SRC_DIR), os.getcwd())
         if src_zip and src_zip.endswith(".zip") and os.path.exists(src_zip):
             unzip(src_zip, os.getcwd())
+            if src_fetched:
+                os.remove(src_zip)
         venv = self.conf.get_str(K.PYTHON_VENV)
-        if venv and os.path.exists(venv.split("#", 1)[0]):
-            path = venv.split("#", 1)[0]
-            if path.endswith(".zip"):
+        if venv:
+            path, venv_fetched = fetch_remote_spec(venv.split("#", 1)[0],
+                                                   os.getcwd())
+            if path and path.endswith(".zip") and os.path.exists(path):
                 unzip(path, os.path.join(os.getcwd(), "venv"))
+                if venv_fetched:
+                    os.remove(path)
         specs = (self.conf.get_strings(K.resources_key(self.job_name))
                  + self.conf.get_strings(K.CONTAINERS_RESOURCES))
         for spec in specs:

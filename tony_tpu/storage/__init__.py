@@ -1,0 +1,3 @@
+from tony_tpu.storage.store import (   # noqa: F401
+    GCSStore, LocalDirStore, StagingStore, fetch_uri, staging_store,
+)

@@ -13,6 +13,7 @@ plain paths so an object-store backend (GCS for TPU pods) can wrap them.
 from __future__ import annotations
 
 import os
+import tempfile
 from dataclasses import dataclass
 
 from tony_tpu.utils.fs import copy_into, unzip, zip_dir
@@ -42,30 +43,62 @@ class LocalizableResource:
         return cls(source_path=path, local_name=name, is_archive=is_archive)
 
 
-def stage_resource(spec: str, staging_dir: str) -> str:
-    """Copy one resource into the staging dir (dirs are zipped, like
-    TonyClient.java:539-551). Returns the staged spec string (path
-    [+#archive]) to write back into the conf."""
+def stage_resource(spec: str, staging_dir_or_store) -> str:
+    """Ship one resource into the staging store (dirs are zipped, like
+    TonyClient.java:539-551). Returns the staged spec string (URI
+    [+#archive]) to write back into the conf. Accepts a plain dir path
+    (wrapped in a LocalDirStore) or any `StagingStore`."""
+    from tony_tpu.storage import LocalDirStore, StagingStore
+
+    store = (staging_dir_or_store
+             if isinstance(staging_dir_or_store, StagingStore)
+             else LocalDirStore(staging_dir_or_store))
     res = LocalizableResource.parse(spec)
     src = res.source_path
     if not os.path.exists(src):
         raise FileNotFoundError(f"resource not found: {src}")
     if os.path.isdir(src):
-        staged = os.path.join(staging_dir, res.local_name + ".zip")
-        zip_dir(src, staged)
+        with tempfile.TemporaryDirectory() as tmp:
+            zipped = os.path.join(tmp, res.local_name + ".zip")
+            zip_dir(src, zipped)
+            staged = store.put(zipped, res.local_name + ".zip")
         return staged + ARCHIVE_SUFFIX
-    staged = copy_into(src, staging_dir, new_name=res.local_name)
+    staged = store.put(src, res.local_name)
     return staged + (ARCHIVE_SUFFIX if res.is_archive else "")
+
+
+def fetch_remote_spec(path: str, dest_dir: str,
+                      name: str = "") -> tuple[str, bool]:
+    """Resolve a remote staged URI (gs://-style) to a local file under
+    `dest_dir/.fetch`; plain / file:// paths pass through untouched.
+    Returns (local_path, was_fetched) — callers delete fetched archives
+    after extraction so a multi-GB zip doesn't double the container's
+    disk footprint. The single scheme-dispatch point for both the
+    resource specs and the src/venv conf entries."""
+    if path and "://" in path and not path.startswith("file://"):
+        from tony_tpu.storage import fetch_uri
+
+        local = fetch_uri(path, os.path.join(
+            dest_dir, ".fetch", name or os.path.basename(path)))
+        return local, True
+    return path, False
 
 
 def localize_resource(spec: str, dest_dir: str) -> str:
     """Container-side: materialize a staged resource into the task workdir —
-    archives are unzipped, plain files symlinked/copied
-    (Utils.addResources + extractResources, util/Utils.java:506-550,699-712)."""
+    archives are unzipped, plain files copied
+    (Utils.addResources + extractResources, util/Utils.java:506-550,699-712).
+    Remote URIs (gs://) are fetched through the staging store first, so the
+    same spec works with or without a shared filesystem."""
     res = LocalizableResource.parse(spec)
-    if res.is_archive or res.source_path.endswith(".zip"):
+    src, fetched = fetch_remote_spec(res.source_path, dest_dir,
+                                     name=res.local_name)
+    if res.is_archive or src.endswith(".zip"):
         name = res.local_name
         if name.endswith(".zip"):
             name = name[:-4]
-        return unzip(res.source_path, os.path.join(dest_dir, name))
-    return copy_into(res.source_path, dest_dir, new_name=res.local_name)
+        out = unzip(src, os.path.join(dest_dir, name))
+        if fetched:
+            os.remove(src)
+        return out
+    return copy_into(src, dest_dir, new_name=res.local_name)
