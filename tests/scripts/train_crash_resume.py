@@ -1,0 +1,40 @@
+"""Fixture: sharded training that crashes mid-run on attempt 0 and must
+RESUME from the sharded checkpoint on the AM's retry (the reference
+delegated checkpointing to frameworks but had to survive restarts via
+ATTEMPT_NUMBER — ApplicationMaster.java:369,581-582; here the Trainer +
+sharded checkpoint close the loop)."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+
+from tony_tpu.models.mnist import mnist_init, mnist_loss  # noqa: E402
+from tony_tpu.train.data import synthetic_mnist  # noqa: E402
+from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
+
+ckpt_dir = os.environ["CKPT_DIR"]
+attempt = int(os.environ.get("ATTEMPT_NUMBER", "0"))
+crash_at = int(os.environ.get("CRASH_AT_STEP", "3"))
+total = int(os.environ.get("TOTAL_STEPS", "6"))
+
+trainer = Trainer(
+    loss_fn=mnist_loss, init_fn=mnist_init,
+    data_iter=synthetic_mnist(32),
+    config=TrainerConfig(num_steps=crash_at if attempt == 0 else total,
+                         log_every=1, checkpoint_every=1,
+                         checkpoint_dir=ckpt_dir, learning_rate=1e-2,
+                         warmup_steps=1))
+trainer.setup()
+resumed_from = trainer.step
+trainer.run()
+if attempt == 0:
+    # simulate preemption AFTER checkpoints exist
+    print(f"attempt 0 dying at step {trainer.step}", flush=True)
+    os._exit(1)
+with open(os.path.join(ckpt_dir, "resume_report.json"), "w") as f:
+    json.dump({"attempt": attempt, "resumed_from": resumed_from,
+               "finished_at": trainer.step}, f)
+print(f"attempt {attempt} resumed from {resumed_from} "
+      f"finished at {trainer.step}", flush=True)
+sys.exit(0)

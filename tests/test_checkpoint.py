@@ -1,0 +1,141 @@
+"""Sharded/async checkpoint tests on the virtual 8-device CPU mesh.
+
+Round-1 VERDICT item 4's acceptance bar: an 8-device sharded run saves
+per-shard (no host ever materializes full state), restores onto a
+DIFFERENT mesh layout, and the async saver overlaps IO without breaking
+the donation contract."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tony_tpu.parallel.mesh import make_mesh, plan_mesh
+from tony_tpu.train.checkpoint import (
+    AsyncCheckpointer, latest_step, restore_checkpoint, save_checkpoint,
+)
+
+
+def _mesh(fsdp=8, tp=1):
+    return make_mesh(plan_mesh(8, fsdp=fsdp, tp=tp))
+
+
+def _sharded_state(mesh):
+    w = jnp.arange(64.0, dtype=jnp.float32).reshape(8, 8)
+    b = jnp.arange(8.0, dtype=jnp.float32)
+    return {
+        "w": jax.device_put(w, NamedSharding(mesh, P("fsdp", "tp"))),
+        "b": jax.device_put(b, NamedSharding(mesh, P(None))),
+        "step": 4,
+    }
+
+
+def test_save_writes_one_file_per_shard_not_full_leaves(tmp_path):
+    mesh = _mesh(fsdp=4, tp=2)
+    state = _sharded_state(mesh)
+    path = save_checkpoint(str(tmp_path), 4, state)
+    shards = os.listdir(os.path.join(path, "shards"))
+    # w: 4x2 shard grid = 8 files; b replicated = 1 file; step = 1 file
+    assert sum(f.startswith("leaf_") and ".p0_" in f for f in shards) == 10
+    # every w shard file holds a 2x4 block, never the full 8x8 — dict keys
+    # flatten sorted, so w is leaf 2 after (b, step)
+    manifest = json.load(open(os.path.join(path, "manifest_p0.json")))
+    w_recs = [r for r in manifest["shards"] if r["leaf"] == 2]
+    assert len(w_recs) == 8
+    for rec in w_recs:
+        data = np.load(os.path.join(path, "shards", rec["file"]))
+        assert data.shape == (2, 4)
+
+
+def test_restore_onto_different_mesh_layout(tmp_path):
+    """Save on fsdp=4 x tp=2, restore onto fsdp=8 (and onto fsdp=2 x tp=4):
+    per-shard paste, bit-exact."""
+    save_mesh = _mesh(fsdp=4, tp=2)
+    state = _sharded_state(save_mesh)
+    save_checkpoint(str(tmp_path), 1, state)
+    for fsdp, tp in ((8, 1), (2, 4), (1, 1)):
+        mesh = _mesh(fsdp=fsdp, tp=tp)
+        template = {
+            "w": jax.device_put(jnp.zeros((8, 8)),
+                                NamedSharding(mesh, P("fsdp", "tp"))),
+            "b": jax.device_put(jnp.zeros(8), NamedSharding(mesh, P(None))),
+            "step": 0,
+        }
+        restored = restore_checkpoint(str(tmp_path), 1, template=template)
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(64.0).reshape(8, 8))
+        np.testing.assert_array_equal(np.asarray(restored["b"]),
+                                      np.arange(8.0))
+        assert restored["step"] == 4
+        assert restored["w"].sharding.spec == P("fsdp", "tp")
+
+
+def test_restore_without_template_assembles_numpy(tmp_path):
+    mesh = _mesh(fsdp=8)
+    state = _sharded_state(mesh)
+    save_checkpoint(str(tmp_path), 2, state)
+    restored = restore_checkpoint(str(tmp_path))
+    assert isinstance(restored["w"], np.ndarray)
+    np.testing.assert_array_equal(restored["w"],
+                                  np.arange(64.0).reshape(8, 8))
+    assert restored["step"] == 4 and isinstance(restored["step"], int)
+
+
+def test_async_checkpointer_survives_donation(tmp_path):
+    """save() must snapshot before returning: the caller immediately
+    donates the state to the next step (buffers invalidated)."""
+    mesh = _mesh(fsdp=8)
+    ckpt = AsyncCheckpointer(str(tmp_path))
+
+    @jax.jit
+    def bump(x):
+        return x + 1.0
+
+    bump_donating = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+    with jax.set_mesh(mesh):
+        x = jax.device_put(jnp.arange(16.0),
+                           NamedSharding(mesh, P("fsdp")))
+        for step in range(3):
+            ckpt.save(step, {"x": x})
+            x = bump_donating(x)   # invalidates the buffer just saved
+        ckpt.close()
+    assert latest_step(str(tmp_path)) == 2
+    restored = restore_checkpoint(str(tmp_path), 2)
+    np.testing.assert_array_equal(restored["x"], np.arange(16.0) * 4.0)
+
+
+def test_am_retry_resumes_sharded_run(tmp_path):
+    """VERDICT-r1 item 4 acceptance: AM retry resumes an 8-device sharded
+    run from per-shard checkpoints — no full-state gather anywhere."""
+    from test_e2e import run_job, script, _dump_logs
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    client = run_job(
+        tmp_path,
+        ["--executes", script("train_crash_resume.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.am.retry-count=2",
+         "--conf", f"tony.execution.env=CKPT_DIR={ckpt_dir}",
+         "--conf", f"tony.execution.env=TONY_REPO_ROOT={repo}"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    report = json.load(open(os.path.join(ckpt_dir, "resume_report.json")))
+    assert report["attempt"] == 1
+    assert report["resumed_from"] == 3      # picked up attempt 0's last save
+    assert report["finished_at"] == 6
+
+
+def test_atomicity_partial_tmp_ignored(tmp_path):
+    mesh = _mesh()
+    save_checkpoint(str(tmp_path), 5, _sharded_state(mesh))
+    # a crashed later save leaves only a .tmp dir — must be invisible
+    os.makedirs(os.path.join(str(tmp_path), "step_9.tmp", "shards"))
+    assert latest_step(str(tmp_path)) == 5
+    restored = restore_checkpoint(str(tmp_path))
+    assert restored["step"] == 4

@@ -26,9 +26,7 @@ import optax
 
 from tony_tpu import constants as C
 from tony_tpu.parallel import mesh_from_env, shard_pytree
-from tony_tpu.train.checkpoint import (
-    latest_step, restore_checkpoint, save_checkpoint,
-)
+from tony_tpu.train.checkpoint import latest_step, restore_checkpoint
 from tony_tpu.train.data import global_batch_iterator
 from tony_tpu.train.step import make_train_step
 
@@ -80,6 +78,7 @@ class Trainer:
         self.opt_state = None
         self.last_loss: Optional[float] = None
         self.metrics_history: list[dict] = []
+        self._checkpointer = None
 
     # ------------------------------------------------------------------
     def setup(self) -> None:
@@ -103,31 +102,35 @@ class Trainer:
 
         resume = (latest_step(cfg.checkpoint_dir)
                   if cfg.checkpoint_dir else None)
-        restored_opt = None
-        if resume is not None:
-            LOG.info("resuming from checkpoint step %d", resume)
-            state = restore_checkpoint(cfg.checkpoint_dir, resume)
-            params, restored_opt, self.step = (
-                state["params"], state["opt_state"], int(state["step"]))
-        else:
-            params = self.init_fn(jax.random.PRNGKey(cfg.seed))
+        params = self.init_fn(jax.random.PRNGKey(cfg.seed))
         if self.param_axes is not None:
             params = shard_pytree(params, self.param_axes, self.mesh)
         else:
-            params = jax.device_put(params)
+            # no sharding rules -> replicate over the whole mesh (a bare
+            # device_put would pin single-device, clashing with the
+            # ambient-mesh jit and with template-based restore)
+            from jax.sharding import NamedSharding, PartitionSpec
+            params = jax.device_put(
+                params, NamedSharding(self.mesh, PartitionSpec()))
         self.params = params
         # jit the optimizer init so the Adam moments inherit the params'
         # shardings (zeros_like propagates sharding) instead of landing
         # replicated — at 8B that's the difference between fitting and OOM
         with jax.set_mesh(self.mesh):
             opt_state = jax.jit(self.optimizer.init)(self.params)
-            if restored_opt is not None:
-                # place restored host arrays with the freshly-derived shardings
-                opt_state = jax.tree.map(
-                    lambda ref, x: jax.device_put(
-                        x, ref.sharding) if isinstance(ref, jax.Array) else x,
-                    opt_state, restored_opt)
         self.opt_state = opt_state
+        if resume is not None:
+            # template restore: each target shard reads only the saved
+            # regions it overlaps (mmap) — no host ever holds a full leaf,
+            # and the checkpoint reshards onto this run's mesh for free
+            LOG.info("resuming from checkpoint step %d", resume)
+            state = restore_checkpoint(
+                cfg.checkpoint_dir, resume,
+                template={"params": self.params,
+                          "opt_state": self.opt_state, "step": 0})
+            self.params = state["params"]
+            self.opt_state = state["opt_state"]
+            self.step = int(state["step"])
         # multi-process data parallelism: assemble global arrays from each
         # process's local shard
         self.data_iter = global_batch_iterator(self.data_iter, self.mesh)
@@ -161,7 +164,10 @@ class Trainer:
             if loss is not None:       # loop may no-op on an exact resume
                 self.last_loss = float(loss)
             if cfg.checkpoint_dir and loss is not None:
-                self._checkpoint()
+                self._checkpoint(final=True)
+            elif self._checkpointer is not None:
+                self._checkpointer.close()
+                self._checkpointer = None
         return self.last_loss
 
     def _maybe_start_profiler(self) -> None:
@@ -178,8 +184,19 @@ class Trainer:
         except Exception:  # noqa: BLE001 — profiling must never kill training
             LOG.exception("could not start profiler server")
 
-    def _checkpoint(self) -> None:
-        save_checkpoint(self.config.checkpoint_dir, self.step,
-                        {"params": self.params, "opt_state": self.opt_state,
-                         "step": self.step})
-        LOG.info("checkpointed step %d", self.step)
+    def _checkpoint(self, final: bool = False) -> None:
+        """Mid-training saves are async (file IO overlaps the next steps;
+        the device->host snapshot inside save() is synchronous because the
+        train step donates buffers); the final save blocks to commit."""
+        if self._checkpointer is None:
+            from tony_tpu.train.checkpoint import AsyncCheckpointer
+            self._checkpointer = AsyncCheckpointer(
+                self.config.checkpoint_dir)
+        self._checkpointer.save(
+            self.step, {"params": self.params, "opt_state": self.opt_state,
+                        "step": self.step})
+        if final:
+            self._checkpointer.close()
+            self._checkpointer = None
+        LOG.info("checkpointed step %d%s", self.step,
+                 " (final)" if final else " (async)")
