@@ -27,6 +27,8 @@ The orchestrator supplies attempt identity + AM retry (SURVEY.md §5
 
 from __future__ import annotations
 
+import bisect
+import itertools
 import json
 import logging
 import os
@@ -176,6 +178,54 @@ def _load_manifests(path: str) -> dict[int, list[dict]]:
     return by_leaf
 
 
+class _RegionIndex:
+    """Grid interval index over one leaf's saved shard records.
+
+    Replica-0 shards of a leaf tile its global shape disjointly, so the
+    distinct shard starts per dimension define a grid refinement: every
+    record covers a contiguous block of grid cells. Restoring a target
+    shard then only enumerates the cells the target overlaps — O(overlap)
+    records touched — instead of re-scanning every saved record per
+    target shard (the O(S_target x S_saved) walk this replaces)."""
+
+    def __init__(self, records: list[dict], ndim: int):
+        self.records = records
+        self._starts: list[list[int]] = []
+        self._cells: dict[tuple, list[int]] = {}
+        if ndim == 0:
+            return
+        for d in range(ndim):
+            self._starts.append(sorted({rec["index"][d][0]
+                                        for rec in records}))
+        for rid, rec in enumerate(records):
+            spans = []
+            for d in range(ndim):
+                a, b = rec["index"][d]
+                i0 = bisect.bisect_right(self._starts[d], a) - 1
+                i1 = bisect.bisect_left(self._starts[d], b)
+                spans.append(range(i0, max(i1, i0 + 1)))
+            for cell in itertools.product(*spans):
+                self._cells.setdefault(cell, []).append(rid)
+
+    def query(self, target: tuple) -> list[dict]:
+        """Records whose region may overlap `target` (tuple of slices)."""
+        if not self._starts:
+            return self.records
+        spans = []
+        for d, sl in enumerate(target):
+            i0 = max(0, bisect.bisect_right(self._starts[d], sl.start) - 1)
+            i1 = bisect.bisect_left(self._starts[d], sl.stop)
+            spans.append(range(i0, max(i1, i0 + 1)))
+        seen: set[int] = set()
+        out = []
+        for cell in itertools.product(*spans):
+            for rid in self._cells.get(cell, ()):
+                if rid not in seen:
+                    seen.add(rid)
+                    out.append(self.records[rid])
+        return out
+
+
 def _paste_region(out: np.ndarray, out_index: tuple, path: str,
                   rec: dict) -> None:
     """Copy the overlap between a saved shard file and the target region
@@ -218,6 +268,7 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
         index = json.load(f)
     by_leaf = _load_manifests(path)
     shards_dir = os.path.join(path, "shards")
+    leaf_index: dict[int, _RegionIndex] = {}
 
     def read_region(i: int, meta: dict, region: tuple) -> np.ndarray:
         # normalize: device shardings hand out slices with None bounds
@@ -230,7 +281,9 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
             target = tuple(slice(0, d) for d in dims)
         out = np.empty([sl.stop - sl.start for sl in target],
                        dtype=meta["dtype"])
-        for rec in by_leaf.get(i, []):
+        if i not in leaf_index:
+            leaf_index[i] = _RegionIndex(by_leaf.get(i, []), len(dims))
+        for rec in leaf_index[i].query(target):
             _paste_region(out, target, os.path.join(shards_dir,
                                                     rec["file"]), rec)
         return out
