@@ -30,8 +30,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512x512 measured 2.05x faster than 128x128 on v5e (28.7 vs 14.0 TF/s,
+# B4 H16 S4096 hd128 causal fwd) — bigger q blocks amortize the K/V stream
+# and feed the MXU full tiles; >=1024 plateaus and 2048 blows compile.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 

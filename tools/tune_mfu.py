@@ -1,0 +1,86 @@
+"""MFU tuning harness: time llama3_1b_proxy train-step variants on the
+live chip and print one JSON line per variant.
+
+Usage: python tools/tune_mfu.py [variant ...]   (default: all)
+
+Variants explore the single-chip levers (VERDICT r2 item 1): batch size,
+remat on/off/policy, sequence length. Each runs in-process sequentially —
+the tunnel is single-claim, so never run this alongside another TPU job.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, ".")
+from bench import peak_flops  # noqa: E402
+from tony_tpu.models.llama import get_config, llama_init, llama_loss  # noqa: E402
+from tony_tpu.train.step import make_train_step  # noqa: E402
+
+VARIANTS: dict[str, dict] = {
+    "base_b4":   dict(batch=4, seq=4096),
+    "b8":        dict(batch=8, seq=4096),
+    "b2":        dict(batch=2, seq=4096),
+    "noremat_b2": dict(batch=2, seq=4096, remat=False),
+    "noremat_b4": dict(batch=4, seq=4096, remat=False),
+    "dots_b4":   dict(batch=4, seq=4096, policy="dots_with_no_batch_dims_saveable"),
+    "seq8k_b2":  dict(batch=2, seq=8192),
+}
+
+
+def run(name: str, spec: dict) -> dict:
+    overrides = {}
+    if not spec.get("remat", True):
+        overrides["remat"] = False
+    config = get_config("llama3_1b_proxy", max_seq=spec["seq"], **overrides)
+    policy = spec.get("policy")
+    if policy is not None:
+        import tony_tpu.models.llama as llama_mod
+        pol = getattr(jax.checkpoint_policies, policy)
+        real_ckpt = jax.checkpoint
+        llama_mod.jax.checkpoint = partial(real_ckpt, policy=pol)
+    try:
+        params = llama_init(config, jax.random.PRNGKey(0))
+        optimizer = optax.adamw(3e-4)
+        step = make_train_step(partial(llama_loss, config=config), optimizer)
+        opt_state = jax.jit(optimizer.init)(params)
+        b, s = spec["batch"], spec["seq"]
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                    config.vocab_size, jnp.int32)
+        batch = {"inputs": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, batch)
+        float(loss)
+        t0 = time.monotonic()
+        n = 6
+        for _ in range(n):
+            params, opt_state, loss = step(params, opt_state, batch)
+        float(loss)
+        dt = (time.monotonic() - t0) / n
+        tok_s = b * s / dt
+        mfu = 100.0 * tok_s * config.flops_per_token(s) / peak_flops(
+            jax.devices()[0])
+        return {"variant": name, "step_s": round(dt, 4),
+                "tok_s": round(tok_s, 1), "mfu_pct": round(mfu, 2)}
+    except Exception as e:  # noqa: BLE001 — report and move on (e.g. OOM)
+        return {"variant": name, "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    finally:
+        if policy is not None:
+            llama_mod.jax.checkpoint = real_ckpt
+
+
+def main() -> None:
+    names = sys.argv[1:] or list(VARIANTS)
+    for name in names:
+        print(json.dumps(run(name, VARIANTS[name])), flush=True)
+
+
+if __name__ == "__main__":
+    main()
