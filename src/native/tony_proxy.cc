@@ -10,6 +10,16 @@
 // the bound port.
 //
 // usage: tony_proxy <remote_host> <remote_port> [local_port]
+//
+// Connection auth: when the TONY_PROXY_TOKEN env var is set (env, never
+// argv — argv is world-readable via /proc), every new connection must
+// authenticate before the upstream is even CONNECTED: either a preamble
+// line "TONY-PROXY-AUTH <token>\n" (stripped), or an HTTP first block
+// carrying "?tony-proxy-token=<token>" in the request line or an
+// "Authorization: Bearer <token>" header (forwarded unmodified). Same
+// protocol as the Python fallback (tony_tpu/proxy.py), including the
+// grace unlock keyed by peer UID on loopback (source IP cannot
+// distinguish local users there; /proc/net/tcp records the owner).
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -18,9 +28,11 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
+#include <ctype.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -33,6 +45,73 @@ namespace {
 
 constexpr size_t kBufSize = 64 * 1024;
 constexpr int kMaxEvents = 256;
+constexpr size_t kAuthMax = 8 * 1024;  // auth must fit the first 8 KB
+constexpr long kGraceSec = 600;        // sliding source-address unlock
+const char kAuthPreamble[] = "TONY-PROXY-AUTH ";
+
+bool ConstTimeEq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  unsigned char acc = 0;
+  for (size_t i = 0; i < a.size(); ++i)
+    acc |= static_cast<unsigned char>(a[i]) ^ static_cast<unsigned char>(b[i]);
+  return acc == 0;
+}
+
+// HTTP first-block auth: ?token= in the request line or an
+// Authorization: Bearer header.
+bool CheckHttpAuth(const std::string& buf, const std::string& token) {
+  size_t head_end = buf.find("\r\n\r\n");
+  std::string head = buf.substr(0, head_end == std::string::npos
+                                       ? buf.size() : head_end);
+  size_t eol = head.find("\r\n");
+  std::string request_line = head.substr(0, eol);
+  size_t qmark = request_line.find('?');
+  if (qmark != std::string::npos) {
+    size_t end = request_line.find(' ', qmark);
+    std::string query = request_line.substr(
+        qmark + 1, end == std::string::npos ? std::string::npos
+                                            : end - qmark - 1);
+    size_t pos = 0;
+    while (pos <= query.size()) {
+      size_t amp = query.find('&', pos);
+      std::string pair = query.substr(
+          pos, amp == std::string::npos ? std::string::npos : amp - pos);
+      // proxy-distinct param: plain ?token= belongs to the proxied app
+      // (e.g. Jupyter's own login token)
+      if (pair.rfind("tony-proxy-token=", 0) == 0 &&
+          ConstTimeEq(pair.substr(17), token)) {
+        return true;
+      }
+      if (amp == std::string::npos) break;
+      pos = amp + 1;
+    }
+  }
+  size_t line_start = eol == std::string::npos ? head.size() : eol + 2;
+  while (line_start < head.size()) {
+    size_t line_end = head.find("\r\n", line_start);
+    std::string line = head.substr(
+        line_start, line_end == std::string::npos ? std::string::npos
+                                                  : line_end - line_start);
+    std::string lower;
+    for (char c : line)   // unsigned cast: tolower(negative) is UB
+      lower.push_back(
+          static_cast<char>(tolower(static_cast<unsigned char>(c))));
+    if (lower.rfind("authorization:", 0) == 0) {
+      std::string value = line.substr(line.find(':') + 1);
+      size_t s = value.find_first_not_of(" \t");
+      if (s != std::string::npos) value = value.substr(s);
+      if (value.rfind("Bearer ", 0) == 0) {
+        std::string tok = value.substr(7);
+        size_t e = tok.find_last_not_of(" \t\r");
+        tok = e == std::string::npos ? "" : tok.substr(0, e + 1);
+        if (ConstTimeEq(tok, token)) return true;
+      }
+    }
+    if (line_end == std::string::npos) break;
+    line_start = line_end + 2;
+  }
+  return false;
+}
 
 struct Pipe {           // one direction of a relay
   char buf[kBufSize];
@@ -47,6 +126,10 @@ struct Relay {
   int upstream = -1;
   bool connecting = true;   // upstream connect() in flight
   bool doomed = false;      // close deferred to end of event batch
+  bool authed = true;       // false until the auth gate passes (token mode)
+  uint32_t source = 0;      // client IPv4 (s_addr) for the grace key
+  uint16_t source_port = 0;  // client source port (host order)
+  std::string pending;      // pre-auth client bytes (bounded by kAuthMax)
   Pipe c2u, u2c;            // client->upstream, upstream->client
 };
 
@@ -55,10 +138,54 @@ int SetNonBlocking(int fd) {
   return flags < 0 ? -1 : fcntl(fd, F_SETFL, flags | O_NONBLOCK);
 }
 
+// Dead-peer reaper: without keepalive a peer that vanishes silently
+// (laptop sleep, NAT drop) parks the relay forever; an idle timeout would
+// kill live-but-quiet websockets instead.
+void SetKeepalive(int fd) {
+  int one = 1, idle = 60, intvl = 20, cnt = 3;
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+}
+
+// UID owning the loopback peer socket, from /proc/net/tcp. -1 = unknown.
+// s_addr holds the network-order bytes; /proc/net/tcp prints that storage
+// as a host-order %08X, so passing s_addr through unchanged matches the
+// file's encoding on any endianness (127.0.0.1 -> "0100007F" on LE).
+long PeerUid(uint32_t s_addr, uint16_t port_host) {
+  char want[32];
+  snprintf(want, sizeof(want), "%08X:%04X", s_addr, port_host);
+  FILE* f = fopen("/proc/net/tcp", "r");
+  if (f == nullptr) return -1;
+  char line[512];
+  long uid = -1;
+  if (fgets(line, sizeof(line), f) != nullptr) {  // skip header
+    while (fgets(line, sizeof(line), f) != nullptr) {
+      char local[64];
+      long u;
+      // sl local rem st tx:rx tr:tm retrnsmt uid ...
+      if (sscanf(line, "%*d: %63s %*s %*s %*s %*s %*d %ld",
+                 local, &u) == 2 &&
+          strcmp(local, want) == 0) {
+        uid = u;
+        break;
+      }
+    }
+  }
+  fclose(f);
+  return uid;
+}
+
+bool IsLoopback(uint32_t ip_be) {
+  return (ntohl(ip_be) >> 24) == 127;
+}
+
 class Proxy {
  public:
-  Proxy(std::string host, int port) : remote_host_(std::move(host)),
-                                      remote_port_(port) {}
+  Proxy(std::string host, int port, std::string token)
+      : remote_host_(std::move(host)), remote_port_(port),
+        token_(std::move(token)) {}
 
   int Listen(int local_port) {
     listener_ = socket(AF_INET, SOCK_STREAM, 0);
@@ -121,26 +248,59 @@ class Proxy {
  private:
   void Accept() {
     for (;;) {
-      int cfd = accept(listener_, nullptr, nullptr);
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int cfd = accept(listener_, reinterpret_cast<sockaddr*>(&peer),
+                       &plen);
       if (cfd < 0) return;  // EAGAIN or error: back to the loop
       SetNonBlocking(cfd);
       int one = 1;
       setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      SetKeepalive(cfd);
 
-      int ufd = ConnectUpstream();
-      if (ufd < 0) {
-        close(cfd);
-        continue;
-      }
       auto* r = new Relay();
       r->client = cfd;
-      r->upstream = ufd;
+      r->source = peer.sin_addr.s_addr;
+      r->source_port = ntohs(peer.sin_port);
+      // browsers open extra connections without credentials: one
+      // successful auth unlocks the source (peer UID on loopback, IP
+      // otherwise) for a sliding window (see tony_tpu/proxy.py)
+      r->authed = token_.empty() || SourceUnlocked(GraceKey(r));
       relays_[cfd] = r;
-      relays_[ufd] = r;
       Register(cfd);
-      Register(ufd);
+      // the upstream is only contacted AFTER auth: rejected probes must
+      // not cost the in-cluster server connect/teardown churn
+      if (r->authed && !AttachUpstream(r)) {
+        CloseRelay(r);
+        continue;
+      }
       Rearm(r);
     }
+  }
+
+  // grace key: "uid:<uid>" on loopback (IP can't distinguish local
+  // users), "ip:<addr>" otherwise; "" = no grace possible
+  std::string GraceKey(const Relay* r) const {
+    char buf[48];
+    if (IsLoopback(r->source)) {
+      long uid = PeerUid(r->source, r->source_port);
+      if (uid < 0) return "";
+      snprintf(buf, sizeof(buf), "uid:%ld", uid);
+    } else {
+      snprintf(buf, sizeof(buf), "ip:%08X", r->source);
+    }
+    return buf;
+  }
+
+  bool AttachUpstream(Relay* r) {
+    int ufd = ConnectUpstream();
+    if (ufd < 0) return false;
+    SetKeepalive(ufd);
+    r->upstream = ufd;
+    r->connecting = true;
+    relays_[ufd] = r;
+    Register(ufd);
+    return true;
   }
 
   int ConnectUpstream() {
@@ -179,10 +339,72 @@ class Proxy {
     ev.events = (r->c2u.eof || r->c2u.len ? 0u : unsigned(EPOLLIN)) |
                 (r->u2c.len ? unsigned(EPOLLOUT) : 0u);
     epoll_ctl(epfd_, EPOLL_CTL_MOD, r->client, &ev);
+    if (r->upstream < 0) return;   // pre-auth: no upstream exists yet
     ev.data.fd = r->upstream;
     ev.events = (r->u2c.eof || r->u2c.len ? 0u : unsigned(EPOLLIN)) |
                 (r->c2u.len || r->connecting ? unsigned(EPOLLOUT) : 0u);
     epoll_ctl(epfd_, EPOLL_CTL_MOD, r->upstream, &ev);
+  }
+
+  long Now() const {
+    timespec ts{};
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec;
+  }
+
+  bool SourceUnlocked(const std::string& key) {
+    if (key.empty()) return false;
+    auto it = unlocked_.find(key);
+    // no slide here: only AUTHENTICATED connections extend the window
+    // (Authenticate sets it) — otherwise an unauthenticated poller
+    // could hold the unlock open forever
+    return it != unlocked_.end() && it->second >= Now();
+  }
+
+  // Pre-relay auth gate: buffer client bytes until a decision.
+  // false = reject (doom the relay); true = authed or still waiting.
+  bool Authenticate(Relay* r, uint32_t evmask) {
+    if (!(evmask & EPOLLIN)) return true;
+    // chunk cap kAuthMax keeps pending <= 2*kAuthMax so a stripped-
+    // preamble remainder always fits the 64K relay buffer below
+    char tmp[kAuthMax];
+    ssize_t got = read(r->client, tmp, kAuthMax);
+    if (got == 0) return false;  // EOF before auth
+    if (got < 0) {
+      return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+    }
+    r->pending.append(tmp, static_cast<size_t>(got));
+    size_t nl = r->pending.find('\n');
+    if (nl == std::string::npos) {
+      // no decision line yet: keep reading, bounded
+      return r->pending.size() <= kAuthMax;
+    }
+    std::string forward;
+    if (r->pending.rfind(kAuthPreamble, 0) == 0) {
+      std::string line = r->pending.substr(0, nl);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (!ConstTimeEq(line.substr(sizeof(kAuthPreamble) - 1), token_))
+        return false;
+      forward = r->pending.substr(nl + 1);  // preamble stripped
+    } else {
+      // HTTP mode: need the full header block for Authorization
+      if (r->pending.find("\r\n\r\n") == std::string::npos) {
+        return r->pending.size() <= kAuthMax;   // keep reading, bounded
+      }
+      if (!CheckHttpAuth(r->pending, token_)) return false;
+      forward = r->pending;  // forwarded unmodified
+    }
+    r->pending.clear();
+    r->authed = true;
+    std::string key = GraceKey(r);
+    if (!key.empty()) unlocked_[key] = Now() + kGraceSec;
+    if (!AttachUpstream(r)) return false;   // upstream only after auth
+    if (forward.size() > kBufSize) return false;  // cannot happen (<=16K)
+    memcpy(r->c2u.buf, forward.data(), forward.size());
+    r->c2u.len = forward.size();
+    r->c2u.off = 0;
+    Rearm(r);  // c2u.len>0 arms upstream EPOLLOUT; upstream reads resume
+    return true;
   }
 
   // Move bytes for one pipe; false = fatal error on this relay.
@@ -229,6 +451,7 @@ class Proxy {
       r->connecting = false;
     }
     bool on_client = fd == r->client;
+    if (!r->authed && on_client) return Authenticate(r, evmask);
     Pipe* read_pipe = on_client ? &r->c2u : &r->u2c;   // fd is source
     Pipe* write_pipe = on_client ? &r->u2c : &r->c2u;  // fd is sink
     int peer = on_client ? r->upstream : r->client;
@@ -241,16 +464,20 @@ class Proxy {
 
   void CloseRelay(Relay* r) {
     epoll_ctl(epfd_, EPOLL_CTL_DEL, r->client, nullptr);
-    epoll_ctl(epfd_, EPOLL_CTL_DEL, r->upstream, nullptr);
     relays_.erase(r->client);
-    relays_.erase(r->upstream);
     close(r->client);
-    close(r->upstream);
+    if (r->upstream >= 0) {
+      epoll_ctl(epfd_, EPOLL_CTL_DEL, r->upstream, nullptr);
+      relays_.erase(r->upstream);
+      close(r->upstream);
+    }
     delete r;
   }
 
   std::string remote_host_;
   int remote_port_;
+  std::string token_;  // empty = open relay
+  std::unordered_map<std::string, long> unlocked_;  // grace key -> expiry
   int listener_ = -1;
   int epfd_ = -1;
   std::unordered_map<int, Relay*> relays_;  // both fds -> relay
@@ -265,7 +492,8 @@ int main(int argc, char** argv) {
     return 2;
   }
   signal(SIGPIPE, SIG_IGN);
-  Proxy proxy(argv[1], atoi(argv[2]));
+  const char* token_env = getenv("TONY_PROXY_TOKEN");
+  Proxy proxy(argv[1], atoi(argv[2]), token_env ? token_env : "");
   int port = proxy.Listen(argc == 4 ? atoi(argv[3]) : 0);
   if (port < 0) {
     perror("listen");

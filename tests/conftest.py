@@ -36,3 +36,42 @@ if "jax" in sys.modules:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+# --- shared relay-test helpers (test_proxy.py + test_native.py) ----------
+
+import socketserver  # noqa: E402
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+class EchoHandler(socketserver.BaseRequestHandler):
+    """Upper-cases everything — relay tests assert bytes crossed both ways."""
+
+    def handle(self):
+        while True:
+            data = self.request.recv(4096)
+            if not data:
+                return
+            self.request.sendall(data.upper())
+
+
+@pytest.fixture()
+def echo_server():
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), EchoHandler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+    srv.server_close()
+
+
+def recv_all(s):
+    out = b""
+    while True:
+        chunk = s.recv(65536)
+        if not chunk:
+            return out
+        out += chunk

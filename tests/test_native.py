@@ -9,11 +9,10 @@ tests off-Linux.
 import os
 import shutil
 import socket
-import socketserver
-import threading
 
 import pytest
 
+from conftest import recv_all as _recv_all  # shared relay-test helpers
 from tony_tpu.utils.native import (
     launch_native_proxy, launch_port_reservation, native_binary,
 )
@@ -26,26 +25,6 @@ pytestmark = pytest.mark.skipif(
 def test_native_binaries_build():
     assert native_binary("tony_proxy") is not None
     assert native_binary("tony_portres") is not None
-
-
-class _Echo(socketserver.BaseRequestHandler):
-    def handle(self):
-        while True:
-            data = self.request.recv(4096)
-            if not data:
-                return
-            self.request.sendall(data.upper())
-
-
-@pytest.fixture()
-def echo_server():
-    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Echo)
-    srv.daemon_threads = True
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
-    t.start()
-    yield srv.server_address[1]
-    srv.shutdown()
-    srv.server_close()
 
 
 def test_native_proxy_relays_both_directions(echo_server):
@@ -82,6 +61,84 @@ def test_native_proxy_concurrent_connections(echo_server):
             assert s.recv(100) == f"CONN{i}".upper().encode()
         for s in socks:
             s.close()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_native_proxy_token_auth(echo_server):
+    """VERDICT-r2 item 6: with TONY_PROXY_TOKEN set, the native relay
+    forwards nothing until the connection authenticates (preamble or
+    HTTP), closes unauthenticated connections, and — after one success —
+    unlocks the source address for a grace window (browser parallel
+    connections carry no credentials)."""
+    launched = launch_native_proxy("127.0.0.1", echo_server, token="tok123")
+    assert launched is not None
+    proc, port = launched
+    try:
+        # every reject case FIRST (one success unlocks this source ip)
+        for payload in (
+                b"sneaky payload\n",                                # no auth
+                b"TONY-PROXY-AUTH wrong\npayload",                  # bad tok
+                b"GET /?tony-proxy-token=no HTTP/1.1\r\nHost: x\r\n\r\n",
+                # plain ?token= belongs to the proxied app, never to us
+                b"GET /?token=tok123 HTTP/1.1\r\nHost: x\r\n\r\n",
+                b"GET / HTTP/1.1\r\nAuthorization: Bearer no\r\n\r\n"):
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5) as s:
+                s.sendall(payload)
+                s.shutdown(socket.SHUT_WR)
+                assert _recv_all(s) == b"", payload
+        # good preamble: stripped, rest relayed both ways
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(b"TONY-PROXY-AUTH tok123\nhello")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b"HELLO"
+        # source now unlocked: a bare connection relays (grace window)
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(b"bare after unlock")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b"BARE AFTER UNLOCK"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_native_proxy_http_auth_modes(echo_server):
+    """Header and query-string HTTP auth, each on a fresh proxy (so the
+    grace unlock from one case can't mask the next)."""
+    for req in (
+            b"GET / HTTP/1.1\r\nHost: x\r\n"
+            b"Authorization: Bearer tok123\r\n\r\n",
+            b"GET /tree?a=b&tony-proxy-token=tok123 HTTP/1.1\r\n"
+            b"Host: x\r\n\r\n"):
+        launched = launch_native_proxy("127.0.0.1", echo_server,
+                                       token="tok123")
+        assert launched is not None
+        proc, port = launched
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5) as s:
+                s.sendall(req)
+                s.shutdown(socket.SHUT_WR)
+                assert _recv_all(s) == req.upper()   # forwarded unmodified
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+def test_native_proxy_auth_payload_larger_than_first_read(echo_server):
+    """A valid preamble followed by a large coalesced payload must not be
+    rejected by the pre-auth buffer cap (review finding)."""
+    launched = launch_native_proxy("127.0.0.1", echo_server, token="tok123")
+    assert launched is not None
+    proc, port = launched
+    try:
+        payload = b"x" * (20 * 1024)
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(b"TONY-PROXY-AUTH tok123\n" + payload)
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == payload.upper()
     finally:
         proc.kill()
         proc.wait()

@@ -48,6 +48,19 @@ def make_app_history(intermediate, app_id, status="SUCCEEDED",
         # pin the filename's completed stamp for deterministic asserts
         want = os.path.join(app_dir, history_file_name(md))
         os.replace(path, want)
+    else:
+        # wait for the writer thread to land BOTH events: a late async
+        # write would otherwise reset the .inprogress mtime after a test
+        # back-dates it (flaky stale-mover test under load)
+        inprog = handler._inprogress_path
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if sum(1 for _ in open(inprog)) >= 2:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.01)
     if config is not None:
         with open(os.path.join(app_dir, C.PORTAL_CONFIG_FILE), "w") as f:
             json.dump(config, f)
@@ -220,3 +233,49 @@ def test_portal_404(portal):
     with pytest.raises(urllib.error.HTTPError) as exc:
         _get(portal, "/jobs/missing")
     assert exc.value.code == 404
+
+
+@pytest.fixture()
+def secure_portal(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_x", completed=2000,
+                     config={"tony.am.memory": "2g"})
+    server = PortalServer(PortalCache(inter, fin), port=0, host="127.0.0.1",
+                          token="sekrit-tok")
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_portal_requires_token(secure_portal):
+    """VERDICT-r2 item 6: every data route 401s without the bearer token —
+    job configs can embed user env (tony.execution.env k=v)."""
+    for path in ("/", "/jobs/app_x", "/config/app_x", "/logs/app_x",
+                 "/api/jobs", "/api/jobs/app_x/config"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(secure_portal, path)
+        assert exc.value.code == 401, path
+    # wrong token is still 401
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(secure_portal, "/api/jobs?token=wrong")
+    assert exc.value.code == 401
+    # non-ASCII token value must 401, not 500 (compare_digest TypeErrors
+    # on non-ASCII str operands)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(secure_portal, "/api/jobs?token=%C3%A9")
+    assert exc.value.code == 401
+    # healthz stays open for liveness probes
+    status, _ = _get(secure_portal, "/healthz")
+    assert status == 200
+
+
+def test_portal_accepts_bearer_and_query_token(secure_portal):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{secure_portal.port}/api/jobs",
+        headers={"Authorization": "Bearer sekrit-tok"})
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+        assert json.loads(resp.read())[0]["application_id"] == "app_x"
+    status, body = _get(secure_portal, "/config/app_x?token=sekrit-tok")
+    assert status == 200 and "tony.am.memory" in body

@@ -77,6 +77,60 @@ def test_server_rejects_missing_and_wrong_token():
         server.stop(grace=None)
 
 
+def test_task_token_scoped_to_task_methods():
+    """VERDICT-r2 item 6: a container's derived token authenticates
+    task-plane RPCs but cannot call client-only methods or pose as a
+    different task — a leaked container env no longer equals the client
+    secret."""
+    from tony_tpu.security.tokens import derive_task_token
+
+    secret = generate_token()
+    handler = FakeHandler()
+    server, port = serve(cluster_handler=handler, auth_token=secret)
+    try:
+        task_tok = derive_task_token(secret, "worker:0")
+        as_task = ClusterServiceClient("localhost", port, retries=1,
+                                       timeout_sec=5.0, auth_token=task_tok,
+                                       task_auth_id="worker:0")
+        as_task.task_executor_heartbeat("worker:0")   # task plane: allowed
+        assert handler.heartbeats == 1
+        for call in (as_task.get_task_infos, as_task.finish_application):
+            with pytest.raises(grpc.RpcError) as exc:
+                call()
+            assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        # payload identity is bound to the authenticated task: worker:0's
+        # token cannot heartbeat ON BEHALF OF worker:1 (review finding)
+        with pytest.raises(grpc.RpcError) as exc:
+            as_task.task_executor_heartbeat("worker:1")
+        assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        assert handler.heartbeats == 1
+        # mixed-identity forgery: a benign task_id alongside a forged
+        # job_name/job_index must not satisfy the bind — EVERY identity
+        # shape in the payload is checked (review finding)
+        with pytest.raises(grpc.RpcError) as exc:
+            as_task.call("register_execution_result", {
+                "task_id": "worker:0", "job_name": "worker",
+                "job_index": 1, "exit_code": 1, "session_id": 0})
+        assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        # identity-free payload on a task-plane method: denied, not
+        # fail-open
+        with pytest.raises(grpc.RpcError) as exc:
+            as_task.call("task_executor_heartbeat", {})
+        assert exc.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        as_task.close()
+
+        # the derived token is useless under any OTHER task identity
+        imposter = ClusterServiceClient("localhost", port, retries=1,
+                                        timeout_sec=5.0, auth_token=task_tok,
+                                        task_auth_id="worker:1")
+        with pytest.raises(grpc.RpcError) as exc:
+            imposter.task_executor_heartbeat("worker:1")
+        assert exc.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        imposter.close()
+    finally:
+        server.stop(grace=None)
+
+
 def test_secure_job_end_to_end(tmp_path):
     """Full chain with security on: client mints token, AM requires it,
     executors authenticate through env (TestTonyE2E secure-mode analogue)."""

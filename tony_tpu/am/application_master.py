@@ -505,11 +505,14 @@ class ApplicationMaster(ClusterServiceHandler):
         docker = docker_env(self.conf, task.job_name)
         if docker:
             env.update(docker)
-        # security: containers inherit the app secret (reference duplicated
-        # credentials into every launch context, ApplicationMaster.java:1137-1140)
+        # security: each container gets its task-scoped derived token, not
+        # the app secret — a leaked container env can authenticate only as
+        # that task, never as the client (reference duplicated the flat
+        # credential into every launch context,
+        # ApplicationMaster.java:1137-1140; this narrows it per principal)
         if self._auth_token:
-            from tony_tpu.security.tokens import TOKEN_ENV
-            env[TOKEN_ENV] = self._auth_token
+            from tony_tpu.security.tokens import TOKEN_ENV, derive_task_token
+            env[TOKEN_ENV] = derive_task_token(self._auth_token, task.task_id)
         return env
 
     def _on_container_completed(self, container_id: str, exit_code: int) -> None:

@@ -24,16 +24,17 @@ DEFAULT_TIMEOUT = "24h"  # reference appended a 24h timeout (:89-93)
 
 
 class _Proxy:
-    """Prefer the native epoll relay; fall back to the Python one."""
+    """Prefer the native epoll relay; fall back to the Python one.
+    With security on, the app token guards every proxy connection."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, token: str | None = None):
         self._proc = None
         self._pyproxy = None
-        launched = launch_native_proxy(host, port)
+        launched = launch_native_proxy(host, port, token=token or "")
         if launched is not None:
             self._proc, self.local_port = launched
         else:
-            self._pyproxy = ProxyServer(host, port)
+            self._pyproxy = ProxyServer(host, port, token=token)
             self._pyproxy.start()
             self.local_port = self._pyproxy.local_port
 
@@ -70,9 +71,25 @@ def submit(argv: list[str]) -> int:
                     hostport = info.url[len("http://"):].split("/", 1)[0]
                     host, _, port = hostport.rpartition(":")
                     if host and port.isdigit():
-                        proxy = _Proxy(host, int(port))
+                        # with security on, a PROXY-SCOPED derived token
+                        # guards connections — never the app secret or a
+                        # task token: this token lands in browser
+                        # history/referers, so it must carry transport
+                        # access only (distinct HMAC namespace)
+                        token = None
+                        if client.auth_token:
+                            from tony_tpu.security.tokens import (
+                                derive_proxy_token,
+                            )
+                            token = derive_proxy_token(client.auth_token,
+                                                       "notebook")
+                        proxy = _Proxy(host, int(port), token=token)
+                        # tony-proxy-token, NOT token: the plain name is
+                        # the proxied notebook's own login param
+                        suffix = (f"/?tony-proxy-token={token}"
+                                  if token else "")
                         print(f"notebook available at "
-                              f"http://127.0.0.1:{proxy.local_port}")
+                              f"http://127.0.0.1:{proxy.local_port}{suffix}")
                         break
             time.sleep(1)
         runner.join()

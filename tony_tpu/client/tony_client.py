@@ -76,6 +76,12 @@ class TonyClient:
         self.final_status = "UNDEFINED"
         self.final_message: Optional[str] = None
 
+    @property
+    def auth_token(self) -> Optional[str]:
+        """The app secret when security is enabled (None otherwise);
+        consumers (notebook proxy, portal) gate access with it."""
+        return self._auth_token
+
     # ------------------------------------------------------------------
     def add_listener(self, listener: ClientListener) -> None:
         self._listeners.append(listener)

@@ -25,6 +25,7 @@ NO_DEFAULT_KEYS = frozenset({
     K.KEYTAB_USER,
     K.KEYTAB_LOCATION,
     K.PORTAL_URL,
+    K.PORTAL_TOKEN_FILE,
     K.SRC_DIR,
     K.PYTHON_VENV,
     K.EXECUTION_ENV,

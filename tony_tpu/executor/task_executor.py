@@ -134,15 +134,20 @@ class TaskExecutor:
         self.port = 0
         self.tb_port: Optional[int] = None
         self._port_reservation = None
-        # security: the AM passes the app secret via env (launch-context
-        # credential duplication, ApplicationMaster.java:1137-1140)
+        # security: the AM passes a per-task derived token via env (scoped
+        # replacement for the reference's launch-context credential
+        # duplication, ApplicationMaster.java:1137-1140); the task id rides
+        # the call metadata so the AM can re-derive and verify
         from tony_tpu.security.tokens import TOKEN_ENV
         token = e.get(TOKEN_ENV) or None
+        task_auth = self.task_id if token else None
         self.client = ClusterServiceClient(self.am_host, self.am_port,
-                                           auth_token=token)
+                                           auth_token=token,
+                                           task_auth_id=task_auth)
         self.metrics_client = MetricsServiceClient(self.am_host,
                                                    self.metrics_port,
-                                                   auth_token=token)
+                                                   auth_token=token,
+                                                   task_auth_id=task_auth)
         self.heartbeater: Optional[Heartbeater] = None
         self.monitor: Optional[TaskMonitor] = None
         self._user_proc = None

@@ -26,6 +26,9 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=None)
     parser.add_argument("--history-location", default=None,
                         help="overrides tony.history.location")
+    parser.add_argument("--token-file", default=None,
+                        help="bearer token file gating all routes "
+                             "(overrides tony.portal.token-file)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -50,7 +53,14 @@ def main(argv=None) -> int:
         conf.get_time_ms(K.HISTORY_PURGER_INTERVAL_MS, 6 * 3600 * 1000))
     port = args.port if args.port is not None else conf.get_int(
         K.PORTAL_PORT, 19886)
-    server = PortalServer(cache, port=port)
+    token = None
+    token_file = args.token_file or conf.get_str(K.PORTAL_TOKEN_FILE)
+    if token_file:
+        with open(token_file, "r", encoding="utf-8") as f:
+            token = f.read().strip()
+        if not token:
+            raise SystemExit(f"empty portal token file: {token_file}")
+    server = PortalServer(cache, port=port, token=token)
 
     mover.start()
     purger.start()

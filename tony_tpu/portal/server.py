@@ -12,10 +12,11 @@ from __future__ import annotations
 import html
 import json
 import logging
+import secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from tony_tpu.portal.cache import PortalCache
 
@@ -49,6 +50,7 @@ def _fmt_ts(ms: int) -> str:
 
 class _Handler(BaseHTTPRequestHandler):
     cache: PortalCache  # injected by PortalServer
+    token: Optional[str] = None  # injected by PortalServer; None = open
 
     # -- plumbing ----------------------------------------------------------
     def log_message(self, fmt, *args):  # route through logging, not stderr
@@ -69,15 +71,45 @@ class _Handler(BaseHTTPRequestHandler):
     def _json(self, obj: Any, code: int = 200) -> None:
         self._send(code, json.dumps(obj, indent=1), "application/json")
 
+    def _authorized(self) -> bool:
+        """Bearer-token gate (VERDICT r2 item 6): constant-time compare of
+        `Authorization: Bearer <tok>` or `?token=<tok>` against the
+        configured portal token. Job configs can embed user env k=v pairs
+        (tony.execution.env), so every data route is gated."""
+        if self.token is None:
+            return True
+        supplied = ""
+        via_query = False
+        auth = self.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            supplied = auth[len("Bearer "):].strip()
+        else:
+            qs = parse_qs(urlparse(self.path).query)
+            supplied = (qs.get("token") or [""])[0]
+            via_query = True
+        # byte compare: compare_digest raises TypeError on non-ASCII str
+        # operands, which a scanner's %C3%A9-style token would trigger
+        ok = secrets.compare_digest(supplied.encode("utf-8", "replace"),
+                                    self.token.encode())
+        # query-authenticated browsers don't resend the token on link
+        # clicks — propagate it into generated page links
+        self._link_qs = f"?token={supplied}" if ok and via_query else ""
+        return ok
+
     # -- routing -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
         path = urlparse(self.path).path.rstrip("/") or "/"
         parts = [p for p in path.split("/") if p]
         try:
+            if path == "/healthz":   # liveness probe stays tokenless
+                return self._json({"ok": True})
+            if not self._authorized():
+                if parts and parts[0] == "api":
+                    return self._json({"error": "unauthorized"}, 401)
+                return self._html("unauthorized",
+                                  "<p>401: missing or invalid token</p>", 401)
             if path == "/":
                 return self._index()
-            if path == "/healthz":
-                return self._json({"ok": True})
             if parts[0] == "api":
                 return self._api(parts[1:])
             if len(parts) == 2 and parts[0] in ("jobs", "config", "logs"):
@@ -108,16 +140,17 @@ class _Handler(BaseHTTPRequestHandler):
     # -- pages (reference: 4 page controllers) -----------------------------
     def _index(self) -> None:
         rows = []
+        qs = getattr(self, "_link_qs", "")
         for m in self.cache.list_metadata():
             app = html.escape(m.application_id)
             rows.append([
-                f'<a href="/jobs/{app}">{app}</a>',
+                f'<a href="/jobs/{app}{qs}">{app}</a>',
                 html.escape(m.user),
                 _fmt_ts(m.started), _fmt_ts(m.completed),
                 f'<span class="{html.escape(m.status)}">'
                 f'{html.escape(m.status)}</span>',
-                f'<a href="/config/{app}">config</a> '
-                f'<a href="/logs/{app}">logs</a>',
+                f'<a href="/config/{app}{qs}">config</a> '
+                f'<a href="/logs/{app}{qs}">logs</a>',
             ])
         self._html("TonY-TPU jobs",
                    _table(["Job", "User", "Started", "Completed", "Status",
@@ -157,9 +190,10 @@ class PortalServer:
     """Owns the HTTP server plus the mover/purger daemons."""
 
     def __init__(self, cache: PortalCache, port: int = 0,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", token: Optional[str] = None):
         self.cache = cache
-        handler = type("BoundHandler", (_Handler,), {"cache": cache})
+        handler = type("BoundHandler", (_Handler,),
+                       {"cache": cache, "token": token})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,

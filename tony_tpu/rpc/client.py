@@ -29,13 +29,16 @@ class _JsonRpcClient:
                  retries: int = DEFAULT_RETRIES,
                  retry_sleep_sec: float = DEFAULT_RETRY_SLEEP_SEC,
                  timeout_sec: float = 30.0,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 task_auth_id: Optional[str] = None):
         from tony_tpu.security.tokens import token_call_creds
         self._channel = grpc.insecure_channel(f"{host}:{port}")
         self._retries = retries
         self._retry_sleep_sec = retry_sleep_sec
         self._timeout_sec = timeout_sec
-        self._metadata = token_call_creds(auth_token)
+        # task_auth_id marks auth_token as a per-task derived token (the
+        # AM re-derives and checks it against this id)
+        self._metadata = token_call_creds(auth_token, task_auth_id)
         self._stubs = {
             m: self._channel.unary_unary(
                 f"/{service}/{m}",

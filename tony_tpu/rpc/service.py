@@ -37,6 +37,10 @@ CLUSTER_METHODS = (
 )
 METRICS_METHODS = ("update_metrics",)
 
+# Methods a per-task derived token may NOT call (client↔AM surface only;
+# the reference expressed this as service ACLs, TonyPolicyProvider.java:23).
+CLIENT_ONLY_METHODS = frozenset({"get_task_infos", "finish_application"})
+
 
 def _ser(obj: Any) -> bytes:
     return json.dumps(obj).encode("utf-8")
@@ -113,7 +117,8 @@ def serve(cluster_handler: Optional[ClusterServiceHandler] = None,
     interceptors = ()
     if auth_token:
         from tony_tpu.security.tokens import TokenAuthInterceptor
-        interceptors = (TokenAuthInterceptor(auth_token),)
+        interceptors = (TokenAuthInterceptor(auth_token,
+                                             client_only=CLIENT_ONLY_METHODS),)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          interceptors=interceptors)
     if cluster_handler is not None:

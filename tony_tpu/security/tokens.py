@@ -14,19 +14,41 @@ container), minus the KDC. Toggle: `tony.application.security.enabled`
 
 from __future__ import annotations
 
+import hmac
 import os
 import secrets
-from typing import Optional
+from typing import Any, Iterable, Optional
 
 import grpc
 
 TOKEN_METADATA_KEY = "tony-token"
+TASK_ID_METADATA_KEY = "tony-task-id"
 TOKEN_FILE = ".tony-token"
 TOKEN_ENV = "TONY_SECURITY_TOKEN"
 
 
 def generate_token() -> str:
     return secrets.token_hex(32)
+
+
+def derive_task_token(secret: str, task_id: str) -> str:
+    """Per-task nonce: HMAC(app secret, task id). Containers receive ONLY
+    their derived token, so a leaked container env can authenticate as that
+    task but cannot impersonate the client (whose RPCs require the app
+    secret) or another task. Mirrors the reference's per-container
+    credential duplication (ApplicationMaster.java:1137-1140) but with
+    task-scoped keys instead of one flat secret."""
+    return hmac.new(secret.encode(), f"task:{task_id}".encode(),
+                    "sha256").hexdigest()
+
+
+def derive_proxy_token(secret: str, name: str) -> str:
+    """Transport-only token for a proxy/portal surface, in a DISTINCT HMAC
+    namespace from task tokens: a leaked proxy token (browser history,
+    Referer) must never double as an AM RPC credential — `derive_task_token`
+    output would (the interceptor accepts any valid task:<id> pair)."""
+    return hmac.new(secret.encode(), f"proxy:{name}".encode(),
+                    "sha256").hexdigest()
 
 
 def write_token_file(app_dir: str, token: str) -> str:
@@ -50,26 +72,113 @@ def read_token_file(app_dir: str) -> Optional[str]:
 
 
 class TokenAuthInterceptor(grpc.ServerInterceptor):
-    """Rejects calls whose metadata lacks the app token
-    (UNAUTHENTICATED, like Hadoop IPC's SASL failure surface)."""
+    """Rejects calls whose metadata lacks a valid token
+    (UNAUTHENTICATED, like Hadoop IPC's SASL failure surface).
 
-    def __init__(self, token: str):
+    Two principals, like the reference's ClientToAM secret manager + service
+    ACLs (ApplicationMaster.java:432-452, TonyPolicyProvider.java:23):
+    - the app secret authenticates everything (client, AM-internal);
+    - a per-task derived token (`derive_task_token`) + the task id in
+      `tony-task-id` metadata authenticates task-scoped methods only —
+      methods listed in `client_only` answer PERMISSION_DENIED to it."""
+
+    def __init__(self, token: str, client_only: Iterable[str] = ()):
         self._token = token
+        self._client_only = frozenset(client_only)
 
         def deny(request, context):
             context.abort(grpc.StatusCode.UNAUTHENTICATED,
                           "missing or invalid tony token")
 
+        def forbid(request, context):
+            context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                          "method not allowed for a task token")
+
         self._deny = grpc.unary_unary_rpc_method_handler(deny)
+        self._forbid = grpc.unary_unary_rpc_method_handler(forbid)
 
     def intercept_service(self, continuation, handler_call_details):
         meta = dict(handler_call_details.invocation_metadata or ())
         supplied = meta.get(TOKEN_METADATA_KEY, "")
         if secrets.compare_digest(supplied, self._token):
             return continuation(handler_call_details)
+        task_id = meta.get(TASK_ID_METADATA_KEY, "")
+        if task_id and secrets.compare_digest(
+                supplied, derive_task_token(self._token, task_id)):
+            method = handler_call_details.method.rsplit("/", 1)[-1]
+            # fail CLOSED: a task token may only call methods with a
+            # declared identity shape (client-only and unknown methods are
+            # both forbidden — a new RPC must be added to
+            # TASK_METHOD_IDENTITY before task tokens can reach it)
+            if method in self._client_only \
+                    or method not in TASK_METHOD_IDENTITY:
+                return self._forbid
+            return _bind_task_identity(continuation(handler_call_details),
+                                       task_id)
         return self._deny
 
 
-def token_call_creds(token: Optional[str]) -> list[tuple[str, str]]:
-    """Metadata list a client attaches per call ([] when security is off)."""
-    return [(TOKEN_METADATA_KEY, token)] if token else []
+# Task-plane methods a per-task token may call, with the payload fields
+# naming the task they act on. Methods absent here are client-plane (or
+# unknown) and fail closed for task tokens.
+TASK_METHOD_IDENTITY = {
+    "get_cluster_spec": ("task_id",),
+    "register_worker_spec": ("task_id",),
+    "register_tensorboard_url": ("task_id",),
+    "task_executor_heartbeat": ("task_id",),
+    "register_execution_result": ("job_name", "job_index"),
+    "update_metrics": ("task_type", "index"),
+}
+
+
+def _payload_identities(req: Any) -> list[str]:
+    """EVERY task identity the payload expresses, in task-id form. All of
+    them must match the authenticated task — checking only the first shape
+    would let a forged payload carry a benign 'task_id' while the handler
+    reads 'job_name'/'job_index'."""
+    ids = []
+    if isinstance(req, dict):
+        if "task_id" in req:
+            ids.append(str(req["task_id"]))
+        if "job_name" in req and "job_index" in req:
+            ids.append(f"{req['job_name']}:{req['job_index']}")
+        if "task_type" in req and "index" in req:
+            ids.append(f"{req['task_type']}:{req['index']}")
+    return ids
+
+
+def _bind_task_identity(handler, task_id: str):
+    """Wrap an RPC handler so a task-token caller can only speak about
+    ITSELF: the payload must express at least one task identity and every
+    identity-shaped field in it must match the authenticated task id
+    (handlers trust req['task_id'] etc. — without this a leaked worker:0
+    env could heartbeat for worker:1 or forge another task's execution
+    result)."""
+    if handler is None or handler.unary_unary is None:
+        return handler
+    inner = handler.unary_unary
+
+    def bound(request, context):
+        ids = _payload_identities(request)
+        if not ids or any(i != task_id for i in ids):
+            context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                          "payload identity does not match "
+                          "authenticated task")
+        return inner(request, context)
+
+    return grpc.unary_unary_rpc_method_handler(
+        bound, request_deserializer=handler.request_deserializer,
+        response_serializer=handler.response_serializer)
+
+
+def token_call_creds(token: Optional[str],
+                     task_id: Optional[str] = None) -> list[tuple[str, str]]:
+    """Metadata list a client attaches per call ([] when security is off).
+    Executors pass their `task_id` so the AM can verify their per-task
+    derived token."""
+    if not token:
+        return []
+    meta = [(TOKEN_METADATA_KEY, token)]
+    if task_id:
+        meta.append((TASK_ID_METADATA_KEY, task_id))
+    return meta

@@ -73,8 +73,13 @@ class TpuMetricsReporter:
         try:
             if self._client is None:
                 from tony_tpu.rpc.client import MetricsServiceClient
+                # env token is the per-task derived token (see
+                # tokens.derive_task_token); identify the task for re-derive
+                task_auth = (f"{self._task_type}:{self._index}"
+                             if self._token else None)
                 self._client = MetricsServiceClient(
-                    self._host, self._port, auth_token=self._token)
+                    self._host, self._port, auth_token=self._token,
+                    task_auth_id=task_auth)
             self._client.call("update_metrics", {
                 "task_type": self._task_type, "index": self._index,
                 "metrics": metrics}, retries=1, timeout_sec=5.0,

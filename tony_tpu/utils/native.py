@@ -55,16 +55,23 @@ def native_binary(name: str) -> Optional[str]:
 
 
 def launch_native_proxy(remote_host: str, remote_port: int,
-                        local_port: int = 0):
+                        local_port: int = 0, token: str = ""):
     """Start the native proxy; returns (Popen, bound_local_port) or None if
-    native is unavailable. Caller owns the process."""
+    native is unavailable. Caller owns the process. `token` (passed via
+    env, never argv) makes the relay require connection auth — see
+    tony_tpu/proxy.py module docstring for the protocol."""
     binary = native_binary("tony_proxy")
     if binary is None:
         return None
     argv = [binary, remote_host, str(remote_port)]
     if local_port:
         argv.append(str(local_port))
-    proc = subprocess.Popen(argv, stdout=subprocess.PIPE, text=True)
+    env = dict(os.environ)
+    if token:
+        env["TONY_PROXY_TOKEN"] = token
+    else:
+        env.pop("TONY_PROXY_TOKEN", None)
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE, text=True, env=env)
     line = proc.stdout.readline()  # "proxying 127.0.0.1:<port> -> ..."
     try:
         bound = int(line.split("->")[0].strip().rsplit(":", 1)[1])
