@@ -47,6 +47,8 @@ constexpr size_t kBufSize = 64 * 1024;
 constexpr int kMaxEvents = 256;
 constexpr size_t kAuthMax = 8 * 1024;  // auth must fit the first 8 KB
 constexpr long kGraceSec = 600;        // sliding source-address unlock
+constexpr long kAuthTimeoutSec = 10;   // pre-auth gate bound (matches
+                                       // the Python _AUTH_TIMEOUT_SEC)
 const char kAuthPreamble[] = "TONY-PROXY-AUTH ";
 
 bool ConstTimeEq(const std::string& a, const std::string& b) {
@@ -127,8 +129,9 @@ struct Relay {
   bool connecting = true;   // upstream connect() in flight
   bool doomed = false;      // close deferred to end of event batch
   bool authed = true;       // false until the auth gate passes (token mode)
-  uint32_t source = 0;      // client IPv4 (s_addr) for the grace key
-  uint16_t source_port = 0;  // client source port (host order)
+  bool grace = false;       // source unlocked: credentials optional
+  long auth_deadline = 0;   // pre-auth wall-clock bound (CLOCK_MONOTONIC s)
+  std::string grace_key;    // computed once at accept (PeerUid scans /proc)
   std::string pending;      // pre-auth client bytes (bounded by kAuthMax)
   Pipe c2u, u2c;            // client->upstream, upstream->client
 };
@@ -217,11 +220,14 @@ class Proxy {
     epoll_event events[kMaxEvents];
     std::vector<Relay*> doomed;
     for (;;) {
-      int n = epoll_wait(epfd_, events, kMaxEvents, -1);
+      // 1s tick (token mode) so pre-auth deadlines fire without events
+      int n = epoll_wait(epfd_, events, kMaxEvents,
+                         token_.empty() ? -1 : 1000);
       if (n < 0) {
         if (errno == EINTR) continue;
         return 1;
       }
+      if (!token_.empty()) SweepAuthDeadlines();
       // Closes are deferred to the end of the batch: closing mid-batch
       // frees fd numbers that a same-batch Accept() could reuse, making a
       // stale queued event hit the wrong (healthy) relay.
@@ -260,16 +266,22 @@ class Proxy {
 
       auto* r = new Relay();
       r->client = cfd;
-      r->source = peer.sin_addr.s_addr;
-      r->source_port = ntohs(peer.sin_port);
-      // browsers open extra connections without credentials: one
-      // successful auth unlocks the source (peer UID on loopback, IP
-      // otherwise) for a sliding window (see tony_tpu/proxy.py)
-      r->authed = token_.empty() || SourceUnlocked(GraceKey(r));
+      if (!token_.empty()) {
+        // browsers open extra connections without credentials: one
+        // successful auth unlocks the source (peer UID on loopback, IP
+        // otherwise) for a sliding window (see tony_tpu/proxy.py). Even
+        // unlocked connections go through Authenticate: a preamble line,
+        // if present, must be consumed/verified, never relayed upstream.
+        r->grace_key = GraceKey(peer.sin_addr.s_addr,
+                                ntohs(peer.sin_port));
+        r->grace = SourceUnlocked(r->grace_key);
+        r->authed = false;
+        r->auth_deadline = Now() + kAuthTimeoutSec;
+      }
       relays_[cfd] = r;
       Register(cfd);
-      // the upstream is only contacted AFTER auth: rejected probes must
-      // not cost the in-cluster server connect/teardown churn
+      // the upstream is only contacted AFTER the auth gate: rejected
+      // probes must not cost the in-cluster server connect churn
       if (r->authed && !AttachUpstream(r)) {
         CloseRelay(r);
         continue;
@@ -280,14 +292,14 @@ class Proxy {
 
   // grace key: "uid:<uid>" on loopback (IP can't distinguish local
   // users), "ip:<addr>" otherwise; "" = no grace possible
-  std::string GraceKey(const Relay* r) const {
+  std::string GraceKey(uint32_t s_addr, uint16_t port) const {
     char buf[48];
-    if (IsLoopback(r->source)) {
-      long uid = PeerUid(r->source, r->source_port);
+    if (IsLoopback(s_addr)) {
+      long uid = PeerUid(s_addr, port);
       if (uid < 0) return "";
       snprintf(buf, sizeof(buf), "uid:%ld", uid);
     } else {
-      snprintf(buf, sizeof(buf), "ip:%08X", r->source);
+      snprintf(buf, sizeof(buf), "ip:%08X", s_addr);
     }
     return buf;
   }
@@ -361,43 +373,15 @@ class Proxy {
     return it != unlocked_.end() && it->second >= Now();
   }
 
-  // Pre-relay auth gate: buffer client bytes until a decision.
-  // false = reject (doom the relay); true = authed or still waiting.
-  bool Authenticate(Relay* r, uint32_t evmask) {
-    if (!(evmask & EPOLLIN)) return true;
-    // chunk cap kAuthMax keeps pending <= 2*kAuthMax so a stripped-
-    // preamble remainder always fits the 64K relay buffer below
-    char tmp[kAuthMax];
-    ssize_t got = read(r->client, tmp, kAuthMax);
-    if (got == 0) return false;  // EOF before auth
-    if (got < 0) {
-      return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
-    }
-    r->pending.append(tmp, static_cast<size_t>(got));
-    size_t nl = r->pending.find('\n');
-    if (nl == std::string::npos) {
-      // no decision line yet: keep reading, bounded
-      return r->pending.size() <= kAuthMax;
-    }
-    std::string forward;
-    if (r->pending.rfind(kAuthPreamble, 0) == 0) {
-      std::string line = r->pending.substr(0, nl);
-      if (!line.empty() && line.back() == '\r') line.pop_back();
-      if (!ConstTimeEq(line.substr(sizeof(kAuthPreamble) - 1), token_))
-        return false;
-      forward = r->pending.substr(nl + 1);  // preamble stripped
-    } else {
-      // HTTP mode: need the full header block for Authorization
-      if (r->pending.find("\r\n\r\n") == std::string::npos) {
-        return r->pending.size() <= kAuthMax;   // keep reading, bounded
-      }
-      if (!CheckHttpAuth(r->pending, token_)) return false;
-      forward = r->pending;  // forwarded unmodified
-    }
+  // Complete the auth gate: mark authed, slide the window if credentials
+  // were verified, connect the upstream, queue `forward` to it.
+  // `forward` BY VALUE: callers pass r->pending itself, and the clear()
+  // below would otherwise wipe the bytes before they are queued.
+  bool FinishAuth(Relay* r, std::string forward, bool verified) {
     r->pending.clear();
     r->authed = true;
-    std::string key = GraceKey(r);
-    if (!key.empty()) unlocked_[key] = Now() + kGraceSec;
+    if (verified && !r->grace_key.empty())
+      unlocked_[r->grace_key] = Now() + kGraceSec;
     if (!AttachUpstream(r)) return false;   // upstream only after auth
     if (forward.size() > kBufSize) return false;  // cannot happen (<=16K)
     memcpy(r->c2u.buf, forward.data(), forward.size());
@@ -405,6 +389,67 @@ class Proxy {
     r->c2u.off = 0;
     Rearm(r);  // c2u.len>0 arms upstream EPOLLOUT; upstream reads resume
     return true;
+  }
+
+  // Pre-relay auth gate: buffer client bytes until a decision.
+  // false = reject (doom the relay); true = authed or still waiting.
+  // Grace connections (source unlocked) may relay WITHOUT credentials,
+  // but a preamble line, if present, is still consumed and verified —
+  // it carries the token and must never reach the upstream as payload.
+  bool Authenticate(Relay* r, uint32_t evmask) {
+    if (!(evmask & EPOLLIN)) return true;
+    // chunk cap kAuthMax keeps pending <= 2*kAuthMax so a stripped-
+    // preamble remainder always fits the 64K relay buffer below
+    char tmp[kAuthMax];
+    ssize_t got = read(r->client, tmp, kAuthMax);
+    if (got == 0) return false;  // EOF before auth (nothing to relay)
+    if (got < 0) {
+      return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+    }
+    r->pending.append(tmp, static_cast<size_t>(got));
+    const size_t pre_len = sizeof(kAuthPreamble) - 1;
+    if (r->pending.size() < pre_len &&
+        memcmp(kAuthPreamble, r->pending.data(), r->pending.size()) == 0) {
+      return true;   // could still become a preamble — keep reading
+    }
+    if (r->pending.rfind(kAuthPreamble, 0) == 0) {
+      size_t nl = r->pending.find('\n');
+      if (nl == std::string::npos)
+        return r->pending.size() <= kAuthMax;   // wait for the line
+      std::string line = r->pending.substr(0, nl);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      if (!ConstTimeEq(line.substr(pre_len), token_)) return false;
+      return FinishAuth(r, r->pending.substr(nl + 1), true);
+    }
+    if (r->grace) {
+      // unlocked source, not a preamble: bare relay
+      return FinishAuth(r, r->pending, false);
+    }
+    // HTTP mode: need the full header block for Authorization
+    if (r->pending.find("\r\n\r\n") == std::string::npos) {
+      return r->pending.size() <= kAuthMax;   // keep reading, bounded
+    }
+    if (!CheckHttpAuth(r->pending, token_)) return false;
+    return FinishAuth(r, r->pending, true);   // forwarded unmodified
+  }
+
+  // Pre-auth connections must not pin fds forever: a silent-but-alive
+  // peer passes TCP keepalive, so sweep on a wall-clock deadline. Grace
+  // connections stalled mid-prefix complete as bare relays instead.
+  void SweepAuthDeadlines() {
+    std::vector<Relay*> expired;
+    long now = Now();
+    for (auto& kv : relays_) {
+      Relay* r = kv.second;
+      if (!r->authed && !r->doomed && r->auth_deadline < now)
+        expired.push_back(r);
+    }
+    for (Relay* r : expired) {
+      if (r->grace) {
+        if (FinishAuth(r, r->pending, false)) continue;
+      }
+      CloseRelay(r);
+    }
   }
 
   // Move bytes for one pipe; false = fatal error on this relay.
@@ -452,6 +497,14 @@ class Proxy {
     }
     bool on_client = fd == r->client;
     if (!r->authed && on_client) return Authenticate(r, evmask);
+    if (r->connecting) {
+      // upstream connect still in flight (auth completes before the
+      // connect with the deferred-attach design): pumping now would
+      // write() into an unconnected socket (ENOTCONN) and doom the
+      // relay. Level-triggered epoll re-delivers once it's up.
+      Rearm(r);
+      return true;
+    }
     Pipe* read_pipe = on_client ? &r->c2u : &r->u2c;   // fd is source
     Pipe* write_pipe = on_client ? &r->u2c : &r->c2u;  // fd is sink
     int peer = on_client ? r->upstream : r->client;

@@ -11,6 +11,7 @@ store, which is what proves the shared-filesystem assumption is gone
 from __future__ import annotations
 
 import os
+import stat
 
 import pytest
 
@@ -19,14 +20,59 @@ from tony_tpu import constants as C
 from test_e2e import _dump_logs, run_job, script
 
 
-def remote_overrides(tmp_path, nodes="nodeA:3,nodeB:3"):
+def remote_overrides(tmp_path, nodes="nodeA:3,nodeB:3", transport="exec"):
     return {
         "tony.cluster.backend": "remote",
         "tony.cluster.nodes": nodes,
-        "tony.cluster.node-transport": "exec",
+        "tony.cluster.node-transport": transport,
         "tony.cluster.node-root": str(tmp_path / "nodes"),
         "tony.staging.location": str(tmp_path / "shared-store"),
     }
+
+
+# ---------------------------------------------------------------------------
+# ssh shim (VERDICT-r2 item 7): a PATH-shimmed `ssh` that parses the real
+# argv shape (`ssh -o k=v ... host cmd`) and runs the remote command in a
+# local `bash -c` with stdin passed through — so SSHTransport.launch/kill
+# themselves (script-over-stdin, pidfile pgid kill, rc-255 branch) are the
+# code under test, mirroring the fake-gsutil pattern in test_storage.py.
+# ---------------------------------------------------------------------------
+
+_SSH_SHIM = """#!/usr/bin/env bash
+args=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -o) shift 2 ;;          # -o consumes its value, like real ssh
+    -*) shift ;;
+    *) args+=("$1"); shift ;;
+  esac
+done
+host="${args[0]}"
+cmd="${args[1]}"
+if [ -n "${TONY_SSH_SHIM_LOG:-}" ]; then
+  printf '%s :: %s\\n' "$host" "$cmd" >> "$TONY_SSH_SHIM_LOG"
+fi
+if [ "$host" = "brokenhost" ]; then
+  exit 255                   # ssh's transport-failure rc
+fi
+exec bash -c "$cmd"
+"""
+
+
+@pytest.fixture()
+def ssh_shim(tmp_path, monkeypatch):
+    """Install the shim first on PATH (inherited by the AM subprocess)
+    and return the path of its call log."""
+    shim_dir = tmp_path / "sshshim"
+    shim_dir.mkdir()
+    shim = shim_dir / "ssh"
+    shim.write_text(_SSH_SHIM)
+    shim.chmod(shim.stat().st_mode | stat.S_IXUSR | stat.S_IXGRP)
+    monkeypatch.setenv("PATH", f"{shim_dir}{os.pathsep}"
+                               f"{os.environ.get('PATH', '')}")
+    log = tmp_path / "ssh_calls.log"
+    monkeypatch.setenv("TONY_SSH_SHIM_LOG", str(log))
+    return log
 
 
 def _node_workdirs(tmp_path):
@@ -99,6 +145,55 @@ def test_worker_failure_fails_app_on_remote_backend(tmp_path):
          "--conf", "tony.worker.instances=1"],
         conf_overrides=remote_overrides(tmp_path, nodes="nodeA:1"))
     assert client.final_status == "FAILED"
+
+
+def test_gang_barrier_over_ssh_transport(tmp_path, ssh_shim):
+    """The full chain with transport=ssh through the shim: launch scripts
+    travel over stdin into `bash -s`, conf localizes through the store,
+    2 workers gang-rendezvous."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_jax_env.py"),
+         "--conf", "tony.worker.instances=2"],
+        conf_overrides=remote_overrides(tmp_path, transport="ssh"))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    calls = ssh_shim.read_text() if ssh_shim.exists() else ""
+    launches = [ln for ln in calls.splitlines() if ":: bash -s" in ln]
+    assert len(launches) == 2, calls
+    assert {ln.split(" :: ")[0] for ln in launches} == {"nodeA", "nodeB"}
+    for wd in _node_workdirs(tmp_path):
+        assert (tmp_path / "nodes" / wd / C.TONY_FINAL_CONF).exists()
+
+
+def test_am_retry_kills_stale_executors_over_ssh(tmp_path, ssh_shim):
+    """Session retry on transport=ssh: attempt 0's containers are killed
+    through SSHTransport.kill — the pidfile pgid TERM/KILL one-liner runs
+    over the shim channel — and attempt 1 succeeds."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0_if_retry.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.am.retry-count=2"],
+        conf_overrides=remote_overrides(tmp_path, transport="ssh"))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    calls = ssh_shim.read_text() if ssh_shim.exists() else ""
+    kills = [ln for ln in calls.splitlines() if "kill -TERM" in ln]
+    assert kills, f"no transport kills recorded:\n{calls}"
+    assert all("container.pid" in ln for ln in kills)
+
+
+def test_ssh_transport_failure_rc255_fails_task(tmp_path, ssh_shim):
+    """A node whose ssh channel dies with rc 255 (transport failure) must
+    surface as a failed container -> FAILED app, exercising the rc-255
+    branch in RemoteClusterBackend._wait_container."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.task.registration-timeout-sec=5"],
+        conf_overrides=remote_overrides(tmp_path, nodes="brokenhost:1",
+                                        transport="ssh"))
+    assert client.final_status == "FAILED", _dump_logs(client)
 
 
 def test_src_dir_ships_through_store_to_nodes(tmp_path):

@@ -99,6 +99,16 @@ def test_native_proxy_token_auth(echo_server):
             s.sendall(b"bare after unlock")
             s.shutdown(socket.SHUT_WR)
             assert _recv_all(s) == b"BARE AFTER UNLOCK"
+        # a preamble during the grace window is still consumed/verified —
+        # the token line must never reach the upstream as payload
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(b"TONY-PROXY-AUTH tok123\nagain")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b"AGAIN"
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as s:
+            s.sendall(b"TONY-PROXY-AUTH wrong\npayload")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b""
     finally:
         proc.kill()
         proc.wait()

@@ -71,6 +71,18 @@ def test_python_proxy_token_auth(echo_server):
             s.sendall(b"bare after unlock")
             s.shutdown(socket.SHUT_WR)
             assert _recv_all(s) == b"BARE AFTER UNLOCK"
+        # a preamble sent DURING the grace window is still consumed and
+        # verified — the token line must never reach the upstream as
+        # payload (review finding)
+        with _conn(proxy.local_port) as s:
+            s.sendall(auth_preamble("tok123") + b"again")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b"AGAIN"
+        # ...and a WRONG preamble under grace is rejected, not relayed
+        with _conn(proxy.local_port) as s:
+            s.sendall(b"TONY-PROXY-AUTH wrong\npayload")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b""
     finally:
         proxy.stop()
 

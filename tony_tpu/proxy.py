@@ -145,28 +145,46 @@ def _check_http_auth(buf: bytes, token: str) -> bool:
     return False
 
 
-def _authenticate(conn: socket.socket, token: str) -> bytes | None:
-    """Read until an auth decision. Returns the bytes to forward upstream
-    (preamble stripped) or None to reject."""
+def _authenticate(conn: socket.socket, token: str,
+                  grace: bool = False) -> tuple[bytes, bool] | None:
+    """Read until an auth decision. Returns (bytes_to_forward,
+    credentials_verified) or None to reject.
+
+    With `grace` (source already unlocked), credentials are OPTIONAL — but
+    a preamble line, if present, is still consumed and verified rather
+    than relayed upstream as payload (it contains the token!); verifying
+    it is what slides the unlock window."""
     import hmac
     conn.settimeout(_AUTH_TIMEOUT_SEC)
     buf = b""
     try:
         while len(buf) < _AUTH_MAX:
-            chunk = conn.recv(_BUF)
+            try:
+                chunk = conn.recv(_BUF)
+            except TimeoutError:
+                # a grace client that paused mid-stream is a bare relay;
+                # a locked client that never authenticated is rejected
+                return (buf, False) if grace else None
             if not chunk:
-                return None
+                return (buf, False) if grace and buf else None
             buf += chunk
-            if b"\n" in buf:
+            if len(buf) < len(_AUTH_PREAMBLE) and \
+                    _AUTH_PREAMBLE.startswith(buf):
+                continue   # could still become a preamble — keep reading
+            if buf.startswith(_AUTH_PREAMBLE):
+                if b"\n" not in buf:
+                    continue
                 line, _, rest = buf.partition(b"\n")
-                if line.startswith(_AUTH_PREAMBLE):
-                    supplied = line[len(_AUTH_PREAMBLE):].strip(b"\r")
-                    return rest if hmac.compare_digest(supplied,
-                                                       token.encode()) \
-                        else None
-                # HTTP mode: need the full header block to see Authorization
-                if b"\r\n\r\n" in buf or len(buf) >= _AUTH_MAX:
-                    return buf if _check_http_auth(buf, token) else None
+                supplied = line[len(_AUTH_PREAMBLE):].strip(b"\r")
+                return (rest, True) if hmac.compare_digest(
+                    supplied, token.encode()) else None
+            if grace:
+                return (buf, False)   # bare relay, no credentials needed
+            if b"\n" in buf and (b"\r\n\r\n" in buf
+                                 or len(buf) >= _AUTH_MAX):
+                # HTTP mode: full header block (or cap) reached
+                return (buf, True) if _check_http_auth(buf, token) \
+                    else None
         return None
     except OSError:
         return None
@@ -209,18 +227,19 @@ class ProxyServer:
         now = time.monotonic()
         if self._token is not None:
             key = _grace_key(peer)
-            if key is None or self._unlocked.get(key, 0.0) <= now:
-                forward = _authenticate(conn, self._token)
-                if forward is None:
-                    LOG.warning("proxy: unauthenticated connection rejected")
-                    conn.close()
-                    return
-                initial = forward
-                # the window extends ONLY on authenticated connections:
-                # bare connections riding the unlock must not keep it open
-                # forever (an unauthenticated poller would never expire)
-                if key is not None:
-                    self._unlocked[key] = now + _GRACE_SEC
+            unlocked = key is not None and self._unlocked.get(key,
+                                                              0.0) > now
+            result = _authenticate(conn, self._token, grace=unlocked)
+            if result is None:
+                LOG.warning("proxy: unauthenticated connection rejected")
+                conn.close()
+                return
+            initial, verified = result
+            # the window extends ONLY when credentials were verified:
+            # bare connections riding the unlock must not keep it open
+            # forever (an unauthenticated poller would never expire)
+            if verified and key is not None:
+                self._unlocked[key] = now + _GRACE_SEC
         try:
             upstream = socket.create_connection(self._remote, timeout=10)
             # 10s bounds the CONNECT only; left in place it would tear the

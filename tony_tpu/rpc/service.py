@@ -37,10 +37,6 @@ CLUSTER_METHODS = (
 )
 METRICS_METHODS = ("update_metrics",)
 
-# Methods a per-task derived token may NOT call (client↔AM surface only;
-# the reference expressed this as service ACLs, TonyPolicyProvider.java:23).
-CLIENT_ONLY_METHODS = frozenset({"get_task_infos", "finish_application"})
-
 
 def _ser(obj: Any) -> bytes:
     return json.dumps(obj).encode("utf-8")
@@ -114,11 +110,13 @@ def serve(cluster_handler: Optional[ClusterServiceHandler] = None,
     carry it in metadata (the reference's ClientToAMTokenSecretManager
     check on both servers, ApplicationMaster.java:432-452).
     Returns (server, bound_port)."""
+    # Task tokens are confined to the TASK_METHOD_IDENTITY allowlist
+    # (security/tokens.py) — the reference's service-ACL split
+    # (TonyPolicyProvider.java:23) expressed as a fail-closed allowlist.
     interceptors = ()
     if auth_token:
         from tony_tpu.security.tokens import TokenAuthInterceptor
-        interceptors = (TokenAuthInterceptor(auth_token,
-                                             client_only=CLIENT_ONLY_METHODS),)
+        interceptors = (TokenAuthInterceptor(auth_token),)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers),
                          interceptors=interceptors)
     if cluster_handler is not None:

@@ -17,7 +17,7 @@ from __future__ import annotations
 import hmac
 import os
 import secrets
-from typing import Any, Iterable, Optional
+from typing import Any, Optional
 
 import grpc
 
@@ -79,12 +79,12 @@ class TokenAuthInterceptor(grpc.ServerInterceptor):
     ACLs (ApplicationMaster.java:432-452, TonyPolicyProvider.java:23):
     - the app secret authenticates everything (client, AM-internal);
     - a per-task derived token (`derive_task_token`) + the task id in
-      `tony-task-id` metadata authenticates task-scoped methods only —
-      methods listed in `client_only` answer PERMISSION_DENIED to it."""
+      `tony-task-id` metadata authenticates ONLY the methods allowlisted
+      in TASK_METHOD_IDENTITY; everything else (client-plane methods,
+      future RPCs not yet classified) answers PERMISSION_DENIED."""
 
-    def __init__(self, token: str, client_only: Iterable[str] = ()):
+    def __init__(self, token: str):
         self._token = token
-        self._client_only = frozenset(client_only)
 
         def deny(request, context):
             context.abort(grpc.StatusCode.UNAUTHENTICATED,
@@ -106,12 +106,12 @@ class TokenAuthInterceptor(grpc.ServerInterceptor):
         if task_id and secrets.compare_digest(
                 supplied, derive_task_token(self._token, task_id)):
             method = handler_call_details.method.rsplit("/", 1)[-1]
-            # fail CLOSED: a task token may only call methods with a
-            # declared identity shape (client-only and unknown methods are
-            # both forbidden — a new RPC must be added to
-            # TASK_METHOD_IDENTITY before task tokens can reach it)
-            if method in self._client_only \
-                    or method not in TASK_METHOD_IDENTITY:
+            # fail CLOSED: a task token may only call allowlisted methods
+            # with a declared identity shape — a new RPC must be added to
+            # TASK_METHOD_IDENTITY before task tokens can reach it, and
+            # client-plane methods (get_task_infos, finish_application)
+            # are simply never listed
+            if method not in TASK_METHOD_IDENTITY:
                 return self._forbid
             return _bind_task_identity(continuation(handler_call_details),
                                        task_id)
