@@ -162,6 +162,16 @@ def child_main(backend: str) -> None:
         except Exception as e:  # metadata only — never sink the headline
             _mark(f"8b layer bench failed: {type(e).__name__}: {e}")
             result["llama3_8b_layer_error"] = f"{type(e).__name__}: {e}"
+        # live duty-cycle path (task_monitor's wedge-detection source):
+        # present on real TPU VMs via the libtpu metrics daemon; absent
+        # over the tunnel — record which, never fail the bench on it
+        try:
+            from tony_tpu.executor.tpu_metrics import LibtpuMetricsClient
+            duty = LibtpuMetricsClient(timeout_sec=2.0).duty_cycle_pct()
+            if duty is not None:
+                result["libtpu_duty_cycle_pct"] = round(duty, 2)
+        except Exception:  # noqa: BLE001
+            pass
 
     print(json.dumps(result), flush=True)
 

@@ -227,7 +227,7 @@ class Proxy {
         if (errno == EINTR) continue;
         return 1;
       }
-      if (!token_.empty()) SweepAuthDeadlines();
+
       // Closes are deferred to the end of the batch: closing mid-batch
       // frees fd numbers that a same-batch Accept() could reuse, making a
       // stale queued event hit the wrong (healthy) relay.
@@ -248,6 +248,10 @@ class Proxy {
         }
       }
       for (Relay* r : doomed) CloseRelay(r);
+      // deadline sweep runs AFTER the batch: closing mid-batch frees fd
+      // numbers a same-batch Accept() could reuse, landing stale queued
+      // events on the wrong relay (same invariant as deferred dooms)
+      if (!token_.empty()) SweepAuthDeadlines();
     }
   }
 
@@ -348,7 +352,12 @@ class Proxy {
   void Rearm(Relay* r) {
     epoll_event ev{};
     ev.data.fd = r->client;
-    ev.events = (r->c2u.eof || r->c2u.len ? 0u : unsigned(EPOLLIN)) |
+    // while the upstream connect is in flight, reading the client would
+    // either overflow c2u or (level-triggered) busy-spin the loop on the
+    // unread data — pause client reads until the connect resolves
+    bool conn_wait = r->connecting && r->upstream >= 0;
+    ev.events = (r->c2u.eof || r->c2u.len || conn_wait
+                     ? 0u : unsigned(EPOLLIN)) |
                 (r->u2c.len ? unsigned(EPOLLOUT) : 0u);
     epoll_ctl(epfd_, EPOLL_CTL_MOD, r->client, &ev);
     if (r->upstream < 0) return;   // pre-auth: no upstream exists yet
@@ -445,8 +454,10 @@ class Proxy {
         expired.push_back(r);
     }
     for (Relay* r : expired) {
-      if (r->grace) {
-        if (FinishAuth(r, r->pending, false)) continue;
+      // pending bytes still unauthed can only be a (partial) preamble —
+      // token bytes that must never reach the upstream as payload
+      if (r->grace && r->pending.empty()) {
+        if (FinishAuth(r, "", false)) continue;
       }
       CloseRelay(r);
     }

@@ -30,6 +30,10 @@ MAX_TPU_UTILIZATION = "MAX_TPU_UTILIZATION"
 AVG_TPU_UTILIZATION = "AVG_TPU_UTILIZATION"
 MAX_TPU_HBM_BYTES = "MAX_TPU_HBM_BYTES"
 AVG_TPU_HBM_BYTES = "AVG_TPU_HBM_BYTES"
+# the LAST sample, not a lifetime aggregate: the AM's wedge detector needs
+# current duty cycle — a monotonic MAX would hide any task that ran
+# healthy before stalling
+TPU_UTILIZATION = "TPU_UTILIZATION"
 
 
 def _proc_tree_rss_bytes(root_pid: int) -> int:
@@ -69,34 +73,66 @@ def _proc_tree_rss_bytes(root_pid: int) -> int:
     return total
 
 
+_libtpu_client = None
+
+
+def _libtpu_sample() -> dict[str, float]:
+    """Duty cycle + HBM from the libtpu metrics service (TPU-VM daemon on
+    localhost:8431) — an OUT-OF-PROCESS source, so the monitor observes
+    the training subprocess's chip use without touching jax itself. This
+    is what makes a wedged-but-alive trainer visible: duty cycle ~0 while
+    heartbeats keep flowing (the reference sampled GPU *util* for the same
+    reason, TaskMonitor.java:116-170)."""
+    global _libtpu_client
+    if _libtpu_client is None:
+        from tony_tpu.executor.tpu_metrics import LibtpuMetricsClient
+        _libtpu_client = LibtpuMetricsClient()
+    out: dict[str, float] = {}
+    duty = _libtpu_client.duty_cycle_pct()
+    if duty is not None:
+        out["duty_cycle"] = duty
+    hbm = _libtpu_client.hbm_usage_bytes()
+    if hbm is not None:
+        out["hbm_bytes"] = hbm
+    return out
+
+
 def default_tpu_sampler() -> dict[str, float]:
-    """HBM occupancy via jax's per-device memory_stats (the TPU re-target of
-    nvidia-smi sampling, GpuDiscoverer.java:43-209). Only reads stats if jax
-    is ALREADY initialized in this process (single-node/preprocess jobs run
-    the model in the executor process; the monitor must never force an
-    accelerator claim). For the normal subprocess case the training process
-    reports its own accelerator metrics straight to the AM via
-    `tony_tpu.train.metrics.report_tpu_metrics` — a child's HBM is not
-    readable from here."""
+    """Accelerator sample, best source first:
+
+    1. the libtpu metrics service (duty cycle + HBM; see _libtpu_sample) —
+       works for the normal subprocess case because the daemon is
+       per-host, not per-process;
+    2. jax's per-device memory_stats (HBM only), but ONLY if jax is
+       ALREADY initialized in this process (single-node/preprocess jobs
+       run the model in the executor process; the monitor must never
+       force an accelerator claim)."""
     import sys
 
+    sample = {}
+    try:
+        sample = _libtpu_sample()
+    except Exception:  # noqa: BLE001 — never break metrics for stats
+        LOG.debug("libtpu metrics unavailable", exc_info=True)
+    if "hbm_bytes" in sample:
+        return sample
     jax_mod = sys.modules.get("jax")
     if jax_mod is None:
-        return {}
+        return sample
     try:
         # guard on an ALREADY-INITIALIZED backend, not mere import:
         # local_devices() on an uninitialized jax would claim the TPU from
         # this monitor thread and break the training subprocess's init
         from jax._src import xla_bridge
         if not xla_bridge._backends:
-            return {}
+            return sample
         from tony_tpu.train.metrics import sum_tpu_hbm
         hbm, _ = sum_tpu_hbm(jax_mod.local_devices())
-        if not hbm:
-            return {}
-        return {"hbm_bytes": float(hbm)}
+        if hbm:
+            sample["hbm_bytes"] = float(hbm)
+        return sample
     except Exception:  # noqa: BLE001 — never break metrics for stats
-        return {}
+        return sample
 
 
 class _Stat:
@@ -126,6 +162,7 @@ class TaskMonitor:
         self._tpu_sampler = tpu_sampler
         self._mem = _Stat()
         self._tpu_util = _Stat()
+        self._tpu_util_last: Optional[float] = None
         self._tpu_hbm = _Stat()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="task-monitor",
@@ -144,6 +181,7 @@ class TaskMonitor:
         ]
         if self._tpu_util.n:
             metrics += [
+                {"name": TPU_UTILIZATION, "value": self._tpu_util_last},
                 {"name": MAX_TPU_UTILIZATION, "value": self._tpu_util.max},
                 {"name": AVG_TPU_UTILIZATION, "value": self._tpu_util.avg},
                 {"name": MAX_TPU_HBM_BYTES, "value": self._tpu_hbm.max},
@@ -168,6 +206,7 @@ class TaskMonitor:
                 sample = self._tpu_sampler()
                 if "duty_cycle" in sample:
                     self._tpu_util.update(sample["duty_cycle"])
+                    self._tpu_util_last = sample["duty_cycle"]
                 if "hbm_bytes" in sample:
                     self._tpu_hbm.update(sample["hbm_bytes"])
             except Exception:  # noqa: BLE001 — metrics must never kill a task

@@ -155,6 +155,14 @@ def _authenticate(conn: socket.socket, token: str,
     than relayed upstream as payload (it contains the token!); verifying
     it is what slides the unlock window."""
     import hmac
+
+    def _bare(buf: bytes):
+        # never bare-relay a (partial) preamble: it carries token bytes
+        if buf and (buf.startswith(_AUTH_PREAMBLE)
+                    or _AUTH_PREAMBLE.startswith(buf)):
+            return None
+        return (buf, False)
+
     conn.settimeout(_AUTH_TIMEOUT_SEC)
     buf = b""
     try:
@@ -164,9 +172,9 @@ def _authenticate(conn: socket.socket, token: str,
             except TimeoutError:
                 # a grace client that paused mid-stream is a bare relay;
                 # a locked client that never authenticated is rejected
-                return (buf, False) if grace else None
+                return _bare(buf) if grace else None
             if not chunk:
-                return (buf, False) if grace and buf else None
+                return _bare(buf) if grace and buf else None
             buf += chunk
             if len(buf) < len(_AUTH_PREAMBLE) and \
                     _AUTH_PREAMBLE.startswith(buf):
