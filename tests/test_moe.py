@@ -55,8 +55,57 @@ def test_moe_learns():
     assert float(loss) < first - 0.5, (first, float(loss))
 
 
+def test_sparse_dense_dispatch_parity():
+    """The sparse slot-indexed dispatch and the dense one-hot einsum
+    formulation implement identical routing semantics — same outputs,
+    including capacity drops (VERDICT r2 item 4)."""
+    for cap in (10.0, 0.5):     # no drops / heavy drops (sentinel path)
+        sparse_cfg = get_moe_config("moe_tiny", capacity_factor=cap)
+        dense_cfg = get_moe_config("moe_tiny", capacity_factor=cap,
+                                   dispatch_mode="dense")
+        params = moe_init(sparse_cfg, jax.random.PRNGKey(0))
+        layer0 = jax.tree.map(lambda p: p[0], params["layers"])
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32,
+                                                      sparse_cfg.dim))
+        out_s, aux_s = moe_mlp(x, layer0, sparse_cfg)
+        out_d, aux_d = moe_mlp(x, layer0, dense_cfg)
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_d),
+                                   rtol=1e-5, atol=1e-5)
+        assert float(aux_s) == float(aux_d)
+
+
+def test_sparse_dispatch_flops_near_ideal():
+    """VERDICT r2 item 4 acceptance: at E=8/top-2 the sparse dispatch's
+    compiled FLOPs stay within 1.3x of ideal (router + expert matmuls),
+    while the dense one-hot dispatch costs O(k*T^2*D) extra."""
+    T, D, F, E, k = 1024, 256, 512, 8, 2
+    config = get_moe_config(
+        "moe_tiny", dim=D, ffn_dim=F, n_experts=E, top_k=k)
+    params = moe_init(config, jax.random.PRNGKey(0))
+    layer0 = jax.tree.map(lambda p: p[0], params["layers"])
+    x = jnp.zeros((2, T // 2, D), jnp.float32)
+    C = max(1, int(config.capacity_factor * T * k / E))
+
+    def flops(cfg):
+        compiled = jax.jit(
+            partial(moe_mlp, layer=layer0, config=cfg)).lower(x).compile()
+        analysis = compiled.cost_analysis()
+        analysis = analysis[0] if isinstance(analysis, list) else analysis
+        return float(analysis["flops"])
+
+    ideal = 2 * T * D * E + 3 * 2 * E * C * D * F   # router + expert bank
+    sparse = flops(config)
+    dense = flops(get_moe_config(
+        "moe_tiny", dim=D, ffn_dim=F, n_experts=E, top_k=k,
+        dispatch_mode="dense"))
+    assert sparse <= 1.3 * ideal, (sparse, ideal)
+    # the dense path's dispatch/combine einsums alone add ~2*2*T*E*C*D
+    assert dense >= sparse + 2 * T * E * C * D, (dense, sparse)
+
+
 def test_moe_expert_parallel_step():
-    """Full train step on a mesh with a real ep axis."""
+    """Full train step on a mesh with a real ep axis (sparse dispatch —
+    the default — compiling and executing under an ep-sharded bank)."""
     mesh = make_mesh(plan_mesh(8, ep=2, tp=2))
     config = get_moe_config("moe_tiny")
     params = moe_init(config, jax.random.PRNGKey(0))

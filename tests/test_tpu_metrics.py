@@ -203,3 +203,34 @@ def test_am_flags_heartbeating_but_idle_task():
     store.update_metrics({"task_type": "ps", "index": 0, "metrics": [
         {"name": "MAX_MEMORY_BYTES", "value": 1.0}]})
     assert store.low_utilization_tasks() == ["worker:0"]
+    # task completion clears the wedge state (a finished task must not
+    # read as wedged; a relaunch with the same id starts clean)
+    store.clear_utilization_state("worker", 0)
+    assert store.low_utilization_tasks() == []
+
+
+def test_am_flags_task_whose_metrics_daemon_went_silent():
+    """The hardest wedge: the runtime hangs so hard the libtpu daemon
+    stops answering — TPU_UTILIZATION disappears from the pushes. A task
+    that reported duty before and stopped counts as idle."""
+    from tony_tpu.am.application_master import MetricsStore
+
+    store = MetricsStore(low_util_intervals=2)
+
+    def push(metrics):
+        store.update_metrics({"task_type": "worker", "index": 0,
+                              "metrics": metrics})
+
+    push([{"name": "TPU_UTILIZATION", "value": 60.0}])   # healthy
+    for _ in range(2):                                    # daemon silent
+        push([{"name": "MAX_MEMORY_BYTES", "value": 1.0}])
+    assert store.low_utilization_tasks() == ["worker:0"]
+
+
+def test_moe_dispatch_mode_validated():
+    import pytest as _pytest
+
+    from tony_tpu.models.moe import get_moe_config
+
+    with _pytest.raises(ValueError, match="dispatch_mode"):
+        get_moe_config("moe_tiny", dispatch_mode="Dense")

@@ -73,6 +73,7 @@ class MetricsStore(MetricsServiceHandler):
         self._metrics: dict[str, dict[int, list[dict]]] = {}
         self._low_util_count: dict[tuple[str, int], int] = {}
         self._low_util_flagged: set[tuple[str, int]] = set()
+        self._had_util: set[tuple[str, int]] = set()
         self._low_util_intervals = low_util_intervals
         self._lock = threading.Lock()
 
@@ -90,9 +91,17 @@ class MetricsStore(MetricsServiceHandler):
         # would never flag a task that ran healthy before wedging
         duty = next((m.get("value") for m in metrics
                      if m.get("name") == "TPU_UTILIZATION"), None)
-        if duty is None:
-            return          # no utilization source on this task
         key = (task_type, index)
+        if duty is None:
+            # a task that REPORTED duty before and stopped is the hardest
+            # wedge (runtime hung so hard the metrics daemon is silent);
+            # count those intervals as idle. Tasks that never had a
+            # utilization source are not judged at all.
+            if key not in self._had_util:
+                return
+            duty = 0.0
+        else:
+            self._had_util.add(key)
         if duty >= self.LOW_UTIL_PCT:
             self._low_util_count.pop(key, None)
             self._low_util_flagged.discard(key)
@@ -112,6 +121,17 @@ class MetricsStore(MetricsServiceHandler):
         """task ids currently flagged as heartbeating-but-idle."""
         with self._lock:
             return sorted(f"{t}:{i}" for t, i in self._low_util_flagged)
+
+    def clear_utilization_state(self, task_type: str, index: int) -> None:
+        """Drop wedge-detection state when a task completes: a finished
+        task must not stay flagged forever, and a relaunched attempt with
+        the same type:index starts clean. Latest metrics stay (the
+        TASK_FINISHED event reads them)."""
+        key = (task_type, index)
+        with self._lock:
+            self._low_util_count.pop(key, None)
+            self._low_util_flagged.discard(key)
+            self._had_util.discard(key)
 
     def get_metrics(self, task_type: str, index: int) -> list[dict]:
         with self._lock:
@@ -583,6 +603,7 @@ class ApplicationMaster(ClusterServiceHandler):
         # a task that crashed without registering its result must not linger
         # in the liveliness monitor and expire later
         self.hb_monitor.unregister(task.task_id)
+        self.metrics_store.clear_utilization_state(task.job_name, task.index)
         session.on_task_completed(task.job_name, task.index, exit_code)
         self.scheduler.register_dependency_completed(task.job_name)
         self.event_handler.emit(Event(
@@ -616,11 +637,15 @@ class ApplicationMaster(ClusterServiceHandler):
             return []
         infos = [i.to_dict() for i in self.session.get_task_infos()]
         # surface the heartbeating-but-idle diagnosis (MetricsStore wedge
-        # detection) on the client status path
+        # detection) on the client status path — RUNNING tasks only; a
+        # completed task's stale flag is cleared on completion, and an
+        # ended status must never read as "currently wedged"
         idle = set(self.metrics_store.low_utilization_tasks())
         if idle:
             for info in infos:
-                if f"{info.get('name')}:{info.get('index')}" in idle:
+                if (info.get("status") == "RUNNING"
+                        and f"{info.get('name')}:{info.get('index')}"
+                        in idle):
                     info["low_utilization"] = True
         if self._tb_url:
             infos.append({"name": "tensorboard", "index": 0,

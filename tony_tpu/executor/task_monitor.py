@@ -181,12 +181,17 @@ class TaskMonitor:
         ]
         if self._tpu_util.n:
             metrics += [
-                {"name": TPU_UTILIZATION, "value": self._tpu_util_last},
                 {"name": MAX_TPU_UTILIZATION, "value": self._tpu_util.max},
                 {"name": AVG_TPU_UTILIZATION, "value": self._tpu_util.avg},
                 {"name": MAX_TPU_HBM_BYTES, "value": self._tpu_hbm.max},
                 {"name": AVG_TPU_HBM_BYTES, "value": self._tpu_hbm.avg},
             ]
+        # current duty only when THIS interval produced a sample: a hung
+        # runtime stops answering the metrics daemon entirely, and
+        # repeating the last healthy number would hide exactly that wedge
+        if self._tpu_util_last is not None:
+            metrics.append({"name": TPU_UTILIZATION,
+                            "value": self._tpu_util_last})
         return metrics
 
     def _run(self) -> None:
@@ -204,12 +209,15 @@ class TaskMonitor:
         if self._tpu_sampler is not None:
             try:
                 sample = self._tpu_sampler()
+                # None (not carry-forward) when this interval had no duty
+                # sample — see snapshot()
+                self._tpu_util_last = sample.get("duty_cycle")
                 if "duty_cycle" in sample:
                     self._tpu_util.update(sample["duty_cycle"])
-                    self._tpu_util_last = sample["duty_cycle"]
                 if "hbm_bytes" in sample:
                     self._tpu_hbm.update(sample["hbm_bytes"])
             except Exception:  # noqa: BLE001 — metrics must never kill a task
+                self._tpu_util_last = None   # no current sample this interval
                 LOG.exception("tpu sampler failed")
         try:
             self._client.update_metrics(self._task_type, self._index,
