@@ -75,3 +75,54 @@ def recv_all(s):
         if not chunk:
             return out
         out += chunk
+
+
+# --- fake gsutil (gs:// store tests across modules) ----------------------
+
+FAKE_GSUTIL = """#!/bin/bash
+# fake gsutil: maps gs://<bucket>/<key> onto $FAKE_GCS_ROOT/<bucket>/<key>
+set -e
+cmd=$1; shift
+map() { echo "$FAKE_GCS_ROOT/${1#gs://}"; }
+unmap() { echo "gs://${1#"$FAKE_GCS_ROOT/"}"; }
+case "$cmd" in
+  cp)
+    src=$1; dst=$2
+    [[ $src == gs://* ]] && src=$(map "$src")
+    if [[ $dst == gs://* ]]; then dst=$(map "$dst"); mkdir -p "$(dirname "$dst")"; fi
+    cp "$src" "$dst"
+    ;;
+  ls)
+    # wildcard form prints matching object URIs (recursive **), like the
+    # real CLI; the plain form is an existence check
+    if [[ $1 == *'*'* ]]; then
+      shopt -s globstar nullglob
+      mapped=$(map "$1")
+      found=0
+      for p in $mapped; do
+        [[ -f $p ]] && { unmap "$p"; found=1; }
+      done
+      [[ $found == 1 ]] || { echo "CommandException: no URLs matched" >&2; exit 1; }
+    else
+      p=$(map "$1"); [[ -e $p ]] || { echo "CommandException: no URLs matched" >&2; exit 1; }
+    fi
+    ;;
+  *) echo "unsupported: $cmd" >&2; exit 2 ;;
+esac
+"""
+
+
+@pytest.fixture
+def fake_gcs(tmp_path, monkeypatch):
+    """PATH-shimmed gsutil mirroring cp/ls onto a local dir; returns the
+    backing root. The canned-fixture pattern for gs:// code paths."""
+    import stat
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    gsutil = bindir / "gsutil"
+    gsutil.write_text(FAKE_GSUTIL)
+    gsutil.chmod(gsutil.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    return tmp_path / "gcs"

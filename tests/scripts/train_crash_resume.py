@@ -13,7 +13,8 @@ from tony_tpu.models.mnist import mnist_init, mnist_loss  # noqa: E402
 from tony_tpu.train.data import synthetic_mnist  # noqa: E402
 from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
 
-ckpt_dir = os.environ["CKPT_DIR"]
+ckpt_dir = os.environ["CKPT_DIR"]           # may be gs:// (store protocol)
+report_dir = os.environ.get("REPORT_DIR", ckpt_dir)
 attempt = int(os.environ.get("ATTEMPT_NUMBER", "0"))
 crash_at = int(os.environ.get("CRASH_AT_STEP", "3"))
 total = int(os.environ.get("TOTAL_STEPS", "6"))
@@ -32,7 +33,8 @@ if attempt == 0:
     # simulate preemption AFTER checkpoints exist
     print(f"attempt 0 dying at step {trainer.step}", flush=True)
     os._exit(1)
-with open(os.path.join(ckpt_dir, "resume_report.json"), "w") as f:
+os.makedirs(report_dir, exist_ok=True)
+with open(os.path.join(report_dir, "resume_report.json"), "w") as f:
     json.dump({"attempt": attempt, "resumed_from": resumed_from,
                "finished_at": trainer.step}, f)
 print(f"attempt {attempt} resumed from {resumed_from} "

@@ -185,3 +185,70 @@ def test_atomicity_partial_tmp_ignored(tmp_path):
     assert latest_step(str(tmp_path)) == 5
     restored = restore_checkpoint(str(tmp_path))
     assert restored["step"] == 4
+
+
+def test_store_checkpoint_roundtrip_and_commit_marker(tmp_path, fake_gcs):
+    """VERDICT r2 item 5: checkpoints on a gs:// store — per-shard
+    uploads + COMMIT marker instead of rename, restore by URI with
+    mesh resharding, and an uncommitted step is invisible."""
+    base = "gs://bkt/ckpts"
+    mesh = _mesh(fsdp=4, tp=2)
+    save_checkpoint(base, 7, _sharded_state(mesh))
+    assert latest_step(base) == 7
+    # restore onto a DIFFERENT mesh layout straight from the store
+    restore_mesh = _mesh(fsdp=8)
+    template = {
+        "w": jax.device_put(jnp.zeros((8, 8)),
+                            NamedSharding(restore_mesh, P("fsdp", "tp"))),
+        "b": jax.device_put(jnp.zeros(8),
+                            NamedSharding(restore_mesh, P(None))),
+        "step": 0,
+    }
+    restored = restore_checkpoint(base, template=template)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(64.0).reshape(8, 8))
+    assert restored["step"] == 4
+    # a later save whose COMMIT never landed must stay invisible
+    save_checkpoint(base, 9, _sharded_state(mesh))
+    os.remove(fake_gcs / "bkt" / "ckpts" / "step_9" / "COMMIT")
+    assert latest_step(base) == 7
+    restored = restore_checkpoint(base)
+    assert restored["step"] == 4
+
+
+def test_store_restore_ignores_stale_manifests(tmp_path, fake_gcs):
+    """An aborted earlier upload of the same step can leave manifests
+    from a different process count behind (no rmtree on object stores);
+    the COMMIT marker names the fresh attempt's manifest set and restore
+    must read EXACTLY that (review finding: merging stale manifests would
+    paste stale shard data over fresh regions)."""
+    base = "gs://bkt/stale-ckpts"
+    mesh = _mesh(fsdp=8)
+    save_checkpoint(base, 3, _sharded_state(mesh))
+    # a stale manifest from a dead 2-process attempt, pointing at a
+    # poisoned shard overlapping leaf regions
+    step_dir = fake_gcs / "bkt" / "stale-ckpts" / "step_3"
+    np.save(step_dir / "shards" / "leaf_2.p1_0.npy",
+            np.full((8, 8), -1.0, np.float32))
+    (step_dir / "manifest_p1.json").write_text(json.dumps({
+        "process": 1, "shards": [{"leaf": 2, "file": "leaf_2.p1_0.npy",
+                                  "index": [[0, 8], [0, 8]]}]}))
+    restored = restore_checkpoint(base, 3)
+    np.testing.assert_array_equal(restored["w"],
+                                  np.arange(64.0).reshape(8, 8))
+
+
+def test_async_checkpointer_on_store(tmp_path, fake_gcs):
+    base = "gs://bkt/async-ckpts"
+    mesh = _mesh(fsdp=8)
+    ckpt = AsyncCheckpointer(base)
+    bump = jax.jit(lambda x: x * 2.0, donate_argnums=0)
+    with jax.set_mesh(mesh):
+        x = jax.device_put(jnp.arange(16.0), NamedSharding(mesh, P("fsdp")))
+        for step in range(2):
+            ckpt.save(step, {"x": x})
+            x = bump(x)
+        ckpt.close()
+    assert latest_step(base) == 1
+    restored = restore_checkpoint(base, 1)
+    np.testing.assert_array_equal(restored["x"], np.arange(16.0) * 2.0)

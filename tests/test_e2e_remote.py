@@ -196,6 +196,54 @@ def test_ssh_transport_failure_rc255_fails_task(tmp_path, ssh_shim):
     assert client.final_status == "FAILED", _dump_logs(client)
 
 
+def test_crash_resume_on_store_no_shared_ckpt_dir(tmp_path, fake_gcs):
+    """VERDICT r2 item 5 acceptance: AM-retry crash-resume where the
+    checkpoints live on the (fake-gsutil) gs:// store — per-shard uploads
+    + COMMIT marker, restore by URI; no shared local checkpoint dir
+    between the attempts' node-side workdirs."""
+    import json as _json
+
+    from test_e2e import run_job as _run_job
+
+    report_dir = str(tmp_path / "report")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    client = _run_job(
+        tmp_path,
+        ["--executes", script("train_crash_resume.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.am.retry-count=2",
+         "--conf", "tony.execution.env=CKPT_DIR=gs://bkt/run-ckpts",
+         "--conf", f"tony.execution.env=REPORT_DIR={report_dir}",
+         "--conf", f"tony.execution.env=TONY_REPO_ROOT={repo}"],
+        conf_overrides=remote_overrides(tmp_path))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    report = _json.load(open(os.path.join(report_dir,
+                                          "resume_report.json")))
+    assert report["attempt"] == 1
+    assert report["resumed_from"] == 3     # picked up attempt 0's last save
+    assert report["finished_at"] == 6
+    # the checkpoints really live in the store, committed
+    assert (fake_gcs / "bkt" / "run-ckpts" / "step_3" / "COMMIT").exists()
+
+
+def test_am_publishes_history_through_store(tmp_path):
+    """The AM uploads finalized jhist + config to the staging store so an
+    off-host portal can serve the job (reference: jhist on HDFS,
+    events/EventHandler.java:97-113)."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=1"],
+        conf_overrides=remote_overrides(tmp_path))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    store_root = tmp_path / "shared-store" / client.app_id / "history"
+    assert store_root.is_dir(), "history not published to the store"
+    names = os.listdir(store_root)
+    assert any(n.endswith(".jhist") and "-SUCCEEDED." in n
+               for n in names), names
+    assert C.PORTAL_CONFIG_FILE in names
+
+
 def test_src_dir_ships_through_store_to_nodes(tmp_path):
     """User code travels client → store → node workdir (the HDFS
     upload/localize loop, TonyClient.java:519-590)."""

@@ -235,6 +235,43 @@ def test_portal_404(portal):
     assert exc.value.code == 404
 
 
+def test_history_store_fetcher_feeds_mover_and_cache(tmp_path, fake_gcs):
+    """Off-host AM story: finished jhist published to the store is pulled
+    into the intermediate dir, the mover finalizes it into finished/, and
+    the cache serves it — the portal works with no shared fs to the AM."""
+    from tony_tpu.portal.fetcher import HistoryStoreFetcher
+    from tony_tpu.storage import GCSStore
+
+    # an "AM on another host" published its finished history
+    store = GCSStore("gs://bkt/stage/app_remote")
+    hist = tmp_path / history_file_name(JobMetadata(
+        application_id="app_remote", started=1000, completed=2000,
+        user="bob", status="SUCCEEDED"))
+    hist.write_text(json.dumps({
+        "type": "APPLICATION_FINISHED", "timestamp": 2000,
+        "payload": {"application_id": "app_remote",
+                    "status": "SUCCEEDED"}}) + "\n")
+    store.put(str(hist), f"history/{hist.name}")
+    cfg = tmp_path / "cfgsnap.json"
+    cfg.write_text(json.dumps({"tony.am.memory": "1g"}))
+    store.put(str(cfg), f"history/{C.PORTAL_CONFIG_FILE}")
+
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    fetcher = HistoryStoreFetcher("gs://bkt/stage", inter)
+    fetched = fetcher.fetch_once()
+    assert len(fetched) == 2
+    assert fetcher.fetch_once() == []     # idempotent: nothing new
+
+    mover = HistoryFileMover(inter, fin)
+    moved = mover.move_once()
+    assert len(moved) == 1
+    cache = PortalCache(inter, fin)
+    md = cache.get_metadata("app_remote")
+    assert md is not None and md.status == "SUCCEEDED"
+    assert cache.get_config("app_remote") == {"tony.am.memory": "1g"}
+
+
 @pytest.fixture()
 def secure_portal(tmp_path):
     inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")

@@ -8,46 +8,11 @@ parse canned nvidia-smi output instead of real GPUs)."""
 from __future__ import annotations
 
 import os
-import stat
-
-import pytest
 
 from tony_tpu.storage import (
     GCSStore, LocalDirStore, fetch_uri, staging_store,
 )
 from tony_tpu.utils.localization import localize_resource, stage_resource
-
-FAKE_GSUTIL = """#!/bin/bash
-# fake gsutil: maps gs://<bucket>/<key> onto $FAKE_GCS_ROOT/<bucket>/<key>
-set -e
-cmd=$1; shift
-map() { echo "$FAKE_GCS_ROOT/${1#gs://}"; }
-case "$cmd" in
-  cp)
-    src=$1; dst=$2
-    [[ $src == gs://* ]] && src=$(map "$src")
-    if [[ $dst == gs://* ]]; then dst=$(map "$dst"); mkdir -p "$(dirname "$dst")"; fi
-    cp "$src" "$dst"
-    ;;
-  ls)
-    p=$(map "$1"); [[ -e $p ]] || { echo "CommandException: no URLs matched" >&2; exit 1; }
-    ;;
-  *) echo "unsupported: $cmd" >&2; exit 2 ;;
-esac
-"""
-
-
-@pytest.fixture
-def fake_gcs(tmp_path, monkeypatch):
-    bindir = tmp_path / "bin"
-    bindir.mkdir()
-    gsutil = bindir / "gsutil"
-    gsutil.write_text(FAKE_GSUTIL)
-    gsutil.chmod(gsutil.stat().st_mode | stat.S_IEXEC)
-    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
-    monkeypatch.setenv("FAKE_GCS_ROOT", str(tmp_path / "gcs"))
-    return tmp_path / "gcs"
-
 
 def test_local_store_roundtrip(tmp_path):
     store = LocalDirStore(str(tmp_path / "stage"))
@@ -86,6 +51,29 @@ def test_staging_store_selection(tmp_path, fake_gcs):
     # shared dirs are app-namespaced too: concurrent apps staging fixed
     # keys (tony_src.zip) into one NFS dir must not clobber each other
     assert explicit.root == str(tmp_path / "shared" / "appX")
+
+
+def test_list_keys_local_and_gcs(tmp_path, fake_gcs):
+    """Enumeration (checkpoint COMMIT discovery, portal history fetcher)
+    on both store kinds."""
+    local = LocalDirStore(str(tmp_path / "stage"))
+    for key in ("a.txt", "sub/b.txt", "sub/deep/c.txt"):
+        src = tmp_path / "src.txt"
+        src.write_text("x")
+        local.put(str(src), key)
+    assert local.list_keys() == ["a.txt", "sub/b.txt", "sub/deep/c.txt"]
+    assert local.list_keys("sub") == ["sub/b.txt", "sub/deep/c.txt"]
+    assert local.uri("a.txt") == os.path.join(local.root, "a.txt")
+
+    gcs = GCSStore("gs://bkt/app")
+    assert gcs.list_keys() == []          # empty listing is not an error
+    src = tmp_path / "s.txt"
+    src.write_text("y")
+    gcs.put(str(src), "x/one.txt")
+    gcs.put(str(src), "x/y/two.txt")
+    assert gcs.list_keys() == ["x/one.txt", "x/y/two.txt"]
+    assert gcs.list_keys("x/y") == ["x/y/two.txt"]
+    assert gcs.uri("x/one.txt") == "gs://bkt/app/x/one.txt"
 
 
 def test_stage_and_localize_through_gcs(tmp_path, fake_gcs):

@@ -228,6 +228,29 @@ class ApplicationMaster(ClusterServiceHandler):
         os.replace(tmp, hostport_path)
         LOG.info("AM RPC serving at %s:%d", self.host, self.rpc_port)
 
+    def _publish_history(self, final_hist: str) -> None:
+        """Upload the finalized jhist + config snapshot to the staging
+        store (VERDICT r2 item 5). The local history dir assumes the
+        portal can read this host's filesystem — false on a multi-host
+        TPU-VM fleet where the AM ran off-host. With a staging location
+        configured, the portal's HistoryStoreFetcher pulls
+        `<location>/<app_id>/history/*` into its own intermediate dir
+        (the reference's equivalent was jhist on HDFS,
+        events/EventHandler.java:97-113)."""
+        location = self.conf.get_str(K.STAGING_LOCATION, "")
+        if not location or not final_hist or not os.path.exists(final_hist):
+            return
+        try:
+            from tony_tpu.storage import staging_store
+            store = staging_store(location, self.app_dir)
+            store.put(final_hist,
+                      f"history/{os.path.basename(final_hist)}")
+            cfg = os.path.join(self.history_dir, C.PORTAL_CONFIG_FILE)
+            if os.path.exists(cfg):
+                store.put(cfg, f"history/{C.PORTAL_CONFIG_FILE}")
+        except Exception:  # noqa: BLE001 — history must never fail the app
+            LOG.exception("failed to publish history to the staging store")
+
     def _write_history_config(self) -> None:
         """Snapshot the frozen conf into the history dir so the portal can
         serve /config/:jobId (reference: writeConfigFile,
@@ -425,6 +448,7 @@ class ApplicationMaster(ClusterServiceHandler):
                                     all_metrics)))
         final_hist = self.event_handler.stop(status)
         LOG.info("history written to %s", final_hist)
+        self._publish_history(final_hist)
         self._write_status(
             status,
             self.session.final_message if self.session else None)

@@ -26,6 +26,7 @@ NO_DEFAULT_KEYS = frozenset({
     K.KEYTAB_LOCATION,
     K.PORTAL_URL,
     K.PORTAL_TOKEN_FILE,
+    K.HISTORY_STORE_LOCATION,
     K.SRC_DIR,
     K.PYTHON_VENV,
     K.EXECUTION_ENV,

@@ -73,6 +73,9 @@ PORTAL_CACHE_MAX_ENTRIES = "tony.portal.cache-max-entries"
 # sat behind YARN/Play auth filters; here the portal requires this token
 # in Authorization: Bearer or ?token= when configured)
 PORTAL_TOKEN_FILE = "tony.portal.token-file"
+# staging-store location the portal pulls finished history from (AMs on
+# other hosts publish jhist there; the reference's HDFS history dir)
+HISTORY_STORE_LOCATION = "tony.history.store-location"
 
 # --- docker (reference: TonyConfigurationKeys.java:227-239,266-268) ------
 DOCKER_ENABLED = "tony.docker.enabled"

@@ -15,6 +15,7 @@ import time
 from tony_tpu.conf import keys as K
 from tony_tpu.conf.configuration import TonyConfiguration
 from tony_tpu.portal.cache import PortalCache
+from tony_tpu.portal.fetcher import HistoryStoreFetcher
 from tony_tpu.portal.mover import HistoryFileMover, ensure_history_dirs
 from tony_tpu.portal.purger import HistoryFilePurger
 from tony_tpu.portal.server import PortalServer
@@ -29,6 +30,10 @@ def main(argv=None) -> int:
     parser.add_argument("--token-file", default=None,
                         help="bearer token file gating all routes "
                              "(overrides tony.portal.token-file)")
+    parser.add_argument("--history-store", default=None,
+                        help="staging-store location (gs:// or shared dir) "
+                             "to pull off-host AMs' finished history from "
+                             "(overrides tony.history.store-location)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -61,6 +66,13 @@ def main(argv=None) -> int:
         if not token:
             raise SystemExit(f"empty portal token file: {token_file}")
     server = PortalServer(cache, port=port, token=token)
+    fetcher = None
+    store_location = args.history_store or conf.get_str(
+        K.HISTORY_STORE_LOCATION)
+    if store_location:
+        fetcher = HistoryStoreFetcher(store_location, intermediate)
+        fetcher.fetch_once()   # immediate first sync before serving
+        fetcher.start()
 
     mover.start()
     purger.start()
@@ -75,6 +87,8 @@ def main(argv=None) -> int:
         server.stop()
         mover.stop()
         purger.stop()
+        if fetcher is not None:
+            fetcher.stop()
     return 0
 
 

@@ -1,0 +1,86 @@
+"""HistoryStoreFetcher: pull finished job history from the staging store.
+
+On a multi-host fleet the AM runs off the portal host, so its local
+history dir is unreachable; `ApplicationMaster._publish_history` uploads
+the finalized jhist + config snapshot to `<location>/<app_id>/history/*`
+and this daemon syncs those keys into the portal's intermediate dir,
+where the existing mover/cache pipeline takes over (finalized jhist files
+move straight to `finished/`). Reference role: the portal reading jhist
+off HDFS (tony-portal HistoryFileMover over the shared store;
+events/EventHandler.java:97-113 wrote it there).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+from tony_tpu.storage import GCSStore, LocalDirStore, StagingStore
+
+LOG = logging.getLogger(__name__)
+
+
+def _store_for_location(location: str) -> StagingStore:
+    if location.startswith("gs://"):
+        return GCSStore(location)
+    return LocalDirStore(location)
+
+
+class HistoryStoreFetcher:
+    """Periodically mirror `<location>/<app_id>/history/<file>` into
+    `<intermediate>/<app_id>/<file>`. Files are immutable once published
+    (the AM uploads only FINALIZED jhist), so presence == done and the
+    sync is a cheap list+fetch of new keys."""
+
+    def __init__(self, location: str, intermediate: str,
+                 interval_ms: int = 60_000):
+        self._location = location
+        self._intermediate = intermediate
+        self._interval_sec = interval_ms / 1000.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="history-fetcher", daemon=True)
+
+    def fetch_once(self) -> list[str]:
+        """One sync pass; returns newly fetched destination paths."""
+        store = _store_for_location(self._location)
+        fetched = []
+        try:
+            keys = store.list_keys()
+        except Exception:  # noqa: BLE001 — store hiccups must not kill us
+            LOG.exception("history store listing failed")
+            return fetched
+        for key in keys:
+            parts = key.split("/")
+            if len(parts) != 3 or parts[1] != "history":
+                continue
+            app_id, _, fname = parts
+            dest = os.path.join(self._intermediate, app_id, fname)
+            if os.path.exists(dest):
+                continue
+            try:
+                # fetch to a tmp name + atomic rename: `dest` existing is
+                # the done-marker, so a crash mid-copy must never leave a
+                # truncated file under the final name (the mover would
+                # finalize corrupt history and every later pass skip it)
+                tmp = dest + ".fetch-tmp"
+                store.fetch(store.uri(key), tmp)
+                os.replace(tmp, dest)
+                fetched.append(dest)
+            except Exception:  # noqa: BLE001
+                LOG.exception("failed to fetch history key %s", key)
+        if fetched:
+            LOG.info("fetched %d history file(s) from %s", len(fetched),
+                     self._location)
+        return fetched
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_sec):
+            self.fetch_once()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

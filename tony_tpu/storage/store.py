@@ -43,6 +43,22 @@ class StagingStore(abc.ABC):
     @abc.abstractmethod
     def exists(self, uri: str) -> bool: ...
 
+    @abc.abstractmethod
+    def list_keys(self, prefix: str = "") -> list[str]:
+        """Keys (relative to this store's base) under `prefix`,
+        recursively. Checkpoint commit-marker discovery and the portal's
+        history fetcher need enumeration, not just point lookups."""
+
+    @abc.abstractmethod
+    def uri(self, key: str) -> str:
+        """The fetchable URI for a key of this store."""
+
+    @abc.abstractmethod
+    def glob(self, pattern: str) -> list[str]:
+        """Keys matching a shell-style pattern relative to the base
+        (e.g. "step_*/COMMIT") — targeted enumeration so callers don't
+        have to list an entire tree to find a handful of markers."""
+
 
 class LocalDirStore(StagingStore):
     """Shared-filesystem store rooted at a directory (the round-1 layout:
@@ -71,6 +87,24 @@ class LocalDirStore(StagingStore):
     def exists(self, uri: str) -> bool:
         src = uri[len("file://"):] if uri.startswith("file://") else uri
         return os.path.exists(src)
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        base = os.path.join(self.root, prefix) if prefix else self.root
+        out = []
+        for dirpath, _dirs, files in os.walk(base):
+            for f in files:
+                out.append(os.path.relpath(os.path.join(dirpath, f),
+                                           self.root))
+        return sorted(out)
+
+    def uri(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def glob(self, pattern: str) -> list[str]:
+        import glob as _glob
+        hits = _glob.glob(os.path.join(self.root, pattern))
+        return sorted(os.path.relpath(h, self.root) for h in hits
+                      if os.path.isfile(h))
 
 
 class GCSStore(StagingStore):
@@ -119,6 +153,39 @@ class GCSStore(StagingStore):
         cmd = [*self._cli, "ls", uri]
         return subprocess.run(cmd, capture_output=True,
                               timeout=120).returncode == 0
+
+    def _ls(self, pattern_uri: str) -> list[str]:
+        """Run `ls` and split no-match (a normal empty listing) from real
+        failures (auth/network/bucket) — a resuming trainer that mistook
+        a transient gsutil failure for 'no checkpoints' would silently
+        restart from step 0 and overwrite the good ones."""
+        cmd = [*self._cli, "ls", pattern_uri]
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=600)
+        if out.returncode != 0:
+            err = out.stderr.lower()
+            if "matched no objects" in err or "no urls matched" in err \
+                    or "not found" in err:
+                return []
+            raise RuntimeError(
+                f"{' '.join(cmd[:2])} {pattern_uri} failed "
+                f"rc={out.returncode}: {out.stderr.strip()[-500:]}")
+        keys = []
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if line.startswith(self.base + "/"):
+                keys.append(line[len(self.base) + 1:])
+        return sorted(keys)
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        base = f"{self.base}/{prefix.rstrip('/')}" if prefix else self.base
+        return self._ls(f"{base.rstrip('/')}/**")
+
+    def glob(self, pattern: str) -> list[str]:
+        return self._ls(f"{self.base}/{pattern}")
+
+    def uri(self, key: str) -> str:
+        return f"{self.base}/{key}"
 
 
 def staging_store(location: str, app_dir: str) -> StagingStore:

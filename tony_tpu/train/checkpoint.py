@@ -46,7 +46,9 @@ LOG = logging.getLogger(__name__)
 _STEP_RE = re.compile(r"^step_(\d+)$")
 _TREE_FILE = "tree.pkl"
 _INDEX_FILE = "index.json"
+_COMMIT_FILE = "COMMIT"
 _MANIFEST_RE = re.compile(r"^manifest_p(\d+)\.json$")
+_COMMIT_KEY_RE = re.compile(r"^step_(\d+)/COMMIT$")
 
 
 # ---------------------------------------------------------------------------
@@ -139,11 +141,86 @@ def _write_snapshot(ckpt_dir: str, step: int, treedef, metas,
     return final
 
 
+def _is_store_path(path: str) -> bool:
+    return path.startswith("gs://")
+
+
+def _ckpt_store(base: str):
+    from tony_tpu.storage import GCSStore
+    return GCSStore(base.rstrip("/"))
+
+
+def _write_snapshot_store(base: str, step: int, treedef, metas,
+                          records) -> Optional[str]:
+    """Object-store checkpoint commit protocol. Object stores have no
+    atomic rename, so the tmp+rename discipline of the local path becomes
+    upload-everything + barrier + a COMMIT marker written LAST by process
+    0: readers ignore any step without its marker, which makes a
+    preempted upload invisible exactly like a leftover .tmp dir. This is
+    what removes the shared-filesystem assumption for multi-host TPU-VM
+    fleets (VERDICT r2 item 5; the reference wrote to HDFS,
+    events/EventHandler.java:97-113)."""
+    import tempfile
+
+    store = _ckpt_store(base)
+    pidx = jax.process_index()
+    prefix = f"step_{step}"
+    scratch = tempfile.mkdtemp(prefix="tony-ckpt-")
+    try:
+        manifest: dict[str, Any] = {"process": pidx, "shards": []}
+        for i, fname, index_spec, data in records:
+            local = os.path.join(scratch, fname)
+            np.save(local, data)
+            store.put(local, f"{prefix}/shards/{fname}")
+            manifest["shards"].append({"leaf": i, "file": fname,
+                                       "index": index_spec})
+        man_path = os.path.join(scratch, f"manifest_p{pidx}.json")
+        with open(man_path, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        store.put(man_path, f"{prefix}/manifest_p{pidx}.json")
+        if pidx == 0:
+            idx_path = os.path.join(scratch, _INDEX_FILE)
+            with open(idx_path, "w", encoding="utf-8") as f:
+                json.dump({"leaves": metas}, f)
+            store.put(idx_path, f"{prefix}/{_INDEX_FILE}")
+            tree_path = os.path.join(scratch, _TREE_FILE)
+            with open(tree_path, "wb") as f:
+                pickle.dump(treedef, f)
+            store.put(tree_path, f"{prefix}/{_TREE_FILE}")
+        _barrier()
+        if pidx != 0:
+            return None
+        commit = os.path.join(scratch, _COMMIT_FILE)
+        with open(commit, "w", encoding="utf-8") as f:
+            # the marker names the EXACT manifest set of this attempt:
+            # an aborted earlier upload of the same step may have left
+            # stale manifest_p*.json from a different process count, and
+            # merging those would paste stale shard data over fresh
+            # (object stores have no rmtree to purge them first)
+            json.dump({"step": step,
+                       "processes": jax.process_count()}, f)
+        store.put(commit, f"{prefix}/{_COMMIT_FILE}")
+        return f"{base.rstrip('/')}/{prefix}"
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _write_any(ckpt_dir: str, step: int, treedef, metas,
+               records) -> Optional[str]:
+    if _is_store_path(ckpt_dir):
+        return _write_snapshot_store(ckpt_dir, step, treedef, metas,
+                                     records)
+    return _write_snapshot(ckpt_dir, step, treedef, metas, records)
+
+
 def save_checkpoint(ckpt_dir: str, step: int, state: Any) -> Optional[str]:
     """Write `state` (any pytree) as step `step`. Every process must call
-    this (it barriers before the final rename in multi-process jobs); each
-    writes only its own shards. Returns the final path on process 0."""
-    return _write_snapshot(ckpt_dir, step, *_snapshot(state))
+    this (it barriers before the commit in multi-process jobs); each
+    writes only its own shards. `ckpt_dir` may be a local/NFS directory
+    (tmp+rename protocol) or a gs:// location (upload + COMMIT-marker
+    protocol — no shared filesystem needed). Returns the final
+    path/URI on process 0."""
+    return _write_any(ckpt_dir, step, *_snapshot(state))
 
 
 def _barrier() -> None:
@@ -158,6 +235,16 @@ def _barrier() -> None:
 # ---------------------------------------------------------------------------
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
+    """Newest complete checkpoint step: local dirs count `step_N` entries
+    (the rename made them atomic); store locations count only steps whose
+    COMMIT marker landed."""
+    if _is_store_path(ckpt_dir):
+        # targeted glob: listing the whole tree would walk every shard
+        # object of every step just to find the handful of markers
+        steps = [int(m.group(1))
+                 for key in _ckpt_store(ckpt_dir).glob("step_*/COMMIT")
+                 if (m := _COMMIT_KEY_RE.match(key))]
+        return max(steps) if steps else None
     if not os.path.isdir(ckpt_dir):
         return None
     steps = [int(m.group(1)) for name in os.listdir(ckpt_dir)
@@ -247,6 +334,59 @@ def _paste_region(out: np.ndarray, out_index: tuple, path: str,
     out[tuple(dst_sl)] = data[tuple(src_sl)]
 
 
+def _open_store_step(base: str, step: int):
+    """Fetch a store step's metadata into a local cache and return
+    (treedef, index, by_leaf, resolve, cleanup) where `resolve(fname)`
+    downloads a shard file ON FIRST TOUCH — combined with the region
+    index, restoring a target shard fetches only the overlapping saved
+    files, never the whole checkpoint. The caller must invoke `cleanup`
+    once assembly is done (the cache can be checkpoint-sized)."""
+    import tempfile
+
+    store = _ckpt_store(base)
+    prefix = f"step_{step}"
+    base_uri = base.rstrip("/")
+    cache = tempfile.mkdtemp(prefix="tony-ckpt-restore-")
+    tree_local = store.fetch(f"{base_uri}/{prefix}/{_TREE_FILE}",
+                             os.path.join(cache, _TREE_FILE))
+    with open(tree_local, "rb") as f:
+        treedef = pickle.load(f)
+    idx_local = store.fetch(f"{base_uri}/{prefix}/{_INDEX_FILE}",
+                            os.path.join(cache, _INDEX_FILE))
+    with open(idx_local, "r", encoding="utf-8") as f:
+        index = json.load(f)
+    # read EXACTLY the manifest set the COMMIT marker names — an aborted
+    # earlier upload of this step may have left stale manifest_p*.json
+    # behind (e.g. from a larger process count), and merging them would
+    # paste stale shard data over fresh regions
+    commit_local = store.fetch(f"{base_uri}/{prefix}/{_COMMIT_FILE}",
+                               os.path.join(cache, _COMMIT_FILE))
+    with open(commit_local, "r", encoding="utf-8") as f:
+        commit = json.load(f)
+    by_leaf: dict[int, list[dict]] = {}
+    for p in range(int(commit.get("processes", 1))):
+        name = f"manifest_p{p}.json"
+        local = store.fetch(f"{base_uri}/{prefix}/{name}",
+                            os.path.join(cache, name))
+        with open(local, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+        for rec in manifest["shards"]:
+            by_leaf.setdefault(rec["leaf"], []).append(rec)
+
+    shards_cache = os.path.join(cache, "shards")
+
+    def resolve(fname: str) -> str:
+        local = os.path.join(shards_cache, fname)
+        if not os.path.exists(local):
+            store.fetch(f"{base_uri}/{prefix}/shards/{fname}", local)
+        return local
+
+    def cleanup() -> None:
+        shutil.rmtree(cache, ignore_errors=True)
+
+    return treedef, index, by_leaf, resolve, cleanup
+
+
 def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
                        template: Any = None) -> Any:
     """Read a checkpoint back.
@@ -261,13 +401,36 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
         step = latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    path = os.path.join(ckpt_dir, f"step_{step}")
-    with open(os.path.join(path, _TREE_FILE), "rb") as f:
-        treedef = pickle.load(f)
-    with open(os.path.join(path, _INDEX_FILE), "r", encoding="utf-8") as f:
-        index = json.load(f)
-    by_leaf = _load_manifests(path)
-    shards_dir = os.path.join(path, "shards")
+
+    if _is_store_path(ckpt_dir):
+        treedef, index, by_leaf, resolve, cleanup = _open_store_step(
+            ckpt_dir, step)
+    else:
+        path = os.path.join(ckpt_dir, f"step_{step}")
+        with open(os.path.join(path, _TREE_FILE), "rb") as f:
+            treedef = pickle.load(f)
+        with open(os.path.join(path, _INDEX_FILE), "r",
+                  encoding="utf-8") as f:
+            index = json.load(f)
+        by_leaf = _load_manifests(path)
+        shards_dir = os.path.join(path, "shards")
+
+        def resolve(fname: str) -> str:
+            return os.path.join(shards_dir, fname)
+
+        def cleanup() -> None:
+            pass
+
+    try:
+        return _assemble(treedef, index, by_leaf, resolve, template)
+    finally:
+        # the fetched-shard cache can be checkpoint-sized; assembly is
+        # eager (make_array_from_callback materializes during the call),
+        # so it is safe to drop here
+        cleanup()
+
+
+def _assemble(treedef, index, by_leaf, resolve, template: Any) -> Any:
     leaf_index: dict[int, _RegionIndex] = {}
 
     def read_region(i: int, meta: dict, region: tuple) -> np.ndarray:
@@ -284,8 +447,7 @@ def restore_checkpoint(ckpt_dir: str, step: Optional[int] = None,
         if i not in leaf_index:
             leaf_index[i] = _RegionIndex(by_leaf.get(i, []), len(dims))
         for rec in leaf_index[i].query(target):
-            _paste_region(out, target, os.path.join(shards_dir,
-                                                    rec["file"]), rec)
+            _paste_region(out, target, resolve(rec["file"]), rec)
         return out
 
     leaves_meta = index["leaves"]
@@ -347,8 +509,7 @@ class AsyncCheckpointer:
 
         def work():
             try:
-                _write_snapshot(self.ckpt_dir, step, treedef, metas,
-                                records)
+                _write_any(self.ckpt_dir, step, treedef, metas, records)
             except BaseException as e:  # noqa: BLE001 — surfaced in wait()
                 self._error = e
                 LOG.exception("async checkpoint step %d failed", step)
