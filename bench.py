@@ -76,6 +76,31 @@ def _mark(msg: str) -> None:
           flush=True)
 
 
+def probe_main() -> None:
+    """Cheap staged TPU probe (VERDICT r2 item 1): touch each backend-init
+    stage separately with progress markers so a wedge is pinpointed to
+    plugin discovery vs client creation vs first compile — without
+    burning the main attempt's budget. Exits 0 and prints PROBE-OK if a
+    trivial computation executes on the accelerator."""
+    import faulthandler
+    faulthandler.enable()
+    faulthandler.register(signal.SIGTERM, all_threads=True, chain=False)
+
+    _mark("probe: importing jax")
+    import jax
+
+    _mark("probe: plugin/backend discovery (jax.devices)")
+    devs = jax.devices()
+    _mark(f"probe: backend up: {devs}")
+    import jax.numpy as jnp
+
+    _mark("probe: first compile + execute (tiny matmul)")
+    x = jnp.ones((128, 128))
+    val = float((x @ x).sum())
+    _mark(f"probe: execute ok ({val})")
+    print("PROBE-OK", flush=True)
+
+
 def child_main(backend: str) -> None:
     import faulthandler
     faulthandler.enable()
@@ -273,8 +298,46 @@ def main() -> None:
     usable = max(60.0, BUDGET_SEC - reserve)
     diags: list[str] = []
 
-    # Attempt 1 + retry on the real accelerator.
-    for attempt, frac in ((1, 0.45), (2, 0.3)):
+    # Cheap pre-probe: if the tunnel is wedged, find out early with a
+    # stage-pinpointed stack instead of burning the 45% first attempt.
+    # Deadline scales with the budget (a slow-but-healthy backend must
+    # not be misclassified) and is overridable for unusual environments.
+    probe_deadline = float(os.environ.get(
+        "TONY_BENCH_PROBE_SEC", max(90.0, 0.2 * BUDGET_SEC)))
+    probe = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--probe"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    try:
+        p_out, p_err = probe.communicate(timeout=probe_deadline)
+        probe_timed_out = False
+    except subprocess.TimeoutExpired:
+        probe_timed_out = True
+        probe.send_signal(signal.SIGTERM)   # faulthandler stack dump
+        try:
+            p_out, p_err = probe.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            probe.kill()
+            p_out, p_err = probe.communicate()
+    probe_ok = (not probe_timed_out and probe.returncode == 0
+                and "PROBE-OK" in p_out)
+    if not probe_ok:
+        marks = [ln for ln in p_err.splitlines()
+                 if ln.startswith("[bench ")]
+        last = marks[-1] if marks else "(no progress marker)"
+        tail = "\n".join(p_err.strip().splitlines()[-12:])
+        state = (f"timed out after {probe_deadline:.0f}s" if probe_timed_out
+                 else f"exited rc={probe.returncode}")
+        diags.append(f"pre-probe: {state}; wedged at stage: {last}; "
+                     f"stderr tail:\n{tail}")
+        print(f"[bench parent] {diags[-1]}", file=sys.stderr, flush=True)
+
+    # Attempt 1 + retry on the real accelerator. A failed probe does NOT
+    # skip TPU entirely (the probe is advisory and could itself be a
+    # fluke) — it shrinks the schedule to one short attempt so most of
+    # the budget is preserved for the CPU fallback measurement.
+    attempts = ((1, 0.45), (2, 0.3)) if probe_ok else ((1, 0.25),)
+    for attempt, frac in attempts:
         remaining = usable - (time.monotonic() - t_start)
         if attempt > 1 and remaining < 75.0:
             diags.append("retry skipped: budget too small")
@@ -317,5 +380,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         child_main(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
+        probe_main()
     else:
         main()

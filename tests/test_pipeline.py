@@ -135,3 +135,68 @@ def test_llama_pipelined_trains():
         if first is None:
             first = float(loss)
     assert float(loss) < first, (first, float(loss))
+
+
+def test_llama_pipelined_grads_match_sequential():
+    """VERDICT r2 item 2 acceptance: gradient parity of the pipelined
+    llama (1F1B custom backward, remat + flash attention inside stages)
+    against the plain sequential forward's AD grads."""
+    from functools import partial
+
+    from tony_tpu.models.llama import (
+        get_config, llama_init, llama_loss, llama_loss_pipelined,
+    )
+
+    mesh = make_mesh(plan_mesh(8, pp=4, fsdp=2, dp=1))
+    config = get_config("tiny", n_layers=4)
+    params = llama_init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                config.vocab_size, jnp.int32)
+    batch = {"tokens": tokens}
+    want = jax.grad(partial(llama_loss, config=config))(params, batch)
+    got = jax.grad(partial(llama_loss_pipelined, config=config, mesh=mesh,
+                           n_micro=4))(params, batch)
+    flat_w, _ = jax.tree_util.tree_flatten_with_path(want)
+    flat_g = jax.tree.leaves(got)
+    for (path, w), g in zip(flat_w, flat_g):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=2e-4, rtol=2e-3,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+
+
+def test_llama_pipelined_composes_pp_with_fsdp_tp():
+    """Stage weights shard on pp AND fsdp/tp simultaneously: the staged
+    logical axes resolve to multi-axis PartitionSpecs, and the pipelined
+    train step runs SHARDED under an ambient pp x fsdp x tp mesh."""
+    from functools import partial
+
+    import optax
+    from tony_tpu.models.llama import (
+        get_config, llama_init, llama_loss_pipelined,
+        llama_pipeline_param_axes,
+    )
+    from tony_tpu.parallel.sharding import logical_to_mesh_axes
+    from tony_tpu.train.step import make_train_step
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(plan_mesh(8, pp=2, fsdp=2, tp=2))
+    config = get_config("tiny", n_layers=4)
+    staged_axes = llama_pipeline_param_axes(config)
+    # wq: (stage, layers, embed, heads) -> pp + fsdp + tp in ONE spec
+    assert logical_to_mesh_axes(staged_axes["wq"], mesh=mesh) == \
+        P("pp", None, "fsdp", "tp")
+    assert logical_to_mesh_axes(staged_axes["w_down"], mesh=mesh) == \
+        P("pp", None, "tp", "fsdp")
+
+    params = llama_init(config, jax.random.PRNGKey(0))
+    opt = optax.adam(1e-3)
+    step = make_train_step(
+        partial(llama_loss_pipelined, config=config, mesh=mesh, n_micro=2),
+        opt)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                config.vocab_size, jnp.int32)
+    with jax.set_mesh(mesh):
+        opt_state = jax.jit(opt.init)(params)
+        params, opt_state, loss = step(params, opt_state,
+                                       {"tokens": tokens})
+    assert np.isfinite(float(loss))

@@ -35,6 +35,20 @@ from jax import lax
 # and feed the MXU full tiles; >=1024 plateaus and 2048 blows compile.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
+
+
+def _vma(x):
+    """Propagate the operand's varying-manual-axes set into pallas
+    out_shapes — required when the kernel is traced under a
+    check_vma=True shard_map (e.g. the pp pipeline's stages)."""
+    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+
+def _like_vma(x, ref):
+    """Give `x` the varying-manual-axes of `ref` (scan carries must match
+    their outputs under check_vma; a fresh zeros init is unvarying)."""
+    want = _vma(ref) - _vma(x)
+    return lax.pcast(x, tuple(want), to="varying") if want else x
 NEG_INF = -1e30
 
 
@@ -140,8 +154,8 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32, vma=_vma(q)),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -184,9 +198,9 @@ def _blockwise_forward(q, k, v, causal, sm_scale, block_k, kv_len=None):
             "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
         return (m_new, l_new, acc), None
 
-    init = (jnp.full((b, h, s, 1), NEG_INF, jnp.float32),
-            jnp.zeros((b, h, s, 1), jnp.float32),
-            jnp.zeros((b, h, s, d), jnp.float32))
+    init = (_like_vma(jnp.full((b, h, s, 1), NEG_INF, jnp.float32), q),
+            _like_vma(jnp.zeros((b, h, s, 1), jnp.float32), q),
+            _like_vma(jnp.zeros((b, h, s, d), jnp.float32), q))
     (m, l, acc), _ = lax.scan(body, init, (jnp.arange(nkb), (kb, vb)))
     l = jnp.maximum(l, 1e-30)
     out = (acc / l).astype(q.dtype)
@@ -227,7 +241,7 @@ def _blockwise_backward(q, k, v, out, lse, g, causal, sm_scale, block_k,
         dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
         return dq, (dk_blk, dv_blk)
 
-    dq0 = jnp.zeros((b, h, s, d), jnp.float32)
+    dq0 = _like_vma(jnp.zeros((b, h, s, d), jnp.float32), q)
     dq, (dk_blocks, dv_blocks) = lax.scan(
         body, dq0, (jnp.arange(nkb), (kb, vb)))
     dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, s, d)
@@ -358,7 +372,7 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype, vma=_vma(q)),
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta)
 
@@ -380,8 +394,8 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype, vma=_vma(k)),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype, vma=_vma(k)),
         ],
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta)

@@ -1,12 +1,31 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over the pp axis.
+"""Pipeline parallelism: microbatch schedule over the pp axis, composable
+with fsdp/tp INSIDE each stage.
 
 No reference equivalent (SURVEY.md §2.3 lists PP as absent) — built
-TPU-first: the schedule is a `lax.scan` over time steps inside `shard_map`,
-with `lax.ppermute` moving activations to the next stage over ICI
-neighbors. Stage weights live sharded on the `pp` mesh axis (logical axis
-"stage"), so each device holds only its layers. The bubble is the standard
-(n_stages - 1) / (n_micro + n_stages - 1); gradients flow through ppermute,
-so the same function trains under `jax.grad` with no extra machinery.
+TPU-first:
+
+- **Composition (VERDICT r2 item 2)**: `shard_map` is manual over ONLY the
+  `pp` axis (`axis_names={"pp"}`); every other mesh axis (fsdp/tp/dp/sp)
+  stays Auto inside the stage body, so the model's own `constrain` calls
+  keep sharding stage-internal weights and activations and XLA inserts the
+  within-stage collectives. Stage weights therefore shard on
+  pp × fsdp × tp simultaneously — the leading stage dim rides pp, the
+  inner dims keep their tensor/FSDP layout.
+- **Schedule**: forward is the standard fill-drain pipeline expressed as a
+  `lax.scan` over ticks with `lax.ppermute` moving activations to the next
+  stage over ICI neighbors. The backward is HAND-SCHEDULED via
+  `jax.custom_vjp` in 1F1B drain order: cotangents enter at the last
+  stage the tick a microbatch's loss grad is ready and flow backward one
+  stage per tick (reverse ppermute), each stage recomputing its forward
+  from the saved stage INPUT (`jax.vjp` per microbatch — activation
+  recompute, not storage) and accumulating weight grads locally. In-flight
+  cotangent state is one microbatch per device; saved state is
+  n_micro + n_stages - 1 stage INPUTS per device (one per forward tick,
+  fill/drain ticks included) — boundary activations only, instead of
+  AD-of-scan retaining every stage's full forward residuals.
+- Bubble: (n_stages - 1)/(n_micro + n_stages - 1) per pass, the classical
+  fill/drain cost — amortize with more microbatches; memory stays bounded
+  as above.
 
 Usage:
     f = make_pipelined_fn(stage_fn, mesh, n_micro=8)
@@ -29,21 +48,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
-def pipeline_apply(stage_fn: StageFn, stage_params: Any,
-                   microbatches: jax.Array,
-                   axis_name: str = "pp") -> jax.Array:
-    """Runs INSIDE shard_map over `axis_name`. microbatches: (M, mb, ...)
-    (replicated across pp); stage_params: this rank's stage weights.
-    Returns (M, mb, ...) — the last stage's outputs, broadcast to every
-    rank (psum of a one-hot mask) so callers can compute the loss anywhere.
-    """
+def _varying(x: jax.Array, axis_name: str) -> jax.Array:
+    """Mark `x` varying over the pp axis (vma discipline, check_vma=True);
+    idempotent — zeros_like of an already-varying operand is varying."""
+    if axis_name in getattr(jax.typeof(x), "vma", ()):
+        return x
+    return lax.pcast(x, (axis_name,), to="varying")
+
+
+def _fwd_scan(stage_fn: StageFn, stage_params: Any,
+              microbatches: jax.Array, axis_name: str):
+    """Fill/drain forward. Returns (out (M, mb, ...), ins (T, mb, ...))
+    where ins[t] is THIS device's stage input at tick t — stage d's input
+    for microbatch m sits at ins[m + d], the residual the 1F1B backward
+    recomputes from."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
 
-    # pad the input stream with n-1 drain steps
     pad = jnp.zeros((n - 1,) + microbatches.shape[1:], microbatches.dtype)
-    stream = jnp.concatenate([microbatches, pad], axis=0)
+    # vma discipline (check_vma=True): everything entering the scan that
+    # mixes with per-device state must be marked varying over pp
+    stream = _varying(jnp.concatenate([microbatches, pad], axis=0),
+                      axis_name)
 
     def step(carry, x_t):
         # stage 0 consumes the input stream; later stages consume what the
@@ -52,15 +79,81 @@ def pipeline_apply(stage_fn: StageFn, stage_params: Any,
         y = stage_fn(stage_params, inp)
         fwd = [(i, (i + 1) % n) for i in range(n)]
         carry_next = lax.ppermute(y, axis_name, fwd)
-        return carry_next, y
+        return carry_next, (y, inp)
 
-    init = jnp.zeros_like(microbatches[0])
-    _, ys = lax.scan(step, init, stream)          # (M+n-1, mb, ...)
+    init = _varying(jnp.zeros_like(microbatches[0]), axis_name)
+    _, (ys, ins) = lax.scan(step, init, stream)      # (M+n-1, mb, ...)
     # the last stage's outputs for microbatch m appear at step m + n - 1
     out = lax.dynamic_slice_in_dim(ys, n - 1, n_micro, axis=0)
     # broadcast the last rank's (only correct) copy to every rank
     mask = (idx == n - 1).astype(out.dtype)
-    return lax.psum(out * mask, axis_name)
+    return lax.psum(out * mask, axis_name), ins
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def pipeline_apply(stage_fn: StageFn, axis_name: str, stage_params: Any,
+                   microbatches: jax.Array) -> jax.Array:
+    """Runs INSIDE shard_map (manual over `axis_name` only).
+    microbatches: (M, mb, ...) replicated across pp; stage_params: this
+    rank's stage weights. Returns (M, mb, ...) — the last stage's outputs
+    broadcast to every rank so callers can compute the loss anywhere.
+    Differentiable: the custom vjp runs the 1F1B-ordered backward pipeline
+    (see module docstring)."""
+    out, _ = _fwd_scan(stage_fn, stage_params, microbatches, axis_name)
+    return out
+
+
+def _pipe_fwd(stage_fn, axis_name, stage_params, microbatches):
+    out, ins = _fwd_scan(stage_fn, stage_params, microbatches, axis_name)
+    return out, (stage_params, ins, microbatches.shape[0])
+
+
+def _pipe_bwd(stage_fn, axis_name, residuals, dy):
+    """1F1B drain-order backward: tick t hands device d the cotangent for
+    microbatch m = t - (n-1-d); the last stage reads it straight from the
+    dy stream, everyone else from the reverse ppermute. Each tick
+    recomputes ONE stage forward from its saved input and accumulates the
+    weight grads — per-stage recompute in pipeline order, never a stored
+    forward graph."""
+    stage_params, ins, n_micro = residuals
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+
+    pad = jnp.zeros((n - 1,) + dy.shape[1:], dy.dtype)
+    dy_stream = _varying(jnp.concatenate([dy, pad], axis=0),
+                         axis_name)                   # (T, mb, ...)
+    ticks = _varying(jnp.arange(n_micro + n - 1), axis_name)
+
+    zero_grads = jax.tree.map(
+        lambda p: _varying(jnp.zeros_like(p), axis_name), stage_params)
+
+    def step(carry, tk):
+        t, g_carry, grads_acc = tk[0], carry[0], carry[1]
+        m = t - (n - 1 - idx)                 # this device's microbatch
+        valid = (m >= 0) & (m < n_micro)
+        g_in = jnp.where(idx == n - 1, dy_stream[t], g_carry)
+        # saved input of stage idx for microbatch m lives at ins[m + idx]
+        x_saved = lax.dynamic_index_in_dim(
+            ins, jnp.clip(m + idx, 0, ins.shape[0] - 1), axis=0,
+            keepdims=False)
+        _, vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        dp, dx = vjp(g_in)
+        grads_acc = jax.tree.map(
+            lambda acc, d: acc + jnp.where(valid, d, 0), grads_acc, dp)
+        rev = [(i, (i - 1) % n) for i in range(n)]
+        g_next = lax.ppermute(jnp.where(valid, dx, 0), axis_name, rev)
+        return (g_next, grads_acc), dx
+
+    init = (_varying(jnp.zeros_like(dy[0]), axis_name), zero_grads)
+    (_, grads), dxs = lax.scan(step, init, (ticks,))
+    # stage 0's dx at tick m + (n-1) is d(microbatch m input)
+    d_mb = lax.dynamic_slice_in_dim(dxs, n - 1, n_micro, axis=0)
+    mask = (idx == 0).astype(d_mb.dtype)
+    d_mb = lax.psum(d_mb * mask, axis_name)
+    return grads, d_mb
+
+
+pipeline_apply.defvjp(_pipe_fwd, _pipe_bwd)
 
 
 def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:
@@ -78,22 +171,25 @@ def merge_microbatches(y: jax.Array) -> jax.Array:
 def make_pipelined_fn(stage_fn: StageFn, mesh: Mesh, n_micro: int,
                       axis_name: str = "pp") -> Callable:
     """Wrap stage_fn into f(stacked_params, x) running the full pipeline.
-    stacked_params: leading stage dim (== mesh pp size) sharded on pp;
-    x: (B, ...) replicated."""
+    stacked_params: leading stage dim (== mesh pp size) sharded on pp —
+    INNER dims may shard on fsdp/tp (they stay Auto; shard_map is manual
+    on pp alone, so within-stage sharding composes); x: (B, ...)
+    replicated across pp (batch/seq may shard on dp/fsdp/sp)."""
 
     def stage_slot(params_stacked, x_mb):
         # inside shard_map the pp-sharded leading dim has local size 1
         local = jax.tree.map(lambda p: p[0], params_stacked)
-        return pipeline_apply(stage_fn, local, x_mb, axis_name)
+        return pipeline_apply(stage_fn, axis_name, local, x_mb)
 
-    param_specs = P(axis_name)  # leading stage dim on pp, rest replicated
+    param_specs = P(axis_name)  # stage dim on pp; inner dims stay Auto
 
     def f(params_stacked, x):
         mb = split_microbatches(x, n_micro)
         specs_in = (jax.tree.map(lambda _: param_specs, params_stacked),
                     P())
         y = jax.shard_map(stage_slot, mesh=mesh, in_specs=specs_in,
-                          out_specs=P(), check_vma=False)(params_stacked, mb)
+                          out_specs=P(), axis_names={axis_name})(
+                              params_stacked, mb)
         return merge_microbatches(y)
 
     return f
