@@ -248,17 +248,14 @@ def _bench_8b_layer(jax, jnp, optax, dev) -> dict:
 # parent: supervise, diagnose, retry, fall back
 # ---------------------------------------------------------------------------
 
-def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
-    """Run one measurement child. Returns (result_json_or_None, diag)."""
-    env = dict(os.environ)
-    if backend == "cpu":
-        # Never let a CPU child (or its jax import) claim the tunnel.
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
-        env["JAX_PLATFORMS"] = "cpu"
+def _supervise(argv: list[str], deadline: float,
+               env: dict | None = None) -> tuple[str, str, str, bool]:
+    """Run one supervised child under a deadline with the
+    SIGTERM(faulthandler dump)->SIGKILL ladder. Returns
+    (stdout, stderr, state, clean_exit) — the single implementation all
+    bench children (probe, tpu, cpu) share."""
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--child", backend],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env, cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
     try:
         out, err = proc.communicate(timeout=deadline)
@@ -271,20 +268,39 @@ def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
         except subprocess.TimeoutExpired:
             proc.kill()
             out, err = proc.communicate()
+    state = (f"timed out after {deadline:.0f}s" if timed_out
+             else f"exited rc={proc.returncode}")
+    return out, err, state, (not timed_out and proc.returncode == 0)
+
+
+def _diag(err: str, state: str, what: str) -> str:
+    """Progress-marker + stderr-tail diagnosis line for a failed child."""
+    marks = [ln for ln in err.splitlines() if ln.startswith("[bench ")]
+    last = marks[-1] if marks else "(no progress marker)"
     tail = "\n".join(err.strip().splitlines()[-12:])
-    if not timed_out and proc.returncode == 0:
+    return f"{what} {state}; last progress: {last}; stderr tail:\n{tail}"
+
+
+def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
+    """Run one measurement child. Returns (result_json_or_None, diag)."""
+    env = dict(os.environ)
+    if backend == "cpu":
+        # Never let a CPU child (or its jax import) claim the tunnel.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    out, err, state, clean = _supervise(
+        [sys.executable, os.path.abspath(__file__), "--child", backend],
+        deadline, env=env)
+    tail = "\n".join(err.strip().splitlines()[-12:])
+    if clean:
         for line in reversed(out.strip().splitlines()):
             try:
                 return json.loads(line), tail
             except ValueError:
                 continue
         return None, f"child exited 0 without JSON; stderr tail:\n{tail}"
-    marks = [ln for ln in err.splitlines() if ln.startswith("[bench ")]
-    last = marks[-1] if marks else "(no progress marker)"
-    state = (f"timed out after {deadline:.0f}s" if timed_out
-             else f"exited rc={proc.returncode}")
-    return None, (f"{backend} child {state}; last progress: {last}; "
-                  f"stderr tail:\n{tail}")
+    return None, _diag(err, state, f"{backend} child")
 
 
 def main() -> None:
@@ -294,42 +310,25 @@ def main() -> None:
     # the parent mid-run and get no JSON at all (round 1's rc=124 mode).
     t_start = time.monotonic()
     grace = 20.0   # per-child kill grace + spawn overhead
-    reserve = 3 * grace + 15.0
+    reserve = 4 * grace + 15.0   # probe + 2 tpu attempts + cpu fallback
     usable = max(60.0, BUDGET_SEC - reserve)
     diags: list[str] = []
 
     # Cheap pre-probe: if the tunnel is wedged, find out early with a
     # stage-pinpointed stack instead of burning the 45% first attempt.
-    # Deadline scales with the budget (a slow-but-healthy backend must
-    # not be misclassified) and is overridable for unusual environments.
+    # Deadline scales with the budget but is CAPPED by the usable window
+    # (a tiny TONY_BENCH_WATCHDOG_SEC must not overrun the total budget —
+    # the parent must always print its JSON inside it) and is
+    # overridable for unusual environments.
     probe_deadline = float(os.environ.get(
         "TONY_BENCH_PROBE_SEC", max(90.0, 0.2 * BUDGET_SEC)))
-    probe = subprocess.Popen(
+    probe_deadline = max(15.0, min(probe_deadline, 0.3 * usable))
+    p_out, p_err, p_state, p_clean = _supervise(
         [sys.executable, os.path.abspath(__file__), "--probe"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
-    try:
-        p_out, p_err = probe.communicate(timeout=probe_deadline)
-        probe_timed_out = False
-    except subprocess.TimeoutExpired:
-        probe_timed_out = True
-        probe.send_signal(signal.SIGTERM)   # faulthandler stack dump
-        try:
-            p_out, p_err = probe.communicate(timeout=15)
-        except subprocess.TimeoutExpired:
-            probe.kill()
-            p_out, p_err = probe.communicate()
-    probe_ok = (not probe_timed_out and probe.returncode == 0
-                and "PROBE-OK" in p_out)
+        probe_deadline)
+    probe_ok = p_clean and "PROBE-OK" in p_out
     if not probe_ok:
-        marks = [ln for ln in p_err.splitlines()
-                 if ln.startswith("[bench ")]
-        last = marks[-1] if marks else "(no progress marker)"
-        tail = "\n".join(p_err.strip().splitlines()[-12:])
-        state = (f"timed out after {probe_deadline:.0f}s" if probe_timed_out
-                 else f"exited rc={probe.returncode}")
-        diags.append(f"pre-probe: {state}; wedged at stage: {last}; "
-                     f"stderr tail:\n{tail}")
+        diags.append(_diag(p_err, p_state, "pre-probe"))
         print(f"[bench parent] {diags[-1]}", file=sys.stderr, flush=True)
 
     # Attempt 1 + retry on the real accelerator. A failed probe does NOT

@@ -59,6 +59,7 @@ class MoEConfig(LlamaConfig):
     dispatch_mode: str = "sparse"
 
     def __post_init__(self):
+        super().__post_init__()
         if self.dispatch_mode not in ("sparse", "dense"):
             raise ValueError(
                 f"dispatch_mode must be 'sparse' or 'dense', got "
@@ -260,7 +261,7 @@ def moe_forward(params: Params, tokens: jax.Array, config: MoEConfig
         return constrain(x + moe_out, ("batch", "seq", None)), aux
 
     if config.remat:
-        block = jax.checkpoint(block)
+        block = jax.checkpoint(block, policy=config.checkpoint_policy())
 
     x, aux_losses = lax.scan(lambda x, layer: block(x, layer), x,
                              params["layers"])

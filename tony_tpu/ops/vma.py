@@ -1,0 +1,37 @@
+"""Varying-manual-axes (vma) helpers for check_vma=True shard_map bodies.
+
+Under a partial-manual `jax.shard_map` (e.g. the pp pipeline), scan
+carries, fresh zeros, and pallas out_shapes must carry explicit vma
+annotations or tracing fails with carry/type mismatches. These two
+helpers are the single implementation shared by the pipeline schedule
+and the flash-attention kernels — the `jax.typeof(x).vma` query and the
+idempotent `lax.pcast(..., to="varying")` promotion live here only.
+
+Lives under ops/ (a leaf package) on purpose: parallel/__init__ imports
+ulysses which imports ops.attention, so an ops -> parallel import edge
+would be a cycle whose failure depends on import order.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def vma_of(x) -> frozenset:
+    """The operand's varying-manual-axes set (empty outside shard_map)."""
+    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+
+def varying_over(x: jax.Array, axis_name: str) -> jax.Array:
+    """Mark `x` varying over one manual axis; idempotent."""
+    if axis_name in vma_of(x):
+        return x
+    return lax.pcast(x, (axis_name,), to="varying")
+
+
+def match_vma(x: jax.Array, ref) -> jax.Array:
+    """Give `x` the varying axes of `ref` (scan carries must match their
+    outputs; a fresh zeros init is unvarying)."""
+    want = vma_of(ref) - vma_of(x)
+    return lax.pcast(x, tuple(want), to="varying") if want else x

@@ -45,15 +45,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tony_tpu.ops.vma import varying_over as _varying
+
 StageFn = Callable[[Any, jax.Array], jax.Array]
-
-
-def _varying(x: jax.Array, axis_name: str) -> jax.Array:
-    """Mark `x` varying over the pp axis (vma discipline, check_vma=True);
-    idempotent — zeros_like of an already-varying operand is varying."""
-    if axis_name in getattr(jax.typeof(x), "vma", ()):
-        return x
-    return lax.pcast(x, (axis_name,), to="varying")
 
 
 def _fwd_scan(stage_fn: StageFn, stage_params: Any,

@@ -24,8 +24,12 @@ from bench import peak_flops  # noqa: E402
 from tony_tpu.models.llama import get_config, llama_init, llama_loss  # noqa: E402
 from tony_tpu.train.step import make_train_step  # noqa: E402
 
+# Measured on v5e (2026-07-30): base_b4 (save_flash remat) 67.8%,
+# fullremat_b4 65.5%, b2 66.2%, b8 flat, noremat_*/dots_b4 exceed HBM
+# (the remote-compile helper then 500s — that error usually means OOM).
 VARIANTS: dict[str, dict] = {
     "base_b4":   dict(batch=4, seq=4096),
+    "fullremat_b4": dict(batch=4, seq=4096, remat_policy="full"),
     "b8":        dict(batch=8, seq=4096),
     "b2":        dict(batch=2, seq=4096),
     "noremat_b2": dict(batch=2, seq=4096, remat=False),
@@ -39,6 +43,8 @@ def run(name: str, spec: dict) -> dict:
     overrides = {}
     if not spec.get("remat", True):
         overrides["remat"] = False
+    if "remat_policy" in spec:
+        overrides["remat_policy"] = spec["remat_policy"]
     config = get_config("llama3_1b_proxy", max_seq=spec["seq"], **overrides)
     policy = spec.get("policy")
     if policy is not None:
