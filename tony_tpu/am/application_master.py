@@ -147,7 +147,8 @@ class ApplicationMaster(ClusterServiceHandler):
         self.backend = backend or backend_from_conf(conf, app_id)
         self.session: Optional[TonySession] = None
         self.scheduler: Optional[TaskScheduler] = None
-        self.metrics_store = MetricsStore()
+        self.metrics_store = MetricsStore(
+            low_util_intervals=conf.get_int(K.TASK_LOW_UTIL_INTERVALS, 24))
         self._session_id = 0
         self._rpc_server = None
         self.rpc_port = 0

@@ -67,6 +67,7 @@ DEFAULTS = {
     K.TASK_HEARTBEAT_INTERVAL_MS: 1000,
     K.TASK_MAX_MISSED_HEARTBEATS: 25,
     K.TASK_METRICS_INTERVAL_MS: 5000,
+    K.TASK_LOW_UTIL_INTERVALS: 24,
     K.TASK_EXECUTOR_JVM_OPTS: "",
     # reference default constant 15 min (TonyConfigurationKeys.java:243-244)
     K.CONTAINER_ALLOCATION_TIMEOUT: 15 * 60 * 1000,

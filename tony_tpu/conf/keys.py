@@ -41,6 +41,9 @@ AM_STOP_POLL_TIMEOUT_MS = "tony.am.stop-poll-timeout-ms"
 TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
 TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
 TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
+# consecutive ~0%-duty metric updates before a heartbeating task is
+# flagged as wedged (AM MetricsStore; 24 x 5s default = 2 min)
+TASK_LOW_UTIL_INTERVALS = "tony.task.low-utilization-intervals"
 TASK_EXECUTOR_JVM_OPTS = "tony.task.executor.jvm.opts"    # kept for parity; unused
 CONTAINER_ALLOCATION_TIMEOUT = "tony.container.allocation.timeout"  # ms
 CONTAINERS_RESOURCES = "tony.containers.resources"        # multi-value append key
