@@ -164,6 +164,38 @@ def test_llama_pipelined_grads_match_sequential():
             err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
 
 
+def test_llama_pipelined_composes_pp_with_sp():
+    """pp x sp composition: the pipeline widens its manual region to
+    {pp, sp} and runs ring/ulysses attention DIRECTLY inside the stage
+    (shard_map cannot nest inside a manual region — the earlier nested
+    form produced silently wrong layer grads). Gradient parity against
+    the meshless sequential model for BOTH sp flavors."""
+    from functools import partial
+
+    from tony_tpu.models.llama import (
+        get_config, llama_init, llama_loss, llama_loss_pipelined,
+    )
+
+    base = get_config("tiny", n_layers=4)
+    params = llama_init(base, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                base.vocab_size, jnp.int32)
+    batch = {"tokens": tokens}
+    want = jax.jit(jax.grad(partial(llama_loss, config=base)))(params,
+                                                               batch)
+    mesh = make_mesh(plan_mesh(8, pp=2, sp=2, fsdp=2))
+    for sp_mode in ("ring", "ulysses"):
+        config = get_config("tiny", n_layers=4, sp_mode=sp_mode)
+        with jax.set_mesh(mesh):
+            got = jax.jit(jax.grad(partial(
+                llama_loss_pipelined, config=config, mesh=mesh,
+                n_micro=2)))(params, batch)
+        for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=2e-4, rtol=2e-3,
+                                       err_msg=f"sp_mode={sp_mode}")
+
+
 def test_llama_pipelined_composes_pp_with_fsdp_tp():
     """Stage weights shard on pp AND fsdp/tp simultaneously: the staged
     logical axes resolve to multi-axis PartitionSpecs, and the pipelined

@@ -37,7 +37,7 @@ from tony_tpu.ops.attention import flash_attention
 from tony_tpu.ops.rmsnorm import rms_norm
 from tony_tpu.ops.rope import apply_rope, rope_frequencies
 from tony_tpu.parallel.ring import ring_attention
-from tony_tpu.parallel.sharding import constrain, logical_to_mesh_axes
+from tony_tpu.parallel.sharding import constrain
 
 Params = dict[str, Any]
 
@@ -183,20 +183,28 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
     """Sequence-parallel attention (ring or ulysses per config.sp_mode)
     when the ambient mesh shards the sequence axis, flash attention
     otherwise."""
+    from tony_tpu.ops.vma import manual_axes_of_context
+
     mesh = jax.sharding.get_abstract_mesh()
     sp = mesh.shape.get("sp", 1) if mesh is not None and mesh.axis_names else 1
     if sp > 1:
         from tony_tpu.parallel.ulysses import ulysses_attention
 
-        spec = logical_to_mesh_axes(("batch", "heads", "seq", None),
-                                    mesh=mesh)
         if config.sp_mode == "ulysses":
             inner = partial(ulysses_attention, axis_name="sp", causal=True)
         else:
             inner = partial(ring_attention, axis_name="sp", causal=True)
+        if "sp" in manual_axes_of_context():
+            # already inside a manual-sp region (the pp pipeline widens
+            # its shard_map to {pp, sp}): call the collective attention
+            # DIRECTLY — shard_map does not nest inside a manual region
+            return inner(q, k, v)
+        # partial-manual over sp ONLY: batch/heads stay Auto so their
+        # sharding constraints keep working
+        spec = jax.sharding.PartitionSpec(None, None, "sp")
         f = jax.shard_map(
             inner, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            axis_names={"sp"})
         return f(q, k, v)
     return flash_attention(q, k, v, True)
 
@@ -288,21 +296,36 @@ def llama_forward_pipelined(params: Params, tokens: jax.Array,
     manual over pp ONLY, so each stage's weights and activations keep
     their within-stage fsdp/tp sharding (VERDICT r2 item 2 — pp composes
     with tp/fsdp). Requires n_layers % pp == 0 and batch % n_micro == 0."""
+    from jax.sharding import PartitionSpec as P
+
+    from tony_tpu.ops.vma import varying_full
     from tony_tpu.parallel.pipeline import make_pipelined_fn
 
     pp = dict(mesh.shape).get("pp", 1)
+    sp = dict(mesh.shape).get("sp", 1)
     L = config.n_layers
     if L % pp != 0:
         raise ValueError(f"n_layers {L} not divisible by pp={pp}")
-    s = tokens.shape[1]
-    cos, sin = rope_frequencies(config.head_dim, s, config.rope_theta)
-
-    block = partial(_block, config, cos, sin)
-    if config.remat:
-        block = jax.checkpoint(block, policy=config.checkpoint_policy())
 
     def stage_fn(stage_layers, x):
-        # scan this stage's L/pp layers (leading dim of stage_layers)
+        # rope tables are computed (cheaply) INSIDE the stage so they are
+        # fresh constants of the manual region; varying_full marks them +
+        # the replicated-over-sp stage weights varying, and the pcast's
+        # vjp is exactly the psum that reduces their cotangents over sp
+        seq = x.shape[1] * sp if sp > 1 else x.shape[1]
+        cos, sin = rope_frequencies(config.head_dim, seq, config.rope_theta)
+        if sp > 1:
+            # each rank holds its local seq chunk: slice its rope rows
+            idx = lax.axis_index("sp")
+            cos = lax.dynamic_slice_in_dim(cos, idx * x.shape[1],
+                                           x.shape[1], axis=0)
+            sin = lax.dynamic_slice_in_dim(sin, idx * x.shape[1],
+                                           x.shape[1], axis=0)
+        cos, sin = varying_full(cos), varying_full(sin)
+        stage_layers = jax.tree.map(varying_full, stage_layers)
+        block = partial(_block, config, cos, sin)
+        if config.remat:
+            block = jax.checkpoint(block, policy=config.checkpoint_policy())
         x, _ = lax.scan(lambda x, layer: (block(x, layer), None),
                         x, stage_layers)
         return x
@@ -315,7 +338,13 @@ def llama_forward_pipelined(params: Params, tokens: jax.Array,
         for k, p in params["layers"].items()}
 
     x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
-    pipe = make_pipelined_fn(stage_fn, mesh, n_micro=n_micro)
+    # with a real sp axis the pipeline's manual region widens to {pp, sp}
+    # and microbatches enter sequence-sharded, so the stage can run
+    # ring/ulysses attention directly (shard_map cannot nest)
+    extra = ("sp",) if sp > 1 else ()
+    mb_spec = P(None, None, "sp") if sp > 1 else P()
+    pipe = make_pipelined_fn(stage_fn, mesh, n_micro=n_micro,
+                             extra_manual=extra, mb_spec=mb_spec)
     x = pipe(staged_layers, x)
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     return jnp.einsum("bsd,dv->bsv", x, params["output"],

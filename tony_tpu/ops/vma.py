@@ -35,3 +35,24 @@ def match_vma(x: jax.Array, ref) -> jax.Array:
     outputs; a fresh zeros init is unvarying)."""
     want = vma_of(ref) - vma_of(x)
     return lax.pcast(x, tuple(want), to="varying") if want else x
+
+
+def manual_axes_of_context() -> frozenset:
+    """Mesh axes the ambient context holds Manually (inside shard_map)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return frozenset()
+    return frozenset(
+        name for name, t in zip(mesh.axis_names,
+                                getattr(mesh, "axis_types", ()))
+        if "Manual" in str(t))
+
+
+def varying_full(x: jax.Array) -> jax.Array:
+    """Mark `x` varying over EVERY manual axis of the ambient context —
+    the right promotion for fresh constants (zeros inits, streams,
+    replicated weights) entering a multi-axis manual region; the vjp of
+    the inserted pcast is the psum that correctly reduces their
+    cotangents."""
+    want = manual_axes_of_context() - vma_of(x)
+    return lax.pcast(x, tuple(sorted(want)), to="varying") if want else x

@@ -45,7 +45,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tony_tpu.ops.vma import varying_over as _varying
+from tony_tpu.ops.vma import (
+    match_vma as _match, varying_full as _varying, varying_over,
+)
 
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
@@ -63,8 +65,7 @@ def _fwd_scan(stage_fn: StageFn, stage_params: Any,
     pad = jnp.zeros((n - 1,) + microbatches.shape[1:], microbatches.dtype)
     # vma discipline (check_vma=True): everything entering the scan that
     # mixes with per-device state must be marked varying over pp
-    stream = _varying(jnp.concatenate([microbatches, pad], axis=0),
-                      axis_name)
+    stream = _varying(jnp.concatenate([microbatches, pad], axis=0))
 
     def step(carry, x_t):
         # stage 0 consumes the input stream; later stages consume what the
@@ -75,7 +76,7 @@ def _fwd_scan(stage_fn: StageFn, stage_params: Any,
         carry_next = lax.ppermute(y, axis_name, fwd)
         return carry_next, (y, inp)
 
-    init = _varying(jnp.zeros_like(microbatches[0]), axis_name)
+    init = _varying(jnp.zeros_like(microbatches[0]))
     _, (ys, ins) = lax.scan(step, init, stream)      # (M+n-1, mb, ...)
     # the last stage's outputs for microbatch m appear at step m + n - 1
     out = lax.dynamic_slice_in_dim(ys, n - 1, n_micro, axis=0)
@@ -114,12 +115,17 @@ def _pipe_bwd(stage_fn, axis_name, residuals, dy):
     idx = lax.axis_index(axis_name)
 
     pad = jnp.zeros((n - 1,) + dy.shape[1:], dy.dtype)
-    dy_stream = _varying(jnp.concatenate([dy, pad], axis=0),
-                         axis_name)                   # (T, mb, ...)
-    ticks = _varying(jnp.arange(n_micro + n - 1), axis_name)
+    dy_stream = _varying(jnp.concatenate([dy, pad], axis=0))                   # (T, mb, ...)
+    # ticks drive the pp schedule only: widening them to the full manual
+    # set would taint `valid` and through it the param-grad accumulators
+    ticks = varying_over(jnp.arange(n_micro + n - 1), axis_name)
 
+    # grad accumulators must carry EXACTLY the params' vma (pp): the vjp
+    # inside the scan already psums any extra-axis (e.g. sp) cotangent
+    # back down via the stage's pvary, so widening these to the full
+    # manual set would overshoot the shard_map transpose's out specs
     zero_grads = jax.tree.map(
-        lambda p: _varying(jnp.zeros_like(p), axis_name), stage_params)
+        lambda p: _match(jnp.zeros_like(p), p), stage_params)
 
     def step(carry, tk):
         t, g_carry, grads_acc = tk[0], carry[0], carry[1]
@@ -138,7 +144,7 @@ def _pipe_bwd(stage_fn, axis_name, residuals, dy):
         g_next = lax.ppermute(jnp.where(valid, dx, 0), axis_name, rev)
         return (g_next, grads_acc), dx
 
-    init = (_varying(jnp.zeros_like(dy[0]), axis_name), zero_grads)
+    init = (_varying(jnp.zeros_like(dy[0])), zero_grads)
     (_, grads), dxs = lax.scan(step, init, (ticks,))
     # stage 0's dx at tick m + (n-1) is d(microbatch m input)
     d_mb = lax.dynamic_slice_in_dim(dxs, n - 1, n_micro, axis=0)
@@ -163,12 +169,22 @@ def merge_microbatches(y: jax.Array) -> jax.Array:
 
 
 def make_pipelined_fn(stage_fn: StageFn, mesh: Mesh, n_micro: int,
-                      axis_name: str = "pp") -> Callable:
+                      axis_name: str = "pp",
+                      extra_manual: tuple = (),
+                      mb_spec: P = P()) -> Callable:
     """Wrap stage_fn into f(stacked_params, x) running the full pipeline.
     stacked_params: leading stage dim (== mesh pp size) sharded on pp —
     INNER dims may shard on fsdp/tp (they stay Auto; shard_map is manual
     on pp alone, so within-stage sharding composes); x: (B, ...)
-    replicated across pp (batch/seq may shard on dp/fsdp/sp)."""
+    replicated across pp (batch may shard on dp/fsdp).
+
+    `extra_manual` widens the manual region (e.g. ("sp",) so the stage
+    can run ring/ulysses attention DIRECTLY over a manual sp axis —
+    shard_map does not nest inside a manual region) and `mb_spec` is the
+    microbatched input/output spec over those extra axes (e.g.
+    P(None, None, "sp") to shard the sequence dim of (M, mb, S, D))."""
+
+    manual = {axis_name, *extra_manual}
 
     def stage_slot(params_stacked, x_mb):
         # inside shard_map the pp-sharded leading dim has local size 1
@@ -180,9 +196,9 @@ def make_pipelined_fn(stage_fn: StageFn, mesh: Mesh, n_micro: int,
     def f(params_stacked, x):
         mb = split_microbatches(x, n_micro)
         specs_in = (jax.tree.map(lambda _: param_specs, params_stacked),
-                    P())
+                    mb_spec)
         y = jax.shard_map(stage_slot, mesh=mesh, in_specs=specs_in,
-                          out_specs=P(), axis_names={axis_name})(
+                          out_specs=mb_spec, axis_names=manual)(
                               params_stacked, mb)
         return merge_microbatches(y)
 

@@ -27,6 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tony_tpu.ops.attention import NEG_INF
+from tony_tpu.ops.vma import match_vma
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -67,9 +68,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return m_new, l_new, acc, k_nxt, v_nxt
 
-    init = (jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32),
-            jnp.zeros((b, h, s_local, 1), jnp.float32),
-            jnp.zeros((b, h, s_local, d), jnp.float32),
+    # fresh zeros are unvarying; the loop carries must match their outputs'
+    # vma under check_vma=True contexts (partial-manual shard_map)
+    init = (match_vma(jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32), q),
+            match_vma(jnp.zeros((b, h, s_local, 1), jnp.float32), q),
+            match_vma(jnp.zeros((b, h, s_local, d), jnp.float32), q),
             k, v)
     m, l, acc, _, _ = lax.fori_loop(0, n, step, init)
     l = jnp.maximum(l, 1e-30)
@@ -79,12 +82,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, causal: bool = False,
                            sm_scale: Optional[float] = None) -> jax.Array:
-    """Standalone wrapper: shards batch over (dp, fsdp), heads over tp, and
-    sequence over sp, then runs the ring."""
-    spec = P(("dp", "fsdp"), "tp", "sp", None)
+    """Standalone wrapper: manual over sp only (batch/heads dims stay Auto
+    and keep whatever dp/fsdp/tp sharding the arrays carry)."""
+    spec = P(None, None, "sp")
     f = jax.shard_map(
         lambda q_, k_, v_: ring_attention(q_, k_, v_, axis_name="sp",
                                           causal=causal, sm_scale=sm_scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        axis_names={"sp"})
     return f(q, k, v)

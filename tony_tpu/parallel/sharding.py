@@ -90,9 +90,8 @@ def constrain(x, logical_axes: Sequence[Optional[str]],
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return x
-    manual = {name for name, t in zip(mesh.axis_names,
-                                      getattr(mesh, "axis_types", ()))
-              if "Manual" in str(t)}
+    from tony_tpu.ops.vma import manual_axes_of_context
+    manual = manual_axes_of_context()
     spec = logical_to_mesh_axes(logical_axes, rules, mesh)
     if manual:
         cleaned = []

@@ -24,7 +24,6 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tony_tpu.ops.attention import flash_attention
-from tony_tpu.parallel.sharding import logical_to_mesh_axes
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -61,11 +60,12 @@ def ulysses_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                               sm_scale: Optional[float] = None,
                               axis_name: str = "sp") -> jax.Array:
     """Global-array entry: q,k,v (B, H, S, D) sharded (or shardable) with
-    seq on `axis_name`; wraps the shard_map with canonical specs."""
-    spec = logical_to_mesh_axes(("batch", "heads", "seq", None), mesh=mesh)
+    seq on `axis_name`; manual over sp only — batch/heads dims stay Auto
+    and keep their dp/fsdp/tp sharding."""
+    spec = P(None, None, axis_name)
     f = jax.shard_map(
         lambda a, b, c: ulysses_attention(a, b, c, axis_name=axis_name,
                                           causal=causal, sm_scale=sm_scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        axis_names={axis_name})
     return f(q, k, v)
