@@ -303,6 +303,43 @@ def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
     return None, _diag(err, state, f"{backend} child")
 
 
+_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tools",
+    "last_good_bench.json")
+
+
+def _record_last_good(result: dict) -> None:
+    """Best-effort snapshot of a successful TPU measurement (skipped for
+    CPU-device results) so a later wedged-tunnel run can attach it as
+    labeled metadata."""
+    if str(result.get("device", "")).lower() in ("cpu", ""):
+        return
+    snap = dict(result)
+    snap["measured_at"] = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
+    try:
+        # commit stamp is best-effort SEPARATELY: a missing git binary
+        # must not discard the whole snapshot
+        snap["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
+    except Exception:  # noqa: BLE001
+        snap["commit"] = "unknown"
+    try:
+        with open(_LAST_GOOD_PATH, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=2)
+    except Exception:  # noqa: BLE001 — metadata only
+        pass
+
+
+def _load_last_good():
+    try:
+        with open(_LAST_GOOD_PATH, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def main() -> None:
     # The whole supervised run must finish INSIDE the budget even when
     # every child eats its full deadline plus the 15s SIGTERM->SIGKILL
@@ -346,13 +383,17 @@ def main() -> None:
         if result is not None:
             if diags:
                 result["retries"] = attempt - 1
+            _record_last_good(result)
             print(json.dumps(result), flush=True)
             return
         diags.append(f"attempt {attempt}: {diag}")
         print(f"[bench parent] {diags[-1]}", file=sys.stderr, flush=True)
 
     # TPU is wedged: measure on CPU so the driver still gets real data,
-    # and report the TPU fault precisely.
+    # and report the TPU fault precisely. The most recent SUCCESSFUL TPU
+    # measurement (tools/last_good_bench.json, stamped with time+commit,
+    # updated on every good TPU run) rides along as clearly-labeled
+    # metadata — `value` stays 0.0; a dead tunnel is a dead tunnel.
     remaining = usable - (time.monotonic() - t_start)
     result, diag = _run_child("cpu", max(15.0, remaining))
     tpu_error = " || ".join(diags)[-1500:]
@@ -366,14 +407,21 @@ def main() -> None:
                                              None),
             "cpu_step_time_s": result.pop("step_time_s", None),
         })
+        last = _load_last_good()
+        if last is not None:
+            result["last_good_tpu_measurement"] = last
         print(json.dumps(result), flush=True)
         return
-    print(json.dumps({
+    final = {
         "metric": METRIC, "value": 0.0, "unit": "%MFU",
         "vs_baseline": 0.0,
         "error": "tpu wedged AND cpu fallback failed",
         "tpu_error": tpu_error, "cpu_error": diag[-800:],
-    }), flush=True)
+    }
+    last = _load_last_good()
+    if last is not None:
+        final["last_good_tpu_measurement"] = last
+    print(json.dumps(final), flush=True)
 
 
 if __name__ == "__main__":

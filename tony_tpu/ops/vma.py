@@ -2,10 +2,12 @@
 
 Under a partial-manual `jax.shard_map` (e.g. the pp pipeline), scan
 carries, fresh zeros, and pallas out_shapes must carry explicit vma
-annotations or tracing fails with carry/type mismatches. These two
-helpers are the single implementation shared by the pipeline schedule
-and the flash-attention kernels — the `jax.typeof(x).vma` query and the
-idempotent `lax.pcast(..., to="varying")` promotion live here only.
+annotations or tracing fails with carry/type mismatches. This module is
+the single implementation of the `jax.typeof(x).vma` query and the
+idempotent `lax.pcast(..., to="varying")` promotions, shared by the
+pipeline schedule, the flash-attention kernels, ring attention, and
+`parallel.sharding.constrain` (which drops the context's manual axes
+from specs via `manual_axes_of_context`).
 
 Lives under ops/ (a leaf package) on purpose: parallel/__init__ imports
 ulysses which imports ops.attention, so an ops -> parallel import edge
