@@ -24,6 +24,11 @@ def run_example(tmp_path, argv, extra_conf=()):
     conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "test")
     conf.set(K.AM_MONITOR_INTERVAL_MS, 200, "test")
     conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 2000, "test")
+    # Safety net: a wedged user process (e.g. a hung cross-process CPU
+    # collective) must FAIL the app, not hang the suite forever — the AM
+    # enforces this exactly like the reference's monitor timeout check
+    # (ApplicationMaster.java:580-658).
+    conf.set(K.APPLICATION_TIMEOUT, 300_000, "test")
     for k, v in extra_conf:
         conf.set(k, v, "test")
     client = TonyClient(conf)
@@ -50,7 +55,13 @@ def test_mnist_jax_example_two_workers(tmp_path):
                                     "mnist_distributed.py"),
          "--task_params", "--steps 60",
          "--conf", "tony.worker.instances=2",
-         "--conf", "tony.application.framework=jax"])
+         "--conf", "tony.application.framework=jax",
+         # 2 virtual CPU devices per worker, not the conftest's 8: the
+         # cross-process Gloo mesh drops from 16 ranks to 4, which cuts
+         # the first-collective compile (the observed wedge point under
+         # concurrent load) by an order of magnitude
+         "--conf", ("tony.execution.env=XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=2")])
     assert client.final_status == "SUCCEEDED", _logs(client)
 
 

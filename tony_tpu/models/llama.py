@@ -64,6 +64,11 @@ class LlamaConfig:
     # chunks over ICI neighbors (long context); "ulysses" swaps to
     # head-sharding with two all-to-alls (DCN-friendly, needs heads % sp == 0)
     sp_mode: str = "ring"
+    # sequence-chunk size for the fused LM-head cross-entropy (ops/xent.py):
+    # caps logits memory at O(B*chunk*vocab) instead of O(B*S*vocab) fwd AND
+    # bwd. 0 = unfused full-logits path (tiny/test configs, and inference
+    # always materializes logits via llama_forward).
+    xent_chunk: int = 0
 
     def __post_init__(self):
         if self.remat_policy not in ("save_flash", "full"):
@@ -102,13 +107,13 @@ class LlamaConfig:
 # Presets. llama3_8b mirrors BASELINE.json's target model; the tiny/bench
 # configs scale it down for tests and single-chip benchmarking.
 PRESETS = {
-    "llama3_8b": LlamaConfig(),
+    "llama3_8b": LlamaConfig(xent_chunk=1024),
     "llama3_1b_proxy": LlamaConfig(vocab_size=32_000, dim=2048, n_layers=16,
                                    n_heads=16, n_kv_heads=8, ffn_dim=8192,
-                                   max_seq=4096),
+                                   max_seq=4096, xent_chunk=1024),
     "bench_350m": LlamaConfig(vocab_size=32_000, dim=1024, n_layers=16,
                               n_heads=16, n_kv_heads=8, ffn_dim=4096,
-                              max_seq=2048),
+                              max_seq=2048, xent_chunk=1024),
     "tiny": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                         n_kv_heads=2, ffn_dim=128, max_seq=128,
                         dtype=jnp.float32, remat=False),
@@ -249,9 +254,9 @@ def _block(config: LlamaConfig, cos, sin, x, layer: Params):
     return constrain(x, ("batch", "seq", None))
 
 
-def llama_forward(params: Params, tokens: jax.Array,
-                  config: LlamaConfig) -> jax.Array:
-    """tokens: (B, S) int32 -> logits (B, S, vocab) in f32."""
+def llama_hidden(params: Params, tokens: jax.Array,
+                 config: LlamaConfig) -> jax.Array:
+    """tokens: (B, S) int32 -> final-normed hidden states (B, S, dim)."""
     s = tokens.shape[1]
     cos, sin = rope_frequencies(config.head_dim, s, config.rope_theta)
     x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
@@ -265,7 +270,13 @@ def llama_forward(params: Params, tokens: jax.Array,
         return block(x, layer), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return rms_norm(x, params["final_norm"], config.norm_eps)
+
+
+def llama_forward(params: Params, tokens: jax.Array,
+                  config: LlamaConfig) -> jax.Array:
+    """tokens: (B, S) int32 -> logits (B, S, vocab) in f32."""
+    x = llama_hidden(params, tokens, config)
     # bf16 operands, f32 accumulation: the MXU accumulates in f32 anyway,
     # so this matches an f32-cast matmul at the accumulator while running
     # at bf16 speed (the f32 cast halved MXU throughput for ~6% of model
@@ -273,6 +284,20 @@ def llama_forward(params: Params, tokens: jax.Array,
     logits = jnp.einsum("bsd,dv->bsv", x, params["output"],
                         preferred_element_type=jnp.float32)
     return constrain(logits, ("batch", "seq", "vocab"))
+
+
+def _head_loss(x: jax.Array, params: Params, targets: jax.Array,
+               config: LlamaConfig) -> jax.Array:
+    """LM-head + mean CE on final hidden states; fused-chunked when the
+    config asks for it (never materializes full (B,S,V) logits)."""
+    if config.xent_chunk > 0:
+        from tony_tpu.ops.xent import fused_cross_entropy
+        return fused_cross_entropy(x, params["output"], targets,
+                                   chunk=config.xent_chunk)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["output"],
+                        preferred_element_type=jnp.float32)
+    logits = constrain(logits, ("batch", "seq", "vocab"))
+    return cross_entropy(logits, targets)
 
 
 def llama_pipeline_param_axes(config: LlamaConfig) -> Params:
@@ -285,10 +310,13 @@ def llama_pipeline_param_axes(config: LlamaConfig) -> Params:
             for k, v in llama_param_axes(config)["layers"].items()}
 
 
-def llama_forward_pipelined(params: Params, tokens: jax.Array,
-                            config: LlamaConfig, mesh, n_micro: int
-                            ) -> jax.Array:
-    """Pipeline-parallel forward: the L layers are split into pp stages
+def llama_hidden_pipelined(params: Params, tokens: jax.Array,
+                           config: LlamaConfig, mesh, n_micro: int
+                           ) -> jax.Array:
+    """Pipeline-parallel backbone up to the final norm (head applied by the
+    caller, so the loss path can use the fused chunked CE).
+
+    The L layers are split into pp stages
     (mesh's pp axis size), microbatches flow through the fill/drain
     schedule with a 1F1B-ordered hand-written backward
     (parallel/pipeline.py); embedding + head run outside the pipeline
@@ -346,7 +374,16 @@ def llama_forward_pipelined(params: Params, tokens: jax.Array,
     pipe = make_pipelined_fn(stage_fn, mesh, n_micro=n_micro,
                              extra_manual=extra, mb_spec=mb_spec)
     x = pipe(staged_layers, x)
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    return rms_norm(x, params["final_norm"], config.norm_eps)
+
+
+def llama_forward_pipelined(params: Params, tokens: jax.Array,
+                            config: LlamaConfig, mesh, n_micro: int
+                            ) -> jax.Array:
+    """Pipelined forward -> logits (B, S, vocab) f32 (parity surface for
+    tests; training uses llama_loss_pipelined which skips full logits when
+    config.xent_chunk is set)."""
+    x = llama_hidden_pipelined(params, tokens, config, mesh, n_micro)
     return jnp.einsum("bsd,dv->bsv", x, params["output"],
                       preferred_element_type=jnp.float32)
 
@@ -355,8 +392,8 @@ def llama_loss_pipelined(params: Params, batch: dict[str, jax.Array],
                          config: LlamaConfig, mesh,
                          n_micro: int) -> jax.Array:
     inputs, targets = unpack_lm_batch(batch)
-    logits = llama_forward_pipelined(params, inputs, config, mesh, n_micro)
-    return cross_entropy(logits, targets)
+    x = llama_hidden_pipelined(params, inputs, config, mesh, n_micro)
+    return _head_loss(x, params, targets, config)
 
 
 def unpack_lm_batch(batch: dict[str, jax.Array]
@@ -379,5 +416,5 @@ def llama_loss(params: Params, batch: dict[str, jax.Array],
     """Next-token cross entropy. batch: {'tokens': (B, S+1)} or
     {'inputs': (B,S), 'targets': (B,S)}."""
     inputs, targets = unpack_lm_batch(batch)
-    logits = llama_forward(params, inputs, config)
-    return cross_entropy(logits, targets)
+    x = llama_hidden(params, inputs, config)
+    return _head_loss(x, params, targets, config)
