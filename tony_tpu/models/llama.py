@@ -195,6 +195,12 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
     if sp > 1:
         from tony_tpu.parallel.ulysses import ulysses_attention
 
+        from tony_tpu.ops.attention import _gqa_broadcast
+
+        # the ring/ulysses collectives work per-head: broadcast GQA K/V up
+        # front (the flash path below instead streams narrow K/V natively)
+        k, v = _gqa_broadcast(q, k, v)
+
         if config.sp_mode == "ulysses":
             inner = partial(ulysses_attention, axis_name="sp", causal=True)
         else:
@@ -216,8 +222,10 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
 
 def attention_sublayer(h: jax.Array, layer: Params, config: LlamaConfig,
                        cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """QKV + RoPE + GQA broadcast + (ring|flash) attention + output proj.
-    Shared by the dense block here and the MoE block (models/moe.py)."""
+    """QKV + RoPE + (ring|flash) attention + output proj. K/V stay in the
+    narrow GQA layout; the flash path streams them natively and the
+    sequence-parallel dispatch broadcasts them just-in-time. Shared by the
+    dense block here and the MoE block (models/moe.py)."""
     b, s, _ = h.shape
     nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     q = jnp.einsum("bsd,dh->bsh", h, layer["wq"])
@@ -228,13 +236,9 @@ def attention_sublayer(h: jax.Array, layer: Params, config: LlamaConfig,
     v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if nkv != nh:                                          # GQA broadcast
-        rep = nh // nkv
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
     q = constrain(q, ("batch", "heads", "seq", None))
-    k = constrain(k, ("batch", "heads", "seq", None))
-    v = constrain(v, ("batch", "heads", "seq", None))
+    k = constrain(k, ("batch", "kv_heads", "seq", None))
+    v = constrain(v, ("batch", "kv_heads", "seq", None))
     attn = _attention_dispatch(q, k, v, config)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
     return jnp.einsum("bsh,hd->bsd", attn, layer["wo"])

@@ -46,10 +46,11 @@ NEG_INF = -1e30
 def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         causal: bool = False,
                         sm_scale: Optional[float] = None) -> jax.Array:
-    """O(S^2) oracle. Shapes: q,k,v = (B, H, S, D) (K/V may have fewer heads
-    pre-broadcast by the caller)."""
+    """O(S^2) oracle. q: (B, H, S, D); k/v: (B, Hkv, S, D) with H % Hkv == 0
+    (GQA groups broadcast here)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    k, v = _gqa_broadcast(q, k, v)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * sm_scale
     if causal:
@@ -114,19 +115,38 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
+def _kv_row_map(h: int, hk: int):
+    """Grid-row -> K/V-row index map for GQA: program i walks (batch-major)
+    the b*h q-heads; its K/V live at row (batch * hk + group). The same map
+    serves the equal-heads case (h == hk -> identity), so one kernel covers
+    MHA and GQA without streaming repeated K/V bytes from HBM."""
+    # guard here so BOTH pallas directions fail loud: on compiled TPU an
+    # out-of-range index-map block clamps instead of raising
+    assert h % hk == 0, (h, hk)
+    rep = h // hk
+
+    def row(i):
+        return (i // h) * hk + (i % h) // rep
+
+    return row
+
+
 def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                     kv_len=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
     b, h, s, d = q.shape
+    hk = k.shape[1]
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, (s, block_q, block_k)
+    assert h % hk == 0, (h, hk)
     bh = b * h
     qf = q.reshape(bh, s, d)
-    kf = k.reshape(bh, s, d)
-    vf = v.reshape(bh, s, d)
+    kf = k.reshape(b * hk, s, d)
+    vf = v.reshape(b * hk, s, d)
+    kv_row = _kv_row_map(h, hk)
     grid = (bh, s // block_q)
     kernel = functools.partial(
         _flash_fwd_kernel, block_k=block_k, seq_len=s,
@@ -137,8 +157,8 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (kv_row(i), 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (kv_row(i), 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -157,9 +177,30 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
 # blockwise jnp path (CPU fallback fwd + the shared bwd)
 # ---------------------------------------------------------------------------
 
+def _gqa_broadcast(q, k, v):
+    """Repeat K/V heads up to Q's head count (non-pallas paths; the pallas
+    kernels read the narrow K/V directly via the grid index map)."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
+
+
+def _gqa_reduce(dk, dv, hk: int):
+    """Sum per-q-head K/V grads over each GQA group -> (B, Hkv, S, D)."""
+    b, h, s, d = dk.shape
+    if h == hk:
+        return dk, dv
+    rep = h // hk
+    return (dk.reshape(b, hk, rep, s, d).sum(axis=2),
+            dv.reshape(b, hk, rep, s, d).sum(axis=2))
+
+
 def _blockwise_forward(q, k, v, causal, sm_scale, block_k, kv_len=None):
     """Same online-softmax math as the kernel, expressed as a lax.scan over
     K blocks — O(S*Bk) memory."""
+    k, v = _gqa_broadcast(q, k, v)
     b, h, s, d = q.shape
     block_k = min(block_k, s)
     assert s % block_k == 0
@@ -203,6 +244,8 @@ def _blockwise_backward(q, k, v, out, lse, g, causal, sm_scale, block_k,
                         kv_len=None):
     """Flash backward: recompute P per K block from saved lse
     (dS = P * (dP - D), D = rowsum(dO * O))."""
+    hk = k.shape[1]
+    k, v = _gqa_broadcast(q, k, v)
     b, h, s, d = q.shape
     block_k = min(block_k, s)
     nkb = s // block_k
@@ -237,6 +280,7 @@ def _blockwise_backward(q, k, v, out, lse, g, causal, sm_scale, block_k,
         body, dq0, (jnp.arange(nkb), (kb, vb)))
     dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, s, d)
     dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h, s, d)
+    dk, dv = _gqa_reduce(dk, dv, hk)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -338,12 +382,14 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
     from jax.experimental import pallas as pl
 
     b, h, s, d = q.shape
+    hk = k.shape[1]
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     bh = b * h
     qf = q.reshape(bh, s, d)
-    kf = k.reshape(bh, s, d)
-    vf = v.reshape(bh, s, d)
+    kf = k.reshape(b * hk, s, d)
+    vf = v.reshape(b * hk, s, d)
+    kv_row = _kv_row_map(h, hk)
     gf = g.reshape(bh, s, d)
     lse_f = lse.reshape(bh, 1, s)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
@@ -356,8 +402,8 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
         grid=(bh, s // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (kv_row(i), 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (kv_row(i), 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
@@ -367,6 +413,8 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta)
 
+    # dK/dV per q-head (clean parallel grid, K/V streamed once per program
+    # via the same row map), group-reduced to the narrow GQA layout after
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, seq_len=s,
                           kv_len=kv_len if kv_len is not None else s,
@@ -374,8 +422,8 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
         grid=(bh, s // block_k),
         in_specs=[
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (kv_row(i), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (kv_row(i), j, 0)),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
@@ -391,8 +439,8 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta)
 
-    return (dq.reshape(b, h, s, d), dk.reshape(b, h, s, d),
-            dv.reshape(b, h, s, d))
+    dk, dv = _gqa_reduce(dk.reshape(b, h, s, d), dv.reshape(b, h, s, d), hk)
+    return dq.reshape(b, h, s, d), dk, dv
 
 
 # ---------------------------------------------------------------------------
@@ -464,11 +512,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
-    """Memory-efficient attention. q,k,v: (B, H, S, D) with equal head
-    counts (callers broadcast GQA KV heads first). Sequence lengths that
-    don't divide the block size are zero-padded; padded K columns are masked
-    out inside the kernels and padded Q rows sliced off (gradients flow
-    through pad/slice, so training works at any length)."""
+    """Memory-efficient attention. q: (B, H, S, D); k/v: (B, Hkv, S, D)
+    with H % Hkv == 0 — GQA is native: the pallas kernels stream the narrow
+    K/V via the grid index map (no repeated K/V bytes in HBM), and dK/dV
+    come back in the narrow layout. Sequence lengths that don't divide the
+    block size are zero-padded; padded K columns are masked out inside the
+    kernels and padded Q rows sliced off (gradients flow through pad/slice,
+    so training works at any length)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     s = q.shape[2]
