@@ -193,15 +193,16 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
     mesh = jax.sharding.get_abstract_mesh()
     sp = mesh.shape.get("sp", 1) if mesh is not None and mesh.axis_names else 1
     if sp > 1:
-        from tony_tpu.parallel.ulysses import ulysses_attention
-
-        from tony_tpu.ops.attention import _gqa_broadcast
-
-        # the ring/ulysses collectives work per-head: broadcast GQA K/V up
-        # front (the flash path below instead streams narrow K/V natively)
-        k, v = _gqa_broadcast(q, k, v)
-
         if config.sp_mode == "ulysses":
+            from tony_tpu.ops.attention import _gqa_broadcast
+            from tony_tpu.parallel.ulysses import ulysses_attention
+
+            # ulysses all-to-alls the head dim, so every rank's head slice
+            # needs its own K/V: broadcast GQA groups up front. Ring needs
+            # no broadcast — its per-chunk flash streams narrow K/V
+            # natively, keeping ppermute bytes at 1/group of the broadcast
+            # layout (fwd K/V and bwd dK/dV alike).
+            k, v = _gqa_broadcast(q, k, v)
             inner = partial(ulysses_attention, axis_name="sp", causal=True)
         else:
             inner = partial(ring_attention, axis_name="sp", causal=True)

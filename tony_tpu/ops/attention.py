@@ -491,8 +491,11 @@ def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
     return out, (q, k, v, out, lse)
 
 
-def _bwd_rule(causal, sm_scale, block_q, block_k, kv_len, residuals, g):
-    q, k, v, out, lse = residuals
+def _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                       block_k, kv_len):
+    """The platform/TONY_FLASH_FORCE dispatch for the flash backward —
+    shared by the custom-VJP rule here and the ring (parallel/ring.py)
+    per-chunk backward, so a forced branch pins BOTH directions."""
     pallas_bwd = lambda *a: _pallas_backward(    # noqa: E731
         *a, causal, sm_scale, block_q, block_k, kv_len)
     blockwise_bwd = lambda *a: _blockwise_backward(    # noqa: E731
@@ -503,6 +506,12 @@ def _bwd_rule(causal, sm_scale, block_q, block_k, kv_len, residuals, g):
         return blockwise_bwd(q, k, v, out, lse, g)
     return lax.platform_dependent(q, k, v, out, lse, g, tpu=pallas_bwd,
                                   default=blockwise_bwd)
+
+
+def _bwd_rule(causal, sm_scale, block_q, block_k, kv_len, residuals, g):
+    q, k, v, out, lse = residuals
+    return _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale,
+                              block_q, block_k, kv_len)
 
 
 _flash_core.defvjp(_fwd_rule, _bwd_rule)

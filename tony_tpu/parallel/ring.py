@@ -4,14 +4,26 @@ Long-context design (first-class per the build goals; absent from the
 reference, SURVEY.md §5): the sequence dim is sharded over `sp`, each device
 holds its local Q/K/V chunk, and K/V chunks rotate around the ring via
 `lax.ppermute` — ICI neighbor traffic only, overlapping the blockwise
-attention compute. Online-softmax accumulators (m, l, acc) merge the chunks
-exactly, so the result matches full attention bit-for-mathematically.
+attention compute.
 
-Causality uses *global* positions (chunk_index * chunk_len + local offset):
-a K/V chunk that is entirely in this Q chunk's future contributes nothing
-(masked), chunks on the diagonal get the triangular mask, past chunks attend
-fully. Everything is pure differentiable jnp + ppermute, so gradients flow
-through the ring for training (blockwise-parallel-transformer style).
+The per-chunk attention is the stack's flash kernel (ops/attention.py), so
+the ring composes with pallas instead of materializing the O(S_local^2)
+score matrix per step:
+
+- forward: each ring step runs flash on (local Q, visiting K/V chunk) and
+  merges the normalized partial (out_c, lse_c) into the running result by
+  logsumexp weights — O(S_local * D) merge state, exact online softmax.
+- backward (custom VJP, the flash-ring decomposition): the ring is just a
+  distributed K-block loop, so the standard flash backward per chunk with
+  the GLOBAL lse and delta = rowsum(dO * O) is exact. dQ accumulates
+  locally; each visiting chunk's dK/dV partial rotates around the ring
+  WITH its chunk, arriving home after n steps with every device's
+  contribution summed.
+
+Causality is decided per chunk pair: a K/V chunk entirely in this Q chunk's
+future is skipped (lax.switch — no kernel launch, ~half the FLOPs at long
+context), the diagonal chunk runs the causal kernel, past chunks run the
+dense kernel.
 
 Use inside shard_map, or via `ring_attention_sharded` which wraps the
 shard_map with the canonical activation specs.
@@ -19,6 +31,7 @@ shard_map with the canonical activation specs.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -26,8 +39,140 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tony_tpu.ops.attention import NEG_INF
+from tony_tpu.ops.attention import (
+    DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, NEG_INF, _backward_dispatch, _forward,
+)
 from tony_tpu.ops.vma import match_vma
+
+
+def _blocks(s: int) -> tuple[int, int]:
+    """Largest standard block sizes that divide the local chunk (the flash
+    entry clamps block > s down to s, so s itself always works)."""
+    for b in (DEFAULT_BLOCK_Q, 256, 128):
+        if s % b == 0:
+            return min(b, DEFAULT_BLOCK_Q), min(b, DEFAULT_BLOCK_K)
+    return s, s
+
+
+def _chunk_forward(q, k_cur, v_cur, mode, sm_scale):
+    """One visiting chunk's flash forward. mode: 0 = dense (past chunk),
+    1 = causal (diagonal), 2 = skip (future chunk, no kernel launch)."""
+    bq, bk = _blocks(q.shape[2])
+
+    def dense(q, k, v):
+        return _forward(q, k, v, False, sm_scale, bq, bk, None)
+
+    def diag(q, k, v):
+        return _forward(q, k, v, True, sm_scale, bq, bk, None)
+
+    def skip(q, k, v):
+        b, h, s, d = q.shape
+        return (match_vma(jnp.zeros((b, h, s, d), q.dtype), q),
+                match_vma(jnp.full((b, h, s), NEG_INF, jnp.float32), q))
+
+    return lax.switch(mode, (dense, diag, skip), q, k_cur, v_cur)
+
+
+def _chunk_backward(q, k_cur, v_cur, out, lse, g, mode, sm_scale):
+    """One visiting chunk's flash backward against the GLOBAL out/lse/delta
+    (exact partial-softmax gradients; platform-dispatched like the fwd)."""
+    bq, bk = _blocks(q.shape[2])
+
+    def bwd(causal):
+        def run(q, k, v, out, g):
+            return _backward_dispatch(q, k, v, out, lse, g, causal,
+                                      sm_scale, bq, bk, None)
+        return run
+
+    def skip(q, k, v, out, g):
+        return (match_vma(jnp.zeros_like(q), q),
+                match_vma(jnp.zeros_like(k), k),
+                match_vma(jnp.zeros_like(v), v))
+
+    return lax.switch(mode, (bwd(False), bwd(True), skip),
+                      q, k_cur, v_cur, out, g)
+
+
+def _rotate(x, axis_name: str, n: int):
+    return lax.ppermute(x, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def _chunk_mode(src_idx, my_idx, causal: bool):
+    """0 dense / 1 diagonal-causal / 2 skip, per global chunk position."""
+    if not causal:
+        return jnp.int32(0)
+    return jnp.where(src_idx == my_idx, 1,
+                     jnp.where(src_idx < my_idx, 0, 2)).astype(jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_core(q, k, v, axis_name, causal, sm_scale):
+    out, _ = _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale)
+    return out
+
+
+def _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale):
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+
+    def step(t, carry):
+        out_acc, lse_acc, k_cur, v_cur = carry
+        src_idx = (my_idx - t) % n           # who produced the chunk we hold
+        mode = _chunk_mode(src_idx, my_idx, causal)
+        out_c, lse_c = _chunk_forward(q, k_cur, v_cur, mode, sm_scale)
+        # exact online merge of normalized partials: new weights from the
+        # joint logsumexp; a skipped chunk (lse = -inf) is a strict no-op
+        lse_new = jnp.logaddexp(lse_acc, lse_c)
+        out_acc = (out_acc * jnp.exp(lse_acc - lse_new)[..., None]
+                   + out_c.astype(jnp.float32)
+                   * jnp.exp(lse_c - lse_new)[..., None])
+        # rotate K/V to the next neighbor; the last rotation is wasted but
+        # keeps the loop body uniform (and XLA overlaps it with compute)
+        return (out_acc, lse_new, _rotate(k_cur, axis_name, n),
+                _rotate(v_cur, axis_name, n))
+
+    init = (match_vma(jnp.zeros((b, h, s_local, d), jnp.float32), q),
+            match_vma(jnp.full((b, h, s_local), NEG_INF, jnp.float32), q),
+            k, v)
+    out, lse, _, _ = lax.fori_loop(0, n, step, init)
+    return out.astype(q.dtype), lse
+
+
+def _ring_fwd_rule(q, k, v, axis_name, causal, sm_scale):
+    out, lse = _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, sm_scale, residuals, g):
+    q, k, v, out, lse = residuals
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    def step(t, carry):
+        dq_acc, dk_acc, dv_acc, k_cur, v_cur = carry
+        src_idx = (my_idx - t) % n
+        mode = _chunk_mode(src_idx, my_idx, causal)
+        dq_c, dk_c, dv_c = _chunk_backward(q, k_cur, v_cur, out, lse, g,
+                                           mode, sm_scale)
+        dq_acc = dq_acc + dq_c.astype(jnp.float32)
+        # the visiting chunk's dK/dV partial travels WITH the chunk: after
+        # n rotations both are home, the partial fully accumulated
+        dk_acc = dk_acc + dk_c.astype(jnp.float32)
+        dv_acc = dv_acc + dv_c.astype(jnp.float32)
+        return (dq_acc, _rotate(dk_acc, axis_name, n),
+                _rotate(dv_acc, axis_name, n),
+                _rotate(k_cur, axis_name, n), _rotate(v_cur, axis_name, n))
+
+    init = (match_vma(jnp.zeros(q.shape, jnp.float32), q),
+            match_vma(jnp.zeros(k.shape, jnp.float32), k),
+            match_vma(jnp.zeros(v.shape, jnp.float32), v),
+            k, v)
+    dq, dk, dv, _, _ = lax.fori_loop(0, n, step, init)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_core.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -37,46 +182,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     global sequence is the concatenation over `axis_name` in ring order."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    n = lax.axis_size(axis_name)
-    my_idx = lax.axis_index(axis_name)
-    b, h, s_local, d = q.shape
-    qf = q.astype(jnp.float32) * sm_scale
-    rows = my_idx * s_local + lax.broadcasted_iota(
-        jnp.int32, (s_local, s_local), 0)
-
-    def step(t, carry):
-        m_prev, l_prev, acc, k_cur, v_cur = carry
-        src_idx = (my_idx - t) % n           # who produced the chunk we hold
-        s_blk = jnp.einsum("bhqd,bhkd->bhqk", qf,
-                           k_cur.astype(jnp.float32),
-                           preferred_element_type=jnp.float32)
-        if causal:
-            cols = src_idx * s_local + lax.broadcasted_iota(
-                jnp.int32, (s_local, s_local), 1)
-            s_blk = jnp.where((rows >= cols)[None, None], s_blk, NEG_INF)
-        m_cur = jnp.max(s_blk, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s_blk - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
-        # rotate K/V to the next neighbor; the last rotation is wasted but
-        # keeps the loop body uniform (and XLA overlaps it with compute)
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return m_new, l_new, acc, k_nxt, v_nxt
-
-    # fresh zeros are unvarying; the loop carries must match their outputs'
-    # vma under check_vma=True contexts (partial-manual shard_map)
-    init = (match_vma(jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32), q),
-            match_vma(jnp.zeros((b, h, s_local, 1), jnp.float32), q),
-            match_vma(jnp.zeros((b, h, s_local, d), jnp.float32), q),
-            k, v)
-    m, l, acc, _, _ = lax.fori_loop(0, n, step, init)
-    l = jnp.maximum(l, 1e-30)
-    return (acc / l).astype(q.dtype)
+    return _ring_core(q, k, v, axis_name, causal, sm_scale)
 
 
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,

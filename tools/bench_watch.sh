@@ -19,11 +19,16 @@ while :; do
   out=$(timeout 75 python bench.py --probe 2>&1)
   if echo "$out" | grep -q "PROBE-OK"; then
     echo "[watch] tunnel healthy at $(date -u +%H:%MZ); running full bench"
-    timeout 600 python bench.py > "tools/bench_watch_result.json" 2> \
-      "tools/bench_watch_stderr.log"
-    echo "[watch] bench done rc=$?"
-    cat tools/bench_watch_result.json
-    exit 0
+    if timeout 600 python bench.py > "tools/bench_watch_result.json" 2> \
+        "tools/bench_watch_stderr.log" \
+        && grep -q '"value"' tools/bench_watch_result.json; then
+      echo "[watch] bench done"
+      cat tools/bench_watch_result.json
+      exit 0
+    fi
+    # healthy probe but failed/partial bench: keep watching, don't report
+    # a measurement that doesn't exist
+    echo "[watch] bench failed after healthy probe; will retry"
   fi
   echo "[watch] tunnel down at $(date -u +%H:%MZ); retry in ${INTERVAL}s"
   sleep "$INTERVAL"
