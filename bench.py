@@ -132,29 +132,52 @@ def child_main(backend: str) -> None:
 
     if on_tpu:
         config = get_config("llama3_1b_proxy")
-        batch_size, seq, steps, warmup = 4, 4096, 10, 2
+        seq, steps, warmup = 4096, 10, 2
+        # fused-CE (config.xent_chunk) freed the ~4 GB full-logits
+        # fwd+bwd footprint: try the larger batch first, fall back on OOM
+        batch_candidates = (8, 4)
     else:
         config = get_config("tiny")
-        batch_size, seq, steps, warmup = 4, 128, 4, 1
+        seq, steps, warmup = 128, 4, 1
+        batch_candidates = (4,)
 
-    params = llama_init(config, jax.random.PRNGKey(0))
     optimizer = optax.adamw(3e-4)
     train_step = make_train_step(partial(llama_loss, config=config),
                                  optimizer)
-    opt_state = jax.jit(optimizer.init)(params)
-
-    tokens = jax.random.randint(
-        jax.random.PRNGKey(1), (batch_size, seq), 0, config.vocab_size,
-        jnp.int32)
-    batch = {"inputs": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
 
     # End each timed region with a device->host transfer of the loss: on
     # tunneled/experimental platforms block_until_ready alone may return
     # before the computation finishes, but a host read cannot.
-    _mark("compiling + warmup")
-    for _ in range(warmup):
-        params, opt_state, loss = train_step(params, opt_state, batch)
-    float(loss)
+    for bi, batch_size in enumerate(batch_candidates):
+        try:
+            # init lives INSIDE the try: a deferred async OOM from a
+            # failed larger-batch attempt can surface during the retry's
+            # init dispatch, and must hit the same fallback handler
+            params = llama_init(config, jax.random.PRNGKey(0))
+            opt_state = jax.jit(optimizer.init)(params)
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (batch_size, seq), 0,
+                config.vocab_size, jnp.int32)
+            batch = {"inputs": tokens,
+                     "targets": jnp.roll(tokens, -1, axis=1)}
+            _mark(f"compiling + warmup (batch {batch_size})")
+            for _ in range(warmup):
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     batch)
+            float(loss)
+            break
+        except Exception as e:  # noqa: BLE001
+            oom = ("RESOURCE_EXHAUSTED" in str(e)
+                   or "Out of memory" in str(e)
+                   or "out of memory" in str(e))
+            if not oom or bi == len(batch_candidates) - 1:
+                raise
+            _mark(f"batch {batch_size} OOM ({type(e).__name__}); "
+                  f"falling back to batch {batch_candidates[bi + 1]}")
+            # the donated params/opt buffers of the failed attempt are
+            # dropped with these references; next iteration re-inits
+            # (plain rebinds: some may be unbound if init itself OOMed)
+            params = opt_state = tokens = batch = None
 
     _mark("timing")
     t0 = time.monotonic()
@@ -198,6 +221,76 @@ def child_main(backend: str) -> None:
         except Exception:  # noqa: BLE001
             pass
 
+    print(json.dumps(result), flush=True)
+
+
+def startup_main() -> None:
+    """AM job-startup latency (the second BASELINE.json metric next to
+    throughput): submit a 2-worker no-op gang through the REAL
+    client->AM->executor chain on the local backend and measure
+    submit -> all-workers-RUNNING and submit -> SUCCEEDED. Pure
+    orchestrator path — no jax import, so it runs regardless of the TPU
+    tunnel's health. Prints one JSON line consumed by the parent as
+    bench metadata. Reference analogue: TonY's client submit ->
+    container-allocation -> task-registration path (TonyClient.java
+    monitorApplication + AM ContainerLauncher), for which the reference
+    publishes no numbers (BASELINE.md)."""
+    import statistics
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # children must not
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)   # claim the tunnel
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+
+    from tony_tpu.client.tony_client import TonyClient
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.configuration import TonyConfiguration
+
+    to_running, to_done = [], []
+    runs = int(os.environ.get("TONY_STARTUP_BENCH_RUNS", "3"))
+    for i in range(runs):
+        with tempfile.TemporaryDirectory() as td:
+            conf = TonyConfiguration()
+            conf.set(K.CLUSTER_WORKDIR, os.path.join(td, "c"), "bench")
+            conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 100, "bench")
+            conf.set(K.AM_MONITOR_INTERVAL_MS, 100, "bench")
+            conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 1000, "bench")
+            client = TonyClient(conf)
+            client.init([
+                "--conf", "tony.worker.instances=2",
+                "--conf",
+                f"tony.worker.command={sys.executable} -c pass"])
+            t0 = time.monotonic()
+            first_all_running = []
+
+            def on_tasks(infos, t0=t0, acc=first_all_running):
+                workers = [ti for ti in infos if ti.name == "worker"]
+                if (not acc and len(workers) >= 2
+                        and all(str(ti.status.value).upper() in
+                                ("RUNNING", "SUCCEEDED")
+                                for ti in workers)):
+                    acc.append(time.monotonic() - t0)
+
+            client.add_listener(on_tasks)
+            ok = client.run()
+            dt = time.monotonic() - t0
+            _mark(f"startup run {i}: ok={ok} total={dt:.2f}s "
+                  f"running={first_all_running}")
+            if ok:
+                to_done.append(dt)
+                if first_all_running:
+                    to_running.append(first_all_running[0])
+    result = {"runs": len(to_done)}
+    if len(to_done) < runs:
+        result["failed_runs"] = runs - len(to_done)
+        result["error"] = (f"{runs - len(to_done)}/{runs} gang runs did "
+                           f"not SUCCEED — orchestrator path unhealthy")
+    if to_running:
+        result["submit_to_all_running_p50_s"] = round(
+            statistics.median(to_running), 3)
+    if to_done:
+        result["submit_to_succeeded_p50_s"] = round(
+            statistics.median(to_done), 3)
     print(json.dumps(result), flush=True)
 
 
@@ -284,11 +377,17 @@ def _diag(err: str, state: str, what: str) -> str:
 def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
     """Run one measurement child. Returns (result_json_or_None, diag)."""
     env = dict(os.environ)
-    if backend == "cpu":
-        # Never let a CPU child (or its jax import) claim the tunnel.
+    if backend in ("cpu", "startup"):
+        # Never let a CPU/orchestrator child (or its jax import, or the
+        # container subprocesses it spawns) claim the tunnel.
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
         env["JAX_PLATFORMS"] = "cpu"
+    if backend == "startup":
+        # hermetic measurement: a machine-level tony-site.json would
+        # silently override the bench's tempdir workdir + 100ms cadences
+        # (merge_site runs after programmatic sets)
+        env.pop("TONY_CONF_DIR", None)
     out, err, state, clean = _supervise(
         [sys.executable, os.path.abspath(__file__), "--child", backend],
         deadline, env=env)
@@ -301,6 +400,19 @@ def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
                 continue
         return None, f"child exited 0 without JSON; stderr tail:\n{tail}"
     return None, _diag(err, state, f"{backend} child")
+
+
+def _attach_startup_latency(result: dict, t_start: float,
+                            usable: float) -> None:
+    """Run the orchestrator startup-latency child and attach its numbers
+    as metadata (never sinks the headline measurement)."""
+    remaining = usable - (time.monotonic() - t_start)
+    deadline = max(20.0, min(90.0, remaining))
+    sub, diag = _run_child("startup", deadline)
+    if sub is not None:
+        result["am_startup_latency"] = sub
+    else:
+        result["am_startup_latency"] = {"error": diag[-300:]}
 
 
 _LAST_GOOD_PATH = os.path.join(
@@ -347,7 +459,8 @@ def main() -> None:
     # the parent mid-run and get no JSON at all (round 1's rc=124 mode).
     t_start = time.monotonic()
     grace = 20.0   # per-child kill grace + spawn overhead
-    reserve = 4 * grace + 15.0   # probe + 2 tpu attempts + cpu fallback
+    # probe + 2 tpu attempts + cpu fallback + startup-latency child
+    reserve = 5 * grace + 15.0
     usable = max(60.0, BUDGET_SEC - reserve)
     diags: list[str] = []
 
@@ -384,6 +497,7 @@ def main() -> None:
             if diags:
                 result["retries"] = attempt - 1
             _record_last_good(result)
+            _attach_startup_latency(result, t_start, usable)
             print(json.dumps(result), flush=True)
             return
         diags.append(f"attempt {attempt}: {diag}")
@@ -410,6 +524,7 @@ def main() -> None:
         last = _load_last_good()
         if last is not None:
             result["last_good_tpu_measurement"] = last
+        _attach_startup_latency(result, t_start, usable)
         print(json.dumps(result), flush=True)
         return
     final = {
@@ -421,12 +536,18 @@ def main() -> None:
     last = _load_last_good()
     if last is not None:
         final["last_good_tpu_measurement"] = last
+    # the orchestrator-only latency metric works regardless of jax/tunnel
+    # health — attach it on the total-failure path too
+    _attach_startup_latency(final, t_start, usable)
     print(json.dumps(final), flush=True)
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
-        child_main(sys.argv[2])
+        if sys.argv[2] == "startup":
+            startup_main()
+        else:
+            child_main(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
         probe_main()
     else:
