@@ -107,3 +107,22 @@ def test_fused_xent_trains_on_tp_mesh():
             losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_moe_loss_fused_matches_unfused():
+    """The MoE model shares _head_loss: xent_chunk must not change the
+    loss or gradients (moe_tiny is f32, tolerance tight)."""
+    from tony_tpu.models.moe import get_moe_config, moe_init, moe_loss
+
+    cfg = get_moe_config("moe_tiny")
+    cfg_fused = get_moe_config("moe_tiny", xent_chunk=16)
+    params = moe_init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                cfg.vocab_size, jnp.int32)
+    batch = {"tokens": tokens}
+    want, gw = jax.value_and_grad(moe_loss)(params, batch, cfg)
+    got, gf = jax.value_and_grad(moe_loss)(params, batch, cfg_fused)
+    assert np.isclose(float(got), float(want), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(gw), jax.tree.leaves(gf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)

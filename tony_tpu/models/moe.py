@@ -73,7 +73,8 @@ PRESETS = {
                           top_k=2),
     "mixtral_proxy": MoEConfig(vocab_size=32_000, dim=2048, n_layers=16,
                                n_heads=16, n_kv_heads=8, ffn_dim=4096,
-                               max_seq=4096, n_experts=8, top_k=2),
+                               max_seq=4096, n_experts=8, top_k=2,
+                               xent_chunk=1024),
 }
 
 
@@ -241,9 +242,9 @@ def _sparse_dispatch(xt, layer, gates, keep, position, capacity,
 # forward/loss (Llama block with MoE MLP)
 # ---------------------------------------------------------------------------
 
-def moe_forward(params: Params, tokens: jax.Array, config: MoEConfig
-                ) -> tuple[jax.Array, jax.Array]:
-    """-> (logits (B,S,V) f32, total aux loss)."""
+def moe_hidden(params: Params, tokens: jax.Array, config: MoEConfig
+               ) -> tuple[jax.Array, jax.Array]:
+    """-> (final-normed hidden (B,S,D), total aux loss)."""
     from tony_tpu.models.llama import attention_sublayer
     from tony_tpu.ops.rope import rope_frequencies
 
@@ -266,15 +267,24 @@ def moe_forward(params: Params, tokens: jax.Array, config: MoEConfig
     x, aux_losses = lax.scan(lambda x, layer: block(x, layer), x,
                              params["layers"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32),
-                        params["output"].astype(jnp.float32))
-    return constrain(logits, ("batch", "seq", "vocab")), jnp.sum(aux_losses)
+    return x, jnp.sum(aux_losses)
+
+
+def moe_forward(params: Params, tokens: jax.Array, config: MoEConfig
+                ) -> tuple[jax.Array, jax.Array]:
+    """-> (logits (B,S,V) f32, total aux loss). bf16 operands with f32
+    accumulation on the head matmul, same as the dense model."""
+    x, aux = moe_hidden(params, tokens, config)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["output"],
+                        preferred_element_type=jnp.float32)
+    return constrain(logits, ("batch", "seq", "vocab")), aux
 
 
 def moe_loss(params: Params, batch: dict[str, jax.Array],
              config: MoEConfig) -> jax.Array:
-    from tony_tpu.models.llama import cross_entropy, unpack_lm_batch
+    from tony_tpu.models.llama import _head_loss, unpack_lm_batch
 
     inputs, targets = unpack_lm_batch(batch)
-    logits, aux = moe_forward(params, inputs, config)
-    return cross_entropy(logits, targets) + config.aux_loss_weight * aux
+    x, aux = moe_hidden(params, inputs, config)
+    return (_head_loss(x, params, targets, config)
+            + config.aux_loss_weight * aux)
