@@ -1,0 +1,88 @@
+"""Long-context Llama pretrain via sequence parallelism (ring attention).
+
+The long-context counterpart of examples/llama-pretrain: the sequence axis
+is sharded over the mesh's `sp` axis, and attention runs as flash-composed
+ring attention (parallel/ring.py) — K/V chunks stream around ICI neighbors,
+each step running the pallas flash kernel on the visiting chunk, so the
+per-device attention memory is O(S_local * D) regardless of global context
+length. `--sp-mode ulysses` swaps in the all-to-all flavor
+(parallel/ulysses.py) for DCN-heavy topologies.
+
+No reference analogue: the reference orchestrator has no sequence/context
+parallelism anywhere (SURVEY.md §5 "long-context: absent"); this example is
+the capability the TPU rebuild adds on top of the gang-scheduling parity.
+
+Submit (v5p-16, 128k-token context, ring over sp=8):
+
+  python -m tony_tpu.cli submit \
+      --executes examples/longcontext-ring/pretrain_long.py \
+      --task_params "--config llama3_8b --seq-len 131072 --steps 1000" \
+      --conf tony.worker.instances=4 --conf tony.worker.tpus=4 \
+      --conf tony.tpu.mesh-shape=2,8 --conf tony.tpu.mesh-axes=fsdp,sp \
+      --conf tony.application.framework=jax
+
+The orchestrator renders TPU_MESH_SHAPE/TPU_MESH_AXES per task; the Trainer
+builds the mesh from env, and the model dispatches ring attention whenever
+the ambient mesh has sp > 1 (models/llama.py `_attention_dispatch`).
+"""
+
+import argparse
+import logging
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.environ.get("TONY_REPO_ROOT",
+                                  os.path.join(os.path.dirname(__file__),
+                                               "..", "..")))
+
+from tony_tpu.models.llama import (  # noqa: E402
+    get_config, llama_init, llama_loss, llama_param_axes,
+)
+from tony_tpu.train.data import synthetic_tokens  # noqa: E402
+from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="tiny")
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--batch-size", type=int, default=1)
+    parser.add_argument("--seq-len", type=int, default=0,
+                        help="global context length; 0 = preset max_seq")
+    parser.add_argument("--sp-mode", default="ring",
+                        choices=("ring", "ulysses"))
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    config = get_config(args.config, sp_mode=args.sp_mode)
+    seq = args.seq_len or config.max_seq
+    if args.seq_len:
+        # max_seq follows the requested context so RoPE tables span it
+        config = get_config(args.config, max_seq=seq, sp_mode=args.sp_mode)
+    process_index = int(os.environ.get("JAX_PROCESS_ID", "0"))
+
+    # validate the seq/sp fit from the rendered env BEFORE any param init
+    # (at 8B scale trainer.setup() shards params + optimizer state first)
+    from tony_tpu.train.trainer import maybe_initialize_distributed
+    from tony_tpu.parallel import mesh_from_env
+    maybe_initialize_distributed()
+    sp = dict(mesh_from_env().shape).get("sp", 1)
+    if seq % max(sp, 1) != 0:
+        raise SystemExit(f"--seq-len {seq} must divide by sp={sp}")
+
+    trainer = Trainer(
+        loss_fn=partial(llama_loss, config=config),
+        init_fn=partial(llama_init, config),
+        data_iter=synthetic_tokens(args.batch_size, seq, config.vocab_size,
+                                   process_index=process_index),
+        config=TrainerConfig(num_steps=args.steps, log_every=10),
+        param_axes=llama_param_axes(config),
+    )
+    final_loss = trainer.run()
+    print(f"final loss {final_loss:.4f} (seq={seq}, sp_mode={args.sp_mode})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,25 @@ def test_multirole_example(tmp_path):
     assert client.final_status == "SUCCEEDED", _logs(client)
 
 
+def test_longcontext_ring_example(tmp_path):
+    """Ring-attention pretrain through the real chain: sp=2 mesh rendered
+    by the orchestrator (TPU_MESH_*), sequence sharded, 3 steps."""
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "longcontext-ring",
+                                    "pretrain_long.py"),
+         "--task_params",
+         "--config tiny --steps 3 --batch-size 2 --seq-len 256",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax",
+         "--conf", "tony.tpu.mesh-shape=2,2",
+         "--conf", "tony.tpu.mesh-axes=fsdp,sp",
+         # 4 local virtual devices to match the 2x2 mesh
+         "--conf", ("tony.execution.env=XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=4")])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+
 def test_llama_pretrain_example_tiny(tmp_path):
     client = run_example(
         tmp_path,

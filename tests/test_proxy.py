@@ -136,3 +136,42 @@ def test_python_proxy_grace_not_extended_by_bare_conns(echo_server,
             assert _recv_all(s) == b""
     finally:
         proxy.stop()
+
+
+def test_python_proxy_waits_for_late_upstream():
+    """The upstream may register its URL before its server binds (notebook
+    bring-up gap): connections arriving in that window must be relayed once
+    the server appears, not dropped on first ECONNREFUSED."""
+    import threading
+
+    # reserve a port nobody is listening on yet
+    placeholder = socket.socket()
+    placeholder.bind(("127.0.0.1", 0))
+    port = placeholder.getsockname()[1]
+    placeholder.close()
+
+    proxy = ProxyServer("127.0.0.1", port, connect_wait_sec=8.0)
+    proxy.start()
+
+    def bind_late():
+        time.sleep(1.0)
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        conn, _ = srv.accept()
+        conn.sendall(_recv_all(conn).upper())
+        conn.shutdown(socket.SHUT_WR)
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=bind_late, daemon=True)
+    t.start()
+    try:
+        with _conn(proxy.local_port) as s:
+            s.sendall(b"late bind")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b"LATE BIND"
+    finally:
+        proxy.stop()
+        t.join(timeout=10)

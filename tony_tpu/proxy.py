@@ -210,9 +210,10 @@ class ProxyServer:
 
     def __init__(self, remote_host: str, remote_port: int,
                  local_port: int = 0, local_host: str = "127.0.0.1",
-                 token: str | None = None):
+                 token: str | None = None, connect_wait_sec: float = 10.0):
         self._remote = (remote_host, remote_port)
         self._token = token
+        self._connect_wait = connect_wait_sec
         self._unlocked: dict[str, float] = {}   # grace key -> expiry
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -248,15 +249,27 @@ class ProxyServer:
             # forever (an unauthenticated poller would never expire)
             if verified and key is not None:
                 self._unlocked[key] = now + _GRACE_SEC
-        try:
-            upstream = socket.create_connection(self._remote, timeout=10)
-            # 10s bounds the CONNECT only; left in place it would tear the
-            # relay down on any 10s-idle gap (recv timeout in _pump)
-            upstream.settimeout(None)
-        except OSError:
-            LOG.warning("cannot reach %s:%d", *self._remote)
-            conn.close()
-            return
+        # Bounded connect retry: a notebook/TB URL is registered when its
+        # port is RESERVED, which can precede the server actually listening
+        # (the reference's NotebookSubmitter proxies as soon as the URL
+        # appears in TaskInfos and has the same bring-up gap). Refused
+        # connections retry until the wait budget runs out.
+        upstream = None
+        deadline = time.monotonic() + self._connect_wait
+        while True:
+            try:
+                upstream = socket.create_connection(self._remote, timeout=10)
+                # the timeout bounds the CONNECT only; left in place it
+                # would tear the relay down on any 10s-idle gap (recv
+                # timeout in _pump)
+                upstream.settimeout(None)
+                break
+            except OSError:
+                if self._stop.is_set() or time.monotonic() >= deadline:
+                    LOG.warning("cannot reach %s:%d", *self._remote)
+                    conn.close()
+                    return
+                time.sleep(0.25)
         _set_keepalive(conn)
         _set_keepalive(upstream)
         if initial:
@@ -277,12 +290,11 @@ class ProxyServer:
                 conn, addr = self._listener.accept()
             except OSError:
                 return
-            # auth involves blocking reads — never stall the accept loop
-            if self._token is not None:
-                threading.Thread(target=self._handle, args=(conn, addr),
-                                 daemon=True).start()
-            else:
-                self._handle(conn, addr)
+            # _handle blocks (auth reads; upstream connect retry while the
+            # notebook server binds) — never stall the accept loop, or
+            # parallel browser connections serialize behind one retry
+            threading.Thread(target=self._handle, args=(conn, addr),
+                             daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()

@@ -35,9 +35,13 @@ LOG = logging.getLogger(__name__)
 
 def maybe_initialize_distributed() -> None:
     """Call jax.distributed.initialize iff the orchestrator rendered a
-    multi-process env; single-process runs skip it."""
+    multi-process env; single-process runs skip it. Idempotent: user code
+    may validate the mesh env before Trainer.setup() calls this again
+    (jax raises on a second initialize)."""
     num = int(os.environ.get(C.JAX_NUM_PROCESSES, "1"))
     if num <= 1:
+        return
+    if jax.distributed.is_initialized():
         return
     coordinator = os.environ[C.JAX_COORDINATOR_ADDRESS]
     process_id = int(os.environ[C.JAX_PROCESS_ID])

@@ -16,10 +16,12 @@ while :; do
     echo "[watch] lifetime exceeded, exiting without a measurement"
     exit 1
   fi
-  out=$(timeout 75 python bench.py --probe 2>&1)
+  # -k: the probe child registers a faulthandler on SIGTERM (stack dump,
+  # no exit), so plain timeout's SIGTERM is swallowed — SIGKILL after 10s
+  out=$(timeout -k 10 75 python bench.py --probe 2>&1)
   if echo "$out" | grep -q "PROBE-OK"; then
     echo "[watch] tunnel healthy at $(date -u +%H:%MZ); running full bench"
-    if timeout 600 python bench.py > "tools/bench_watch_result.json" 2> \
+    if timeout -k 15 600 python bench.py > "tools/bench_watch_result.json" 2> \
         "tools/bench_watch_stderr.log" \
         && grep -q '"value"' tools/bench_watch_result.json; then
       echo "[watch] bench done"
