@@ -39,6 +39,8 @@ def main() -> int:
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=0,
                         help="0 = the preset's max_seq")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="microbatch gradient-accumulation steps")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=0)
     parser.add_argument("--data", default="",
@@ -68,7 +70,8 @@ def main() -> int:
         config=TrainerConfig(
             num_steps=args.steps, log_every=10,
             checkpoint_dir=args.checkpoint_dir,
-            checkpoint_every=args.checkpoint_every),
+            checkpoint_every=args.checkpoint_every,
+            grad_accum=args.grad_accum),
         param_axes=llama_param_axes(config),
     )
     final_loss = trainer.run()

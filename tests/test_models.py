@@ -175,3 +175,61 @@ def test_llama_ulysses_sp_mode_trains():
         opt_state = jax.jit(opt.init)(params)
         _, _, loss = step(params, opt_state, {"tokens": tokens})
     assert np.isfinite(float(loss))
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum=2 on the same global batch must produce the SAME update
+    as a single full-batch step. SGD, not adam: the update is then linear
+    in the mean gradient, so this pins the accumulation math itself
+    (adam's first step is ~sign(g), which amplifies f32 accumulation-order
+    noise wherever g is near zero)."""
+    cfg = get_config("tiny")
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size, jnp.int32)
+    batch = {"tokens": tokens}
+    loss_fn = lambda p, b: llama_loss(p, b, cfg)  # noqa: E731
+
+    step_full = make_train_step(loss_fn, opt)
+    step_accum = make_train_step(loss_fn, opt, grad_accum=2)
+    import copy
+    p1, o1, l1 = step_full(copy.deepcopy(params), opt.init(params), batch)
+    p2, o2, l2 = step_accum(copy.deepcopy(params), opt.init(params), batch)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_grad_accum_on_mesh():
+    """grad_accum under a dp+fsdp+tp mesh: loss decreases, shapes hold."""
+    cfg = get_config("tiny")
+    mesh = make_mesh(plan_mesh(8, tp=2))
+    params = shard_pytree(llama_init(cfg, jax.random.PRNGKey(0)),
+                          llama_param_axes(cfg), mesh)
+    opt = optax.adam(1e-2)
+    step = make_train_step(lambda p, b: llama_loss(p, b, cfg), opt,
+                           grad_accum=2)
+    data = synthetic_tokens(8, 32, cfg.vocab_size)
+    with jax.set_mesh(mesh):
+        opt_state = jax.jit(opt.init)(params)
+        losses = []
+        for _ in range(10):
+            batch = {k: jax.device_put(v) for k, v in next(data).items()}
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    cfg = get_config("tiny")
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    opt = optax.adam(1e-2)
+    step = make_train_step(lambda p, b: llama_loss(p, b, cfg), opt,
+                           grad_accum=3, jit=False)
+    tokens = jnp.zeros((4, 33), jnp.int32)
+    import pytest
+    with pytest.raises(ValueError, match="not divisible"):
+        step(params, opt.init(params), {"tokens": tokens})

@@ -62,6 +62,9 @@ class TrainerConfig:
     weight_decay: float = 0.01
     seed: int = 0
     optimizer: Optional[optax.GradientTransformation] = None
+    # microbatch gradient accumulation: batch dim split into this many
+    # scan slices, one optimizer update on the mean gradient (train/step.py)
+    grad_accum: int = 1
     extra: dict = field(default_factory=dict)
 
 
@@ -102,7 +105,8 @@ class Trainer:
                 max(cfg.num_steps, cfg.warmup_steps + 1))
             self.optimizer = optax.adamw(schedule,
                                          weight_decay=cfg.weight_decay)
-        self.train_step = make_train_step(self.loss_fn, self.optimizer)
+        self.train_step = make_train_step(self.loss_fn, self.optimizer,
+                                          grad_accum=cfg.grad_accum)
 
         resume = (latest_step(cfg.checkpoint_dir)
                   if cfg.checkpoint_dir else None)
