@@ -109,6 +109,34 @@ def test_multirole_example(tmp_path):
     assert client.final_status == "SUCCEEDED", _logs(client)
 
 
+def test_train_then_generate_lifecycle(tmp_path):
+    """Full model lifecycle through the real chain: pretrain with
+    checkpointing, then a second app restores that checkpoint and runs
+    the KV-cache decode loop (examples/llama-generate)."""
+    ckpt = str(tmp_path / "ckpts")
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "llama-pretrain",
+                                    "pretrain.py"),
+         "--task_params",
+         f"--config tiny --steps 3 --batch-size 2 --seq-len 64 "
+         f"--checkpoint-dir {ckpt} --checkpoint-every 3",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "llama-generate",
+                                    "generate_demo.py"),
+         "--task_params",
+         f"--config tiny --checkpoint-dir {ckpt} --max-new 8",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+    assert "GENERATE_OK" in _logs(client)
+
+
 def test_longcontext_ring_example(tmp_path):
     """Ring-attention pretrain through the real chain: sp=2 mesh rendered
     by the orchestrator (TPU_MESH_*), sequence sharded, 3 steps."""

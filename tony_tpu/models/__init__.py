@@ -8,6 +8,7 @@ pytrees + functions: init(cfg, key) -> params, forward(params, batch) ->
 logits, with logical sharding axes declared next to the params.
 """
 
+from tony_tpu.models.generate import generate, generate_text
 from tony_tpu.models.llama import (
     LlamaConfig, llama_forward, llama_init, llama_loss, llama_param_axes,
 )
@@ -18,6 +19,7 @@ from tony_tpu.models.moe import (
 )
 
 __all__ = [
+    "generate", "generate_text",
     "LlamaConfig", "llama_forward", "llama_init", "llama_loss",
     "llama_param_axes", "mnist_forward", "mnist_init", "mnist_loss",
     "linreg_forward", "linreg_init", "linreg_loss",

@@ -1,0 +1,211 @@
+"""Autoregressive generation for the Llama family: KV-cache decode.
+
+TPU-first inference path (greenfield — the reference is an orchestrator
+with no model code, SURVEY.md §2.3):
+
+- **Static shapes end to end**: the cache is allocated once at
+  (L, B, Hkv, prompt+max_new, hd); the decode loop is a `lax.scan` over a
+  fixed token budget with a length mask — no dynamic shapes, one compile.
+- **Prefill via the training forward pieces**: full causal flash attention
+  over the prompt (narrow GQA K/V), capturing each layer's K/V as scan
+  outputs.
+- **Decode step**: one token per step; per layer, the new K/V row is
+  `dynamic_update_slice`d into the cache and attention is a masked
+  single-query einsum against the cache, grouped by GQA head group (no
+  K/V repeat materialization — (B, G, rep, d) x (B, G, S, d)).
+- **Sampling**: greedy (temperature 0) or temperature + optional top-k
+  via `jax.random.categorical`; an emitted `eos_id` latches and pads the
+  remainder with `eos_id`.
+
+Oracle parity: `tests/test_generate.py` pins greedy decode against
+re-running the full training forward on the growing sequence.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.models.llama import (
+    LlamaConfig, Params, qkv_proj, swiglu_mlp,
+)
+from tony_tpu.ops.attention import NEG_INF, flash_attention
+from tony_tpu.ops.rmsnorm import rms_norm
+from tony_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+def _cache_attention(q, k_cache, v_cache, cur_len: jax.Array,
+                     config: LlamaConfig) -> jax.Array:
+    """Single-position attention against the cache.
+
+    q: (B, H, 1, hd); caches: (B, Hkv, S_max, hd); positions >= cur_len
+    are masked. GQA grouped einsum — K/V never repeated."""
+    b, nh, _, hd = q.shape
+    nkv = k_cache.shape[1]
+    rep = nh // nkv
+    qg = q.reshape(b, nkv, rep, hd).astype(jnp.float32) * hd ** -0.5
+    scores = jnp.einsum("bgrd,bgsd->bgrs", qg,
+                        k_cache.astype(jnp.float32))      # (B,G,rep,S)
+    mask = lax.broadcasted_iota(jnp.int32, scores.shape, 3) < cur_len
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrs,bgsd->bgrd", probs,
+                     v_cache.astype(jnp.float32))         # (B,G,rep,hd)
+    return out.reshape(b, nh, 1, hd).astype(q.dtype)
+
+
+def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
+            cache_len: int) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Run the prompt through the model, returning last-position logits
+    and the KV cache (prompt K/V written, remainder zeros).
+
+    tokens: (B, P) int32; cache_len >= P."""
+    b, p = tokens.shape
+    nkv, hd = config.n_kv_heads, config.head_dim
+    cos, sin = rope_frequencies(config.head_dim, cache_len,
+                                config.rope_theta)
+    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
+
+    def body(x, layer):
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q, k, v = qkv_proj(h, layer, config)
+        q = apply_rope(q, cos[:p], sin[:p])
+        k = apply_rope(k, cos[:p], sin[:p])
+        attn = flash_attention(q, k, v, True)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, p, -1)
+        x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
+        h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        x = x + swiglu_mlp(h, layer)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], params["output"],
+                        preferred_element_type=jnp.float32)
+
+    pad = cache_len - p
+    widths = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
+    cache = {"k": jnp.pad(ks, widths), "v": jnp.pad(vs, widths)}
+    return logits, cache
+
+
+def decode_step(params: Params, config: LlamaConfig,
+                cache: dict[str, jax.Array], token: jax.Array,
+                pos: jax.Array) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """One decode step. token: (B,) int32; pos: scalar int32 (the position
+    the token occupies). Returns (logits (B, V), updated cache)."""
+    cache_len = cache["k"].shape[3]
+    cos, sin = rope_frequencies(config.head_dim, cache_len,
+                                config.rope_theta)
+    cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
+    sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+    x = jnp.take(params["embed"], token[:, None], axis=0).astype(
+        config.dtype)                                     # (B, 1, D)
+    b = x.shape[0]
+
+    def body(x, layer_and_cache):
+        layer, kc, vc = layer_and_cache
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q, k, v = qkv_proj(h, layer, config)
+        q = apply_rope(q, cos_p, sin_p)
+        k = apply_rope(k, cos_p, sin_p)
+        kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos,
+                                             axis=2)
+        vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos,
+                                             axis=2)
+        attn = _cache_attention(q, kc, vc, pos + 1, config)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, -1)
+        x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
+        h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        x = x + swiglu_mlp(h, layer)
+        return x, (kc, vc)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
+                                     cache["v"]))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["output"],
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
+def _sample(logits: jax.Array, temperature: float, top_k: int,
+            key: jax.Array) -> jax.Array:
+    """(B, V) -> (B,) next tokens."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]      # (B, 1)
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("config", "max_new_tokens",
+                                   "temperature", "top_k", "eos_id"))
+def generate(params: Params, config: LlamaConfig, prompt: jax.Array,
+             max_new_tokens: int, temperature: float = 0.0,
+             top_k: int = 0, eos_id: Optional[int] = None,
+             key: Optional[jax.Array] = None) -> jax.Array:
+    """prompt: (B, P) int32 -> (B, max_new_tokens) generated tokens.
+
+    Greedy when temperature == 0 (key unused); once a row emits eos_id it
+    keeps emitting eos_id. One compile per (shape, config, budget)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    b, p = prompt.shape
+    cache_len = p + max_new_tokens
+    if cache_len > config.max_seq:
+        raise ValueError(f"prompt {p} + max_new {max_new_tokens} exceeds "
+                         f"max_seq {config.max_seq}")
+    logits, cache = prefill(params, prompt, config, cache_len)
+
+    keys = jax.random.split(key, max_new_tokens)
+    tok0 = _sample(logits, temperature, top_k, keys[0])
+    done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((b,),
+                                                                  bool)
+
+    def step(carry, step_key):
+        cache, tok, pos, done = carry
+        # decode the PREVIOUS token, sample the next — the final sampled
+        # token therefore never pays a trailing decode_step
+        logits, cache = decode_step(params, config, cache, tok, pos)
+        nxt = _sample(logits, temperature, top_k, step_key)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, pos + 1, done), nxt
+
+    if max_new_tokens == 1:
+        return tok0[:, None]
+    (_, _, _, _), rest = lax.scan(
+        step, (cache, tok0, jnp.int32(p), done0), keys[1:])
+    return jnp.concatenate([tok0[:, None], rest.T], axis=1)   # (B, N)
+
+
+def generate_text(params: Params, config: LlamaConfig, prompt: Any,
+                  tokenizer: Any, max_new_tokens: int = 64,
+                  **kwargs) -> list[str]:
+    """Convenience wrapper for tokenizer objects with encode/decode
+    (e.g. a transformers tokenizer); prompt: str or list[str].
+
+    There is no padding/attention mask in the decode path, so ragged
+    prompts are grouped by length and each group generated as its own
+    batch — padding a shorter prompt would feed pad embeddings into
+    attention and shift its RoPE positions."""
+    if isinstance(prompt, str):
+        prompt = [prompt]
+    ids = [tuple(tokenizer.encode(t)) for t in prompt]
+    out: dict[int, list[int]] = {}
+    by_len: dict[int, list[int]] = {}
+    for i, seq in enumerate(ids):
+        by_len.setdefault(len(seq), []).append(i)
+    for length, idxs in by_len.items():
+        batch = jnp.asarray([list(ids[i]) for i in idxs], jnp.int32)
+        toks = generate(params, config, batch, max_new_tokens, **kwargs)
+        for i, row in zip(idxs, jax.device_get(toks)):
+            out[i] = list(row)
+    return [tokenizer.decode(out[i]) for i in range(len(ids))]

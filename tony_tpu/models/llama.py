@@ -221,12 +221,11 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
     return flash_attention(q, k, v, True)
 
 
-def attention_sublayer(h: jax.Array, layer: Params, config: LlamaConfig,
-                       cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """QKV + RoPE + (ring|flash) attention + output proj. K/V stay in the
-    narrow GQA layout; the flash path streams them natively and the
-    sequence-parallel dispatch broadcasts them just-in-time. Shared by the
-    dense block here and the MoE block (models/moe.py)."""
+def qkv_proj(h: jax.Array, layer: Params, config: LlamaConfig
+             ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(B, S, D) -> q (B,H,S,hd), k/v (B,Hkv,S,hd) — pre-RoPE. Shared by
+    the training forward here and the KV-cache decode (models/generate.py)
+    so architecture changes land in one place."""
     b, s, _ = h.shape
     nh, nkv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     q = jnp.einsum("bsd,dh->bsh", h, layer["wq"])
@@ -235,6 +234,26 @@ def attention_sublayer(h: jax.Array, layer: Params, config: LlamaConfig,
     q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)      # (B,H,S,hd)
     k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+    return q, k, v
+
+
+def swiglu_mlp(h: jax.Array, layer: Params) -> jax.Array:
+    """SwiGLU feed-forward; shared with models/generate.py."""
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                      layer["w_down"])
+
+
+def attention_sublayer(h: jax.Array, layer: Params, config: LlamaConfig,
+                       cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """QKV + RoPE + (ring|flash) attention + output proj. K/V stay in the
+    narrow GQA layout; the flash path streams them natively and the
+    sequence-parallel dispatch broadcasts them just-in-time. Shared by the
+    dense block here and the MoE block (models/moe.py)."""
+    b, s, _ = h.shape
+    nh, hd = config.n_heads, config.head_dim
+    q, k, v = qkv_proj(h, layer, config)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     q = constrain(q, ("batch", "heads", "seq", None))
@@ -253,6 +272,8 @@ def _block(config: LlamaConfig, cos, sin, x, layer: Params):
     h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"])
     up = jnp.einsum("bsd,df->bsf", h, layer["w_up"])
+    # inlined swiglu_mlp so the mid-activation sharding constraint can sit
+    # between the einsums (generate.py's decode uses the helper directly)
     ff = jax.nn.silu(gate) * up
     ff = constrain(ff, ("batch", "seq", "mlp"))
     x = x + jnp.einsum("bsf,fd->bsd", ff, layer["w_down"])
