@@ -210,6 +210,11 @@ def child_main(backend: str) -> None:
         except Exception as e:  # metadata only — never sink the headline
             _mark(f"8b layer bench failed: {type(e).__name__}: {e}")
             result["llama3_8b_layer_error"] = f"{type(e).__name__}: {e}"
+        try:
+            result.update(_bench_decode(jax, jnp, config, params))
+        except Exception as e:  # metadata only
+            _mark(f"decode bench failed: {type(e).__name__}: {e}")
+            result["decode_error"] = f"{type(e).__name__}: {e}"
         # live duty-cycle path (task_monitor's wedge-detection source):
         # present on real TPU VMs via the libtpu metrics daemon; absent
         # over the tunnel — record which, never fail the bench on it
@@ -292,6 +297,35 @@ def startup_main() -> None:
         result["submit_to_succeeded_p50_s"] = round(
             statistics.median(to_done), 3)
     print(json.dumps(result), flush=True)
+
+
+def _bench_decode(jax, jnp, config, params) -> dict:
+    """KV-cache generation throughput on the bench model (metadata next
+    to the training MFU headline: the inference half of the lifecycle).
+    The timed region is one whole generate() call — prefill of the
+    prompt PLUS the decode scan — and the keys say so; a decode-only
+    number would need a second compile (separate static budget), which
+    isn't worth the bench-budget cost for metadata."""
+    from tony_tpu.models.generate import generate
+
+    _mark("timing KV-cache generate (prefill + decode)")
+    b, p, n = 8, 128, 64
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (b, p), 0,
+                                config.vocab_size, jnp.int32)
+    toks = generate(params, config, prompt, n)   # compile + warmup
+    int(jax.device_get(toks)[0, 0])              # force host read
+    t0 = time.monotonic()
+    toks = generate(params, config, prompt, n)
+    int(jax.device_get(toks)[0, 0])
+    dt = time.monotonic() - t0
+    return {
+        # new tokens / whole-call time: prefill amortized in, hence
+        # "generate_", not "decode_"
+        "generate_new_tokens_per_sec": round(b * n / dt, 1),
+        "generate_ms_per_new_token": round(dt / n * 1000.0, 3),
+        "generate_batch": b, "generate_prompt_len": p,
+        "generate_new_tokens": n,
+    }
 
 
 def _bench_8b_layer(jax, jnp, optax, dev) -> dict:

@@ -36,6 +36,12 @@ VARIANTS: dict[str, dict] = {
     "noremat_b4": dict(batch=4, seq=4096, remat=False),
     "dots_b4":   dict(batch=4, seq=4096, policy="dots_with_no_batch_dims_saveable"),
     "seq8k_b2":  dict(batch=2, seq=8192),
+    # fused chunked LM-head CE A/B (preset default is xent_chunk=1024;
+    # 0 = full-logits path) — the lever that freed ~4 GB for b8
+    "unfused_b4": dict(batch=4, seq=4096, xent_chunk=0),
+    "unfused_b8": dict(batch=8, seq=4096, xent_chunk=0),
+    "xc512_b8":  dict(batch=8, seq=4096, xent_chunk=512),
+    "xc2048_b8": dict(batch=8, seq=4096, xent_chunk=2048),
 }
 
 
@@ -45,6 +51,8 @@ def run(name: str, spec: dict) -> dict:
         overrides["remat"] = False
     if "remat_policy" in spec:
         overrides["remat_policy"] = spec["remat_policy"]
+    if "xent_chunk" in spec:
+        overrides["xent_chunk"] = spec["xent_chunk"]
     config = get_config("llama3_1b_proxy", max_seq=spec["seq"], **overrides)
     policy = spec.get("policy")
     if policy is not None:
