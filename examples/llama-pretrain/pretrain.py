@@ -31,6 +31,18 @@ from tony_tpu.train.data import synthetic_tokens  # noqa: E402
 from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
 
 
+def _eval_stream(args, seq, config, process_index):
+    """Held-out eval batches from the SAME source as training: the real
+    corpus (disjoint sampling seed) when --data is given, else the
+    synthetic stream with a disjoint seed."""
+    if args.data:
+        from tony_tpu.train.native_data import token_batches
+        return token_batches(args.data, args.batch_size, seq,
+                             seed=1_000_000 + process_index)
+    return synthetic_tokens(args.batch_size, seq, config.vocab_size,
+                            seed=1, process_index=process_index)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="tiny",
@@ -41,6 +53,8 @@ def main() -> int:
                         help="0 = the preset's max_seq")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="microbatch gradient-accumulation steps")
+    parser.add_argument("--eval-every", type=int, default=0,
+                        help="held-out eval cadence in steps (0 = off)")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=0)
     parser.add_argument("--data", default="",
@@ -71,8 +85,11 @@ def main() -> int:
             num_steps=args.steps, log_every=10,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
-            grad_accum=args.grad_accum),
+            grad_accum=args.grad_accum,
+            eval_every=args.eval_every),
         param_axes=llama_param_axes(config),
+        eval_data_iter=(_eval_stream(args, seq, config, process_index)
+                        if args.eval_every else None),
     )
     final_loss = trainer.run()
     print(f"final loss {final_loss:.4f}")

@@ -112,3 +112,19 @@ def test_generate_text_ragged_prompts_unaffected_by_batchmates():
                              max_new_tokens=4)
     alone = generate_text(params, cfg, [short], tok, max_new_tokens=4)
     assert together[0] == alone[0]
+
+
+def test_generate_on_tp_mesh_matches_single_device():
+    """Greedy decode with tp/fsdp-sharded params under an ambient mesh
+    must produce the same tokens as the unsharded path (serving-style
+    sharded inference; XLA inserts the collectives from shardings)."""
+    from tony_tpu.models.llama import llama_param_axes
+    from tony_tpu.parallel import make_mesh, plan_mesh, shard_pytree
+
+    cfg, params, prompt = _setup()
+    want = generate(params, cfg, prompt, 6)
+    mesh = make_mesh(plan_mesh(8, tp=2))
+    sharded = shard_pytree(params, llama_param_axes(cfg), mesh)
+    with jax.set_mesh(mesh):
+        got = generate(sharded, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -233,3 +233,17 @@ def test_grad_accum_rejects_indivisible_batch():
     import pytest
     with pytest.raises(ValueError, match="not divisible"):
         step(params, opt.init(params), {"tokens": tokens})
+
+
+def test_trainer_eval_loop():
+    """eval_every runs the held-out loss on cadence; eval loss tracks the
+    train loss down on the same synthetic distribution."""
+    cfg = TrainerConfig(num_steps=6, log_every=2, eval_every=3,
+                        eval_batches=2, learning_rate=1e-2, warmup_steps=1)
+    t = Trainer(mnist_loss, mnist_init, synthetic_mnist(32), cfg,
+                eval_data_iter=synthetic_mnist(32, seed=9))
+    t.run()
+    evals = [m for m in t.metrics_history if "eval_loss" in m]
+    assert [m["step"] for m in evals] == [3, 6]
+    assert t.last_eval_loss is not None
+    assert np.isfinite(t.last_eval_loss)

@@ -65,6 +65,11 @@ class TrainerConfig:
     # microbatch gradient accumulation: batch dim split into this many
     # scan slices, one optimizer update on the mean gradient (train/step.py)
     grad_accum: int = 1
+    # held-out evaluation cadence: every N train steps run `eval_batches`
+    # batches from eval_data_iter through a jitted loss-only step and log
+    # the mean (0 = no eval; requires eval_data_iter on the Trainer)
+    eval_every: int = 0
+    eval_batches: int = 1
     extra: dict = field(default_factory=dict)
 
 
@@ -73,10 +78,13 @@ class Trainer:
                  init_fn: Callable[[jax.Array], Any],
                  data_iter: Iterator[Any],
                  config: TrainerConfig,
-                 param_axes: Optional[Any] = None):
+                 param_axes: Optional[Any] = None,
+                 eval_data_iter: Optional[Iterator[Any]] = None):
         self.loss_fn = loss_fn
         self.init_fn = init_fn
         self.data_iter = data_iter
+        self.eval_data_iter = eval_data_iter
+        self.last_eval_loss: Optional[float] = None
         self.config = config
         self.param_axes = param_axes
         self.mesh = None
@@ -142,6 +150,24 @@ class Trainer:
         # multi-process data parallelism: assemble global arrays from each
         # process's local shard
         self.data_iter = global_batch_iterator(self.data_iter, self.mesh)
+        if cfg.eval_every and self.eval_data_iter is not None:
+            from tony_tpu.train.step import make_eval_step
+            self.eval_step = make_eval_step(self.loss_fn)
+            # materialize a FIXED eval set once: successive eval_loss
+            # values are then comparable across steps (and across
+            # AM-retry resumes — a streaming iterator would restart and
+            # score different batches after a resume)
+            stream = global_batch_iterator(self.eval_data_iter, self.mesh)
+            self._eval_set = [next(stream)
+                              for _ in range(max(1, cfg.eval_batches))]
+
+    def _evaluate(self) -> float:
+        """Mean loss over the fixed held-out eval set (params only — no
+        gradients, no optimizer state touched)."""
+        total = 0.0
+        for batch in self._eval_set:
+            total += float(self.eval_step(self.params, batch))
+        return total / len(self._eval_set)
 
     # ------------------------------------------------------------------
     def run(self) -> float:
@@ -166,6 +192,14 @@ class Trainer:
                     LOG.info("step %d loss %.4f (%.1fs)", self.step, loss_f,
                              dt)
                     self._metrics_reporter.report()
+                if (cfg.eval_every and self.eval_data_iter is not None
+                        and self.step % cfg.eval_every == 0):
+                    self.last_eval_loss = self._evaluate()
+                    self.metrics_history.append(
+                        {"step": self.step,
+                         "eval_loss": self.last_eval_loss})
+                    LOG.info("step %d eval_loss %.4f", self.step,
+                             self.last_eval_loss)
                 if (cfg.checkpoint_dir and cfg.checkpoint_every
                         and self.step % cfg.checkpoint_every == 0):
                     self._checkpoint()
