@@ -1,0 +1,90 @@
+"""Horovod-equivalent workload: all-reduce data-parallel ResNet.
+
+The reference's Horovod path is deliberately env-free — the orchestrator
+gang-schedules the workers and `horovodrun` does its own rendezvous from
+the host list (`TaskExecutor.java:201-204`; SURVEY.md §2.3). Same contract
+here: submitted with `tony.application.framework=horovod`, the executor
+renders NO framework env, and this script plays the horovodrun role —
+it builds the coordinator address from the universal `CLUSTER_SPEC`
+(worker 0's registered host:port, reserved with SO_REUSEPORT so the bind
+works), calls `jax.distributed.initialize`, and trains data-parallel with
+XLA all-reduce over the mesh instead of MPI/NCCL ring-allreduce (BASELINE
+"Horovod ResNet-50-equivalent" workload; model: models/resnet.py).
+
+Submit:
+  python -m tony_tpu.cli submit \
+      --executes examples/allreduce-resnet/train_allreduce.py \
+      --task_params "--config resnet50_proxy --steps 200" \
+      --conf tony.worker.instances=4 --conf tony.worker.tpus=4 \
+      --conf tony.application.framework=horovod
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+from functools import partial
+
+sys.path.insert(0, os.environ.get("TONY_REPO_ROOT",
+                                  os.path.join(os.path.dirname(__file__),
+                                               "..", "..")))
+
+from tony_tpu import constants as C  # noqa: E402
+from tony_tpu.models.resnet import (  # noqa: E402
+    get_resnet_config, resnet_init, resnet_loss,
+)
+from tony_tpu.train.data import synthetic_mnist  # noqa: E402
+from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
+
+
+def horovod_style_rendezvous() -> int:
+    """jax.distributed bring-up from CLUSTER_SPEC alone (no JAX_* env is
+    rendered for framework=horovod). Returns this process's rank."""
+    import jax
+
+    spec = json.loads(os.environ.get(C.CLUSTER_SPEC, "{}"))
+    workers = spec.get(C.WORKER_JOB_NAME, [])
+    rank = int(os.environ.get(C.TASK_INDEX, "0"))
+    if len(workers) > 1:
+        coordinator = workers[0]
+        logging.info("allreduce rendezvous: %s rank %d/%d", coordinator,
+                     rank, len(workers))
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=len(workers),
+                                   process_id=rank)
+    return rank
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="resnet_tiny")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-process batch")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rank = horovod_style_rendezvous()
+    # the synthetic stream is mnist-shaped (1-channel 28x28), so the
+    # input channel count follows the DATA regardless of preset — the
+    # resnet50_proxy depth/width still applies
+    config = get_resnet_config(args.config, in_channels=1)
+
+    def loss_with_images(params, batch):
+        return resnet_loss(params, batch, config)
+
+    trainer = Trainer(
+        loss_fn=loss_with_images,
+        init_fn=partial(resnet_init, config),
+        data_iter=synthetic_mnist(args.batch_size, process_index=rank),
+        config=TrainerConfig(num_steps=args.steps, log_every=10,
+                             learning_rate=1e-2, warmup_steps=2),
+    )
+    final_loss = trainer.run()
+    print(f"final loss {final_loss:.4f} (rank {rank})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

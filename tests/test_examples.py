@@ -98,6 +98,22 @@ def test_mxnet_linreg_example(tmp_path):
     assert client.final_status == "SUCCEEDED", _logs(client)
 
 
+def test_allreduce_resnet_example_two_workers(tmp_path):
+    """Horovod-equivalent contract: framework=horovod renders NO env, the
+    script rendezvouses from CLUSTER_SPEC alone and all-reduce-trains the
+    conv model across 2 processes."""
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "allreduce-resnet",
+                                    "train_allreduce.py"),
+         "--task_params", "--steps 8 --batch-size 8",
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.framework=horovod",
+         "--conf", ("tony.execution.env=XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=2")])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+
+
 def test_multirole_example(tmp_path):
     role = os.path.join(EXAMPLES, "multirole", "role.py")
     client = run_example(

@@ -14,6 +14,9 @@ from tony_tpu.models.llama import (
 )
 from tony_tpu.models.mnist import mnist_forward, mnist_init, mnist_loss
 from tony_tpu.models.linear import linreg_forward, linreg_init, linreg_loss
+from tony_tpu.models.resnet import (
+    ResNetConfig, resnet_forward, resnet_init, resnet_loss,
+)
 from tony_tpu.models.moe import (
     MoEConfig, moe_forward, moe_init, moe_loss, moe_param_axes,
 )
@@ -24,4 +27,5 @@ __all__ = [
     "llama_param_axes", "mnist_forward", "mnist_init", "mnist_loss",
     "linreg_forward", "linreg_init", "linreg_loss",
     "MoEConfig", "moe_forward", "moe_init", "moe_loss", "moe_param_axes",
+    "ResNetConfig", "resnet_forward", "resnet_init", "resnet_loss",
 ]

@@ -1,0 +1,172 @@
+"""Conv/ResNet family: the framework's convolutional workload.
+
+The reference's canonical workloads are MLP/CNN image models wrapped by
+the orchestrator (tony-examples mnist CNNs; BASELINE.md names a
+"Horovod ResNet-50-equivalent" gang). This is that family, TPU-first:
+
+- `lax.conv_general_dilated` in NHWC (the TPU-native conv layout — the
+  MXU consumes the channel dim as the contraction axis).
+- **GroupNorm instead of BatchNorm**: norm statistics are per-sample, so
+  the model is purely functional under SPMD — no cross-device batch-stat
+  syncing, no train/eval mode split, no mutable state to checkpoint.
+  (The standard TPU/SPMD substitution; accuracy-neutral at these scales.)
+- Residual blocks with a 1x1 projection on stride/width changes; stacked
+  per-stage weights are NOT scanned (depths here are small and stages
+  differ in shape — unlike the Llama tower, unrolling is the simpler and
+  equally-compiled choice).
+
+Presets: `resnet_tiny` (CIFAR-ish 3-stage, for tests/examples) and
+`resnet50_proxy` (the bottleneck-free 50-layer-equivalent depth/width
+used by the allreduce example on real chips).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 10
+    in_channels: int = 1
+    # (blocks, channels, first-block stride) per stage
+    stages: tuple = ((2, 16, 1), (2, 32, 2), (2, 64, 2))
+    stem_channels: int = 16
+    groups: int = 8              # GroupNorm groups
+    dtype: Any = jnp.float32
+
+
+PRESETS = {
+    "resnet_tiny": ResNetConfig(),
+    "resnet50_proxy": ResNetConfig(
+        in_channels=3, num_classes=1000, stem_channels=64,
+        stages=((3, 64, 1), (4, 128, 2), (6, 256, 2), (3, 512, 2)),
+        groups=32, dtype=jnp.bfloat16),
+}
+
+
+def get_resnet_config(name: str, **overrides) -> ResNetConfig:
+    return replace(PRESETS[name], **overrides)
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+
+def resnet_init(config: ResNetConfig, key: jax.Array) -> Params:
+    keys = iter(jax.random.split(key, 256))
+    p: Params = {
+        "stem": _conv_init(next(keys), 3, 3, config.in_channels,
+                           config.stem_channels, config.dtype),
+        "stem_scale": jnp.ones((config.stem_channels,), jnp.float32),
+        "stem_bias": jnp.zeros((config.stem_channels,), jnp.float32),
+        "stages": [],
+    }
+    cin = config.stem_channels
+    for n_blocks, cout, _stride in config.stages:
+        blocks = []
+        for b in range(n_blocks):
+            blk = {
+                "conv1": _conv_init(next(keys), 3, 3, cin if b == 0 else cout,
+                                    cout, config.dtype),
+                "scale1": jnp.ones((cout,), jnp.float32),
+                "bias1": jnp.zeros((cout,), jnp.float32),
+                "conv2": _conv_init(next(keys), 3, 3, cout, cout,
+                                    config.dtype),
+                "scale2": jnp.ones((cout,), jnp.float32),
+                "bias2": jnp.zeros((cout,), jnp.float32),
+            }
+            if b == 0 and cin != cout:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout,
+                                         config.dtype)
+            blocks.append(blk)
+        p["stages"].append(blocks)
+        cin = cout
+    p["head_w"] = (jax.random.normal(next(keys), (cin, config.num_classes),
+                                     jnp.float32) * cin ** -0.5).astype(
+        config.dtype)
+    p["head_b"] = jnp.zeros((config.num_classes,), jnp.float32)
+    return p
+
+
+def _group_norm(x, scale, bias, groups, eps=1e-5):
+    """x: (B, H, W, C) — per-sample, SPMD-pure."""
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * lax.rsqrt(var + eps)
+    xf = xf.reshape(b, h, w, c)
+    return (xf * scale + bias).astype(x.dtype)
+
+
+def _conv(x, w, stride=1):
+    # no preferred_element_type: an f32-typed primal output makes the conv
+    # VJP mix f32 cotangents with bf16 weights (TypeError); the MXU still
+    # accumulates bf16 conv partial products in f32 internally, and the
+    # following GroupNorm computes its statistics in f32
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def resnet_forward(params: Params, images: jax.Array,
+                   config: ResNetConfig) -> jax.Array:
+    """images: (B, H, W, C_in) -> logits (B, num_classes) f32."""
+    x = images.astype(config.dtype)
+    x = _conv(x, params["stem"])
+    x = jax.nn.relu(_group_norm(x, params["stem_scale"],
+                                params["stem_bias"], config.groups))
+    for (n_blocks, _cout, stride), blocks in zip(config.stages,
+                                                 params["stages"]):
+        for b, blk in enumerate(blocks):
+            s = stride if b == 0 else 1
+            h = _conv(x, blk["conv1"], stride=s)
+            h = jax.nn.relu(_group_norm(h, blk["scale1"], blk["bias1"],
+                                        config.groups))
+            h = _conv(h, blk["conv2"])
+            h = _group_norm(h, blk["scale2"], blk["bias2"], config.groups)
+            shortcut = x
+            if "proj" in blk:
+                shortcut = _conv(x, blk["proj"], stride=s)
+            elif s != 1:
+                shortcut = x[:, ::s, ::s]
+            x = jax.nn.relu(h + shortcut)
+    x = x.mean(axis=(1, 2))                       # global average pool
+    return jnp.einsum("bc,cn->bn", x, params["head_w"],
+                      preferred_element_type=jnp.float32) + params["head_b"]
+
+
+def _as_images(images: jax.Array) -> jax.Array:
+    """(B, N*N) mnist-flat convenience -> (B, N, N, 1); NHWC passes
+    through. Shared by loss and accuracy so the convention lives once."""
+    if images.ndim == 2:
+        side = int(images.shape[1] ** 0.5)
+        images = images.reshape(-1, side, side, 1)
+    return images
+
+
+def resnet_loss(params: Params, batch: dict[str, jax.Array],
+                config: ResNetConfig) -> jax.Array:
+    """batch: {'images': (B,H,W,C) or (B, 784) mnist-flat, 'labels': (B,)}"""
+    logits = resnet_forward(params, _as_images(batch["images"]), config)
+    labels = batch["labels"]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def resnet_accuracy(params: Params, batch: dict[str, jax.Array],
+                    config: ResNetConfig) -> jax.Array:
+    logits = resnet_forward(params, _as_images(batch["images"]), config)
+    return jnp.mean(jnp.argmax(logits, axis=-1) == batch["labels"])
