@@ -55,6 +55,8 @@ def main() -> int:
                         help="microbatch gradient-accumulation steps")
     parser.add_argument("--eval-every", type=int, default=0,
                         help="held-out eval cadence in steps (0 = off)")
+    parser.add_argument("--master-weights", action="store_true",
+                        help="f32 master copy for bf16 params")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=0)
     parser.add_argument("--data", default="",
@@ -86,7 +88,8 @@ def main() -> int:
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             grad_accum=args.grad_accum,
-            eval_every=args.eval_every),
+            eval_every=args.eval_every,
+            master_weights=args.master_weights),
         param_axes=llama_param_axes(config),
         eval_data_iter=(_eval_stream(args, seq, config, process_index)
                         if args.eval_every else None),

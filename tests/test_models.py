@@ -285,3 +285,86 @@ def test_resnet50_proxy_shapes():
     logits = resnet_forward(params, imgs, cfg)
     assert logits.shape == (2, 12)
     assert logits.dtype == jnp.float32
+
+
+def test_f32_master_rescues_bf16_underflow():
+    """With lr small enough that bf16 updates underflow the ULP, plain
+    bf16 adam stalls EXACTLY (params unchanged) while the f32-master
+    wrapper keeps making progress — the defining property of master
+    weights."""
+    from tony_tpu.train.precision import with_f32_master
+
+    w0_host = np.full((64,), 1.0, np.float32)  # ULP(1.0) = 2^-8 in bf16
+
+    def fresh():
+        return {"w": jnp.full((64,), 1.0, jnp.bfloat16)}
+
+    def loss_fn(params, batch):
+        return jnp.sum((params["w"].astype(jnp.float32) - 2.0) ** 2)
+
+    # sgd step = lr * grad = 1e-5 * 2 ≈ 2e-5 << 2^-8: underflows in bf16
+    plain = optax.sgd(1e-5)
+    step_plain = make_train_step(loss_fn, plain)
+    p1, s1 = fresh(), plain.init(fresh())
+    for _ in range(50):
+        p1, s1, _ = step_plain(p1, s1, None)
+    np.testing.assert_array_equal(np.asarray(p1["w"], np.float32),
+                                  w0_host)  # stalled exactly
+
+    master = with_f32_master(optax.sgd(1e-5))
+    step_m = make_train_step(loss_fn, master)
+    p2, s2 = fresh(), master.init(fresh())
+    for _ in range(300):
+        p2, s2, _ = step_m(p2, s2, None)
+    # loss pulls w from 1.0 toward 2.0: the master accumulated
+    # ~300*2e-5 = 6e-3 of progress, and 6e-3 > ULP(1.0)=2^-8 so the
+    # visible bf16 params moved too
+    assert float(np.asarray(s2["master"]["w"], np.float32)[0]) > 1.004
+    assert float(np.asarray(p2["w"], np.float32)[0]) > 1.0
+
+
+def test_f32_master_trains_llama_bf16_on_mesh():
+    """Full sharded step with master weights on the bf16 tiny config."""
+    cfg = get_config("tiny", dtype=jnp.bfloat16)
+    from tony_tpu.train.precision import with_f32_master
+
+    mesh = make_mesh(plan_mesh(8, tp=2))
+    params = shard_pytree(llama_init(cfg, jax.random.PRNGKey(0)),
+                          llama_param_axes(cfg), mesh)
+    opt = with_f32_master(optax.adam(1e-2))
+    step = make_train_step(lambda p, b: llama_loss(p, b, cfg), opt)
+    data = synthetic_tokens(8, 32, cfg.vocab_size)
+    with jax.set_mesh(mesh):
+        opt_state = jax.jit(opt.init)(params)
+        losses = []
+        for _ in range(10):
+            batch = {k: jax.device_put(v) for k, v in next(data).items()}
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # params stayed bf16; master is f32
+    assert params["embed"].dtype == jnp.bfloat16
+    assert opt_state["master"]["embed"].dtype == jnp.float32
+
+
+def test_master_weights_with_grad_accum_keeps_f32_grads():
+    """grad_accum + master weights together: the f32-accumulated mean
+    gradient must reach the master un-quantized (params stay bf16, loss
+    finite, master f32) — the combination the trainer wires."""
+    from tony_tpu.train.precision import with_f32_master
+
+    cfg = get_config("tiny", dtype=jnp.bfloat16)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    opt = with_f32_master(optax.adam(1e-2))
+    step = make_train_step(lambda p, b: llama_loss(p, b, cfg), opt,
+                           grad_accum=2, emit_accum_dtype=True)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg.vocab_size, jnp.int32)
+    opt_state = jax.jit(opt.init)(params)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state,
+                                       {"tokens": tokens})
+    assert np.isfinite(float(loss))
+    assert params["embed"].dtype == jnp.bfloat16
+    assert opt_state["master"]["embed"].dtype == jnp.float32

@@ -38,7 +38,7 @@ import queue
 import shlex
 import subprocess
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import IO, Mapping, Optional
 
 from tony_tpu.cluster.backend import (

@@ -8,7 +8,7 @@ dataclasses with dict codecs (the gRPC layer carries them as JSON).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field, asdict
+from dataclasses import dataclass, asdict
 from typing import Any
 
 

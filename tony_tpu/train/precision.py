@@ -1,0 +1,60 @@
+"""Mixed-precision training: f32 master weights for bf16 models.
+
+bf16 has ~8 bits of mantissa: once `lr * grad` drops below a parameter's
+bf16 ULP, `param + update` rounds back to `param` and training silently
+stalls — the standard failure mode of keeping optimizer state in the
+compute dtype. The standard fix (kept out of the model code, where bf16 is
+the right compute dtype for the MXU): the optimizer keeps an f32 master
+copy, updates accumulate there, and the bf16 params are re-derived as a
+cast of the master each step.
+
+`with_f32_master(opt)` wraps any optax optimizer:
+- init: master = f32 copy of the params; inner optimizer state is built
+  over the master (so Adam moments are f32 too).
+- update: grads cast to f32, inner update applied to the master, and the
+  emitted update is `cast(master') - param` — so `optax.apply_updates`
+  yields exactly the cast master and the train-step contract
+  (params, opt_state, loss) is unchanged.
+
+Memory: +4 bytes/param for the master (plus the inner optimizer's state
+now f32). The sharded train step keeps everything distributed: the master
+inherits the params' shardings through zeros_like-style propagation.
+
+Reference parity: none (the reference delegates all tensor math;
+SURVEY.md §2.3) — this is TPU-training table stakes for the bf16 presets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def _to_f32(tree):
+    return jax.tree.map(
+        lambda x: x.astype(jnp.float32)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def with_f32_master(opt: optax.GradientTransformation
+                    ) -> optax.GradientTransformation:
+    """Wrap `opt` to accumulate updates in an f32 master copy."""
+
+    def init(params):
+        master = _to_f32(params)
+        return {"inner": opt.init(master), "master": master}
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("with_f32_master requires params in update()")
+        inner_updates, inner_state = opt.update(
+            _to_f32(grads), state["inner"], state["master"])
+        master = optax.apply_updates(state["master"], inner_updates)
+        # emitted update = cast(master') - param, so apply_updates lands
+        # exactly on the cast master (no drift between param and master)
+        updates = jax.tree.map(
+            lambda m, p: m.astype(p.dtype) - p, master, params)
+        return updates, {"inner": inner_state, "master": master}
+
+    return optax.GradientTransformation(init, update)

@@ -30,12 +30,18 @@ def make_train_step(loss_fn: Callable[..., jax.Array],
                     optimizer: optax.GradientTransformation,
                     jit: bool = True,
                     grad_accum: int = 1,
-                    accum_dtype: Any = jnp.float32) -> Callable:
+                    accum_dtype: Any = jnp.float32,
+                    emit_accum_dtype: bool = False) -> Callable:
     """loss_fn(params, batch) -> scalar. Returns
     train_step(params, opt_state, batch) -> (params, opt_state, loss).
 
     With grad_accum=N, every array in `batch` must have a leading dim
-    divisible by N; the returned loss is the mean over microbatches."""
+    divisible by N; the returned loss is the mean over microbatches.
+    The accumulated mean gradient is cast back to the param dtype by
+    default (optax type promotion would otherwise upcast the params on
+    apply); pass emit_accum_dtype=True when the optimizer keeps its own
+    higher-precision state (train/precision.py with_f32_master) so the
+    f32-accumulated mean is not quantized at the interface."""
 
     if grad_accum <= 1:
         def loss_and_grads(params, batch):
@@ -91,8 +97,9 @@ def make_train_step(loss_fn: Callable[..., jax.Array],
             (loss_sum, grad_sum), _ = lax.scan(
                 body, (jnp.zeros((), jnp.float32), zeros), micro)
             grads = jax.tree.map(
-                lambda g, p: (g / grad_accum).astype(p.dtype), grad_sum,
-                params)
+                lambda g, p: (g / grad_accum if emit_accum_dtype
+                              else (g / grad_accum).astype(p.dtype)),
+                grad_sum, params)
             return loss_sum / grad_accum, grads
 
     def train_step(params: Any, opt_state: Any, batch: Any):

@@ -65,6 +65,9 @@ class TrainerConfig:
     # microbatch gradient accumulation: batch dim split into this many
     # scan slices, one optimizer update on the mean gradient (train/step.py)
     grad_accum: int = 1
+    # f32 master weights for bf16 params (train/precision.py): updates
+    # accumulate in f32 so tiny-lr steps don't underflow the bf16 ULP
+    master_weights: bool = False
     # held-out evaluation cadence: every N train steps run `eval_batches`
     # batches from eval_data_iter through a jitted loss-only step and log
     # the mean (0 = no eval; requires eval_data_iter on the Trainer)
@@ -113,8 +116,14 @@ class Trainer:
                 max(cfg.num_steps, cfg.warmup_steps + 1))
             self.optimizer = optax.adamw(schedule,
                                          weight_decay=cfg.weight_decay)
-        self.train_step = make_train_step(self.loss_fn, self.optimizer,
-                                          grad_accum=cfg.grad_accum)
+        if cfg.master_weights:
+            from tony_tpu.train.precision import with_f32_master
+            self.optimizer = with_f32_master(self.optimizer)
+        self.train_step = make_train_step(
+            self.loss_fn, self.optimizer, grad_accum=cfg.grad_accum,
+            # the master consumes f32 grads: don't quantize the
+            # f32-accumulated mean back to bf16 at the interface
+            emit_accum_dtype=cfg.master_weights)
 
         resume = (latest_step(cfg.checkpoint_dir)
                   if cfg.checkpoint_dir else None)
