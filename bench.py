@@ -408,9 +408,12 @@ def _diag(err: str, state: str, what: str) -> str:
     return f"{what} {state}; last progress: {last}; stderr tail:\n{tail}"
 
 
-def _run_child(backend: str, deadline: float) -> tuple[dict | None, str]:
+def _run_child(backend: str, deadline: float,
+               extra_env: dict | None = None) -> tuple[dict | None, str]:
     """Run one measurement child. Returns (result_json_or_None, diag)."""
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     if backend in ("cpu", "startup"):
         # Never let a CPU/orchestrator child (or its jax import, or the
         # container subprocesses it spawns) claim the tunnel.
@@ -460,6 +463,11 @@ def _record_last_good(result: dict) -> None:
     labeled metadata."""
     if str(result.get("device", "")).lower() in ("cpu", ""):
         return
+    if result.get("kernel_fallback"):
+        # a degraded-kernel measurement must not shadow a faster real one
+        prev = _load_last_good()
+        if prev and prev.get("value", 0.0) > result.get("value", 0.0):
+            return
     snap = dict(result)
     snap["measured_at"] = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
     try:
@@ -520,21 +528,35 @@ def main() -> None:
     # fluke) — it shrinks the schedule to one short attempt so most of
     # the budget is preserved for the CPU fallback measurement.
     attempts = ((1, 0.45), (2, 0.3)) if probe_ok else ((1, 0.25),)
+    kernel_fallback = False
     for attempt, frac in attempts:
         remaining = usable - (time.monotonic() - t_start)
         if attempt > 1 and remaining < 75.0:
             diags.append("retry skipped: budget too small")
             break
         deadline = max(15.0, min(frac * usable, remaining - 45.0))
-        result, diag = _run_child("tpu", deadline)
+        # if the previous attempt died in pallas/Mosaic kernel lowering
+        # (a clean exception, not a tunnel wedge), the retry pins the
+        # blockwise-jnp kernels: a slower nonzero MFU beats a 0.0 headline
+        extra = ({"TONY_FLASH_FORCE": "blockwise"} if kernel_fallback
+                 else None)
+        result, diag = _run_child("tpu", deadline, extra_env=extra)
         if result is not None:
             if diags:
                 result["retries"] = attempt - 1
+            if kernel_fallback:
+                result["kernel_fallback"] = "blockwise"
             _record_last_good(result)
             _attach_startup_latency(result, t_start, usable)
             print(json.dumps(result), flush=True)
             return
         diags.append(f"attempt {attempt}: {diag}")
+        # only a CLEAN child exit counts as a kernel-lowering failure — a
+        # timed-out child's faulthandler dump can mention pallas frames
+        # while the real fault is a tunnel wedge
+        if "timed out after" not in diag and any(
+                m in diag.lower() for m in ("mosaic", "pallas")):
+            kernel_fallback = True
         print(f"[bench parent] {diags[-1]}", file=sys.stderr, flush=True)
 
     # TPU is wedged: measure on CPU so the driver still gets real data,
