@@ -52,14 +52,23 @@ def main() -> int:
                         help="global context length; 0 = preset max_seq")
     parser.add_argument("--sp-mode", default="ring",
                         choices=("ring", "ulysses"))
+    parser.add_argument("--rope-scaling", type=float, default=0.0,
+                        help="Llama-3.1-style RoPE rescale factor for "
+                             "contexts beyond the preset's max_seq (0=off)")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
-    config = get_config(args.config, sp_mode=args.sp_mode)
-    seq = args.seq_len or config.max_seq
+    base = get_config(args.config, sp_mode=args.sp_mode)
+    seq = args.seq_len or base.max_seq
+    overrides = dict(sp_mode=args.sp_mode)
     if args.seq_len:
-        # max_seq follows the requested context so RoPE tables span it
-        config = get_config(args.config, max_seq=seq, sp_mode=args.sp_mode)
+        # max_seq follows the requested context so RoPE tables span it;
+        # rope_orig_max_seq stays the preset's window so the rescale
+        # anchors to what the model was (or would be) pretrained at
+        overrides.update(max_seq=seq, rope_orig_max_seq=base.max_seq)
+    if args.rope_scaling:
+        overrides.update(rope_scaling_factor=args.rope_scaling)
+    config = get_config(args.config, **overrides)
     process_index = int(os.environ.get("JAX_PROCESS_ID", "0"))
 
     # validate the seq/sp fit from the rendered env BEFORE any param init

@@ -225,3 +225,42 @@ def test_pallas_gqa_kernels_interpret_mode():
         assert got_g.shape == w.shape, name
         np.testing.assert_allclose(np.asarray(got_g), np.asarray(w),
                                    atol=2e-4, rtol=2e-4, err_msg=name)
+
+
+def test_rope_long_context_scaling():
+    """Llama-3.1 rescale: high-frequency components untouched, fully
+    low-frequency ones slowed by exactly `factor`, band in between
+    monotonic — and the scaled tables match unscaled inside the original
+    context for local-geometry dims."""
+    import numpy as np
+
+    from tony_tpu.ops.rope import rope_frequencies, scale_rope_frequencies
+    import jax.numpy as jnp
+
+    head_dim, orig, factor = 128, 512, 8.0
+    inv = 1.0 / (10_000.0 ** (jnp.arange(0, head_dim, 2,
+                                         dtype=jnp.float32) / head_dim))
+    scaled = scale_rope_frequencies(inv, factor, orig)
+    wavelen = np.asarray(2.0 * np.pi / inv)
+    s, i = np.asarray(scaled), np.asarray(inv)
+    hi = wavelen < orig / 4.0          # clearly-local dims
+    lo = wavelen > orig / 1.0          # never completed a period
+    assert hi.any() and lo.any()
+    np.testing.assert_array_equal(s[hi], i[hi])
+    np.testing.assert_allclose(s[lo], i[lo] / factor, rtol=1e-6)
+    mid = ~(hi | lo)
+    if mid.any():                       # band interpolates within bounds
+        assert (s[mid] <= i[mid] + 1e-9).all()
+        assert (s[mid] >= i[mid] / factor - 1e-9).all()
+
+    # table-level: the rescale flows into rope_frequencies — the slowest
+    # component's accumulated phase at the last position shrinks by ~factor
+    # (acos of its cos row recovers phase while phase < pi)
+    cos_u, _ = rope_frequencies(64, 256, scaling_factor=0.0)
+    cos_s, _ = rope_frequencies(64, 256, scaling_factor=8.0,
+                                orig_max_seq=128)
+    assert cos_u.shape == cos_s.shape
+    phase_u = float(np.arccos(np.clip(np.asarray(cos_u)[255, -1], -1, 1)))
+    phase_s = float(np.arccos(np.clip(np.asarray(cos_s)[255, -1], -1, 1)))
+    assert 0 < phase_s < phase_u
+    np.testing.assert_allclose(phase_s, phase_u / 8.0, rtol=1e-2)

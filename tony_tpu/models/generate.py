@@ -31,11 +31,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from tony_tpu.models.llama import (
-    LlamaConfig, Params, qkv_proj, swiglu_mlp,
+    LlamaConfig, Params, qkv_proj, rope_tables, swiglu_mlp,
 )
 from tony_tpu.ops.attention import NEG_INF, flash_attention
 from tony_tpu.ops.rmsnorm import rms_norm
-from tony_tpu.ops.rope import apply_rope, rope_frequencies
+from tony_tpu.ops.rope import apply_rope
 
 
 def _cache_attention(q, k_cache, v_cache, cur_len: jax.Array,
@@ -66,8 +66,7 @@ def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
     tokens: (B, P) int32; cache_len >= P."""
     b, p = tokens.shape
     nkv, hd = config.n_kv_heads, config.head_dim
-    cos, sin = rope_frequencies(config.head_dim, cache_len,
-                                config.rope_theta)
+    cos, sin = rope_tables(config, cache_len)
     x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
 
     def body(x, layer):
@@ -99,8 +98,7 @@ def decode_step(params: Params, config: LlamaConfig,
     """One decode step. token: (B,) int32; pos: scalar int32 (the position
     the token occupies). Returns (logits (B, V), updated cache)."""
     cache_len = cache["k"].shape[3]
-    cos, sin = rope_frequencies(config.head_dim, cache_len,
-                                config.rope_theta)
+    cos, sin = rope_tables(config, cache_len)
     cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
     sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
     x = jnp.take(params["embed"], token[:, None], axis=0).astype(

@@ -52,6 +52,14 @@ class LlamaConfig:
     ffn_dim: int = 14_336
     max_seq: int = 8192
     rope_theta: float = 500_000.0
+    # Llama-3.1-style long-context RoPE rescale (ops/rope.py): >1 slows
+    # the low-frequency components so a model trained at rope_orig_max_seq
+    # extends to factor-times-longer contexts (the ring-attention regime);
+    # 0 = off
+    rope_scaling_factor: float = 0.0
+    # pretrained context window the rescale anchors to; 0 = this config's
+    # max_seq (set explicitly when max_seq itself was extended)
+    rope_orig_max_seq: int = 0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -221,6 +229,16 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
     return flash_attention(q, k, v, True)
 
 
+def rope_tables(config: LlamaConfig, seq: int):
+    """(cos, sin) tables honoring the config's theta and long-context
+    scaling; the single rope entry point for every model path (training,
+    pipelined, MoE, prefill/decode)."""
+    return rope_frequencies(
+        config.head_dim, seq, config.rope_theta,
+        scaling_factor=config.rope_scaling_factor,
+        orig_max_seq=config.rope_orig_max_seq or config.max_seq)
+
+
 def qkv_proj(h: jax.Array, layer: Params, config: LlamaConfig
              ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """(B, S, D) -> q (B,H,S,hd), k/v (B,Hkv,S,hd) — pre-RoPE. Shared by
@@ -284,7 +302,7 @@ def llama_hidden(params: Params, tokens: jax.Array,
                  config: LlamaConfig) -> jax.Array:
     """tokens: (B, S) int32 -> final-normed hidden states (B, S, dim)."""
     s = tokens.shape[1]
-    cos, sin = rope_frequencies(config.head_dim, s, config.rope_theta)
+    cos, sin = rope_tables(config, s)
     x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
     x = constrain(x, ("batch", "seq", None))
 
@@ -367,7 +385,7 @@ def llama_hidden_pipelined(params: Params, tokens: jax.Array,
         # the replicated-over-sp stage weights varying, and the pcast's
         # vjp is exactly the psum that reduces their cotangents over sp
         seq = x.shape[1] * sp if sp > 1 else x.shape[1]
-        cos, sin = rope_frequencies(config.head_dim, seq, config.rope_theta)
+        cos, sin = rope_tables(config, seq)
         if sp > 1:
             # each rank holds its local seq chunk: slice its rope rows
             idx = lax.axis_index("sp")

@@ -245,11 +245,10 @@ def _sparse_dispatch(xt, layer, gates, keep, position, capacity,
 def moe_hidden(params: Params, tokens: jax.Array, config: MoEConfig
                ) -> tuple[jax.Array, jax.Array]:
     """-> (final-normed hidden (B,S,D), total aux loss)."""
-    from tony_tpu.models.llama import attention_sublayer
-    from tony_tpu.ops.rope import rope_frequencies
+    from tony_tpu.models.llama import attention_sublayer, rope_tables
 
     s = tokens.shape[1]
-    cos, sin = rope_frequencies(config.head_dim, s, config.rope_theta)
+    cos, sin = rope_tables(config, s)
     x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
     x = constrain(x, ("batch", "seq", None))
 

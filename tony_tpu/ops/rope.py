@@ -13,11 +13,47 @@ import jax
 import jax.numpy as jnp
 
 
+def scale_rope_frequencies(inv_freq: jax.Array, factor: float,
+                           orig_max_seq: int,
+                           low_freq_factor: float = 1.0,
+                           high_freq_factor: float = 4.0) -> jax.Array:
+    """Llama-3.1-style long-context RoPE rescale.
+
+    Components whose wavelength exceeds the original context window
+    (low-frequency — they never completed a period during pretraining)
+    are slowed by `factor`; components with short wavelengths
+    (high-frequency, local-position detail) are left untouched; the band
+    between interpolates smoothly. This is what lets a model trained at
+    `orig_max_seq` extend to `factor * orig_max_seq` token contexts (the
+    ring-attention regime) without scrambling local position geometry.
+    """
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_bound = orig_max_seq / low_freq_factor      # longest "trained" wl
+    high_bound = orig_max_seq / high_freq_factor    # clearly-local wl
+    # smooth: 0 at the low-frequency boundary (fully slowed) -> 1 at the
+    # high-frequency boundary (untouched)
+    smooth = (orig_max_seq / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    interpolated = smooth * inv_freq + (1.0 - smooth) * inv_freq / factor
+    return jnp.where(wavelen > low_bound, inv_freq / factor,
+                     jnp.where(wavelen < high_bound, inv_freq,
+                               interpolated))
+
+
 def rope_frequencies(head_dim: int, max_seq: int,
-                     theta: float = 10_000.0) -> tuple[jax.Array, jax.Array]:
-    """(cos, sin) tables of shape (max_seq, head_dim//2), f32."""
+                     theta: float = 10_000.0,
+                     scaling_factor: float = 0.0,
+                     orig_max_seq: int = 8192
+                     ) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape (max_seq, head_dim//2), f32.
+    scaling_factor > 1 applies the Llama-3.1 long-context rescale against
+    `orig_max_seq` (0 = off)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                            dtype=jnp.float32) / head_dim))
+    if scaling_factor and scaling_factor > 1.0:
+        inv_freq = scale_rope_frequencies(inv_freq, scaling_factor,
+                                          orig_max_seq)
     t = jnp.arange(max_seq, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)                  # (S, D/2)
     return jnp.cos(freqs), jnp.sin(freqs)
