@@ -209,12 +209,14 @@ def child_main(backend: str) -> None:
             result.update(_bench_8b_layer(jax, jnp, optax, dev))
         except Exception as e:  # metadata only — never sink the headline
             _mark(f"8b layer bench failed: {type(e).__name__}: {e}")
-            result["llama3_8b_layer_error"] = f"{type(e).__name__}: {e}"
+            result["llama3_8b_layer_error"] = _compact(
+                f"{type(e).__name__}: {e}", 160)
         try:
             result.update(_bench_decode(jax, jnp, config, params))
         except Exception as e:  # metadata only
             _mark(f"decode bench failed: {type(e).__name__}: {e}")
-            result["decode_error"] = f"{type(e).__name__}: {e}"
+            result["decode_error"] = _compact(f"{type(e).__name__}: {e}",
+                                              160)
         # live duty-cycle path (task_monitor's wedge-detection source):
         # present on real TPU VMs via the libtpu metrics daemon; absent
         # over the tunnel — record which, never fail the bench on it
@@ -449,12 +451,56 @@ def _attach_startup_latency(result: dict, t_start: float,
     if sub is not None:
         result["am_startup_latency"] = sub
     else:
-        result["am_startup_latency"] = {"error": diag[-300:]}
+        result["am_startup_latency"] = {"error": _compact(diag, 160)}
 
 
 _LAST_GOOD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "tools",
     "last_good_bench.json")
+_DIAG_LOG_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tools",
+    "bench_diag.log")
+
+
+def _compact(s: str, limit: int) -> str:
+    """One physical line, bounded length — safe to embed in the final
+    JSON line (see _emit)."""
+    s = " | ".join(part.strip() for part in str(s).splitlines()
+                   if part.strip())
+    return s[-limit:] if len(s) > limit else s
+
+
+def _emit(result: dict) -> None:
+    """THE measurement contract (VERDICT r3 weak #2): the final stdout
+    line is exactly one compact JSON object, short enough to survive a
+    driver that keeps only a tail of stdout (~2 KB in BENCH_r03, where a
+    stack-dump-bearing 4 KB line arrived truncated and parsed as null).
+    Anything long goes to stderr + tools/bench_diag.log, never stdout."""
+    drop_order = ("tpu_error", "cpu_error", "last_good_tpu_measurement",
+                  "am_startup_latency", "error")
+    line = json.dumps(result, separators=(",", ":"))
+    for key in drop_order:
+        if len(line) <= 1400:
+            break
+        if key in result:
+            result.pop(key)
+            result["truncated"] = (result.get("truncated", "") + f" {key}"
+                                   ).strip()
+            line = json.dumps(result, separators=(",", ":"))
+    print(line, flush=True)
+
+
+def _log_diag(diags: list[str]) -> None:
+    """Full, untruncated diagnosis to stderr and a scratch log file."""
+    text = "\n\n".join(diags)
+    print(f"[bench parent] full diagnosis:\n{text}", file=sys.stderr,
+          flush=True)
+    try:
+        with open(_DIAG_LOG_PATH, "w", encoding="utf-8") as f:
+            f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ\n", time.gmtime()))
+            f.write(text + "\n")
+    except Exception:  # noqa: BLE001 — diagnostics only
+        pass
 
 
 def _record_last_good(result: dict) -> None:
@@ -492,6 +538,15 @@ def _load_last_good():
             return json.load(f)
     except Exception:  # noqa: BLE001
         return None
+
+
+def _compact_last_good(last: dict) -> dict:
+    """Embed only the headline fields of the last good TPU run — the full
+    snapshot lives in tools/last_good_bench.json and must not bloat the
+    final stdout line past the driver's tail window."""
+    keep = ("metric", "value", "unit", "tokens_per_sec_per_chip",
+            "step_time_s", "measured_at", "commit")
+    return {k: last[k] for k in keep if k in last}
 
 
 def main() -> None:
@@ -548,7 +603,9 @@ def main() -> None:
                 result["kernel_fallback"] = "blockwise"
             _record_last_good(result)
             _attach_startup_latency(result, t_start, usable)
-            print(json.dumps(result), flush=True)
+            if diags:
+                _log_diag(diags)
+            _emit(result)
             return
         diags.append(f"attempt {attempt}: {diag}")
         # only a CLEAN child exit counts as a kernel-lowering failure — a
@@ -566,7 +623,8 @@ def main() -> None:
     # metadata — `value` stays 0.0; a dead tunnel is a dead tunnel.
     remaining = usable - (time.monotonic() - t_start)
     result, diag = _run_child("cpu", max(15.0, remaining))
-    tpu_error = " || ".join(diags)[-1500:]
+    _log_diag(diags + ([f"cpu fallback: {diag}"] if result is None else []))
+    tpu_error = _compact(" || ".join(diags), 300)
     if result is not None:
         result.update({
             "value": 0.0, "vs_baseline": 0.0,
@@ -579,23 +637,23 @@ def main() -> None:
         })
         last = _load_last_good()
         if last is not None:
-            result["last_good_tpu_measurement"] = last
+            result["last_good_tpu_measurement"] = _compact_last_good(last)
         _attach_startup_latency(result, t_start, usable)
-        print(json.dumps(result), flush=True)
+        _emit(result)
         return
     final = {
         "metric": METRIC, "value": 0.0, "unit": "%MFU",
         "vs_baseline": 0.0,
         "error": "tpu wedged AND cpu fallback failed",
-        "tpu_error": tpu_error, "cpu_error": diag[-800:],
+        "tpu_error": tpu_error, "cpu_error": _compact(diag, 200),
     }
     last = _load_last_good()
     if last is not None:
-        final["last_good_tpu_measurement"] = last
+        final["last_good_tpu_measurement"] = _compact_last_good(last)
     # the orchestrator-only latency metric works regardless of jax/tunnel
     # health — attach it on the total-failure path too
     _attach_startup_latency(final, t_start, usable)
-    print(json.dumps(final), flush=True)
+    _emit(final)
 
 
 if __name__ == "__main__":

@@ -137,6 +137,9 @@ def _sample(logits: jax.Array, temperature: float, top_k: int,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
+        # clamp: top_k past the vocab is "no truncation", not an opaque
+        # XLA shape error inside jit
+        top_k = min(top_k, logits.shape[-1])
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]      # (B, 1)
         logits = jnp.where(logits < kth, NEG_INF, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -101,7 +101,12 @@ def resnet_init(config: ResNetConfig, key: jax.Array) -> Params:
 def _group_norm(x, scale, bias, groups, eps=1e-5):
     """x: (B, H, W, C) — per-sample, SPMD-pure."""
     b, h, w, c = x.shape
+    # largest divisor of c that is <= groups: a non-dividing group count
+    # (e.g. a custom stage width with the default groups=8) must not hit
+    # an opaque reshape error at trace time
     g = min(groups, c)
+    while c % g:
+        g -= 1
     xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
     mean = xf.mean(axis=(1, 2, 4), keepdims=True)
     var = ((xf - mean) ** 2).mean(axis=(1, 2, 4), keepdims=True)

@@ -115,7 +115,11 @@ def _bwd(mask_start, chunk, residuals, g):
     dx = dx_chunks.transpose(1, 0, 2, 3).reshape(b, s, d)
     dx = constrain(dx, ("batch", "seq", None))
     dw = constrain(dw, ("embed", "vocab"))
-    return dx, dw.astype(w.dtype), None
+    # float0 zero (not bare None) for the integer targets primal: None is
+    # accepted by jax>=0.9 but older versions require the typed zero —
+    # keep the op version-portable
+    dt = jax.custom_derivatives.zero_from_primal(targets)
+    return dx, dw.astype(w.dtype), dt
 
 
 _fused_xent_sum.defvjp(lambda x, w, t, ms, c: _fwd(x, w, t, ms, c), _bwd)

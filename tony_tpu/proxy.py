@@ -223,6 +223,57 @@ class ProxyServer:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve, name="proxy",
                                         daemon=True)
+        # shared upstream-liveness probe: when the upstream is down, ONE
+        # handler polls and the rest wait on this event, so a connection
+        # burst (or a port scanner) does not accumulate a 0.25s poll loop
+        # per client thread for up to connect_wait_sec each
+        self._up_lock = threading.Lock()
+        self._up_event = threading.Event()
+
+    def _dial_upstream(self, deadline: float) -> socket.socket | None:
+        """Dial the upstream with a bounded wait. Every handler gets one
+        immediate attempt; while the upstream is down only ONE elected
+        handler runs the 0.25s retry loop (no separate probe connection —
+        its successful dial IS its relay socket, so single-accept
+        upstreams are not disturbed) and the rest park on _up_event."""
+        try:
+            s = socket.create_connection(self._remote, timeout=10)
+            # the timeout bounds the CONNECT only; left in place it would
+            # tear the relay down on any 10s-idle gap (recv timeout in
+            # _pump)
+            s.settimeout(None)
+            self._up_event.set()
+            return s
+        except OSError:
+            pass
+        while not self._stop.is_set():
+            if deadline - time.monotonic() <= 0:
+                return None
+            if self._up_lock.acquire(blocking=False):
+                try:  # elected prober: the only thread that poll-loops
+                    self._up_event.clear()
+                    while (not self._stop.is_set()
+                           and deadline - time.monotonic() > 0):
+                        try:
+                            s = socket.create_connection(self._remote,
+                                                         timeout=10)
+                            s.settimeout(None)
+                            self._up_event.set()
+                            return s
+                        except OSError:
+                            time.sleep(0.25)
+                    return None
+                finally:
+                    self._up_lock.release()
+            remaining = min(deadline - time.monotonic(), 0.5)
+            if remaining > 0 and self._up_event.wait(timeout=remaining):
+                try:  # prober saw the upstream come up — dial for myself
+                    s = socket.create_connection(self._remote, timeout=10)
+                    s.settimeout(None)
+                    return s
+                except OSError:
+                    continue  # raced a fresh outage; re-elect
+        return None
 
     def start(self) -> None:
         LOG.info("proxy 127.0.0.1:%d -> %s:%d%s", self.local_port,
@@ -254,22 +305,12 @@ class ProxyServer:
         # (the reference's NotebookSubmitter proxies as soon as the URL
         # appears in TaskInfos and has the same bring-up gap). Refused
         # connections retry until the wait budget runs out.
-        upstream = None
-        deadline = time.monotonic() + self._connect_wait
-        while True:
-            try:
-                upstream = socket.create_connection(self._remote, timeout=10)
-                # the timeout bounds the CONNECT only; left in place it
-                # would tear the relay down on any 10s-idle gap (recv
-                # timeout in _pump)
-                upstream.settimeout(None)
-                break
-            except OSError:
-                if self._stop.is_set() or time.monotonic() >= deadline:
-                    LOG.warning("cannot reach %s:%d", *self._remote)
-                    conn.close()
-                    return
-                time.sleep(0.25)
+        upstream = self._dial_upstream(
+            time.monotonic() + self._connect_wait)
+        if upstream is None:
+            LOG.warning("cannot reach %s:%d", *self._remote)
+            conn.close()
+            return
         _set_keepalive(conn)
         _set_keepalive(upstream)
         if initial:
