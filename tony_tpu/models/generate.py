@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tony_tpu.models.llama import (
-    LlamaConfig, Params, qkv_proj, rope_tables, swiglu_mlp,
+    LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables, swiglu_mlp,
 )
 from tony_tpu.ops.attention import NEG_INF, flash_attention
 from tony_tpu.ops.rmsnorm import rms_norm
@@ -67,7 +67,7 @@ def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
     b, p = tokens.shape
     nkv, hd = config.n_kv_heads, config.head_dim
     cos, sin = rope_tables(config, cache_len)
-    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
+    x = embed_lookup(params["embed"], tokens, config)
 
     def body(x, layer):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
@@ -101,8 +101,7 @@ def decode_step(params: Params, config: LlamaConfig,
     cos, sin = rope_tables(config, cache_len)
     cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
     sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
-    x = jnp.take(params["embed"], token[:, None], axis=0).astype(
-        config.dtype)                                     # (B, 1, D)
+    x = embed_lookup(params["embed"], token[:, None], config)  # (B, 1, D)
     b = x.shape[0]
 
     def body(x, layer_and_cache):

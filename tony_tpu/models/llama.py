@@ -298,13 +298,32 @@ def _block(config: LlamaConfig, cos, sin, x, layer: Params):
     return constrain(x, ("batch", "seq", None))
 
 
+def embed_lookup(embed: jax.Array, tokens: jax.Array,
+                 config: LlamaConfig) -> jax.Array:
+    """Sharding-aware embedding lookup: (V, D) table x (B, S) ids ->
+    (B, S, D) in the compute dtype.
+
+    The table is stored ("vocab","embed") = (tp, fsdp); gathering from it
+    directly makes the SPMD partitioner inherit the operand's embed-dim
+    sharding on the output, and resharding THAT to ("batch","seq",None)
+    triggers XLA's "Involuntary full rematerialization" fallback (the
+    warning in MULTICHIP_r03's dense leg). Constraining the ids to the
+    batch layout and un-sharding the table's embed dim first (the
+    standard FSDP weight all-gather) flips the partitioner to its
+    masked-local-gather + all-reduce(tp) path: no replication, and the
+    collectives are the same shapes FSDP pays for every weight."""
+    tokens = constrain(tokens, ("batch", "seq"))
+    table = constrain(embed, ("vocab", None))
+    x = jnp.take(table, tokens, axis=0).astype(config.dtype)
+    return constrain(x, ("batch", "seq", None))
+
+
 def llama_hidden(params: Params, tokens: jax.Array,
                  config: LlamaConfig) -> jax.Array:
     """tokens: (B, S) int32 -> final-normed hidden states (B, S, dim)."""
     s = tokens.shape[1]
     cos, sin = rope_tables(config, s)
-    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
-    x = constrain(x, ("batch", "seq", None))
+    x = embed_lookup(params["embed"], tokens, config)
 
     block = partial(_block, config, cos, sin)
     if config.remat:
@@ -409,7 +428,7 @@ def llama_hidden_pipelined(params: Params, tokens: jax.Array,
                      staged_axes[k])
         for k, p in params["layers"].items()}
 
-    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
+    x = embed_lookup(params["embed"], tokens, config)
     # with a real sp axis the pipeline's manual region widens to {pp, sp}
     # and microbatches enter sequence-sharded, so the stage can run
     # ring/ulysses attention directly (shard_map cannot nest)

@@ -245,12 +245,13 @@ def _sparse_dispatch(xt, layer, gates, keep, position, capacity,
 def moe_hidden(params: Params, tokens: jax.Array, config: MoEConfig
                ) -> tuple[jax.Array, jax.Array]:
     """-> (final-normed hidden (B,S,D), total aux loss)."""
-    from tony_tpu.models.llama import attention_sublayer, rope_tables
+    from tony_tpu.models.llama import (
+        attention_sublayer, embed_lookup, rope_tables,
+    )
 
     s = tokens.shape[1]
     cos, sin = rope_tables(config, s)
-    x = jnp.take(params["embed"], tokens, axis=0).astype(config.dtype)
-    x = constrain(x, ("batch", "seq", None))
+    x = embed_lookup(params["embed"], tokens, config)
 
     def block(x, layer):
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
