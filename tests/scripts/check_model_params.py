@@ -1,0 +1,9 @@
+"""Fixture: the training task must see the parameters the preprocess
+stage printed (reference: ApplicationMaster.java:753-764 scrape into
+Constants.TASK_PARAM_KEY)."""
+import os
+import sys
+
+assert os.environ.get("MODEL_PARAMS") == "lr=0.01 layers=4", \
+    f"MODEL_PARAMS={os.environ.get('MODEL_PARAMS')!r}"
+sys.exit(0)

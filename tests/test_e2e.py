@@ -323,6 +323,35 @@ def test_single_node_mode(tmp_path):
     assert client.final_status == "SUCCEEDED", _dump_logs(client)
 
 
+def test_preprocess_model_params_reach_task_env(tmp_path):
+    """A prepare-stage job's 'Model parameters: ...' stdout line lands in
+    every training container's $MODEL_PARAMS (reference:
+    ApplicationMaster.java:753-764, Constants.java:84)."""
+    prep = tmp_path / "prep.py"
+    prep.write_text("print('some log line')\n"
+                    "print('Model parameters: lr=0.01 layers=4')\n"
+                    "print('another line')\n")
+    client = run_job(
+        tmp_path,
+        ["--executes", script("check_model_params.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.enable-preprocess=true",
+         "--conf", f"tony.am.command={sys.executable} {prep}"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+
+
+def test_preprocess_failure_fails_application(tmp_path):
+    """A nonzero prepare-stage exit short-circuits the app (reference:
+    doPreprocessingJob exit-code check, ApplicationMaster.java:746-751)."""
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.enable-preprocess=true",
+         "--conf", f"tony.am.command={sys.executable} -c 'import sys; sys.exit(3)'"])
+    assert client.final_status == "FAILED"
+
+
 def test_final_conf_artifact(tmp_path):
     """The frozen conf must ship every layer merged
     (reference: testTonyFinalConf, TestTonyE2E.java:457-482)."""

@@ -1,0 +1,131 @@
+"""GPU sampler tests (reference: TestGpuDiscoverer + TaskMonitor GPU
+metrics, GpuDiscoverer.java:43-209, TaskMonitor.java:116-170)."""
+
+import os
+import stat
+import textwrap
+
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.configuration import TonyConfiguration
+from tony_tpu.executor.gpu_metrics import (
+    MAX_REPEATED_ERRORS, GpuSampler, find_nvidia_smi, maybe_gpu_sampler,
+    parse_gpu_xml,
+)
+
+SAMPLE_XML = textwrap.dedent("""\
+    <?xml version="1.0" ?>
+    <nvidia_smi_log>
+      <attached_gpus>2</attached_gpus>
+      <gpu id="00000000:03:00.0">
+        <fb_memory_usage>
+          <total>16160 MiB</total>
+          <used>8080 MiB</used>
+          <free>8080 MiB</free>
+        </fb_memory_usage>
+        <bar1_memory_usage>
+          <total>16384 MiB</total>
+          <used>4096 MiB</used>
+        </bar1_memory_usage>
+        <utilization>
+          <gpu_util>90 %</gpu_util>
+          <memory_util>30 %</memory_util>
+        </utilization>
+      </gpu>
+      <gpu id="00000000:04:00.0">
+        <fb_memory_usage>
+          <total>16160 MiB</total>
+          <used>1616 MiB</used>
+          <free>14544 MiB</free>
+        </fb_memory_usage>
+        <bar1_memory_usage>
+          <total>16384 MiB</total>
+          <used>0 MiB</used>
+        </bar1_memory_usage>
+        <utilization>
+          <gpu_util>10 %</gpu_util>
+          <memory_util>1 %</memory_util>
+        </utilization>
+      </gpu>
+    </nvidia_smi_log>
+""")
+
+
+def fake_smi(tmp_path, body: str) -> str:
+    path = tmp_path / "nvidia-smi"
+    path.write_text(f"#!/bin/sh\n{body}\n")
+    path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    return str(path)
+
+
+def test_parse_gpu_xml():
+    gpus = parse_gpu_xml(SAMPLE_XML)
+    assert len(gpus) == 2
+    assert gpus[0].utilization_pct == 90.0
+    assert gpus[0].fb_pct == 50.0
+    assert gpus[0].bar1_pct == 25.0
+    assert gpus[1].utilization_pct == 10.0
+    assert gpus[1].fb_pct == 10.0
+    assert gpus[1].bar1_pct == 0.0
+
+
+def test_sampler_aggregates(tmp_path):
+    xml_file = tmp_path / "out.xml"
+    xml_file.write_text(SAMPLE_XML)
+    sampler = GpuSampler(fake_smi(tmp_path, f'cat "{xml_file}"'))
+    s = sampler()
+    assert s["util_max"] == 90.0
+    assert s["util_avg"] == 50.0
+    assert s["fb_pct_max"] == 50.0
+    assert s["fb_pct_avg"] == 30.0
+    assert s["main_pct_max"] == 25.0
+    assert s["main_pct_avg"] == 12.5
+
+
+def test_sampler_error_cap(tmp_path):
+    sampler = GpuSampler(fake_smi(tmp_path, "exit 9"))
+    for _ in range(MAX_REPEATED_ERRORS + 3):
+        assert sampler() == {}
+    assert sampler._errors == MAX_REPEATED_ERRORS  # capped, not unbounded
+
+
+def test_maybe_gpu_sampler_gating(tmp_path):
+    binary = fake_smi(tmp_path, "echo '<nvidia_smi_log/>'")
+    conf = TonyConfiguration()
+    # no gpus requested -> no sampler even with a binary available
+    conf.set(K.GPU_PATH_TO_EXEC, binary, "test")
+    assert maybe_gpu_sampler(conf, "worker") is None
+    # gpus requested + binary -> sampler
+    conf.set(K.gpus_key("worker"), 2, "test")
+    assert isinstance(maybe_gpu_sampler(conf, "worker"), GpuSampler)
+    # disabled by the reference's kill-switch key
+    conf.set(K.TASK_GPU_METRICS_ENABLED, False, "test")
+    assert maybe_gpu_sampler(conf, "worker") is None
+
+
+def test_find_nvidia_smi_override_must_be_executable(tmp_path):
+    plain = tmp_path / "not-exec"
+    plain.write_text("")
+    assert find_nvidia_smi(str(plain)) is None
+    assert find_nvidia_smi(fake_smi(tmp_path, "true")) is not None
+
+
+def test_monitor_reports_gpu_metrics(tmp_path):
+    from tony_tpu.executor.task_monitor import (
+        AVG_GPU_UTILIZATION, MAX_GPU_FB_MEMORY_USAGE, MAX_GPU_UTILIZATION,
+        TaskMonitor,
+    )
+
+    class _Client:
+        def update_metrics(self, *a):
+            pass
+
+    xml_file = tmp_path / "out.xml"
+    xml_file.write_text(SAMPLE_XML)
+    mon = TaskMonitor(_Client(), "worker", 0, pid_fn=lambda: os.getpid(),
+                      gpu_sampler=GpuSampler(
+                          fake_smi(tmp_path, f'cat "{xml_file}"')))
+    mon._sample_and_push()
+    named = {m["name"]: m["value"] for m in mon.snapshot()}
+    assert named[MAX_GPU_UTILIZATION] == 90.0
+    assert named[AVG_GPU_UTILIZATION] == 50.0
+    assert named[MAX_GPU_FB_MEMORY_USAGE] == 50.0

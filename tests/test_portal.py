@@ -316,3 +316,65 @@ def test_portal_accepts_bearer_and_query_token(secure_portal):
         assert json.loads(resp.read())[0]["application_id"] == "app_x"
     status, body = _get(secure_portal, "/config/app_x?token=sekrit-tok")
     assert status == 200 and "tony.am.memory" in body
+
+
+# ---------------------------------------------------------------------------
+# per-user named tokens (reference multi-tenant parity:
+# TonyPolicyProvider.java:23, TokenCache.java:44-72)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def multiuser_portal(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_alice", user="alice",
+                     config={"k": "va"})
+    make_app_history(inter, "app_bob", user="bob", config={"k": "vb"})
+    server = PortalServer(
+        PortalCache(inter, fin), port=0, host="127.0.0.1",
+        token="admin-tok",
+        user_tokens={"alice": "tok-alice", "bob": "tok-bob"})
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_portal_user_token_scopes_job_list(multiuser_portal):
+    """User A cannot list (or read) user B's jobs; admin sees all."""
+    status, body = _get(multiuser_portal, "/api/jobs?token=tok-alice")
+    jobs = json.loads(body)
+    assert status == 200
+    assert [j["application_id"] for j in jobs] == ["app_alice"]
+    status, body = _get(multiuser_portal, "/api/jobs?token=tok-bob")
+    assert [j["application_id"] for j in json.loads(body)] == ["app_bob"]
+    status, body = _get(multiuser_portal, "/api/jobs?token=admin-tok")
+    assert {j["application_id"] for j in json.loads(body)} == {
+        "app_alice", "app_bob"}
+    # the HTML index filters the same way
+    status, body = _get(multiuser_portal, "/?token=tok-alice")
+    assert "app_alice" in body and "app_bob" not in body
+
+
+def test_portal_user_token_cannot_read_others_job(multiuser_portal):
+    """Another user's job must 404 exactly like a missing one — a scoped
+    token must not even confirm existence."""
+    for path in ("/jobs/app_bob", "/config/app_bob", "/logs/app_bob",
+                 "/api/jobs/app_bob/config", "/api/jobs/app_bob/events"):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(multiuser_portal, f"{path}?token=tok-alice")
+        assert exc.value.code == 404, path
+    # while the owner reads it fine
+    status, body = _get(multiuser_portal,
+                        "/api/jobs/app_bob/config?token=tok-bob")
+    assert status == 200 and json.loads(body) == {"k": "vb"}
+    # and an unknown token is still unauthorized, not scoped-to-nothing
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(multiuser_portal, "/api/jobs?token=nope")
+    assert exc.value.code == 401
+
+
+def test_read_user_tokens(tmp_path):
+    from tony_tpu.portal.server import read_user_tokens
+    f = tmp_path / "users.txt"
+    f.write_text("# comment\nalice=tok-a\n\nbob = tok-b\nbad-line\n")
+    assert read_user_tokens(str(f)) == {"alice": "tok-a", "bob": "tok-b"}

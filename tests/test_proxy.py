@@ -175,3 +175,24 @@ def test_python_proxy_waits_for_late_upstream():
     finally:
         proxy.stop()
         t.join(timeout=10)
+
+
+def test_python_proxy_accepts_any_named_token(echo_server):
+    """Multi-principal auth: the proxy takes a set of named tokens and any
+    of them authenticates (portal scopes visibility; the proxy gates the
+    byte stream)."""
+    proxy = ProxyServer("127.0.0.1", echo_server,
+                        token=["tok-alice", "tok-bob"])
+    proxy.start()
+    try:
+        for tok in ("tok-alice", "tok-bob"):
+            with _conn(proxy.local_port) as s:
+                s.sendall(auth_preamble(tok) + b"hi")
+                s.shutdown(socket.SHUT_WR)
+                assert _recv_all(s) == b"HI"
+        with _conn(proxy.local_port) as s:
+            s.sendall(auth_preamble("tok-mallory") + b"hi")
+            s.shutdown(socket.SHUT_WR)
+            assert _recv_all(s) == b""
+    finally:
+        proxy.stop()

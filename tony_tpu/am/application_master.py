@@ -161,6 +161,7 @@ class ApplicationMaster(ClusterServiceHandler):
         self._registration_deadline: Optional[float] = None
         self._preprocess_exit_code = 0
         self._preprocess_finished = False
+        self._model_params: Optional[str] = None
         self._single_node = conf.get_bool(K.APPLICATION_SINGLE_NODE, False)
         # container bookkeeping: container_id -> (task, session_id at launch)
         self._launched: dict[str, tuple[Task, int]] = {}
@@ -301,6 +302,7 @@ class ApplicationMaster(ClusterServiceHandler):
         self._killed_by_client = False
         self._preprocess_exit_code = 0
         self._preprocess_finished = False
+        self._model_params: str | None = None
         self.session = TonySession(self.conf, session_id=self._session_id)
         self._session_containers.setdefault(self._session_id, [])
         self.scheduler = TaskScheduler(self.session, _Requestor(self.backend))
@@ -325,6 +327,15 @@ class ApplicationMaster(ClusterServiceHandler):
                         FinalStatus.FAILED,
                         f"preprocess exit {self._preprocess_exit_code}")
                 return ok
+            if self._preprocess_exit_code != 0:
+                # short-circuit BEFORE requesting containers (reference:
+                # doPreprocessingJob exit-code check feeds run()'s early
+                # return, ApplicationMaster.java:746-751)
+                self.session.set_final_status(
+                    FinalStatus.FAILED,
+                    f"Preprocess failed with exit code: "
+                    f"{self._preprocess_exit_code}")
+                return False
 
         self.scheduler.schedule_tasks()
         if not self.scheduler.dependency_check_passed:
@@ -480,8 +491,14 @@ class ApplicationMaster(ClusterServiceHandler):
     # ApplicationMaster.java:713-765): run the user command ON the AM host.
     # ------------------------------------------------------------------
     def _do_preprocessing_job(self, attempt: int) -> None:
-        command = self.conf.get_str("tony.task.command") or os.environ.get(
-            C.TASK_COMMAND, "")
+        # the AM's own command key first, so a prepare stage can run a
+        # different script than the training gang (reference:
+        # getExecuteCommandKey(AM_NAME) fallback chain,
+        # ApplicationMaster.java:738-739)
+        from tony_tpu.conf.keys import command_key
+        command = (self.conf.get_str(command_key("am"))
+                   or self.conf.get_str("tony.task.command")
+                   or os.environ.get(C.TASK_COMMAND, ""))
         if not command:
             LOG.warning("single-node/preprocess mode with no task command")
             self._preprocess_finished = True
@@ -507,9 +524,16 @@ class ApplicationMaster(ClusterServiceHandler):
             reservation = reserve_port()
             env[C.TB_PORT] = str(reservation.port)
             self._tb_url = f"http://{self.host}:{reservation.port}"
+        stdout_path = os.path.join(log_dir, "stdout")
+        scan_from = 0
         try:
-            with open(os.path.join(log_dir, "stdout"), "ab") as out, \
+            with open(stdout_path, "ab") as out, \
                     open(os.path.join(log_dir, "stderr"), "ab") as err:
+                # append mode: on an AM retry this file already holds the
+                # previous attempt's output — the scrape must only see
+                # THIS attempt's lines or a stale 'Model parameters:'
+                # value would win
+                scan_from = out.tell()
                 if reservation is not None:
                     reservation.release()  # user process binds it now
                 self._preprocess_exit_code = execute_shell(
@@ -518,7 +542,35 @@ class ApplicationMaster(ClusterServiceHandler):
         finally:
             if reservation is not None:
                 reservation.release()
+        if self._preprocess_exit_code == 0:
+            self._model_params = self._scrape_model_params(stdout_path,
+                                                           scan_from)
         self._preprocess_finished = True
+
+    @staticmethod
+    def _scrape_model_params(stdout_path: str,
+                             scan_from: int = 0) -> Optional[str]:
+        """Scan the preprocess job's stdout (from `scan_from`, i.e. this
+        attempt's output only) for a 'Model parameters: ' line; the
+        remainder of the first such line is injected into every training
+        container's env as $MODEL_PARAMS — how a prepare-stage job hands
+        computed parameters to the gang (reference:
+        ApplicationMaster.java:753-764, Constants.java:84)."""
+        try:
+            with open(stdout_path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                f.seek(scan_from)
+                for line in f:
+                    if C.MODEL_PARAMS_MARKER in line:
+                        params = line.split(C.MODEL_PARAMS_MARKER, 1)[1]
+                        params = params.rstrip("\n")
+                        LOG.info("preprocess published model parameters "
+                                 "(%d chars)", len(params))
+                        return params
+        except OSError as e:
+            LOG.warning("cannot scan preprocess stdout %s: %s",
+                        stdout_path, e)
+        return None
 
     # ------------------------------------------------------------------
     # backend callbacks
@@ -582,6 +634,10 @@ class ApplicationMaster(ClusterServiceHandler):
             **({C.TONY_CONF_URI: self._conf_uri} if self._conf_uri else {}),
             "PYTHONPATH": framework_pythonpath(),
         }
+        # preprocess-scraped parameters, visible to every task
+        # (ApplicationMaster.java:753-764)
+        if self._model_params is not None:
+            env[C.MODEL_PARAMS] = self._model_params
         # per-jobtype command override, else the global task command
         command = req.command or self.conf.get_str("tony.task.command") \
             or os.environ.get(C.TASK_COMMAND, "")

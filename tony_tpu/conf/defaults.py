@@ -26,6 +26,7 @@ NO_DEFAULT_KEYS = frozenset({
     K.KEYTAB_LOCATION,
     K.PORTAL_URL,
     K.PORTAL_TOKEN_FILE,
+    K.PORTAL_USER_TOKENS_FILE,
     K.HISTORY_STORE_LOCATION,
     K.SRC_DIR,
     K.PYTHON_VENV,
@@ -68,6 +69,11 @@ DEFAULTS = {
     K.TASK_MAX_MISSED_HEARTBEATS: 25,
     K.TASK_METRICS_INTERVAL_MS: 5000,
     K.TASK_LOW_UTIL_INTERVALS: 24,
+    # GPU sampling for `gpus` jobtypes (reference defaults: enabled, bare
+    # binary name resolved through the search dirs —
+    # TonyConfigurationKeys.java:152-154,273-274)
+    K.TASK_GPU_METRICS_ENABLED: True,
+    K.GPU_PATH_TO_EXEC: "",
     K.TASK_EXECUTOR_JVM_OPTS: "",
     # reference default constant 15 min (TonyConfigurationKeys.java:243-244)
     K.CONTAINER_ALLOCATION_TIMEOUT: 15 * 60 * 1000,

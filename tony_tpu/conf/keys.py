@@ -44,6 +44,10 @@ TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
 # consecutive ~0%-duty metric updates before a heartbeating task is
 # flagged as wedged (AM MetricsStore; 24 x 5s default = 2 min)
 TASK_LOW_UTIL_INTERVALS = "tony.task.low-utilization-intervals"
+# GPU sampling for `gpus` jobtypes (reference:
+# TonyConfigurationKeys.java:152,273-274 + GpuDiscoverer.java:43-209)
+TASK_GPU_METRICS_ENABLED = "tony.task.gpu-metrics.enabled"
+GPU_PATH_TO_EXEC = "tony.gpu-exec-path"
 TASK_EXECUTOR_JVM_OPTS = "tony.task.executor.jvm.opts"    # kept for parity; unused
 CONTAINER_ALLOCATION_TIMEOUT = "tony.container.allocation.timeout"  # ms
 CONTAINERS_RESOURCES = "tony.containers.resources"        # multi-value append key
@@ -76,6 +80,12 @@ PORTAL_CACHE_MAX_ENTRIES = "tony.portal.cache-max-entries"
 # sat behind YARN/Play auth filters; here the portal requires this token
 # in Authorization: Bearer or ?token= when configured)
 PORTAL_TOKEN_FILE = "tony.portal.token-file"
+# file of `user=token` lines: named per-user credentials whose job
+# visibility is scoped to that user's own jobs (the shared token-file
+# credential above stays the all-seeing admin). Multi-tenant identity in
+# place of the reference's Kerberos + service ACLs
+# (TonyPolicyProvider.java:23)
+PORTAL_USER_TOKENS_FILE = "tony.portal.user-tokens-file"
 # staging-store location the portal pulls finished history from (AMs on
 # other hosts publish jhist there; the reference's HDFS history dir)
 HISTORY_STORE_LOCATION = "tony.history.store-location"

@@ -22,6 +22,10 @@ APP_ID = "APP_ID"
 ATTEMPT_NUMBER = "ATTEMPT_NUMBER"    # reference: ApplicationMaster.java:369
 NUM_AM_RETRIES = "NUM_AM_RETRIES"    # reference: Constants.java:113-114
 TASK_COMMAND = "TASK_COMMAND"        # the user command this executor runs
+MODEL_PARAMS = "MODEL_PARAMS"        # preprocess-scraped params injected into
+                                     # every task env (Constants.java:84,
+                                     # ApplicationMaster.java:753-764)
+MODEL_PARAMS_MARKER = "Model parameters: "  # stdout line prefix the AM scans
 
 # ---------------------------------------------------------------------------
 # Framework bootstrap env (reference: TaskExecutor.java:161-207)

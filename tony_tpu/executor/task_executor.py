@@ -266,13 +266,15 @@ class TaskExecutor:
             return C.EXIT_FAILURE
         self._user_proc = launch_shell(self.task_command, extra_env=env,
                                        cwd=os.getcwd())
+        from tony_tpu.executor.gpu_metrics import maybe_gpu_sampler
         from tony_tpu.executor.task_monitor import default_tpu_sampler
         self.monitor = TaskMonitor(
             self.metrics_client, self.job_name, self.task_index,
             pid_fn=lambda: (self._user_proc.pid
                             if self._user_proc.poll() is None else None),
             interval_sec=self.metrics_interval_sec,
-            tpu_sampler=default_tpu_sampler)
+            tpu_sampler=default_tpu_sampler,
+            gpu_sampler=maybe_gpu_sampler(self.conf, self.job_name))
         self.monitor.start()
         rc = wait_or_kill(self._user_proc, timeout_sec)
         self.monitor.stop()

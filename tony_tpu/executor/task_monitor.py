@@ -34,6 +34,14 @@ AVG_TPU_HBM_BYTES = "AVG_TPU_HBM_BYTES"
 # current duty cycle — a monotonic MAX would hide any task that ran
 # healthy before stalling
 TPU_UTILIZATION = "TPU_UTILIZATION"
+# GPU jobtypes keep the reference's exact metric names
+# (Constants.java / TaskMonitor.java:34-46)
+MAX_GPU_UTILIZATION = "MAX_GPU_UTILIZATION"
+AVG_GPU_UTILIZATION = "AVG_GPU_UTILIZATION"
+MAX_GPU_FB_MEMORY_USAGE = "MAX_GPU_FB_MEMORY_USAGE"
+AVG_GPU_FB_MEMORY_USAGE = "AVG_GPU_FB_MEMORY_USAGE"
+MAX_GPU_MAIN_MEMORY_USAGE = "MAX_GPU_MAIN_MEMORY_USAGE"
+AVG_GPU_MAIN_MEMORY_USAGE = "AVG_GPU_MAIN_MEMORY_USAGE"
 
 
 def _proc_tree_rss_bytes(root_pid: int) -> int:
@@ -147,23 +155,46 @@ class _Stat:
         self.avg += (value - self.avg) / self.n
 
 
+class _GpuPlane:
+    """Running max-of-per-sample-max and avg-of-per-sample-avg, matching
+    the reference's setMaxMetrics/setAvgMetrics pair per GPU metric
+    (TaskMonitor.java:152-160)."""
+
+    def __init__(self):
+        self.max_stat = _Stat()
+        self.avg_stat = _Stat()
+
+    @property
+    def n(self) -> int:
+        return self.max_stat.n
+
+    def update(self, sample_max: float, sample_avg: float) -> None:
+        self.max_stat.update(sample_max)
+        self.avg_stat.update(sample_avg)
+
+
 class TaskMonitor:
     """Samples every `interval_sec` and pushes to the AM's metrics RPC."""
 
     def __init__(self, client: MetricsServiceClient, task_type: str,
                  index: int, pid_fn: Callable[[], Optional[int]],
                  interval_sec: float = 5.0,
-                 tpu_sampler: Optional[Callable[[], dict[str, float]]] = None):
+                 tpu_sampler: Optional[Callable[[], dict[str, float]]] = None,
+                 gpu_sampler: Optional[Callable[[], dict[str, float]]] = None):
         self._client = client
         self._task_type = task_type
         self._index = index
         self._pid_fn = pid_fn
         self._interval = interval_sec
         self._tpu_sampler = tpu_sampler
+        self._gpu_sampler = gpu_sampler
         self._mem = _Stat()
         self._tpu_util = _Stat()
         self._tpu_util_last: Optional[float] = None
         self._tpu_hbm = _Stat()
+        self._gpu_util = _GpuPlane()
+        self._gpu_fb = _GpuPlane()
+        self._gpu_main = _GpuPlane()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="task-monitor",
                                         daemon=True)
@@ -192,6 +223,21 @@ class TaskMonitor:
         if self._tpu_util_last is not None:
             metrics.append({"name": TPU_UTILIZATION,
                             "value": self._tpu_util_last})
+        if self._gpu_util.n:
+            metrics += [
+                {"name": MAX_GPU_UTILIZATION,
+                 "value": self._gpu_util.max_stat.max},
+                {"name": AVG_GPU_UTILIZATION,
+                 "value": self._gpu_util.avg_stat.avg},
+                {"name": MAX_GPU_FB_MEMORY_USAGE,
+                 "value": self._gpu_fb.max_stat.max},
+                {"name": AVG_GPU_FB_MEMORY_USAGE,
+                 "value": self._gpu_fb.avg_stat.avg},
+                {"name": MAX_GPU_MAIN_MEMORY_USAGE,
+                 "value": self._gpu_main.max_stat.max},
+                {"name": AVG_GPU_MAIN_MEMORY_USAGE,
+                 "value": self._gpu_main.avg_stat.avg},
+            ]
         return metrics
 
     def _run(self) -> None:
@@ -219,6 +265,16 @@ class TaskMonitor:
             except Exception:  # noqa: BLE001 — metrics must never kill a task
                 self._tpu_util_last = None   # no current sample this interval
                 LOG.exception("tpu sampler failed")
+        if self._gpu_sampler is not None:
+            try:
+                g = self._gpu_sampler()
+                if g:
+                    self._gpu_util.update(g["util_max"], g["util_avg"])
+                    self._gpu_fb.update(g["fb_pct_max"], g["fb_pct_avg"])
+                    self._gpu_main.update(g["main_pct_max"],
+                                          g["main_pct_avg"])
+            except Exception:  # noqa: BLE001 — metrics must never kill
+                LOG.exception("gpu sampler failed")
         try:
             self._client.update_metrics(self._task_type, self._index,
                                         self.snapshot())

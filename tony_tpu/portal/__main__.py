@@ -18,7 +18,7 @@ from tony_tpu.portal.cache import PortalCache
 from tony_tpu.portal.fetcher import HistoryStoreFetcher
 from tony_tpu.portal.mover import HistoryFileMover, ensure_history_dirs
 from tony_tpu.portal.purger import HistoryFilePurger
-from tony_tpu.portal.server import PortalServer
+from tony_tpu.portal.server import PortalServer, read_user_tokens
 
 
 def main(argv=None) -> int:
@@ -30,6 +30,10 @@ def main(argv=None) -> int:
     parser.add_argument("--token-file", default=None,
                         help="bearer token file gating all routes "
                              "(overrides tony.portal.token-file)")
+    parser.add_argument("--user-tokens-file", default=None,
+                        help="file of user=token lines; each token sees "
+                             "only that user's jobs "
+                             "(overrides tony.portal.user-tokens-file)")
     parser.add_argument("--history-store", default=None,
                         help="staging-store location (gs:// or shared dir) "
                              "to pull off-host AMs' finished history from "
@@ -65,7 +69,16 @@ def main(argv=None) -> int:
             token = f.read().strip()
         if not token:
             raise SystemExit(f"empty portal token file: {token_file}")
-    server = PortalServer(cache, port=port, token=token)
+    user_tokens = {}
+    user_tokens_file = (args.user_tokens_file
+                        or conf.get_str(K.PORTAL_USER_TOKENS_FILE))
+    if user_tokens_file:
+        user_tokens = read_user_tokens(user_tokens_file)
+        if not user_tokens:
+            raise SystemExit(
+                f"empty portal user-tokens file: {user_tokens_file}")
+    server = PortalServer(cache, port=port, token=token,
+                          user_tokens=user_tokens)
     fetcher = None
     store_location = args.history_store or conf.get_str(
         K.HISTORY_STORE_LOCATION)

@@ -32,6 +32,21 @@ th{{background:#eee}}a{{text-decoration:none}}
 </style></head><body><h2>{title}</h2>{body}</body></html>"""
 
 
+def read_user_tokens(path: str) -> dict[str, str]:
+    """Parse a `user=token`-per-line credentials file (blank lines and
+    #-comments ignored) — the tony.portal.user-tokens-file format."""
+    out: dict[str, str] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            user, sep, tok = line.partition("=")
+            if sep and user.strip() and tok.strip():
+                out[user.strip()] = tok.strip()
+    return out
+
+
 def _table(headers: list[str], rows: list[list[str]]) -> str:
     head = "".join(f"<th>{h}</th>" for h in headers)
     body = "".join(
@@ -51,6 +66,13 @@ def _fmt_ts(ms: int) -> str:
 class _Handler(BaseHTTPRequestHandler):
     cache: PortalCache  # injected by PortalServer
     token: Optional[str] = None  # injected by PortalServer; None = open
+    # named per-user tokens (tony.portal.user-tokens); a match scopes job
+    # visibility to that user's own jobs, while the shared `token` above
+    # stays the all-seeing admin credential. This is the multi-tenant
+    # identity layer the reference got from Kerberos + service ACLs
+    # (TonyPolicyProvider.java:23, TokenCache.java:44-72) re-based on the
+    # rebuild's token scheme.
+    user_tokens: dict[str, str] = {}
 
     # -- plumbing ----------------------------------------------------------
     def log_message(self, fmt, *args):  # route through logging, not stderr
@@ -76,7 +98,8 @@ class _Handler(BaseHTTPRequestHandler):
         `Authorization: Bearer <tok>` or `?token=<tok>` against the
         configured portal token. Job configs can embed user env k=v pairs
         (tony.execution.env), so every data route is gated."""
-        if self.token is None:
+        self._auth_user: Optional[str] = None   # None = admin / open
+        if self.token is None and not self.user_tokens:
             return True
         supplied = ""
         via_query = False
@@ -89,12 +112,25 @@ class _Handler(BaseHTTPRequestHandler):
             via_query = True
         # byte compare: compare_digest raises TypeError on non-ASCII str
         # operands, which a scanner's %C3%A9-style token would trigger
-        ok = secrets.compare_digest(supplied.encode("utf-8", "replace"),
-                                    self.token.encode())
+        supplied_b = supplied.encode("utf-8", "replace")
+        ok = self.token is not None and secrets.compare_digest(
+            supplied_b, self.token.encode())
+        # check EVERY named token even after a match so response timing
+        # doesn't depend on which user's token was supplied
+        for user, tok in self.user_tokens.items():
+            if secrets.compare_digest(supplied_b, tok.encode()) and not ok:
+                self._auth_user = user
+                ok = True
         # query-authenticated browsers don't resend the token on link
         # clicks — propagate it into generated page links
         self._link_qs = f"?token={supplied}" if ok and via_query else ""
         return ok
+
+    def _visible(self, owner: Optional[str]) -> bool:
+        """Owner scoping: admin (or open portal) sees everything; a named
+        user sees only jobs whose history records them as the user.
+        Callers pass the owner they already hold — no metadata refetch."""
+        return self._auth_user is None or owner == self._auth_user
 
     # -- routing -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
@@ -114,7 +150,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._api(parts[1:])
             if len(parts) == 2 and parts[0] in ("jobs", "config", "logs"):
                 job_id = parts[1]
-                if self.cache.get_metadata(job_id) is None:
+                md = self.cache.get_metadata(job_id)
+                # another user's job 404s identically to a missing one:
+                # a scoped token must not even confirm existence
+                if md is None or not self._visible(md.user):
                     return self._html("not found",
                                       f"<p>no such job {html.escape(job_id)}</p>",
                                       404)
@@ -126,9 +165,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _api(self, parts: list[str]) -> None:
         if parts == ["jobs"]:
-            return self._json(self.cache.metadata_dicts())
+            return self._json([d for d in self.cache.metadata_dicts()
+                               if self._visible(d["user"])])
         if len(parts) == 3 and parts[0] == "jobs":
             job_id, what = parts[1], parts[2]
+            md = self.cache.get_metadata(job_id)
+            if md is None or not self._visible(md.user):
+                return self._json({"error": "not found"}, 404)
             if what == "events":
                 return self._json(self.cache.get_events(job_id))
             if what == "config":
@@ -142,6 +185,8 @@ class _Handler(BaseHTTPRequestHandler):
         rows = []
         qs = getattr(self, "_link_qs", "")
         for m in self.cache.list_metadata():
+            if not self._visible(m.user):
+                continue
             app = html.escape(m.application_id)
             rows.append([
                 f'<a href="/jobs/{app}{qs}">{app}</a>',
@@ -190,10 +235,12 @@ class PortalServer:
     """Owns the HTTP server plus the mover/purger daemons."""
 
     def __init__(self, cache: PortalCache, port: int = 0,
-                 host: str = "0.0.0.0", token: Optional[str] = None):
+                 host: str = "0.0.0.0", token: Optional[str] = None,
+                 user_tokens: Optional[dict[str, str]] = None):
         self.cache = cache
         handler = type("BoundHandler", (_Handler,),
-                       {"cache": cache, "token": token})
+                       {"cache": cache, "token": token,
+                        "user_tokens": dict(user_tokens or {})})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -117,13 +117,23 @@ def _pump(src: socket.socket, dst: socket.socket) -> None:
             pass
 
 
-def _check_http_auth(buf: bytes, token: str) -> bool:
+def _token_match(supplied: bytes, tokens: tuple[str, ...]) -> bool:
+    """Constant-time compare against EVERY accepted token (named per-user
+    credentials + the shared one) — no early exit, so timing doesn't
+    reveal which entry matched."""
+    import hmac
+    ok = False
+    for tok in tokens:
+        if hmac.compare_digest(supplied, tok.encode()):
+            ok = True
+    return ok
+
+
+def _check_http_auth(buf: bytes, tokens: tuple[str, ...]) -> bool:
     """First-block HTTP auth: ?token= in the request line or an
     Authorization: Bearer header. All comparisons on BYTES —
     hmac.compare_digest raises TypeError for non-ASCII str operands, so a
     garbage token from a scanner must never reach a str compare."""
-    import hmac
-    tok = token.encode()
     head = buf.split(b"\r\n\r\n", 1)[0]
     lines = head.split(b"\r\n")
     request_line = lines[0]
@@ -134,18 +144,18 @@ def _check_http_auth(buf: bytes, token: str) -> bool:
             # a proxy-distinct param name: plain ?token= belongs to the
             # PROXIED app (Jupyter's login token uses it) — claiming it
             # would both collide with and shadow the app's own auth
-            if k == b"tony-proxy-token" and hmac.compare_digest(v, tok):
+            if k == b"tony-proxy-token" and _token_match(v, tokens):
                 return True
     for ln in lines[1:]:
         if ln.lower().startswith(b"authorization:"):
             value = ln.split(b":", 1)[1].strip()
-            if value.startswith(b"Bearer ") and hmac.compare_digest(
-                    value[len(b"Bearer "):].strip(), tok):
+            if value.startswith(b"Bearer ") and _token_match(
+                    value[len(b"Bearer "):].strip(), tokens):
                 return True
     return False
 
 
-def _authenticate(conn: socket.socket, token: str,
+def _authenticate(conn: socket.socket, tokens: tuple[str, ...],
                   grace: bool = False) -> tuple[bytes, bool] | None:
     """Read until an auth decision. Returns (bytes_to_forward,
     credentials_verified) or None to reject.
@@ -154,7 +164,6 @@ def _authenticate(conn: socket.socket, token: str,
     a preamble line, if present, is still consumed and verified rather
     than relayed upstream as payload (it contains the token!); verifying
     it is what slides the unlock window."""
-    import hmac
 
     def _bare(buf: bytes):
         # never bare-relay a (partial) preamble: it carries token bytes
@@ -184,14 +193,14 @@ def _authenticate(conn: socket.socket, token: str,
                     continue
                 line, _, rest = buf.partition(b"\n")
                 supplied = line[len(_AUTH_PREAMBLE):].strip(b"\r")
-                return (rest, True) if hmac.compare_digest(
-                    supplied, token.encode()) else None
+                return (rest, True) if _token_match(supplied, tokens) \
+                    else None
             if grace:
                 return (buf, False)   # bare relay, no credentials needed
             if b"\n" in buf and (b"\r\n\r\n" in buf
                                  or len(buf) >= _AUTH_MAX):
                 # HTTP mode: full header block (or cap) reached
-                return (buf, True) if _check_http_auth(buf, token) \
+                return (buf, True) if _check_http_auth(buf, tokens) \
                     else None
         return None
     except OSError:
@@ -210,9 +219,16 @@ class ProxyServer:
 
     def __init__(self, remote_host: str, remote_port: int,
                  local_port: int = 0, local_host: str = "127.0.0.1",
-                 token: str | None = None, connect_wait_sec: float = 10.0):
+                 token: "str | list[str] | tuple[str, ...] | None" = None,
+                 connect_wait_sec: float = 10.0):
         self._remote = (remote_host, remote_port)
-        self._token = token
+        # one shared secret or a set of named per-user tokens — any
+        # accepted entry authenticates (TonyPolicyProvider.java:23
+        # multi-principal parity; the portal scopes visibility, the proxy
+        # only gates the byte stream)
+        self._token: tuple[str, ...] | None = (
+            (token,) if isinstance(token, str) else
+            tuple(token) if token else None)
         self._connect_wait = connect_wait_sec
         self._unlocked: dict[str, float] = {}   # grace key -> expiry
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
