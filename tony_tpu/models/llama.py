@@ -195,7 +195,10 @@ def llama_param_axes(config: LlamaConfig) -> Params:
 def _attention_dispatch(q, k, v, config: LlamaConfig):
     """Sequence-parallel attention (ring or ulysses per config.sp_mode)
     when the ambient mesh shards the sequence axis, flash attention
-    otherwise."""
+    otherwise. The pallas kernels themselves handle multi-chip meshes by
+    running inside their own batch/heads shard_map (ops/attention.py
+    _kernel_shard_axes) — a Mosaic custom call cannot be partitioned by
+    XLA's Auto partitioner."""
     from tony_tpu.ops.vma import manual_axes_of_context
 
     mesh = jax.sharding.get_abstract_mesh()
@@ -217,14 +220,31 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
         if "sp" in manual_axes_of_context():
             # already inside a manual-sp region (the pp pipeline widens
             # its shard_map to {pp, sp}): call the collective attention
-            # DIRECTLY — shard_map does not nest inside a manual region
+            # DIRECTLY — the kernel dispatch (ops/attention.py
+            # _shard_kernel_call) handles any remaining Auto axes
             return inner(q, k, v)
-        # partial-manual over sp ONLY: batch/heads stay Auto so their
-        # sharding constraints keep working
-        spec = jax.sharding.PartitionSpec(None, None, "sp")
+        # manual over the WHOLE mesh: the per-chunk flash is a Mosaic
+        # call, and jax only lowers those in a fully-manual context
+        # (ops/attention.py _shard_kernel_call). Batch rides (dp, fsdp),
+        # heads ride tp, sequence rides sp; axes the operands don't
+        # shard on are left unmentioned (replicated — Auto semantics)
+        from tony_tpu.ops.attention import _kernel_shard_axes
+        batch_axes, tp_axes = _kernel_shard_axes(q.shape[0], q.shape[1],
+                                                 k.shape[1])
+        if tp_axes and config.sp_mode == "ulysses":
+            # ulysses splits the LOCAL head count over sp; pre-sharding
+            # heads over tp tightens its divisibility to (H/tp) % sp —
+            # fall back to replicated heads when that fails rather than
+            # raising on a config the un-tp'd path accepted
+            tp = mesh.shape["tp"]
+            if (q.shape[1] // tp) % sp != 0:
+                tp_axes = ()
+        spec = jax.sharding.PartitionSpec(
+            batch_axes if batch_axes else None,
+            "tp" if tp_axes else None, "sp")
         f = jax.shard_map(
             inner, in_specs=(spec, spec, spec), out_specs=spec,
-            axis_names={"sp"})
+            axis_names=set(mesh.axis_names))
         return f(q, k, v)
     return flash_attention(q, k, v, True)
 

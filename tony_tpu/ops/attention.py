@@ -131,6 +131,89 @@ def _kv_row_map(h: int, hk: int):
     return row
 
 
+def _kernel_shard_axes(batch_dim: int, nh: int, nkv: int):
+    """Mesh axes the flash kernels must be manually mapped over on a
+    multi-chip mesh: batch over (dp, fsdp), heads over tp. A Mosaic
+    custom call CANNOT be split by XLA's Auto partitioner ("Mosaic
+    kernels cannot be automatically partitioned" — surfaced by the v5p
+    AOT compile, tools/aot_8b.py), so the kernel runs inside a shard_map
+    over exactly these axes with purely local shards; attention is
+    embarrassingly parallel across batch and heads, so no collectives
+    are introduced. Axes already Manual in the ambient context (sp/pp in
+    the ring or pipeline paths) and axes that don't divide the operand
+    dims are excluded."""
+    from tony_tpu.ops.vma import manual_axes_of_context
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return (), ()
+    manual = manual_axes_of_context()
+    present = tuple(a for a in ("dp", "fsdp")
+                    if mesh.shape.get(a, 1) > 1 and a not in manual)
+
+    def _divides(axes):
+        prod = 1
+        for a in axes:
+            prod *= mesh.shape[a]
+        return batch_dim % prod == 0
+
+    # largest divisible subset, not all-or-nothing: a small eval/decode
+    # batch on a big fsdp mesh should still shard over whatever divides
+    # (fsdp preferred — it's the bigger axis in every plan) instead of
+    # silently all-gathering the batch to every chip
+    options = [present] + [(a,) for a in reversed(present)]
+    batch_axes = next((o for o in options if o and _divides(o)), ())
+    tp = mesh.shape.get("tp", 1)
+    tp_axes = ("tp",) if (tp > 1 and "tp" not in manual
+                          and nh % tp == 0 and nkv % tp == 0) else ()
+    return batch_axes, tp_axes
+
+
+def _shard_kernel_call(fn, args, n_in: int, n_out: int):
+    """Run `fn(*args)` so the Mosaic kernel never needs Auto
+    partitioning. jax's tpu_custom_call lowering REQUIRES the manual
+    context to cover EVERY mesh axis (tpu_custom_call.py:339-346 — any
+    partially-manual context raises "Mosaic kernels cannot be
+    automatically partitioned", even over size-1 axes; surfaced by the
+    v5p AOT compile, tools/aot_8b.py). Three regimes:
+
+    - no mesh, or a region already manual over ALL axes (the ring
+      dispatch widens its region to the full mesh): plain dispatch —
+      the kernel lowers as a purely local call;
+    - top level of a multi-axis mesh: wrap the WHOLE dispatch (pallas +
+      blockwise branches) in a shard_map over EVERY mesh axis — batch
+      dims ride (dp, fsdp), heads ride tp, all other axes are
+      unmentioned in the specs (operands replicated over them, exactly
+      the Auto semantics). This sits inside the custom_vjp rules, so AD
+      never differentiates through the shard_map;
+    - inside a PARTIAL manual region (a pipeline stage manual over
+      pp / pp+sp, whose remaining Auto axes cannot legally host a
+      nested manual computation): force the blockwise branch — plain
+      jnp that the Auto partitioner splits fine. Correct everywhere; a
+      perf (not correctness) cost limited to multi-chip pipeline
+      stages."""
+    from tony_tpu.ops.vma import manual_axes_of_context
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names or mesh.size == 1:
+        return fn(*args)
+    manual = manual_axes_of_context()
+    if manual:
+        if set(manual) == set(mesh.axis_names):
+            return fn(*args)
+        return fn(*args, force="blockwise")
+    q, k = args[0], args[1]
+    batch_axes, tp_axes = _kernel_shard_axes(q.shape[0], q.shape[1],
+                                             k.shape[1])
+    spec = jax.P(batch_axes if batch_axes else None,
+                 "tp" if tp_axes else None)
+    f = jax.shard_map(
+        fn, in_specs=(spec,) * n_in,
+        out_specs=tuple(spec for _ in range(n_out)),
+        axis_names=set(mesh.axis_names))
+    return f(*args)
+
+
 def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                     kv_len=None):
     from jax.experimental import pallas as pl
@@ -470,12 +553,17 @@ def _forward(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
     blockwise_fwd = functools.partial(
         _blockwise_forward, causal=causal, sm_scale=sm_scale,
         block_k=block_k, kv_len=kv_len)
-    if _FORCE == "pallas":
-        return pallas_fwd(q, k, v)
-    if _FORCE == "blockwise":
-        return blockwise_fwd(q, k, v)
-    return lax.platform_dependent(q, k, v, tpu=pallas_fwd,
-                                  default=blockwise_fwd)
+
+    def dispatch(qs, ks, vs, force=""):
+        eff = force or _FORCE
+        if eff == "pallas":
+            return pallas_fwd(qs, ks, vs)
+        if eff == "blockwise":
+            return blockwise_fwd(qs, ks, vs)
+        return lax.platform_dependent(qs, ks, vs, tpu=pallas_fwd,
+                                      default=blockwise_fwd)
+
+    return _shard_kernel_call(dispatch, (q, k, v), 3, 2)
 
 
 def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
@@ -500,12 +588,17 @@ def _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale, block_q,
         *a, causal, sm_scale, block_q, block_k, kv_len)
     blockwise_bwd = lambda *a: _blockwise_backward(    # noqa: E731
         *a, causal, sm_scale, block_k, kv_len=kv_len)
-    if _FORCE == "pallas":
-        return pallas_bwd(q, k, v, out, lse, g)
-    if _FORCE == "blockwise":
-        return blockwise_bwd(q, k, v, out, lse, g)
-    return lax.platform_dependent(q, k, v, out, lse, g, tpu=pallas_bwd,
-                                  default=blockwise_bwd)
+
+    def dispatch(*a, force=""):
+        eff = force or _FORCE
+        if eff == "pallas":
+            return pallas_bwd(*a)
+        if eff == "blockwise":
+            return blockwise_bwd(*a)
+        return lax.platform_dependent(*a, tpu=pallas_bwd,
+                                      default=blockwise_bwd)
+
+    return _shard_kernel_call(dispatch, (q, k, v, out, lse, g), 6, 3)
 
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, kv_len, residuals, g):

@@ -120,6 +120,43 @@ def make_partition_spec(logical_tree: Any, rules=DEFAULT_RULES,
     )
 
 
+def opt_state_specs(opt_state_tree: Any, param_specs: Any) -> Any:
+    """PartitionSpecs for an optax state tree, derived structurally from
+    the params' specs: any state leaf whose key-path SUFFIX matches a
+    parameter's path (f32 masters, Adam mu/nu — optax state mirrors the
+    param treedef) gets that parameter's spec; everything else (step
+    counts, scalars) replicates.
+
+    Why explicit specs instead of relying on jit propagation: XLA's
+    sharding propagation is free to leave `optimizer.init` outputs
+    replicated (observed on the v5p-32 AOT compile, tools/aot_8b.py —
+    the Adam moments came out replicated, 64 GB/chip at 8B where the
+    sharded plan needs 4 GB). At 8B this is the difference between
+    fitting and OOM, so the trainer pins init's out_shardings with
+    these."""
+    from jax.tree_util import (
+        tree_flatten_with_path, tree_unflatten,
+    )
+
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    spec_leaves, _ = tree_flatten_with_path(param_specs, is_leaf=is_spec)
+    by_path = {tuple(str(k) for k in path): spec
+               for path, spec in spec_leaves}
+    leaves, treedef = tree_flatten_with_path(opt_state_tree)
+    out = []
+    for path, leaf in leaves:
+        keys = tuple(str(k) for k in path)
+        spec = P()
+        for i in range(len(keys)):
+            cand = by_path.get(keys[i:])
+            if cand is not None and len(cand) <= getattr(
+                    leaf, "ndim", len(getattr(leaf, "shape", ()))):
+                spec = cand
+                break
+        out.append(spec)
+    return tree_unflatten(treedef, out)
+
+
 def shard_pytree(tree: Any, logical_tree: Any, mesh: Mesh,
                  rules=DEFAULT_RULES) -> Any:
     """Device-put a pytree of arrays with NamedShardings derived from its

@@ -138,11 +138,26 @@ class Trainer:
             params = jax.device_put(
                 params, NamedSharding(self.mesh, PartitionSpec()))
         self.params = params
-        # jit the optimizer init so the Adam moments inherit the params'
-        # shardings (zeros_like propagates sharding) instead of landing
-        # replicated — at 8B that's the difference between fitting and OOM
+        # explicit out_shardings on the optimizer init: propagation alone
+        # may leave the masters/Adam moments replicated (observed on the
+        # v5p AOT compile) — at 8B that's the difference between fitting
+        # and OOM
+        from jax.sharding import NamedSharding as NS
+        from tony_tpu.parallel.sharding import (
+            make_partition_spec, opt_state_specs,
+        )
+        if self.param_axes is not None:
+            pspecs = make_partition_spec(self.param_axes, mesh=self.mesh)
+        else:
+            from jax.sharding import PartitionSpec
+            pspecs = jax.tree.map(lambda _: PartitionSpec(), self.params)
+        ospecs = opt_state_specs(
+            jax.eval_shape(self.optimizer.init, self.params), pspecs)
         with jax.set_mesh(self.mesh):
-            opt_state = jax.jit(self.optimizer.init)(self.params)
+            opt_state = jax.jit(
+                self.optimizer.init,
+                out_shardings=jax.tree.map(
+                    lambda s: NS(self.mesh, s), ospecs))(self.params)
         self.opt_state = opt_state
         if resume is not None:
             # template restore: each target shard reads only the saved
