@@ -394,8 +394,8 @@ def llama_pipeline_param_axes(config: LlamaConfig) -> Params:
 
 
 def llama_hidden_pipelined(params: Params, tokens: jax.Array,
-                           config: LlamaConfig, mesh, n_micro: int
-                           ) -> jax.Array:
+                           config: LlamaConfig, mesh, n_micro: int,
+                           n_virtual: int = 1) -> jax.Array:
     """Pipeline-parallel backbone up to the final norm (head applied by the
     caller, so the loss path can use the fused chunked CE).
 
@@ -415,8 +415,9 @@ def llama_hidden_pipelined(params: Params, tokens: jax.Array,
     pp = dict(mesh.shape).get("pp", 1)
     sp = dict(mesh.shape).get("sp", 1)
     L = config.n_layers
-    if L % pp != 0:
-        raise ValueError(f"n_layers {L} not divisible by pp={pp}")
+    if L % (pp * n_virtual) != 0:
+        raise ValueError(f"n_layers {L} not divisible by "
+                         f"pp*n_virtual={pp}*{n_virtual}")
 
     def stage_fn(stage_layers, x):
         # rope tables are computed (cheaply) INSIDE the stage so they are
@@ -441,12 +442,19 @@ def llama_hidden_pipelined(params: Params, tokens: jax.Array,
                         x, stage_layers)
         return x
 
-    # (L, ...) -> (pp, L/pp, ...): stage dim on pp, inner dims fsdp/tp
+    # (L, ...) -> (pp*v, L/(pp*v), ...): stage dim on pp, inner dims
+    # fsdp/tp. For the interleaved schedule (v > 1) the chunks are laid
+    # out so PartitionSpec('pp') hands device d its round-robin virtual
+    # stages [d, pp+d, ...] (interleave_stage_dim)
+    from tony_tpu.parallel.pipeline import interleave_stage_dim
+    n_chunks = pp * n_virtual
     staged_axes = llama_pipeline_param_axes(config)
-    staged_layers = {
-        k: constrain(p.reshape((pp, L // pp) + p.shape[1:]),
-                     staged_axes[k])
-        for k, p in params["layers"].items()}
+    staged_layers = {}
+    for k, p in params["layers"].items():
+        stacked = p.reshape((n_chunks, L // n_chunks) + p.shape[1:])
+        if n_virtual > 1:
+            stacked = interleave_stage_dim(stacked, pp, n_virtual)
+        staged_layers[k] = constrain(stacked, staged_axes[k])
 
     x = embed_lookup(params["embed"], tokens, config)
     # with a real sp axis the pipeline's manual region widens to {pp, sp}
@@ -455,27 +463,30 @@ def llama_hidden_pipelined(params: Params, tokens: jax.Array,
     extra = ("sp",) if sp > 1 else ()
     mb_spec = P(None, None, "sp") if sp > 1 else P()
     pipe = make_pipelined_fn(stage_fn, mesh, n_micro=n_micro,
-                             extra_manual=extra, mb_spec=mb_spec)
+                             extra_manual=extra, mb_spec=mb_spec,
+                             n_virtual=n_virtual)
     x = pipe(staged_layers, x)
     return rms_norm(x, params["final_norm"], config.norm_eps)
 
 
 def llama_forward_pipelined(params: Params, tokens: jax.Array,
-                            config: LlamaConfig, mesh, n_micro: int
-                            ) -> jax.Array:
+                            config: LlamaConfig, mesh, n_micro: int,
+                            n_virtual: int = 1) -> jax.Array:
     """Pipelined forward -> logits (B, S, vocab) f32 (parity surface for
     tests; training uses llama_loss_pipelined which skips full logits when
     config.xent_chunk is set)."""
-    x = llama_hidden_pipelined(params, tokens, config, mesh, n_micro)
+    x = llama_hidden_pipelined(params, tokens, config, mesh, n_micro,
+                               n_virtual=n_virtual)
     return jnp.einsum("bsd,dv->bsv", x, params["output"],
                       preferred_element_type=jnp.float32)
 
 
 def llama_loss_pipelined(params: Params, batch: dict[str, jax.Array],
-                         config: LlamaConfig, mesh,
-                         n_micro: int) -> jax.Array:
+                         config: LlamaConfig, mesh, n_micro: int,
+                         n_virtual: int = 1) -> jax.Array:
     inputs, targets = unpack_lm_batch(batch)
-    x = llama_hidden_pipelined(params, inputs, config, mesh, n_micro)
+    x = llama_hidden_pipelined(params, inputs, config, mesh, n_micro,
+                               n_virtual=n_virtual)
     return _head_loss(x, params, targets, config)
 
 

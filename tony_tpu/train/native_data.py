@@ -132,7 +132,14 @@ def token_batches(path: str, batch: int, seq: int, seed: int = 0,
         lib = _load_lib()
         if lib is not None:
             try:
-                return iter(_NativeLoader(lib, path, batch, seq, seed))
+                loader = _NativeLoader(lib, path, batch, seq, seed)
+                # load-bearing marker: the orchestrated flagship e2e
+                # greps container logs for it to prove the native
+                # double-buffer thread ran in the executor-launched
+                # process, not the numpy fallback
+                LOG.info("native prefetching loader active: %s "
+                         "(double-buffer thread, seed %d)", path, seed)
+                return iter(loader)
             except OSError:
                 LOG.warning("native loader rejected %s; numpy fallback",
                             path)
