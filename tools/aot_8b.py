@@ -103,8 +103,16 @@ def main() -> int:
                 (BATCH, SEQ), jnp.int32,
                 sharding=NamedSharding(mesh, batch_spec)),
         }
-        step = make_train_step(partial(llama_loss, config=config),
-                               optimizer, jit=False,
+        if mesh_kwargs.get("pp", 1) > 1:
+            # pipeline-parallel compile check: the pp path (1F1B custom
+            # backward, blockwise attention inside the manual stage) had
+            # only ever lowered for CPU before this
+            from tony_tpu.models.llama import llama_loss_pipelined
+            loss_fn = partial(llama_loss_pipelined, config=config,
+                              mesh=mesh, n_micro=4)
+        else:
+            loss_fn = partial(llama_loss, config=config)
+        step = make_train_step(loss_fn, optimizer, jit=False,
                                emit_accum_dtype=True)
         print("[aot] lowering + compiling the full train step "
               "(fwd+bwd+adamw, donated state)...", file=sys.stderr)
