@@ -51,6 +51,10 @@ def main() -> int:
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=0,
                         help="0 = the preset's max_seq")
+    parser.add_argument("--n-layers", type=int, default=0,
+                        help="override the preset's layer count (0 = "
+                             "preset; pipelining needs n_layers %% "
+                             "(pp*virtual) == 0)")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="microbatch gradient-accumulation steps")
     parser.add_argument("--eval-every", type=int, default=0,
@@ -61,10 +65,18 @@ def main() -> int:
     parser.add_argument("--checkpoint-every", type=int, default=0)
     parser.add_argument("--data", default="",
                         help="raw int32 token shard; synthetic when empty")
+    parser.add_argument("--pp-micro", type=int, default=0,
+                        help="pipeline microbatches; >0 with a pp axis in "
+                             "tony.tpu.mesh-axes selects the pipelined "
+                             "loss (parallel/pipeline.py)")
+    parser.add_argument("--pp-virtual", type=int, default=1,
+                        help="virtual chunks per pipeline stage (>1 = "
+                             "interleaved schedule, bubble/(v))")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
-    config = get_config(args.config)
+    config = get_config(args.config, **({"n_layers": args.n_layers}
+                                        if args.n_layers else {}))
     seq = args.seq_len or config.max_seq
     process_index = int(os.environ.get("JAX_PROCESS_ID", "0"))
 
@@ -79,8 +91,28 @@ def main() -> int:
                                         config.vocab_size,
                                         process_index=process_index)
 
+    # pipelined loss when requested and the orchestrator rendered a pp
+    # axis (tony.tpu.mesh-axes=pp,...): the 1F1B schedule, interleaved
+    # when --pp-virtual > 1; the trainer binds the runtime mesh at setup
+    mesh_axes = [a.strip() for a in
+                 os.environ.get("TPU_MESH_AXES", "").split(",")]
+    pipelined = args.pp_micro > 0 and "pp" in mesh_axes
+    if pipelined:
+        from tony_tpu.models.llama import llama_loss_pipelined
+        loss_fn = partial(llama_loss_pipelined, config=config,
+                          n_micro=args.pp_micro,
+                          n_virtual=args.pp_virtual)
+    else:
+        if args.pp_micro > 0:
+            logging.warning(
+                "--pp-micro %d requested but tony.tpu.mesh-axes (%s) has "
+                "no pp axis — training WITHOUT pipeline parallelism",
+                args.pp_micro, os.environ.get("TPU_MESH_AXES", ""))
+        loss_fn = partial(llama_loss, config=config)
+
     trainer = Trainer(
-        loss_fn=partial(llama_loss, config=config),
+        loss_fn=loss_fn,
+        loss_takes_mesh=pipelined,
         init_fn=partial(llama_init, config),
         data_iter=clipped_tokens(),
         config=TrainerConfig(

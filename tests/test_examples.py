@@ -184,6 +184,28 @@ def test_llama_pretrain_example_tiny(tmp_path):
     assert client.final_status == "SUCCEEDED", _logs(client)
 
 
+def test_llama_pretrain_pipelined_interleaved(tmp_path):
+    """Pipeline-parallel training through the REAL chain: the
+    orchestrator renders a pp mesh (TPU_MESH_*), and the example selects
+    the interleaved (v=2) 1F1B pipelined loss — submit -> AM -> executor
+    -> pipelined train steps."""
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "llama-pretrain",
+                                    "pretrain.py"),
+         "--task_params",
+         "--config tiny --steps 3 --batch-size 4 --seq-len 64 "
+         "--n-layers 4 --pp-micro 2 --pp-virtual 2",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax",
+         "--conf", "tony.tpu.mesh-shape=2,1",
+         "--conf", "tony.tpu.mesh-axes=pp,fsdp",
+         "--conf", ("tony.execution.env=XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=2")])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+    assert "final loss" in _logs(client)
+
+
 def test_llama_pretrain_native_data_two_workers(tmp_path):
     """The flagship through the REAL host data plane (VERDICT r3 weak
     #5): submit -> AM -> executors launch 2 workers that train

@@ -82,7 +82,12 @@ class Trainer:
                  data_iter: Iterator[Any],
                  config: TrainerConfig,
                  param_axes: Optional[Any] = None,
-                 eval_data_iter: Optional[Iterator[Any]] = None):
+                 eval_data_iter: Optional[Iterator[Any]] = None,
+                 loss_takes_mesh: bool = False):
+        # loss_takes_mesh: the loss needs the runtime mesh (pipelined
+        # losses take mesh=...) — it's only known at setup() once
+        # jax.distributed is up, so Trainer binds it there
+        self.loss_takes_mesh = loss_takes_mesh
         self.loss_fn = loss_fn
         self.init_fn = init_fn
         self.data_iter = data_iter
@@ -107,6 +112,9 @@ class Trainer:
         self.mesh = mesh_from_env()
         LOG.info("mesh: %s over %d devices", dict(self.mesh.shape),
                  self.mesh.devices.size)
+        if self.loss_takes_mesh:
+            from functools import partial as _partial
+            self.loss_fn = _partial(self.loss_fn, mesh=self.mesh)
         cfg = self.config
         if cfg.optimizer is not None:
             self.optimizer = cfg.optimizer
