@@ -331,26 +331,30 @@ def test_interleaved_forward_matches_sequential():
 
 
 def test_llama_pipelined_interleaved_composes_with_sp():
-    """Interleaved (v=2) schedule with ring attention running inside the
-    widened {pp, sp} manual region — gradient parity against sequential
-    AD, same acceptance as the v=1 pp-x-sp composition."""
+    """Interleaved (v=2) schedule with ring/ulysses attention running
+    inside the widened {pp, sp} manual region — gradient parity against
+    sequential AD for BOTH sp flavors, same acceptance as the v=1
+    pp-x-sp composition."""
     from functools import partial
 
     from tony_tpu.models.llama import (
         get_config, llama_init, llama_loss, llama_loss_pipelined,
     )
 
-    config = get_config("tiny", n_layers=4)
-    params = llama_init(config, jax.random.PRNGKey(0))
+    base = get_config("tiny", n_layers=4)
+    params = llama_init(base, jax.random.PRNGKey(0))
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
-                                config.vocab_size, jnp.int32)
+                                base.vocab_size, jnp.int32)
     batch = {"tokens": tokens}
-    want = jax.jit(jax.grad(partial(llama_loss, config=config)))(params,
-                                                                 batch)
+    want = jax.jit(jax.grad(partial(llama_loss, config=base)))(params,
+                                                               batch)
     mesh = make_mesh(plan_mesh(8, pp=2, sp=2, fsdp=2))
-    got = jax.jit(jax.grad(partial(
-        llama_loss_pipelined, config=config, mesh=mesh, n_micro=2,
-        n_virtual=2)))(params, batch)
-    for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
-        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
-                                   atol=2e-4, rtol=2e-3)
+    for sp_mode in ("ring", "ulysses"):
+        config = get_config("tiny", n_layers=4, sp_mode=sp_mode)
+        got = jax.jit(jax.grad(partial(
+            llama_loss_pipelined, config=config, mesh=mesh, n_micro=2,
+            n_virtual=2)))(params, batch)
+        for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=2e-4, rtol=2e-3,
+                                       err_msg=f"sp_mode={sp_mode}")
