@@ -28,17 +28,35 @@ GiB = 1024 ** 3
 # fsdp=16 over a v5p-32 slice (16 chips, 95 GB HBM each)
 BATCH, SEQ = 16, 8192
 TOPOLOGY = "v5p:2x2x4"
-HBM_PER_CHIP_GIB = 95.0
+HBM_GIB = {"v5p": 95.0, "v5e": 16.0, "v5lite": 16.0, "v4": 32.0}
 
 
 def main() -> int:
-    mesh_kwargs = {"fsdp": 16}
-    for arg in sys.argv[1:]:
-        if arg.startswith("--mesh"):
-            mesh_kwargs = {}
-            for part in arg.split("=", 1)[1].split(","):
-                k, _, v = part.partition(":")
-                mesh_kwargs[k] = int(v)
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="AOT-compile the llama3_8b train step against a "
+                    "detached TPU topology")
+    parser.add_argument("--mesh", default="fsdp:16",
+                        help="axis:size list, e.g. fsdp:8,tp:2 or "
+                             "pp:4,fsdp:4")
+    parser.add_argument("--topology", default=TOPOLOGY)
+    parser.add_argument("--slices", type=int, default=1,
+                        help=">1 compiles a multi-slice hybrid mesh "
+                             "(outermost axes cross DCN)")
+    parser.add_argument("--batch", type=int, default=BATCH)
+    parser.add_argument("--seq", type=int, default=SEQ)
+    args = parser.parse_args()
+    mesh_kwargs = {}
+    for part in args.mesh.split(","):
+        k, _, v = part.partition(":")
+        mesh_kwargs[k.strip()] = int(v)
+    topology, num_slices = args.topology, args.slices
+    batch, seq = args.batch, args.seq
+    # strict lookup: an unknown device generation must not inherit the
+    # largest part's HBM and fake a fits=true verdict
+    hbm_gib = next((v for k, v in HBM_GIB.items()
+                    if topology.lower().startswith(k)), None)
 
     import jax
     import jax.numpy as jnp
@@ -57,11 +75,21 @@ def main() -> int:
     from tony_tpu.train.step import make_train_step
 
     t0 = time.monotonic()
-    topo = topologies.get_topology_desc(TOPOLOGY, "tpu")
-    mesh = make_mesh(plan_mesh(len(topo.devices), **mesh_kwargs),
-                     topo.devices)
-    print(f"[aot] topology {TOPOLOGY}: {len(topo.devices)} chips, "
-          f"mesh {dict(mesh.shape)}", file=sys.stderr)
+    kw = {"num_slices": num_slices} if num_slices > 1 else {}
+    topo = topologies.get_topology_desc(topology, "tpu", **kw)
+    if num_slices > 1:
+        # DCN-crossing layout: outermost plan axes span slices, inner
+        # axes stay within a slice on ICI (the scaling-book rule the
+        # hybrid mesh implements)
+        from tony_tpu.parallel.mesh import make_hybrid_mesh
+        mesh = make_hybrid_mesh(plan_mesh(len(topo.devices),
+                                          **mesh_kwargs), topo.devices)
+    else:
+        mesh = make_mesh(plan_mesh(len(topo.devices), **mesh_kwargs),
+                         topo.devices)
+    print(f"[aot] topology {topology} x{num_slices}: "
+          f"{len(topo.devices)} chips, mesh {dict(mesh.shape)}",
+          file=sys.stderr)
 
     config = get_config("llama3_8b")
     param_axes = llama_param_axes(config)
@@ -97,10 +125,10 @@ def main() -> int:
         batch_spec = logical_to_mesh_axes(("batch", "seq"), mesh=mesh)
         batch_in = {
             "inputs": jax.ShapeDtypeStruct(
-                (BATCH, SEQ), jnp.int32,
+                (batch, seq), jnp.int32,
                 sharding=NamedSharding(mesh, batch_spec)),
             "targets": jax.ShapeDtypeStruct(
-                (BATCH, SEQ), jnp.int32,
+                (batch, seq), jnp.int32,
                 sharding=NamedSharding(mesh, batch_spec)),
         }
         if mesh_kwargs.get("pp", 1) > 1:
@@ -122,10 +150,11 @@ def main() -> int:
 
     mem = exe.memory_analysis()
     result = {
-        "topology": TOPOLOGY,
+        "topology": topology,
+        "num_slices": num_slices,
         "mesh": dict(mesh.shape),
         "model": "llama3_8b",
-        "batch": BATCH, "seq": SEQ,
+        "batch": batch, "seq": seq,
         "compile_s": round(time.monotonic() - t0, 1),
     }
     if mem is not None:
@@ -136,13 +165,20 @@ def main() -> int:
             "peak_total_gib": round(
                 (mem.argument_size_in_bytes + mem.temp_size_in_bytes)
                 / GiB, 2),
-            "hbm_per_chip_gib": HBM_PER_CHIP_GIB,
+            "hbm_per_chip_gib": hbm_gib,
         }
-        per_chip["fits"] = per_chip["peak_total_gib"] < HBM_PER_CHIP_GIB
+        per_chip["fits"] = (per_chip["peak_total_gib"] < hbm_gib
+                            if hbm_gib is not None else None)
         result["memory_analysis_per_chip"] = per_chip
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "aot_8b_result.json")
+    # the key must capture EVERY knob that changes the numbers, or a
+    # sweep overwrites the canonical rows SCALING.md cites
     key = "x".join(f"{k}{v}" for k, v in sorted(mesh_kwargs.items()))
+    if topology != TOPOLOGY or num_slices > 1:
+        key += f"-{topology}-s{num_slices}"
+    if (batch, seq) != (BATCH, SEQ):
+        key += f"-b{batch}-s{seq}"
     try:
         with open(out_path, "r", encoding="utf-8") as f:
             all_results = json.load(f)
