@@ -20,6 +20,9 @@ from tony_tpu.models.resnet import (
 from tony_tpu.models.moe import (
     MoEConfig, moe_forward, moe_init, moe_loss, moe_param_axes,
 )
+from tony_tpu.models.vit import (
+    ViTConfig, vit_forward, vit_init, vit_loss, vit_param_axes,
+)
 
 __all__ = [
     "generate", "generate_text",
@@ -28,4 +31,5 @@ __all__ = [
     "linreg_forward", "linreg_init", "linreg_loss",
     "MoEConfig", "moe_forward", "moe_init", "moe_loss", "moe_param_axes",
     "ResNetConfig", "resnet_forward", "resnet_init", "resnet_loss",
+    "ViTConfig", "vit_forward", "vit_init", "vit_loss", "vit_param_axes",
 ]

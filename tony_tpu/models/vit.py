@@ -1,0 +1,188 @@
+"""Vision Transformer family: attention on images, TPU-first.
+
+Rounds out the model zoo next to the Llama tower (causal attention), the
+MoE variant, and the Conv/ResNet family — the reference orchestrates
+arbitrary user models (tony-examples CNNs; SURVEY §2.2), so the rebuild
+ships first-class coverage of the standard architectures users bring.
+Design choices:
+
+- **Patchify as one matmul**: images are cut into P×P patches with a
+  reshape/transpose and embedded by a single (P·P·C, D) projection —
+  the MXU path, not a conv (`lax.conv` would compile to the same thing
+  for stride == kernel, with more ceremony).
+- **Non-causal flash attention**: reuses `ops/attention.py` (the pallas
+  kernel + blockwise fallback + multi-chip shard_map dispatch) with
+  `causal=False` — the one attention path in the zoo that exercises the
+  kernels' dense mask branch under meshes.
+- Learned position embeddings + a CLS token; pre-norm blocks (RMSNorm,
+  like the Llama tower — one norm implementation across the zoo), GELU
+  MLP.
+- Same logical-axis sharding contract as the rest of the zoo
+  (`vit_param_axes`): embed dims on fsdp, heads/mlp on tp, batch on
+  (dp, fsdp); `lax.scan` over stacked layer weights.
+
+Presets: `vit_tiny` (tests/examples), `vit_s16_proxy` (ViT-S/16-shaped,
+the scale the allreduce-resnet example's gang would train).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.ops.attention import flash_attention
+from tony_tpu.ops.rmsnorm import rms_norm
+from tony_tpu.parallel.sharding import constrain
+
+Params = dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    in_channels: int = 3
+    num_classes: int = 10
+    dim: int = 64
+    n_layers: int = 2
+    n_heads: int = 4
+    mlp_ratio: int = 4
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def seq(self) -> int:
+        return self.n_patches + 1        # + CLS
+
+
+PRESETS = {
+    "vit_tiny": ViTConfig(),
+    "vit_s16_proxy": ViTConfig(image_size=224, patch_size=16,
+                               num_classes=1000, dim=384, n_layers=12,
+                               n_heads=6, dtype=jnp.bfloat16),
+}
+
+
+def get_config(name: str, **overrides) -> ViTConfig:
+    return replace(PRESETS[name], **overrides)
+
+
+def vit_init(config: ViTConfig, key: jax.Array) -> Params:
+    d = config.dim
+    patch_in = config.patch_size ** 2 * config.in_channels
+    ks = jax.random.split(key, 3)
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            config.dtype)
+
+    L, h = config.n_layers, config.mlp_ratio * d
+    kl = jax.random.split(ks[2], 4)
+    return {
+        "patch_embed": normal(ks[0], (patch_in, d), patch_in ** -0.5),
+        "pos_embed": normal(ks[1], (config.seq, d), 0.02),
+        "cls_token": jnp.zeros((d,), config.dtype),
+        "layers": {
+            "wqkv": normal(kl[0], (L, d, 3 * d), d ** -0.5),
+            "wo": normal(kl[1], (L, d, d), d ** -0.5),
+            "w_up": normal(kl[2], (L, d, h), d ** -0.5),
+            "w_down": normal(kl[3], (L, h, d), h ** -0.5),
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "head_w": jnp.zeros((d, config.num_classes), jnp.float32),
+        "head_b": jnp.zeros((config.num_classes,), jnp.float32),
+    }
+
+
+def vit_param_axes(config: ViTConfig) -> Params:
+    return {
+        "patch_embed": ("embed", None),
+        "pos_embed": (None, None),
+        "cls_token": (None,),
+        "layers": {
+            "wqkv": ("layers", "embed", "heads"),
+            "wo": ("layers", "heads", "embed"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+            "attn_norm": ("layers", "norm"),
+            "mlp_norm": ("layers", "norm"),
+        },
+        "final_norm": ("norm",),
+        "head_w": ("embed", None),
+        "head_b": (None,),
+    }
+
+
+def _patchify(images: jax.Array, config: ViTConfig) -> jax.Array:
+    """(B, H, W, C) -> (B, n_patches, P*P*C) via reshape/transpose only."""
+    b, hgt, wdt, c = images.shape
+    p = config.patch_size
+    gh, gw = hgt // p, wdt // p
+    x = images.reshape(b, gh, p, gw, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)            # (B, gh, gw, p, p, C)
+    return x.reshape(b, gh * gw, p * p * c)
+
+
+def _block(config: ViTConfig, x: jax.Array, layer: Params) -> jax.Array:
+    b, s, d = x.shape
+    nh, hd = config.n_heads, config.head_dim
+    h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+    qkv = jnp.einsum("bsd,dh->bsh", h, layer["wqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+    attn = flash_attention(heads(q), heads(k), heads(v), causal=False)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
+    x = constrain(x, ("batch", None, None))
+
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    up = jax.nn.gelu(jnp.einsum("bsd,df->bsf", h, layer["w_up"]))
+    up = constrain(up, ("batch", None, "mlp"))
+    x = x + jnp.einsum("bsf,fd->bsd", up, layer["w_down"])
+    return constrain(x, ("batch", None, None))
+
+
+def vit_forward(params: Params, images: jax.Array,
+                config: ViTConfig) -> jax.Array:
+    """images: (B, H, W, C) -> logits (B, num_classes) f32."""
+    x = _patchify(images.astype(config.dtype), config)
+    x = jnp.einsum("bpi,id->bpd", x, params["patch_embed"])
+    cls = jnp.broadcast_to(params["cls_token"], (x.shape[0], 1, config.dim))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(config.dtype)
+    x = constrain(x, ("batch", None, None))
+
+    def body(x, layer):
+        return _block(config, x, layer), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    cls_out = x[:, 0].astype(jnp.float32)
+    return cls_out @ params["head_w"] + params["head_b"]
+
+
+def vit_loss(params: Params, batch: dict[str, jax.Array],
+             config: ViTConfig) -> jax.Array:
+    """Mean softmax cross-entropy. batch: {'images': (B,H,W,C),
+    'labels': (B,)}."""
+    from tony_tpu.models.llama import cross_entropy
+
+    logits = vit_forward(params, batch["images"], config)
+    return cross_entropy(logits, batch["labels"])
