@@ -34,6 +34,9 @@ from tony_tpu import constants as C  # noqa: E402
 from tony_tpu.models.resnet import (  # noqa: E402
     get_resnet_config, resnet_init, resnet_loss,
 )
+from tony_tpu.models.vit import (  # noqa: E402
+    get_config as get_vit_config, vit_init, vit_loss,
+)
 from tony_tpu.train.data import synthetic_mnist  # noqa: E402
 from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
 
@@ -58,7 +61,12 @@ def horovod_style_rendezvous() -> int:
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="resnet_tiny")
+    parser.add_argument("--model", default="resnet",
+                        choices=("resnet", "vit"),
+                        help="conv or attention image model — the same "
+                             "all-reduce DP harness drives both")
+    parser.add_argument("--config", default="",
+                        help="preset (default: the model's tiny preset)")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--batch-size", type=int, default=32,
                         help="per-process batch")
@@ -67,16 +75,23 @@ def main() -> int:
 
     rank = horovod_style_rendezvous()
     # the synthetic stream is mnist-shaped (1-channel 28x28), so the
-    # input channel count follows the DATA regardless of preset — the
-    # resnet50_proxy depth/width still applies
-    config = get_resnet_config(args.config, in_channels=1)
+    # input geometry follows the DATA regardless of preset — the
+    # preset's depth/width still applies
+    if args.model == "vit":
+        config = get_vit_config(args.config or "vit_tiny", image_size=28,
+                                patch_size=7, in_channels=1)
+        loss, init = vit_loss, vit_init
+    else:
+        config = get_resnet_config(args.config or "resnet_tiny",
+                                   in_channels=1)
+        loss, init = resnet_loss, resnet_init
 
     def loss_with_images(params, batch):
-        return resnet_loss(params, batch, config)
+        return loss(params, batch, config)
 
     trainer = Trainer(
         loss_fn=loss_with_images,
-        init_fn=partial(resnet_init, config),
+        init_fn=partial(init, config),
         data_iter=synthetic_mnist(args.batch_size, process_index=rank),
         config=TrainerConfig(num_steps=args.steps, log_every=10,
                              learning_rate=1e-2, warmup_steps=2),

@@ -114,6 +114,20 @@ def test_allreduce_resnet_example_two_workers(tmp_path):
     assert client.final_status == "SUCCEEDED", _logs(client)
 
 
+def test_allreduce_vit_example(tmp_path):
+    """The same all-reduce DP harness drives the attention image model
+    (--model vit): ViT through the orchestrated chain."""
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "allreduce-resnet",
+                                    "train_allreduce.py"),
+         "--task_params", "--model vit --steps 8 --batch-size 8",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=horovod"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+    assert "final loss" in _logs(client)
+
+
 def test_multirole_example(tmp_path):
     role = os.path.join(EXAMPLES, "multirole", "role.py")
     client = run_example(

@@ -89,16 +89,21 @@ def vit_init(config: ViTConfig, key: jax.Array) -> Params:
             config.dtype)
 
     L, h = config.n_layers, config.mlp_ratio * d
-    kl = jax.random.split(ks[2], 4)
+    kl = jax.random.split(ks[2], 6)
     return {
         "patch_embed": normal(ks[0], (patch_in, d), patch_in ** -0.5),
         "pos_embed": normal(ks[1], (config.seq, d), 0.02),
         "cls_token": jnp.zeros((d,), config.dtype),
         "layers": {
-            "wqkv": normal(kl[0], (L, d, 3 * d), d ** -0.5),
-            "wo": normal(kl[1], (L, d, d), d ** -0.5),
-            "w_up": normal(kl[2], (L, d, h), d ** -0.5),
-            "w_down": normal(kl[3], (L, h, d), h ** -0.5),
+            # separate projections (llama convention): a fused (d, 3d)
+            # weight tp-shards the concatenated axis across the q/k/v
+            # split boundaries and forces per-layer resharding
+            "wq": normal(kl[0], (L, d, d), d ** -0.5),
+            "wk": normal(kl[1], (L, d, d), d ** -0.5),
+            "wv": normal(kl[2], (L, d, d), d ** -0.5),
+            "wo": normal(kl[3], (L, d, d), d ** -0.5),
+            "w_up": normal(kl[4], (L, d, h), d ** -0.5),
+            "w_down": normal(kl[5], (L, h, d), h ** -0.5),
             "attn_norm": jnp.ones((L, d), jnp.float32),
             "mlp_norm": jnp.ones((L, d), jnp.float32),
         },
@@ -110,11 +115,13 @@ def vit_init(config: ViTConfig, key: jax.Array) -> Params:
 
 def vit_param_axes(config: ViTConfig) -> Params:
     return {
-        "patch_embed": ("embed", None),
+        "patch_embed": (None, "embed"),
         "pos_embed": (None, None),
         "cls_token": (None,),
         "layers": {
-            "wqkv": ("layers", "embed", "heads"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "heads"),
+            "wv": ("layers", "embed", "heads"),
             "wo": ("layers", "heads", "embed"),
             "w_up": ("layers", "embed", "mlp"),
             "w_down": ("layers", "mlp", "embed"),
@@ -141,13 +148,13 @@ def _block(config: ViTConfig, x: jax.Array, layer: Params) -> jax.Array:
     b, s, d = x.shape
     nh, hd = config.n_heads, config.head_dim
     h = rms_norm(x, layer["attn_norm"], config.norm_eps)
-    qkv = jnp.einsum("bsd,dh->bsh", h, layer["wqkv"])
-    q, k, v = jnp.split(qkv, 3, axis=-1)
 
-    def heads(t):
+    def heads(w):
+        t = jnp.einsum("bsd,dh->bsh", h, w)
         return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
 
-    attn = flash_attention(heads(q), heads(k), heads(v), causal=False)
+    attn = flash_attention(heads(layer["wq"]), heads(layer["wk"]),
+                           heads(layer["wv"]), causal=False)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, d)
     x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
     x = constrain(x, ("batch", None, None))
@@ -173,16 +180,18 @@ def vit_forward(params: Params, images: jax.Array,
         return _block(config, x, layer), None
 
     x, _ = lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
-    cls_out = x[:, 0].astype(jnp.float32)
-    return cls_out @ params["head_w"] + params["head_b"]
+    # only the CLS row feeds the head: slice BEFORE the final norm
+    cls_out = rms_norm(x[:, 0], params["final_norm"], config.norm_eps)
+    return (cls_out.astype(jnp.float32) @ params["head_w"]
+            + params["head_b"])
 
 
 def vit_loss(params: Params, batch: dict[str, jax.Array],
              config: ViTConfig) -> jax.Array:
-    """Mean softmax cross-entropy. batch: {'images': (B,H,W,C),
-    'labels': (B,)}."""
+    """Mean softmax cross-entropy. batch: {'images': (B,H,W,C) or
+    (B, side*side) mnist-flat, 'labels': (B,)}."""
     from tony_tpu.models.llama import cross_entropy
+    from tony_tpu.models.resnet import _as_images
 
-    logits = vit_forward(params, batch["images"], config)
+    logits = vit_forward(params, _as_images(batch["images"]), config)
     return cross_entropy(logits, batch["labels"])
