@@ -430,3 +430,14 @@ def test_vit_trains_sharded_on_mesh():
                  "labels": jnp.zeros((8,), jnp.int32)}
         params, opt_state, loss = step(params, opt_state, batch)
     np.testing.assert_allclose(float(loss), want, rtol=1e-4)
+
+
+def test_llama3_70b_preset_geometry():
+    """The 70B preset carries the Llama-3-70B geometry and ~70B params
+    (the >16B pp regime docs/SCALING.md compiles against v5p-128)."""
+    from tony_tpu.models.llama import get_config
+
+    cfg = get_config("llama3_70b")
+    assert (cfg.dim, cfg.n_layers, cfg.n_heads, cfg.n_kv_heads,
+            cfg.ffn_dim) == (8192, 80, 64, 8, 28_672)
+    assert 6.9e10 < cfg.num_params() < 7.2e10, cfg.num_params()

@@ -116,6 +116,12 @@ class LlamaConfig:
 # configs scale it down for tests and single-chip benchmarking.
 PRESETS = {
     "llama3_8b": LlamaConfig(xent_chunk=1024),
+    # Llama-3-70B geometry: the ">16B models need pp" regime
+    # (docs/SCALING.md) — compiler-validated on a v5p-128 topology by
+    # tools/aot_8b.py --model llama3_70b
+    "llama3_70b": LlamaConfig(dim=8192, n_layers=80, n_heads=64,
+                              n_kv_heads=8, ffn_dim=28_672,
+                              xent_chunk=1024),
     "llama3_1b_proxy": LlamaConfig(vocab_size=32_000, dim=2048, n_layers=16,
                                    n_heads=16, n_kv_heads=8, ffn_dim=8192,
                                    max_seq=4096, xent_chunk=1024),

@@ -152,9 +152,10 @@ def resnet_forward(params: Params, images: jax.Array,
                       preferred_element_type=jnp.float32) + params["head_b"]
 
 
-def _as_images(images: jax.Array) -> jax.Array:
+def as_images(images: jax.Array) -> jax.Array:
     """(B, N*N) mnist-flat convenience -> (B, N, N, 1); NHWC passes
-    through. Shared by loss and accuracy so the convention lives once."""
+    through. Public: the zoo's image models (resnet loss/accuracy, vit
+    loss) share it so the convention lives once."""
     if images.ndim == 2:
         side = int(images.shape[1] ** 0.5)
         images = images.reshape(-1, side, side, 1)
@@ -164,7 +165,7 @@ def _as_images(images: jax.Array) -> jax.Array:
 def resnet_loss(params: Params, batch: dict[str, jax.Array],
                 config: ResNetConfig) -> jax.Array:
     """batch: {'images': (B,H,W,C) or (B, 784) mnist-flat, 'labels': (B,)}"""
-    logits = resnet_forward(params, _as_images(batch["images"]), config)
+    logits = resnet_forward(params, as_images(batch["images"]), config)
     labels = batch["labels"]
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
@@ -173,5 +174,5 @@ def resnet_loss(params: Params, batch: dict[str, jax.Array],
 
 def resnet_accuracy(params: Params, batch: dict[str, jax.Array],
                     config: ResNetConfig) -> jax.Array:
-    logits = resnet_forward(params, _as_images(batch["images"]), config)
+    logits = resnet_forward(params, as_images(batch["images"]), config)
     return jnp.mean(jnp.argmax(logits, axis=-1) == batch["labels"])

@@ -191,7 +191,7 @@ def vit_loss(params: Params, batch: dict[str, jax.Array],
     """Mean softmax cross-entropy. batch: {'images': (B,H,W,C) or
     (B, side*side) mnist-flat, 'labels': (B,)}."""
     from tony_tpu.models.llama import cross_entropy
-    from tony_tpu.models.resnet import _as_images
+    from tony_tpu.models.resnet import as_images
 
-    logits = vit_forward(params, _as_images(batch["images"]), config)
+    logits = vit_forward(params, as_images(batch["images"]), config)
     return cross_entropy(logits, batch["labels"])
