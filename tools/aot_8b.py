@@ -46,8 +46,13 @@ def main() -> int:
                              "(outermost axes cross DCN)")
     parser.add_argument("--batch", type=int, default=BATCH)
     parser.add_argument("--seq", type=int, default=SEQ)
+    parser.add_argument("--virtual", type=int, default=1,
+                        help="virtual chunks per pipeline stage (pp "
+                             "meshes; >1 = interleaved schedule)")
     parser.add_argument("--model", default="llama3_8b",
-                        help="LlamaConfig preset to compile")
+                        help="LlamaConfig preset, or a MoEConfig preset "
+                             "(moe_tiny / mixtral_proxy) for the "
+                             "expert-parallel path")
     args = parser.parse_args()
     mesh_kwargs = {}
     for part in args.mesh.split(","):
@@ -93,8 +98,18 @@ def main() -> int:
           f"{len(topo.devices)} chips, mesh {dict(mesh.shape)}",
           file=sys.stderr)
 
-    config = get_config(args.model)
-    param_axes = llama_param_axes(config)
+    is_moe = args.model.startswith(("moe_", "mixtral"))
+    if is_moe:
+        from tony_tpu.models.moe import (
+            get_moe_config, moe_init, moe_loss, moe_param_axes,
+        )
+        config = get_moe_config(args.model)
+        init_fn = partial(moe_init, config)
+        param_axes = moe_param_axes(config)
+    else:
+        config = get_config(args.model)
+        init_fn = partial(llama_init, config)
+        param_axes = llama_param_axes(config)
 
     def sds(tree, spec_tree=None):
         """eval_shape tree -> ShapeDtypeStructs with shardings."""
@@ -107,8 +122,7 @@ def main() -> int:
             return jax.tree.map(one, tree)
         return jax.tree.map(one, tree, spec_tree)
 
-    abstract_params = jax.eval_shape(
-        partial(llama_init, config), jax.random.PRNGKey(0))
+    abstract_params = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     param_specs = make_partition_spec(param_axes, mesh=mesh)
     params_in = sds(abstract_params, param_specs)
 
@@ -125,21 +139,38 @@ def main() -> int:
             opt_shapes, opt_state_specs(opt_shapes, param_specs))
 
         batch_spec = logical_to_mesh_axes(("batch", "seq"), mesh=mesh)
-        batch_in = {
-            "inputs": jax.ShapeDtypeStruct(
-                (batch, seq), jnp.int32,
-                sharding=NamedSharding(mesh, batch_spec)),
-            "targets": jax.ShapeDtypeStruct(
-                (batch, seq), jnp.int32,
-                sharding=NamedSharding(mesh, batch_spec)),
-        }
-        if mesh_kwargs.get("pp", 1) > 1:
+        if is_moe:
+            # MoE batches ship as {'tokens': (B, S+1)}; seq+1 must stay
+            # divisible enough for the sp spec -> keep tokens unsharded
+            # on seq (moe runs ep/fsdp meshes)
+            tok_spec = logical_to_mesh_axes(("batch",), mesh=mesh)
+            batch_in = {"tokens": jax.ShapeDtypeStruct(
+                (batch, seq + 1), jnp.int32,
+                sharding=NamedSharding(mesh, tok_spec))}
+        else:
+            batch_in = {
+                "inputs": jax.ShapeDtypeStruct(
+                    (batch, seq), jnp.int32,
+                    sharding=NamedSharding(mesh, batch_spec)),
+                "targets": jax.ShapeDtypeStruct(
+                    (batch, seq), jnp.int32,
+                    sharding=NamedSharding(mesh, batch_spec)),
+            }
+        if is_moe:
+            if mesh_kwargs.get("pp", 1) > 1:
+                raise SystemExit(
+                    "MoE has no pipelined loss — a pp axis would record "
+                    "a mesh the compiled program never uses")
+            loss_fn = partial(moe_loss, config=config)
+        elif mesh_kwargs.get("pp", 1) > 1:
             # pipeline-parallel compile check: the pp path (1F1B custom
-            # backward, blockwise attention inside the manual stage) had
-            # only ever lowered for CPU before this
+            # backward, blockwise attention inside the manual stage,
+            # interleaved when --virtual > 1) had only ever lowered for
+            # CPU before this
             from tony_tpu.models.llama import llama_loss_pipelined
             loss_fn = partial(llama_loss_pipelined, config=config,
-                              mesh=mesh, n_micro=4)
+                              mesh=mesh, n_micro=4,
+                              n_virtual=args.virtual)
         else:
             loss_fn = partial(llama_loss, config=config)
         step = make_train_step(loss_fn, optimizer, jit=False,
@@ -156,6 +187,7 @@ def main() -> int:
         "num_slices": num_slices,
         "mesh": dict(mesh.shape),
         "model": args.model,
+        **({"n_virtual": args.virtual} if args.virtual > 1 else {}),
         "batch": batch, "seq": seq,
         "compile_s": round(time.monotonic() - t0, 1),
     }
@@ -183,6 +215,8 @@ def main() -> int:
         key += f"-b{batch}-s{seq}"
     if args.model != "llama3_8b":
         key += f"-{args.model}"
+    if args.virtual > 1:
+        key += f"-v{args.virtual}"
     try:
         with open(out_path, "r", encoding="utf-8") as f:
             all_results = json.load(f)
