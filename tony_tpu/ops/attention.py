@@ -546,22 +546,100 @@ def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
 _FORCE = os.environ.get("TONY_FLASH_FORCE", "")
 
 
-def _forward(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
-    pallas_fwd = functools.partial(
-        _pallas_forward, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, block_k=block_k, interpret=False, kv_len=kv_len)
-    blockwise_fwd = functools.partial(
-        _blockwise_forward, causal=causal, sm_scale=sm_scale,
-        block_k=block_k, kv_len=kv_len)
+# Largest LOCAL sequence whose whole K/V rows the pallas kernels may
+# stage in VMEM: each grid program holds full (s, d) K and V tiles, and
+# at s = 32768, d = 128 that is 2 x 8 MB (x2 double-buffered) against the
+# 16 MB scoped-vmem budget — the v5p AOT compile of a 128k-context
+# fsdp=4 x sp=4 mesh failed exactly there. Longer local sequences are
+# split into <=LONG_SEQ_CHUNK segments and every (q_i, k_j) pair runs
+# the standard kernel (dense below the diagonal, causal on it, skipped
+# above), merged by the exact normalized-partial lse rule — the ring's
+# per-chunk math (parallel/ring.py) applied locally.
+LONG_SEQ_CHUNK = int(os.environ.get("TONY_FLASH_MAX_CHUNK", 8192))
+_MAX_SEGMENTS = 16   # past this, the O(n^2) unrolled pairs bloat the
+                     # program; the blockwise path handles it instead
 
-    def dispatch(qs, ks, vs, force=""):
-        eff = force or _FORCE
+
+def _segments(s: int) -> int:
+    """Segment count for a local sequence, 0 = no segmentation."""
+    if s <= LONG_SEQ_CHUNK or s % LONG_SEQ_CHUNK != 0:
+        return 0
+    n = s // LONG_SEQ_CHUNK
+    return n if n <= _MAX_SEGMENTS else 0
+
+
+def _seg_kv_len(kv_len, j: int, seg: int):
+    """The j-th K segment's live-column count (None = full)."""
+    return seg if kv_len is None else min(max(kv_len - j * seg, 0), seg)
+
+
+def merge_partials(out_acc, lse_acc, o_c, l_c):
+    """Exact online merge of normalized attention partials: new weights
+    from the joint logsumexp; a skipped/empty partial (lse = -inf) is a
+    strict no-op. Shared by the ring (parallel/ring.py) and the local
+    long-sequence segmentation so the numerically delicate rule lives
+    once."""
+    lse_new = jnp.logaddexp(lse_acc, l_c)
+    out_new = (out_acc * jnp.exp(lse_acc - lse_new)[..., None]
+               + o_c.astype(jnp.float32)
+               * jnp.exp(l_c - lse_new)[..., None])
+    return out_new, lse_new
+
+
+def _segmented_forward(one, q, k, v, causal, kv_len, eff):
+    """(out, lse) over VMEM-sized K/V segments; `one` runs the standard
+    kernel for a single (q_i, k_j) pair."""
+    b, h, s, d = q.shape
+    seg = LONG_SEQ_CHUNK
+    n = s // seg
+    outs, lses = [], []
+    for i in range(n):
+        qi = q[:, :, i * seg:(i + 1) * seg]
+        out_acc = jnp.zeros((b, h, seg, d), jnp.float32)
+        lse_acc = jnp.full((b, h, seg), NEG_INF, jnp.float32)
+        for j in range(i + 1 if causal else n):
+            kvl = _seg_kv_len(kv_len, j, seg)
+            if kvl == 0:
+                continue
+            kj = k[:, :, j * seg:(j + 1) * seg]
+            vj = v[:, :, j * seg:(j + 1) * seg]
+            o_c, l_c = one(qi, kj, vj, causal and j == i,
+                           kvl if kvl < seg else None, eff)
+            out_acc, lse_acc = merge_partials(out_acc, lse_acc, o_c, l_c)
+        outs.append(out_acc.astype(q.dtype))
+        lses.append(lse_acc)
+    return jnp.concatenate(outs, axis=2), jnp.concatenate(lses, axis=2)
+
+
+def _forward(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
+    def one(qs, ks, vs, causal_, kv_len_, eff):
+        pallas_fwd = functools.partial(
+            _pallas_forward, causal=causal_, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, interpret=False,
+            kv_len=kv_len_)
+        blockwise_fwd = functools.partial(
+            _blockwise_forward, causal=causal_, sm_scale=sm_scale,
+            block_k=block_k, kv_len=kv_len_)
         if eff == "pallas":
             return pallas_fwd(qs, ks, vs)
         if eff == "blockwise":
             return blockwise_fwd(qs, ks, vs)
         return lax.platform_dependent(qs, ks, vs, tpu=pallas_fwd,
                                       default=blockwise_fwd)
+
+    def dispatch(qs, ks, vs, force=""):
+        eff = force or _FORCE
+        s = qs.shape[2]
+        if _segments(s):
+            return _segmented_forward(one, qs, ks, vs, causal, kv_len,
+                                      eff)
+        if s > LONG_SEQ_CHUNK and eff != "pallas":
+            # unsegmentable long sequence (non-multiple or too many
+            # segments): the pallas kernels would blow scoped VMEM
+            # staging full K/V rows — the blockwise path is the one
+            # that scales
+            eff = "blockwise"
+        return one(qs, ks, vs, causal, kv_len, eff)
 
     return _shard_kernel_call(dispatch, (q, k, v), 3, 2)
 
@@ -584,19 +662,54 @@ def _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale, block_q,
     """The platform/TONY_FLASH_FORCE dispatch for the flash backward —
     shared by the custom-VJP rule here and the ring (parallel/ring.py)
     per-chunk backward, so a forced branch pins BOTH directions."""
-    pallas_bwd = lambda *a: _pallas_backward(    # noqa: E731
-        *a, causal, sm_scale, block_q, block_k, kv_len)
-    blockwise_bwd = lambda *a: _blockwise_backward(    # noqa: E731
-        *a, causal, sm_scale, block_k, kv_len=kv_len)
-
-    def dispatch(*a, force=""):
-        eff = force or _FORCE
+    def one(qs, ks, vs, outs, lses, gs, causal_, kv_len_, eff):
+        pallas_bwd = lambda *a: _pallas_backward(    # noqa: E731
+            *a, causal_, sm_scale, block_q, block_k, kv_len_)
+        blockwise_bwd = lambda *a: _blockwise_backward(    # noqa: E731
+            *a, causal_, sm_scale, block_k, kv_len=kv_len_)
+        args = (qs, ks, vs, outs, lses, gs)
         if eff == "pallas":
-            return pallas_bwd(*a)
+            return pallas_bwd(*args)
         if eff == "blockwise":
-            return blockwise_bwd(*a)
-        return lax.platform_dependent(*a, tpu=pallas_bwd,
+            return blockwise_bwd(*args)
+        return lax.platform_dependent(*args, tpu=pallas_bwd,
                                       default=blockwise_bwd)
+
+    def dispatch(qs, ks, vs, outs, lses, gs, force=""):
+        eff = force or _FORCE
+        n = _segments(qs.shape[2])
+        if not n:
+            if qs.shape[2] > LONG_SEQ_CHUNK and eff != "pallas":
+                eff = "blockwise"   # see the forward dispatch
+            return one(qs, ks, vs, outs, lses, gs, causal, kv_len, eff)
+        # segmented backward: every (q_i, k_j) pair's standard flash
+        # backward against q_i's GLOBAL out/lse/g is exact (the ring's
+        # per-chunk decomposition); dq accumulates per q segment, dK/dV
+        # per k segment
+        seg = LONG_SEQ_CHUNK
+        dq_segs = []
+        dk_acc = jnp.zeros(ks.shape, jnp.float32)
+        dv_acc = jnp.zeros(vs.shape, jnp.float32)
+        for i in range(n):
+            sl_i = slice(i * seg, (i + 1) * seg)
+            dq_i = jnp.zeros(qs[:, :, sl_i].shape, jnp.float32)
+            for j in range(i + 1 if causal else n):
+                kvl = _seg_kv_len(kv_len, j, seg)
+                if kvl == 0:
+                    continue
+                sl_j = slice(j * seg, (j + 1) * seg)
+                dq_c, dk_c, dv_c = one(
+                    qs[:, :, sl_i], ks[:, :, sl_j], vs[:, :, sl_j],
+                    outs[:, :, sl_i], lses[:, :, sl_i], gs[:, :, sl_i],
+                    causal and j == i, kvl if kvl < seg else None, eff)
+                dq_i = dq_i + dq_c.astype(jnp.float32)
+                dk_acc = dk_acc.at[:, :, sl_j].add(
+                    dk_c.astype(jnp.float32))
+                dv_acc = dv_acc.at[:, :, sl_j].add(
+                    dv_c.astype(jnp.float32))
+            dq_segs.append(dq_i.astype(qs.dtype))
+        return (jnp.concatenate(dq_segs, axis=2),
+                dk_acc.astype(ks.dtype), dv_acc.astype(vs.dtype))
 
     return _shard_kernel_call(dispatch, (q, k, v, out, lse, g), 6, 3)
 

@@ -41,6 +41,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tony_tpu.ops.attention import (
     DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, NEG_INF, _backward_dispatch, _forward,
+    merge_partials,
 )
 from tony_tpu.ops.vma import match_vma
 
@@ -121,12 +122,9 @@ def _ring_fwd_loop(q, k, v, axis_name, causal, sm_scale):
         src_idx = (my_idx - t) % n           # who produced the chunk we hold
         mode = _chunk_mode(src_idx, my_idx, causal)
         out_c, lse_c = _chunk_forward(q, k_cur, v_cur, mode, sm_scale)
-        # exact online merge of normalized partials: new weights from the
-        # joint logsumexp; a skipped chunk (lse = -inf) is a strict no-op
-        lse_new = jnp.logaddexp(lse_acc, lse_c)
-        out_acc = (out_acc * jnp.exp(lse_acc - lse_new)[..., None]
-                   + out_c.astype(jnp.float32)
-                   * jnp.exp(lse_c - lse_new)[..., None])
+        # exact online merge of normalized partials (shared rule:
+        # ops/attention.py merge_partials)
+        out_acc, lse_new = merge_partials(out_acc, lse_acc, out_c, lse_c)
         # rotate K/V to the next neighbor; the last rotation is wasted but
         # keeps the loop body uniform (and XLA overlaps it with compute)
         return (out_acc, lse_new, _rotate(k_cur, axis_name, n),
