@@ -441,6 +441,14 @@ def llama_hidden_pipelined(params: Params, tokens: jax.Array,
                                            x.shape[1], axis=0)
         cos, sin = varying_full(cos), varying_full(sin)
         stage_layers = jax.tree.map(varying_full, stage_layers)
+        # pin the weights' Auto-axis layout INSIDE the manual region:
+        # with dp in the mesh the partitioner otherwise invents leading-
+        # dim shardings for the local stage stacks and pays involuntary
+        # rematerializations re-sharding them (16-device dryrun, dp=2).
+        # staged_axes[k][1:] = the per-chunk logical dims; manual axes
+        # (pp/sp) are dropped by constrain automatically
+        stage_layers = {k: constrain(p, staged_axes[k][1:])
+                        for k, p in stage_layers.items()}
         block = partial(_block, config, cos, sin)
         if config.remat:
             block = jax.checkpoint(block, policy=config.checkpoint_policy())
@@ -459,6 +467,14 @@ def llama_hidden_pipelined(params: Params, tokens: jax.Array,
     for k, p in params["layers"].items():
         stacked = p.reshape((n_chunks, L // n_chunks) + p.shape[1:])
         if n_virtual > 1:
+            # the contiguous-pp -> round-robin reorder is an all-to-all
+            # GSPMD cannot plan through reshape/transpose (it falls back
+            # to involuntary replication): make it explicit — all-gather
+            # the stage dim (inner dims stay fsdp/tp-sharded, so the
+            # payload is the already-sharded stack), reorder locally,
+            # re-slice onto pp
+            stacked = constrain(stacked,
+                                (None, None) + tuple(staged_axes[k][2:]))
             stacked = interleave_stage_dim(stacked, pp, n_virtual)
         staged_layers[k] = constrain(stacked, staged_axes[k])
 

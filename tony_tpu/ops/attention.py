@@ -630,7 +630,9 @@ def _forward(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
     def dispatch(qs, ks, vs, force=""):
         eff = force or _FORCE
         s = qs.shape[2]
-        if _segments(s):
+        # segmentation exists purely for the pallas kernels' VMEM
+        # budget; the blockwise branch streams any length in one call
+        if eff != "blockwise" and _segments(s):
             return _segmented_forward(one, qs, ks, vs, causal, kv_len,
                                       eff)
         if s > LONG_SEQ_CHUNK and eff != "pallas":
@@ -677,7 +679,8 @@ def _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale, block_q,
 
     def dispatch(qs, ks, vs, outs, lses, gs, force=""):
         eff = force or _FORCE
-        n = _segments(qs.shape[2])
+        n = 0 if (force or _FORCE) == "blockwise" \
+            else _segments(qs.shape[2])
         if not n:
             if qs.shape[2] > LONG_SEQ_CHUNK and eff != "pallas":
                 eff = "blockwise"   # see the forward dispatch

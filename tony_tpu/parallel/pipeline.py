@@ -369,14 +369,43 @@ def make_pipelined_fn(stage_fn: StageFn, mesh: Mesh, n_micro: int,
 
     param_specs = P(axis_name)  # stage dim on pp; inner dims stay Auto
 
+    def constrain_mb(t):
+        """Pin the microbatched tensors' Auto-axis layout at the
+        shard_map boundary: microbatch dim unsharded, per-microbatch
+        batch dim over (dp, fsdp), remaining dims per mb_spec. Without
+        this the partitioner is free to factor the batch sharding
+        across (M, mb) dims and then pays an involuntary full
+        rematerialization re-sharding it back (seen at dp=2 on the
+        16-device dryrun)."""
+        shape = dict(mesh.shape)
+        batch_axes = tuple(a for a in ("dp", "fsdp")
+                           if shape.get(a, 1) > 1)
+        prod = 1
+        for a in batch_axes:
+            prod *= shape[a]
+        # all-or-nothing: every microbatch must carry the FULL batch
+        # sharding (each dp/fsdp group pipelines its own slice of every
+        # microbatch) — a partial constraint would force a cross-group
+        # reshuffle of the batch layout instead of preventing one
+        if not batch_axes or t.shape[1] % prod != 0:
+            return t
+        entries = [None, batch_axes] + [
+            mb_spec[i] if i < len(mb_spec) else None
+            for i in range(2, t.ndim)]
+        # explicit NamedSharding: callers may run without an ambient
+        # set_mesh (the mesh is a constructor argument here)
+        from jax.sharding import NamedSharding
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(mesh, P(*entries)))
+
     def f(params_stacked, x):
-        mb = split_microbatches(x, n_micro)
+        mb = constrain_mb(split_microbatches(x, n_micro))
         specs_in = (jax.tree.map(lambda _: param_specs, params_stacked),
                     mb_spec)
         y = jax.shard_map(stage_slot, mesh=mesh, in_specs=specs_in,
                           out_specs=mb_spec, axis_names=manual)(
                               params_stacked, mb)
-        return merge_microbatches(y)
+        return merge_microbatches(constrain_mb(y))
 
     return f
 
