@@ -679,8 +679,7 @@ def _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale, block_q,
 
     def dispatch(qs, ks, vs, outs, lses, gs, force=""):
         eff = force or _FORCE
-        n = 0 if (force or _FORCE) == "blockwise" \
-            else _segments(qs.shape[2])
+        n = 0 if eff == "blockwise" else _segments(qs.shape[2])
         if not n:
             if qs.shape[2] > LONG_SEQ_CHUNK and eff != "pallas":
                 eff = "blockwise"   # see the forward dispatch

@@ -377,9 +377,13 @@ def make_pipelined_fn(stage_fn: StageFn, mesh: Mesh, n_micro: int,
         across (M, mb) dims and then pays an involuntary full
         rematerialization re-sharding it back (seen at dp=2 on the
         16-device dryrun)."""
+        from tony_tpu.parallel.sharding import logical_to_mesh_axes
         shape = dict(mesh.shape)
-        batch_axes = tuple(a for a in ("dp", "fsdp")
-                           if shape.get(a, 1) > 1)
+        # derive the batch mapping from the shared rules (one source of
+        # truth with every other constrain site)
+        rule = logical_to_mesh_axes(("batch",), mesh=mesh)[0] or ()
+        rule = rule if isinstance(rule, tuple) else (rule,)
+        batch_axes = tuple(a for a in rule if shape.get(a, 1) > 1)
         prod = 1
         for a in batch_axes:
             prod *= shape[a]

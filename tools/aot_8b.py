@@ -46,6 +46,10 @@ def main() -> int:
                              "(outermost axes cross DCN)")
     parser.add_argument("--batch", type=int, default=BATCH)
     parser.add_argument("--seq", type=int, default=SEQ)
+    parser.add_argument("--generate", action="store_true",
+                        help="compile the inference path (prefill + "
+                             "KV-cache decode scan) instead of the "
+                             "train step")
     parser.add_argument("--virtual", type=int, default=1,
                         help="virtual chunks per pipeline stage (pp "
                              "meshes; >1 = interleaved schedule)")
@@ -126,6 +130,24 @@ def main() -> int:
     param_specs = make_partition_spec(param_axes, mesh=mesh)
     params_in = sds(abstract_params, param_specs)
 
+    if args.generate:
+        # inference path: --seq is the PROMPT length (prefill), 64 new
+        # tokens decoded through the KV-cache scan
+        if is_moe:
+            raise SystemExit("--generate supports the Llama presets only")
+        from tony_tpu.models.generate import generate
+        prompt_in = jax.ShapeDtypeStruct(
+            (batch, seq), jnp.int32,
+            sharding=NamedSharding(
+                mesh, logical_to_mesh_axes(("batch",), mesh=mesh)))
+        print("[aot] lowering + compiling generate (prefill + KV-cache "
+              "decode scan)...", file=sys.stderr)
+        with jax.set_mesh(mesh):
+            exe = jax.jit(
+                lambda p, t: generate(p, config, t, 64)).lower(
+                    params_in, prompt_in).compile()
+    else:
+        exe = None
     optimizer = with_f32_master(optax.adamw(3e-4))
     with jax.set_mesh(mesh):
         # explicit optimizer-state specs (masters/moments mirror the
@@ -175,11 +197,12 @@ def main() -> int:
             loss_fn = partial(llama_loss, config=config)
         step = make_train_step(loss_fn, optimizer, jit=False,
                                emit_accum_dtype=True)
-        print("[aot] lowering + compiling the full train step "
-              "(fwd+bwd+adamw, donated state)...", file=sys.stderr)
-        exe = jax.jit(
-            step, donate_argnums=(0, 1)).lower(
-                params_in, opt_in, batch_in).compile()
+        if exe is None:
+            print("[aot] lowering + compiling the full train step "
+                  "(fwd+bwd+adamw, donated state)...", file=sys.stderr)
+            exe = jax.jit(
+                step, donate_argnums=(0, 1)).lower(
+                    params_in, opt_in, batch_in).compile()
 
     mem = exe.memory_analysis()
     result = {
@@ -187,6 +210,7 @@ def main() -> int:
         "num_slices": num_slices,
         "mesh": dict(mesh.shape),
         "model": args.model,
+        **({"mode": "generate"} if args.generate else {}),
         **({"n_virtual": args.virtual} if args.virtual > 1 else {}),
         "batch": batch, "seq": seq,
         "compile_s": round(time.monotonic() - t0, 1),
@@ -217,6 +241,8 @@ def main() -> int:
         key += f"-{args.model}"
     if args.virtual > 1:
         key += f"-v{args.virtual}"
+    if args.generate:
+        key += "-generate"
     try:
         with open(out_path, "r", encoding="utf-8") as f:
             all_results = json.load(f)
