@@ -544,6 +544,10 @@ def _flash_core(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
 # canonicalize to "tpu", so they select the pallas branch too.
 # TONY_FLASH_FORCE={pallas,blockwise} pins a branch for debugging.
 _FORCE = os.environ.get("TONY_FLASH_FORCE", "")
+# interpret-mode pallas for tests: lets the REAL kernels (interpreted on
+# CPU) run through every dispatch layer — segmentation, ring, GQA —
+# instead of only via direct _pallas_* calls
+_INTERPRET = os.environ.get("TONY_FLASH_INTERPRET", "") == "1"
 
 
 # Largest LOCAL sequence whose whole K/V rows the pallas kernels may
@@ -615,7 +619,7 @@ def _forward(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
     def one(qs, ks, vs, causal_, kv_len_, eff):
         pallas_fwd = functools.partial(
             _pallas_forward, causal=causal_, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, interpret=False,
+            block_q=block_q, block_k=block_k, interpret=_INTERPRET,
             kv_len=kv_len_)
         blockwise_fwd = functools.partial(
             _blockwise_forward, causal=causal_, sm_scale=sm_scale,
@@ -666,7 +670,8 @@ def _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale, block_q,
     per-chunk backward, so a forced branch pins BOTH directions."""
     def one(qs, ks, vs, outs, lses, gs, causal_, kv_len_, eff):
         pallas_bwd = lambda *a: _pallas_backward(    # noqa: E731
-            *a, causal_, sm_scale, block_q, block_k, kv_len_)
+            *a, causal_, sm_scale, block_q, block_k, kv_len_,
+            interpret=_INTERPRET)
         blockwise_bwd = lambda *a: _blockwise_backward(    # noqa: E731
             *a, causal_, sm_scale, block_k, kv_len=kv_len_)
         args = (qs, ks, vs, outs, lses, gs)
