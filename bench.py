@@ -487,6 +487,17 @@ def _emit(result: dict) -> None:
             result["truncated"] = (result.get("truncated", "") + f" {key}"
                                    ).strip()
             line = json.dumps(result, separators=(",", ":"))
+    if len(line) > 1400:
+        # hard floor: drop_order exhausted but other keys (or the
+        # truncated field itself) still blow the bound — emit a minimal
+        # object that is always parseable rather than a truncated tail
+        line = json.dumps(
+            {"metric": result.get("metric", "unknown"),
+             "value": result.get("value", 0.0),
+             "unit": result.get("unit", ""),
+             "vs_baseline": result.get("vs_baseline", 0.0),
+             "truncated": "hard-floor"},
+            separators=(",", ":"))
     print(line, flush=True)
 
 

@@ -441,3 +441,19 @@ def test_llama3_70b_preset_geometry():
     assert (cfg.dim, cfg.n_layers, cfg.n_heads, cfg.n_kv_heads,
             cfg.ffn_dim) == (8192, 80, 64, 8, 28_672)
     assert 6.9e10 < cfg.num_params() < 7.2e10, cfg.num_params()
+
+
+def test_trainer_double_setup_mesh_loss():
+    """setup() twice (session retry path) must not stack a duplicate
+    mesh= kwarg onto a loss_takes_mesh loss (r4 advisor)."""
+    def meshy_loss(params, batch, mesh=None):
+        assert mesh is not None
+        return mnist_loss(params, batch)
+
+    cfg = TrainerConfig(num_steps=2, log_every=1, warmup_steps=1)
+    t = Trainer(meshy_loss, mnist_init, synthetic_mnist(32), cfg,
+                loss_takes_mesh=True)
+    t.setup()
+    t.setup()          # retry: rebinds against the ORIGINAL loss_fn
+    t.run()
+    assert t.last_loss is not None

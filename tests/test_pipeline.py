@@ -358,3 +358,21 @@ def test_llama_pipelined_interleaved_composes_with_sp():
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        atol=2e-4, rtol=2e-3,
                                        err_msg=f"sp_mode={sp_mode}")
+
+
+def test_pipeline_on_bare_pp_only_mesh():
+    """make_pipelined_fn is public API accepting ANY mesh: a hand-built
+    Mesh with only a pp axis (no dp/fsdp) maps "batch" to an empty spec
+    — constrain_mb must treat that as unsharded, not IndexError
+    (r4 advisor)."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("pp",))
+    per_stage = make_params(jax.random.PRNGKey(7))
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.PRNGKey(8), (8, DIM))
+    got = make_pipelined_fn(stage_fn, mesh, n_micro=4)(stacked, x)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(sequential(per_stage, x)),
+                               atol=1e-5, rtol=1e-5)

@@ -52,6 +52,13 @@ from tony_tpu.ops.vma import (
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
+def _acc_dtype(p: jax.Array):
+    """Dtype for a gradient running sum over microbatches: at least f32
+    for inexact params (bf16 sums drop low-order contributions)."""
+    return (jnp.promote_types(p.dtype, jnp.float32)
+            if jnp.issubdtype(p.dtype, jnp.inexact) else p.dtype)
+
+
 def _fwd_scan(stage_fn: StageFn, stage_params: Any,
               microbatches: jax.Array, axis_name: str):
     """Fill/drain forward. Returns (out (M, mb, ...), ins (T, mb, ...))
@@ -123,9 +130,12 @@ def _pipe_bwd(stage_fn, axis_name, residuals, dy):
     # grad accumulators must carry EXACTLY the params' vma (pp): the vjp
     # inside the scan already psums any extra-axis (e.g. sp) cotangent
     # back down via the stage's pvary, so widening these to the full
-    # manual set would overshoot the shard_map transpose's out specs
+    # manual set would overshoot the shard_map transpose's out specs.
+    # Accumulate in f32 regardless of param dtype: a bf16 running sum
+    # over many microbatches drops low-order contributions.
     zero_grads = jax.tree.map(
-        lambda p: _match(jnp.zeros_like(p), p), stage_params)
+        lambda p: _match(jnp.zeros_like(p, dtype=_acc_dtype(p)), p),
+        stage_params)
 
     def step(carry, tk):
         t, g_carry, grads_acc = tk[0], carry[0], carry[1]
@@ -146,6 +156,8 @@ def _pipe_bwd(stage_fn, axis_name, residuals, dy):
 
     init = (_varying(jnp.zeros_like(dy[0])), zero_grads)
     (_, grads), dxs = lax.scan(step, init, (ticks,))
+    grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                         grads, stage_params)
     # stage 0's dx at tick m + (n-1) is d(microbatch m input)
     d_mb = lax.dynamic_slice_in_dim(dxs, n - 1, n_micro, axis=0)
     mask = (idx == 0).astype(d_mb.dtype)
@@ -271,8 +283,10 @@ def _pipe_bwd_inter(stage_fn, axis_name, v, residuals, dy):
     T = interleaved_ticks(n_micro, n, v)
     dy_stream = _varying(dy)
     ticks = varying_over(jnp.arange(T), axis_name)
+    # f32 accumulators for the same low-order-loss reason as _pipe_bwd
     zero_grads = jax.tree.map(
-        lambda p: _match(jnp.zeros_like(p), p), stage_params)
+        lambda p: _match(jnp.zeros_like(p, dtype=_acc_dtype(p)), p),
+        stage_params)
 
     def step(carry, tk):
         t, (g_carry, grads_acc) = tk[0], carry
@@ -287,7 +301,8 @@ def _pipe_bwd_inter(stage_fn, axis_name, v, residuals, dy):
         _, vjp = jax.vjp(stage_fn, _chunk_params(stage_params, j), x_saved)
         dp, dx = vjp(g_in)
         grads_acc = jax.tree.map(
-            lambda acc, d_: acc.at[j].add(jnp.where(valid, d_, 0)),
+            lambda acc, d_: acc.at[j].add(
+                jnp.where(valid, d_, 0).astype(acc.dtype)),
             grads_acc, dp)
         rev = [(i, (i - 1) % n) for i in range(n)]
         g_next = lax.ppermute(jnp.where(valid, dx, 0), axis_name, rev)
@@ -295,6 +310,8 @@ def _pipe_bwd_inter(stage_fn, axis_name, v, residuals, dy):
 
     init = (_varying(jnp.zeros_like(dy[0])), zero_grads)
     (_, grads), dxs = lax.scan(step, init, (ticks,))
+    grads = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                         grads, stage_params)
     d_mb = jnp.take(dxs, _exit_ticks(n_micro, n, v), axis=0)
     mask = (idx == 0).astype(d_mb.dtype)
     return grads, lax.psum(d_mb * mask, axis_name)
@@ -381,7 +398,10 @@ def make_pipelined_fn(stage_fn: StageFn, mesh: Mesh, n_micro: int,
         shape = dict(mesh.shape)
         # derive the batch mapping from the shared rules (one source of
         # truth with every other constrain site)
-        rule = logical_to_mesh_axes(("batch",), mesh=mesh)[0] or ()
+        spec = logical_to_mesh_axes(("batch",), mesh=mesh)
+        # hand-built meshes without dp/fsdp axes map "batch" to P() —
+        # treat that as "no batch sharding", not an index error
+        rule = (spec[0] or ()) if len(spec) else ()
         rule = rule if isinstance(rule, tuple) else (rule,)
         batch_axes = tuple(a for a in rule if shape.get(a, 1) > 1)
         prod = 1

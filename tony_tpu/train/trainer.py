@@ -112,9 +112,14 @@ class Trainer:
         self.mesh = mesh_from_env()
         LOG.info("mesh: %s over %d devices", dict(self.mesh.shape),
                  self.mesh.devices.size)
+        # bind into a local, never back onto self.loss_fn: a second
+        # setup() (session retry) would otherwise stack a duplicate
+        # mesh= kwarg onto the already-bound partial
+        loss_fn = self.loss_fn
         if self.loss_takes_mesh:
             from functools import partial as _partial
-            self.loss_fn = _partial(self.loss_fn, mesh=self.mesh)
+            loss_fn = _partial(loss_fn, mesh=self.mesh)
+        self._bound_loss_fn = loss_fn
         cfg = self.config
         if cfg.optimizer is not None:
             self.optimizer = cfg.optimizer
@@ -128,7 +133,7 @@ class Trainer:
             from tony_tpu.train.precision import with_f32_master
             self.optimizer = with_f32_master(self.optimizer)
         self.train_step = make_train_step(
-            self.loss_fn, self.optimizer, grad_accum=cfg.grad_accum,
+            self._bound_loss_fn, self.optimizer, grad_accum=cfg.grad_accum,
             # the master consumes f32 grads: don't quantize the
             # f32-accumulated mean back to bf16 at the interface
             emit_accum_dtype=cfg.master_weights)
@@ -180,18 +185,27 @@ class Trainer:
             self.opt_state = state["opt_state"]
             self.step = int(state["step"])
         # multi-process data parallelism: assemble global arrays from each
-        # process's local shard
-        self.data_iter = global_batch_iterator(self.data_iter, self.mesh)
+        # process's local shard. Bind into a separate attribute — a
+        # second setup() (session retry) must not wrap the wrapper (the
+        # outer one would feed already-global arrays into
+        # make_array_from_process_local_data)
+        self._global_data_iter = global_batch_iterator(self.data_iter,
+                                                       self.mesh)
         if cfg.eval_every and self.eval_data_iter is not None:
             from tony_tpu.train.step import make_eval_step
-            self.eval_step = make_eval_step(self.loss_fn)
+            self.eval_step = make_eval_step(self._bound_loss_fn)
             # materialize a FIXED eval set once: successive eval_loss
             # values are then comparable across steps (and across
             # AM-retry resumes — a streaming iterator would restart and
-            # score different batches after a resume)
-            stream = global_batch_iterator(self.eval_data_iter, self.mesh)
-            self._eval_set = [next(stream)
-                              for _ in range(max(1, cfg.eval_batches))]
+            # score different batches after a resume). "Once" includes
+            # across a re-setup(): rebuilding would draw the NEXT
+            # batches from the partially-consumed iterator and silently
+            # swap the held-out set
+            if getattr(self, "_eval_set", None) is None:
+                stream = global_batch_iterator(self.eval_data_iter,
+                                               self.mesh)
+                self._eval_set = [
+                    next(stream) for _ in range(max(1, cfg.eval_batches))]
 
     def _evaluate(self) -> float:
         """Mean loss over the fixed held-out eval set (params only — no
@@ -211,7 +225,7 @@ class Trainer:
         with jax.set_mesh(self.mesh):
             t0 = time.monotonic()
             while self.step < cfg.num_steps:
-                batch = next(self.data_iter)
+                batch = next(self._global_data_iter)
                 self.params, self.opt_state, loss = self.train_step(
                     self.params, self.opt_state, batch)
                 self.step += 1

@@ -62,6 +62,13 @@ def main() -> int:
     for part in args.mesh.split(","):
         k, _, v = part.partition(":")
         mesh_kwargs[k.strip()] = int(v)
+    if args.generate and (args.virtual > 1
+                          or mesh_kwargs.get("pp", 1) > 1):
+        # argv-detectable conflict: fail before any topology/mesh work
+        raise SystemExit(
+            "--generate compiles the inference path only; --virtual "
+            "and pp meshes apply to the train step — drop them or "
+            "drop --generate")
     topology, num_slices = args.topology, args.slices
     batch, seq = args.batch, args.seq
     # strict lookup: an unknown device generation must not inherit the
@@ -148,56 +155,59 @@ def main() -> int:
                     params_in, prompt_in).compile()
     else:
         exe = None
-    optimizer = with_f32_master(optax.adamw(3e-4))
-    with jax.set_mesh(mesh):
-        # explicit optimizer-state specs (masters/moments mirror the
-        # param tree): propagation left the Adam moments replicated on
-        # this very compile before opt_state_specs existed
-        from tony_tpu.parallel.sharding import opt_state_specs
-        opt_shapes = jax.eval_shape(optimizer.init, params_in)
-        opt_in = jax.tree.map(
-            lambda s, spec: jax.ShapeDtypeStruct(
-                s.shape, s.dtype, sharding=NamedSharding(mesh, spec)),
-            opt_shapes, opt_state_specs(opt_shapes, param_specs))
+    # train-step construction only when the train step is what compiles:
+    # in --generate mode the full-scale optimizer eval_shape + loss/step
+    # build was pure wasted compile-path work (r4 advisor)
+    if exe is None:
+        optimizer = with_f32_master(optax.adamw(3e-4))
+        with jax.set_mesh(mesh):
+            # explicit optimizer-state specs (masters/moments mirror the
+            # param tree): propagation left the Adam moments replicated on
+            # this very compile before opt_state_specs existed
+            from tony_tpu.parallel.sharding import opt_state_specs
+            opt_shapes = jax.eval_shape(optimizer.init, params_in)
+            opt_in = jax.tree.map(
+                lambda s, spec: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=NamedSharding(mesh, spec)),
+                opt_shapes, opt_state_specs(opt_shapes, param_specs))
 
-        batch_spec = logical_to_mesh_axes(("batch", "seq"), mesh=mesh)
-        if is_moe:
-            # MoE batches ship as {'tokens': (B, S+1)}; seq+1 must stay
-            # divisible enough for the sp spec -> keep tokens unsharded
-            # on seq (moe runs ep/fsdp meshes)
-            tok_spec = logical_to_mesh_axes(("batch",), mesh=mesh)
-            batch_in = {"tokens": jax.ShapeDtypeStruct(
-                (batch, seq + 1), jnp.int32,
-                sharding=NamedSharding(mesh, tok_spec))}
-        else:
-            batch_in = {
-                "inputs": jax.ShapeDtypeStruct(
-                    (batch, seq), jnp.int32,
-                    sharding=NamedSharding(mesh, batch_spec)),
-                "targets": jax.ShapeDtypeStruct(
-                    (batch, seq), jnp.int32,
-                    sharding=NamedSharding(mesh, batch_spec)),
-            }
-        if is_moe:
-            if mesh_kwargs.get("pp", 1) > 1:
-                raise SystemExit(
-                    "MoE has no pipelined loss — a pp axis would record "
-                    "a mesh the compiled program never uses")
-            loss_fn = partial(moe_loss, config=config)
-        elif mesh_kwargs.get("pp", 1) > 1:
-            # pipeline-parallel compile check: the pp path (1F1B custom
-            # backward, blockwise attention inside the manual stage,
-            # interleaved when --virtual > 1) had only ever lowered for
-            # CPU before this
-            from tony_tpu.models.llama import llama_loss_pipelined
-            loss_fn = partial(llama_loss_pipelined, config=config,
-                              mesh=mesh, n_micro=4,
-                              n_virtual=args.virtual)
-        else:
-            loss_fn = partial(llama_loss, config=config)
-        step = make_train_step(loss_fn, optimizer, jit=False,
-                               emit_accum_dtype=True)
-        if exe is None:
+            batch_spec = logical_to_mesh_axes(("batch", "seq"), mesh=mesh)
+            if is_moe:
+                # MoE batches ship as {'tokens': (B, S+1)}; seq+1 must stay
+                # divisible enough for the sp spec -> keep tokens unsharded
+                # on seq (moe runs ep/fsdp meshes)
+                tok_spec = logical_to_mesh_axes(("batch",), mesh=mesh)
+                batch_in = {"tokens": jax.ShapeDtypeStruct(
+                    (batch, seq + 1), jnp.int32,
+                    sharding=NamedSharding(mesh, tok_spec))}
+            else:
+                batch_in = {
+                    "inputs": jax.ShapeDtypeStruct(
+                        (batch, seq), jnp.int32,
+                        sharding=NamedSharding(mesh, batch_spec)),
+                    "targets": jax.ShapeDtypeStruct(
+                        (batch, seq), jnp.int32,
+                        sharding=NamedSharding(mesh, batch_spec)),
+                }
+            if is_moe:
+                if mesh_kwargs.get("pp", 1) > 1:
+                    raise SystemExit(
+                        "MoE has no pipelined loss — a pp axis would record "
+                        "a mesh the compiled program never uses")
+                loss_fn = partial(moe_loss, config=config)
+            elif mesh_kwargs.get("pp", 1) > 1:
+                # pipeline-parallel compile check: the pp path (1F1B custom
+                # backward, blockwise attention inside the manual stage,
+                # interleaved when --virtual > 1) had only ever lowered for
+                # CPU before this
+                from tony_tpu.models.llama import llama_loss_pipelined
+                loss_fn = partial(llama_loss_pipelined, config=config,
+                                  mesh=mesh, n_micro=4,
+                                  n_virtual=args.virtual)
+            else:
+                loss_fn = partial(llama_loss, config=config)
+            step = make_train_step(loss_fn, optimizer, jit=False,
+                                   emit_accum_dtype=True)
             print("[aot] lowering + compiling the full train step "
                   "(fwd+bwd+adamw, donated state)...", file=sys.stderr)
             exe = jax.jit(
