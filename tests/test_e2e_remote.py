@@ -264,3 +264,70 @@ def test_src_dir_ships_through_store_to_nodes(tmp_path):
         if os.path.exists(p):
             stdouts.append(open(p).read())
     assert sum("trained-on-node" in s for s in stdouts) == 2
+
+
+def test_node_label_pins_jobtype_to_matching_node(tmp_path):
+    """VERDICT r4 item 2: a labeled jobtype lands ONLY on the node
+    carrying that label (TonyClient.java:260 setNodeLabelExpression
+    semantics on the static pool)."""
+    marker = str(tmp_path / "hosts")
+    client = run_job(
+        tmp_path,
+        ["--conf", "tony.worker.instances=2",
+         "--conf", "tony.worker.node-label=tpu",
+         "--conf",
+         "tony.worker.command=bash -c 'mkdir -p %s && pwd > %s/$TASK_INDEX'"
+         % (marker, marker)],
+        conf_overrides=remote_overrides(
+            tmp_path, nodes="plainA:4,tpuB:4;label=tpu"))
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)
+    cwds = [open(os.path.join(marker, f)).read().strip()
+            for f in os.listdir(marker)]
+    assert len(cwds) == 2
+    # ExecTransport keys node workdirs by container id under the shared
+    # node root; assert via the backend's own placement record in the AM
+    # log instead: every launch line names tpuB
+    am_stderr = open(os.path.join(client.app_dir, "am.stderr")).read()
+    launches = [ln for ln in am_stderr.splitlines()
+                if "launched container_" in ln]
+    assert len(launches) == 2, am_stderr
+    assert all("on node tpuB" in ln for ln in launches), launches
+
+
+def test_unsatisfiable_placement_fails_fast(tmp_path):
+    """An impossible ask (label no node carries) fails the app in well
+    under the 15-min registration timeout, naming the jobtype and the
+    node inventory."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.worker.node-label=gpu"],
+        conf_overrides=remote_overrides(tmp_path, nodes="nodeA:2"))
+    elapsed = _time.monotonic() - t0
+    assert client.final_status == "FAILED"
+    msg = client.final_message or ""
+    assert "worker" in msg and "label='gpu'" in msg, msg
+    assert "nodeA:2" in msg, msg
+    assert elapsed < 30, f"fail-fast took {elapsed:.1f}s"
+
+
+def test_joint_gang_infeasibility_fails_fast(tmp_path):
+    """ps=2 + worker=3 on a 4-slot pool: each jobtype fits alone, the
+    gang can never co-reside -> FAILED fast with the joint message."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"),
+         "--conf", "tony.ps.instances=2",
+         "--conf", "tony.worker.instances=3"],
+        conf_overrides=remote_overrides(tmp_path, nodes="nodeA:4"))
+    assert client.final_status == "FAILED"
+    msg = client.final_message or ""
+    assert "jointly need" in msg and "slots" in msg, msg
+    assert _time.monotonic() - t0 < 30

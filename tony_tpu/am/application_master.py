@@ -158,6 +158,7 @@ class ApplicationMaster(ClusterServiceHandler):
         self._killed_by_client = False
         self._task_missed_hb = False
         self._untracked_task_failed = False
+        self._unsatisfiable_request: Optional[str] = None
         self._registration_deadline: Optional[float] = None
         self._preprocess_exit_code = 0
         self._preprocess_finished = False
@@ -287,6 +288,10 @@ class ApplicationMaster(ClusterServiceHandler):
                     break
                 if self._client_signal_stop.is_set():
                     break
+                if self._unsatisfiable_request:
+                    # deterministic placement failure: a retry would hit
+                    # the identical node pool — don't burn the retries
+                    break
                 attempt += 1
                 LOG.warning("session failed; AM retry %d/%d", attempt, max_retries)
                 self._reset()
@@ -299,13 +304,15 @@ class ApplicationMaster(ClusterServiceHandler):
         """One session generation: build, preprocess, schedule, monitor."""
         self._task_missed_hb = False
         self._untracked_task_failed = False
+        self._unsatisfiable_request: str | None = None
         self._killed_by_client = False
         self._preprocess_exit_code = 0
         self._preprocess_finished = False
         self._model_params: str | None = None
         self.session = TonySession(self.conf, session_id=self._session_id)
         self._session_containers.setdefault(self._session_id, [])
-        self.scheduler = TaskScheduler(self.session, _Requestor(self.backend))
+        self.scheduler = TaskScheduler(self.session,
+                                       _Requestor(self.backend, self))
 
         if attempt == 0:
             self.event_handler.emit(Event(
@@ -337,8 +344,31 @@ class ApplicationMaster(ClusterServiceHandler):
                     f"{self._preprocess_exit_code}")
                 return False
 
+        # joint gang feasibility BEFORE scheduling: tracked jobtypes with
+        # no ordering between them all rendezvous at the barrier, so
+        # their summed demand must fit the pool at once — per-request
+        # gates can't see this (review r5). Any depends_on among tracked
+        # jobs means they need NOT all co-reside; skip the joint check
+        # then (the per-request gate still applies).
+        tracked = [r for r in self.session.requests.values()
+                   if not r.untracked]
+        if tracked and not any(r.depends_on for r in tracked):
+            from tony_tpu.cluster.backend import UnsatisfiableRequestError
+            try:
+                self.backend.validate_coresident(
+                    [(r.num_instances, r.memory_mb, r.gpus, r.tpus,
+                      r.node_label) for r in tracked])
+            except UnsatisfiableRequestError as e:
+                self._fail_unsatisfiable(
+                    "+".join(r.job_name for r in tracked), str(e))
+                return False
+
         self.scheduler.schedule_tasks()
         if not self.scheduler.dependency_check_passed:
+            return False
+        if self._unsatisfiable_request:
+            # placement infeasibility surfaced synchronously from
+            # request_containers — final status already set
             return False
         # registration timeout clock starts at scheduling time (reference:
         # tony.container.allocation.timeout, ApplicationMaster.java:790-791)
@@ -382,6 +412,11 @@ class ApplicationMaster(ClusterServiceHandler):
                 session.set_final_status(
                     FinalStatus.FAILED,
                     "An untracked task failed with a non-zero exit code.")
+                break
+            if self._unsatisfiable_request:
+                # a dependency-released jobtype asked for placement no
+                # node can provide (scheduling-time asks are caught
+                # before the monitor starts)
                 break
             if (self._registration_deadline is not None
                     and not session.all_tasks_registered()
@@ -785,16 +820,44 @@ class ApplicationMaster(ClusterServiceHandler):
         self._wake.set()
         return {}
 
+    def _fail_unsatisfiable(self, job_name: str, message: str) -> None:
+        """An UnsatisfiableRequestError from the backend: fail the app
+        immediately (set-once final status; wake the monitor in case the
+        request came from a mid-run dependency release). Status is set
+        BEFORE the flag: the monitor may observe the flag the instant it
+        is written, and must then find the FAILED status in place."""
+        if self.session is not None:
+            self.session.set_final_status(
+                FinalStatus.FAILED,
+                f"Unsatisfiable container request for jobtype "
+                f"{job_name!r}: {message}")
+        self._unsatisfiable_request = job_name
+        self._wake.set()
+
     def task_executor_heartbeat(self, req: dict) -> dict:
         self.hb_monitor.ping(req["task_id"])
         return {}
 
 
 class _Requestor(ResourceRequestor):
-    def __init__(self, backend: ClusterBackend):
+    def __init__(self, backend: ClusterBackend,
+                 am: "ApplicationMaster" = None):
         self.backend = backend
+        self.am = am
 
     def request_containers(self, request: JobContainerRequest) -> None:
-        self.backend.request_containers(
-            request.num_instances, request.priority, request.memory_mb,
-            request.vcores, request.gpus, request.tpus, request.node_label)
+        from tony_tpu.cluster.backend import UnsatisfiableRequestError
+        try:
+            self.backend.request_containers(
+                request.num_instances, request.priority, request.memory_mb,
+                request.vcores, request.gpus, request.tpus,
+                request.node_label, gang=not request.untracked)
+        except UnsatisfiableRequestError as e:
+            # fail the app NOW, not at the 15-min registration timeout
+            # (reference: YARN rejected impossible asks at submission)
+            LOG.error("unsatisfiable container request for %s: %s",
+                      request.job_name, e)
+            if self.am is not None:
+                self.am._fail_unsatisfiable(request.job_name, str(e))
+            else:
+                raise

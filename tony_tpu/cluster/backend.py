@@ -39,6 +39,15 @@ class Container:
 EXIT_KILLED_BY_AM = C.EXIT_KILLED_BY_AM
 
 
+class UnsatisfiableRequestError(ValueError):
+    """No node in the pool can EVER satisfy a container request (label
+    mismatch or a resource quantity above every node's declared capacity).
+    Raised synchronously from request_containers so the AM fails the app
+    immediately instead of spinning until the registration timeout — the
+    fail-fast YARN gave the reference by rejecting impossible resource
+    asks at submission (util/Utils.java:186-204)."""
+
+
 AllocatedCallback = Callable[[Container], None]
 CompletedCallback = Callable[[str, int], None]  # (container_id, exit_code)
 
@@ -63,9 +72,21 @@ class ClusterBackend(abc.ABC):
     @abc.abstractmethod
     def request_containers(self, num: int, priority: int, memory_mb: int,
                            vcores: int, gpus: int, tpus: int,
-                           node_label: str = "") -> None:
+                           node_label: str = "", gang: bool = True) -> None:
         """Ask for `num` containers at `priority`; answers arrive via the
-        on_allocated callback (AMRMClientAsync.addContainerRequest equiv)."""
+        on_allocated callback (AMRMClientAsync.addContainerRequest equiv).
+        `gang=True` (tracked jobtypes) means all `num` must be able to
+        run CO-RESIDENTLY — they rendezvous at the barrier — and a pool
+        that can never co-host them raises UnsatisfiableRequestError;
+        gang=False (untracked) permits sequential reuse of slots."""
+
+    def validate_coresident(self, asks: list[tuple[int, int, int, int,
+                                                   str]]) -> None:
+        """Joint gang feasibility across jobtypes that must all be
+        resident at once; each ask is (num, memory_mb, gpus, tpus,
+        node_label). Raises UnsatisfiableRequestError only when
+        co-residency is provably impossible. Default: no static node
+        pool to check against — accept."""
 
     @abc.abstractmethod
     def launch_container(self, container: Container, command: list[str],

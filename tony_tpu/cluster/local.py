@@ -54,7 +54,7 @@ class LocalClusterBackend(ClusterBackend):
 
     def request_containers(self, num: int, priority: int, memory_mb: int,
                            vcores: int, gpus: int, tpus: int,
-                           node_label: str = "") -> None:
+                           node_label: str = "", gang: bool = True) -> None:
         for _ in range(num):
             self._pending.put((priority, memory_mb, vcores, gpus, tpus,
                                node_label))

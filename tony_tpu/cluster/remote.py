@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import logging
 import os
-import queue
 import shlex
 import subprocess
 import threading
@@ -43,6 +42,7 @@ from typing import IO, Mapping, Optional
 
 from tony_tpu.cluster.backend import (
     ClusterBackend, Container, EXIT_KILLED_BY_AM,
+    UnsatisfiableRequestError,
 )
 
 LOG = logging.getLogger(__name__)
@@ -56,19 +56,109 @@ class NodeSpec:
     host: str
     slots: int = 1
     root: str = ""          # node-side base dir for container workdirs
+    # placement attributes (reference: YARN node labels + resource
+    # quantities, TonyClient.java:260 setNodeLabelExpression +
+    # util/Utils.java:186-204). label follows YARN's exclusive-partition
+    # semantics: a request's node_label must EQUAL the node's label
+    # (both may be "", the default partition). Capacities of -1 mean
+    # "undeclared" = unconstrained, so plain "host:slots" pools keep
+    # their old behavior.
+    label: str = ""
+    tpus: int = -1
+    gpus: int = -1
+    memory_mb: int = -1
 
     @classmethod
     def parse(cls, spec: str, default_root: str = "") -> "NodeSpec":
-        host, _, slots = spec.partition(":")
+        """Grammar: host[:slots][;attr=val...] with attrs label, tpus,
+        gpus, memory (memory accepts 16g/512m suffixes)."""
+        head, *attrs = [p.strip() for p in spec.split(";")]
+        host, _, slots = head.partition(":")
         if not host:
             raise ValueError(f"empty host in node spec {spec!r}")
-        return cls(host=host.strip(), slots=int(slots) if slots else 1,
+        node = cls(host=host.strip(), slots=int(slots) if slots else 1,
                    root=default_root)
+        for attr in attrs:
+            if not attr:
+                continue
+            k, sep, v = attr.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"bad node attribute {attr!r} in {spec!r} "
+                    f"(want key=value)")
+            k = k.strip().lower()
+            if k == "label":
+                node.label = v.strip()
+            elif k in ("tpus", "gpus"):
+                setattr(node, k, int(v))
+            elif k in ("memory", "memory_mb"):
+                from tony_tpu.conf.configuration import parse_memory_mb
+                node.memory_mb = parse_memory_mb(v)
+            else:
+                raise ValueError(
+                    f"unknown node attribute {k!r} in {spec!r} "
+                    f"(label|tpus|gpus|memory)")
+        return node
+
+    def describe(self) -> str:
+        parts = [f"{self.host}:{self.slots}"]
+        if self.label:
+            parts.append(f"label={self.label}")
+        for k in ("tpus", "gpus", "memory_mb"):
+            v = getattr(self, k)
+            if v >= 0:
+                parts.append(f"{k}={v}")
+        return ";".join(parts)
 
 
 def parse_nodes(specs: str, default_root: str = "") -> list[NodeSpec]:
     return [NodeSpec.parse(s, default_root)
             for s in specs.split(",") if s.strip()]
+
+
+# resource dimensions a request claims on its node; "slots" is implicit
+# (always 1 per container). ONE place defines the vector shape — init,
+# fit checks, claim, and release all iterate these dicts.
+def _request_vector(memory_mb: int, gpus: int, tpus: int) -> dict:
+    return {"slots": 1, "tpus": tpus or 0, "gpus": gpus or 0,
+            "memory_mb": memory_mb or 0}
+
+
+def _zero_vector() -> dict:
+    return _request_vector(0, 0, 0) | {"slots": 0}
+
+
+def _node_capacity(node: NodeSpec) -> dict:
+    """Declared capacities; -1 = undeclared/unconstrained."""
+    return {"slots": node.slots, "tpus": node.tpus, "gpus": node.gpus,
+            "memory_mb": node.memory_mb}
+
+
+def _fits(node: NodeSpec, used: dict, need: dict,
+          node_label: str) -> bool:
+    """Can `node` host one more container of `need` given `used`?
+    Labels follow YARN exclusive partitions: exact match, "" = the
+    default partition. A declared capacity (>= 0) bounds the summed
+    quantities of resident containers; undeclared (-1) is
+    unconstrained (plain "host:slots" pools behave as before)."""
+    if node.label != (node_label or ""):
+        return False
+    cap = _node_capacity(node)
+    return all(cap[k] < 0 or used[k] + need[k] <= cap[k]
+               for k in need)
+
+
+def _node_max_fit(node: NodeSpec, need: dict, node_label: str) -> int:
+    """How many containers of `need` this node can ever hold
+    SIMULTANEOUSLY (gang feasibility)."""
+    if node.label != (node_label or ""):
+        return 0
+    cap = _node_capacity(node)
+    bound = node.slots
+    for k, v in need.items():
+        if k != "slots" and cap[k] >= 0 and v > 0:
+            bound = min(bound, cap[k] // v)
+    return max(0, bound)
 
 
 def build_launch_script(command: list[str], env: Mapping[str, str],
@@ -198,11 +288,21 @@ class RemoteClusterBackend(ClusterBackend):
         self._transport = transport
         self._app_id = app_id
         self._seq = 0
-        self._pending: "queue.Queue" = queue.Queue()
+        # FIFO-preference pending list (NOT a strict queue: the
+        # dispatcher places the FIRST item that fits *right now*, so a
+        # label/capacity-starved head can't starve later requests whose
+        # partition has free capacity — head-of-line blocking)
+        self._pending_list: list[tuple] = []
         self._allocated: dict[str, tuple[Container, NodeSpec]] = {}
         self._live: dict[str, _Live] = {}
-        self._node_load: dict[str, int] = {n.host: 0 for n in nodes}
+        # per-node usage vector: slots + the declared-capacity resources
+        self._used: dict[str, dict[str, int]] = {
+            n.host: _zero_vector() for n in nodes}
         self._lock = threading.Lock()
+        # set whenever placement state changes (new request, usage
+        # released, stop) — the dispatcher blocks on it when idle or
+        # starved instead of busy-polling
+        self._work = threading.Event()
         self._stopping = False
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="remote-rm", daemon=True)
@@ -214,40 +314,131 @@ class RemoteClusterBackend(ClusterBackend):
 
     def request_containers(self, num: int, priority: int, memory_mb: int,
                            vcores: int, gpus: int, tpus: int,
-                           node_label: str = "") -> None:
-        for _ in range(num):
-            self._pending.put((priority, memory_mb, vcores, gpus, tpus,
-                               node_label))
+                           node_label: str = "", gang: bool = True) -> None:
+        # fail-fast feasibility gate (reference: YARN rejected resource
+        # asks beyond any node's capacity at submission instead of
+        # letting the app spin to the registration timeout): an
+        # impossible request must surface in <1s with a clear message.
+        # Gang semantics (tracked jobtypes): all `num` must be
+        # CO-RESIDENT — the barrier waits for every instance — so the
+        # bound is the sum over matching nodes of how many of this
+        # request each can ever hold simultaneously. Untracked
+        # (gang=False) jobs may reuse slots sequentially: they only need
+        # ONE container to ever fit.
+        need = _request_vector(memory_mb, gpus, tpus)
+        max_coresident = sum(_node_max_fit(n, need, node_label)
+                             for n in self._nodes)
+        if max_coresident < (num if gang else 1):
+            inventory = ", ".join(n.describe() for n in self._nodes)
+            want = [f"{num} {'co-resident ' if gang else ''}container(s)"]
+            if node_label:
+                want.append(f"label={node_label!r}")
+            want += [f"{k}={v}" for k, v in need.items()
+                     if k != "slots" and v]
+            raise UnsatisfiableRequestError(
+                f"the node pool can co-host at most {max_coresident} of "
+                f"the requested [{' '.join(want)}] — nodes: [{inventory}]")
+        with self._lock:
+            for _ in range(num):
+                self._pending_list.append(
+                    (priority, memory_mb, vcores, gpus, tpus, node_label))
+        self._work.set()
 
-    def _pick_node(self) -> Optional[NodeSpec]:
-        """Least-loaded node with a free slot (deterministic tie-break by
-        list order, which keeps allocation→task matching reproducible)."""
+    def validate_coresident(self, asks: list[tuple[int, int, int, int,
+                                                   str]]) -> None:
+        """Joint gang feasibility over MULTIPLE tracked jobtypes that
+        must all be resident at once (the barrier waits for every
+        instance of every one). Each ask is (num, memory_mb, gpus, tpus,
+        node_label). Checks a sound NECESSARY condition per label
+        partition — total slots and, where every partition node declares
+        a resource, total declared capacity vs summed demand — so it
+        only raises when co-residency is provably impossible
+        (fragmentation may still starve; the per-request gate and the
+        registration timeout cover that)."""
+        by_label: dict[str, list[tuple]] = {}
+        for ask in asks:
+            by_label.setdefault(ask[4] or "", []).append(ask)
+        for label, group in by_label.items():
+            part = [n for n in self._nodes if n.label == label]
+            total = {"slots": sum(n.slots for n in part)}
+            demand = {"slots": sum(a[0] for a in group)}
+            for key, idx in (("memory_mb", 1), ("gpus", 2), ("tpus", 3)):
+                if part and all(getattr(n, key) >= 0 for n in part):
+                    total[key] = sum(getattr(n, key) for n in part)
+                    demand[key] = sum(a[0] * (a[idx] or 0)
+                                      for a in group)
+            over = [k for k in demand if demand[k] > total.get(k, 0)]
+            if over:
+                inventory = ", ".join(n.describe() for n in self._nodes)
+                raise UnsatisfiableRequestError(
+                    f"tracked jobtypes jointly need "
+                    f"{ {k: demand[k] for k in over} } in partition "
+                    f"label={label!r} which can ever provide only "
+                    f"{ {k: total.get(k, 0) for k in over} } — "
+                    f"nodes: [{inventory}]")
+
+    def _pick_node(self, need: dict, node_label: str) -> Optional[NodeSpec]:
+        """Least-slot-loaded node satisfying the request's label and
+        resource constraints (deterministic tie-break by list order,
+        which keeps allocation→task matching reproducible). Claims the
+        request's resource vector on the chosen node."""
         best = None
         with self._lock:
             for node in self._nodes:
-                load = self._node_load[node.host]
-                if load >= node.slots:
+                if not _fits(node, self._used[node.host], need,
+                             node_label):
                     continue
-                if best is None or load < self._node_load[best.host]:
+                if (best is None or self._used[node.host]["slots"]
+                        < self._used[best.host]["slots"]):
                     best = node
             if best is not None:
-                self._node_load[best.host] += 1
+                u = self._used[best.host]
+                for k, v in need.items():
+                    u[k] += v
         return best
+
+    def _release_usage(self, container: Container, host: str) -> None:
+        """Return a container's resource vector to its node (caller holds
+        the lock)."""
+        u = self._used[host]
+        vec = _request_vector(container.memory_mb, container.gpus,
+                              container.tpus)
+        for k, v in vec.items():
+            u[k] = max(0, u[k] - v)
+        self._work.set()
 
     def _dispatch_loop(self) -> None:
         while not self._stopping:
-            try:
-                item = self._pending.get(timeout=0.2)
-            except queue.Empty:
-                continue
-            node = self._pick_node()
-            while node is None and not self._stopping:
-                threading.Event().wait(0.1)
-                node = self._pick_node()
-            if self._stopping:
-                return
-            priority, memory_mb, vcores, gpus, tpus, node_label = item
+            # clear BEFORE scanning so a state change during the scan
+            # re-wakes us instead of being lost
+            self._work.clear()
             with self._lock:
+                pending = list(self._pending_list)
+            # first-fit over the whole pending list (FIFO preference,
+            # no head-of-line blocking): a currently-starved head must
+            # not stall later requests placeable on other partitions
+            placed = None
+            for item in pending:
+                priority, memory_mb, vcores, gpus, tpus, node_label = item
+                node = self._pick_node(
+                    _request_vector(memory_mb, gpus, tpus), node_label)
+                if node is not None:
+                    placed = (item, node)
+                    break
+            if placed is None:
+                # idle or starved: block until a request arrives or
+                # capacity frees (1s backstop timeout)
+                self._work.wait(1.0)
+                continue
+            item, node = placed
+            priority, memory_mb, vcores, gpus, tpus, node_label = item
+            if self._stopping:
+                # stop() raced the placement: don't allocate a container
+                # the stop loop's _live snapshot will never kill
+                return
+            with self._lock:
+                # single dispatcher thread: the item is still present
+                self._pending_list.remove(item)
                 self._seq += 1
                 cid = f"container_{self._app_id}_{self._seq:06d}"
                 container = Container(
@@ -284,8 +475,7 @@ class RemoteClusterBackend(ClusterBackend):
             stdout.close()
             stderr.close()
             with self._lock:
-                self._node_load[node.host] = max(
-                    0, self._node_load[node.host] - 1)
+                self._release_usage(container, node.host)
                 self._allocated.pop(container.container_id, None)
             LOG.error("transport launch on %s failed: %s", node.host, e)
             self._on_completed(container.container_id, 1)
@@ -308,8 +498,7 @@ class RemoteClusterBackend(ClusterBackend):
         live.stderr.close()
         cid = live.container.container_id
         with self._lock:
-            self._node_load[live.node.host] = max(
-                0, self._node_load[live.node.host] - 1)
+            self._release_usage(live.container, live.node.host)
             killed = live.killed
             # prune per-container state: a long-lived AM cycling many
             # sessions must not accumulate dead channels/threads forever
@@ -341,12 +530,12 @@ class RemoteClusterBackend(ClusterBackend):
         with self._lock:
             entry = self._allocated.pop(container_id, None)
             if entry is not None and container_id not in self._live:
-                _, node = entry
-                self._node_load[node.host] = max(
-                    0, self._node_load[node.host] - 1)
+                container, node = entry
+                self._release_usage(container, node.host)
 
     def stop(self) -> None:
         self._stopping = True
+        self._work.set()
         with self._lock:
             lives = list(self._live.values())
         for live in lives:

@@ -106,7 +106,10 @@ CLUSTER_BACKEND = "tony.cluster.backend"      # "local" | "remote"
 CLUSTER_WORKDIR = "tony.cluster.workdir"      # staging root for local backend
 # remote backend (off-host executors — the YARN RM/NM role, ApplicationMaster
 # .java:1002-1156): static node pool + per-container transport channel
-CLUSTER_NODES = "tony.cluster.nodes"          # "host[:slots],host[:slots],..."
+# node spec grammar: "host[:slots][;label=X][;tpus=N][;gpus=N][;memory=16g]"
+# — labels are YARN-exclusive partitions (request label must match exactly);
+# declared capacities bound co-resident containers; undeclared = unlimited
+CLUSTER_NODES = "tony.cluster.nodes"          # "host[:slots][;attr=val...],..."
 CLUSTER_NODE_TRANSPORT = "tony.cluster.node-transport"  # "ssh" | "exec" (test)
 CLUSTER_NODE_ROOT = "tony.cluster.node-root"  # node-side container workdir base
 CLUSTER_SSH_OPTS = "tony.cluster.ssh-opts"    # extra ssh flags (spaces split)

@@ -27,6 +27,9 @@ class JobContainerRequest:
     node_label: str = ""
     command: str = ""          # per-jobtype override of the task command
     depends_on: list[str] = field(default_factory=list)
+    # untracked jobtypes don't gang at the barrier, so their instances
+    # may run sequentially through the pool (no co-residency requirement)
+    untracked: bool = False
 
     def __hash__(self):
         return hash(self.job_name)
@@ -81,6 +84,7 @@ def parse_container_requests(conf: TonyConfiguration) -> dict[str, JobContainerR
             node_label=conf.get_str(K.node_label_key(job)),
             command=conf.get_str(K.command_key(job)),
             depends_on=depends_on,
+            untracked=job in untracked,
         )
         priority += 1
     # validate depends-on targets exist
