@@ -242,6 +242,14 @@ def test_am_publishes_history_through_store(tmp_path):
     assert any(n.endswith(".jhist") and "-SUCCEEDED." in n
                for n in names), names
     assert C.PORTAL_CONFIG_FILE in names
+    # aggregated container logs ride along (VERDICT r4 item 3): an
+    # off-host portal can serve /logs/... from its fetched mirror
+    logs_root = store_root / C.HISTORY_LOGS_DIR_NAME
+    assert logs_root.is_dir(), "aggregated logs not published"
+    worker_dirs = [d for d in os.listdir(logs_root)
+                   if d.startswith("worker_0")]
+    assert worker_dirs and (logs_root / worker_dirs[0] /
+                            "stdout").exists()
 
 
 def test_src_dir_ships_through_store_to_nodes(tmp_path):

@@ -28,10 +28,17 @@ from tony_tpu.portal.server import PortalServer
 
 def make_app_history(intermediate, app_id, status="SUCCEEDED",
                      started=1000, completed=2000, user="alice",
-                     final=True, config=None):
-    """Lay down a per-app history dir the way the AM does."""
+                     final=True, config=None, logs=None):
+    """Lay down a per-app history dir the way the AM does. `logs` maps
+    container-dir -> {stream: content} (the AM's log aggregation)."""
     app_dir = os.path.join(intermediate, app_id)
     os.makedirs(app_dir, exist_ok=True)
+    for cdir, streams in (logs or {}).items():
+        d = os.path.join(app_dir, C.HISTORY_LOGS_DIR_NAME, cdir)
+        os.makedirs(d, exist_ok=True)
+        for stream, content in streams.items():
+            with open(os.path.join(d, stream), "w") as f:
+                f.write(content)
     md = JobMetadata(application_id=app_id, started=started,
                      completed=completed, user=user, status=status)
     handler = EventHandler(app_dir, JobMetadata(
@@ -179,9 +186,35 @@ def test_cache_lists_both_trees_and_serves_entries(tmp_path):
     links = cache.get_log_links("app_done")
     assert links[0]["task"] == "worker:0"
     assert links[0]["host"] == "hostA"
-    assert "container_1" in links[0]["url"]
+    # no aggregated logs -> NO synthesized URL (the old NM-style links
+    # pointed at servers that don't exist — VERDICT r4 item 3)
+    assert links[0]["url"] == "" and links[0]["streams"] == {}
     assert cache.get_metadata("nope") is None
     assert cache.get_events("nope") == []
+
+
+def test_cache_serves_aggregated_logs(tmp_path):
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_l", completed=2000,
+                     logs={"worker_0_s0": {"stdout": "trained fine\n",
+                                           "stderr": "warnings\n"},
+                           "am": {"stdout": "am out\n"}})
+    cache = PortalCache(inter, fin)
+    links = {l["task"]: l for l in cache.get_log_links("app_l")}
+    w = links["worker:0"]
+    assert w["url"] == "/logs/app_l/worker_0_s0/stdout"
+    assert set(w["streams"]) == {"stdout", "stderr"}
+    assert w["host"] == "hostA"          # enriched from TASK_STARTED
+    assert links["am"]["url"] == "/logs/app_l/am/stdout"
+    # content resolution + traversal containment
+    p = cache.get_log_file("app_l", "worker_0_s0", "stdout")
+    assert open(p).read() == "trained fine\n"
+    assert cache.get_log_file("app_l", "../app_l", "stdout") is None
+    assert cache.get_log_file("app_l", "worker_0_s0", "secrets") is None
+    # links survive the move to finished/ (logs travel with the app dir)
+    HistoryFileMover(inter, fin).move_once()
+    assert cache.get_log_file("app_l", "worker_0_s0", "stdout")
 
 
 # ---------------------------------------------------------------------------
@@ -255,12 +288,16 @@ def test_history_store_fetcher_feeds_mover_and_cache(tmp_path, fake_gcs):
     cfg = tmp_path / "cfgsnap.json"
     cfg.write_text(json.dumps({"tony.am.memory": "1g"}))
     store.put(str(cfg), f"history/{C.PORTAL_CONFIG_FILE}")
+    log = tmp_path / "wstdout"
+    log.write_text("remote body\n")
+    store.put(str(log),
+              f"history/{C.HISTORY_LOGS_DIR_NAME}/worker_0_s0/stdout")
 
     inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
     ensure_history_dirs(inter, fin)
     fetcher = HistoryStoreFetcher("gs://bkt/stage", inter)
     fetched = fetcher.fetch_once()
-    assert len(fetched) == 2
+    assert len(fetched) == 3
     assert fetcher.fetch_once() == []     # idempotent: nothing new
 
     mover = HistoryFileMover(inter, fin)
@@ -270,6 +307,9 @@ def test_history_store_fetcher_feeds_mover_and_cache(tmp_path, fake_gcs):
     md = cache.get_metadata("app_remote")
     assert md is not None and md.status == "SUCCEEDED"
     assert cache.get_config("app_remote") == {"tony.am.memory": "1g"}
+    # the fetched aggregated log serves through the portal's own route
+    p = cache.get_log_file("app_remote", "worker_0_s0", "stdout")
+    assert p and open(p).read() == "remote body\n"
 
 
 @pytest.fixture()
@@ -378,3 +418,28 @@ def test_read_user_tokens(tmp_path):
     f = tmp_path / "users.txt"
     f.write_text("# comment\nalice=tok-a\n\nbob = tok-b\nbad-line\n")
     assert read_user_tokens(str(f)) == {"alice": "tok-a", "bob": "tok-b"}
+
+
+def test_portal_serves_log_content_route(tmp_path):
+    """/logs/:id/:dir/:stream returns the real aggregated stdout body
+    (VERDICT r4 item 3 acceptance)."""
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    make_app_history(inter, "app_lc", completed=2000,
+                     logs={"worker_0_s0": {"stdout": "real body 42\n"}})
+    server = PortalServer(PortalCache(inter, fin), port=0,
+                          host="127.0.0.1")
+    server.start()
+    try:
+        status, body = _get(server, "/logs/app_lc")
+        assert status == 200 and "/logs/app_lc/worker_0_s0/stdout" in body
+        status, body = _get(server, "/logs/app_lc/worker_0_s0/stdout")
+        assert status == 200 and body == "real body 42\n"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server, "/logs/app_lc/worker_0_s0/stderr")
+        assert exc.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(server, "/logs/app_lc/..%2Fworker_0_s0/stdout")
+        assert exc.value.code == 404
+    finally:
+        server.stop()

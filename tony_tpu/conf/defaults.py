@@ -90,6 +90,7 @@ DEFAULTS = {
     K.HISTORY_MOVER_INTERVAL_MS: 5 * 60 * 1000,
     K.HISTORY_PURGER_INTERVAL_MS: 6 * 3600 * 1000,
     K.HISTORY_STALE_INPROGRESS_SEC: 24 * 3600,
+    K.HISTORY_LOG_MAX_SIZE: "10m",
 
     # portal
     K.PORTAL_PORT: 19886,

@@ -69,6 +69,8 @@ HISTORY_MOVER_INTERVAL_MS = "tony.history.mover-interval-ms"
 HISTORY_PURGER_INTERVAL_MS = "tony.history.purger-interval-ms"
 # inprogress files older than this are finalized as KILLED by the mover
 HISTORY_STALE_INPROGRESS_SEC = "tony.history.stale-inprogress-sec"
+# per-stream tail cap for aggregated container logs (memory syntax: 10m, 1g)
+HISTORY_LOG_MAX_SIZE = "tony.history.log-max-size"
 KEYTAB_USER = "tony.keytab.user"
 KEYTAB_LOCATION = "tony.keytab.location"
 

@@ -81,6 +81,7 @@ TONY_SRC_ZIP = "tony_src.zip"
 HISTORY_SUFFIX = "jhist"
 HISTORY_INPROGRESS_SUFFIX = "jhist.inprogress"
 PORTAL_CONFIG_FILE = "config.json"   # frozen conf copy in each history dir
+HISTORY_LOGS_DIR_NAME = "logs"       # aggregated container logs in history
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------

@@ -83,7 +83,8 @@ def main(argv=None) -> int:
     store_location = args.history_store or conf.get_str(
         K.HISTORY_STORE_LOCATION)
     if store_location:
-        fetcher = HistoryStoreFetcher(store_location, intermediate)
+        fetcher = HistoryStoreFetcher(store_location, intermediate,
+                                      finished=finished)
         fetcher.fetch_once()   # immediate first sync before serving
         fetcher.start()
 

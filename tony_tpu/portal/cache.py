@@ -179,23 +179,78 @@ class PortalCache:
         return conf
 
     def get_log_links(self, job_id: str) -> list[dict[str, Any]]:
-        """Per-task log locations synthesized from TASK_STARTED events
-        (reference: models/JobLog.java:27-60 builds NM containerlogs URLs)."""
-        md = self.get_metadata(job_id)
-        user = md.user if md else "unknown"
-        links = []
+        """Per-task log links. The reference synthesized NodeManager
+        containerlogs URLs (models/JobLog.java:27-60) pointing at a live
+        NM web server; no such server exists here, so links point at the
+        portal's OWN /logs/:jobId/:dir/:stream routes over the logs the
+        AM aggregated into the history dir. Tasks whose logs haven't
+        been aggregated yet (still running) get url="" — never a URL
+        that can't resolve."""
+        d = self._find_app_dir(job_id)
+        logs_root = (os.path.join(d, C.HISTORY_LOGS_DIR_NAME)
+                     if d else None)
+        # host/container enrichment from TASK_STARTED events, keyed by
+        # the AM's container-dir naming <jobtype>_<index>_s<session>
+        started: dict[str, dict] = {}
         for ev in self.get_events(job_id):
             if ev["type"] != EventType.TASK_STARTED.value:
                 continue
             p = ev["payload"]
-            links.append({
-                "task": f'{p["task_type"]}:{p["task_index"]}',
-                "host": p["host"],
-                "container_id": p.get("container_id", ""),
-                "url": (f'http://{p["host"]}/node/containerlogs/'
-                        f'{p.get("container_id", "")}/{user}'),
-            })
+            # later sessions (AM retries) overwrite earlier ones
+            started[f'{p["task_type"]}:{p["task_index"]}'] = p
+        links, seen = [], set()
+        if logs_root and os.path.isdir(logs_root):
+            for cdir in sorted(os.listdir(logs_root)):
+                streams = [s for s in ("stdout", "stderr")
+                           if os.path.isfile(
+                               os.path.join(logs_root, cdir, s))]
+                if not streams:
+                    continue
+                task = self._task_label(cdir)
+                p = started.get(task, {})
+                seen.add(task)
+                links.append({
+                    "task": task,
+                    "host": p.get("host", ""),
+                    "container_id": p.get("container_id", ""),
+                    "url": f"/logs/{job_id}/{cdir}/stdout",
+                    "streams": {
+                        s: f"/logs/{job_id}/{cdir}/{s}"
+                        for s in streams},
+                })
+        for task, p in started.items():
+            if task not in seen:       # running / not yet aggregated
+                links.append({
+                    "task": task, "host": p.get("host", ""),
+                    "container_id": p.get("container_id", ""),
+                    "url": "", "streams": {},
+                })
         return links
+
+    @staticmethod
+    def _task_label(container_dir: str) -> str:
+        """`worker_0_s1` -> `worker:0` (the AM's container-dir naming);
+        non-task dirs (`am`) pass through unchanged."""
+        parts = container_dir.rsplit("_", 2)
+        if (len(parts) == 3 and parts[1].isdigit()
+                and parts[2].startswith("s")):
+            return f"{parts[0]}:{parts[1]}"
+        return container_dir
+
+    def get_log_file(self, job_id: str, container_dir: str,
+                     stream: str) -> Optional[str]:
+        """Path of an aggregated log file, with containment checks (the
+        serving route must not traverse outside the app's logs dir)."""
+        if stream not in ("stdout", "stderr"):
+            return None
+        d = self._find_app_dir(job_id)
+        if d is None:
+            return None
+        root = os.path.realpath(os.path.join(d, C.HISTORY_LOGS_DIR_NAME))
+        path = os.path.realpath(os.path.join(root, container_dir, stream))
+        if not path.startswith(root + os.sep) or not os.path.isfile(path):
+            return None
+        return path
 
     def metadata_dicts(self) -> list[dict[str, Any]]:
         return [asdict(m) for m in self.list_metadata()]

@@ -16,6 +16,7 @@ import logging
 import os
 import threading
 
+from tony_tpu import constants as C
 from tony_tpu.storage import GCSStore, LocalDirStore, StagingStore
 
 LOG = logging.getLogger(__name__)
@@ -34,13 +35,31 @@ class HistoryStoreFetcher:
     sync is a cheap list+fetch of new keys."""
 
     def __init__(self, location: str, intermediate: str,
-                 interval_ms: int = 60_000):
+                 interval_ms: int = 60_000, finished: str = ""):
         self._location = location
         self._intermediate = intermediate
+        # mover destination tree: an app already moved there must not be
+        # re-fetched into intermediate (it would churn the network every
+        # pass and pile copies into duplicates/ forever)
+        self._finished = finished
+        self._moved_apps: set[str] = set()
         self._interval_sec = interval_ms / 1000.0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run,
                                         name="history-fetcher", daemon=True)
+
+    def _app_moved(self, app_id: str) -> bool:
+        """Is the app already under finished/ (memoized — moved dirs are
+        immutable, so a hit never needs re-checking)?"""
+        if not self._finished:
+            return False
+        if app_id in self._moved_apps:
+            return True
+        for dirpath, dirnames, _ in os.walk(self._finished):
+            if os.path.basename(dirpath) == app_id:
+                self._moved_apps.add(app_id)
+                return True
+        return False
 
     def fetch_once(self) -> list[str]:
         """One sync pass; returns newly fetched destination paths."""
@@ -51,19 +70,32 @@ class HistoryStoreFetcher:
         except Exception:  # noqa: BLE001 — store hiccups must not kill us
             LOG.exception("history store listing failed")
             return fetched
+        logs_dir = C.HISTORY_LOGS_DIR_NAME
+        moved: dict[str, bool] = {}      # one finished-tree check per app
         for key in keys:
             parts = key.split("/")
-            if len(parts) != 3 or parts[1] != "history":
+            if len(parts) == 3 and parts[1] == "history":
+                app_id, fname = parts[0], parts[2]
+                dest = os.path.join(self._intermediate, app_id, fname)
+            elif (len(parts) == 5 and parts[1] == "history"
+                  and parts[2] == logs_dir):
+                # aggregated container logs:
+                # <app>/history/logs/<container-dir>/<stream>
+                app_id, cdir, fname = parts[0], parts[3], parts[4]
+                dest = os.path.join(self._intermediate, app_id, logs_dir,
+                                    cdir, fname)
+            else:
                 continue
-            app_id, _, fname = parts
-            dest = os.path.join(self._intermediate, app_id, fname)
-            if os.path.exists(dest):
+            if app_id not in moved:
+                moved[app_id] = self._app_moved(app_id)
+            if os.path.exists(dest) or moved[app_id]:
                 continue
             try:
                 # fetch to a tmp name + atomic rename: `dest` existing is
                 # the done-marker, so a crash mid-copy must never leave a
                 # truncated file under the final name (the mover would
                 # finalize corrupt history and every later pass skip it)
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
                 tmp = dest + ".fetch-tmp"
                 store.fetch(store.uri(key), tmp)
                 os.replace(tmp, dest)

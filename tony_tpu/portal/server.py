@@ -12,6 +12,7 @@ from __future__ import annotations
 import html
 import json
 import logging
+import os
 import secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -148,7 +149,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._index()
             if parts[0] == "api":
                 return self._api(parts[1:])
-            if len(parts) == 2 and parts[0] in ("jobs", "config", "logs"):
+            if (len(parts) in (2, 4) and parts[0] in ("jobs", "config",
+                                                      "logs")):
                 job_id = parts[1]
                 md = self.cache.get_metadata(job_id)
                 # another user's job 404s identically to a missing one:
@@ -157,7 +159,12 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._html("not found",
                                       f"<p>no such job {html.escape(job_id)}</p>",
                                       404)
-                return getattr(self, "_" + parts[0])(job_id)
+                if len(parts) == 4 and parts[0] == "logs":
+                    # /logs/:jobId/:containerDir/:stream — the served
+                    # replacement for the reference's NM containerlogs
+                    return self._log_file(job_id, parts[2], parts[3])
+                if len(parts) == 2:
+                    return getattr(self, "_" + parts[0])(job_id)
             self._html("not found", "<p>404</p>", 404)
         except Exception:  # noqa: BLE001
             LOG.exception("portal request failed: %s", self.path)
@@ -220,15 +227,48 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _logs(self, job_id: str) -> None:
         rows = []
+        qs = getattr(self, "_link_qs", "")
+        md = self.cache.get_metadata(job_id)
+        # a terminal job with no aggregated logs will never get them
+        # (AM died before aggregation) — don't claim "still running"
+        terminal = md is not None and md.status != "RUNNING"
         for link in self.cache.get_log_links(job_id):
-            url = html.escape(link["url"])
+            if link["streams"]:
+                cell = " ".join(
+                    f'<a href="{html.escape(url)}{qs}">'
+                    f'{html.escape(stream)}</a>'
+                    for stream, url in sorted(link["streams"].items()))
+            elif terminal:
+                cell = "<i>logs unavailable (not aggregated)</i>"
+            else:
+                cell = "<i>pending (task still running)</i>"
             rows.append([
                 html.escape(link["task"]), html.escape(link["host"]),
-                html.escape(link["container_id"]),
-                f'<a href="{url}">{url}</a>',
+                html.escape(link["container_id"]), cell,
             ])
         self._html(f"logs — {job_id}",
-                   _table(["Task", "Host", "Container", "Log"], rows))
+                   _table(["Task", "Host", "Container", "Logs"], rows))
+
+    def _log_file(self, job_id: str, container_dir: str,
+                  stream: str) -> None:
+        path = self.cache.get_log_file(job_id, container_dir, stream)
+        if path is None:
+            return self._html("not found", "<p>no such log</p>", 404)
+        try:
+            # stream in constant memory: aggregated logs may be large
+            # (tony.history.log-max-size) and the threading server can
+            # hold many of these handlers at once
+            import shutil
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(size))
+                self.end_headers()
+                shutil.copyfileobj(f, self.wfile)
+        except OSError:
+            LOG.exception("failed to serve log %s", path)
 
 
 class PortalServer:
