@@ -148,3 +148,29 @@ def test_config_docs_current():
         [sys.executable, os.path.join(repo, "tools", "gen_config_docs.py"),
          "--check"], capture_output=True, text=True)
     assert rc.returncode == 0, rc.stderr
+
+
+def test_queue_quota_validation():
+    """VERDICT r4 item 5: tony.queues.<name>.max-tpus is enforced, and an
+    undeclared queue is a loud error once any queue exists."""
+    from tony_tpu.conf.queues import (
+        configured_queues, validate_queue_quota,
+    )
+
+    conf = TonyConfiguration()
+    conf.set("tony.worker.instances", 4, "t")
+    conf.set("tony.worker.tpus", 4, "t")
+    validate_queue_quota(conf)           # no queues declared: tag only
+
+    conf.set("tony.queues.default.max-tpus", 8, "t")
+    conf.set("tony.queues.big.max-tpus", 32, "t")
+    assert configured_queues(conf) == {"default": 8, "big": 32}
+    with pytest.raises(ValueError, match="'default'.*16 TPUs.*quota of 8"):
+        validate_queue_quota(conf)       # 4x4=16 > default's 8
+    conf.set(K.APPLICATION_QUEUE, "big", "t")
+    validate_queue_quota(conf)           # fits big's 32
+    conf.set(K.APPLICATION_QUEUE, "nosuch", "t")
+    with pytest.raises(ValueError, match="unknown queue 'nosuch'"):
+        validate_queue_quota(conf)
+    # "queues" never becomes a jobtype
+    assert "queues" not in conf.job_types()

@@ -471,3 +471,23 @@ def test_portal_serves_real_container_logs(tmp_path):
         assert "portal-sees-this-line" in body
     finally:
         server.stop()
+
+
+def test_queue_quota_rejects_over_ask(tmp_path):
+    """VERDICT r4 item 5 acceptance: over-quota submission fails with the
+    queue named in the message; a fitting queue submits fine."""
+    with pytest.raises(ValueError, match="queue 'default'.*max-tpus"):
+        run_job(
+            tmp_path,
+            ["--executes", script("exit_0.py"),
+             "--conf", "tony.worker.instances=2",
+             "--conf", "tony.worker.tpus=8",
+             "--conf", "tony.queues.default.max-tpus=8"])
+    client = run_job(
+        tmp_path,
+        ["--executes", script("exit_0.py"), "--queue", "big",
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.worker.tpus=8",
+         "--conf", "tony.queues.default.max-tpus=8",
+         "--conf", "tony.queues.big.max-tpus=16"])
+    assert client.final_status == "SUCCEEDED", _dump_logs(client)

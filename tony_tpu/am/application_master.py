@@ -364,6 +364,18 @@ class ApplicationMaster(ClusterServiceHandler):
         self.scheduler = TaskScheduler(self.session,
                                        _Requestor(self.backend, self))
 
+        # queue quota, re-validated AM-side (conf files can reach the AM
+        # without passing through TonyClient.validate_conf) — a pure-conf
+        # check, so it runs BEFORE preprocess burns minutes of user code
+        from tony_tpu.conf.queues import validate_queue_quota
+        try:
+            validate_queue_quota(self.conf)
+        except ValueError as e:
+            LOG.error("queue quota violation: %s", e)
+            self.session.set_final_status(FinalStatus.FAILED, str(e))
+            self._unsatisfiable_request = "queue-quota"
+            return False
+
         if attempt == 0:
             self.event_handler.emit(Event(
                 EventType.APPLICATION_INITED,

@@ -58,7 +58,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--shell_env", action="append", default=[],
                    help="k=v env passed into task containers, repeatable")
     p.add_argument("--app_name", help="application name")
-    p.add_argument("--queue", help="scheduler queue (kept for parity)")
+    p.add_argument("--queue",
+                   help="scheduler queue; quota declared via "
+                        "tony.queues.<name>.max-tpus (no queues "
+                        "configured = tag only)")
     return p
 
 
@@ -172,6 +175,10 @@ class TonyClient:
         if 0 <= max_gpus < total_gpus:
             raise ValueError(
                 f"requested {total_gpus} total GPUs > max allowed {max_gpus}")
+        # queue quota (TonyClient.java:249-251's YARN queue, re-based on
+        # declared tony.queues.<name>.max-tpus — see conf/queues.py)
+        from tony_tpu.conf.queues import validate_queue_quota
+        validate_queue_quota(self.conf)
 
     # ------------------------------------------------------------------
     def run(self) -> bool:

@@ -143,8 +143,16 @@ JOBTYPE_INSTANCES_RE = re.compile(r"^tony\.([a-z][a-z0-9_\-]*)\.instances$")
 RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
-    "execution", "other",
+    "execution", "other", "queues",
 })
+
+
+def queue_max_tpus_key(queue: str) -> str:
+    """Cap on a SINGLE application's summed TPU ask when submitted into
+    this queue (the capacity-scheduler slice the reference inherited
+    from YARN queues, TonyClient.java:249-251 — aggregate cross-app
+    capacity needs a shared RM, which this rebuild doesn't have)."""
+    return f"tony.queues.{queue}.max-tpus"
 
 
 def jobtype_key(jobtype: str, attr: str) -> str:

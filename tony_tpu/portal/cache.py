@@ -54,6 +54,8 @@ class PortalCache:
         self._configs = _LRU(max_entries)
         # finished app dirs are immutable once moved: job_id -> dir
         self._finished_dirs: dict[str, str] = {}
+        # a job's queue never changes: job_id -> queue, no re-stat
+        self._queues: dict[str, str] = {}
 
     # -- directory scan ----------------------------------------------------
     def _finished_app_dirs(self):
@@ -251,6 +253,24 @@ class PortalCache:
         if not path.startswith(root + os.sep) or not os.path.isfile(path):
             return None
         return path
+
+    def get_queue(self, job_id: str) -> str:
+        """The job's scheduler queue, memoized forever (immutable) — the
+        index page reads it per row and must not re-stat config.json on
+        every render."""
+        with self._lock:
+            cached = self._queues.get(job_id)
+        if cached is not None:
+            return cached
+        conf = self.get_config(job_id)
+        queue = str(conf.get("tony.application.queue", "default")
+                    or "default")
+        if conf:
+            # memoize only once the config snapshot exists — a RUNNING
+            # job may not have written it yet
+            with self._lock:
+                self._queues[job_id] = queue
+        return queue
 
     def metadata_dicts(self) -> list[dict[str, Any]]:
         return [asdict(m) for m in self.list_metadata()]

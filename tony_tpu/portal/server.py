@@ -195,9 +195,10 @@ class _Handler(BaseHTTPRequestHandler):
             if not self._visible(m.user):
                 continue
             app = html.escape(m.application_id)
+            queue = self.cache.get_queue(m.application_id)
             rows.append([
                 f'<a href="/jobs/{app}{qs}">{app}</a>',
-                html.escape(m.user),
+                html.escape(m.user), html.escape(str(queue)),
                 _fmt_ts(m.started), _fmt_ts(m.completed),
                 f'<span class="{html.escape(m.status)}">'
                 f'{html.escape(m.status)}</span>',
@@ -205,8 +206,8 @@ class _Handler(BaseHTTPRequestHandler):
                 f'<a href="/logs/{app}{qs}">logs</a>',
             ])
         self._html("TonY-TPU jobs",
-                   _table(["Job", "User", "Started", "Completed", "Status",
-                           ""], rows))
+                   _table(["Job", "User", "Queue", "Started", "Completed",
+                           "Status", ""], rows))
 
     def _jobs(self, job_id: str) -> None:
         rows = []
