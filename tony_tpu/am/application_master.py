@@ -360,6 +360,11 @@ class ApplicationMaster(ClusterServiceHandler):
         self._preprocess_finished = False
         self._model_params: str | None = None
         self.session = TonySession(self.conf, session_id=self._session_id)
+        # wipe liveliness entries a stale executor's in-flight
+        # registration may have planted between _reset()'s clear and this
+        # point — from here on register_worker_spec validates ids against
+        # THIS session
+        self.hb_monitor.clear()
         self._session_containers.setdefault(self._session_id, [])
         self.scheduler = TaskScheduler(self.session,
                                        _Requestor(self.backend, self))
@@ -698,7 +703,11 @@ class ApplicationMaster(ClusterServiceHandler):
                            f"{task.job_name}_{task.index}_s{task.session_id}")
         task.url = os.path.join(cwd, "stdout")
         self.backend.launch_container(container, cmd, env, cwd)
-        self.hb_monitor.register(task.task_id)
+        # NOT hb-registered yet: liveliness starts at registerWorkerSpec
+        # (reference ApplicationMaster.java:851) — at gang width, dozens
+        # of executors boot concurrently and can take >expiry to reach
+        # their first heartbeat; pre-registration loss is covered by the
+        # registration timeout + container-completion callbacks
         self.event_handler.emit(Event(
             EventType.TASK_STARTED,
             TaskStarted(task.job_name, task.index, container.host,
@@ -799,12 +808,20 @@ class ApplicationMaster(ClusterServiceHandler):
 
     def _on_task_deemed_dead(self, task_id: str) -> None:
         """(ApplicationMaster.onTaskDeemedDead, ApplicationMaster.java:1158-1165)."""
+        session = self.session
+        if session is None or session.get_task_by_id(task_id) is None:
+            # orphaned liveliness entry: a stale executor's registration
+            # raced _reset()'s clear() — the task isn't in the current
+            # session, so its silence must not fail the new session
+            LOG.warning("ignoring heartbeat expiry for stale task %s",
+                        task_id)
+            self.hb_monitor.unregister(task_id)
+            return
         msg = (f"Task with id [{task_id}] has missed "
                f"[{self._max_missed_hb}] heartbeats. Ending application!")
         LOG.error(msg)
         self._task_missed_hb = True
-        if self.session is not None:
-            self.session.set_final_status(FinalStatus.FAILED, msg)
+        session.set_final_status(FinalStatus.FAILED, msg)
         self._wake.set()
 
     # ------------------------------------------------------------------
@@ -837,9 +854,19 @@ class ApplicationMaster(ClusterServiceHandler):
         return {"spec": self.session.cluster_spec_json()}
 
     def register_worker_spec(self, req: dict) -> dict:
-        if self.session is None:
+        session = self.session
+        if session is None:
             return {"spec": None}
-        spec = self.session.register_worker_spec(req["task_id"], req["spec"])
+        # liveliness begins HERE, like the reference (ApplicationMaster
+        # .java:851): the executor is demonstrably alive and its
+        # heartbeater starts right after this call returns. Only a task
+        # the CURRENT session knows gets an entry — a stale/unknown
+        # registration must not plant a liveliness record nothing will
+        # ever unregister (its completion callback early-returns on the
+        # session-id check before reaching hb_monitor.unregister).
+        if session.get_task_by_id(req["task_id"]) is not None:
+            self.hb_monitor.register(req["task_id"])
+        spec = session.register_worker_spec(req["task_id"], req["spec"])
         # TEST hook: simulate chief-worker termination once the chief shows up
         # (reference: killChiefWorkerIfTesting, ApplicationMaster.java:1204-1215)
         if (os.environ.get(C.TEST_WORKER_TERMINATION)
