@@ -491,6 +491,10 @@ def _run_child(backend: str, deadline: float,
             parsed = json.loads(line)
         except ValueError:
             continue
+        if not isinstance(parsed, dict):
+            # a bare number/null from stray output parses as JSON but
+            # is not a result object
+            continue
         if not clean:
             # killed child (deadline): a JSON line printed before the
             # kill is still a valid partial result — label it

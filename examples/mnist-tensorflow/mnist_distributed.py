@@ -6,9 +6,11 @@ TF_CONFIG). The TaskExecutor's tensorflow runtime renders both
 (tony_tpu/executor/runtimes.py _tf_env). On TPU the same TF_CONFIG drives
 tf.distribute.TPUStrategy.
 
-TensorFlow is not in the zero-egress image, so when `import tensorflow`
-fails this script still VALIDATES the rendered env and exits 0 — the
-orchestration contract is what the E2E suite asserts.
+When TensorFlow is importable, the script really trains: a 2-layer MLP
+under tf.distribute.MultiWorkerMirroredStrategy with a loss threshold
+(tests/test_examples.py::test_mnist_tensorflow_example_really_trains).
+On TF-less images it still VALIDATES the rendered env and exits 0 so
+the orchestration contract stays asserted everywhere.
 """
 
 import json

@@ -859,12 +859,14 @@ class ApplicationMaster(ClusterServiceHandler):
             return {"spec": None}
         # liveliness begins HERE, like the reference (ApplicationMaster
         # .java:851): the executor is demonstrably alive and its
-        # heartbeater starts right after this call returns. Only a task
-        # the CURRENT session knows gets an entry — a stale/unknown
-        # registration must not plant a liveliness record nothing will
-        # ever unregister (its completion callback early-returns on the
-        # session-id check before reaching hb_monitor.unregister).
-        if session.get_task_by_id(req["task_id"]) is not None:
+        # heartbeater starts right after this call returns. Gate on the
+        # executor's SESSION id (task ids repeat across AM retries): a
+        # stale previous-session registration racing _reset must not
+        # plant a liveliness record attributed to the new session's
+        # same-named task (register_execution_result has the same gate).
+        sid = int(req.get("session_id", -1))
+        if (sid in (session.session_id, -1)
+                and session.get_task_by_id(req["task_id"]) is not None):
             self.hb_monitor.register(req["task_id"])
         spec = session.register_worker_spec(req["task_id"], req["spec"])
         # TEST hook: simulate chief-worker termination once the chief shows up

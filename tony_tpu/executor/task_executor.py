@@ -182,7 +182,8 @@ class TaskExecutor:
         host_port = f"{self.host}:{self.port}"
         LOG.info("registering %s at %s", self.task_id, host_port)
         return poll_till_non_null(
-            lambda: self.client.register_worker_spec(self.task_id, host_port),
+            lambda: self.client.register_worker_spec(self.task_id, host_port,
+                                                     self.session_id),
             interval_sec=0.2,
             timeout_sec=self.registration_timeout_sec)
 

@@ -95,10 +95,15 @@ class ClusterServiceClient(_JsonRpcClient):
         spec = self.call("get_cluster_spec", {"task_id": task_id}).get("spec")
         return json.loads(spec) if spec else None
 
-    def register_worker_spec(self, task_id: str, spec: str) -> Optional[dict]:
+    def register_worker_spec(self, task_id: str, spec: str,
+                             session_id: int = -1) -> Optional[dict]:
         """Gang barrier: returns the full cluster spec once everyone has
-        registered, else None (reference: TaskExecutor.java:295-309 poll)."""
-        resp = self.call("register_worker_spec", {"task_id": task_id, "spec": spec})
+        registered, else None (reference: TaskExecutor.java:295-309 poll).
+        session_id lets the AM reject a stale previous-session executor's
+        registration (task ids alone repeat across AM retries)."""
+        resp = self.call("register_worker_spec",
+                         {"task_id": task_id, "spec": spec,
+                          "session_id": session_id})
         spec_json = resp.get("spec")
         return json.loads(spec_json) if spec_json else None
 
