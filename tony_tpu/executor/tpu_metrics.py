@@ -178,8 +178,12 @@ class LibtpuMetricsClient:
                 response_deserializer=lambda b: b)
         return self._stub
 
-    def get_metric(self, metric_name: str) -> dict[int, float]:
-        """-> {device_id: value}; {} when the service is unreachable."""
+    def get_metric(self, metric_name: str,
+                   strict: bool = False) -> dict[int, float]:
+        """-> {device_id: value}; {} when the service is unreachable
+        (strict=True re-raises instead — callers gathering EVIDENCE of
+        daemon reachability need unreachable and empty kept distinct;
+        the task-monitor sampler wants the silent {})."""
         import grpc
         try:
             stub = self._ensure_stub()
@@ -187,14 +191,18 @@ class LibtpuMetricsClient:
                        timeout=self._timeout, wait_for_ready=False)
             return parse_metric_response(raw)
         except grpc.RpcError:
+            if strict:
+                raise
             return {}
         except Exception:  # noqa: BLE001 — metrics must never break a task
+            if strict:
+                raise
             LOG.debug("libtpu metrics query failed", exc_info=True)
             return {}
 
-    def duty_cycle_pct(self) -> Optional[float]:
+    def duty_cycle_pct(self, strict: bool = False) -> Optional[float]:
         """Mean tensorcore duty cycle over local chips, 0-100."""
-        per_dev = self.get_metric(DUTY_CYCLE_PCT)
+        per_dev = self.get_metric(DUTY_CYCLE_PCT, strict=strict)
         if not per_dev:
             return None
         return sum(per_dev.values()) / len(per_dev)
