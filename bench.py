@@ -609,8 +609,8 @@ def _emit(result: dict) -> None:
     driver that keeps only a tail of stdout (~2 KB in BENCH_r03, where a
     stack-dump-bearing 4 KB line arrived truncated and parsed as null).
     Anything long goes to stderr + tools/bench_diag.log, never stdout."""
-    drop_order = ("tpu_error", "cpu_error", "last_good_tpu_measurement",
-                  "am_startup_latency", "error")
+    drop_order = ("tpu_error", "cpu_error", "head_partial_tpu_measurement",
+                  "last_good_tpu_measurement", "am_startup_latency", "error")
     line = json.dumps(result, separators=(",", ":"))
     for key in drop_order:
         if len(line) <= 1400:
@@ -689,6 +689,33 @@ def _load_last_good():
         return None
 
 
+def _head_partial():
+    """Most recent deadline-truncated ON-CHIP measurement at/near HEAD
+    (tools/bench_head_partial_*.json, kept out of last-good so it can't
+    shadow a complete run). Attached on the wedged-fallback path so the
+    round's record still carries live-at-HEAD evidence when the tunnel
+    is down at bench time. Recency-gated (48h file mtime): a snapshot
+    from an old round must not masquerade as current-code evidence."""
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools")
+    try:
+        paths = [os.path.join(tools, n) for n in os.listdir(tools)
+                 if n.startswith("bench_head_partial")
+                 and n.endswith(".json")]
+        fresh = [p for p in paths
+                 if time.time() - os.path.getmtime(p) < 48 * 3600]
+        if not fresh:
+            return None
+        with open(max(fresh, key=os.path.getmtime),
+                  encoding="utf-8") as f:
+            snap = json.load(f)
+        keep = ("value", "unit", "tokens_per_sec_per_chip", "step_time_s",
+                "batch_tokens", "partial", "measured_at", "commit")
+        return {k: snap[k] for k in keep if k in snap}
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _compact_last_good(last: dict) -> dict:
     """Embed only the headline fields of the last good TPU run — the full
     snapshot lives in tools/last_good_bench.json and must not bloat the
@@ -697,6 +724,22 @@ def _compact_last_good(last: dict) -> dict:
             "step_time_s", "measured_at", "commit", "partial",
             "kernel_fallback")
     return {k: last[k] for k in keep if k in last}
+
+
+def _attach_fallback_metadata(result: dict, t_start: float,
+                              usable: float) -> None:
+    """Everything a wedged-tunnel record still carries: the last complete
+    on-chip measurement, any fresh partial at-HEAD one, and the
+    orchestrator-only startup-latency metric (which needs no jax). ONE
+    place, used by both the cpu-fallback and total-failure paths, so the
+    two records can't silently diverge."""
+    last = _load_last_good()
+    if last is not None:
+        result["last_good_tpu_measurement"] = _compact_last_good(last)
+    hp = _head_partial()
+    if hp is not None:
+        result["head_partial_tpu_measurement"] = hp
+    _attach_startup_latency(result, t_start, usable)
 
 
 def main() -> None:
@@ -795,10 +838,7 @@ def main() -> None:
                                              None),
             "cpu_step_time_s": result.pop("step_time_s", None),
         })
-        last = _load_last_good()
-        if last is not None:
-            result["last_good_tpu_measurement"] = _compact_last_good(last)
-        _attach_startup_latency(result, t_start, usable)
+        _attach_fallback_metadata(result, t_start, usable)
         _emit(result)
         return
     final = {
@@ -807,12 +847,7 @@ def main() -> None:
         "error": "tpu wedged AND cpu fallback failed",
         "tpu_error": tpu_error, "cpu_error": _compact(diag, 200),
     }
-    last = _load_last_good()
-    if last is not None:
-        final["last_good_tpu_measurement"] = _compact_last_good(last)
-    # the orchestrator-only latency metric works regardless of jax/tunnel
-    # health — attach it on the total-failure path too
-    _attach_startup_latency(final, t_start, usable)
+    _attach_fallback_metadata(final, t_start, usable)
     _emit(final)
 
 
