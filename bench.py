@@ -157,81 +157,153 @@ def child_main(backend: str) -> None:
         config = get_config("llama3_1b_proxy")
         seq, steps, warmup = 4096, 10, 2
         # fused-CE (config.xent_chunk) freed the ~4 GB full-logits
-        # fwd+bwd footprint: batch 8 wins on-chip (r5 A/B); OOM falls
-        # back to 4. TONY_BENCH_BATCH pins it for manual A/B runs.
+        # fwd+bwd footprint and enables batch 8; OOM falls back to 4.
+        # TONY_BENCH_BATCH pins it for manual A/B runs.
         pinned = os.environ.get("TONY_BENCH_BATCH")
         try:
             batch_candidates = (int(pinned),) if pinned else (8, 4)
         except ValueError:
             _mark(f"ignoring malformed TONY_BENCH_BATCH={pinned!r}")
+            pinned = None
             batch_candidates = (8, 4)
     else:
         config = get_config("tiny")
         seq, steps, warmup = 128, 4, 1
         batch_candidates = (4,)
 
-    optimizer = optax.adamw(3e-4)
-    train_step = make_train_step(partial(llama_loss, config=config),
-                                 optimizer)
+    def measure(tag, cfg, cands):
+        """Compile+warmup+time one config. Returns (stats, params)."""
+        optimizer = optax.adamw(3e-4)
+        train_step = make_train_step(partial(llama_loss, config=cfg),
+                                     optimizer)
+        # End each timed region with a device->host transfer of the
+        # loss: on tunneled/experimental platforms block_until_ready
+        # alone may return before the computation finishes, but a host
+        # read cannot.
+        for bi, batch_size in enumerate(cands):
+            try:
+                # init lives INSIDE the try: a deferred async OOM from a
+                # failed larger-batch attempt can surface during the
+                # retry's init dispatch, and must hit the same handler
+                params = llama_init(cfg, jax.random.PRNGKey(0))
+                opt_state = jax.jit(optimizer.init)(params)
+                tokens = jax.random.randint(
+                    jax.random.PRNGKey(1), (batch_size, seq), 0,
+                    cfg.vocab_size, jnp.int32)
+                batch = {"inputs": tokens,
+                         "targets": jnp.roll(tokens, -1, axis=1)}
+                _mark(f"[{tag}] compiling + warmup (batch {batch_size})")
+                for _ in range(warmup):
+                    params, opt_state, loss = train_step(
+                        params, opt_state, batch)
+                float(loss)
+                break
+            except Exception as e:  # noqa: BLE001
+                oom = ("RESOURCE_EXHAUSTED" in str(e)
+                       or "Out of memory" in str(e)
+                       or "out of memory" in str(e))
+                if not oom or bi == len(cands) - 1:
+                    raise
+                _mark(f"[{tag}] batch {batch_size} OOM "
+                      f"({type(e).__name__}); falling back to batch "
+                      f"{cands[bi + 1]}")
+                # the donated params/opt buffers of the failed attempt
+                # are dropped with these references; next iteration
+                # re-inits (plain rebinds: some may be unbound if init
+                # itself OOMed)
+                params = opt_state = tokens = batch = None
 
-    # End each timed region with a device->host transfer of the loss: on
-    # tunneled/experimental platforms block_until_ready alone may return
-    # before the computation finishes, but a host read cannot.
-    for bi, batch_size in enumerate(batch_candidates):
-        try:
-            # init lives INSIDE the try: a deferred async OOM from a
-            # failed larger-batch attempt can surface during the retry's
-            # init dispatch, and must hit the same fallback handler
-            params = llama_init(config, jax.random.PRNGKey(0))
-            opt_state = jax.jit(optimizer.init)(params)
-            tokens = jax.random.randint(
-                jax.random.PRNGKey(1), (batch_size, seq), 0,
-                config.vocab_size, jnp.int32)
-            batch = {"inputs": tokens,
-                     "targets": jnp.roll(tokens, -1, axis=1)}
-            _mark(f"compiling + warmup (batch {batch_size})")
-            for _ in range(warmup):
-                params, opt_state, loss = train_step(params, opt_state,
-                                                     batch)
-            float(loss)
-            break
-        except Exception as e:  # noqa: BLE001
-            oom = ("RESOURCE_EXHAUSTED" in str(e)
-                   or "Out of memory" in str(e)
-                   or "out of memory" in str(e))
-            if not oom or bi == len(batch_candidates) - 1:
-                raise
-            _mark(f"batch {batch_size} OOM ({type(e).__name__}); "
-                  f"falling back to batch {batch_candidates[bi + 1]}")
-            # the donated params/opt buffers of the failed attempt are
-            # dropped with these references; next iteration re-inits
-            # (plain rebinds: some may be unbound if init itself OOMed)
-            params = opt_state = tokens = batch = None
+        _mark(f"[{tag}] timing")
+        t0 = time.monotonic()
+        for _ in range(steps):
+            params, opt_state, loss = train_step(params, opt_state, batch)
+        final_loss = float(loss)
+        dt = time.monotonic() - t0
+        tokens_per_step = batch_size * seq
+        tok_s = tokens_per_step * steps / dt
+        mfu_pct = (100.0 * tok_s * cfg.flops_per_token(seq)
+                   / peak_flops(dev))
+        return {
+            # labeled from the batch that actually ran (an OOM fallback
+            # must not report the requested batch)
+            "config": (f"xc{cfg.xent_chunk}-b{batch_size}" if on_tpu
+                       else tag),
+            "value": round(mfu_pct, 2),
+            "tokens_per_sec_per_chip": round(tok_s, 1),
+            "step_time_s": round(dt / steps, 4),
+            "batch_tokens": tokens_per_step,
+            "final_loss": round(final_loss, 4),
+        }, params
 
-    _mark("timing")
-    t0 = time.monotonic()
-    for _ in range(steps):
-        params, opt_state, loss = train_step(params, opt_state, batch)
-    final_loss = float(loss)
-    dt = time.monotonic() - t0
+    def headline(stats):
+        return {
+            "metric": METRIC,
+            "value": stats["value"],
+            "unit": "%MFU",
+            "vs_baseline": round(stats["value"] / 40.0, 3),
+            "tokens_per_sec_per_chip": stats["tokens_per_sec_per_chip"],
+            "step_time_s": stats["step_time_s"],
+            "model": "llama3_1b_proxy" if on_tpu else "tiny",
+            "config": stats["config"],
+            "batch_tokens": stats["batch_tokens"],
+            "device": getattr(dev, "device_kind", dev.platform),
+            "final_loss": stats["final_loss"],
+        }
 
-    tokens_per_step = batch_size * seq
-    tok_s = tokens_per_step * steps / dt
-    flops_s = tok_s * config.flops_per_token(seq)
-    mfu_pct = 100.0 * flops_s / peak_flops(dev)
+    child_deadline = float(os.environ.get(
+        "TONY_BENCH_CHILD_DEADLINE", "0"))
 
-    result = {
-        "metric": METRIC,
-        "value": round(mfu_pct, 2),
-        "unit": "%MFU",
-        "vs_baseline": round(mfu_pct / 40.0, 3),
-        "tokens_per_sec_per_chip": round(tok_s, 1),
-        "step_time_s": round(dt / steps, 4),
-        "model": "llama3_1b_proxy" if on_tpu else "tiny",
-        "batch_tokens": tokens_per_step,
-        "device": getattr(dev, "device_kind", dev.platform),
-        "final_loss": round(final_loss, 4),
-    }
+    def headroom() -> float:
+        """Seconds left before the parent's SIGTERM (inf if unknown)."""
+        if child_deadline <= 0:
+            return float("inf")
+        return child_deadline - (time.monotonic() - _T0)
+
+    t_a = time.monotonic()
+    stats, params = measure("main", config, batch_candidates)
+    cost_a = time.monotonic() - t_a
+    result = headline(stats)
+
+    if on_tpu:
+        # Best-of-two: the fused-CE backward deliberately recomputes
+        # chunk logits (uncounted FLOPs), so the pre-fused full-logits
+        # b4 config — the one the 68.08 record was set with — may still
+        # be the faster *measured* configuration. Try it when the
+        # parent-granted deadline leaves room for a second cycle whose
+        # compile may be COLD (~150s through the tunnel — a warm
+        # candidate-A cost is no predictor for a never-compiled config)
+        # plus the metadata benches that follow (~60s budget).
+        alt_cost = max(150.0, 1.2 * cost_a) + 30.0
+        if (not pinned and config.xent_chunk > 0
+                and headroom() > alt_cost + 60.0):
+            print(json.dumps(result), flush=True)   # crash-safe headline
+            try:
+                from dataclasses import replace as _replace
+                params = None   # release candidate-A buffers first
+                alt_stats, params = measure(
+                    "alt", _replace(config, xent_chunk=0), (4,))
+                better, worse = ((alt_stats, stats)
+                                 if alt_stats["value"] > stats["value"]
+                                 else (stats, alt_stats))
+                stats = better
+                result = headline(better)
+                result["alt_config"] = {
+                    k: worse[k] for k in ("config", "value",
+                                          "step_time_s", "batch_tokens")}
+            except Exception as e:  # alt config is opportunistic only
+                _mark(f"alt-config bench failed: {type(e).__name__}: {e}")
+                result["alt_config_error"] = _compact(
+                    f"{type(e).__name__}: {e}", 120)
+                if params is None:
+                    # decode metadata below needs live weights; re-init
+                    # (weights only, no opt state — cheap and small)
+                    try:
+                        params = llama_init(config, jax.random.PRNGKey(0))
+                    except Exception:  # noqa: BLE001
+                        pass
+        elif not pinned and config.xent_chunk > 0:
+            _mark(f"skipping alt config: headroom {headroom():.0f}s < "
+                  f"{alt_cost + 60.0:.0f}s")
 
     if on_tpu:
         # emit the HEADLINE now: each metadata bench below pays its own
@@ -239,24 +311,30 @@ def child_main(backend: str) -> None:
         # cost the measurement (the parent parses the LAST JSON line;
         # killed children yield their most recent print)
         print(json.dumps(result), flush=True)
-        try:
-            result.update(_bench_8b_layer(jax, jnp, optax, dev))
-        except Exception as e:  # metadata only — never sink the headline
-            _mark(f"8b layer bench failed: {type(e).__name__}: {e}")
-            result["llama3_8b_layer_error"] = _compact(
-                f"{type(e).__name__}: {e}", 160)
-        try:
-            result.update(_bench_longseq_layer(jax, jnp, optax, dev))
-        except Exception as e:  # metadata only
-            _mark(f"longseq bench failed: {type(e).__name__}: {e}")
-            result["longseq_error"] = _compact(f"{type(e).__name__}: {e}",
-                                               160)
-        try:
-            result.update(_bench_decode(jax, jnp, config, params))
-        except Exception as e:  # metadata only
-            _mark(f"decode bench failed: {type(e).__name__}: {e}")
-            result["decode_error"] = _compact(f"{type(e).__name__}: {e}",
-                                              160)
+        # Each metadata bench pays its own compile (~60s cold through
+        # the tunnel). Gate on headroom so the child finishes CLEAN
+        # before the parent's SIGTERM — a deadline kill mid-metadata
+        # labels the complete headline 'partial' and blocks the
+        # last-good snapshot.
+        meta_benches = (
+            ("llama3_8b_layer",
+             lambda: _bench_8b_layer(jax, jnp, optax, dev)),
+            ("longseq",
+             lambda: _bench_longseq_layer(jax, jnp, optax, dev)),
+            ("decode", lambda: _bench_decode(jax, jnp, config, params)),
+        )
+        for name, fn in meta_benches:
+            if headroom() < 75.0:
+                _mark(f"skipping {name} bench: headroom "
+                      f"{headroom():.0f}s")
+                result[f"{name}_skipped"] = "deadline headroom"
+                continue
+            try:
+                result.update(fn())
+            except Exception as e:  # metadata — never sink the headline
+                _mark(f"{name} bench failed: {type(e).__name__}: {e}")
+                result[f"{name}_error"] = _compact(
+                    f"{type(e).__name__}: {e}", 160)
         print(json.dumps(result), flush=True)   # headline + metadata so far
         # live duty-cycle path (task_monitor's wedge-detection source):
         # present on real TPU VMs via the libtpu metrics daemon; absent
@@ -536,6 +614,9 @@ def _run_child(backend: str, deadline: float,
                extra_env: dict | None = None) -> tuple[dict | None, str]:
     """Run one measurement child. Returns (result_json_or_None, diag)."""
     env = dict(os.environ)
+    # the child plans opportunistic extra work (alt-config measurement)
+    # against the deadline it actually has
+    env["TONY_BENCH_CHILD_DEADLINE"] = f"{deadline:.0f}"
     if extra_env:
         env.update(extra_env)
     if backend in ("cpu", "startup"):
@@ -609,7 +690,8 @@ def _emit(result: dict) -> None:
     driver that keeps only a tail of stdout (~2 KB in BENCH_r03, where a
     stack-dump-bearing 4 KB line arrived truncated and parsed as null).
     Anything long goes to stderr + tools/bench_diag.log, never stdout."""
-    drop_order = ("tpu_error", "cpu_error", "head_partial_tpu_measurement",
+    drop_order = ("tpu_error", "cpu_error", "alt_config",
+                  "head_partial_tpu_measurement",
                   "last_good_tpu_measurement", "am_startup_latency", "error")
     line = json.dumps(result, separators=(",", ":"))
     for key in drop_order:
