@@ -274,8 +274,11 @@ def child_main(backend: str) -> None:
         # candidate-A cost is no predictor for a never-compiled config)
         # plus the metadata benches that follow (~60s budget).
         alt_cost = max(150.0, 1.2 * cost_a) + 30.0
+        # 90s reserve: the per-metadata-bench gate below needs 75s of
+        # headroom to run at all, so reserving less would silently
+        # starve every metadata section whenever the alt runs
         if (not pinned and config.xent_chunk > 0
-                and headroom() > alt_cost + 60.0):
+                and headroom() > alt_cost + 90.0):
             print(json.dumps(result), flush=True)   # crash-safe headline
             try:
                 from dataclasses import replace as _replace
@@ -285,7 +288,6 @@ def child_main(backend: str) -> None:
                 better, worse = ((alt_stats, stats)
                                  if alt_stats["value"] > stats["value"]
                                  else (stats, alt_stats))
-                stats = better
                 result = headline(better)
                 result["alt_config"] = {
                     k: worse[k] for k in ("config", "value",

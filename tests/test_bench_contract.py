@@ -49,6 +49,77 @@ def test_emit_small_result_untouched(capsys):
     assert json.loads(line) == result
 
 
+def test_record_last_good_partial_never_shadows_complete(tmp_path,
+                                                         monkeypatch):
+    """r5 regression: a deadline-killed (partial) or degraded-kernel
+    measurement overwrote the clean 68.08 record."""
+    path = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "_LAST_GOOD_PATH", str(path))
+    complete = {"metric": bench.METRIC, "value": 68.08, "unit": "%MFU",
+                "device": "TPU v5 lite"}
+    bench._record_last_good(dict(complete))
+    assert bench._load_last_good()["value"] == 68.08
+
+    # partial must NOT overwrite a complete record — even a faster one
+    bench._record_last_good({"metric": bench.METRIC, "value": 70.0,
+                             "unit": "%MFU", "device": "TPU v5 lite",
+                             "partial": "timed out after 164s"})
+    assert bench._load_last_good()["value"] == 68.08
+    assert "partial" not in bench._load_last_good()
+
+    # a new complete record DOES overwrite
+    bench._record_last_good({"metric": bench.METRIC, "value": 69.5,
+                             "unit": "%MFU", "device": "TPU v5 lite"})
+    assert bench._load_last_good()["value"] == 69.5
+
+    # cpu-device results are never recorded
+    bench._record_last_good({"metric": bench.METRIC, "value": 99.0,
+                             "unit": "%MFU", "device": "cpu"})
+    assert bench._load_last_good()["value"] == 69.5
+
+
+def test_record_last_good_partial_upgrades_partial(tmp_path, monkeypatch):
+    """Partials may replace partials (a better one is strictly more
+    evidence) but the 'partial' label must survive into the compact
+    embed so the driver record never presents one as complete."""
+    path = tmp_path / "last_good.json"
+    monkeypatch.setattr(bench, "_LAST_GOOD_PATH", str(path))
+    bench._record_last_good({"metric": bench.METRIC, "value": 50.0,
+                             "unit": "%MFU", "device": "TPU v5 lite",
+                             "partial": "timed out after 100s"})
+    bench._record_last_good({"metric": bench.METRIC, "value": 58.5,
+                             "unit": "%MFU", "device": "TPU v5 lite",
+                             "partial": "timed out after 164s"})
+    last = bench._load_last_good()
+    assert last["value"] == 58.5
+    assert bench._compact_last_good(last)["partial"] \
+        == "timed out after 164s"
+
+
+def test_head_partial_recency_gate(tmp_path, monkeypatch):
+    """Only snapshots written in the last 48h qualify as at-HEAD
+    evidence; the newest fresh one wins by mtime, not filename."""
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    real_abspath = os.path.abspath   # bench.os IS the stdlib os: the
+    monkeypatch.setattr(             # fallback must call the ORIGINAL
+        bench.os.path, "abspath",
+        lambda p: str(tmp_path / "bench.py") if p.endswith("bench.py")
+        else real_abspath(p))
+    stale = tools / "bench_head_partial_r5.json"
+    stale.write_text(json.dumps({"value": 11.1, "commit": "old"}))
+    os.utime(stale, (0, 0))   # epoch: far past the 48h window
+    assert bench._head_partial() is None
+
+    # a fresh snapshot qualifies; r10 vs r5 must sort by mtime not name
+    fresh = tools / "bench_head_partial_r10.json"
+    fresh.write_text(json.dumps({"value": 58.53, "commit": "3bc892f",
+                                 "partial": "contended", "extra": "x"}))
+    got = bench._head_partial()
+    assert got["value"] == 58.53 and got["commit"] == "3bc892f"
+    assert "extra" not in got
+
+
 def test_compact_last_good_keeps_headline_only():
     last = {"metric": "m", "value": 68.08, "unit": "%MFU",
             "commit": "abc", "measured_at": "t", "step_time_s": 1.0,
