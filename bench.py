@@ -120,6 +120,18 @@ def probe_main() -> None:
     x = jnp.ones((128, 128))
     val = float((x @ x).sum())
     _mark(f"probe: execute ok ({val})")
+
+    # A fixed-shape matmul can be served from the persistent compile cache,
+    # so it proves the execute path but not the *compile* path — which is
+    # exactly the stage that wedged in r4/r5 (attempt stuck in from_hlo).
+    # Compile a shape keyed to the current minute so successive probes
+    # (the watcher fires one every >=300s) virtually never share a cache
+    # entry and each probe exercises a live tunnel compile.
+    k = 8 * ((int(time.time()) // 60) % 1440 + 1)
+    _mark(f"probe: fresh uncached compile (k={k})")
+    y = jnp.ones((k, 128))
+    val = float((y @ x).sum())
+    _mark(f"probe: fresh compile ok ({val})")
     print("PROBE-OK", flush=True)
 
 

@@ -18,7 +18,9 @@ while :; do
   fi
   # -k: the probe child registers a faulthandler on SIGTERM (stack dump,
   # no exit), so plain timeout's SIGTERM is swallowed — SIGKILL after 10s
-  out=$(timeout -k 10 75 python bench.py --probe 2>&1)
+  # 150s: the probe now includes a guaranteed-uncached compile, which on
+  # a healthy-but-slow tunnel can cost ~40s+ on its own
+  out=$(timeout -k 10 150 python bench.py --probe 2>&1)
   if echo "$out" | grep -q "PROBE-OK"; then
     echo "[watch] tunnel healthy at $(date -u +%H:%MZ); running full bench"
     # Cold compile through the tunnel is ~135s (r5): give the bench a
@@ -27,14 +29,21 @@ while :; do
     if TONY_BENCH_WATCHDOG_SEC=$BUDGET timeout -k 15 $((BUDGET + 100)) \
         python bench.py > "tools/bench_watch_result.json" 2> \
         "tools/bench_watch_stderr.log" \
-        && grep -q '"value"' tools/bench_watch_result.json; then
-      echo "[watch] bench done"
+        && python -c "
+import json, sys
+try:
+    rec = json.loads(open('tools/bench_watch_result.json').read().strip().splitlines()[-1])
+except Exception:
+    sys.exit(1)
+sys.exit(0 if rec.get('value', 0) > 0 and not rec.get('partial') else 1)"; then
+      echo "[watch] bench done (positive on-chip value)"
       cat tools/bench_watch_result.json
       exit 0
     fi
-    # healthy probe but failed/partial bench: keep watching, don't report
-    # a measurement that doesn't exist
-    echo "[watch] bench failed after healthy probe; will retry"
+    # healthy probe but failed/partial/zero bench: keep watching — a
+    # wedged-tunnel record has value 0.0 and must NOT stop the watch
+    # (r5: grep '\"value\"' matched the 0.0 record and the watch exited).
+    echo "[watch] bench failed or zero after healthy probe; will retry"
   fi
   echo "[watch] tunnel down at $(date -u +%H:%MZ); retry in ${INTERVAL}s"
   sleep "$INTERVAL"
