@@ -732,8 +732,8 @@ _flash_core.defvjp(_fwd_rule, _bwd_rule)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, sm_scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None) -> jax.Array:
     """Memory-efficient attention. q: (B, H, S, D); k/v: (B, Hkv, S, D)
     with H % Hkv == 0 — GQA is native: the pallas kernels stream the narrow
     K/V via the grid index map (no repeated K/V bytes in HBM), and dK/dV
@@ -743,6 +743,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     so training works at any length)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    # resolved at call time (not def time) so tuning harnesses can sweep
+    # the module-level defaults without threading args through every model
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
     s = q.shape[2]
     if s <= min(block_q, block_k):
         pad = 0   # kernels clamp both block sizes down to s

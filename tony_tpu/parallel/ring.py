@@ -39,19 +39,22 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+import tony_tpu.ops.attention as _attn
 from tony_tpu.ops.attention import (
-    DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, NEG_INF, _backward_dispatch, _forward,
-    merge_partials,
+    NEG_INF, _backward_dispatch, _forward, merge_partials,
 )
 from tony_tpu.ops.vma import match_vma
 
 
 def _blocks(s: int) -> tuple[int, int]:
     """Largest standard block sizes that divide the local chunk (the flash
-    entry clamps block > s down to s, so s itself always works)."""
-    for b in (DEFAULT_BLOCK_Q, 256, 128):
+    entry clamps block > s down to s, so s itself always works). Reads the
+    defaults off the module at call time so block-size sweeps that mutate
+    them (tools/tune_mfu.py) reach the ring path too."""
+    bq, bk = _attn.DEFAULT_BLOCK_Q, _attn.DEFAULT_BLOCK_K
+    for b in (bq, 256, 128):
         if s % b == 0:
-            return min(b, DEFAULT_BLOCK_Q), min(b, DEFAULT_BLOCK_K)
+            return min(b, bq), min(b, bk)
     return s, s
 
 

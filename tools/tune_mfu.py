@@ -42,6 +42,16 @@ VARIANTS: dict[str, dict] = {
     "unfused_b8": dict(batch=8, seq=4096, xent_chunk=0),
     "xc512_b8":  dict(batch=8, seq=4096, xent_chunk=512),
     "xc2048_b8": dict(batch=8, seq=4096, xent_chunk=2048),
+    # flash-kernel tile sweep (DEFAULT_BLOCK_Q/K = 512 measured 2.05x over
+    # 128 on v5e; 1024 and 256 untried on the current kernel stack)
+    "blk1024_b4": dict(batch=4, seq=4096, flash_block=1024),
+    "blk256_b4": dict(batch=4, seq=4096, flash_block=256),
+    "blkq1024k512_b4": dict(batch=4, seq=4096, flash_block_q=1024,
+                            flash_block_k=512),
+    # batch/seq grid corners never measured on-chip
+    "b6":        dict(batch=6, seq=4096),
+    "seq8k_b4":  dict(batch=4, seq=8192),
+    "seq2k_b8":  dict(batch=8, seq=2048),
 }
 
 
@@ -54,13 +64,23 @@ def run(name: str, spec: dict) -> dict:
     if "xent_chunk" in spec:
         overrides["xent_chunk"] = spec["xent_chunk"]
     config = get_config("llama3_1b_proxy", max_seq=spec["seq"], **overrides)
-    policy = spec.get("policy")
-    if policy is not None:
-        import tony_tpu.models.llama as llama_mod
-        pol = getattr(jax.checkpoint_policies, policy)
-        real_ckpt = jax.checkpoint
-        llama_mod.jax.checkpoint = partial(real_ckpt, policy=pol)
+    # all fallible per-variant setup (policy lookup included) runs inside
+    # the try so one bad variant reports its error line and the finally
+    # restores every global for the next variant
+    import tony_tpu.models.llama as llama_mod
+    import tony_tpu.ops.attention as attn_mod
+    real_ckpt = None
+    saved_blocks = (attn_mod.DEFAULT_BLOCK_Q, attn_mod.DEFAULT_BLOCK_K)
     try:
+        policy = spec.get("policy")
+        if policy is not None:
+            pol = getattr(jax.checkpoint_policies, policy)
+            real_ckpt = jax.checkpoint
+            llama_mod.jax.checkpoint = partial(real_ckpt, policy=pol)
+        attn_mod.DEFAULT_BLOCK_Q = spec.get(
+            "flash_block_q", spec.get("flash_block", saved_blocks[0]))
+        attn_mod.DEFAULT_BLOCK_K = spec.get(
+            "flash_block_k", spec.get("flash_block", saved_blocks[1]))
         params = llama_init(config, jax.random.PRNGKey(0))
         optimizer = optax.adamw(3e-4)
         step = make_train_step(partial(llama_loss, config=config), optimizer)
@@ -86,7 +106,8 @@ def run(name: str, spec: dict) -> dict:
     except Exception as e:  # noqa: BLE001 — report and move on (e.g. OOM)
         return {"variant": name, "error": f"{type(e).__name__}: {str(e)[:200]}"}
     finally:
-        if policy is not None:
+        attn_mod.DEFAULT_BLOCK_Q, attn_mod.DEFAULT_BLOCK_K = saved_blocks
+        if real_ckpt is not None:
             llama_mod.jax.checkpoint = real_ckpt
 
 
