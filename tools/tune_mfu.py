@@ -52,6 +52,17 @@ VARIANTS: dict[str, dict] = {
     "b6":        dict(batch=6, seq=4096),
     "seq8k_b4":  dict(batch=4, seq=8192),
     "seq2k_b8":  dict(batch=8, seq=2048),
+    # 8B-geometry single layer (bench's llama3_8b_layer metric, 63.04%
+    # at r4's b1/blk512) — can a bigger batch or tile lift it?
+    "L8b_b1":    dict(model="8b_layer", batch=1, seq=4096),
+    "L8b_b2":    dict(model="8b_layer", batch=2, seq=4096),
+    "L8b_b4":    dict(model="8b_layer", batch=4, seq=4096),
+    "L8b_blk1024_b2": dict(model="8b_layer", batch=2, seq=4096,
+                           flash_block=1024),
+    "L8b_noremat_b1": dict(model="8b_layer", batch=1, seq=4096,
+                           remat=False),
+    "L8b_noremat_b2": dict(model="8b_layer", batch=2, seq=4096,
+                           remat=False),
 }
 
 
@@ -63,7 +74,14 @@ def run(name: str, spec: dict) -> dict:
         overrides["remat_policy"] = spec["remat_policy"]
     if "xent_chunk" in spec:
         overrides["xent_chunk"] = spec["xent_chunk"]
-    config = get_config("llama3_1b_proxy", max_seq=spec["seq"], **overrides)
+    if spec.get("model") == "8b_layer":
+        # mirror bench._bench_8b_layer's geometry: one 8B layer, small
+        # vocab so embed/head don't dominate
+        config = get_config("llama3_8b", n_layers=1, vocab_size=8192,
+                            max_seq=spec["seq"], **overrides)
+    else:
+        config = get_config("llama3_1b_proxy", max_seq=spec["seq"],
+                            **overrides)
     # all fallible per-variant setup (policy lookup included) runs inside
     # the try so one bad variant reports its error line and the finally
     # restores every global for the next variant
