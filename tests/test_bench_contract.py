@@ -13,6 +13,27 @@ _spec = importlib.util.spec_from_file_location(
 bench = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(bench)
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolated_bench_paths(tmp_path, monkeypatch):
+    """EVERY snapshot path bench can write rides through these module
+    globals; redirecting them wholesale means no test can ever leak a
+    fabricated measurement into the real tools/ evidence directory
+    (r5: a 70.0 'partial' from this file briefly landed there)."""
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    monkeypatch.setattr(bench, "_TOOLS_DIR", str(tools))
+    monkeypatch.setattr(bench, "_LAST_GOOD_PATH",
+                        str(tools / "last_good_bench.json"))
+    monkeypatch.setattr(bench, "_DIAG_LOG_PATH",
+                        str(tools / "bench_diag.log"))
+    monkeypatch.setattr(bench, "_HEAD_PARTIAL_AUTO_PATH",
+                        str(tools / "bench_head_partial_auto.json"))
+    monkeypatch.setattr(bench, "_commit_stamp", lambda: "testhead")
+    yield tools
+
 
 def test_compact_is_single_bounded_line():
     s = bench._compact("a\nb\r\n  c  \n" + "x" * 500, 40)
@@ -96,16 +117,10 @@ def test_record_last_good_partial_upgrades_partial(tmp_path, monkeypatch):
         == "timed out after 164s"
 
 
-def test_head_partial_recency_gate(tmp_path, monkeypatch):
+def test_head_partial_recency_gate(_isolated_bench_paths):
     """Only snapshots written in the last 48h qualify as at-HEAD
     evidence; the newest fresh one wins by mtime, not filename."""
-    tools = tmp_path / "tools"
-    tools.mkdir()
-    real_abspath = os.path.abspath   # bench.os IS the stdlib os: the
-    monkeypatch.setattr(             # fallback must call the ORIGINAL
-        bench.os.path, "abspath",
-        lambda p: str(tmp_path / "bench.py") if p.endswith("bench.py")
-        else real_abspath(p))
+    tools = _isolated_bench_paths
     stale = tools / "bench_head_partial_r5.json"
     stale.write_text(json.dumps({"value": 11.1, "commit": "old"}))
     os.utime(stale, (0, 0))   # epoch: far past the 48h window
@@ -118,6 +133,58 @@ def test_head_partial_recency_gate(tmp_path, monkeypatch):
     got = bench._head_partial()
     assert got["value"] == 58.53 and got["commit"] == "3bc892f"
     assert "extra" not in got
+
+
+def test_partial_auto_persists_to_head_partial(_isolated_bench_paths):
+    """A deadline-truncated on-chip measurement is live at-HEAD evidence:
+    _record_last_good must side-channel it to bench_head_partial_auto.json
+    (without letting it shadow the complete last-good); a lower fresh
+    partial from the SAME commit must not replace a higher one, but after
+    the code changes the fresh measurement always wins."""
+    tools = _isolated_bench_paths
+    complete = {"metric": bench.METRIC, "value": 68.08, "unit": "%MFU",
+                "device": "TPU v5 lite"}
+    bench._record_last_good(dict(complete))
+
+    partial = {"metric": bench.METRIC, "value": 58.53, "unit": "%MFU",
+               "device": "TPU v5 lite", "batch_tokens": 32768,
+               "partial": "timed out after 164s"}
+    bench._record_last_good(dict(partial))
+    # last-good untouched, head-partial written with stamps
+    assert bench._load_last_good()["value"] == 68.08
+    auto = json.loads((tools / "bench_head_partial_auto.json").read_text())
+    assert auto["value"] == 58.53 and auto["partial"]
+    assert auto["measured_at"] and auto["commit"] == "testhead"
+    assert bench._head_partial()["value"] == 58.53
+
+    # a LOWER fresh partial from the same commit must not replace it
+    bench._record_last_good({"metric": bench.METRIC, "value": 30.0,
+                             "unit": "%MFU", "device": "TPU v5 lite",
+                             "partial": "timed out after 60s"})
+    assert bench._head_partial()["value"] == 58.53
+
+    # a higher partial upgrades it
+    bench._record_last_good({"metric": bench.METRIC, "value": 61.2,
+                             "unit": "%MFU", "device": "TPU v5 lite",
+                             "partial": "timed out after 200s",
+                             "kernel_fallback": "blockwise"})
+    got = bench._head_partial()
+    # the degraded-kernel marker must survive persist AND read-back
+    assert got["value"] == 61.2 and got["kernel_fallback"] == "blockwise"
+
+    # after a code change (different commit), a lower fresh partial WINS:
+    # stale evidence must not masquerade as at-HEAD
+    bench._commit_stamp = lambda: "newhead"
+    bench._record_last_good({"metric": bench.METRIC, "value": 44.0,
+                             "unit": "%MFU", "device": "TPU v5 lite",
+                             "partial": "timed out after 90s"})
+    assert bench._head_partial()["value"] == 44.0
+
+    # cpu-device partials never persist
+    bench._record_last_good({"metric": bench.METRIC, "value": 99.0,
+                             "unit": "%MFU", "device": "cpu",
+                             "partial": "x"})
+    assert bench._head_partial()["value"] == 44.0
 
 
 def test_compact_last_good_keeps_headline_only():
