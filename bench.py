@@ -762,6 +762,7 @@ def _record_last_good(result: dict) -> None:
     labeled metadata."""
     if str(result.get("device", "")).lower() in ("cpu", ""):
         return
+    commit = _commit_stamp()
     if result.get("kernel_fallback") or result.get("partial"):
         # a degraded-kernel or deadline-truncated measurement must not
         # shadow a complete one (r5: a killed batch-8 attempt overwrote
@@ -769,7 +770,7 @@ def _record_last_good(result: dict) -> None:
         # partial IS live at-HEAD evidence: persist it to the head-partial
         # side channel that _head_partial() reads on wedged runs
         if result.get("partial"):
-            _record_head_partial(result)
+            _record_head_partial(result, commit)
         prev = _load_last_good()
         if prev and not prev.get("partial") and not prev.get(
                 "kernel_fallback"):
@@ -778,7 +779,7 @@ def _record_last_good(result: dict) -> None:
             return
     snap = dict(result)
     snap["measured_at"] = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
-    snap["commit"] = _commit_stamp()
+    snap["commit"] = commit
     try:
         with open(_LAST_GOOD_PATH, "w", encoding="utf-8") as f:
             json.dump(snap, f, indent=2)
@@ -794,17 +795,23 @@ def _load_last_good():
         return None
 
 
-def _record_head_partial(result: dict) -> None:
+def _record_head_partial(result: dict, commit: str) -> None:
     """Persist a deadline-truncated on-chip measurement so a later
     wedged-tunnel run can attach live at-HEAD evidence (_head_partial
     reads the freshest bench_head_partial_*.json). A higher existing
     partial only suppresses a lower one FROM THE SAME COMMIT — after the
     code changes, the fresh measurement wins regardless, so stale
-    evidence can never masquerade as at-HEAD."""
+    evidence can never masquerade as at-HEAD. The guard compares against
+    the auto file this function owns (NOT _head_partial(), whose
+    freshest-by-mtime pick can be a manual snapshot from another
+    commit that would defeat the same-commit suppression)."""
     if str(result.get("device", "")).lower() in ("cpu", ""):
         return
-    commit = _commit_stamp()
-    prev = _head_partial()
+    try:
+        with open(_HEAD_PARTIAL_AUTO_PATH, encoding="utf-8") as f:
+            prev = json.load(f)
+    except Exception:  # noqa: BLE001
+        prev = None
     if (prev and prev.get("commit") == commit
             and prev.get("value", 0.0) > result.get("value", 0.0)):
         return

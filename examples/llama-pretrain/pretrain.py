@@ -75,6 +75,9 @@ def main() -> int:
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
+    # device evidence is logged by Trainer.setup() AFTER distributed
+    # init — touching jax.devices() here would initialize the local
+    # backend and break jax.distributed.initialize() on multi-worker runs
     config = get_config(args.config, **({"n_layers": args.n_layers}
                                         if args.n_layers else {}))
     seq = args.seq_len or config.max_seq

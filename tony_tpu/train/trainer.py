@@ -106,6 +106,12 @@ class Trainer:
     # ------------------------------------------------------------------
     def setup(self) -> None:
         maybe_initialize_distributed()
+        # device evidence AFTER distributed init — jax.devices() here
+        # would otherwise initialize the local backend first and make a
+        # later jax.distributed.initialize() raise on multi-worker runs
+        LOG.info("devices: %d x %s (backend=%s)", jax.device_count(),
+                 getattr(jax.devices()[0], "device_kind", "?"),
+                 jax.default_backend())
         self._maybe_start_profiler()
         from tony_tpu.train.metrics import TpuMetricsReporter
         self._metrics_reporter = TpuMetricsReporter()
