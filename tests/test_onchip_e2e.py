@@ -1,0 +1,40 @@
+"""tools/onchip_e2e.py mechanics, driven on the CPU backend.
+
+The tool's purpose is the real-chip lifecycle proof (client -> AM ->
+executor -> worker claiming the TPU tunnel), which can't run under the
+test suite's forced-CPU env — but every moving part EXCEPT the chip can:
+the probe gate, the submission, the log scrape, and the honest ok=False
+verdict when the backend isn't a TPU. Pinning those here means a healthy
+tunnel window can't be wasted on a broken tool."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "onchip_e2e.py")
+
+
+def test_onchip_e2e_cpu_mechanics(tmp_path, monkeypatch):
+    result_path = tmp_path / "onchip_result.json"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    env.update(JAX_PLATFORMS="cpu", TONY_ONCHIP_STEPS="2",
+               TONY_ONCHIP_CONFIG="tiny", TONY_ONCHIP_SEQ="128",
+               # never the real tools/ slot: a rehearsal must not clobber
+               # genuine on-chip evidence from a healthy-tunnel window
+               TONY_ONCHIP_RESULT=str(result_path))
+    proc = subprocess.run([sys.executable, TOOL], env=env,
+                          capture_output=True, text=True, timeout=360)
+    # honest verdict: the chain ran, but a CPU backend is NOT on-chip
+    # evidence, so the tool must exit nonzero with ok=False
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["ok"] is False
+    assert rec["final_status"] == "SUCCEEDED"
+    assert rec["device"]["backend"] == "cpu"
+    assert rec["final_loss"] > 0
+    assert rec["commit"]
+    assert json.loads(result_path.read_text())["ok"] is False

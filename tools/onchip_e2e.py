@@ -31,7 +31,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-RESULT_PATH = os.path.join(REPO, "tools", "onchip_e2e_result.json")
+RESULT_PATH = os.environ.get(
+    "TONY_ONCHIP_RESULT",
+    os.path.join(REPO, "tools", "onchip_e2e_result.json"))
 
 
 def _write(result: dict) -> None:
