@@ -335,7 +335,8 @@ def child_main(backend: str) -> None:
              lambda: _bench_8b_layer(jax, jnp, optax, dev)),
             ("longseq",
              lambda: _bench_longseq_layer(jax, jnp, optax, dev)),
-            ("decode", lambda: _bench_decode(jax, jnp, config, params)),
+            ("decode", lambda: _bench_decode(jax, jnp, config, params,
+                                             headroom)),
         )
         for name, fn in meta_benches:
             if headroom() < 75.0:
@@ -499,7 +500,7 @@ def _width_gang_run(width: int) -> dict:
     return out
 
 
-def _bench_decode(jax, jnp, config, params) -> dict:
+def _bench_decode(jax, jnp, config, params, headroom=None) -> dict:
     """KV-cache generation throughput on the bench model (metadata next
     to the training MFU headline: the inference half of the lifecycle).
     The timed region is one whole generate() call — prefill of the
@@ -518,7 +519,7 @@ def _bench_decode(jax, jnp, config, params) -> dict:
     toks = generate(params, config, prompt, n)
     int(jax.device_get(toks)[0, 0])
     dt = time.monotonic() - t0
-    return {
+    out = {
         # new tokens / whole-call time: prefill amortized in, hence
         # "generate_", not "decode_"
         "generate_new_tokens_per_sec": round(b * n / dt, 1),
@@ -526,6 +527,32 @@ def _bench_decode(jax, jnp, config, params) -> dict:
         "generate_batch": b, "generate_prompt_len": p,
         "generate_new_tokens": n,
     }
+    if headroom is not None and headroom() < 100.0:
+        # the int8 variant pays its own cold compile (new pytree
+        # structure => retrace); running it into the parent deadline
+        # would label the COMPLETE headline 'partial' and block the
+        # last-good snapshot — never worth opportunistic metadata
+        out["generate_int8_skipped"] = "deadline headroom"
+        return out
+    try:
+        # weight-only int8 variant (models/quant.py): decode is
+        # weight-bandwidth-bound, so this is the halved-bytes A/B
+        from tony_tpu.models.quant import quantize_params
+        _mark("timing int8 weight-only generate")
+        qparams = quantize_params(params)
+        toks = generate(qparams, config, prompt, n)   # compile + warmup
+        int(jax.device_get(toks)[0, 0])
+        t0 = time.monotonic()
+        toks = generate(qparams, config, prompt, n)
+        int(jax.device_get(toks)[0, 0])
+        dt = time.monotonic() - t0
+        out["generate_int8_new_tokens_per_sec"] = round(b * n / dt, 1)
+        out["generate_int8_ms_per_new_token"] = round(dt / n * 1000.0, 3)
+    except Exception as e:  # variant is opportunistic metadata only
+        _mark(f"int8 generate failed: {type(e).__name__}: {e}")
+        out["generate_int8_error"] = _compact(
+            f"{type(e).__name__}: {e}", 120)
+    return out
 
 
 def _bench_layer(jax, jnp, optax, dev, seq: int, iters: int,

@@ -44,6 +44,10 @@ def main() -> int:
     parser.add_argument("--max-new", type=int, default=16)
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--quant", default="", choices=("", "int8"),
+                        help="int8 = weight-only quantized decode "
+                             "(models/quant.py): ~half the weight "
+                             "bytes per generated token")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -59,6 +63,13 @@ def main() -> int:
         state = restore_checkpoint(args.checkpoint_dir, step)
         params = jax.tree.map(jnp.asarray, state["params"])
         print(f"restored checkpoint step {step}")
+
+    if args.quant == "int8":
+        from tony_tpu.models.quant import quantize_params, quantized_bytes
+        params = quantize_params(params)
+        now, full = quantized_bytes(params)
+        print(f"int8 weight-only: {now / 1e6:.1f} MB streamed per token "
+              f"vs {full / 1e6:.1f} MB bf16")
 
     prompt = jax.random.randint(jax.random.PRNGKey(1),
                                 (args.batch_size, args.prompt_len), 0,

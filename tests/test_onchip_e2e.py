@@ -16,7 +16,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOL = os.path.join(REPO, "tools", "onchip_e2e.py")
 
 
-def test_onchip_e2e_cpu_mechanics(tmp_path, monkeypatch):
+def test_onchip_e2e_cpu_mechanics(tmp_path):
     result_path = tmp_path / "onchip_result.json"
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)

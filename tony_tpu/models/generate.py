@@ -33,6 +33,7 @@ from jax import lax
 from tony_tpu.models.llama import (
     LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables, swiglu_mlp,
 )
+from tony_tpu.models.quant import dequantize_layer, maybe_dequantize
 from tony_tpu.ops.attention import NEG_INF, flash_attention
 from tony_tpu.ops.rmsnorm import rms_norm
 from tony_tpu.ops.rope import apply_rope
@@ -70,6 +71,9 @@ def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
     x = embed_lookup(params["embed"], tokens, config)
 
     def body(x, layer):
+        # int8-quantized layers (models/quant.py) dequantize HERE, inside
+        # the scan body, so XLA fuses the int8 read into each matmul
+        layer = dequantize_layer(layer)
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = qkv_proj(h, layer, config)
         q = apply_rope(q, cos[:p], sin[:p])
@@ -83,7 +87,8 @@ def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = jnp.einsum("bd,dv->bv", x[:, -1], params["output"],
+    logits = jnp.einsum("bd,dv->bv", x[:, -1],
+                        maybe_dequantize(params["output"]),
                         preferred_element_type=jnp.float32)
 
     pad = cache_len - p
@@ -106,6 +111,7 @@ def decode_step(params: Params, config: LlamaConfig,
 
     def body(x, layer_and_cache):
         layer, kc, vc = layer_and_cache
+        layer = dequantize_layer(layer)
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = qkv_proj(h, layer, config)
         q = apply_rope(q, cos_p, sin_p)
@@ -124,7 +130,8 @@ def decode_step(params: Params, config: LlamaConfig,
     x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
                                      cache["v"]))
     x = rms_norm(x, params["final_norm"], config.norm_eps)
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["output"],
+    logits = jnp.einsum("bd,dv->bv", x[:, 0],
+                        maybe_dequantize(params["output"]),
                         preferred_element_type=jnp.float32)
     return logits, {"k": ks, "v": vs}
 

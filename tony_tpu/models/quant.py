@@ -1,0 +1,96 @@
+"""Weight-only int8 quantization for the inference path.
+
+TPU-first rationale: single-token KV-cache decode is HBM-bandwidth-bound
+on WEIGHT reads — every step streams every layer's matmul weights to
+produce one token — so halving weight bytes (bf16 2B -> int8 1B per
+element) roughly doubles the decode throughput ceiling on v5e. The
+dequantize happens INSIDE the jitted decode body, per layer, where XLA
+fuses the int8 load + channel-scale multiply into the matmul operand
+read: the bf16 weight tensor is never materialized in HBM.
+
+Scheme: symmetric per-output-channel. For a weight W (.., d_in, d_out)
+contracted over d_in, scale_j = max_i |W[.., i, j]| / 127 (kept-dims so
+the same broadcast works stacked (L, d, f) and unstacked (d, f)), and
+Q = clip(round(W / scale), -127, 127) in int8. Per-channel scaling keeps
+the quantization error of each output feature proportional to that
+feature's own dynamic range — the standard weight-only recipe.
+
+Quantized leaves are plain dicts {"int8": ..., "scale": ...} so they
+ride every jax pytree mechanism (scan over stacked layers, jit
+donation, checkpointing) without custom node registration.
+
+Reference parity: none — the reference is an orchestrator with no model
+code (SURVEY.md §2.3); this is a rebuild-only capability on top of
+models/generate.py's KV-cache decode.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# layer-dict weight names that feed matmuls (contracted over their
+# second-to-last axis); norms are vectors and stay full precision
+LAYER_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize(w: jax.Array) -> dict[str, jax.Array]:
+    """W (.., d_in, d_out) -> {"int8", "scale"} with per-output-channel
+    symmetric scales (kept-dims over the contraction axis)."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"int8": q, "scale": scale}
+
+
+def is_qtensor(leaf: Any) -> bool:
+    return (isinstance(leaf, dict) and set(leaf) == {"int8", "scale"})
+
+
+def dequantize(t: dict[str, jax.Array],
+               dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """Fusable dequant: int8 -> dtype multiply by the channel scale."""
+    return t["int8"].astype(dtype) * t["scale"].astype(dtype)
+
+
+def maybe_dequantize(leaf: Any, dtype: jnp.dtype = jnp.bfloat16) -> Any:
+    return dequantize(leaf, dtype) if is_qtensor(leaf) else leaf
+
+
+def dequantize_layer(layer: dict, dtype: jnp.dtype = jnp.bfloat16) -> dict:
+    """Shallow map over one layer's dict (works on a scan-sliced layer:
+    stacked (L, d, f)/(L, 1, f) leaves slice to (d, f)/(1, f) and the
+    dequant broadcast still lines up)."""
+    return {k: maybe_dequantize(v, dtype) for k, v in layer.items()}
+
+
+def quantize_params(params: dict, include_output: bool = True) -> dict:
+    """Quantize a Llama param tree's matmul weights for inference.
+
+    The embedding table stays full precision: decode gathers only B rows
+    per step (negligible bandwidth), and quantizing it would force a
+    full-table dequant before the gather. Norm vectors stay as-is.
+    The LM head ("output", (d, V)) IS streamed fully every step, so it
+    is quantized by default."""
+    out = dict(params)
+    out["layers"] = {
+        k: (quantize(v) if k in LAYER_QUANT_KEYS else v)
+        for k, v in params["layers"].items()}
+    if include_output and "output" in params:
+        out["output"] = quantize(params["output"])
+    return out
+
+
+def quantized_bytes(params: dict) -> tuple[int, int]:
+    """(bytes_now, bytes_if_bf16) over quantized leaves — the bandwidth
+    story in one tuple, used by tests and the bench report."""
+    now = full = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=is_qtensor):
+        if is_qtensor(leaf):
+            now += leaf["int8"].size + leaf["scale"].size * 4
+            full += leaf["int8"].size * 2
+    return now, full
