@@ -48,6 +48,10 @@ def main() -> int:
                         help="int8 = weight-only quantized decode "
                              "(models/quant.py): ~half the weight "
                              "bytes per generated token")
+    parser.add_argument("--quant-cache", action="store_true",
+                        help="per-row int8 KV cache: ~half the cache "
+                             "bytes per step (the long-context lever; "
+                             "composes with --quant int8)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -74,9 +78,12 @@ def main() -> int:
     prompt = jax.random.randint(jax.random.PRNGKey(1),
                                 (args.batch_size, args.prompt_len), 0,
                                 config.vocab_size, jnp.int32)
+    if args.quant_cache:
+        print("int8 KV cache: per-row scales, half the cache bytes/step")
     toks = generate(params, config, prompt, args.max_new,
                     temperature=args.temperature, top_k=args.top_k,
-                    key=jax.random.PRNGKey(2))
+                    key=jax.random.PRNGKey(2),
+                    quant_cache=args.quant_cache)
     for i, row in enumerate(jax.device_get(toks)):
         print(f"sample {i}: {[int(t) for t in row]}")
     print("GENERATE_OK")

@@ -88,6 +88,48 @@ def test_generate_runs_quantized_and_is_deterministic():
     assert bool(jnp.all((out1 >= 0) & (out1 < config.vocab_size)))
 
 
+def test_quant_cache_logits_parity():
+    """int8 KV-cache decode (per-row scales) through REAL prefill +
+    decode_step stays close to the full-precision cache."""
+    config = get_config("tiny")
+    params = llama_init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 12), 0,
+                                config.vocab_size, jnp.int32)
+    logits, cache = prefill(params, tokens, config, cache_len=16)
+    qlogits, qcache = prefill(params, tokens, config, cache_len=16,
+                              quant_cache=True)
+    # prefill logits don't touch the cache: identical
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(qlogits),
+                               rtol=0, atol=0)
+    assert qcache["k"].dtype == jnp.int8
+    assert qcache["k_scale"].shape == qcache["k"].shape[:-1] + (1,)
+
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    d, _ = decode_step(params, config, cache, tok, jnp.int32(12))
+    qd, qc2 = decode_step(params, config, qcache, tok, jnp.int32(12))
+    assert qc2["k"].dtype == jnp.int8   # cache stays int8 step to step
+    denom = float(jnp.sqrt(jnp.mean(d ** 2)))
+    rmse = float(jnp.sqrt(jnp.mean((d - qd) ** 2))) / denom
+    assert rmse < 0.05, rmse
+
+
+def test_generate_quant_cache_and_composed():
+    """generate(quant_cache=True) end to end, alone and composed with
+    int8 weight-only params."""
+    config = get_config("tiny")
+    params = llama_init(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 8), 0,
+                                config.vocab_size, jnp.int32)
+    out = generate(params, config, prompt, max_new_tokens=6,
+                   quant_cache=True)
+    assert out.shape == (2, 6)
+    assert bool(jnp.all((out >= 0) & (out < config.vocab_size)))
+    both = generate(quantize_params(params), config, prompt,
+                    max_new_tokens=6, quant_cache=True)
+    assert both.shape == (2, 6)
+    assert bool(jnp.all((both >= 0) & (both < config.vocab_size)))
+
+
 def test_generate_quantized_tracks_full_precision():
     """Greedy decode with a REAL margin: sharpen the tiny model's logits
     by scaling the LM head so argmax is decisive, then quantized greedy

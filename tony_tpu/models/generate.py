@@ -33,7 +33,9 @@ from jax import lax
 from tony_tpu.models.llama import (
     LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables, swiglu_mlp,
 )
-from tony_tpu.models.quant import dequantize_layer, maybe_dequantize
+from tony_tpu.models.quant import (
+    dequantize_layer, dequantize_rows, maybe_dequantize, quantize_rows,
+)
 from tony_tpu.ops.attention import NEG_INF, flash_attention
 from tony_tpu.ops.rmsnorm import rms_norm
 from tony_tpu.ops.rope import apply_rope
@@ -60,11 +62,16 @@ def _cache_attention(q, k_cache, v_cache, cur_len: jax.Array,
 
 
 def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
-            cache_len: int) -> tuple[jax.Array, dict[str, jax.Array]]:
+            cache_len: int, quant_cache: bool = False
+            ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Run the prompt through the model, returning last-position logits
     and the KV cache (prompt K/V written, remainder zeros).
 
-    tokens: (B, P) int32; cache_len >= P."""
+    tokens: (B, P) int32; cache_len >= P. quant_cache=True stores the
+    cache as per-row int8 + scales (models/quant.py) — at long contexts
+    decode bandwidth is cache-read-bound, so halving cache bytes is the
+    long-context serving lever the way weight int8 is the short-context
+    one."""
     b, p = tokens.shape
     nkv, hd = config.n_kv_heads, config.head_dim
     cos, sin = rope_tables(config, cache_len)
@@ -93,7 +100,14 @@ def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
 
     pad = cache_len - p
     widths = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
-    cache = {"k": jnp.pad(ks, widths), "v": jnp.pad(vs, widths)}
+    if quant_cache:
+        qk, k_scale = quantize_rows(ks)
+        qv, v_scale = quantize_rows(vs)
+        cache = {"k": jnp.pad(qk, widths), "v": jnp.pad(qv, widths),
+                 "k_scale": jnp.pad(k_scale, widths),
+                 "v_scale": jnp.pad(v_scale, widths)}
+    else:
+        cache = {"k": jnp.pad(ks, widths), "v": jnp.pad(vs, widths)}
     return logits, cache
 
 
@@ -101,7 +115,10 @@ def decode_step(params: Params, config: LlamaConfig,
                 cache: dict[str, jax.Array], token: jax.Array,
                 pos: jax.Array) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One decode step. token: (B,) int32; pos: scalar int32 (the position
-    the token occupies). Returns (logits (B, V), updated cache)."""
+    the token occupies). Returns (logits (B, V), updated cache). An int8
+    cache (prefill's quant_cache=True) is detected by tree structure —
+    a static property under jit, so both layouts share this function."""
+    quant = "k_scale" in cache
     cache_len = cache["k"].shape[3]
     cos, sin = rope_tables(config, cache_len)
     cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
@@ -110,30 +127,53 @@ def decode_step(params: Params, config: LlamaConfig,
     b = x.shape[0]
 
     def body(x, layer_and_cache):
-        layer, kc, vc = layer_and_cache
+        if quant:
+            layer, kc, vc, ksc, vsc = layer_and_cache
+        else:
+            layer, kc, vc = layer_and_cache
         layer = dequantize_layer(layer)
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = qkv_proj(h, layer, config)
         q = apply_rope(q, cos_p, sin_p)
         k = apply_rope(k, cos_p, sin_p)
-        kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos,
-                                             axis=2)
-        vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos,
-                                             axis=2)
-        attn = _cache_attention(q, kc, vc, pos + 1, config)
+        if quant:
+            qk, k_s = quantize_rows(k)
+            qv, v_s = quantize_rows(v)
+            kc = lax.dynamic_update_slice_in_dim(kc, qk, pos, axis=2)
+            vc = lax.dynamic_update_slice_in_dim(vc, qv, pos, axis=2)
+            ksc = lax.dynamic_update_slice_in_dim(ksc, k_s, pos, axis=2)
+            vsc = lax.dynamic_update_slice_in_dim(vsc, v_s, pos, axis=2)
+            # dequant feeds straight into the attention einsums: XLA
+            # fuses the int8 read + row scale into the operand load
+            k_eff = dequantize_rows(kc, ksc)
+            v_eff = dequantize_rows(vc, vsc)
+        else:
+            kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype),
+                                                 pos, axis=2)
+            vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype),
+                                                 pos, axis=2)
+            k_eff, v_eff = kc, vc
+        attn = _cache_attention(q, k_eff, v_eff, pos + 1, config)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
         h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
         x = x + swiglu_mlp(h, layer)
-        return x, (kc, vc)
+        return x, ((kc, vc, ksc, vsc) if quant else (kc, vc))
 
-    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
-                                     cache["v"]))
+    if quant:
+        xs = (params["layers"], cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"])
+        x, (ks, vs, kscs, vscs) = lax.scan(body, x, xs)
+        new_cache = {"k": ks, "v": vs, "k_scale": kscs, "v_scale": vscs}
+    else:
+        x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
+                                         cache["v"]))
+        new_cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = jnp.einsum("bd,dv->bv", x[:, 0],
                         maybe_dequantize(params["output"]),
                         preferred_element_type=jnp.float32)
-    return logits, {"k": ks, "v": vs}
+    return logits, new_cache
 
 
 def _sample(logits: jax.Array, temperature: float, top_k: int,
@@ -152,15 +192,19 @@ def _sample(logits: jax.Array, temperature: float, top_k: int,
 
 
 @partial(jax.jit, static_argnames=("config", "max_new_tokens",
-                                   "temperature", "top_k", "eos_id"))
+                                   "temperature", "top_k", "eos_id",
+                                   "quant_cache"))
 def generate(params: Params, config: LlamaConfig, prompt: jax.Array,
              max_new_tokens: int, temperature: float = 0.0,
              top_k: int = 0, eos_id: Optional[int] = None,
-             key: Optional[jax.Array] = None) -> jax.Array:
+             key: Optional[jax.Array] = None,
+             quant_cache: bool = False) -> jax.Array:
     """prompt: (B, P) int32 -> (B, max_new_tokens) generated tokens.
 
     Greedy when temperature == 0 (key unused); once a row emits eos_id it
-    keeps emitting eos_id. One compile per (shape, config, budget)."""
+    keeps emitting eos_id. One compile per (shape, config, budget).
+    quant_cache=True keeps the KV cache in per-row int8 (long-context
+    bandwidth lever; composes freely with int8 weight-only params)."""
     if key is None:
         key = jax.random.PRNGKey(0)
     b, p = prompt.shape
@@ -168,7 +212,8 @@ def generate(params: Params, config: LlamaConfig, prompt: jax.Array,
     if cache_len > config.max_seq:
         raise ValueError(f"prompt {p} + max_new {max_new_tokens} exceeds "
                          f"max_seq {config.max_seq}")
-    logits, cache = prefill(params, prompt, config, cache_len)
+    logits, cache = prefill(params, prompt, config, cache_len,
+                            quant_cache=quant_cache)
 
     keys = jax.random.split(key, max_new_tokens)
     tok0 = _sample(logits, temperature, top_k, keys[0])

@@ -36,13 +36,22 @@ import jax.numpy as jnp
 LAYER_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def _symmetric_int8(x: jax.Array, axis: int) -> tuple[jax.Array,
+                                                      jax.Array]:
+    """The shared core: symmetric int8 with kept-dims scales over
+    `axis`. ONE place, so the weight (-2) and KV-cache (-1) schemes
+    can't silently diverge."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def quantize(w: jax.Array) -> dict[str, jax.Array]:
     """W (.., d_in, d_out) -> {"int8", "scale"} with per-output-channel
     symmetric scales (kept-dims over the contraction axis)."""
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    q, scale = _symmetric_int8(w, axis=-2)
     return {"int8": q, "scale": scale}
 
 
@@ -82,6 +91,21 @@ def quantize_params(params: dict, include_output: bool = True) -> dict:
     if include_output and "output" in params:
         out["output"] = quantize(params["output"])
     return out
+
+
+def quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row (last-axis) symmetric int8 for activation-like tensors —
+    the KV-cache scheme: each cached K/V row gets its own scale, so the
+    quantization error tracks that position's own dynamic range. Returns
+    (int8 (.., d), scale f32 (.., 1))."""
+    return _symmetric_int8(x, axis=-1)
+
+
+def dequantize_rows(q: jax.Array, scale: jax.Array,
+                    dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    """Fusable per-row dequant (the consumer einsum reads int8 + scale
+    from HBM, never a materialized full-precision tensor)."""
+    return q.astype(dtype) * scale.astype(dtype)
 
 
 def quantized_bytes(params: dict) -> tuple[int, int]:

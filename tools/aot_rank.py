@@ -1,0 +1,140 @@
+"""Offline screening of tuner variants with the real XLA:TPU compiler.
+
+The axon tunnel is flaky (single claim, hours-long wedges), but JAX's
+AOT path runs the REAL XLA:TPU compiler against a detached
+TopologyDescription — no chip needed. So while the tunnel is down, every
+tools/tune_mfu.py variant can be compiled for an actual v5e target and
+screened by its compiled HBM plan (argument + temp bytes vs the 16 GiB
+chip) and a roofline bound (model-accounted FLOPs vs MXU peak, XLA
+'bytes accessed' vs HBM bandwidth).
+
+This is SCREENING, not measurement: XLA's cost_analysis can't price the
+Mosaic custom-call kernels (its optimal_seconds comes back as a negative
+sentinel on these programs, and its flops/bytes skip kernel internals),
+so the bound is a floor on step time, not an estimate. The value is
+(a) variants that will OOM or blow compile are eliminated offline, and
+(b) the HBM plan per variant is exact — so a short healthy-tunnel
+window is spent measuring only configs that can actually run.
+
+Usage (CPU host, no TPU):
+  env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+      JAX_PLATFORMS=cpu python tools/aot_rank.py [variant ...]
+
+One JSON line per variant, then a ranked summary on stderr; full results
+in tools/aot_rank_result.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from bench import peak_flops  # noqa: E402
+from tune_mfu import VARIANTS, build_config, variant_globals  # noqa: E402
+from tony_tpu.models.llama import llama_init, llama_loss  # noqa: E402
+from tony_tpu.train.step import make_train_step  # noqa: E402
+
+V5E_HBM = 16 * 1024 ** 3
+RESULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "aot_rank_result.json")
+
+
+def _single_v5e_mesh():
+    from jax.experimental import topologies
+
+    # v5e:1x1 violates the default chips-per-host bound; take one device
+    # of the smallest valid slice — the compiled program is single-chip
+    topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    return jax.sharding.Mesh([topo.devices[0]], ("chip",)), topo.devices[0]
+
+
+def rank_one(name: str, spec: dict, mesh, dev) -> dict:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    config = build_config(spec)
+    b, s = spec["batch"], spec["seq"]
+
+    def sds(tree):
+        return jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                l.shape, l.dtype, sharding=NamedSharding(mesh, P())),
+            tree)
+
+    with variant_globals(spec):
+        params_shape = jax.eval_shape(partial(llama_init, config),
+                                      jax.random.PRNGKey(0))
+        optimizer = optax.adamw(3e-4)
+        opt_shape = jax.eval_shape(optimizer.init, params_shape)
+        step = make_train_step(partial(llama_loss, config=config),
+                               optimizer)
+        tok = jax.ShapeDtypeStruct((b, s), jnp.int32,
+                                   sharding=NamedSharding(mesh, P()))
+        t0 = time.monotonic()
+        exe = jax.jit(step).lower(
+            sds(params_shape), sds(opt_shape),
+            {"inputs": tok, "targets": tok}).compile()
+    ca = exe.cost_analysis()
+    ma = exe.memory_analysis()
+    live = (ma.argument_size_in_bytes + ma.temp_size_in_bytes)
+    out = {
+        "variant": name,
+        "hbm_gib": round(live / 1024 ** 3, 2),
+        "hbm_temp_gib": round(ma.temp_size_in_bytes / 1024 ** 3, 2),
+        "fits_v5e": bool(live <= V5E_HBM),
+        "compile_s": round(time.monotonic() - t0, 1),
+    }
+    # roofline FLOOR on step time: model-accounted train FLOPs at MXU
+    # peak vs XLA-visible HBM traffic at ~819 GB/s (v5e). A real step is
+    # slower than both; the bound mainly exposes bandwidth-heavy configs.
+    model_flops = b * s * config.flops_per_token(s)
+    t_compute = model_flops / peak_flops(dev)
+    t_bw = float(ca.get("bytes accessed", 0.0)) / 819e9
+    floor_s = max(t_compute, t_bw)
+    out["floor_ms"] = round(floor_s * 1e3, 2)
+    out["bound"] = "bandwidth" if t_bw > t_compute else "compute"
+    out["mfu_ceiling_pct"] = round(100.0 * t_compute / floor_s, 2)
+    return out
+
+
+def main() -> int:
+    names = sys.argv[1:] or list(VARIANTS)
+    mesh, dev = _single_v5e_mesh()
+    results = []
+    for name in names:
+        try:
+            rec = rank_one(name, VARIANTS[name], mesh, dev)
+        except Exception as e:  # rank what compiles; report the rest
+            rec = {"variant": name,
+                   "error": f"{type(e).__name__}: {str(e)[:160]}"}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    ranked = sorted((r for r in results if "mfu_ceiling_pct" in r),
+                    key=lambda r: (-r["fits_v5e"], -r["mfu_ceiling_pct"]))
+    for i, r in enumerate(ranked):
+        print(f"[rank {i + 1}] {r['variant']}: ceiling "
+              f"{r['mfu_ceiling_pct']}% ({r['bound']}-bound, hbm "
+              f"{r['hbm_gib']} GiB, fits={r['fits_v5e']})",
+              file=sys.stderr)
+    for r in results:
+        if "error" in r:
+            print(f"[fail] {r['variant']}: {r['error']}", file=sys.stderr)
+    with open(RESULT_PATH, "w", encoding="utf-8") as f:
+        json.dump({"measured_at": time.strftime(
+            "%Y-%m-%dT%H:%MZ", time.gmtime()), "results": results},
+            f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -66,7 +66,9 @@ VARIANTS: dict[str, dict] = {
 }
 
 
-def run(name: str, spec: dict) -> dict:
+def build_config(spec: dict):
+    """Resolve a variant spec's preset + config overrides (shared with
+    tools/aot_rank.py's offline cost-model ranking)."""
     overrides = {}
     if not spec.get("remat", True):
         overrides["remat"] = False
@@ -77,56 +79,82 @@ def run(name: str, spec: dict) -> dict:
     if spec.get("model") == "8b_layer":
         # mirror bench._bench_8b_layer's geometry: one 8B layer, small
         # vocab so embed/head don't dominate
-        config = get_config("llama3_8b", n_layers=1, vocab_size=8192,
-                            max_seq=spec["seq"], **overrides)
-    else:
-        config = get_config("llama3_1b_proxy", max_seq=spec["seq"],
-                            **overrides)
-    # all fallible per-variant setup (policy lookup included) runs inside
-    # the try so one bad variant reports its error line and the finally
-    # restores every global for the next variant
-    import tony_tpu.models.llama as llama_mod
-    import tony_tpu.ops.attention as attn_mod
-    real_ckpt = None
-    saved_blocks = (attn_mod.DEFAULT_BLOCK_Q, attn_mod.DEFAULT_BLOCK_K)
-    try:
-        policy = spec.get("policy")
+        return get_config("llama3_8b", n_layers=1, vocab_size=8192,
+                          max_seq=spec["seq"], **overrides)
+    return get_config("llama3_1b_proxy", max_seq=spec["seq"], **overrides)
+
+
+class variant_globals:
+    """Context manager applying a spec's module-global knobs (flash
+    block sizes, checkpoint policy) and restoring them on exit — the
+    fallible setup shared by the live tuner and the AOT ranker."""
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+
+    def __enter__(self):
+        import tony_tpu.models.llama as llama_mod
+        import tony_tpu.ops.attention as attn_mod
+        self._llama_mod, self._attn_mod = llama_mod, attn_mod
+        self._real_ckpt = None
+        self._saved_blocks = (attn_mod.DEFAULT_BLOCK_Q,
+                              attn_mod.DEFAULT_BLOCK_K)
+        policy = self.spec.get("policy")
         if policy is not None:
             pol = getattr(jax.checkpoint_policies, policy)
-            real_ckpt = jax.checkpoint
-            llama_mod.jax.checkpoint = partial(real_ckpt, policy=pol)
-        attn_mod.DEFAULT_BLOCK_Q = spec.get(
-            "flash_block_q", spec.get("flash_block", saved_blocks[0]))
-        attn_mod.DEFAULT_BLOCK_K = spec.get(
-            "flash_block_k", spec.get("flash_block", saved_blocks[1]))
-        params = llama_init(config, jax.random.PRNGKey(0))
-        optimizer = optax.adamw(3e-4)
-        step = make_train_step(partial(llama_loss, config=config), optimizer)
-        opt_state = jax.jit(optimizer.init)(params)
-        b, s = spec["batch"], spec["seq"]
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
-                                    config.vocab_size, jnp.int32)
-        batch = {"inputs": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
-        for _ in range(2):
-            params, opt_state, loss = step(params, opt_state, batch)
-        float(loss)
-        t0 = time.monotonic()
-        n = 6
-        for _ in range(n):
-            params, opt_state, loss = step(params, opt_state, batch)
-        float(loss)
-        dt = (time.monotonic() - t0) / n
-        tok_s = b * s / dt
-        mfu = 100.0 * tok_s * config.flops_per_token(s) / peak_flops(
-            jax.devices()[0])
-        return {"variant": name, "step_s": round(dt, 4),
-                "tok_s": round(tok_s, 1), "mfu_pct": round(mfu, 2)}
+            self._real_ckpt = jax.checkpoint
+            llama_mod.jax.checkpoint = partial(self._real_ckpt,
+                                               policy=pol)
+        attn_mod.DEFAULT_BLOCK_Q = self.spec.get(
+            "flash_block_q",
+            self.spec.get("flash_block", self._saved_blocks[0]))
+        attn_mod.DEFAULT_BLOCK_K = self.spec.get(
+            "flash_block_k",
+            self.spec.get("flash_block", self._saved_blocks[1]))
+        return self
+
+    def __exit__(self, *exc):
+        (self._attn_mod.DEFAULT_BLOCK_Q,
+         self._attn_mod.DEFAULT_BLOCK_K) = self._saved_blocks
+        if self._real_ckpt is not None:
+            self._llama_mod.jax.checkpoint = self._real_ckpt
+        return False
+
+
+def run(name: str, spec: dict) -> dict:
+    config = build_config(spec)
+    # all fallible per-variant setup (policy lookup included) runs inside
+    # the try so one bad variant reports its error line, and the with
+    # block restores every global for the next variant
+    try:
+        with variant_globals(spec):
+            params = llama_init(config, jax.random.PRNGKey(0))
+            optimizer = optax.adamw(3e-4)
+            step = make_train_step(partial(llama_loss, config=config),
+                                   optimizer)
+            opt_state = jax.jit(optimizer.init)(params)
+            b, s = spec["batch"], spec["seq"]
+            tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0,
+                                        config.vocab_size, jnp.int32)
+            batch = {"inputs": tokens,
+                     "targets": jnp.roll(tokens, -1, axis=1)}
+            for _ in range(2):
+                params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+            t0 = time.monotonic()
+            n = 6
+            for _ in range(n):
+                params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+            dt = (time.monotonic() - t0) / n
+            tok_s = b * s / dt
+            mfu = 100.0 * tok_s * config.flops_per_token(s) / peak_flops(
+                jax.devices()[0])
+            return {"variant": name, "step_s": round(dt, 4),
+                    "tok_s": round(tok_s, 1), "mfu_pct": round(mfu, 2)}
     except Exception as e:  # noqa: BLE001 — report and move on (e.g. OOM)
-        return {"variant": name, "error": f"{type(e).__name__}: {str(e)[:200]}"}
-    finally:
-        attn_mod.DEFAULT_BLOCK_Q, attn_mod.DEFAULT_BLOCK_K = saved_blocks
-        if real_ckpt is not None:
-            llama_mod.jax.checkpoint = real_ckpt
+        return {"variant": name,
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
 
 
 def main() -> None:
