@@ -32,14 +32,18 @@ VARIANTS: dict[str, dict] = {
     "fullremat_b4": dict(batch=4, seq=4096, remat_policy="full"),
     "b8":        dict(batch=8, seq=4096),
     "b2":        dict(batch=2, seq=4096),
-    "noremat_b2": dict(batch=2, seq=4096, remat=False),
-    "noremat_b4": dict(batch=4, seq=4096, remat=False),
+    # oom_v5e: tools/aot_rank.py compiled these against a detached v5e
+    # topology — 19.64G / 31.31G / 18.18G (unfused_b8) vs 15.75G HBM —
+    # so the default sweep skips them instead of burning a ~150s live
+    # compile to rediscover the OOM (pass --all to force)
+    "noremat_b2": dict(batch=2, seq=4096, remat=False, oom_v5e=True),
+    "noremat_b4": dict(batch=4, seq=4096, remat=False, oom_v5e=True),
     "dots_b4":   dict(batch=4, seq=4096, policy="dots_with_no_batch_dims_saveable"),
     "seq8k_b2":  dict(batch=2, seq=8192),
     # fused chunked LM-head CE A/B (preset default is xent_chunk=1024;
     # 0 = full-logits path) — the lever that freed ~4 GB for b8
     "unfused_b4": dict(batch=4, seq=4096, xent_chunk=0),
-    "unfused_b8": dict(batch=8, seq=4096, xent_chunk=0),
+    "unfused_b8": dict(batch=8, seq=4096, xent_chunk=0, oom_v5e=True),
     "xc512_b8":  dict(batch=8, seq=4096, xent_chunk=512),
     "xc2048_b8": dict(batch=8, seq=4096, xent_chunk=2048),
     # flash-kernel tile sweep (DEFAULT_BLOCK_Q/K = 512 measured 2.05x over
@@ -158,9 +162,17 @@ def run(name: str, spec: dict) -> dict:
 
 
 def main() -> None:
-    names = sys.argv[1:] or list(VARIANTS)
+    argv = [a for a in sys.argv[1:] if a != "--all"]
+    force_all = "--all" in sys.argv[1:]
+    names = argv or list(VARIANTS)
     for name in names:
-        print(json.dumps(run(name, VARIANTS[name])), flush=True)
+        spec = VARIANTS[name]
+        if spec.get("oom_v5e") and not force_all and not argv:
+            print(json.dumps({"variant": name,
+                              "skipped": "oom_v5e (aot_rank verdict)"}),
+                  flush=True)
+            continue
+        print(json.dumps(run(name, spec)), flush=True)
 
 
 if __name__ == "__main__":
