@@ -107,7 +107,77 @@ def rank_one(name: str, spec: dict, mesh, dev) -> dict:
     return out
 
 
+def rank_decode(mesh) -> list[dict]:
+    """AOT A/B of the decode step: bf16 vs int8 weight-only vs int8
+    weights + int8 KV cache, against the real v5e target. The verdict
+    that matters is memory_analysis: temp==0 proves the dequant FUSES
+    (a single materialized bf16 LM head alone would be ~131 MB of temp),
+    and argument bytes are the per-step weight/cache stream. Measured
+    2026-07-31: bf16 2376.3 MB args / int8 1305.9 MB, both temp 0."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tony_tpu.models.generate import decode_step
+    from tony_tpu.models.llama import get_config, llama_init
+    from tony_tpu.models.quant import quantize_params
+
+    config = get_config("llama3_1b_proxy")
+    b, cache_len = 8, 192
+    nl, nkv, hd = config.n_layers, config.n_kv_heads, config.head_dim
+
+    def sds_tree(tree):
+        return jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                l.shape, l.dtype, sharding=NamedSharding(mesh, P())),
+            tree)
+
+    params_s = jax.eval_shape(partial(llama_init, config),
+                              jax.random.PRNGKey(0))
+    qparams_s = jax.eval_shape(quantize_params, params_s)
+    tok = jax.ShapeDtypeStruct((b,), jnp.int32,
+                               sharding=NamedSharding(mesh, P()))
+    pos = jax.ShapeDtypeStruct((), jnp.int32,
+                               sharding=NamedSharding(mesh, P()))
+
+    def cache_sds(qc):
+        kv = jnp.int8 if qc else jnp.bfloat16
+        c = {"k": jax.ShapeDtypeStruct((nl, b, nkv, cache_len, hd), kv),
+             "v": jax.ShapeDtypeStruct((nl, b, nkv, cache_len, hd), kv)}
+        if qc:
+            c["k_scale"] = jax.ShapeDtypeStruct(
+                (nl, b, nkv, cache_len, 1), jnp.float32)
+            c["v_scale"] = jax.ShapeDtypeStruct(
+                (nl, b, nkv, cache_len, 1), jnp.float32)
+        return sds_tree(c)
+
+    results = []
+    for tag, ps, qc in (("decode_bf16", params_s, False),
+                        ("decode_int8", qparams_s, False),
+                        ("decode_int8_qcache", qparams_s, True)):
+        t0 = time.monotonic()
+        exe = jax.jit(partial(decode_step, config=config)).lower(
+            sds_tree(ps), cache=cache_sds(qc), token=tok,
+            pos=pos).compile()
+        ma = exe.memory_analysis()
+        rec = {"variant": tag,
+               "args_mb": round(ma.argument_size_in_bytes / 1e6, 1),
+               "temp_mb": round(ma.temp_size_in_bytes / 1e6, 1),
+               "dequant_fused": bool(ma.temp_size_in_bytes < 16e6),
+               "compile_s": round(time.monotonic() - t0, 1)}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    return results
+
+
 def main() -> int:
+    if "--decode" in sys.argv[1:]:
+        mesh, _ = _single_v5e_mesh()
+        results = rank_decode(mesh)
+        with open(RESULT_PATH.replace(".json", "_decode.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({"measured_at": time.strftime(
+                "%Y-%m-%dT%H:%MZ", time.gmtime()), "results": results},
+                f, indent=2)
+        return 0
     names = sys.argv[1:] or list(VARIANTS)
     mesh, dev = _single_v5e_mesh()
     results = []
