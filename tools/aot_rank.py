@@ -168,16 +168,83 @@ def rank_decode(mesh) -> list[dict]:
     return results
 
 
+def rank_decode_8b(mesh) -> list[dict]:
+    """The capability-unlock check: Llama-3-8B single-chip v5e serving.
+    bf16 CANNOT fit (16.07 GB params alone vs 15.75 GB HBM — the real
+    compiler OOMs at 15.96G used), int8 weights + int8 KV cache FITS
+    (9.12 GB args, dequant fused, temp 0) at batch 4 x 2k context.
+    Measured 2026-07-31 via this mode (--decode-8b)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tony_tpu.models.generate import decode_step
+    from tony_tpu.models.llama import get_config, llama_init
+    from tony_tpu.models.quant import quantize_params
+
+    config = get_config("llama3_8b")
+    b, cache_len = 4, 2048
+    nl, nkv, hd = config.n_layers, config.n_kv_heads, config.head_dim
+
+    def sds_tree(tree):
+        return jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                l.shape, l.dtype, sharding=NamedSharding(mesh, P())),
+            tree)
+
+    params_s = jax.eval_shape(partial(llama_init, config),
+                              jax.random.PRNGKey(0))
+    tok = jax.ShapeDtypeStruct((b,), jnp.int32,
+                               sharding=NamedSharding(mesh, P()))
+    pos = jax.ShapeDtypeStruct((), jnp.int32,
+                               sharding=NamedSharding(mesh, P()))
+
+    def cache_sds(qc):
+        kv = jnp.int8 if qc else jnp.bfloat16
+        c = {"k": jax.ShapeDtypeStruct((nl, b, nkv, cache_len, hd), kv),
+             "v": jax.ShapeDtypeStruct((nl, b, nkv, cache_len, hd), kv)}
+        if qc:
+            c["k_scale"] = jax.ShapeDtypeStruct(
+                (nl, b, nkv, cache_len, 1), jnp.float32)
+            c["v_scale"] = jax.ShapeDtypeStruct(
+                (nl, b, nkv, cache_len, 1), jnp.float32)
+        return sds_tree(c)
+
+    results = []
+    for tag, ps, qc in (
+            ("8b_decode_bf16", params_s, False),
+            ("8b_decode_int8_qcache",
+             jax.eval_shape(quantize_params, params_s), True)):
+        t0 = time.monotonic()
+        try:
+            exe = jax.jit(partial(decode_step, config=config)).lower(
+                sds_tree(ps), cache=cache_sds(qc), token=tok,
+                pos=pos).compile()
+            ma = exe.memory_analysis()
+            rec = {"variant": tag, "fits_v5e": True,
+                   "args_gb": round(
+                       ma.argument_size_in_bytes / 1e9, 2),
+                   "temp_mb": round(ma.temp_size_in_bytes / 1e6, 1),
+                   "compile_s": round(time.monotonic() - t0, 1)}
+        except Exception as e:
+            rec = {"variant": tag, "fits_v5e": False,
+                   "error": f"{type(e).__name__}: {str(e)[:140]}"}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+    return results
+
+
 def main() -> int:
-    if "--decode" in sys.argv[1:]:
-        mesh, _ = _single_v5e_mesh()
-        results = rank_decode(mesh)
-        with open(RESULT_PATH.replace(".json", "_decode.json"), "w",
-                  encoding="utf-8") as f:
-            json.dump({"measured_at": time.strftime(
-                "%Y-%m-%dT%H:%MZ", time.gmtime()), "results": results},
-                f, indent=2)
-        return 0
+    for flag, fn in (("--decode", rank_decode),
+                     ("--decode-8b", rank_decode_8b)):
+        if flag in sys.argv[1:]:
+            mesh, _ = _single_v5e_mesh()
+            results = fn(mesh)
+            with open(RESULT_PATH.replace(
+                    ".json", f"_{flag.strip('-').replace('-', '_')}.json"),
+                    "w", encoding="utf-8") as f:
+                json.dump({"measured_at": time.strftime(
+                    "%Y-%m-%dT%H:%MZ", time.gmtime()),
+                    "results": results}, f, indent=2)
+            return 0
     names = sys.argv[1:] or list(VARIANTS)
     mesh, dev = _single_v5e_mesh()
     results = []
