@@ -52,6 +52,14 @@ def main() -> int:
                         help="per-row int8 KV cache: ~half the cache "
                              "bytes per step (the long-context lever; "
                              "composes with --quant int8)")
+    parser.add_argument("--draft-config", default="",
+                        help="smaller preset (same vocab) to drive "
+                             "lossless greedy speculative decoding; "
+                             "draft weights are random-init in this "
+                             "demo, so it shows the mechanism, not "
+                             "the speedup")
+    parser.add_argument("--gamma", type=int, default=4,
+                        help="drafted tokens per speculative round")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -80,10 +88,26 @@ def main() -> int:
                                 config.vocab_size, jnp.int32)
     if args.quant_cache:
         print("int8 KV cache: per-row scales, half the cache bytes/step")
-    toks = generate(params, config, prompt, args.max_new,
-                    temperature=args.temperature, top_k=args.top_k,
-                    key=jax.random.PRNGKey(2),
-                    quant_cache=args.quant_cache)
+    if args.draft_config:
+        from tony_tpu.models.speculative import speculative_generate
+        if args.temperature > 0:
+            raise SystemExit("speculative decoding is greedy-only")
+        if args.quant_cache:
+            raise SystemExit("--quant-cache is not supported on the "
+                             "speculative path (weights --quant int8 "
+                             "composes fine)")
+        draft_config = get_config(args.draft_config)
+        draft = llama_init(draft_config, jax.random.PRNGKey(3))
+        print(f"speculative: draft={args.draft_config} "
+              f"gamma={args.gamma} (lossless greedy)")
+        toks = speculative_generate(params, draft, config, draft_config,
+                                    prompt, args.max_new,
+                                    gamma=args.gamma)
+    else:
+        toks = generate(params, config, prompt, args.max_new,
+                        temperature=args.temperature, top_k=args.top_k,
+                        key=jax.random.PRNGKey(2),
+                        quant_cache=args.quant_cache)
     for i, row in enumerate(jax.device_get(toks)):
         print(f"sample {i}: {[int(t) for t in row]}")
     print("GENERATE_OK")

@@ -1,0 +1,220 @@
+"""Speculative decoding: draft-model propose, target-model verify.
+
+Single-sequence decode runs one matmul-starved token at a time; a small
+draft model proposes `gamma` tokens cheaply and the target verifies ALL
+of them in ONE windowed forward (W = gamma+1 positions through the MXU
+instead of 1). Greedy-only and LOSSLESS: the emitted stream is exactly
+`generate(params, ...)`'s greedy output — the draft only changes how
+fast tokens appear, never which tokens. That identity is the test
+oracle (tests/test_speculative.py).
+
+TPU-first mechanics (greenfield — the reference is an orchestrator with
+no inference code, SURVEY §2.3):
+- static shapes end to end: every round drafts exactly `gamma` tokens
+  and verifies a fixed (gamma+1)-token window inside `lax.while_loop`;
+  per-row acceptance divergence is handled with per-row cache lengths,
+  not dynamic shapes.
+- caches may hold garbage BEYOND each row's length: the attention mask
+  (`col < len + row + 1`) makes stale rows invisible and later rounds
+  simply overwrite them — no rollback pass.
+- per-row cache writes are `vmap`ed `dynamic_update_slice`s (batched
+  start indices), and RoPE uses `apply_rope`'s per-batch positions.
+- the draft chain deliberately consumes ALL gamma drafted tokens (one
+  step more than strictly needed to produce them): that keeps the draft
+  cache exactly ONE token behind the target stream in every case, so
+  rounds stay uniform with no data-dependent resync window.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.models.generate import prefill
+from tony_tpu.models.llama import (
+    LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables, swiglu_mlp,
+)
+from tony_tpu.models.quant import dequantize_layer, maybe_dequantize
+from tony_tpu.ops.attention import NEG_INF
+from tony_tpu.ops.rmsnorm import rms_norm
+from tony_tpu.ops.rope import apply_rope
+
+
+def _row_update(cache_row, new_row, off):
+    """(Hkv, S, hd), (Hkv, W, hd), scalar — one batch row's cache write."""
+    return lax.dynamic_update_slice_in_dim(cache_row, new_row, off, axis=1)
+
+
+def _window_attention(q, k_cache, v_cache, lens, config: LlamaConfig):
+    """q: (B, H, W, hd) for window rows written at per-row offsets
+    `lens`; caches (B, Hkv, S, hd). Window row i of batch b attends to
+    cache cols < lens[b] + i + 1 (prefix + within-window causal)."""
+    b, nh, w, hd = q.shape
+    nkv = k_cache.shape[1]
+    rep = nh // nkv
+    qg = q.reshape(b, nkv, rep, w, hd).astype(jnp.float32) * hd ** -0.5
+    scores = jnp.einsum("bgrwd,bgsd->bgrws", qg,
+                        k_cache.astype(jnp.float32))   # (B,G,rep,W,S)
+    col = lax.broadcasted_iota(jnp.int32, scores.shape, 4)
+    row = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    limit = lens[:, None, None, None, None] + row + 1
+    scores = jnp.where(col < limit, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrws,bgsd->bgrwd", probs,
+                     v_cache.astype(jnp.float32))
+    return out.reshape(b, nh, w, hd).astype(q.dtype)
+
+
+def window_logits(params: Params, config: LlamaConfig,
+                  cache: dict[str, jax.Array], tokens: jax.Array,
+                  lens: jax.Array
+                  ) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Forward a (B, W) token window against per-row cache lengths.
+
+    Writes the window's K/V at row b's positions lens[b]..lens[b]+W-1
+    and returns (logits (B, W, V), new cache). The caller owns lens
+    bookkeeping: only advance past positions whose tokens were actually
+    accepted — anything beyond stays invisible to the mask and is
+    overwritten by later windows."""
+    b, w = tokens.shape
+    cache_len = cache["k"].shape[3]
+    cos, sin = rope_tables(config, cache_len)
+    positions = lens[:, None] + jnp.arange(w, dtype=lens.dtype)[None, :]
+    x = embed_lookup(params["embed"], tokens, config)   # (B, W, D)
+
+    def body(x, layer_and_cache):
+        layer, kc, vc = layer_and_cache
+        layer = dequantize_layer(layer)
+        h = rms_norm(x, layer["attn_norm"], config.norm_eps)
+        q, k, v = qkv_proj(h, layer, config)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        kc = jax.vmap(_row_update)(kc, k.astype(kc.dtype), lens)
+        vc = jax.vmap(_row_update)(vc, v.astype(vc.dtype), lens)
+        attn = _window_attention(q, kc, vc, lens, config)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, w, -1)
+        x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
+        h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+        x = x + swiglu_mlp(h, layer)
+        return x, (kc, vc)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
+                                     cache["v"]))
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    logits = jnp.einsum("bwd,dv->bwv", x,
+                        maybe_dequantize(params["output"]),
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": ks, "v": vs}
+
+
+@partial(jax.jit, static_argnames=("config", "draft_config",
+                                   "max_new_tokens", "gamma"))
+def speculative_generate(params: Params, draft_params: Params,
+                         config: LlamaConfig, draft_config: LlamaConfig,
+                         prompt: jax.Array, max_new_tokens: int,
+                         gamma: int = 4) -> jax.Array:
+    """prompt: (B, P) int32 -> (B, max_new_tokens), greedily identical
+    to `generate(params, config, prompt, max_new_tokens)`. The models
+    must share a vocabulary. gamma = drafted tokens per round."""
+    if config.vocab_size != draft_config.vocab_size:
+        raise ValueError("target and draft must share a vocabulary: "
+                         f"{config.vocab_size} vs "
+                         f"{draft_config.vocab_size}")
+    b, p = prompt.shape
+    n = max_new_tokens
+    # slack: a round may write gamma+1 rows beyond a row's frozen length
+    cache_len = p + n + gamma + 2
+    if cache_len > config.max_seq or cache_len > draft_config.max_seq:
+        raise ValueError(f"prompt {p} + max_new {n} + gamma {gamma} "
+                         f"slack exceeds max_seq")
+
+    t_logits, t_cache = prefill(params, prompt, config, cache_len)
+    _, d_cache = prefill(draft_params, prompt, draft_config, cache_len)
+
+    tok0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # (B,)
+    out0 = jnp.zeros((b, n), jnp.int32).at[:, 0].set(tok0)
+
+    # per-row state; `last` = the newest emitted token, which NEITHER
+    # model has consumed yet. Invariant at every round boundary:
+    # t_len = tokens the target consumed (= p + emitted - 1) and the
+    # draft cache holds exactly the same tokens (d_len == t_len).
+    state = {
+        "t_cache": t_cache, "d_cache": d_cache,
+        "len": jnp.full((b,), p, jnp.int32),
+        "last": tok0,
+        "out": out0,
+        "emitted": jnp.ones((b,), jnp.int32),
+    }
+
+    def not_done(s):
+        return jnp.any(s["emitted"] < n)
+
+    def round_(s):
+        live = s["emitted"] < n   # (B,) — frozen rows stop advancing
+
+        # --- draft chain: consume [last, d1..d_{gamma-1}] to produce
+        # d1..dgamma, then one extra step consumes dgamma so the draft
+        # cache ends exactly one token behind the target stream for ANY
+        # acceptance count (stale rows are masked + overwritten later)
+        def draft_step(carry, _):
+            d_cache, d_len, tok = carry
+            lg, d_cache = window_logits(draft_params, draft_config,
+                                        d_cache, tok[:, None], d_len)
+            nxt = lg[:, 0].argmax(-1).astype(jnp.int32)
+            return (d_cache, d_len + jnp.where(live, 1, 0), nxt), nxt
+
+        # gamma+1 steps: consume [last, d1..dgamma] so the draft cache
+        # covers every token the target can accept this round; the
+        # (gamma+1)-th proposal is produced but never used
+        (d_cache, _, _), drafts = lax.scan(
+            draft_step, (s["d_cache"], s["len"], s["last"]), None,
+            length=gamma + 1)
+        drafts = drafts.T[:, :gamma]                    # (B, gamma)
+
+        # --- target: one windowed forward over [last, d1..dgamma]
+        window = jnp.concatenate([s["last"][:, None], drafts], axis=1)
+        t_logits, t_cache = window_logits(
+            params, config, s["t_cache"], window, s["len"])
+        greedy = t_logits.argmax(-1).astype(jnp.int32)  # (B, gamma+1)
+
+        # accept the longest draft prefix that matched target-greedy
+        match = (drafts == greedy[:, :gamma])
+        accepted = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((b, 1), bool)], axis=1),
+            axis=1).astype(jnp.int32)                   # (B,) in [0, g]
+
+        # emit accepted+1 target-greedy tokens (bounded by remaining).
+        # Gather-select per output slot — NOT a scatter: clipped scatter
+        # indices would collide and a masked keep-original duplicate
+        # could overwrite the real token (unspecified duplicate order)
+        emit = jnp.where(live,
+                         jnp.minimum(accepted + 1, n - s["emitted"]), 0)
+        off = jnp.arange(n)[None, :] - s["emitted"][:, None]   # (B, n)
+        sel = (off >= 0) & (off < emit[:, None])
+        gathered = jnp.take_along_axis(greedy,
+                                       jnp.clip(off, 0, gamma), axis=1)
+        out = jnp.where(sel, gathered, s["out"])
+
+        # the target consumed [last, d1..d_accepted] = accepted+1
+        # tokens; the new `last` is its correction/bonus greedy[accepted].
+        # adv is clipped exactly like emit so a finishing row's len stays
+        # <= p+n-1 and frozen-row window writes can never outrun the
+        # cache_len slack (gamma+2) — without the clip a final-round
+        # full acceptance would overshoot and rely on XLA's update-slice
+        # clamping
+        adv = emit
+        last = jnp.take_along_axis(greedy, accepted[:, None],
+                                   axis=1)[:, 0]
+        return {
+            "t_cache": t_cache, "d_cache": d_cache,
+            "len": s["len"] + adv,
+            "last": jnp.where(live, last, s["last"]),
+            "out": out,
+            "emitted": s["emitted"] + emit,
+        }
+
+    state = lax.while_loop(not_done, round_, state)
+    return state["out"]
