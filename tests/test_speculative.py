@@ -73,6 +73,23 @@ def test_adversarial_draft_still_lossless():
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
 
 
+def test_gamma_exceeds_budget_and_long_run():
+    """Boundary coverage: gamma larger than the whole budget (every
+    round over-drafts), and a longer run whose rows finish in different
+    rounds — both must still match the oracle exactly."""
+    params, draft = _params(0), _params(7, DRAFT_CFG)
+    prompt = _prompt(8, b=3, p=5)
+    want = generate(params, CFG, prompt, max_new_tokens=2)
+    got = speculative_generate(params, draft, CFG, DRAFT_CFG, prompt,
+                               max_new_tokens=2, gamma=6)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    want = generate(params, CFG, prompt, max_new_tokens=33)
+    got = speculative_generate(params, draft, CFG, DRAFT_CFG, prompt,
+                               max_new_tokens=33, gamma=5)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
 def test_composes_with_int8_weights():
     """Speculative decode over an int8 weight-only TARGET must equal
     that target's own greedy decode (lossless relative to whatever
