@@ -180,8 +180,11 @@ def rank_decode_8b(mesh) -> list[dict]:
     from tony_tpu.models.llama import get_config, llama_init
     from tony_tpu.models.quant import quantize_params
 
-    config = get_config("llama3_8b")
-    b, cache_len = 4, 2048
+    # TONY_AOT_8B_CTX extends the check to long contexts (verified
+    # 2026-07-31: 32k-ctx b1 int8+qcache fits at 10.78 GB, temp 0.5 MB)
+    cache_len = int(os.environ.get("TONY_AOT_8B_CTX", "2048"))
+    b = 4 if cache_len <= 4096 else 1
+    config = get_config("llama3_8b", max_seq=max(8192, cache_len))
     nl, nkv, hd = config.n_layers, config.n_kv_heads, config.head_dim
 
     def sds_tree(tree):
