@@ -92,17 +92,14 @@ def main() -> int:
         from tony_tpu.models.speculative import speculative_generate
         if args.temperature > 0:
             raise SystemExit("speculative decoding is greedy-only")
-        if args.quant_cache:
-            raise SystemExit("--quant-cache is not supported on the "
-                             "speculative path (weights --quant int8 "
-                             "composes fine)")
         draft_config = get_config(args.draft_config)
         draft = llama_init(draft_config, jax.random.PRNGKey(3))
         print(f"speculative: draft={args.draft_config} "
               f"gamma={args.gamma} (lossless greedy)")
         toks = speculative_generate(params, draft, config, draft_config,
                                     prompt, args.max_new,
-                                    gamma=args.gamma)
+                                    gamma=args.gamma,
+                                    quant_cache=args.quant_cache)
     else:
         toks = generate(params, config, prompt, args.max_new,
                         temperature=args.temperature, top_k=args.top_k,

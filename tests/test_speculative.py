@@ -27,12 +27,15 @@ def _prompt(key, b=2, p=8):
                               CFG.vocab_size, jnp.int32)
 
 
-def test_window_logits_matches_decode_step():
+@pytest.mark.parametrize("quant_cache", [False, True])
+def test_window_logits_matches_decode_step(quant_cache):
     """W=1 window against a uniform-length cache must reproduce
-    decode_step (same math through a different masking path)."""
+    decode_step (same math through a different masking path) — on both
+    cache layouts, since each has its own write/dequant branch."""
     params = _params(0)
     tokens = _prompt(3, b=2, p=10)
-    logits, cache = prefill(params, tokens, CFG, cache_len=16)
+    logits, cache = prefill(params, tokens, CFG, cache_len=16,
+                            quant_cache=quant_cache)
     tok = jnp.argmax(logits, -1).astype(jnp.int32)
     ref, _ = decode_step(params, CFG, cache, tok, jnp.int32(10))
     lens = jnp.full((2,), 10, jnp.int32)
@@ -102,6 +105,38 @@ def test_composes_with_int8_weights():
     want = generate(qparams, CFG, prompt, max_new_tokens=10)
     got = speculative_generate(qparams, draft, CFG, DRAFT_CFG, prompt,
                                max_new_tokens=10, gamma=3)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_composes_with_int8_kv_cache():
+    """quant_cache=True speculative must be byte-identical to
+    quant_cache=True vanilla greedy: both paths quantize the SAME K/V
+    rows at the same positions, so the lossless identity is exact even
+    though the cache itself is lossy."""
+    params, draft = _params(0), _params(7, DRAFT_CFG)
+    prompt = _prompt(9)
+    want = generate(params, CFG, prompt, max_new_tokens=10,
+                    quant_cache=True)
+    got = speculative_generate(params, draft, CFG, DRAFT_CFG, prompt,
+                               max_new_tokens=10, gamma=3,
+                               quant_cache=True)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_composes_with_full_int8_stack():
+    """int8 weights AND int8 KV cache together (what the demo's
+    --quant int8 --quant-cache --draft-config enables) must equal the
+    same-stack vanilla greedy."""
+    from tony_tpu.models.quant import quantize_params
+
+    params, draft = _params(0), _params(7, DRAFT_CFG)
+    qparams = quantize_params(params)
+    prompt = _prompt(10)
+    want = generate(qparams, CFG, prompt, max_new_tokens=10,
+                    quant_cache=True)
+    got = speculative_generate(qparams, draft, CFG, DRAFT_CFG, prompt,
+                               max_new_tokens=10, gamma=3,
+                               quant_cache=True)
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
 
 

@@ -34,11 +34,41 @@ from tony_tpu.models.llama import (
     LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables, swiglu_mlp,
 )
 from tony_tpu.models.quant import (
-    dequantize_layer, dequantize_rows, maybe_dequantize, quantize_rows,
+    dequantize_layer, maybe_dequantize, quantize_rows,
 )
 from tony_tpu.ops.attention import NEG_INF, flash_attention
 from tony_tpu.ops.rmsnorm import rms_norm
 from tony_tpu.ops.rope import apply_rope
+
+
+def _row_update(cache_row, new_row, off):
+    """(Hkv, S, hd), (Hkv, W, hd), scalar — one batch row's cache write."""
+    return lax.dynamic_update_slice_in_dim(cache_row, new_row, off, axis=1)
+
+
+def write_cache_rows(kc, vc, scales, k, v, offsets):
+    """Write new K/V rows (B, Hkv, W, hd) into the caches at PER-ROW
+    offsets (B,), quantizing iff `scales` is present ((ksc, vsc) for an
+    int8 cache, None for bf16). Returns (kc, vc, scales', k_eff, v_eff)
+    where k_eff/v_eff are the attention-ready (dequantized) views.
+
+    ONE place for the int8/bf16 cache write+view, shared by decode_step
+    and speculative.window_logits — a scheme change applied to one and
+    not the other would silently break the greedy-lossless identity."""
+    if scales is None:
+        kc = jax.vmap(_row_update)(kc, k.astype(kc.dtype), offsets)
+        vc = jax.vmap(_row_update)(vc, v.astype(vc.dtype), offsets)
+        return kc, vc, None, kc, vc
+    from tony_tpu.models.quant import dequantize_rows
+    ksc, vsc = scales
+    qk, k_s = quantize_rows(k)
+    qv, v_s = quantize_rows(v)
+    kc = jax.vmap(_row_update)(kc, qk, offsets)
+    vc = jax.vmap(_row_update)(vc, qv, offsets)
+    ksc = jax.vmap(_row_update)(ksc, k_s, offsets)
+    vsc = jax.vmap(_row_update)(vsc, v_s, offsets)
+    return (kc, vc, (ksc, vsc),
+            dequantize_rows(kc, ksc), dequantize_rows(vc, vsc))
 
 
 def _cache_attention(q, k_cache, v_cache, cur_len: jax.Array,
@@ -126,33 +156,25 @@ def decode_step(params: Params, config: LlamaConfig,
     x = embed_lookup(params["embed"], token[:, None], config)  # (B, 1, D)
     b = x.shape[0]
 
+    offsets = jnp.broadcast_to(pos, (b,))
+
     def body(x, layer_and_cache):
         if quant:
             layer, kc, vc, ksc, vsc = layer_and_cache
         else:
             layer, kc, vc = layer_and_cache
+            ksc = vsc = None
         layer = dequantize_layer(layer)
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = qkv_proj(h, layer, config)
         q = apply_rope(q, cos_p, sin_p)
         k = apply_rope(k, cos_p, sin_p)
+        # dequantized views feed straight into the attention einsums:
+        # XLA fuses the int8 read + row scale into the operand load
+        kc, vc, scales, k_eff, v_eff = write_cache_rows(
+            kc, vc, (ksc, vsc) if quant else None, k, v, offsets)
         if quant:
-            qk, k_s = quantize_rows(k)
-            qv, v_s = quantize_rows(v)
-            kc = lax.dynamic_update_slice_in_dim(kc, qk, pos, axis=2)
-            vc = lax.dynamic_update_slice_in_dim(vc, qv, pos, axis=2)
-            ksc = lax.dynamic_update_slice_in_dim(ksc, k_s, pos, axis=2)
-            vsc = lax.dynamic_update_slice_in_dim(vsc, v_s, pos, axis=2)
-            # dequant feeds straight into the attention einsums: XLA
-            # fuses the int8 read + row scale into the operand load
-            k_eff = dequantize_rows(kc, ksc)
-            v_eff = dequantize_rows(vc, vsc)
-        else:
-            kc = lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype),
-                                                 pos, axis=2)
-            vc = lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype),
-                                                 pos, axis=2)
-            k_eff, v_eff = kc, vc
+            ksc, vsc = scales
         attn = _cache_attention(q, k_eff, v_eff, pos + 1, config)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tony_tpu.models.generate import prefill
+from tony_tpu.models.generate import prefill, write_cache_rows
 from tony_tpu.models.llama import (
     LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables, swiglu_mlp,
 )
@@ -41,11 +41,6 @@ from tony_tpu.models.quant import dequantize_layer, maybe_dequantize
 from tony_tpu.ops.attention import NEG_INF
 from tony_tpu.ops.rmsnorm import rms_norm
 from tony_tpu.ops.rope import apply_rope
-
-
-def _row_update(cache_row, new_row, off):
-    """(Hkv, S, hd), (Hkv, W, hd), scalar — one batch row's cache write."""
-    return lax.dynamic_update_slice_in_dim(cache_row, new_row, off, axis=1)
 
 
 def _window_attention(q, k_cache, v_cache, lens, config: LlamaConfig):
@@ -78,7 +73,9 @@ def window_logits(params: Params, config: LlamaConfig,
     and returns (logits (B, W, V), new cache). The caller owns lens
     bookkeeping: only advance past positions whose tokens were actually
     accepted — anything beyond stays invisible to the mask and is
-    overwritten by later windows."""
+    overwritten by later windows. An int8 cache (prefill's
+    quant_cache=True) is detected by tree structure, like decode_step."""
+    quant = "k_scale" in cache
     b, w = tokens.shape
     cache_len = cache["k"].shape[3]
     cos, sin = rope_tables(config, cache_len)
@@ -86,39 +83,57 @@ def window_logits(params: Params, config: LlamaConfig,
     x = embed_lookup(params["embed"], tokens, config)   # (B, W, D)
 
     def body(x, layer_and_cache):
-        layer, kc, vc = layer_and_cache
+        if quant:
+            layer, kc, vc, ksc, vsc = layer_and_cache
+        else:
+            layer, kc, vc = layer_and_cache
+            ksc = vsc = None
         layer = dequantize_layer(layer)
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = qkv_proj(h, layer, config)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        kc = jax.vmap(_row_update)(kc, k.astype(kc.dtype), lens)
-        vc = jax.vmap(_row_update)(vc, v.astype(vc.dtype), lens)
-        attn = _window_attention(q, kc, vc, lens, config)
+        kc, vc, scales, k_eff, v_eff = write_cache_rows(
+            kc, vc, (ksc, vsc) if quant else None, k, v, lens)
+        if quant:
+            ksc, vsc = scales
+        attn = _window_attention(q, k_eff, v_eff, lens, config)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, w, -1)
         x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
         h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
         x = x + swiglu_mlp(h, layer)
-        return x, (kc, vc)
+        return x, ((kc, vc, ksc, vsc) if quant else (kc, vc))
 
-    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
-                                     cache["v"]))
+    if quant:
+        xs = (params["layers"], cache["k"], cache["v"],
+              cache["k_scale"], cache["v_scale"])
+        x, (ks, vs, kscs, vscs) = lax.scan(body, x, xs)
+        new_cache = {"k": ks, "v": vs, "k_scale": kscs, "v_scale": vscs}
+    else:
+        x, (ks, vs) = lax.scan(body, x, (params["layers"], cache["k"],
+                                         cache["v"]))
+        new_cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], config.norm_eps)
     logits = jnp.einsum("bwd,dv->bwv", x,
                         maybe_dequantize(params["output"]),
                         preferred_element_type=jnp.float32)
-    return logits, {"k": ks, "v": vs}
+    return logits, new_cache
 
 
 @partial(jax.jit, static_argnames=("config", "draft_config",
-                                   "max_new_tokens", "gamma"))
+                                   "max_new_tokens", "gamma",
+                                   "quant_cache"))
 def speculative_generate(params: Params, draft_params: Params,
                          config: LlamaConfig, draft_config: LlamaConfig,
                          prompt: jax.Array, max_new_tokens: int,
-                         gamma: int = 4) -> jax.Array:
+                         gamma: int = 4,
+                         quant_cache: bool = False) -> jax.Array:
     """prompt: (B, P) int32 -> (B, max_new_tokens), greedily identical
-    to `generate(params, config, prompt, max_new_tokens)`. The models
-    must share a vocabulary. gamma = drafted tokens per round."""
+    to `generate(params, config, prompt, max_new_tokens,
+    quant_cache=quant_cache)` — with an int8 cache both paths quantize
+    the SAME K/V rows at the same positions, so the identity holds
+    exactly, not approximately. The models must share a vocabulary.
+    gamma = drafted tokens per round."""
     if config.vocab_size != draft_config.vocab_size:
         raise ValueError("target and draft must share a vocabulary: "
                          f"{config.vocab_size} vs "
@@ -131,8 +146,10 @@ def speculative_generate(params: Params, draft_params: Params,
         raise ValueError(f"prompt {p} + max_new {n} + gamma {gamma} "
                          f"slack exceeds max_seq")
 
-    t_logits, t_cache = prefill(params, prompt, config, cache_len)
-    _, d_cache = prefill(draft_params, prompt, draft_config, cache_len)
+    t_logits, t_cache = prefill(params, prompt, config, cache_len,
+                                quant_cache=quant_cache)
+    _, d_cache = prefill(draft_params, prompt, draft_config, cache_len,
+                         quant_cache=quant_cache)
 
     tok0 = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # (B,)
     out0 = jnp.zeros((b, n), jnp.int32).at[:, 0].set(tok0)
