@@ -44,6 +44,9 @@ def main() -> int:
     parser.add_argument("--max-new", type=int, default=16)
     parser.add_argument("--temperature", type=float, default=0.0)
     parser.add_argument("--top-k", type=int, default=0)
+    parser.add_argument("--top-p", type=float, default=1.0,
+                        help="nucleus sampling mass (<1.0 truncates "
+                             "the tail; composes with --top-k)")
     parser.add_argument("--quant", default="", choices=("", "int8"),
                         help="int8 = weight-only quantized decode "
                              "(models/quant.py): ~half the weight "
@@ -103,7 +106,7 @@ def main() -> int:
     else:
         toks = generate(params, config, prompt, args.max_new,
                         temperature=args.temperature, top_k=args.top_k,
-                        key=jax.random.PRNGKey(2),
+                        top_p=args.top_p, key=jax.random.PRNGKey(2),
                         quant_cache=args.quant_cache)
     for i, row in enumerate(jax.device_get(toks)):
         print(f"sample {i}: {[int(t) for t in row]}")

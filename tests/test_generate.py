@@ -85,6 +85,44 @@ def test_sampled_generation_valid_and_reproducible():
     assert ((np.asarray(a) >= 0) & (np.asarray(a) < cfg.vocab_size)).all()
 
 
+def test_top_p_nucleus_semantics():
+    """top_p truncation: only tokens inside the smallest prefix whose
+    probability mass reaches top_p can ever be sampled, the most
+    probable token always survives, and top_p=1.0 is exactly the
+    untruncated distribution."""
+    from tony_tpu.models.generate import _sample
+
+    probs = jnp.array([[0.5, 0.3, 0.15, 0.05]])
+    logits = jnp.log(probs)
+    # mass 0.6 -> keep {0 (cum-p=0), 1 (cum-p=0.5)}; 2 (0.8) is out
+    seen = {int(_sample(logits, 1.0, 0, jax.random.PRNGKey(i),
+                        top_p=0.6)[0]) for i in range(64)}
+    assert seen <= {0, 1} and 1 in seen, seen
+    # a tiny mass keeps only the argmax — sampling degenerates to greedy
+    seen = {int(_sample(logits, 1.0, 0, jax.random.PRNGKey(i),
+                        top_p=1e-6)[0]) for i in range(16)}
+    assert seen == {0}, seen
+    # top_p=0 (CLI-reachable) must degrade to the argmax too, never to
+    # a fully-masked row that categorical samples uniformly
+    seen = {int(_sample(logits, 1.0, 0, jax.random.PRNGKey(i),
+                        top_p=0.0)[0]) for i in range(16)}
+    assert seen == {0}, seen
+    # top_p=1.0 is a no-op: identical draws to the plain path per key
+    for i in range(8):
+        k = jax.random.PRNGKey(100 + i)
+        assert int(_sample(logits, 1.0, 0, k, top_p=1.0)[0]) == \
+            int(_sample(logits, 1.0, 0, k)[0])
+    # end-to-end through generate(): reproducible and in-range
+    cfg, params, prompt = _setup()
+    k = jax.random.PRNGKey(8)
+    a = generate(params, cfg, prompt, 5, temperature=0.9, top_p=0.8,
+                 key=k)
+    b = generate(params, cfg, prompt, 5, temperature=0.9, top_p=0.8,
+                 key=k)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert ((np.asarray(a) >= 0) & (np.asarray(a) < cfg.vocab_size)).all()
+
+
 def test_generate_budget_guard():
     cfg, params, prompt = _setup()
     import pytest

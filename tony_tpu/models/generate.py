@@ -199,7 +199,7 @@ def decode_step(params: Params, config: LlamaConfig,
 
 
 def _sample(logits: jax.Array, temperature: float, top_k: int,
-            key: jax.Array) -> jax.Array:
+            key: jax.Array, top_p: float = 1.0) -> jax.Array:
     """(B, V) -> (B,) next tokens."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -210,15 +210,29 @@ def _sample(logits: jax.Array, temperature: float, top_k: int,
         top_k = min(top_k, logits.shape[-1])
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]      # (B, 1)
         logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        # nucleus: keep the smallest set of tokens whose probability
+        # mass reaches top_p. Floored so the most-probable token ALWAYS
+        # survives — at top_p=0 an all-False keep would mask every
+        # token to the same NEG_INF and categorical would then sample
+        # uniformly over the whole vocab (pure noise)
+        top_p = max(top_p, 1e-9)
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]           # descending
+        probs = jax.nn.softmax(srt, axis=-1)
+        keep = jnp.cumsum(probs, axis=-1) - probs < top_p  # (B, V)
+        threshold = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                            keepdims=True)                 # (B, 1)
+        logits = jnp.where(logits >= threshold, logits, NEG_INF)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("config", "max_new_tokens",
-                                   "temperature", "top_k", "eos_id",
-                                   "quant_cache"))
+                                   "temperature", "top_k", "top_p",
+                                   "eos_id", "quant_cache"))
 def generate(params: Params, config: LlamaConfig, prompt: jax.Array,
              max_new_tokens: int, temperature: float = 0.0,
-             top_k: int = 0, eos_id: Optional[int] = None,
+             top_k: int = 0, top_p: float = 1.0,
+             eos_id: Optional[int] = None,
              key: Optional[jax.Array] = None,
              quant_cache: bool = False) -> jax.Array:
     """prompt: (B, P) int32 -> (B, max_new_tokens) generated tokens.
@@ -238,7 +252,7 @@ def generate(params: Params, config: LlamaConfig, prompt: jax.Array,
                             quant_cache=quant_cache)
 
     keys = jax.random.split(key, max_new_tokens)
-    tok0 = _sample(logits, temperature, top_k, keys[0])
+    tok0 = _sample(logits, temperature, top_k, keys[0], top_p)
     done0 = (tok0 == eos_id) if eos_id is not None else jnp.zeros((b,),
                                                                   bool)
 
@@ -247,7 +261,7 @@ def generate(params: Params, config: LlamaConfig, prompt: jax.Array,
         # decode the PREVIOUS token, sample the next — the final sampled
         # token therefore never pays a trailing decode_step
         logits, cache = decode_step(params, config, cache, tok, pos)
-        nxt = _sample(logits, temperature, top_k, step_key)
+        nxt = _sample(logits, temperature, top_k, step_key, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
