@@ -123,6 +123,22 @@ def test_composes_with_int8_kv_cache():
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
 
 
+def test_eos_latch_matches_vanilla():
+    """eos_id latching: pick an eos that PROVABLY fires mid-stream (a
+    token from the vanilla output's interior), then speculative must
+    reproduce vanilla's forced-eos tail exactly."""
+    params, draft = _params(0), _params(7, DRAFT_CFG)
+    prompt = _prompt(11)
+    base = np.asarray(generate(params, CFG, prompt, max_new_tokens=12))
+    eos = int(base[0][4])   # fires at position 4 of row 0 at the latest
+    want = generate(params, CFG, prompt, max_new_tokens=12, eos_id=eos)
+    got = speculative_generate(params, draft, CFG, DRAFT_CFG, prompt,
+                               max_new_tokens=12, gamma=3, eos_id=eos)
+    w = np.asarray(want)
+    assert (w[0] == eos).any()   # the latch actually engaged
+    np.testing.assert_array_equal(w, np.asarray(got))
+
+
 def test_composes_with_full_int8_stack():
     """int8 weights AND int8 KV cache together (what the demo's
     --quant int8 --quant-cache --draft-config enables) must equal the

@@ -122,12 +122,12 @@ def window_logits(params: Params, config: LlamaConfig,
 
 @partial(jax.jit, static_argnames=("config", "draft_config",
                                    "max_new_tokens", "gamma",
-                                   "quant_cache"))
+                                   "quant_cache", "eos_id"))
 def speculative_generate(params: Params, draft_params: Params,
                          config: LlamaConfig, draft_config: LlamaConfig,
                          prompt: jax.Array, max_new_tokens: int,
-                         gamma: int = 4,
-                         quant_cache: bool = False) -> jax.Array:
+                         gamma: int = 4, quant_cache: bool = False,
+                         eos_id: int | None = None) -> jax.Array:
     """prompt: (B, P) int32 -> (B, max_new_tokens), greedily identical
     to `generate(params, config, prompt, max_new_tokens,
     quant_cache=quant_cache)` — with an int8 cache both paths quantize
@@ -234,4 +234,15 @@ def speculative_generate(params: Params, draft_params: Params,
         }
 
     state = lax.while_loop(not_done, round_, state)
-    return state["out"]
+    out = state["out"]
+    if eos_id is not None:
+        # vanilla generate LATCHES eos: every token after the first
+        # emitted eos_id is forced to eos_id regardless of the model.
+        # The loop above keeps emitting target-greedy continuations, so
+        # reproducing the latch is pure post-processing — the prefix
+        # before the first eos is target-greedy in both paths
+        hit = out == eos_id
+        first = jnp.argmax(hit, axis=1)
+        after = jnp.arange(n)[None, :] > first[:, None]
+        out = jnp.where(after & hit.any(axis=1)[:, None], eos_id, out)
+    return out
