@@ -210,6 +210,21 @@ def test_train_then_generate_lifecycle(tmp_path):
     logs = _logs(client)
     assert "GENERATE_OK" in logs and "int8 weight-only" in logs
 
+    # speculative decoding from the same checkpoint (random-init draft —
+    # lossless mechanism through the real chain, not a speedup claim)
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "llama-generate",
+                                    "generate_demo.py"),
+         "--task_params",
+         f"--config tiny --checkpoint-dir {ckpt} --max-new 8 "
+         "--draft-config tiny --gamma 3",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+    logs = _logs(client)
+    assert "GENERATE_OK" in logs and "speculative: draft=tiny" in logs
+
 
 def test_longcontext_ring_example(tmp_path):
     """Ring-attention pretrain through the real chain: sp=2 mesh rendered
