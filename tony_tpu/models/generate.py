@@ -41,6 +41,25 @@ from tony_tpu.ops.rmsnorm import rms_norm
 from tony_tpu.ops.rope import apply_rope
 
 
+def _mlp(h: jax.Array, layer: Params, config: LlamaConfig) -> jax.Array:
+    """Dense SwiGLU or MoE expert MLP, dispatched on the config type —
+    ONE decode/serve stack for both families. The MoE aux loss is a
+    training concern and is dropped here.
+
+    MoE capacity note: each call routes over ITS OWN tokens, so a
+    decode step's expert queues start empty while a full training
+    forward fills them across the whole sequence. With
+    capacity_factor >= n_experts / top_k nothing overflows in either
+    case and incremental decode is exactly the training forward
+    (pinned by tests/test_moe_generate.py); below that, training may
+    drop tokens that decode serves — standard Switch semantics."""
+    if getattr(config, "n_experts", 0):
+        from tony_tpu.models.moe import moe_mlp
+        out, _aux = moe_mlp(h, layer, config)
+        return out
+    return swiglu_mlp(h, layer)
+
+
 def _row_update(cache_row, new_row, off):
     """(Hkv, S, hd), (Hkv, W, hd), scalar — one batch row's cache write."""
     return lax.dynamic_update_slice_in_dim(cache_row, new_row, off, axis=1)
@@ -119,7 +138,7 @@ def prefill(params: Params, tokens: jax.Array, config: LlamaConfig,
         attn = attn.transpose(0, 2, 1, 3).reshape(b, p, -1)
         x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
         h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
-        x = x + swiglu_mlp(h, layer)
+        x = x + _mlp(h, layer, config)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
@@ -179,7 +198,7 @@ def decode_step(params: Params, config: LlamaConfig,
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
         h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
-        x = x + swiglu_mlp(h, layer)
+        x = x + _mlp(h, layer, config)
         return x, ((kc, vc, ksc, vsc) if quant else (kc, vc))
 
     if quant:

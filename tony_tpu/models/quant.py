@@ -32,8 +32,13 @@ import jax
 import jax.numpy as jnp
 
 # layer-dict weight names that feed matmuls (contracted over their
-# second-to-last axis); norms are vectors and stay full precision
-LAYER_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# second-to-last axis); norms are vectors and stay full precision, and
+# the MoE router stays unquantized (stored in config.dtype, cast to f32
+# at routing time, and tiny). we_* are the MoE expert banks:
+# (L, E, d_in, d_out) quantizes
+# per-(layer, expert, channel) through the same axis=-2 reduction.
+LAYER_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                    "we_gate", "we_up", "we_down")
 
 
 def _symmetric_int8(x: jax.Array, axis: int) -> tuple[jax.Array,

@@ -33,9 +33,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tony_tpu.models.generate import prefill, write_cache_rows
+from tony_tpu.models.generate import _mlp, prefill, write_cache_rows
 from tony_tpu.models.llama import (
-    LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables, swiglu_mlp,
+    LlamaConfig, Params, embed_lookup, qkv_proj, rope_tables,
 )
 from tony_tpu.models.quant import dequantize_layer, maybe_dequantize
 from tony_tpu.ops.attention import NEG_INF
@@ -101,7 +101,7 @@ def window_logits(params: Params, config: LlamaConfig,
         attn = attn.transpose(0, 2, 1, 3).reshape(b, w, -1)
         x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
         h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
-        x = x + swiglu_mlp(h, layer)
+        x = x + _mlp(h, layer, config)
         return x, ((kc, vc, ksc, vsc) if quant else (kc, vc))
 
     if quant:
@@ -138,6 +138,18 @@ def speculative_generate(params: Params, draft_params: Params,
         raise ValueError("target and draft must share a vocabulary: "
                          f"{config.vocab_size} vs "
                          f"{draft_config.vocab_size}")
+    for cfg, who in ((config, "target"), (draft_config, "draft")):
+        n_exp = getattr(cfg, "n_experts", 0)
+        if n_exp and cfg.capacity_factor < n_exp / cfg.top_k:
+            # below no-drop capacity, expert-queue overflow depends on
+            # how many tokens each call routes — the verify window
+            # routes gamma+1 at once while vanilla decode routes 1, so
+            # the two paths drop DIFFERENT tokens and the lossless
+            # identity silently breaks
+            raise ValueError(
+                f"speculative decoding needs the {who} MoE config at "
+                f"no-drop capacity (capacity_factor >= n_experts/top_k "
+                f"= {n_exp / cfg.top_k}); got {cfg.capacity_factor}")
     b, p = prompt.shape
     n = max_new_tokens
     # slack: a round may write gamma+1 rows beyond a row's frozen length
