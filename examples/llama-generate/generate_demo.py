@@ -66,8 +66,18 @@ def main() -> int:
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    config = get_config(args.config)
-    params = llama_init(config, jax.random.PRNGKey(0))
+    from tony_tpu.models.moe import is_moe_preset
+    if is_moe_preset(args.config):
+        from tony_tpu.models.moe import get_moe_config, moe_init
+        # no-drop capacity for serving: incremental decode then equals
+        # the training forward (models/generate._mlp docstring)
+        base = get_moe_config(args.config)
+        config = get_moe_config(args.config, capacity_factor=max(
+            base.capacity_factor, base.n_experts / base.top_k))
+        params = moe_init(config, jax.random.PRNGKey(0))
+    else:
+        config = get_config(args.config)
+        params = llama_init(config, jax.random.PRNGKey(0))
     if args.checkpoint_dir:
         step = latest_step(args.checkpoint_dir)
         if step is None:

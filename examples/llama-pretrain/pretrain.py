@@ -46,7 +46,9 @@ def _eval_stream(args, seq, config, process_index):
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="tiny",
-                        help="preset: tiny|bench_350m|llama3_1b_proxy|llama3_8b")
+                        help="preset: tiny|bench_350m|llama3_1b_proxy|"
+                             "llama3_8b|llama3_70b, or a MoE preset "
+                             "(moe_tiny|mixtral_proxy)")
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=0,
@@ -78,8 +80,22 @@ def main() -> int:
     # device evidence is logged by Trainer.setup() AFTER distributed
     # init — touching jax.devices() here would initialize the local
     # backend and break jax.distributed.initialize() on multi-worker runs
-    config = get_config(args.config, **({"n_layers": args.n_layers}
-                                        if args.n_layers else {}))
+    overrides = {"n_layers": args.n_layers} if args.n_layers else {}
+    from tony_tpu.models.moe import is_moe_preset
+    is_moe = is_moe_preset(args.config)
+    if is_moe:
+        from tony_tpu.models.moe import (
+            get_moe_config, moe_init, moe_loss, moe_param_axes,
+        )
+        config = get_moe_config(args.config, **overrides)
+        init_fn = partial(moe_init, config)
+        base_loss = partial(moe_loss, config=config)
+        param_axes = moe_param_axes(config)
+    else:
+        config = get_config(args.config, **overrides)
+        init_fn = partial(llama_init, config)
+        base_loss = partial(llama_loss, config=config)
+        param_axes = llama_param_axes(config)
     seq = args.seq_len or config.max_seq
     process_index = int(os.environ.get("JAX_PROCESS_ID", "0"))
 
@@ -101,6 +117,9 @@ def main() -> int:
                  os.environ.get("TPU_MESH_AXES", "").split(",")]
     pipelined = args.pp_micro > 0 and "pp" in mesh_axes
     if pipelined:
+        if is_moe:
+            raise SystemExit("pipelined training is the dense-Llama "
+                             "path; MoE scales via the ep/fsdp axes")
         from tony_tpu.models.llama import llama_loss_pipelined
         loss_fn = partial(llama_loss_pipelined, config=config,
                           n_micro=args.pp_micro,
@@ -111,12 +130,12 @@ def main() -> int:
                 "--pp-micro %d requested but tony.tpu.mesh-axes (%s) has "
                 "no pp axis — training WITHOUT pipeline parallelism",
                 args.pp_micro, os.environ.get("TPU_MESH_AXES", ""))
-        loss_fn = partial(llama_loss, config=config)
+        loss_fn = base_loss
 
     trainer = Trainer(
         loss_fn=loss_fn,
         loss_takes_mesh=pipelined,
-        init_fn=partial(llama_init, config),
+        init_fn=init_fn,
         data_iter=clipped_tokens(),
         config=TrainerConfig(
             num_steps=args.steps, log_every=10,
@@ -125,7 +144,7 @@ def main() -> int:
             grad_accum=args.grad_accum,
             eval_every=args.eval_every,
             master_weights=args.master_weights),
-        param_axes=llama_param_axes(config),
+        param_axes=param_axes,
         eval_data_iter=(_eval_stream(args, seq, config, process_index)
                         if args.eval_every else None),
     )

@@ -226,6 +226,35 @@ def test_train_then_generate_lifecycle(tmp_path):
     assert "GENERATE_OK" in logs and "speculative: draft=tiny" in logs
 
 
+def test_moe_train_then_generate_lifecycle(tmp_path):
+    """The expert family end to end through the real chain: MoE pretrain
+    (router + expert banks, aux loss) checkpoints, then the generate
+    demo restores it and runs the shared KV-cache decode stack."""
+    ckpt = str(tmp_path / "ckpts")
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "llama-pretrain",
+                                    "pretrain.py"),
+         "--task_params",
+         f"--config moe_tiny --steps 3 --batch-size 2 --seq-len 64 "
+         f"--checkpoint-dir {ckpt} --checkpoint-every 3",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+    assert "final loss" in _logs(client)
+
+    client = run_example(
+        tmp_path,
+        ["--executes", os.path.join(EXAMPLES, "llama-generate",
+                                    "generate_demo.py"),
+         "--task_params",
+         f"--config moe_tiny --checkpoint-dir {ckpt} --max-new 8",
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.application.framework=jax"])
+    assert client.final_status == "SUCCEEDED", _logs(client)
+    assert "GENERATE_OK" in _logs(client)
+
+
 def test_longcontext_ring_example(tmp_path):
     """Ring-attention pretrain through the real chain: sp=2 mesh rendered
     by the orchestrator (TPU_MESH_*), sequence sharded, 3 steps."""

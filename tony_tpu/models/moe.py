@@ -82,6 +82,13 @@ def get_moe_config(name: str, **overrides) -> MoEConfig:
     return replace(PRESETS[name], **overrides)
 
 
+def is_moe_preset(name: str) -> bool:
+    """Family resolver for entrypoints that accept any preset name —
+    membership in THIS registry, not name sniffing, so a future preset
+    with an unconventional name routes correctly everywhere."""
+    return name in PRESETS
+
+
 # ---------------------------------------------------------------------------
 # params
 # ---------------------------------------------------------------------------

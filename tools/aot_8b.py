@@ -109,7 +109,8 @@ def main() -> int:
           f"{len(topo.devices)} chips, mesh {dict(mesh.shape)}",
           file=sys.stderr)
 
-    is_moe = args.model.startswith(("moe_", "mixtral"))
+    from tony_tpu.models.moe import is_moe_preset
+    is_moe = is_moe_preset(args.model)
     if is_moe:
         from tony_tpu.models.moe import (
             get_moe_config, moe_init, moe_loss, moe_param_axes,
