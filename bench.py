@@ -70,6 +70,43 @@ def peak_flops(device) -> float:
 _T0 = time.monotonic()
 
 
+def _lm_feed(vocab_size: int, batch_size: int, seq: int, seed: int = 1):
+    """Host-side {'inputs','targets'} stream for the bench hot loop —
+    fresh synthetic batches every step, fed through PrefetchIterator so
+    generation + H2D overlap the previous train step exactly like the
+    trainer's input path (docs/HOTLOOP.md). Local imports keep the
+    parent process pure-stdlib."""
+    import numpy as np
+
+    from tony_tpu.train.data import synthetic_tokens
+
+    for b in synthetic_tokens(batch_size, seq, vocab_size, seed=seed):
+        toks = b["tokens"]
+        yield {"inputs": np.ascontiguousarray(toks[:, :-1]),
+               "targets": np.ascontiguousarray(toks[:, 1:])}
+
+
+def _input_stall_ms_per_step(feed, snapshot, steps: int) -> float:
+    """Per-step input stall over a timed region, from stall snapshots
+    taken before/after it. Fails LOUDLY when `feed` is not the
+    prefetching path — the bench contract requires the overlapped input
+    pipeline, and a silent fallback to a plain iterator would report an
+    MFU that hides input serialization (tests/test_bench_contract.py)."""
+    snap = getattr(feed, "stall_snapshot", None)
+    if snap is None:
+        raise TypeError(
+            "bench input feed bypasses the prefetch path: "
+            f"{type(feed).__name__} has no stall accounting")
+    stall_s, batches = snap()
+    s0, n0 = snapshot
+    used = batches - n0
+    if used < max(1, steps):
+        raise ValueError(
+            f"prefetch feed yielded {used} batches in a {steps}-step "
+            f"timed region — the prefetch path was bypassed or starved")
+    return 1000.0 * (stall_s - s0) / used
+
+
 def _mark(msg: str) -> None:
     """Progress marker on stderr — the parent's diagnosis tail."""
     print(f"[bench +{time.monotonic() - _T0:.1f}s] {msg}", file=sys.stderr,
@@ -184,7 +221,15 @@ def child_main(backend: str) -> None:
         batch_candidates = (4,)
 
     def measure(tag, cfg, cands):
-        """Compile+warmup+time one config. Returns (stats, params)."""
+        """Compile+warmup+time one config. Returns (stats, params).
+
+        The input path is the OVERLAPPED one the trainer uses: a
+        PrefetchIterator feeds fresh synthetic batches (background host
+        generation + H2D, 2-deep on device), so the measured MFU
+        reflects the real hot loop — and its stall accounting yields
+        the `input_stall_ms_per_step` headline field."""
+        from tony_tpu.train.data import PrefetchIterator
+
         optimizer = optax.adamw(3e-4)
         train_step = make_train_step(partial(llama_loss, config=cfg),
                                      optimizer)
@@ -192,6 +237,7 @@ def child_main(backend: str) -> None:
         # loss: on tunneled/experimental platforms block_until_ready
         # alone may return before the computation finishes, but a host
         # read cannot.
+        feed = None
         for bi, batch_size in enumerate(cands):
             try:
                 # init lives INSIDE the try: a deferred async OOM from a
@@ -199,18 +245,18 @@ def child_main(backend: str) -> None:
                 # retry's init dispatch, and must hit the same handler
                 params = llama_init(cfg, jax.random.PRNGKey(0))
                 opt_state = jax.jit(optimizer.init)(params)
-                tokens = jax.random.randint(
-                    jax.random.PRNGKey(1), (batch_size, seq), 0,
-                    cfg.vocab_size, jnp.int32)
-                batch = {"inputs": tokens,
-                         "targets": jnp.roll(tokens, -1, axis=1)}
+                feed = PrefetchIterator(
+                    _lm_feed(cfg.vocab_size, batch_size, seq), depth=2)
                 _mark(f"[{tag}] compiling + warmup (batch {batch_size})")
                 for _ in range(warmup):
                     params, opt_state, loss = train_step(
-                        params, opt_state, batch)
+                        params, opt_state, next(feed))
                 float(loss)
                 break
             except Exception as e:  # noqa: BLE001
+                if feed is not None:
+                    feed.close()
+                    feed = None
                 oom = ("RESOURCE_EXHAUSTED" in str(e)
                        or "Out of memory" in str(e)
                        or "out of memory" in str(e))
@@ -223,14 +269,24 @@ def child_main(backend: str) -> None:
                 # are dropped with these references; next iteration
                 # re-inits (plain rebinds: some may be unbound if init
                 # itself OOMed)
-                params = opt_state = tokens = batch = None
+                params = opt_state = None
 
         _mark(f"[{tag}] timing")
-        t0 = time.monotonic()
-        for _ in range(steps):
-            params, opt_state, loss = train_step(params, opt_state, batch)
-        final_loss = float(loss)
-        dt = time.monotonic() - t0
+        # finally: a deferred async OOM surfacing mid-timing is caught
+        # by the caller (best-of-two continues) — the feed's producer
+        # thread and its on-device batches must not outlive the region
+        try:
+            snap = feed.stall_snapshot()
+            t0 = time.monotonic()
+            for _ in range(steps):
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     next(feed))
+            final_loss = float(loss)
+            dt = time.monotonic() - t0
+            stall_ms = _input_stall_ms_per_step(feed, snap, steps)
+            prefetch_depth = feed.depth
+        finally:
+            feed.close()
         tokens_per_step = batch_size * seq
         tok_s = tokens_per_step * steps / dt
         mfu_pct = (100.0 * tok_s * cfg.flops_per_token(seq)
@@ -244,6 +300,8 @@ def child_main(backend: str) -> None:
             "tokens_per_sec_per_chip": round(tok_s, 1),
             "step_time_s": round(dt / steps, 4),
             "batch_tokens": tokens_per_step,
+            "input_stall_ms_per_step": round(stall_ms, 3),
+            "prefetch_depth": prefetch_depth,
             "final_loss": round(final_loss, 4),
         }, params
 
@@ -255,6 +313,8 @@ def child_main(backend: str) -> None:
             "vs_baseline": round(stats["value"] / 40.0, 3),
             "tokens_per_sec_per_chip": stats["tokens_per_sec_per_chip"],
             "step_time_s": stats["step_time_s"],
+            "input_stall_ms_per_step": stats["input_stall_ms_per_step"],
+            "prefetch_depth": stats["prefetch_depth"],
             "model": "llama3_1b_proxy" if on_tpu else "tiny",
             "config": stats["config"],
             "batch_tokens": stats["batch_tokens"],
@@ -844,8 +904,8 @@ def _record_head_partial(result: dict, commit: str) -> None:
         return
     snap = {k: result[k] for k in
             ("metric", "value", "unit", "tokens_per_sec_per_chip",
-             "step_time_s", "batch_tokens", "partial", "device",
-             "kernel_fallback")
+             "step_time_s", "batch_tokens", "input_stall_ms_per_step",
+             "partial", "device", "kernel_fallback")
             if k in result}
     snap["measured_at"] = time.strftime("%Y-%m-%dT%H:%MZ", time.gmtime())
     snap["commit"] = commit

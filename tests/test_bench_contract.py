@@ -187,6 +187,72 @@ def test_partial_auto_persists_to_head_partial(_isolated_bench_paths):
     assert bench._head_partial()["value"] == 44.0
 
 
+def test_input_stall_field_from_prefetch_feed():
+    """The overlapped-input contract: the bench's timed region must pull
+    its batches through the prefetch path, and the stall helper turns its
+    accounting into the headline `input_stall_ms_per_step` field."""
+    from tony_tpu.train.data import PrefetchIterator
+
+    feed = PrefetchIterator(bench._lm_feed(64, 2, 8), depth=2,
+                            transfer=lambda b: b)
+    try:
+        for _ in range(2):        # warmup pulls, outside the timed region
+            next(feed)
+        snap = feed.stall_snapshot()
+        for _ in range(3):
+            batch = next(feed)
+        assert set(batch) == {"inputs", "targets"}
+        assert batch["inputs"].shape == (2, 8)
+        stall = bench._input_stall_ms_per_step(feed, snap, 3)
+        assert stall >= 0.0
+    finally:
+        feed.close()
+
+
+def test_input_stall_fails_loudly_when_prefetch_bypassed():
+    """A plain iterator silently replacing the prefetch path must raise,
+    not report an MFU that hides input serialization."""
+    with pytest.raises(TypeError, match="prefetch"):
+        bench._input_stall_ms_per_step(iter([{"inputs": None}]), (0.0, 0),
+                                       1)
+    # a feed that exists but starved/was not consumed also fails
+    from tony_tpu.train.data import PrefetchIterator
+
+    feed = PrefetchIterator(bench._lm_feed(64, 2, 8), depth=1,
+                            transfer=lambda b: b)
+    try:
+        with pytest.raises(ValueError, match="bypassed or starved"):
+            bench._input_stall_ms_per_step(feed, feed.stall_snapshot(), 3)
+    finally:
+        feed.close()
+
+
+def test_emit_preserves_input_stall_field(capsys):
+    """input_stall_ms_per_step is a headline field: it must survive
+    _emit's truncation ladder (it is not in drop_order) and ride into the
+    head-partial snapshot keep-list."""
+    result = {"metric": bench.METRIC, "value": 68.08, "unit": "%MFU",
+              "vs_baseline": 1.702, "input_stall_ms_per_step": 0.41,
+              "prefetch_depth": 2,
+              "tpu_error": "e" * 2000, "error": "z" * 2000}
+    bench._emit(result)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    parsed = json.loads(line)
+    assert parsed["input_stall_ms_per_step"] == 0.41
+    assert parsed["prefetch_depth"] == 2
+
+
+def test_head_partial_snapshot_keeps_input_stall(_isolated_bench_paths):
+    bench._record_last_good({
+        "metric": bench.METRIC, "value": 58.53, "unit": "%MFU",
+        "device": "TPU v5 lite", "input_stall_ms_per_step": 1.2,
+        "partial": "timed out after 164s"})
+    auto = json.loads(
+        (_isolated_bench_paths / "bench_head_partial_auto.json")
+        .read_text())
+    assert auto["input_stall_ms_per_step"] == 1.2
+
+
 def test_compact_last_good_keeps_headline_only():
     last = {"metric": "m", "value": 68.08, "unit": "%MFU",
             "commit": "abc", "measured_at": "t", "step_time_s": 1.0,

@@ -52,12 +52,39 @@ def _mlp(h: jax.Array, layer: Params, config: LlamaConfig) -> jax.Array:
     capacity_factor >= n_experts / top_k nothing overflows in either
     case and incremental decode is exactly the training forward
     (pinned by tests/test_moe_generate.py); below that, training may
-    drop tokens that decode serves — standard Switch semantics."""
+    drop tokens that decode serves — standard Switch semantics.
+    `generate()` warns at trace time when a below-no-drop-capacity
+    config reaches the decode path (`_warn_moe_below_capacity`);
+    speculative_generate raises, because there the divergence breaks
+    its lossless-identity contract outright."""
     if getattr(config, "n_experts", 0):
         from tony_tpu.models.moe import moe_mlp
         out, _aux = moe_mlp(h, layer, config)
         return out
     return swiglu_mlp(h, layer)
+
+
+def _warn_moe_below_capacity(config: LlamaConfig, who: str = "decode"
+                             ) -> None:
+    """Warn when an MoE config below no-drop capacity reaches the decode
+    path. Decode routes 1 token per call while the training forward
+    routes the whole sequence, so below capacity_factor >= n_experts /
+    top_k the two paths overflow DIFFERENT expert queues and decode
+    silently serves tokens training dropped (ADVICE r5). Mirrors the
+    ValueError in speculative_generate, softened to a warning here
+    because plain sampling has no exactness contract to break."""
+    if not getattr(config, "n_experts", 0):
+        return
+    from tony_tpu.models.moe import no_drop_capacity_floor
+    floor = no_drop_capacity_floor(config)
+    if config.capacity_factor < floor:
+        import warnings
+        warnings.warn(
+            f"MoE config reaches the {who} path below no-drop capacity "
+            f"(capacity_factor {config.capacity_factor} < n_experts/"
+            f"top_k = {floor}): decode routes tokens the training "
+            f"forward dropped — raise capacity_factor to >= {floor} "
+            f"for train/serve parity", stacklevel=3)
 
 
 def _row_update(cache_row, new_row, off):
@@ -259,7 +286,10 @@ def generate(params: Params, config: LlamaConfig, prompt: jax.Array,
     Greedy when temperature == 0 (key unused); once a row emits eos_id it
     keeps emitting eos_id. One compile per (shape, config, budget).
     quant_cache=True keeps the KV cache in per-row int8 (long-context
-    bandwidth lever; composes freely with int8 weight-only params)."""
+    bandwidth lever; composes freely with int8 weight-only params).
+    An MoE config below no-drop capacity triggers a trace-time warning
+    (once per compile) — see _warn_moe_below_capacity."""
+    _warn_moe_below_capacity(config)
     if key is None:
         key = jax.random.PRNGKey(0)
     b, p = prompt.shape

@@ -89,6 +89,18 @@ def is_moe_preset(name: str) -> bool:
     return name in PRESETS
 
 
+def no_drop_capacity_floor(config) -> float:
+    """Smallest capacity_factor at which NO routing can overflow an
+    expert queue: with capacity = capacity_factor * T * top_k / E, even
+    all T*top_k assignments landing on one expert fit once
+    capacity_factor >= n_experts / top_k. Below this floor, overflow
+    depends on how many tokens a call routes at once — decode routes 1
+    per call while training routes the whole sequence, so the two paths
+    drop DIFFERENT tokens. The single source of truth behind generate's
+    decode warning and speculative_generate's hard error."""
+    return config.n_experts / config.top_k
+
+
 # ---------------------------------------------------------------------------
 # params
 # ---------------------------------------------------------------------------

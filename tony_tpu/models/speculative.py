@@ -6,7 +6,11 @@ of them in ONE windowed forward (W = gamma+1 positions through the MXU
 instead of 1). Greedy-only and LOSSLESS: the emitted stream is exactly
 `generate(params, ...)`'s greedy output — the draft only changes how
 fast tokens appear, never which tokens. That identity is the test
-oracle (tests/test_speculative.py).
+oracle (tests/test_speculative.py, CPU) and is re-asserted on the real
+backend by the multichip dryrun's decode-spec leg (__graft_entry__.py):
+the (gamma+1)-wide verify-window matmuls could in principle accumulate
+in a different order than single-token decode steps and flip argmax on
+near-ties, so exactness is pinned per-backend, not assumed.
 
 TPU-first mechanics (greenfield — the reference is an orchestrator with
 no inference code, SURVEY §2.3):
@@ -139,8 +143,11 @@ def speculative_generate(params: Params, draft_params: Params,
                          f"{config.vocab_size} vs "
                          f"{draft_config.vocab_size}")
     for cfg, who in ((config, "target"), (draft_config, "draft")):
-        n_exp = getattr(cfg, "n_experts", 0)
-        if n_exp and cfg.capacity_factor < n_exp / cfg.top_k:
+        if not getattr(cfg, "n_experts", 0):
+            continue
+        from tony_tpu.models.moe import no_drop_capacity_floor
+        floor = no_drop_capacity_floor(cfg)
+        if cfg.capacity_factor < floor:
             # below no-drop capacity, expert-queue overflow depends on
             # how many tokens each call routes — the verify window
             # routes gamma+1 at once while vanilla decode routes 1, so
@@ -149,7 +156,7 @@ def speculative_generate(params: Params, draft_params: Params,
             raise ValueError(
                 f"speculative decoding needs the {who} MoE config at "
                 f"no-drop capacity (capacity_factor >= n_experts/top_k "
-                f"= {n_exp / cfg.top_k}); got {cfg.capacity_factor}")
+                f"= {floor}); got {cfg.capacity_factor}")
     b, p = prompt.shape
     n = max_new_tokens
     # slack: a round may write gamma+1 rows beyond a row's frozen length

@@ -11,11 +11,15 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
+import threading
 from typing import Optional
 
 from tony_tpu import constants as C
 
 LOG = logging.getLogger(__name__)
+
+_CLOSE = object()
 
 
 def sum_tpu_hbm(devices) -> tuple[int, int]:
@@ -50,7 +54,13 @@ def tpu_memory_metrics() -> list[dict]:
 
 class TpuMetricsReporter:
     """Lazily-connected pusher; no-op when the task env is absent (direct
-    script runs outside the orchestrator)."""
+    script runs outside the orchestrator).
+
+    Non-blocking (docs/HOTLOOP.md): `report()` samples HBM here (a cheap
+    host call) and hands the RPC to a daemon worker thread — the train
+    loop never waits on the network. The push queue is shallow and
+    drop-newest: metrics are a periodic gauge, so when the AM is slow a
+    stale sample is simply skipped in favor of the next interval's."""
 
     def __init__(self, env: Optional[dict] = None):
         e = env if env is not None else os.environ
@@ -63,13 +73,41 @@ class TpuMetricsReporter:
         self._token = e.get(TOKEN_ENV) or None
         self._client = None
         self._enabled = bool(self._host and self._port and self._task_type)
+        self._queue: queue.Queue = queue.Queue(maxsize=2)
+        self._worker: Optional[threading.Thread] = None
 
     def report(self) -> None:
+        """Enqueue one HBM sample for the background pusher. Never blocks
+        the caller: a full queue drops the sample (the next interval's
+        fresher one supersedes it)."""
         if not self._enabled:
             return
         metrics = tpu_memory_metrics()
         if not metrics:
             return
+        if self._worker is None:
+            # a FRESH queue per worker: after a timed-out close() the old
+            # queue may still hold a stale _CLOSE (its wedged worker owns
+            # it and exits when it unwedges) — a successor must not
+            # consume that sentinel and die on arrival
+            self._queue = queue.Queue(maxsize=2)
+            self._worker = threading.Thread(
+                target=self._drain, args=(self._queue,),
+                name="tony-metrics-push", daemon=True)
+            self._worker.start()
+        try:
+            self._queue.put_nowait(metrics)
+        except queue.Full:
+            LOG.debug("metrics push queue full; dropping stale sample")
+
+    def _drain(self, q: queue.Queue) -> None:
+        while True:
+            item = q.get()
+            if item is _CLOSE:
+                return
+            self._push(item)
+
+    def _push(self, metrics: list[dict]) -> None:
         try:
             if self._client is None:
                 from tony_tpu.rpc.client import MetricsServiceClient
@@ -86,3 +124,15 @@ class TpuMetricsReporter:
                 wait_for_ready=False)
         except Exception:  # noqa: BLE001 — metrics never break training
             LOG.debug("tpu metrics push failed", exc_info=True)
+
+    def close(self, timeout: float = 2.0) -> None:
+        """Flush-and-stop the background pusher (idempotent). Queued
+        samples ahead of the close marker are still delivered."""
+        worker, self._worker = self._worker, None
+        if worker is None or not worker.is_alive():
+            return
+        try:
+            self._queue.put(_CLOSE, timeout=timeout)
+        except queue.Full:
+            return   # worker wedged on a slow RPC; it is a daemon thread
+        worker.join(timeout)

@@ -26,12 +26,35 @@ import optax
 from jax import lax
 
 
+class AnnotatedStep:
+    """Wraps a step callable so every invocation runs under
+    `jax.profiler.StepTraceAnnotation` with an auto-incrementing
+    `step_num` — XProf then attributes host stalls (input waits, sync
+    points) to the exact train step they delayed. The counter is plain
+    host state: a resuming trainer re-seats it (`step_num = resume_step`)
+    so trace step numbers line up with training steps across retries."""
+
+    def __init__(self, fn: Callable, name: str = "train_step",
+                 step_num: int = 0):
+        self._fn = fn
+        self._name = name
+        self.step_num = step_num
+
+    def __call__(self, *args, **kwargs):
+        with jax.profiler.StepTraceAnnotation(self._name,
+                                              step_num=self.step_num):
+            out = self._fn(*args, **kwargs)
+        self.step_num += 1
+        return out
+
+
 def make_train_step(loss_fn: Callable[..., jax.Array],
                     optimizer: optax.GradientTransformation,
                     jit: bool = True,
                     grad_accum: int = 1,
                     accum_dtype: Any = jnp.float32,
-                    emit_accum_dtype: bool = False) -> Callable:
+                    emit_accum_dtype: bool = False,
+                    annotate: bool = False) -> Callable:
     """loss_fn(params, batch) -> scalar. Returns
     train_step(params, opt_state, batch) -> (params, opt_state, loss).
 
@@ -41,7 +64,11 @@ def make_train_step(loss_fn: Callable[..., jax.Array],
     default (optax type promotion would otherwise upcast the params on
     apply); pass emit_accum_dtype=True when the optimizer keeps its own
     higher-precision state (train/precision.py with_f32_master) so the
-    f32-accumulated mean is not quantized at the interface."""
+    f32-accumulated mean is not quantized at the interface.
+
+    annotate=True wraps the returned callable in AnnotatedStep so each
+    dispatch carries an XProf StepTraceAnnotation (hot-loop overlap
+    tracing, docs/HOTLOOP.md)."""
 
     if grad_accum <= 1:
         def loss_and_grads(params, batch):
@@ -110,6 +137,8 @@ def make_train_step(loss_fn: Callable[..., jax.Array],
 
     if jit:
         train_step = jax.jit(train_step, donate_argnums=(0, 1))
+    if annotate:
+        train_step = AnnotatedStep(train_step)
     return train_step
 
 

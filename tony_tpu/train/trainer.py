@@ -15,6 +15,7 @@ Boot sequence inside a task container:
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import time
@@ -27,7 +28,7 @@ import optax
 from tony_tpu import constants as C
 from tony_tpu.parallel import mesh_from_env, shard_pytree
 from tony_tpu.train.checkpoint import latest_step, restore_checkpoint
-from tony_tpu.train.data import global_batch_iterator
+from tony_tpu.train.data import PrefetchIterator, global_batch_iterator
 from tony_tpu.train.step import make_train_step
 
 LOG = logging.getLogger(__name__)
@@ -73,6 +74,10 @@ class TrainerConfig:
     # the mean (0 = no eval; requires eval_data_iter on the Trainer)
     eval_every: int = 0
     eval_batches: int = 1
+    # overlapped input pipeline (docs/HOTLOOP.md): depth of the
+    # background device-prefetch queue. None = TONY_PREFETCH_DEPTH env
+    # (default 2); 0 = synchronous global_batch_iterator (debug knob)
+    prefetch_depth: Optional[int] = None
     extra: dict = field(default_factory=dict)
 
 
@@ -142,7 +147,10 @@ class Trainer:
             self._bound_loss_fn, self.optimizer, grad_accum=cfg.grad_accum,
             # the master consumes f32 grads: don't quantize the
             # f32-accumulated mean back to bf16 at the interface
-            emit_accum_dtype=cfg.master_weights)
+            emit_accum_dtype=cfg.master_weights,
+            # XProf step annotations: traces attribute host stalls to the
+            # exact step they delayed (docs/HOTLOOP.md)
+            annotate=True)
 
         resume = (latest_step(cfg.checkpoint_dir)
                   if cfg.checkpoint_dir else None)
@@ -190,13 +198,45 @@ class Trainer:
             self.params = state["params"]
             self.opt_state = state["opt_state"]
             self.step = int(state["step"])
-        # multi-process data parallelism: assemble global arrays from each
-        # process's local shard. Bind into a separate attribute — a
-        # second setup() (session retry) must not wrap the wrapper (the
-        # outer one would feed already-global arrays into
-        # make_array_from_process_local_data)
-        self._global_data_iter = global_batch_iterator(self.data_iter,
-                                                       self.mesh)
+        # re-seat the XProf annotation counter so trace step numbers
+        # line up with training steps across AM retries — including a
+        # checkpoint-less re-setup() where self.step was retained but
+        # make_train_step rebuilt the wrapper at 0 (no-op when fresh)
+        self.train_step.step_num = self.step
+        # Overlapped input pipeline: background host generation + H2D
+        # transfer, N batches deep on device (docs/HOTLOOP.md). Bind into
+        # a separate attribute — a second setup() (session retry) must
+        # not wrap the wrapper (the outer one would feed already-global
+        # arrays into make_array_from_process_local_data); close the old
+        # prefetcher first so its thread is released, and carry its
+        # undelivered batches into the successor — they were already
+        # pulled from the shared self.data_iter, so dropping them would
+        # silently skip up to depth+1 batches across a retry.
+        old = getattr(self, "_global_data_iter", None)
+        # sync-path leftovers live on self._carry (consumed in place),
+        # prefetch-path leftovers on the closed iterator — exactly one
+        # of the two is non-empty, and either survives ANOTHER re-setup
+        carry: list = list(getattr(self, "_carry", ()))
+        if isinstance(old, PrefetchIterator):
+            old.close()
+            carry = old.leftover + carry
+        depth = cfg.prefetch_depth
+        if depth is None:
+            depth = int(os.environ.get("TONY_PREFETCH_DEPTH", "2"))
+        if depth > 0:
+            self._carry = []
+            self._global_data_iter = PrefetchIterator(
+                self.data_iter, self.mesh, depth=depth, initial=carry)
+        else:
+            self._carry = carry
+
+            def _sync_with_carry():
+                while self._carry:
+                    yield self._carry.pop(0)
+                yield from global_batch_iterator(self.data_iter,
+                                                 self.mesh)
+
+            self._global_data_iter = _sync_with_carry()
         if cfg.eval_every and self.eval_data_iter is not None:
             from tony_tpu.train.step import make_eval_step
             self.eval_step = make_eval_step(self._bound_loss_fn)
@@ -206,62 +246,120 @@ class Trainer:
             # score different batches after a resume). "Once" includes
             # across a re-setup(): rebuilding would draw the NEXT
             # batches from the partially-consumed iterator and silently
-            # swap the held-out set
+            # swap the held-out set. Materialization rides the same
+            # prefetcher so generation overlaps the H2D copies, then the
+            # temporary thread is closed.
             if getattr(self, "_eval_set", None) is None:
-                stream = global_batch_iterator(self.eval_data_iter,
-                                               self.mesh)
-                self._eval_set = [
-                    next(stream) for _ in range(max(1, cfg.eval_batches))]
+                n = max(1, cfg.eval_batches)
+                # islice caps the pull at exactly n: the producer would
+                # otherwise run ahead and silently advance a shared
+                # eval_data_iter past the batches actually kept
+                with PrefetchIterator(
+                        itertools.islice(self.eval_data_iter, n),
+                        self.mesh, depth=n) as stream:
+                    self._eval_set = [next(stream) for _ in range(n)]
 
     def _evaluate(self) -> float:
         """Mean loss over the fixed held-out eval set (params only — no
-        gradients, no optimizer state touched)."""
-        total = 0.0
+        gradients, no optimizer state touched). Losses accumulate ON
+        DEVICE; the single host read happens once at the end, so an
+        N-batch eval costs one sync, not N."""
+        total = None
         for batch in self._eval_set:
-            total += float(self.eval_step(self.params, batch))
-        return total / len(self._eval_set)
+            loss = self.eval_step(self.params, batch)
+            total = loss if total is None else total + loss
+        return float(total) / len(self._eval_set)
 
     # ------------------------------------------------------------------
     def run(self) -> float:
-        """Train to num_steps; returns the final loss."""
+        """Train to num_steps; returns the final loss.
+
+        The hot loop is sync-free (docs/HOTLOOP.md): the loss stays a
+        device array between optimizer updates — no `float()` forces a
+        host<->device barrier on the current step. Logging is one
+        interval LATENT: at each log boundary the PREVIOUS boundary's
+        retained loss is fetched (the device is log_every steps past it,
+        so the read returns immediately) and the current one is queued.
+        The final boundary and the final loss flush after the loop."""
         if self.params is None:
             self.setup()
+        it = self._global_data_iter
+        if (isinstance(it, PrefetchIterator) and it.closed
+                and self.step < self.config.num_steps):
+            # a previous run() completed and released its prefetch
+            # thread; a num_steps-bump re-run restarts one, resuming
+            # the shared source stream from the retained leftovers
+            # (the step guard keeps an exact-resume no-op run() from
+            # spinning up a pipeline it would immediately tear down)
+            self._global_data_iter = PrefetchIterator(
+                self.data_iter, self.mesh, depth=it.depth,
+                initial=it.leftover)
         cfg = self.config
         loss = None
-        with jax.set_mesh(self.mesh):
-            t0 = time.monotonic()
-            while self.step < cfg.num_steps:
-                batch = next(self._global_data_iter)
-                self.params, self.opt_state, loss = self.train_step(
-                    self.params, self.opt_state, batch)
-                self.step += 1
-                if cfg.log_every and self.step % cfg.log_every == 0:
-                    loss_f = float(loss)
-                    dt = time.monotonic() - t0
-                    self.last_loss = loss_f
-                    self.metrics_history.append(
-                        {"step": self.step, "loss": loss_f, "elapsed_s": dt})
-                    LOG.info("step %d loss %.4f (%.1fs)", self.step, loss_f,
-                             dt)
-                    self._metrics_reporter.report()
-                if (cfg.eval_every and self.eval_data_iter is not None
-                        and self.step % cfg.eval_every == 0):
-                    self.last_eval_loss = self._evaluate()
-                    self.metrics_history.append(
-                        {"step": self.step,
-                         "eval_loss": self.last_eval_loss})
-                    LOG.info("step %d eval_loss %.4f", self.step,
-                             self.last_eval_loss)
-                if (cfg.checkpoint_dir and cfg.checkpoint_every
-                        and self.step % cfg.checkpoint_every == 0):
-                    self._checkpoint()
-            if loss is not None:       # loop may no-op on an exact resume
-                self.last_loss = float(loss)
-            if cfg.checkpoint_dir and loss is not None:
-                self._checkpoint(final=True)
-            elif self._checkpointer is not None:
-                self._checkpointer.close()
-                self._checkpointer = None
+        pending = None   # (step, device loss, elapsed_s) awaiting fetch
+
+        def _flush(p) -> None:
+            step, dev_loss, dt = p
+            loss_f = float(dev_loss)
+            self.last_loss = loss_f
+            self.metrics_history.append(
+                {"step": step, "loss": loss_f, "elapsed_s": dt})
+            LOG.info("step %d loss %.4f (%.1fs)", step, loss_f, dt)
+
+        try:
+            with jax.set_mesh(self.mesh):
+                t0 = time.monotonic()
+                while self.step < cfg.num_steps:
+                    batch = next(self._global_data_iter)
+                    self.params, self.opt_state, loss = self.train_step(
+                        self.params, self.opt_state, batch)
+                    self.step += 1
+                    if cfg.log_every and self.step % cfg.log_every == 0:
+                        if pending is not None:
+                            _flush(pending)
+                        pending = (self.step, loss,
+                                   time.monotonic() - t0)
+                        self._metrics_reporter.report()
+                    if (cfg.eval_every
+                            and self.eval_data_iter is not None
+                            and self.step % cfg.eval_every == 0):
+                        self.last_eval_loss = self._evaluate()
+                        self.metrics_history.append(
+                            {"step": self.step,
+                             "eval_loss": self.last_eval_loss})
+                        LOG.info("step %d eval_loss %.4f", self.step,
+                                 self.last_eval_loss)
+                    if (cfg.checkpoint_dir and cfg.checkpoint_every
+                            and self.step % cfg.checkpoint_every == 0):
+                        self._checkpoint()
+                if pending is not None:
+                    _flush(pending)
+                    pending = None
+                if loss is not None:   # loop may no-op on exact resume
+                    self.last_loss = float(loss)
+                if cfg.checkpoint_dir and loss is not None:
+                    self._checkpoint(final=True)
+                elif self._checkpointer is not None:
+                    self._checkpointer.close()
+                    self._checkpointer = None
+        finally:
+            # an error mid-loop must not lose the already-queued log
+            # boundary the synchronous loop would have recorded (the
+            # read may itself fail if the device is wedged — best-effort)
+            if pending is not None:
+                try:
+                    _flush(pending)
+                except Exception:  # noqa: BLE001
+                    LOG.debug("could not flush pending log boundary",
+                              exc_info=True)
+            # on completion AND on error: release the prefetch thread
+            # and the metrics push worker (both idempotent). Undelivered
+            # batches stay on the closed iterator's .leftover, so a
+            # num_steps-bump re-run() — or a retry after the error —
+            # revives the pipeline above with no gap in the stream.
+            if isinstance(self._global_data_iter, PrefetchIterator):
+                self._global_data_iter.close()
+            self._metrics_reporter.close()
         return self.last_loss
 
     def _maybe_start_profiler(self) -> None:
