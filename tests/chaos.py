@@ -1,0 +1,265 @@
+"""Deterministic chaos harness for the fault-tolerance suite.
+
+Drives the TEST_* fault-injection hooks compiled into the AM and the
+TaskExecutor (the reference's pattern, Constants.java:116-121) plus the
+task-relaunch injection points (TEST_TASK_KILL / TEST_TASK_HB_SILENCE)
+through the LocalClusterBackend, so every recovery path is proven on the
+genuine client → AM → executor → user-python chain.
+
+Determinism contract: every randomized quantity in a chaos run derives from
+`ChaosRun.seed` — injection delays come from the run's own
+`random.Random(seed)`, and the seed is exported as TONY_TEST_SEED so the
+rpc-client retry jitter inside the AM and every executor child process is
+seeded per endpoint too (rpc/client.py). A failing chaos test therefore
+replays exactly by pinning the same seed.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+from typing import Optional
+
+from tony_tpu import constants as C
+from tony_tpu.client.tony_client import TonyClient
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.events.handler import parse_events
+from tony_tpu.events.schema import EventType
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+
+
+def script(name: str) -> str:
+    return os.path.join(SCRIPTS, name)
+
+
+# ---------------------------------------------------------------------------
+# injections: each knows the env hook(s) it plants; the AM and executor
+# subprocesses inherit them (the reference compiled the same hooks into
+# prod code, Constants.java:116-121)
+# ---------------------------------------------------------------------------
+
+class Injection:
+    def env(self) -> dict:
+        raise NotImplementedError
+
+
+class KillTask(Injection):
+    """Hard-crash one attempt's container `after_ms` after its user process
+    launches, WITHOUT registering a result — the container-completion
+    relaunch path (executor hook TEST_TASK_KILL)."""
+
+    def __init__(self, job: str, index: int, after_ms: int,
+                 attempt: "int | str" = 0):
+        self.job, self.index = job, index
+        self.after_ms, self.attempt = after_ms, attempt
+
+    def env(self) -> dict:
+        return {C.TEST_TASK_KILL:
+                f"{self.job}#{self.index}#{self.after_ms}#{self.attempt}"}
+
+
+class SilenceHeartbeats(Injection):
+    """One attempt's heartbeater goes permanently silent while its user
+    process keeps running — the wedge, exercising the heartbeat-expiry
+    relaunch path (executor hook TEST_TASK_HB_SILENCE)."""
+
+    def __init__(self, job: str, index: int, attempt: "int | str" = 0):
+        self.job, self.index, self.attempt = job, index, attempt
+
+    def env(self) -> dict:
+        return {C.TEST_TASK_HB_SILENCE:
+                f"{self.job}#{self.index}#{self.attempt}"}
+
+
+class MissHeartbeats(Injection):
+    """Every executor skips its first `n` heartbeats
+    (TEST_TASK_EXECUTOR_NUM_HB_MISS, TaskExecutor.java:334-344)."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def env(self) -> dict:
+        return {C.TEST_TASK_EXECUTOR_NUM_HB_MISS: str(self.n)}
+
+
+class DelayCompletionNotification(Injection):
+    """Container-completion callbacks arrive `sec` late
+    (TEST_TASK_COMPLETION_NOTIFICATION_DELAYED,
+    ApplicationMaster.java:1028-1037)."""
+
+    def __init__(self, sec: float):
+        self.sec = sec
+
+    def env(self) -> dict:
+        return {C.TEST_TASK_COMPLETION_NOTIFICATION_DELAYED: str(self.sec)}
+
+
+class CrashAM(Injection):
+    """The AM dies right after prepare() (TEST_AM_CRASH,
+    ApplicationMaster.java:337-342)."""
+
+    def env(self) -> dict:
+        return {C.TEST_AM_CRASH: "1"}
+
+
+class TerminateWorkers(Injection):
+    """The AM kills every worker container once the chief registers
+    (TEST_WORKER_TERMINATION, ApplicationMaster.java:1204-1215)."""
+
+    def env(self) -> dict:
+        return {C.TEST_WORKER_TERMINATION: "1"}
+
+
+class Skew(Injection):
+    """Delay one task between the barrier and exec
+    (TEST_TASK_EXECUTOR_SKEW, TaskExecutor.java:372-392)."""
+
+    def __init__(self, job: str, index: int, ms: int):
+        self.job, self.index, self.ms = job, index, ms
+
+    def env(self) -> dict:
+        return {C.TEST_TASK_EXECUTOR_SKEW: f"{self.job}#{self.index}#{self.ms}"}
+
+
+# ---------------------------------------------------------------------------
+# the harness
+# ---------------------------------------------------------------------------
+
+def fast_conf(tmp_path, **overrides) -> TonyConfiguration:
+    """Test-scale cadences (mirrors test_e2e.fast_conf): heartbeat expiry
+    window = 0.2s * max(3, max-missed)."""
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_WORKDIR, str(tmp_path), "chaos")
+    conf.set(K.AM_MONITOR_INTERVAL_MS, 100, "chaos")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "chaos")
+    conf.set(K.TASK_MAX_MISSED_HEARTBEATS, 25, "chaos")
+    conf.set(K.TASK_METRICS_INTERVAL_MS, 500, "chaos")
+    conf.set(K.TASK_REGISTRATION_TIMEOUT_SEC, 60, "chaos")
+    conf.set(K.CONTAINER_ALLOCATION_TIMEOUT, 60_000, "chaos")
+    conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 3000, "chaos")
+    for k, v in overrides.items():
+        conf.set(k, v, "chaos")
+    return conf
+
+
+class ChaosRun:
+    """One seeded chaos experiment: plants injection env hooks, runs a real
+    job on the local backend, and exposes the evidence (final status,
+    history events, AM/container logs, per-start markers)."""
+
+    def __init__(self, tmp_path, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(f"chaos:{seed}")
+        self.tmp_path = tmp_path
+        self.marker_dir = str(tmp_path / "markers")
+        self.client: Optional[TonyClient] = None
+
+    def delay_ms(self, lo: int, hi: int) -> int:
+        """Seed-deterministic injection delay: same seed → same delay, so a
+        chaos failure replays with identical timing intent."""
+        return self.rng.randint(lo, hi)
+
+    # -- execution -----------------------------------------------------
+    def run(self, argv: list, injections: "tuple | list" = (),
+            conf_overrides: Optional[dict] = None,
+            extra_env: Optional[dict] = None) -> TonyClient:
+        # hooks + extras ride os.environ: the AM is a child process of this
+        # one and executors are children of the AM, so the whole chain
+        # inherits them (the reference's TEST_* hooks worked the same way)
+        env = {C.TEST_SEED: str(self.seed)}
+        for inj in injections:
+            env.update(inj.env())
+        env.update({k: str(v) for k, v in (extra_env or {}).items()})
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            conf = fast_conf(self.tmp_path, **(conf_overrides or {}))
+            self.client = TonyClient(conf)
+            self.client.init(list(argv)
+                             + ["--conf",
+                                f"tony.execution.env=MARKER_DIR={self.marker_dir}"])
+            self.client.run()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        return self.client
+
+    # -- evidence ------------------------------------------------------
+    @property
+    def final_status(self) -> str:
+        return self.client.final_status
+
+    @property
+    def final_message(self) -> str:
+        return self.client.final_message or ""
+
+    def history_events(self):
+        hist_base = os.path.join(self.client.app_dir, C.HISTORY_DIR_NAME)
+        finals = [os.path.join(d, f)
+                  for d, _, files in os.walk(hist_base)
+                  for f in files if f.endswith(".jhist")]
+        assert len(finals) == 1, f"expected one .jhist, got {finals}"
+        return os.path.basename(finals[0]), parse_events(finals[0])
+
+    def events_of_type(self, event_type: EventType) -> list:
+        _, events = self.history_events()
+        return [e for e in events if e.type == event_type]
+
+    def relaunches(self) -> list:
+        """TASK_RELAUNCHED payloads, in history order."""
+        return [e.payload
+                for e in self.events_of_type(EventType.TASK_RELAUNCHED)]
+
+    def task_starts(self, job: str, index: int) -> list:
+        """TASK_STARTED payloads for one task slot — one per container, so
+        a surviving task keeps exactly one across peer relaunches."""
+        return [e.payload for e in self.events_of_type(EventType.TASK_STARTED)
+                if e.payload.task_type == job and e.payload.task_index == index]
+
+    def am_log(self) -> str:
+        chunks = []
+        for name in (C.AM_STDERR, C.AM_STDOUT):
+            path = os.path.join(self.client.app_dir, name)
+            if os.path.isfile(path):
+                with open(path, "r", errors="replace") as f:
+                    chunks.append(f.read())
+        return "\n".join(chunks)
+
+    def session_retry_backoffs_ms(self) -> list:
+        """The observed whole-session retry backoffs, parsed from the AM's
+        'session failed; AM retry i/N after X ms backoff' log lines."""
+        return [float(m) for m in re.findall(
+            r"AM retry \d+/\d+ after (\d+) ms backoff", self.am_log())]
+
+    def markers(self, job: str, index: int) -> list:
+        """One parsed line per user-process start of `job:index` — the
+        chaos scripts append {attempt, generation} on every launch, so this
+        is the ground truth for 'survivor restarted its user process on the
+        new generation without a new container'."""
+        import json
+        path = os.path.join(self.marker_dir, f"{job}_{index}")
+        if not os.path.isfile(path):
+            return []
+        with open(path, "r") as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def all_logs(self) -> str:
+        """Every AM/container stream, for assertion messages."""
+        chunks = []
+        for root, _dirs, files in os.walk(self.client.app_dir):
+            for f in files:
+                if f in ("stdout", "stderr", C.AM_STDOUT, C.AM_STDERR):
+                    path = os.path.join(root, f)
+                    try:
+                        with open(path, "r", errors="replace") as fh:
+                            content = fh.read().strip()
+                        if content:
+                            chunks.append(f"==== {path} ====\n{content}")
+                    except OSError:
+                        pass
+        return "\n".join(chunks)[-8000:]

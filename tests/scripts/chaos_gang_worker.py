@@ -1,0 +1,53 @@
+"""Chaos-harness gang member (tests/chaos.py + tests/test_fault_tolerance.py).
+
+Every start appends a {attempt, generation} line to $MARKER_DIR/<job>_<idx>,
+so the test can prove which attempt of which task ran against which
+cluster-spec generation. Behavior:
+
+- generation > 1: a relaunch already happened and this process was launched
+  against the post-relaunch spec — exit 0 (the job converges).
+- generation 1 and this task is $CHAOS_EXIT_ONE (format "job:index") on its
+  first attempt: wait until every gang member has started (their generation-1
+  markers exist — the deterministic ordering guarantee), then exit 1. The
+  executor reports the failure, exercising the register_execution_result
+  relaunch path.
+- generation 1 otherwise: sleep — the process is either hard-killed by an
+  injection (TEST_TASK_KILL / heartbeat expiry) or stopped by its executor
+  for re-rendezvous once a peer is relaunched.
+"""
+
+import json
+import os
+import time
+
+job = os.environ["JOB_NAME"]
+index = int(os.environ["TASK_INDEX"])
+task_num = int(os.environ.get("TASK_NUM", "1"))
+attempt = int(os.environ.get("TASK_ATTEMPT", "0"))
+generation = int(os.environ.get("SPEC_GENERATION", "0"))
+marker_dir = os.environ["MARKER_DIR"]
+
+os.makedirs(marker_dir, exist_ok=True)
+with open(os.path.join(marker_dir, f"{job}_{index}"), "a") as f:
+    f.write(json.dumps({"attempt": attempt, "generation": generation}) + "\n")
+
+
+def peers_started() -> bool:
+    for i in range(task_num):
+        path = os.path.join(marker_dir, f"{job}_{i}")
+        if not os.path.isfile(path):
+            return False
+    return True
+
+
+if generation > 1:
+    raise SystemExit(0)
+
+if (os.environ.get("CHAOS_EXIT_ONE") == f"{job}:{index}" and attempt == 0):
+    deadline = time.monotonic() + 30
+    while not peers_started() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    raise SystemExit(1)
+
+time.sleep(60)
+raise SystemExit(1)

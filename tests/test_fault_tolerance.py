@@ -1,0 +1,726 @@
+"""Task-level fault tolerance: bounded relaunch, jittered backoff, chaos.
+
+The e2e cases drive real client → AM → executor → user-python chains on the
+LocalClusterBackend through the deterministic chaos harness (tests/chaos.py);
+the unit cases pin the decision-path mechanics (attempt budgets, backoff
+shapes, liveliness gating, executor re-rendezvous) without processes.
+
+Recovery paths proven end-to-end:
+- container crash without a registered result  → relaunch (completion path)
+- executor-reported non-zero exit              → relaunch (result path)
+- heartbeat expiry (wedged/silent task)        → relaunch (liveliness path)
+- attempt budget exhausted                     → whole-session retry w/ backoff
+- app-wide failure circuit breaker             → stop relaunching, fail
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.am.application_master import (
+    ApplicationMaster, session_retry_backoff_sec,
+)
+from tony_tpu.am.liveliness import LivelinessMonitor
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.events.schema import EventType
+from tony_tpu.executor.task_executor import TaskExecutor
+from tony_tpu.rpc.client import _JsonRpcClient
+from tony_tpu.rpc.service import CLUSTER_SERVICE, CLUSTER_METHODS
+from tony_tpu.session.session import TonySession
+
+from tests.chaos import (
+    ChaosRun, CrashAM, DelayCompletionNotification, KillTask, MissHeartbeats,
+    SilenceHeartbeats, TerminateWorkers, script,
+)
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: the relaunch decision paths (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+chaos = pytest.mark.chaos
+
+
+@chaos
+def test_worker_killed_midrun_is_relaunched_within_budget(tmp_path):
+    """The headline scenario: a worker container hard-crashes mid-run
+    (no result registered), the AM relaunches ONLY that task, the survivor
+    re-rendezvouses on the bumped generation in its original container, and
+    the job succeeds."""
+    run = ChaosRun(tmp_path, seed=1)
+    run.run(
+        ["--executes", script("chaos_gang_worker.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.task.max-task-attempts=2"],
+        injections=[KillTask("worker", 1, run.delay_ms(800, 1200),
+                             attempt=0)])
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+
+    rel = run.relaunches()
+    assert len(rel) == 1, run.all_logs()
+    assert (rel[0].task_type, rel[0].task_index) == ("worker", 1)
+    assert rel[0].attempt == 1          # replacement runs as attempt 1
+    assert rel[0].generation == 2       # relaunch bumped the spec generation
+    assert "exited with code" in rel[0].reason
+
+    # the victim got a replacement container; the survivor kept its own
+    assert len(run.task_starts("worker", 1)) == 2
+    assert len(run.task_starts("worker", 0)) == 1
+
+    # survivor's user process restarted against the new generation — same
+    # attempt (same container), new spec
+    survivor = run.markers("worker", 0)
+    assert [m["generation"] for m in survivor] == [1, 2], run.all_logs()
+    assert [m["attempt"] for m in survivor] == [0, 0]
+    # the replacement attempt launched against the post-relaunch spec
+    assert run.markers("worker", 1)[-1] == {"attempt": 1, "generation": 2}
+
+
+@chaos
+def test_executor_reported_failure_is_relaunched(tmp_path):
+    """A non-zero exit reported through register_execution_result (not a
+    silent container crash) takes the same relaunch path. Fully
+    deterministic: the victim only exits after every gang member's
+    generation-1 marker exists."""
+    run = ChaosRun(tmp_path, seed=2)
+    run.run(
+        ["--executes", script("chaos_gang_worker.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.task.max-task-attempts=2"],
+        extra_env={"CHAOS_EXIT_ONE": "worker:1"})
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    rel = run.relaunches()
+    assert len(rel) == 1, run.all_logs()
+    assert "executor reported exit 1" in rel[0].reason
+    assert [m["generation"] for m in run.markers("worker", 0)] == [1, 2]
+
+
+@chaos
+def test_heartbeat_expiry_is_relaunched(tmp_path):
+    """A wedged task (user process alive, heartbeats silent) expires in the
+    liveliness monitor and is relaunched instead of ending the app —
+    the _on_task_deemed_dead path."""
+    run = ChaosRun(tmp_path, seed=3)
+    run.run(
+        ["--executes", script("chaos_gang_worker.py"),
+         "--conf", "tony.worker.instances=2",
+        # expiry window = 0.2s * 8 = 1.6s: quick for the silent victim,
+        # roomy enough that a loaded machine can't expire a healthy
+        # survivor whose heartbeats merely stall for a moment
+         "--conf", "tony.task.max-task-attempts=2",
+         "--conf", "tony.task.max-missed-heartbeats=8"],
+        injections=[SilenceHeartbeats("worker", 1, attempt=0)])
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    rel = run.relaunches()
+    assert len(rel) == 1, run.all_logs()
+    assert "missed" in rel[0].reason and "heartbeats" in rel[0].reason
+    assert len(run.task_starts("worker", 0)) == 1   # survivor kept container
+
+
+@chaos
+def test_exhausted_budget_falls_back_to_session_retry_with_backoff(tmp_path):
+    """Budget exhaustion escalates to today's whole-session retry, which now
+    waits a capped jittered exponential backoff. The backoff is
+    deterministic per (app_id, attempt), so the observed delay must equal
+    the recomputed one — the replay-exactly property."""
+    run = ChaosRun(tmp_path, seed=4)
+    run.run(
+        ["--executes", script("exit_1.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.task.max-task-attempts=2",
+         "--conf", "tony.am.retry-count=1",
+         "--conf", "tony.am.retry-backoff-base-ms=400",
+         "--conf", "tony.am.retry-backoff-max-ms=400"])
+    assert run.final_status == "FAILED", run.all_logs()
+    # each session burned the 2-attempt budget: 1 relaunch per session
+    rel = run.relaunches()
+    assert len(rel) == 2, run.all_logs()
+    assert [r.attempt for r in rel] == [1, 1]
+    # observable backoff between the sessions, inside the jitter envelope
+    backoffs = run.session_retry_backoffs_ms()
+    assert len(backoffs) == 1, run.am_log()[-4000:]
+    assert 200 <= backoffs[0] <= 400
+    expected_ms = session_retry_backoff_sec(
+        run.client.app_id, 1, 400, 400) * 1000
+    assert abs(backoffs[0] - expected_ms) <= 1  # log prints %.0f
+
+
+@chaos
+def test_total_failure_circuit_breaker_stops_relaunching(tmp_path):
+    """tony.application.max-total-task-failures caps relaunches app-wide
+    even when the per-task budget has room left."""
+    run = ChaosRun(tmp_path, seed=5)
+    run.run(
+        ["--executes", script("exit_1.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.task.max-task-attempts=10",
+         "--conf", "tony.application.max-total-task-failures=1"])
+    assert run.final_status == "FAILED", run.all_logs()
+    assert len(run.relaunches()) == 1, run.all_logs()
+    assert "circuit breaker" in run.am_log()
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: the four pre-existing fault-injection hooks, with history and
+# exit-code assertions (satellite coverage)
+# ---------------------------------------------------------------------------
+
+@chaos
+def test_am_crash_fails_with_status_and_exit_code(tmp_path):
+    run = ChaosRun(tmp_path, seed=6)
+    run.run(["--executes", script("exit_0.py"),
+             "--conf", "tony.worker.instances=1"],
+            injections=[CrashAM()])
+    assert run.final_status == "FAILED"
+    assert "TEST_AM_CRASH" in run.final_message
+    # the AM process itself died non-zero, like a real AM container crash
+    assert run.client._am_proc.poll() == 1
+
+
+@chaos
+@pytest.mark.slow
+def test_worker_termination_records_killed_tasks(tmp_path):
+    run = ChaosRun(tmp_path, seed=7)
+    run.run(["--executes", script("sleep_30.py"),
+             "--conf", "tony.worker.instances=2"],
+            injections=[TerminateWorkers()])
+    assert run.final_status == "FAILED", run.all_logs()
+    # AM-killed containers exit EXIT_KILLED_BY_AM → task status FINISHED
+    finished = run.events_of_type(EventType.TASK_FINISHED)
+    assert len(finished) == 2
+    assert all(e.payload.status == "FINISHED" for e in finished)
+    # an AM kill is not a task fault: no relaunch may fire
+    assert run.relaunches() == []
+
+
+@chaos
+def test_missed_heartbeats_relaunch_then_exhaust(tmp_path):
+    """TEST_TASK_EXECUTOR_NUM_HB_MISS composed with the attempt budget: the
+    first expiry relaunches, the replacement (inheriting the hook) expires
+    again, the exhausted budget fails the app with the classic message."""
+    run = ChaosRun(tmp_path, seed=8)
+    run.run(["--executes", script("sleep_30.py"),
+             "--conf", "tony.worker.instances=1",
+             "--conf", "tony.task.max-missed-heartbeats=5",
+             "--conf", "tony.task.max-task-attempts=2"],
+            injections=[MissHeartbeats(100)])
+    assert run.final_status == "FAILED", run.all_logs()
+    assert "missed" in run.final_message and "[5]" in run.final_message
+    rel = run.relaunches()
+    assert len(rel) == 1 and "missed" in rel[0].reason
+
+
+@chaos
+def test_delayed_completion_is_neither_failure_nor_relaunch(tmp_path):
+    """A clean exit whose container-completion callback arrives late must
+    stay a success — and must not be mistaken for a crash to relaunch."""
+    run = ChaosRun(tmp_path, seed=9)
+    run.run(["--executes", script("exit_0.py"),
+             "--conf", "tony.worker.instances=1",
+             "--conf", "tony.task.max-task-attempts=3"],
+            injections=[DelayCompletionNotification(2)])
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    name, _ = run.history_events()
+    assert "SUCCEEDED" in name
+    finished = run.events_of_type(EventType.TASK_FINISHED)
+    assert [e.payload.status for e in finished] == ["SUCCEEDED"]
+    assert run.relaunches() == []
+
+
+def test_chaos_harness_is_seed_deterministic(tmp_path):
+    """Replay-exactly: the same seed yields the same injection timings (and
+    exports TONY_TEST_SEED so child-process rpc jitter is pinned too)."""
+    a, b = ChaosRun(tmp_path, seed=7), ChaosRun(tmp_path, seed=7)
+    other = ChaosRun(tmp_path, seed=8)
+    seq = [a.delay_ms(100, 1000) for _ in range(5)]
+    assert seq == [b.delay_ms(100, 1000) for _ in range(5)]
+    assert seq != [other.delay_ms(100, 1000) for _ in range(5)]
+    kill = KillTask("worker", 1, seq[0], attempt=0)
+    assert kill.env() == {C.TEST_TASK_KILL: f"worker#1#{seq[0]}#0"}
+
+
+# ---------------------------------------------------------------------------
+# unit: AM decision path + satellite regressions
+# ---------------------------------------------------------------------------
+
+class _StubBackend:
+    off_host = False
+
+    def __init__(self):
+        self.stopped = []
+
+    def set_callbacks(self, *a, **k): ...
+    def start(self): ...
+    def stop(self): ...
+
+    def stop_container(self, cid):
+        self.stopped.append(cid)
+
+    def release_container(self, cid): ...
+    def request_containers(self, *a, **k): ...
+
+
+class _StubScheduler:
+    def __init__(self):
+        self.replacements = []
+
+    def schedule_replacement(self, job_name):
+        self.replacements.append(job_name)
+
+
+def _make_am(tmp_path, **conf_kv):
+    conf = TonyConfiguration()
+    conf.set("tony.worker.instances", 1, "test")
+    for k, v in conf_kv.items():
+        conf.set(k, v, "test")
+    am = ApplicationMaster(conf, "app_test_1", str(tmp_path),
+                           backend=_StubBackend())
+    am.session = TonySession(conf, session_id=0)
+    am.scheduler = _StubScheduler()
+    return am
+
+
+def test_stale_session_result_keeps_liveliness_registration(tmp_path):
+    """Satellite regression: register_execution_result must validate the
+    session id BEFORE unregistering from the liveliness monitor — a stale
+    previous-session executor reporting a same-named task must not strip
+    the current session's task from monitoring."""
+    am = _make_am(tmp_path)
+    am.hb_monitor.register("worker:0")
+    am.register_execution_result({"job_name": "worker", "job_index": 0,
+                                  "exit_code": 0, "session_id": 99})
+    assert am.hb_monitor.registered("worker:0"), \
+        "stale-session result stripped the live task from monitoring"
+    # the genuine session's result does unregister and complete the task
+    am.register_execution_result({"job_name": "worker", "job_index": 0,
+                                  "exit_code": 0, "session_id": 0})
+    assert not am.hb_monitor.registered("worker:0")
+    assert am.session.get_task("worker", 0).completed
+
+
+def test_superseded_attempt_result_is_ignored(tmp_path):
+    """A zombie executor of a relaunched-past attempt reporting its exit
+    must not complete (or fail) the replacement attempt."""
+    am = _make_am(tmp_path, **{"tony.task.max-task-attempts": 3})
+    am.session.relaunch_task("worker", 0)   # current attempt becomes 1
+    am.register_execution_result({"job_name": "worker", "job_index": 0,
+                                  "exit_code": 1, "session_id": 0,
+                                  "task_attempt": 0})
+    task = am.session.get_task("worker", 0)
+    assert not task.completed and task.attempt == 1
+
+
+def test_relaunch_budget_and_circuit_breaker_unit(tmp_path):
+    am = _make_am(tmp_path, **{"tony.task.max-task-attempts": 2})
+    task = am.session.get_task("worker", 0)
+    task.container_id = "c1"
+    assert am._maybe_relaunch_task(task, "boom") is True
+    assert am.backend.stopped == ["c1"]
+    assert am.scheduler.replacements == ["worker"]
+    assert task.attempt == 1 and am.session.spec_generation == 2
+    # budget (2 attempts) now exhausted → falls back to session failure
+    assert am._maybe_relaunch_task(task, "boom again") is False
+
+    am2 = _make_am(tmp_path, **{
+        "tony.task.max-task-attempts": 10,
+        "tony.application.max-total-task-failures": 0})
+    t2 = am2.session.get_task("worker", 0)
+    assert am2._maybe_relaunch_task(t2, "boom") is False  # breaker at 0
+
+
+def test_relaunch_fence_absorbs_second_observer_of_same_crash(tmp_path):
+    """One crash has up to three observers (executor result, container
+    completion, heartbeat expiry) racing without the AM lock: the second
+    observer of the SAME attempt's failure must be absorbed — not burn a
+    second budget slot, double-count the circuit breaker, or fail the
+    in-flight replacement."""
+    am = _make_am(tmp_path, **{"tony.task.max-task-attempts": 2})
+    task = am.session.get_task("worker", 0)
+    task.container_id = "c1"
+    assert am._maybe_relaunch_task(task, "crash", observed_attempt=0) is True
+    assert am._maybe_relaunch_task(task, "crash", observed_attempt=0) is True
+    assert task.attempt == 1                    # relaunched exactly once
+    assert am._total_task_failures == 1         # counted exactly once
+    assert am.scheduler.replacements == ["worker"]
+    # a genuinely NEW failure of the replacement is not fenced: budget is
+    # exhausted, so it falls through to the session path
+    assert am._maybe_relaunch_task(task, "crash", observed_attempt=1) is False
+
+
+def test_rendezvous_timeout_exit_never_relaunches(tmp_path):
+    """A flagged barrier timeout signals missing allocation, not a task
+    fault: spending relaunch budget on it would stop healthy containers
+    and re-arm the allocation deadline exactly when the pool is
+    starved."""
+    am = _make_am(tmp_path, **{"tony.task.max-task-attempts": 5})
+    task = am.session.get_task("worker", 0)
+    task.container_id = "c1"
+    am.hb_monitor.register("worker:0")
+    am.register_execution_result({
+        "job_name": "worker", "job_index": 0, "session_id": 0,
+        "exit_code": C.EXIT_RENDEZVOUS_TIMEOUT, "task_attempt": 0,
+        "barrier_timeout": True})
+    assert task.completed and task.attempt == 0     # no relaunch
+    assert am.scheduler.replacements == []
+
+
+def test_user_exit_code_10_still_relaunches(tmp_path):
+    """A user process exiting with the same numeric value as
+    EXIT_RENDEZVOUS_TIMEOUT is a genuine fault (no barrier_timeout flag)
+    and must keep its relaunch budget — the no-relaunch decision rides
+    the flag, never the exit code."""
+    am = _make_am(tmp_path, **{"tony.task.max-task-attempts": 5})
+    task = am.session.get_task("worker", 0)
+    task.container_id = "c1"
+    am.register_execution_result({
+        "job_name": "worker", "job_index": 0, "session_id": 0,
+        "exit_code": C.EXIT_RENDEZVOUS_TIMEOUT, "task_attempt": 0})
+    assert not task.completed and task.attempt == 1
+    assert am.scheduler.replacements == ["worker"]
+
+
+def test_relaunch_declined_once_a_tracked_peer_completed(tmp_path):
+    """A completed peer cannot re-enter the barrier — relaunching the
+    failed task would hang its replacement against a dead endpoint, so the
+    failure falls back to the session ladder instead."""
+    conf_kv = {"tony.task.max-task-attempts": 5, "tony.worker.instances": 2}
+    am = _make_am(tmp_path, **conf_kv)
+    done, failed = am.session.get_task("worker", 0), \
+        am.session.get_task("worker", 1)
+    done.set_exit_status(0)
+    failed.container_id = "c2"
+    assert am._maybe_relaunch_task(failed, "crash", observed_attempt=0) \
+        is False
+    assert failed.attempt == 0 and am.scheduler.replacements == []
+
+
+def test_liveliness_register_is_attempt_monotonic():
+    """A stalled registration thread of a superseded attempt must not
+    downgrade the replacement's entry — the downgraded attempt would make
+    the replacement's real expiry look stale and be fenced forever."""
+    mon = LivelinessMonitor(hb_interval_ms=1000, max_missed=3,
+                            on_expired=lambda tid, att: None)
+    mon.register("worker:0", attempt=1)      # the replacement
+    mon.register("worker:0", attempt=0)      # stale thread resumes late
+    assert mon._last_ping["worker:0"][1] == 1
+    mon.register("worker:0", attempt=2)      # a newer attempt upgrades
+    assert mon._last_ping["worker:0"][1] == 2
+
+
+def test_stale_session_failure_is_absorbed_not_relaunched(tmp_path):
+    """A failure observer from a superseded session racing an AM retry
+    must neither relaunch nor complete the NEW session's same-named
+    slot."""
+    am = _make_am(tmp_path, **{"tony.task.max-task-attempts": 5})
+    conf = am.conf
+    old_task = am.session.get_task("worker", 0)
+    old_task.container_id = "c_old"
+    am.session = TonySession(conf, session_id=1)      # AM retried
+    fresh = am.session.get_task("worker", 0)
+    assert am._maybe_relaunch_task(old_task, "stale crash",
+                                   observed_attempt=0) is True  # absorbed
+    assert fresh.attempt == 0 and not fresh.completed
+    assert am.scheduler.replacements == []
+
+
+def test_executor_bounded_rerendezvous_gives_up(monkeypatch):
+    """An executor the AM answers but never accepts (superseded attempt
+    that outlived its container stop) must stop polling after a bounded
+    number of rounds instead of spamming the AM for the application's
+    life — and its report is flagged as a barrier problem."""
+    ex = _make_executor()
+    reported = []
+    regs = {"n": 0}
+
+    def fake_register():
+        regs["n"] += 1
+        if regs["n"] == 1:
+            ex._spec_generation = 1
+            return {"worker": ["localhost:1"]}
+        return None                      # AM keeps rejecting us
+
+    def fake_execute(env, timeout):
+        ex._on_generation(2)             # peer relaunch → respec
+        return -9
+
+    monkeypatch.setattr(ex, "localize_resources", lambda: None)
+    monkeypatch.setattr(ex, "register_and_get_cluster_spec", fake_register)
+    monkeypatch.setattr(ex, "_execute", fake_execute)
+    monkeypatch.setattr(ex, "_report",
+                        lambda rc, barrier_timeout=False:
+                        reported.append((rc, barrier_timeout)))
+    assert ex.run() == C.EXIT_FAILURE
+    assert regs["n"] == 4                # 1 initial + 3 bounded rounds
+    assert reported == [(C.EXIT_FAILURE, True)]
+
+
+def test_untracked_and_completed_tasks_never_relaunch(tmp_path):
+    am = _make_am(tmp_path, **{
+        "tony.task.max-task-attempts": 5,
+        "tony.application.untracked.jobtypes": "worker"})
+    task = am.session.get_task("worker", 0)
+    assert am._maybe_relaunch_task(task, "boom") is False
+    am3 = _make_am(tmp_path, **{"tony.task.max-task-attempts": 5})
+    t3 = am3.session.get_task("worker", 0)
+    t3.set_exit_status(1)
+    assert am3._maybe_relaunch_task(t3, "boom") is False
+
+
+def test_session_relaunch_invalidates_registration_and_bumps_generation():
+    conf = TonyConfiguration()
+    conf.set("tony.worker.instances", 2, "test")
+    session = TonySession(conf)
+    session.num_expected_tasks = 2
+    assert session.spec_generation == 1
+    session.register_worker_spec("worker:0", "h0:1")
+    spec, gen, accepted = session.register_worker_spec_with_generation(
+        "worker:1", "h1:2")
+    assert spec is not None and gen == 1 and accepted
+    session.relaunch_task("worker", 1)
+    assert session.spec_generation == 2
+    assert not session.all_tasks_registered()          # barrier re-opened
+    assert session.get_task("worker", 1).attempt == 1
+    # a superseded attempt's in-flight registration is fenced under the
+    # session lock — it must not re-fill the barrier it was evicted from
+    spec, gen, accepted = session.register_worker_spec_with_generation(
+        "worker:1", "h1:2", expected_attempt=0)
+    assert spec is None and not accepted
+    assert not session.all_tasks_registered()
+    # replacement re-registers under the same id; barrier closes on gen 2
+    spec, gen, accepted = session.register_worker_spec_with_generation(
+        "worker:1", "h2:3", expected_attempt=1)
+    assert spec is not None and gen == 2 and accepted and "h2:3" in spec
+
+
+def test_max_task_attempts_per_jobtype_override():
+    conf = TonyConfiguration()
+    conf.set("tony.worker.instances", 1, "test")
+    conf.set("tony.ps.instances", 1, "test")
+    conf.set(K.TASK_MAX_TASK_ATTEMPTS, 2, "test")
+    conf.set(K.max_task_attempts_key("ps"), 4, "test")
+    session = TonySession(conf)
+    assert session.max_task_attempts("worker") == 2
+    assert session.max_task_attempts("ps") == 4
+    # default (no keys) is 1 = the all-or-nothing reference behavior
+    assert TonySession(TonyConfiguration()).max_task_attempts("worker") == 1
+
+
+def test_liveliness_ping_never_resurrects_unknown_task():
+    mon = LivelinessMonitor(hb_interval_ms=1000, max_missed=3,
+                            on_expired=lambda tid, attempt: None)
+    assert mon.ping("worker:0") is False     # never registered
+    mon.register("worker:0")
+    assert mon.ping("worker:0") is True
+    mon.unregister("worker:0")
+    assert mon.ping("worker:0") is False     # zombie stays dead
+    assert not mon.registered("worker:0")
+
+
+def test_liveliness_expiry_reports_the_silent_attempt():
+    """The expiry callback carries the attempt the entry belonged to, so a
+    stale expiry delivered after a relaunch can be fenced by the AM."""
+    expired = []
+    mon = LivelinessMonitor(hb_interval_ms=10, max_missed=3,
+                            on_expired=lambda tid, att: expired.append(
+                                (tid, att)))
+    mon.register("worker:0", attempt=2)
+    mon.start()
+    try:
+        deadline = time.monotonic() + 5
+        while not expired and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        mon.stop()
+    assert expired == [("worker:0", 2)]
+    assert not mon.registered("worker:0")    # dropped before the callback
+
+
+# ---------------------------------------------------------------------------
+# unit: jittered backoff shapes (rpc client + session retry)
+# ---------------------------------------------------------------------------
+
+def test_rpc_backoff_is_capped_and_seed_deterministic(monkeypatch):
+    monkeypatch.setenv(C.TEST_SEED, "42")
+    mk = lambda: _JsonRpcClient(CLUSTER_SERVICE, CLUSTER_METHODS, "localhost", 1,
+                                retry_sleep_sec=0.5, retry_max_sleep_sec=4.0)
+    a, b = mk(), mk()
+    try:
+        seq_a = [a._backoff_sec(i) for i in range(8)]
+        seq_b = [b._backoff_sec(i) for i in range(8)]
+        # same seed + endpoint → identical jitter (replay-exactly)
+        assert seq_a == seq_b
+        for i, s in enumerate(seq_a):
+            cap = min(4.0, 0.5 * 2 ** i)
+            assert cap / 2 <= s <= cap     # equal-jitter envelope
+    finally:
+        a.close()
+        b.close()
+
+
+def test_rpc_backoff_unseeded_clients_decorrelate(monkeypatch):
+    monkeypatch.delenv(C.TEST_SEED, raising=False)
+    mk = lambda: _JsonRpcClient(CLUSTER_SERVICE, CLUSTER_METHODS, "localhost", 1,
+                                retry_sleep_sec=0.5, retry_max_sleep_sec=4.0)
+    a, b = mk(), mk()
+    try:
+        # 8 independent uniform draws colliding exactly ≈ impossible —
+        # lockstep here is precisely the thundering herd being removed
+        assert [a._backoff_sec(i) for i in range(8)] != \
+               [b._backoff_sec(i) for i in range(8)]
+    finally:
+        a.close()
+        b.close()
+
+
+def test_heartbeat_fast_path_fails_fast_without_backoff():
+    """retries=1 (the heartbeat path) must never enter the backoff sleep —
+    a dead AM is detected in well under a single backoff period."""
+    from tony_tpu.rpc.client import ClusterServiceClient
+    from tony_tpu.utils.common import pick_free_port
+    c = ClusterServiceClient("localhost", pick_free_port())
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            c.task_executor_heartbeat("worker:0")
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        c.close()
+
+
+def test_session_retry_backoff_deterministic_and_capped():
+    f = session_retry_backoff_sec
+    assert f("app1", 1, 1000, 30_000) == f("app1", 1, 1000, 30_000)
+    assert f("app1", 1, 1000, 30_000) != f("app2", 1, 1000, 30_000)
+    # grows exponentially until the cap, inside the equal-jitter envelope
+    for attempt in range(1, 10):
+        cap = min(30.0, 1.0 * 2 ** (attempt - 1))
+        got = f("app1", attempt, 1000, 30_000)
+        assert cap / 2 <= got <= cap
+    assert f("app1", 5, 0, 30_000) == 0.0       # base 0 disables backoff
+    assert f("app1", 0, 1000, 30_000) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# unit: executor re-rendezvous state machine + port-reservation hygiene
+# ---------------------------------------------------------------------------
+
+def _make_executor():
+    return TaskExecutor(env={
+        C.JOB_NAME: "worker", C.TASK_INDEX: "0",
+        C.AM_HOST: "localhost", C.AM_PORT: "1",
+        C.TASK_COMMAND: "true",
+    })
+
+
+def test_executor_releases_port_on_rendezvous_timeout(monkeypatch):
+    """Satellite regression: the gang-rendezvous-timeout exit path must
+    release the SO_REUSEPORT reservation like every other path."""
+    ex = _make_executor()
+    reported = []
+    monkeypatch.setattr(ex, "localize_resources", lambda: None)
+    monkeypatch.setattr(ex, "register_and_get_cluster_spec", lambda: None)
+    monkeypatch.setattr(ex, "_report",
+                        lambda rc, barrier_timeout=False: reported.append(
+                            (rc, barrier_timeout)))
+    assert ex.run() == C.EXIT_RENDEZVOUS_TIMEOUT
+    assert reported == [(C.EXIT_RENDEZVOUS_TIMEOUT, True)]
+    assert ex._port_reservation is None, "reservation leaked on timeout path"
+
+
+def test_executor_respec_loop_restarts_user_process(monkeypatch):
+    """A generation bump between launches sends the executor back to the
+    barrier exactly once; only the final attempt's exit code is reported."""
+    ex = _make_executor()
+    calls = {"reg": 0, "exec": 0, "reported": []}
+
+    def fake_register():
+        calls["reg"] += 1
+        ex._spec_generation = calls["reg"]
+        return {"worker": ["localhost:1"]}
+
+    def fake_execute(env, timeout):
+        calls["exec"] += 1
+        assert env[C.SPEC_GENERATION] == str(ex._spec_generation)
+        if calls["exec"] == 1:
+            ex._on_generation(2)        # peer relaunched mid-run
+            return -9                   # our user proc was killed
+        return 0
+
+    monkeypatch.setattr(ex, "localize_resources", lambda: None)
+    monkeypatch.setattr(ex, "register_and_get_cluster_spec", fake_register)
+    monkeypatch.setattr(ex, "_execute", fake_execute)
+    monkeypatch.setattr(ex, "_report",
+                        lambda rc, barrier_timeout=False:
+                        calls["reported"].append(rc))
+    assert ex.run() == 0
+    assert calls["reg"] == 2 and calls["exec"] == 2
+    assert calls["reported"] == [0]
+    assert ex._port_reservation is None
+
+
+def test_executor_probes_generation_after_collateral_exit(monkeypatch):
+    """A survivor whose collectives die from a peer's crash can exit
+    non-zero BEFORE the next heartbeat delivers the generation bump: the
+    executor probes the AM once and re-rendezvouses instead of reporting a
+    failure that would burn its own attempt budget (and cascade a single
+    fault into gang-wide relaunches)."""
+    ex = _make_executor()
+    calls = {"reg": 0, "exec": 0, "reported": []}
+
+    def fake_register():
+        calls["reg"] += 1
+        ex._spec_generation = calls["reg"]
+        return {"worker": ["localhost:1"]}
+
+    def fake_execute(env, timeout):
+        calls["exec"] += 1
+        return 1 if calls["exec"] == 1 else 0  # collateral crash, then clean
+
+    monkeypatch.setattr(ex, "localize_resources", lambda: None)
+    monkeypatch.setattr(ex, "register_and_get_cluster_spec", fake_register)
+    monkeypatch.setattr(ex, "_execute", fake_execute)
+    monkeypatch.setattr(ex, "_report",
+                        lambda rc, barrier_timeout=False:
+                        calls["reported"].append(rc))
+    monkeypatch.setattr(ex.client, "task_executor_heartbeat",
+                        lambda tid, att=-1: {"spec_generation": 2})
+    assert ex.run() == 0
+    assert calls["reg"] == 2 and calls["exec"] == 2
+    assert calls["reported"] == [0]
+
+
+def test_executor_genuine_failure_is_still_reported(monkeypatch):
+    """With no generation bump at the AM, a non-zero exit is a genuine
+    fault and must be reported as such (the victim's own crash path)."""
+    ex = _make_executor()
+    reported = []
+    monkeypatch.setattr(ex, "localize_resources", lambda: None)
+    monkeypatch.setattr(ex, "register_and_get_cluster_spec",
+                        lambda: (setattr(ex, "_spec_generation", 1)
+                                 or {"worker": ["localhost:1"]}))
+    monkeypatch.setattr(ex, "_execute", lambda env, t: 1)
+    monkeypatch.setattr(ex, "_report",
+                        lambda rc, barrier_timeout=False:
+                        reported.append((rc, barrier_timeout)))
+    monkeypatch.setattr(ex.client, "task_executor_heartbeat",
+                        lambda tid, att=-1: {"spec_generation": 1})
+    assert ex.run() == 1
+    assert reported == [(1, False)]
+
+
+def test_executor_generation_gating():
+    """Bumps are ignored before the first barrier completes (the barrier
+    itself returns the freshest spec), armed exactly once after."""
+    ex = _make_executor()
+    ex._on_generation(5)                      # pre-barrier: no respec
+    assert not ex._respec_pending
+    ex._spec_generation = 5                   # barrier done at gen 5
+    ex._on_generation(5)                      # same generation: no-op
+    assert not ex._respec_pending
+    ex._on_generation(6)                      # peer relaunch
+    assert ex._respec_pending
+    assert ex._take_respec() is True
+    assert ex._take_respec() is False         # consumed

@@ -103,7 +103,9 @@ def test_all_methods_round_trip(cluster):
     assert handler.tb_url == "http://tb:6006"
     c.register_execution_result(0, "worker", 1, session_id=0)
     assert handler.results == [{"exit_code": 0, "job_name": "worker",
-                                "job_index": 1, "session_id": 0}]
+                                "job_index": 1, "session_id": 0,
+                                "task_attempt": -1,
+                                "barrier_timeout": False}]
     c.task_executor_heartbeat("worker:1")
     assert handler.heartbeats == ["worker:1"]
     c.finish_application()

@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import random
 import sys
 import threading
 import time
@@ -40,7 +41,7 @@ from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
     ApplicationFinished, ApplicationInited, Event, EventType, TaskFinished,
-    TaskStarted,
+    TaskRelaunched, TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor
 from tony_tpu.rpc.service import (
@@ -49,10 +50,27 @@ from tony_tpu.rpc.service import (
 from tony_tpu.session.scheduler import ResourceRequestor, TaskScheduler
 from tony_tpu.session.session import FinalStatus, Task, TonySession
 from tony_tpu.session.requests import JobContainerRequest
-from tony_tpu.utils.common import current_host, framework_pythonpath
+from tony_tpu.utils.common import (
+    current_host, equal_jitter_backoff_sec, framework_pythonpath,
+)
 from tony_tpu.utils.shell import execute_shell
 
 LOG = logging.getLogger(__name__)
+
+
+def session_retry_backoff_sec(app_id: str, attempt: int, base_ms: int,
+                              max_ms: int) -> float:
+    """Capped equal-jitter exponential backoff before whole-session retry
+    `attempt` (1-based). Deterministic for a given (app_id, attempt) so a
+    replayed application backs off identically. The reference relaunched
+    immediately (ApplicationMaster.java:311-386); at TPU-pod gang widths an
+    immediate rebuild against a still-broken substrate just burns the
+    retry budget."""
+    if attempt <= 0:
+        return 0.0
+    rng = random.Random(f"{app_id}:session-retry:{attempt}")
+    return equal_jitter_backoff_sec(base_ms / 1000.0, max_ms / 1000.0,
+                                    attempt - 1, rng)
 
 
 class MetricsStore(MetricsServiceHandler):
@@ -168,6 +186,12 @@ class ApplicationMaster(ClusterServiceHandler):
         self._launched: dict[str, tuple[Task, int]] = {}
         self._finished_containers: set[str] = set()
         self._session_containers: dict[int, list[str]] = {}
+        # task-attempt fault tolerance: cumulative tracked-task failures
+        # across attempts AND sessions (feeds the
+        # tony.application.max-total-task-failures circuit breaker)
+        self._total_task_failures = 0
+        self._alloc_timeout_ms = conf.get_time_ms(
+            K.CONTAINER_ALLOCATION_TIMEOUT, 15 * 60 * 1000)
         self._lock = threading.RLock()
         self._tb_url = ""
         self._wake = threading.Event()   # kick the monitor loop early
@@ -343,8 +367,19 @@ class ApplicationMaster(ClusterServiceHandler):
                     # the identical node pool — don't burn the retries
                     break
                 attempt += 1
-                LOG.warning("session failed; AM retry %d/%d", attempt, max_retries)
+                backoff = session_retry_backoff_sec(
+                    self.app_id, attempt,
+                    self.conf.get_time_ms(K.AM_RETRY_BACKOFF_BASE_MS, 1000),
+                    self.conf.get_time_ms(K.AM_RETRY_BACKOFF_MAX_MS, 30_000))
+                LOG.warning("session failed; AM retry %d/%d after %.0f ms "
+                            "backoff", attempt, max_retries, backoff * 1000)
                 self._reset()
+                if backoff > 0:
+                    # interruptible: a client kill during backoff must not
+                    # be held hostage by the wait
+                    self._client_signal_stop.wait(backoff)
+                    if self._client_signal_stop.is_set():
+                        break
             self._finish(succeeded)
         finally:
             self._teardown()
@@ -439,11 +474,10 @@ class ApplicationMaster(ClusterServiceHandler):
             return False
         # registration timeout clock starts at scheduling time (reference:
         # tony.container.allocation.timeout, ApplicationMaster.java:790-791)
-        alloc_timeout_ms = self.conf.get_time_ms(K.CONTAINER_ALLOCATION_TIMEOUT,
-                                                 15 * 60 * 1000)
+        # and is re-armed whenever a task relaunch re-opens the barrier
         self._registration_deadline = (
-            time.monotonic() + alloc_timeout_ms / 1000.0
-            if alloc_timeout_ms > 0 else None)
+            time.monotonic() + self._alloc_timeout_ms / 1000.0
+            if self._alloc_timeout_ms > 0 else None)
         return self._monitor()
 
     def _monitor(self) -> bool:
@@ -492,9 +526,15 @@ class ApplicationMaster(ClusterServiceHandler):
                     FinalStatus.FAILED,
                     "Tasks failed to register within the allocation timeout.")
                 break
-            if session.all_tasks_registered():
-                # all gang members arrived: stop the registration clock
-                self._registration_deadline = None
+            with self._lock:
+                # clear-and-check atomically against the relaunch path,
+                # which re-arms the deadline under the same lock while
+                # popping the dead task's registration — an unlocked clear
+                # here could wipe that re-arm and let a replacement that
+                # never registers hang the session forever
+                if session.all_tasks_registered():
+                    # all gang members arrived: stop the registration clock
+                    self._registration_deadline = None
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
                 LOG.info("all %d tracked tasks completed", total)
@@ -699,8 +739,13 @@ class ApplicationMaster(ClusterServiceHandler):
         req = session.requests[task.job_name]
         env = self._container_env(task, req, container)
         cmd = [sys.executable, "-m", "tony_tpu.executor"]
-        cwd = os.path.join(self.app_dir, C.CONTAINERS_DIR_NAME,
-                           f"{task.job_name}_{task.index}_s{task.session_id}")
+        # a relaunched attempt gets its own log dir: the crashed attempt's
+        # stdout/stderr are the evidence being debugged, and a slow
+        # stop_container could leave the old process writing concurrently
+        cdir = f"{task.job_name}_{task.index}_s{task.session_id}"
+        if task.attempt > 0:
+            cdir += f"_a{task.attempt}"
+        cwd = os.path.join(self.app_dir, C.CONTAINERS_DIR_NAME, cdir)
         task.url = os.path.join(cwd, "stdout")
         self.backend.launch_container(container, cmd, env, cwd)
         # NOT hb-registered yet: liveliness starts at registerWorkerSpec
@@ -729,6 +774,7 @@ class ApplicationMaster(ClusterServiceHandler):
             C.CONTAINER_ID: container.container_id,
             C.APP_ID: self.app_id,
             C.ATTEMPT_NUMBER: str(self._session_id),
+            C.TASK_ATTEMPT: str(task.attempt),
             C.NUM_AM_RETRIES: str(self.conf.get_int(K.AM_RETRY_COUNT, 0)),
             C.TONY_APP_DIR: self.app_dir,
             # off-host containers with a configured staging store get a
@@ -788,6 +834,31 @@ class ApplicationMaster(ClusterServiceHandler):
                 LOG.info("ignoring completion from stale session %d (now %d)",
                          launch_session, session.session_id)
                 return
+            if task.container_id != container_id:
+                # the slot was relaunched and this completion belongs to the
+                # superseded attempt's container (the AM killed it, or the
+                # crash that triggered the relaunch is only now reported) —
+                # it must not complete/fail the replacement attempt, and the
+                # replacement's liveliness entry must stay registered
+                LOG.info("ignoring completion of superseded container %s for "
+                         "%s (attempt now %d)", container_id, task.task_id,
+                         task.attempt)
+                return
+            # the attempt this completion belongs to, captured while the
+            # container ownership check above still holds
+            observed_attempt = task.attempt
+        # within budget, a tracked task's crash replaces only that container
+        # instead of failing the session (the reference's all-or-nothing
+        # short-circuit, TonySession.java:251-271, becomes the fallback).
+        # (Rendezvous timeouts are fenced at register_execution_result via
+        # the barrier_timeout flag; an executor that died before reporting
+        # is indistinguishable from a crash here, which is the safe side.)
+        if (exit_code not in (0, C.EXIT_KILLED_BY_AM)
+                and session.is_tracked(task.job_name)
+                and self._maybe_relaunch_task(
+                    task, f"container exited with code {exit_code}",
+                    observed_attempt=observed_attempt)):
+            return
         # a task that crashed without registering its result must not linger
         # in the liveliness monitor and expire later
         self.hb_monitor.unregister(task.task_id)
@@ -806,10 +877,16 @@ class ApplicationMaster(ClusterServiceHandler):
             self._untracked_task_failed = True
         self._wake.set()
 
-    def _on_task_deemed_dead(self, task_id: str) -> None:
-        """(ApplicationMaster.onTaskDeemedDead, ApplicationMaster.java:1158-1165)."""
+    def _on_task_deemed_dead(self, task_id: str, attempt: int = -1) -> None:
+        """(ApplicationMaster.onTaskDeemedDead, ApplicationMaster.java:1158-1165
+        — but expiry now routes through the relaunch budget first; only an
+        exhausted budget ends the application). `attempt` is the attempt the
+        expired liveliness entry belonged to — an expiry delivered after
+        that attempt was already relaunched past must not judge the healthy
+        replacement by its predecessor's silence."""
         session = self.session
-        if session is None or session.get_task_by_id(task_id) is None:
+        task = session.get_task_by_id(task_id) if session is not None else None
+        if task is None:
             # orphaned liveliness entry: a stale executor's registration
             # raced _reset()'s clear() — the task isn't in the current
             # session, so its silence must not fail the new session
@@ -817,12 +894,138 @@ class ApplicationMaster(ClusterServiceHandler):
                         task_id)
             self.hb_monitor.unregister(task_id)
             return
+        if attempt >= 0 and task.attempt != attempt:
+            # stale expiry: the silent attempt was already relaunched past
+            LOG.info("ignoring expiry of %s attempt %d (slot now at "
+                     "attempt %d)", task_id, attempt, task.attempt)
+            return
+        if task.completed:
+            # result already registered; the expired entry was a leftover
+            return
+        if not task.container_id:
+            # the slot is between attempts (a relaunch is in flight): this
+            # expiry belongs to the superseded attempt's liveliness entry
+            # that raced the unregister — the replacement re-registers with
+            # a fresh clock, so its silence must not be judged yet
+            LOG.info("ignoring expiry for %s: slot awaiting its "
+                     "replacement container", task_id)
+            return
+        if self._maybe_relaunch_task(
+                task, f"missed {self._max_missed_hb} heartbeats",
+                observed_attempt=(attempt if attempt >= 0
+                                  else task.attempt)):
+            return
         msg = (f"Task with id [{task_id}] has missed "
                f"[{self._max_missed_hb}] heartbeats. Ending application!")
         LOG.error(msg)
         self._task_missed_hb = True
         session.set_final_status(FinalStatus.FAILED, msg)
         self._wake.set()
+
+    def _maybe_relaunch_task(self, task: Task, reason: str,
+                             observed_attempt: int = -1) -> bool:
+        """The relaunch decision path: on a tracked task's crash or
+        heartbeat expiry, stop only that container, recycle the slot
+        (bumping the cluster-spec generation so survivors re-rendezvous
+        while keeping their containers and localized resources), and
+        re-request ONE replacement through the scheduler — if and only if
+        the per-jobtype attempt budget and the app-wide failure circuit
+        breaker both allow it. Returns True when the failure was absorbed
+        by a relaunch (or is stale — see observed_attempt); False means
+        the caller proceeds with today's fail-the-session path.
+
+        `observed_attempt` is the attempt number the caller saw failing.
+        One crash has up to three observers (executor-reported result,
+        container-completion callback, heartbeat expiry) and none of them
+        holds the AM lock when calling here — the first to win the lock
+        relaunches, bumping task.attempt; the fence turns every later
+        observer of the SAME failure into a no-op instead of letting it
+        burn a second budget slot or fail the in-flight replacement."""
+        with self._lock:
+            session = self.session
+            if (session is None or session.training_finished
+                    or session.final_status != FinalStatus.UNDEFINED
+                    or self._client_signal_stop.is_set()):
+                return False
+            if task.session_id != session.session_id:
+                # a stale-session observer racing an AM session retry: the
+                # old Task object must not resolve by name/index onto the
+                # NEW session's healthy same-named slot and burn its
+                # budget. Absorbed (True), not declined: the caller's
+                # fail path would complete the new slot with a dead
+                # session's exit code
+                LOG.info("ignoring failure of %s from superseded session "
+                         "%d (now %d)", task.task_id, task.session_id,
+                         session.session_id)
+                return True
+            if observed_attempt >= 0 and task.attempt != observed_attempt:
+                # another observer already relaunched past the attempt this
+                # failure belongs to — absorb it (the caller must neither
+                # fail the session nor complete the replacement's slot).
+                # This fence runs FIRST: any later gate returning False
+                # would hand the stale failure to the fail-the-session path
+                LOG.info("ignoring stale failure of %s attempt %d (%s): "
+                         "already relaunched to attempt %d", task.task_id,
+                         observed_attempt, reason, task.attempt)
+                return True
+            if not session.is_tracked(task.job_name) or task.completed:
+                return False
+            if session.num_completed_tracked_tasks() > 0:
+                # a completed peer cannot re-enter the barrier, so the
+                # replacement would rendezvous against its dead endpoint
+                # and hang — once any tracked task has finished, failures
+                # fall back to the session-level recovery ladder
+                LOG.warning("not relaunching %s (%s): %d tracked peer(s) "
+                            "already completed and cannot re-join the gang",
+                            task.task_id, reason,
+                            session.num_completed_tracked_tasks())
+                return False
+            self._total_task_failures += 1
+            max_attempts = session.max_task_attempts(task.job_name)
+            if task.attempt + 1 >= max_attempts:
+                if max_attempts > 1:
+                    LOG.error("task %s failed (%s) with its attempt budget "
+                              "exhausted (%d/%d)", task.task_id, reason,
+                              task.attempt + 1, max_attempts)
+                return False
+            max_total = self.conf.get_int(
+                K.APPLICATION_MAX_TOTAL_TASK_FAILURES, -1)
+            if 0 <= max_total < self._total_task_failures:
+                LOG.error("task %s failed (%s) but the application already "
+                          "saw %d task failures (circuit breaker: %d) — not "
+                          "relaunching", task.task_id, reason,
+                          self._total_task_failures, max_total)
+                return False
+            old_cid = task.container_id
+            if session.relaunch_task(task.job_name, task.index) is None:
+                return False
+            # the dead attempt must not linger in liveliness or wedge
+            # detection; the replacement re-registers under the same id
+            self.hb_monitor.unregister(task.task_id)
+            self.metrics_store.clear_utilization_state(task.job_name,
+                                                       task.index)
+            # re-arm the barrier clock: a replacement that never registers
+            # must still time the session out instead of hanging forever
+            if self._alloc_timeout_ms > 0:
+                self._registration_deadline = (
+                    time.monotonic() + self._alloc_timeout_ms / 1000.0)
+            new_attempt = task.attempt
+            new_generation = session.spec_generation
+            LOG.warning("relaunching task %s (%s): attempt %d/%d, spec "
+                        "generation %d, stopping container %s",
+                        task.task_id, reason, new_attempt + 1, max_attempts,
+                        new_generation, old_cid or "<none>")
+        # outside the AM lock: container stop + event emit don't need it,
+        # and stop_container may block on process teardown
+        if old_cid:
+            self.backend.stop_container(old_cid)
+        self.event_handler.emit(Event(
+            EventType.TASK_RELAUNCHED,
+            TaskRelaunched(task.job_name, task.index, new_attempt,
+                           new_generation, reason)))
+        self.scheduler.schedule_replacement(task.job_name)
+        self._wake.set()
+        return True
 
     # ------------------------------------------------------------------
     # ClusterServiceHandler: the 7-RPC control plane
@@ -851,31 +1054,51 @@ class ApplicationMaster(ClusterServiceHandler):
     def get_cluster_spec(self, req: dict) -> dict:
         if self.session is None:
             return {"spec": None}
-        return {"spec": self.session.cluster_spec_json()}
+        return {"spec": self.session.cluster_spec_json(),
+                "generation": self.session.spec_generation}
 
     def register_worker_spec(self, req: dict) -> dict:
         session = self.session
         if session is None:
             return {"spec": None}
+        sid = int(req.get("session_id", -1))
+        task = session.get_task_by_id(req["task_id"])
+        attempt = int(req.get("task_attempt", -1))
+        if task is not None and attempt >= 0 and attempt != task.attempt:
+            # fast path: a superseded attempt's executor (zombie the AM
+            # already relaunched past) re-registering must not overwrite
+            # the replacement's host:port or plant a liveliness entry — it
+            # gets an open barrier forever and eventually times itself out.
+            # (The session-locked expected_attempt fence below is the
+            # authoritative check; this just skips the work.)
+            LOG.warning("ignoring registration from superseded attempt %d "
+                        "of %s (current attempt %d)", attempt,
+                        req["task_id"], task.attempt)
+            return {"spec": None, "generation": session.spec_generation}
+        spec, generation, accepted = \
+            session.register_worker_spec_with_generation(
+                req["task_id"], req["spec"], expected_attempt=attempt)
         # liveliness begins HERE, like the reference (ApplicationMaster
         # .java:851): the executor is demonstrably alive and its
-        # heartbeater starts right after this call returns. Gate on the
-        # executor's SESSION id (task ids repeat across AM retries): a
-        # stale previous-session registration racing _reset must not
-        # plant a liveliness record attributed to the new session's
-        # same-named task (register_execution_result has the same gate).
-        sid = int(req.get("session_id", -1))
-        if (sid in (session.session_id, -1)
-                and session.get_task_by_id(req["task_id"]) is not None):
-            self.hb_monitor.register(req["task_id"])
-        spec = session.register_worker_spec(req["task_id"], req["spec"])
+        # heartbeater starts right after this call returns. Gated on the
+        # session-locked acceptance (planting it before the fence could
+        # resurrect an entry a concurrent relaunch just unregistered) and
+        # on the executor's SESSION id (task ids repeat across AM
+        # retries): a stale previous-session registration racing _reset
+        # must not plant a liveliness record attributed to the new
+        # session's same-named task (register_execution_result has the
+        # same gate). The entry carries the attempt the acceptance was
+        # based on, so a stale expiry can be fenced later.
+        if accepted and sid in (session.session_id, -1) and task is not None:
+            self.hb_monitor.register(
+                req["task_id"], attempt if attempt >= 0 else task.attempt)
         # TEST hook: simulate chief-worker termination once the chief shows up
         # (reference: killChiefWorkerIfTesting, ApplicationMaster.java:1204-1215)
         if (os.environ.get(C.TEST_WORKER_TERMINATION)
                 and req["task_id"] == f"{C.WORKER_JOB_NAME}:0"):
             threading.Thread(target=self._kill_workers_for_test,
                              daemon=True).start()
-        return {"spec": spec}
+        return {"spec": spec, "generation": generation}
 
     def _kill_workers_for_test(self) -> None:
         time.sleep(0.5)
@@ -894,16 +1117,41 @@ class ApplicationMaster(ClusterServiceHandler):
 
     def register_execution_result(self, req: dict) -> dict:
         """Executor-reported exit code. Unregisters the task from the HB
-        monitor FIRST so a delayed container-completion callback can't
-        race a clean exit into a missed-heartbeat failure
+        monitor early — AFTER the session-id gate, so a stale
+        previous-session executor reporting a same-named task cannot strip
+        the current session's task from liveliness monitoring — but before
+        completion handling, so a delayed container-completion callback
+        can't race a clean exit into a missed-heartbeat failure
         (reference rationale: ApplicationMaster.java:890-918)."""
         task_id = f"{req['job_name']}:{req['job_index']}"
-        self.hb_monitor.unregister(task_id)
         session = self.session
         if session is None or int(req.get("session_id", -1)) != session.session_id:
             return {}
+        task = session.get_task_by_id(task_id)
+        attempt = int(req.get("task_attempt", -1))
+        if task is not None and attempt >= 0 and attempt != task.attempt:
+            # superseded attempt reporting after its slot was relaunched:
+            # its result must not complete (or fail) the replacement
+            LOG.info("ignoring execution result from superseded attempt %d "
+                     "of %s (current attempt %d)", attempt, task_id,
+                     task.attempt)
+            return {}
+        exit_code = int(req["exit_code"])
+        # barrier_timeout marks a rendezvous timeout — an allocation
+        # problem, not a task fault: replacing healthy containers cannot
+        # conjure the missing allocation, so no relaunch budget is spent.
+        # (An explicit flag, not an exit code: every 0-255 value is
+        # reachable by the user process itself.)
+        if (task is not None and not req.get("barrier_timeout")
+                and exit_code not in (0, C.EXIT_KILLED_BY_AM)
+                and self._maybe_relaunch_task(
+                    task, f"executor reported exit {exit_code}",
+                    observed_attempt=(attempt if attempt >= 0
+                                      else task.attempt))):
+            return {}
+        self.hb_monitor.unregister(task_id)
         session.on_task_completed(req["job_name"], int(req["job_index"]),
-                                  int(req["exit_code"]))
+                                  exit_code)
         self._wake.set()
         return {}
 
@@ -927,8 +1175,23 @@ class ApplicationMaster(ClusterServiceHandler):
         self._wake.set()
 
     def task_executor_heartbeat(self, req: dict) -> dict:
-        self.hb_monitor.ping(req["task_id"])
-        return {}
+        session = self.session
+        generation = session.spec_generation if session is not None else 0
+        attempt = int(req.get("task_attempt", -1))
+        if session is not None and attempt >= 0:
+            task = session.get_task_by_id(req["task_id"])
+            if task is not None and attempt != task.attempt:
+                # zombie ping from a relaunched-past attempt: must not keep
+                # the replacement's liveliness entry fresh
+                return {"spec_generation": generation}
+        if not self.hb_monitor.ping(req["task_id"]):
+            # an alive executor with no liveliness entry: it either has not
+            # registered yet (entries are planted at register_worker_spec)
+            # or its entry already expired and the relaunch verdict is in
+            # flight — either way the ping must not resurrect it
+            LOG.debug("heartbeat from %s has no liveliness entry",
+                      req["task_id"])
+        return {"spec_generation": generation}
 
 
 class _Requestor(ResourceRequestor):

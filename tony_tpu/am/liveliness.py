@@ -5,6 +5,14 @@ Equivalent of the reference's use of YARN's AbstractLivelinessMonitor
 monitor thread sweeps registered tasks and fires an expiry callback for any
 task whose last ping is older than `hb_interval * max(3, max_missed)` —
 the reference's exact expiry formula (ApplicationMaster.java:197-204).
+
+Unlike the reference — where onTaskDeemedDead ended the application — the
+expiry callback now feeds the AM's task-relaunch decision first
+(ApplicationMaster._on_task_deemed_dead → _maybe_relaunch_task): within the
+attempt budget the dead task's container is replaced and the gang
+re-rendezvouses; only an exhausted budget escalates to session failure. The
+expired entry is dropped before the callback fires, so the replacement
+attempt re-registers under the same task id with a clean slate.
 """
 
 from __future__ import annotations
@@ -19,13 +27,17 @@ LOG = logging.getLogger(__name__)
 
 class LivelinessMonitor:
     def __init__(self, hb_interval_ms: int, max_missed: int,
-                 on_expired: Callable[[str], None]):
+                 on_expired: Callable[[str, int], None]):
         self._expiry_sec = hb_interval_ms * max(3, max_missed) / 1000.0
         # sweep frequently relative to the expiry window so detection latency
         # stays a fraction of the window even with test-scale intervals
         self._sweep_sec = max(0.05, min(1.0, self._expiry_sec / 10))
         self._on_expired = on_expired
-        self._last_ping: dict[str, float] = {}
+        # task_id -> (last ping, attempt the entry belongs to): the expiry
+        # callback reports WHICH attempt went silent, so a stale expiry
+        # racing a relaunch can be fenced instead of judging the healthy
+        # replacement by the dead attempt's silence
+        self._last_ping: dict[str, tuple[float, int]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="hb-monitor",
@@ -39,9 +51,20 @@ class LivelinessMonitor:
         if self._thread.is_alive():
             self._thread.join(timeout=2)
 
-    def register(self, task_id: str) -> None:
+    def register(self, task_id: str, attempt: int = 0) -> None:
+        """Plant (or refresh) a task's liveliness entry. Attempt-monotonic:
+        a stalled registration thread of a superseded attempt re-planting
+        after the replacement registered must not downgrade the entry's
+        attempt — a downgraded attempt would make the replacement's real
+        expiry look stale and be fenced off forever."""
         with self._lock:
-            self._last_ping[task_id] = time.monotonic()
+            entry = self._last_ping.get(task_id)
+            if entry is not None and entry[1] > attempt:
+                LOG.warning("ignoring stale registration of %s attempt %d "
+                            "(entry is at attempt %d)", task_id, attempt,
+                            entry[1])
+                return
+            self._last_ping[task_id] = (time.monotonic(), attempt)
 
     def unregister(self, task_id: str) -> None:
         """Must be called when an executor registers its result, BEFORE the
@@ -51,10 +74,20 @@ class LivelinessMonitor:
         with self._lock:
             self._last_ping.pop(task_id, None)
 
-    def ping(self, task_id: str) -> None:
+    def ping(self, task_id: str) -> bool:
+        """Refresh a registered task's liveness; returns False for unknown
+        ids (never resurrects an expired/unregistered entry — a zombie
+        attempt pinging after its slot was relaunched must stay dead)."""
         with self._lock:
-            if task_id in self._last_ping:
-                self._last_ping[task_id] = time.monotonic()
+            entry = self._last_ping.get(task_id)
+            if entry is not None:
+                self._last_ping[task_id] = (time.monotonic(), entry[1])
+                return True
+            return False
+
+    def registered(self, task_id: str) -> bool:
+        with self._lock:
+            return task_id in self._last_ping
 
     def clear(self) -> None:
         with self._lock:
@@ -64,14 +97,15 @@ class LivelinessMonitor:
         while not self._stop.wait(self._sweep_sec):
             now = time.monotonic()
             with self._lock:
-                expired = [tid for tid, last in self._last_ping.items()
+                expired = [(tid, attempt)
+                           for tid, (last, attempt) in self._last_ping.items()
                            if now - last > self._expiry_sec]
-                for tid in expired:
+                for tid, _ in expired:
                     del self._last_ping[tid]
-            for tid in expired:
-                LOG.error("task %s missed heartbeats for %.1fs — expired",
-                          tid, self._expiry_sec)
+            for tid, attempt in expired:
+                LOG.error("task %s (attempt %d) missed heartbeats for %.1fs "
+                          "— expired", tid, attempt, self._expiry_sec)
                 try:
-                    self._on_expired(tid)
+                    self._on_expired(tid, attempt)
                 except Exception:  # noqa: BLE001
                     LOG.exception("expiry callback failed for %s", tid)

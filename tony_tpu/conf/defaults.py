@@ -54,6 +54,8 @@ DEFAULTS = {
 
     # am (reference defaults: tony-default.xml am section)
     K.AM_RETRY_COUNT: 0,
+    K.AM_RETRY_BACKOFF_BASE_MS: 1000,
+    K.AM_RETRY_BACKOFF_MAX_MS: 30_000,
     K.AM_MEMORY: "2g",
     K.AM_VCORES: 1,
     K.AM_GANG_MAX_WAIT_MS: 0,
@@ -67,6 +69,10 @@ DEFAULTS = {
     # task cadences (reference: TonyConfigurationKeys.java:143-150)
     K.TASK_HEARTBEAT_INTERVAL_MS: 1000,
     K.TASK_MAX_MISSED_HEARTBEATS: 25,
+    # fault tolerance: 1 attempt = the reference's all-or-nothing behavior;
+    # raise to enable single-task relaunch without full-gang teardown
+    K.TASK_MAX_TASK_ATTEMPTS: 1,
+    K.APPLICATION_MAX_TOTAL_TASK_FAILURES: -1,
     K.TASK_METRICS_INTERVAL_MS: 5000,
     K.TASK_LOW_UTIL_INTERVALS: 24,
     # GPU sampling for `gpus` jobtypes (reference defaults: enabled, bare

@@ -31,6 +31,10 @@ APPLICATION_YARN_CONF_LOCATION = "tony.application.yarn-conf-path"
 
 # --- am ------------------------------------------------------------------
 AM_RETRY_COUNT = "tony.am.retry-count"
+# capped jittered exponential backoff between whole-session retries
+# (attempt N waits in [cap/2, cap], cap = min(max, base * 2^(N-1)); 0 = none)
+AM_RETRY_BACKOFF_BASE_MS = "tony.am.retry-backoff-base-ms"
+AM_RETRY_BACKOFF_MAX_MS = "tony.am.retry-backoff-max-ms"
 AM_MEMORY = "tony.am.memory"
 AM_VCORES = "tony.am.vcores"
 AM_GANG_MAX_WAIT_MS = "tony.am.gang-allocation-timeout-ms"
@@ -40,6 +44,15 @@ AM_STOP_POLL_TIMEOUT_MS = "tony.am.stop-poll-timeout-ms"
 # --- task / containers ---------------------------------------------------
 TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
 TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
+# task-attempt budget: total attempts (first run + relaunches) a tracked
+# task slot gets before its failure fails the session; 1 = no relaunch
+# (today's all-or-nothing behavior). Per-jobtype override:
+# tony.<jobtype>.max-task-attempts.
+TASK_MAX_TASK_ATTEMPTS = "tony.task.max-task-attempts"
+# app-wide circuit breaker: once MORE than this many tracked-task failures
+# have occurred (across all attempts and sessions), stop relaunching tasks
+# and fail the session instead; -1 = unlimited
+APPLICATION_MAX_TOTAL_TASK_FAILURES = "tony.application.max-total-task-failures"
 TASK_METRICS_INTERVAL_MS = "tony.task.metrics-interval-ms"
 # consecutive ~0%-duty metric updates before a heartbeating task is
 # flagged as wedged (AM MetricsStore; 24 x 5s default = 2 min)
@@ -195,6 +208,11 @@ def resources_key(jobtype: str) -> str:
 
 def depends_on_key(jobtype: str) -> str:
     return jobtype_key(jobtype, "depends-on")
+
+
+def max_task_attempts_key(jobtype: str) -> str:
+    """Per-jobtype override of tony.task.max-task-attempts."""
+    return jobtype_key(jobtype, "max-task-attempts")
 
 
 def node_label_key(jobtype: str) -> str:

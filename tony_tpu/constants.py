@@ -21,6 +21,11 @@ CONTAINER_ID = "CONTAINER_ID"
 APP_ID = "APP_ID"
 ATTEMPT_NUMBER = "ATTEMPT_NUMBER"    # reference: ApplicationMaster.java:369
 NUM_AM_RETRIES = "NUM_AM_RETRIES"    # reference: Constants.java:113-114
+TASK_ATTEMPT = "TASK_ATTEMPT"        # per-task attempt number (bumped on
+                                     # single-task relaunch, not AM retry)
+SPEC_GENERATION = "SPEC_GENERATION"  # cluster-spec generation the user
+                                     # process was launched against (bumped
+                                     # on every task relaunch)
 TASK_COMMAND = "TASK_COMMAND"        # the user command this executor runs
 MODEL_PARAMS = "MODEL_PARAMS"        # preprocess-scraped params injected into
                                      # every task env (Constants.java:84,
@@ -124,6 +129,20 @@ TEST_WORKER_TERMINATION = "TEST_WORKER_TERMINATION"
 TEST_TASK_COMPLETION_NOTIFICATION_DELAYED = "TEST_TASK_COMPLETION_NOTIFICATION_DELAYED"
 TEST_TASK_EXECUTOR_NUM_HB_MISS = "TEST_TASK_EXECUTOR_NUM_HB_MISS"
 TEST_TASK_EXECUTOR_SKEW = "TEST_TASK_EXECUTOR_SKEW"  # format: "type#index#sleep_ms"
+# chaos-harness kill/delay injection points (tests/chaos.py drives these):
+# hard-crash one specific task attempt's executor mid-run — the container
+# exits non-zero WITHOUT registering a result, exercising the
+# container-completion relaunch path. Format: "type#index#after_ms#attempt"
+# with after_ms measured from the user process's launch (not executor
+# boot), so the gang is guaranteed past the barrier when the kill fires.
+TEST_TASK_KILL = "TEST_TASK_KILL"
+# silently drop every heartbeat of one specific task attempt while its user
+# process keeps running — exercises the heartbeat-expiry relaunch path.
+# Format: "type#index#attempt".
+TEST_TASK_HB_SILENCE = "TEST_TASK_HB_SILENCE"
+# seed for jittered backoff/injection randomness so chaos failures replay
+# exactly (propagates into AM + executor child processes)
+TEST_SEED = "TONY_TEST_SEED"
 
 # Executor self-destructs after this many consecutive failed heartbeats
 # (reference: TaskExecutor.java:36 MAX_CONSECUTIVE_FAILED_HEARTBEATS)
@@ -133,6 +152,11 @@ MAX_CONSECUTIVE_FAILED_HEARTBEATS = 5
 EXIT_SUCCESS = 0
 EXIT_FAILURE = 1
 EXIT_HEARTBEAT_FAILURE = 9  # executor killed itself after missed heartbeats
+# executor gave up waiting at the gang-rendezvous barrier. Observability
+# only: the AM's no-relaunch decision rides the barrier_timeout flag on
+# register_execution_result, NOT this value — every 0-255 exit code is
+# also reachable by the user process, so the code alone proves nothing
+EXIT_RENDEZVOUS_TIMEOUT = 10
 # Exit code reported when the AM itself stops a container; matches YARN's
 # ContainerExitStatus.KILLED_BY_APPMASTER used by the reference
 # (TonySession.java:485-488). Single source of truth for all modules.

@@ -21,6 +21,7 @@ class EventType(str, enum.Enum):
     APPLICATION_FINISHED = "APPLICATION_FINISHED"
     TASK_STARTED = "TASK_STARTED"
     TASK_FINISHED = "TASK_FINISHED"
+    TASK_RELAUNCHED = "TASK_RELAUNCHED"
 
 
 @dataclass
@@ -51,6 +52,19 @@ class TaskFinished:
 
 
 @dataclass
+class TaskRelaunched:
+    """No reference equivalent (the reference's fault model was
+    all-or-nothing): records a single-task relaunch — the end of attempt
+    `attempt - 1` and the request for a replacement container at cluster-spec
+    `generation` — so history shows every attempt of every task slot."""
+    task_type: str
+    task_index: int
+    attempt: int        # the NEW attempt number the replacement runs as
+    generation: int     # cluster-spec generation after invalidation
+    reason: str = ""
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -64,9 +78,11 @@ _PAYLOADS = {
     EventType.APPLICATION_FINISHED: ApplicationFinished,
     EventType.TASK_STARTED: TaskStarted,
     EventType.TASK_FINISHED: TaskFinished,
+    EventType.TASK_RELAUNCHED: TaskRelaunched,
 }
 
-Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted, TaskFinished]
+Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
+                TaskFinished, TaskRelaunched]
 
 
 @dataclass

@@ -19,7 +19,10 @@ Equivalent of the reference's TaskExecutor.java:135-393:
 
 Fault-injection hooks TEST_TASK_EXECUTOR_NUM_HB_MISS and
 TEST_TASK_EXECUTOR_SKEW are compiled in like the reference
-(TaskExecutor.java:334-344,372-392).
+(TaskExecutor.java:334-344,372-392); TEST_TASK_KILL (mid-run hard crash,
+no result registered) and TEST_TASK_HB_SILENCE (permanently silent
+heartbeater while the user process runs) are the chaos harness's
+task-relaunch injection points (tests/chaos.py).
 """
 
 from __future__ import annotations
@@ -47,20 +50,31 @@ LOG = logging.getLogger(__name__)
 
 
 class Heartbeater(threading.Thread):
-    """(reference: TaskExecutor.Heartbeater, TaskExecutor.java:330-370)."""
+    """(reference: TaskExecutor.Heartbeater, TaskExecutor.java:330-370).
+
+    Besides liveness, each heartbeat response carries the AM's current
+    cluster-spec generation; `on_generation` lets the executor detect a
+    peer's relaunch (generation bump) and re-enter the rendezvous barrier
+    without its container being restarted."""
 
     def __init__(self, client: ClusterServiceClient, task_id: str,
-                 interval_sec: float, on_fatal=None):
+                 interval_sec: float, on_fatal=None, task_attempt: int = -1,
+                 on_generation=None, silent: bool = False):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
+        self._task_attempt = task_attempt
         self._interval = interval_sec
         self._on_fatal = on_fatal  # kill the user process before we die
+        self._on_generation = on_generation
         self._stop = threading.Event()
         # TEST hook: skip the first N heartbeats to simulate missed HBs
         # (TaskExecutor.java:334-344)
         self._skip_remaining = int(
             os.environ.get(C.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
+        # TEST hook: permanently silent heartbeater (chaos harness wedge —
+        # the user process keeps running while the AM sees only silence)
+        self._silent = silent
         self._consecutive_failures = 0
 
     def stop(self) -> None:
@@ -68,14 +82,20 @@ class Heartbeater(threading.Thread):
 
     def run(self) -> None:
         while not self._stop.wait(self._interval):
+            if self._silent:
+                continue
             if self._skip_remaining > 0:
                 self._skip_remaining -= 1
                 LOG.warning("TEST hook: skipping heartbeat (%d more)",
                             self._skip_remaining)
                 continue
             try:
-                self._client.task_executor_heartbeat(self._task_id)
+                resp = self._client.task_executor_heartbeat(
+                    self._task_id, self._task_attempt)
                 self._consecutive_failures = 0
+                generation = (resp or {}).get("spec_generation")
+                if generation and self._on_generation is not None:
+                    self._on_generation(int(generation))
             except Exception:  # noqa: BLE001
                 self._consecutive_failures += 1
                 LOG.warning("heartbeat failed (%d consecutive)",
@@ -104,6 +124,7 @@ class TaskExecutor:
         self.task_num = int(e.get(C.TASK_NUM, "1"))
         self.is_chief = e.get(C.IS_CHIEF, "false").lower() == "true"
         self.session_id = int(e.get(C.SESSION_ID, "0"))
+        self.task_attempt = int(e.get(C.TASK_ATTEMPT, "0"))
         self.am_host = e[C.AM_HOST]
         self.am_port = int(e[C.AM_PORT])
         self.metrics_port = int(e.get(C.METRICS_RPC_PORT, self.am_port))
@@ -151,6 +172,15 @@ class TaskExecutor:
         self.heartbeater: Optional[Heartbeater] = None
         self.monitor: Optional[TaskMonitor] = None
         self._user_proc = None
+        # generation-aware re-rendezvous state: the spec generation the
+        # running user process was launched with, the newest generation any
+        # heartbeat has carried, and whether a newer generation (a peer's
+        # relaunch) has requested a barrier re-entry
+        self._spec_generation = 0
+        self._latest_generation = 0
+        self._respec_pending = False
+        self._respec_lock = threading.Lock()
+        self._test_kill_scheduled = False
 
     @property
     def task_id(self) -> str:
@@ -174,18 +204,137 @@ class TaskExecutor:
 
     def register_and_get_cluster_spec(self) -> Optional[dict]:
         """Gang barrier (TaskExecutor.java:295-309): start heartbeating, then
-        poll register_worker_spec until every expected task has registered."""
-        self.heartbeater = Heartbeater(self.client, self.task_id,
-                                       self.hb_interval_sec,
-                                       on_fatal=self._kill_user_proc)
-        self.heartbeater.start()
+        poll register_worker_spec until every expected task has registered.
+        Re-entrant: a generation bump (peer relaunch) sends the executor back
+        here; the heartbeater keeps running across re-entries."""
+        if self.heartbeater is None:
+            self.heartbeater = Heartbeater(
+                self.client, self.task_id, self.hb_interval_sec,
+                on_fatal=self._kill_user_proc,
+                task_attempt=self.task_attempt,
+                on_generation=self._on_generation,
+                silent=self._hb_silent_for_testing())
+            self.heartbeater.start()
         host_port = f"{self.host}:{self.port}"
-        LOG.info("registering %s at %s", self.task_id, host_port)
-        return poll_till_non_null(
-            lambda: self.client.register_worker_spec(self.task_id, host_port,
-                                                     self.session_id),
+        LOG.info("registering %s at %s (attempt %d)", self.task_id,
+                 host_port, self.task_attempt)
+        result = poll_till_non_null(
+            lambda: self.client.register_worker_spec(
+                self.task_id, host_port, self.session_id,
+                task_attempt=self.task_attempt, with_generation=True),
             interval_sec=0.2,
             timeout_sec=self.registration_timeout_sec)
+        if result is None:
+            return None
+        spec, generation = result
+        with self._respec_lock:
+            self._spec_generation = generation
+            # a bump observed mid-poll that is NEWER than the spec we just
+            # got keeps the respec flag armed; anything older is already
+            # satisfied by this spec
+            self._respec_pending = self._latest_generation > generation
+        return spec
+
+    def _on_generation(self, generation: int) -> None:
+        """Heartbeat-piggybacked spec generation: a bump past the launched
+        generation means a peer was relaunched — stop only the user process
+        and arm a barrier re-entry (the container and its localized
+        resources stay alive)."""
+        launched = 0
+        kill = False
+        with self._respec_lock:
+            if generation > self._latest_generation:
+                self._latest_generation = generation
+            launched = self._spec_generation
+            if (launched > 0 and generation > launched
+                    and not self._respec_pending):
+                self._respec_pending = True
+                kill = True
+        if kill:
+            LOG.warning("cluster-spec generation %d > launched %d — a peer "
+                        "was relaunched; re-entering gang rendezvous",
+                        generation, launched)
+            self._kill_user_proc()
+
+    def _take_respec(self) -> bool:
+        with self._respec_lock:
+            pending = self._respec_pending
+            self._respec_pending = False
+            return pending
+
+    def _generation_bumped_at_am(self) -> bool:
+        """One synchronous probe of the AM's spec generation, used after a
+        non-zero user exit that arrived with no respec pending: if a peer's
+        relaunch already bumped the generation, this exit is collateral of
+        the peer's death (failed collective), not an independent fault."""
+        try:
+            resp = self.client.task_executor_heartbeat(self.task_id,
+                                                       self.task_attempt)
+        except Exception:  # noqa: BLE001
+            return False
+        generation = int((resp or {}).get("spec_generation") or 0)
+        if generation > self._spec_generation:
+            LOG.warning("user exit coincides with spec generation bump "
+                        "(%d > %d) — treating as a peer relaunch, not an "
+                        "independent failure", generation,
+                        self._spec_generation)
+            return True
+        return False
+
+    def _hb_silent_for_testing(self) -> bool:
+        """TEST_TASK_HB_SILENCE='type#index#attempt': this attempt's
+        heartbeater never pings while the user process keeps running — the
+        chaos harness's wedge, exercising the heartbeat-expiry relaunch
+        path (attempt '*' matches every attempt)."""
+        spec = os.environ.get(C.TEST_TASK_HB_SILENCE)
+        if not spec:
+            return False
+        try:
+            jtype, idx, attempt = spec.split("#")
+            match = (jtype == self.job_name and int(idx) == self.task_index
+                     and attempt in ("*", str(self.task_attempt)))
+        except ValueError:
+            LOG.error("bad TEST_TASK_HB_SILENCE spec: %r", spec)
+            return False
+        if match:
+            LOG.warning("TEST hook: heartbeater silenced for attempt %d",
+                        self.task_attempt)
+        return match
+
+    def _schedule_kill_if_testing(self) -> None:
+        """TEST_TASK_KILL='type#index#after_ms#attempt': hard-crash THIS
+        attempt's container after_ms after its user process launches,
+        WITHOUT registering a result — the chaos harness's mid-run crash,
+        exercising the container-completion relaunch path (attempt '*'
+        matches every attempt). One-shot per executor: the respec loop may
+        pass here again."""
+        if self._test_kill_scheduled:
+            return
+        self._test_kill_scheduled = True
+        spec = os.environ.get(C.TEST_TASK_KILL)
+        if not spec:
+            return
+        try:
+            jtype, idx, after_ms, attempt = spec.split("#")
+            if (jtype != self.job_name or int(idx) != self.task_index
+                    or attempt not in ("*", str(self.task_attempt))):
+                return
+            delay = int(after_ms) / 1000.0
+        except ValueError:
+            LOG.error("bad TEST_TASK_KILL spec: %r", spec)
+            return
+
+        def _die():
+            LOG.error("TEST hook: TEST_TASK_KILL — hard-crashing attempt %d",
+                      self.task_attempt)
+            self._kill_user_proc()
+            os._exit(C.EXIT_FAILURE)
+
+        LOG.warning("TEST hook: attempt %d will hard-crash in %d ms",
+                    self.task_attempt, int(after_ms))
+        timer = threading.Timer(delay, _die)
+        timer.daemon = True
+        timer.start()
 
     def _skew_if_testing(self) -> None:
         """TEST_TASK_EXECUTOR_SKEW='type#index#ms': delay this specific task
@@ -232,34 +381,111 @@ class TaskExecutor:
 
     def run(self) -> int:
         """Full executor lifecycle; returns the user process exit code
-        (TaskExecutor.main, TaskExecutor.java:211-253)."""
+        (TaskExecutor.main, TaskExecutor.java:211-253).
+
+        The inner loop is the generation-aware re-rendezvous: when a peer is
+        relaunched the AM bumps the cluster-spec generation, this executor
+        stops only its user process, re-enters the gang barrier, and
+        relaunches the user command against the replacement's host:port —
+        the container and its localized resources stay alive."""
         self.localize_resources()
         self.setup_ports()
-        cluster_spec = self.register_and_get_cluster_spec()
-        if cluster_spec is None:
-            LOG.error("gang rendezvous timed out after %ds",
-                      self.registration_timeout_sec)
-            self._report(C.EXIT_FAILURE)
-            return C.EXIT_FAILURE
-        LOG.info("cluster spec: %s", cluster_spec)
-        env = render_framework_env(self.framework, cluster_spec,
-                                   self.job_name, self.task_index, self.conf)
-        env[C.JOB_NAME] = self.job_name
-        env[C.TASK_INDEX] = str(self.task_index)
-        env[C.TASK_NUM] = str(self.task_num)
-        env[C.IS_CHIEF] = str(self.is_chief).lower()
-        if self.tb_port is not None:
-            env[C.TB_PORT] = str(self.tb_port)
-        self._skew_if_testing()
-        # hand the reserved port over to the user process right before exec
-        # (TaskExecutor.java:227-235 release-or-keep logic)
+        try:
+            cluster_spec = self.register_and_get_cluster_spec()
+            if cluster_spec is None:
+                LOG.error("gang rendezvous timed out after %ds",
+                          self.registration_timeout_sec)
+                # flagged as a barrier timeout: an allocation problem, not
+                # a task fault — the AM excludes it from task relaunch
+                self._report(C.EXIT_RENDEZVOUS_TIMEOUT,
+                             barrier_timeout=True)
+                return C.EXIT_RENDEZVOUS_TIMEOUT
+            timeout_ms = self.conf.get_time_ms(K.APPLICATION_TIMEOUT, 0)
+            rendezvous_gave_up = False
+            while True:
+                LOG.info("cluster spec (generation %d): %s",
+                         self._spec_generation, cluster_spec)
+                env = render_framework_env(self.framework, cluster_spec,
+                                           self.job_name, self.task_index,
+                                           self.conf)
+                env[C.JOB_NAME] = self.job_name
+                env[C.TASK_INDEX] = str(self.task_index)
+                env[C.TASK_NUM] = str(self.task_num)
+                env[C.IS_CHIEF] = str(self.is_chief).lower()
+                env[C.TASK_ATTEMPT] = str(self.task_attempt)
+                env[C.SPEC_GENERATION] = str(self._spec_generation)
+                if self.tb_port is not None:
+                    env[C.TB_PORT] = str(self.tb_port)
+                self._skew_if_testing()
+                # hand the reserved port over to the user process right
+                # before exec (TaskExecutor.java:227-235 release-or-keep
+                # logic); re-rendezvous keeps the SAME host:port, the
+                # relaunched user process simply rebinds it
+                self._release_port_reservation()
+                # the chaos kill clock starts at user-process launch, not
+                # executor boot: a "crash after N ms mid-run" must not fire
+                # while the gang is still at the barrier, or the injected
+                # timing (peers running when the victim dies) is lost
+                self._schedule_kill_if_testing()
+                exit_code = self._execute(env, timeout_ms / 1000.0)
+                respec = self._take_respec()
+                if not respec and exit_code != 0:
+                    # a dying peer can take this task's collectives down
+                    # BEFORE the next heartbeat delivers the AM's
+                    # generation bump — probe once so the collateral exit
+                    # re-rendezvouses instead of reporting a failure that
+                    # would burn this healthy task's own attempt budget
+                    # (and, gang-wide, replay the full-gang teardown this
+                    # layer exists to avoid)
+                    respec = self._generation_bumped_at_am()
+                if not respec:
+                    break
+                LOG.warning("user process stopped for re-rendezvous "
+                            "(rc=%d); re-entering the barrier", exit_code)
+                # the wait for the replacement peer is governed by the
+                # AM's re-armed allocation deadline, not the local poll
+                # timeout: reporting EXIT_FAILURE on the first timeout
+                # would relaunch every healthy survivor exactly when
+                # allocation is starved. Bounded, though: an executor the
+                # AM keeps answering but never accepts (a superseded
+                # attempt that outlived its container stop, or a
+                # replacement unplaceable beyond the AM's own deadline)
+                # must not poll the AM for the rest of the application's
+                # life. A dead AM is covered by the heartbeater's
+                # self-destruct.
+                cluster_spec = None
+                for _ in range(3):
+                    cluster_spec = self.register_and_get_cluster_spec()
+                    if cluster_spec is not None:
+                        break
+                    LOG.warning("re-rendezvous barrier still open after "
+                                "%ds — retrying (the AM's allocation "
+                                "deadline governs)",
+                                self.registration_timeout_sec)
+                if cluster_spec is None:
+                    LOG.error("re-rendezvous never completed after 3 "
+                              "rounds of %ds — giving up",
+                              self.registration_timeout_sec)
+                    rendezvous_gave_up = True
+                    exit_code = C.EXIT_FAILURE
+                    break
+            LOG.info("user process exited with %d", exit_code)
+            # a given-up re-rendezvous is a barrier problem, not a task
+            # fault — flag it so the AM spends no relaunch budget on it
+            # (a superseded attempt's report is attempt-fenced anyway)
+            self._report(exit_code, barrier_timeout=rendezvous_gave_up)
+            return exit_code
+        finally:
+            # every exit path — including the rendezvous-timeout returns
+            # above and unexpected exceptions — must free the reservation,
+            # or the SO_REUSEPORT socket stays held for the executor's
+            # remaining lifetime
+            self._release_port_reservation()
+
+    def _release_port_reservation(self) -> None:
         if self._port_reservation is not None:
             self._port_reservation.release()
-        timeout_ms = self.conf.get_time_ms(K.APPLICATION_TIMEOUT, 0)
-        exit_code = self._execute(env, timeout_ms / 1000.0)
-        LOG.info("user process exited with %d", exit_code)
-        self._report(exit_code)
-        return exit_code
+            self._port_reservation = None
 
     def _execute(self, env: dict[str, str], timeout_sec: float) -> int:
         if not self.task_command:
@@ -267,6 +493,11 @@ class TaskExecutor:
             return C.EXIT_FAILURE
         self._user_proc = launch_shell(self.task_command, extra_env=env,
                                        cwd=os.getcwd())
+        if self._respec_pending:
+            # a generation bump landed between _on_generation's kill (which
+            # found no live process) and this launch — take the fresh
+            # process down so the respec loop re-enters the barrier
+            self._kill_user_proc()
         from tony_tpu.executor.gpu_metrics import maybe_gpu_sampler
         from tony_tpu.executor.task_monitor import default_tpu_sampler
         self.monitor = TaskMonitor(
@@ -290,11 +521,13 @@ class TaskExecutor:
             except (ProcessLookupError, PermissionError):
                 proc.kill()
 
-    def _report(self, exit_code: int) -> None:
+    def _report(self, exit_code: int, barrier_timeout: bool = False) -> None:
         if self.heartbeater is not None:
             self.heartbeater.stop()
         try:
             self.client.register_execution_result(
-                exit_code, self.job_name, self.task_index, self.session_id)
+                exit_code, self.job_name, self.task_index, self.session_id,
+                task_attempt=self.task_attempt,
+                barrier_timeout=barrier_timeout)
         except Exception:  # noqa: BLE001
             LOG.exception("failed to register execution result")

@@ -9,18 +9,26 @@ configurable attempts/backoff + wait_for_ready.
 from __future__ import annotations
 
 import json
+import os
+import random
 import time
 from typing import Any, Optional
 
 import grpc
 
+from tony_tpu import constants as C
+from tony_tpu.utils.common import equal_jitter_backoff_sec
 from tony_tpu.rpc.service import (
     CLUSTER_SERVICE, METRICS_SERVICE, CLUSTER_METHODS, METRICS_METHODS,
     _ser, _deser,
 )
 
 DEFAULT_RETRIES = 10
-DEFAULT_RETRY_SLEEP_SEC = 2.0
+# base of the capped jittered exponential backoff between retries (the
+# reference slept a flat 2 s — at gang width that had every executor of a
+# booting AM retry in lockstep; jitter decorrelates the thundering herd)
+DEFAULT_RETRY_SLEEP_SEC = 0.5
+DEFAULT_RETRY_MAX_SLEEP_SEC = 8.0
 
 
 class _JsonRpcClient:
@@ -28,6 +36,7 @@ class _JsonRpcClient:
                  host: str, port: int,
                  retries: int = DEFAULT_RETRIES,
                  retry_sleep_sec: float = DEFAULT_RETRY_SLEEP_SEC,
+                 retry_max_sleep_sec: float = DEFAULT_RETRY_MAX_SLEEP_SEC,
                  timeout_sec: float = 30.0,
                  auth_token: Optional[str] = None,
                  task_auth_id: Optional[str] = None):
@@ -35,7 +44,20 @@ class _JsonRpcClient:
         self._channel = grpc.insecure_channel(f"{host}:{port}")
         self._retries = retries
         self._retry_sleep_sec = retry_sleep_sec
+        self._retry_max_sleep_sec = retry_max_sleep_sec
         self._timeout_sec = timeout_sec
+        # jitter source; TONY_TEST_SEED makes delays replayable while the
+        # caller's task identity keeps concurrent executors decorrelated —
+        # seeding on the endpoint alone would have every executor of a
+        # booting AM draw identical delays, recreating the thundering herd
+        # this backoff exists to break
+        seed = os.environ.get(C.TEST_SEED)
+        ident = (f"{os.environ.get(C.JOB_NAME, '')}:"
+                 f"{os.environ.get(C.TASK_INDEX, '')}:"
+                 f"{os.environ.get(C.TASK_ATTEMPT, '')}")
+        self._rng = random.Random(
+            None if seed is None
+            else f"{seed}:{ident}:{service}:{host}:{port}")
         # task_auth_id marks auth_token as a per-task derived token (the
         # AM re-derives and checks it against this id)
         self._metadata = token_call_creds(auth_token, task_auth_id)
@@ -58,9 +80,9 @@ class _JsonRpcClient:
              timeout_sec: Optional[float] = None,
              wait_for_ready: bool = True) -> Any:
         """Per-call overrides exist for liveness-critical paths (heartbeats)
-        that must fail FAST — the caller is its own retry loop there, and
-        wait_for_ready would otherwise stall a call against a dead AM for
-        the full deadline."""
+        that must fail FAST — the caller is its own retry loop there (with
+        retries=1 no backoff sleep ever runs), and wait_for_ready would
+        otherwise stall a call against a dead AM for the full deadline."""
         retries = self._retries if retries is None else retries
         timeout_sec = self._timeout_sec if timeout_sec is None else timeout_sec
         last_err: Optional[Exception] = None
@@ -74,9 +96,18 @@ class _JsonRpcClient:
                     raise
                 last_err = e
                 if attempt + 1 < retries:
-                    time.sleep(self._retry_sleep_sec)
+                    time.sleep(self._backoff_sec(attempt))
         raise ConnectionError(
             f"RPC {method} failed after {retries} attempts: {last_err}")
+
+    def _backoff_sec(self, attempt: int) -> float:
+        """Capped equal-jitter exponential backoff: attempt N sleeps in
+        [cap/2, cap], cap = min(max, base * 2^N) — keeps the lower bound
+        meaningful (a booting AM isn't hammered immediately) while
+        decorrelating simultaneous retriers."""
+        return equal_jitter_backoff_sec(self._retry_sleep_sec,
+                                        self._retry_max_sleep_sec,
+                                        attempt, self._rng)
 
     def close(self) -> None:
         self._channel.close()
@@ -96,36 +127,59 @@ class ClusterServiceClient(_JsonRpcClient):
         return json.loads(spec) if spec else None
 
     def register_worker_spec(self, task_id: str, spec: str,
-                             session_id: int = -1) -> Optional[dict]:
+                             session_id: int = -1, task_attempt: int = -1,
+                             with_generation: bool = False):
         """Gang barrier: returns the full cluster spec once everyone has
         registered, else None (reference: TaskExecutor.java:295-309 poll).
         session_id lets the AM reject a stale previous-session executor's
-        registration (task ids alone repeat across AM retries)."""
+        registration (task ids alone repeat across AM retries); task_attempt
+        likewise rejects a superseded attempt's registration after a
+        relaunch. With with_generation=True the complete-barrier return is
+        (spec_dict, spec_generation) so the executor can detect later
+        generation bumps (peer relaunched → re-rendezvous)."""
         resp = self.call("register_worker_spec",
                          {"task_id": task_id, "spec": spec,
-                          "session_id": session_id})
+                          "session_id": session_id,
+                          "task_attempt": task_attempt})
         spec_json = resp.get("spec")
-        return json.loads(spec_json) if spec_json else None
+        if not spec_json:
+            return None
+        parsed = json.loads(spec_json)
+        if with_generation:
+            return parsed, int(resp.get("generation", 0))
+        return parsed
 
     def register_tensorboard_url(self, task_id: str, url: str) -> None:
         self.call("register_tensorboard_url", {"task_id": task_id, "url": url})
 
     def register_execution_result(self, exit_code: int, job_name: str,
-                                  job_index: int, session_id: int) -> None:
+                                  job_index: int, session_id: int,
+                                  task_attempt: int = -1,
+                                  barrier_timeout: bool = False) -> None:
+        """barrier_timeout marks a gang-rendezvous timeout: an allocation
+        problem, not a task fault — the AM must not spend relaunch budget
+        on it. An explicit flag because exit codes can't carry it: every
+        0-255 value is reachable by the user process itself."""
         self.call("register_execution_result", {
             "exit_code": exit_code, "job_name": job_name,
-            "job_index": job_index, "session_id": session_id})
+            "job_index": job_index, "session_id": session_id,
+            "task_attempt": task_attempt,
+            "barrier_timeout": barrier_timeout})
 
     def finish_application(self) -> None:
         self.call("finish_application", {})
 
-    def task_executor_heartbeat(self, task_id: str) -> None:
+    def task_executor_heartbeat(self, task_id: str,
+                                task_attempt: int = -1) -> dict:
         # liveness signal: one attempt, short deadline, no wait_for_ready —
         # the Heartbeater counts consecutive failures and kills the executor
         # when the AM is gone (reference: TaskExecutor.java:358-368; with
-        # the default retry proxy a dead AM would take ~27 min to detect)
-        self.call("task_executor_heartbeat", {"task_id": task_id},
-                  retries=1, timeout_sec=5.0, wait_for_ready=False)
+        # the default retry proxy a dead AM would take ~27 min to detect).
+        # The response piggybacks the AM's current spec_generation so
+        # running executors learn about relaunches without extra polling.
+        return self.call("task_executor_heartbeat",
+                         {"task_id": task_id, "task_attempt": task_attempt},
+                         retries=1, timeout_sec=5.0, wait_for_ready=False)
 
 
 class MetricsServiceClient(_JsonRpcClient):

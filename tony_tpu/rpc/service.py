@@ -59,9 +59,13 @@ class ClusterServiceHandler(abc.ABC):
 
     @abc.abstractmethod
     def register_worker_spec(self, req: dict) -> dict:
-        """req: {task_id, spec} -> {"spec": json-str|None}. Returns None spec
+        """req: {task_id, spec, session_id?, task_attempt?} ->
+        {"spec": json-str|None, "generation": int?}. Returns None spec
         until ALL expected tasks have registered — the gang-rendezvous barrier
-        (reference: ApplicationMaster.java:840-888)."""
+        (reference: ApplicationMaster.java:840-888). `generation` stamps
+        which cluster-spec generation the returned spec belongs to; a task
+        relaunch bumps it and invalidates the dead task's registration, so
+        surviving executors re-enter this barrier."""
 
     @abc.abstractmethod
     def register_tensorboard_url(self, req: dict) -> dict:
@@ -69,7 +73,9 @@ class ClusterServiceHandler(abc.ABC):
 
     @abc.abstractmethod
     def register_execution_result(self, req: dict) -> dict:
-        """req: {exit_code, job_name, job_index, session_id} -> {}."""
+        """req: {exit_code, job_name, job_index, session_id, task_attempt?}
+        -> {}. Results from a stale session or a superseded task attempt
+        are ignored."""
 
     @abc.abstractmethod
     def finish_application(self, req: dict) -> dict:
@@ -77,7 +83,10 @@ class ClusterServiceHandler(abc.ABC):
 
     @abc.abstractmethod
     def task_executor_heartbeat(self, req: dict) -> dict:
-        """req: {task_id} -> {}."""
+        """req: {task_id, task_attempt?} -> {"spec_generation": int?}.
+        Pings from a superseded attempt (zombie executor of a relaunched
+        task) are ignored; the response carries the current cluster-spec
+        generation so running executors detect peer relaunches."""
 
 
 class MetricsServiceHandler(abc.ABC):

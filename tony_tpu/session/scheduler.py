@@ -13,6 +13,7 @@ drives the local process backend today and a real cluster backend later.
 from __future__ import annotations
 
 import abc
+import dataclasses
 import logging
 import threading
 
@@ -92,6 +93,18 @@ class TaskScheduler:
         self._scheduled.add(request.job_name)
         self.session.num_expected_tasks += request.num_instances
         self.requestor.request_containers(request)
+
+    def schedule_replacement(self, job_name: str) -> None:
+        """Re-request ONE container for a relaunched task slot at the
+        jobtype's priority (no reference equivalent — the reference rebuilt
+        the whole session). num_expected_tasks is untouched: the slot is
+        recycled, not added, and the allocation matches it through the same
+        unique-priority path as the original launch."""
+        request = self.session.requests[job_name]
+        LOG.info("re-requesting 1 x %s replacement (priority %d)",
+                 job_name, request.priority)
+        self.requestor.request_containers(
+            dataclasses.replace(request, num_instances=1))
 
     def register_dependency_completed(self, job_name: str) -> None:
         """One instance of `job_name` completed: decrement counters; release

@@ -40,12 +40,18 @@ class FinalStatus(str, enum.Enum):
 
 
 class Task:
-    """One task slot (reference: TonySession.TonyTask, TonySession.java:440+)."""
+    """One task slot (reference: TonySession.TonyTask, TonySession.java:440+).
+
+    A slot survives its container: on a tracked task's crash or heartbeat
+    expiry within budget, the slot is reset for a fresh attempt in a
+    replacement container (no reference equivalent — the reference's fault
+    model rebuilt the whole session instead)."""
 
     def __init__(self, job_name: str, index: int, session_id: int):
         self.job_name = job_name
         self.index = index
         self.session_id = session_id
+        self.attempt = 0            # bumped by reset_for_relaunch
         self.host: str = ""
         self.port: int = -1
         self.container_id: str = ""
@@ -86,6 +92,20 @@ class Task:
                 self.status = TaskStatus.FAILED
             self.completed = True
 
+    def reset_for_relaunch(self) -> None:
+        """Recycle this slot for a replacement container: next attempt, no
+        container, no result. The unassigned slot matches the replacement
+        allocation exactly like a first launch (match_allocation)."""
+        with self._lock:
+            self.attempt += 1
+            self.host = ""
+            self.port = -1
+            self.container_id = ""
+            self.url = ""
+            self.completed = False
+            self._exit_status = None
+            self.status = TaskStatus.NEW
+
     def to_task_info(self) -> TaskInfo:
         return TaskInfo(self.job_name, self.index, self.url, self.status)
 
@@ -115,6 +135,11 @@ class TonySession:
         self.final_status = FinalStatus.UNDEFINED
         self.final_message: Optional[str] = None
         self._registered: dict[str, str] = {}   # task_id -> host:port
+        # cluster-spec generation: bumped whenever a task's registration is
+        # invalidated for relaunch. Executors compare it against the
+        # generation their running spec came from; a newer generation means
+        # "re-enter the rendezvous barrier" (without restarting containers).
+        self.spec_generation = 1
         self._lock = threading.RLock()
 
     # ------------------------------------------------------------------
@@ -174,6 +199,51 @@ class TonySession:
             self._registered[task_id] = task.host_port
             return self.cluster_spec_json()
 
+    def register_worker_spec_with_generation(
+            self, task_id: str, host_port: str,
+            expected_attempt: int = -1) -> tuple[Optional[str], int, bool]:
+        """register_worker_spec plus the generation the returned spec belongs
+        to, read atomically — a relaunch between reading the spec and reading
+        the generation would hand an executor a stale spec stamped with the
+        new generation, and it would never notice the re-rendezvous.
+
+        `expected_attempt` (>= 0) fences the registration itself: the AM's
+        attempt check and this registration would otherwise be separate
+        atomic sections, letting a relaunch interleave so a dead attempt's
+        in-flight poll re-fills the barrier it was just evicted from.
+
+        Returns (spec_json_or_None, generation, accepted): `accepted` tells
+        the caller whether the registration was recorded (a None spec with
+        accepted=True just means the barrier is still open), so liveliness
+        tracking can be gated on it."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if (expected_attempt >= 0 and task is not None
+                    and task.attempt != expected_attempt):
+                LOG.warning("rejecting registration of %s attempt %d "
+                            "(slot is at attempt %d)", task_id,
+                            expected_attempt, task.attempt)
+                return None, self.spec_generation, False
+            return (self.register_worker_spec(task_id, host_port),
+                    self.spec_generation, task is not None)
+
+    def relaunch_task(self, job_name: str, index: int) -> Optional[Task]:
+        """Invalidate a task's registration and recycle its slot for a
+        replacement attempt. Bumps the cluster-spec generation so surviving
+        executors (which keep their containers and localized resources)
+        re-enter the rendezvous barrier and pick up the replacement's
+        host:port."""
+        with self._lock:
+            task = self.get_task(job_name, index)
+            if task is None:
+                return None
+            self._registered.pop(task.task_id, None)
+            task.reset_for_relaunch()
+            self.spec_generation += 1
+            LOG.info("task %s recycled for attempt %d (spec generation %d)",
+                     task.task_id, task.attempt, self.spec_generation)
+            return task
+
     def all_tasks_registered(self) -> bool:
         with self._lock:
             return (self.num_expected_tasks > 0
@@ -206,6 +276,15 @@ class TonySession:
 
     def is_tracked(self, job_name: str) -> bool:
         return job_name not in self._untracked
+
+    def max_task_attempts(self, job_name: str) -> int:
+        """Total attempts (first run + relaunches) a slot of this jobtype
+        gets: tony.<job>.max-task-attempts, else tony.task.max-task-attempts
+        (default 1 = the all-or-nothing reference behavior)."""
+        per_job = self.conf.get_int(K.max_task_attempts_key(job_name), 0)
+        if per_job >= 1:
+            return per_job
+        return max(1, self.conf.get_int(K.TASK_MAX_TASK_ATTEMPTS, 1))
 
     def total_tracked_tasks(self) -> int:
         return sum(len(t) for j, t in self.job_tasks.items() if self.is_tracked(j))

@@ -6,11 +6,28 @@ Reference: util/Utils.java polling helpers (:89-143), env kv parsing
 
 from __future__ import annotations
 
+import random
 import socket
 import time
 from typing import Callable, Optional, TypeVar
 
 T = TypeVar("T")
+
+
+def equal_jitter_backoff_sec(base_sec: float, max_sec: float, exponent: int,
+                             rng: "random.Random") -> float:
+    """Capped equal-jitter exponential backoff: uniform in [cap/2, cap] with
+    cap = min(max_sec, base_sec * 2^exponent) (max_sec <= 0 means no cap);
+    0 when base_sec <= 0 or exponent < 0. Equal jitter keeps the lower bound
+    meaningful (a booting peer is never hammered immediately) while
+    decorrelating simultaneous retriers. Shared by the rpc client's retry
+    loop and the AM's whole-session retry."""
+    if base_sec <= 0 or exponent < 0:
+        return 0.0
+    cap = base_sec * (2 ** min(exponent, 30))
+    if max_sec > 0:
+        cap = min(max_sec, cap)
+    return rng.uniform(cap / 2.0, cap)
 
 
 def poll(func: Callable[[], bool], interval_sec: float, timeout_sec: float) -> bool:
