@@ -1,0 +1,132 @@
+"""Submit an online-serving job for a pretrained Llama checkpoint.
+
+The serving half of the examples/llama-pretrain lifecycle: train with
+`--checkpoint-dir`, then point this submitter at the same directory — it
+submits a `serving` jobtype through the regular TonY client path, the AM
+brings up `python -m tony_tpu.serve` in a container, the endpoint is
+registered in the cluster spec + history, and `/v1/generate` answers
+live traffic (continuous batching, slot-recycled KV cache).
+
+Usage:
+  python examples/llama-serve/serve_submit.py \
+      --config llama3_8b --checkpoint-dir /ckpts/run1 \
+      --quant int8 --slots 8 --token-budget 2048 [--smoke]
+
+`--smoke` fires one blocking /v1/generate request at the endpoint once it
+registers, prints the generated token ids, then stops the job — the whole
+train→serve handoff as a one-command check. Without it the job serves
+until killed (Ctrl-C sends the kill through the client shutdown hook).
+
+Equivalent raw CLI:
+  python -m tony_tpu.cli submit \
+      --conf tony.serving.instances=1 \
+      --conf tony.serving.slots=8 \
+      --conf tony.serving.token-budget=2048 \
+      --conf "tony.serving.command=python -m tony_tpu.serve \
+              --config llama3_8b --checkpoint-dir /ckpts/run1 --quant int8"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.environ.get("TONY_REPO_ROOT",
+                                  os.path.join(os.path.dirname(__file__),
+                                               "..", "..")))
+
+from tony_tpu import constants as C  # noqa: E402
+from tony_tpu.client.tony_client import TonyClient  # noqa: E402
+from tony_tpu.conf import TonyConfiguration, keys as K  # noqa: E402
+from tony_tpu.rpc.client import ClusterServiceClient  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="tiny")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="examples/llama-pretrain checkpoint dir")
+    parser.add_argument("--quant", default="", choices=("", "int8"))
+    parser.add_argument("--quant-cache", action="store_true")
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--token-budget", type=int, default=2048)
+    parser.add_argument("--queue-depth", type=int, default=64)
+    parser.add_argument("--smoke", action="store_true",
+                        help="one /v1/generate request, then stop the job")
+    args = parser.parse_args()
+
+    serve_cmd = f"{sys.executable} -m tony_tpu.serve --config {args.config}"
+    if args.checkpoint_dir:
+        serve_cmd += f" --checkpoint-dir {args.checkpoint_dir}"
+    if args.quant:
+        serve_cmd += f" --quant {args.quant}"
+    if args.quant_cache:
+        serve_cmd += " --quant-cache"
+
+    conf = TonyConfiguration()
+    conf.set(K.SERVING_SLOTS, args.slots, "example")
+    conf.set(K.SERVING_TOKEN_BUDGET, args.token_budget, "example")
+    conf.set(K.SERVING_QUEUE_DEPTH, args.queue_depth, "example")
+    client = TonyClient(conf)
+    client.init(["--conf", "tony.serving.instances=1",
+                 "--conf", f"tony.serving.command={serve_cmd}"])
+    client.submit()
+    print(f"submitted {client.app_id}; waiting for the endpoint...")
+
+    monitor = threading.Thread(target=client.monitor, daemon=True)
+    monitor.start()
+    try:
+        endpoint = _wait_endpoint(client)
+        print(f"serving endpoint: {endpoint}/v1/generate")
+        if not args.smoke:
+            print("serving until killed (Ctrl-C to stop)")
+            monitor.join()
+            return 0 if client.final_status == "SUCCEEDED" else 1
+        body = json.dumps({"prompt": [1, 2, 3, 4, 5, 6, 7, 8],
+                           "max_new_tokens": 16}).encode()
+        req = urllib.request.Request(
+            f"{endpoint}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        print(f"generated: {resp['tokens']}")
+        print("SERVE_SMOKE_OK")
+        return 0
+    finally:
+        client.cleanup()
+
+
+def _wait_endpoint(client: TonyClient, timeout_sec: float = 600.0) -> str:
+    hostport = os.path.join(client.app_dir, C.AM_HOSTPORT_FILE)
+    deadline = time.monotonic() + timeout_sec
+    while time.monotonic() < deadline and not os.path.exists(hostport):
+        time.sleep(0.2)
+    if not os.path.exists(hostport):
+        raise SystemExit("AM never came up (no amhostport file) — see "
+                         f"{client.app_dir}/am.stderr")
+    with open(hostport, encoding="utf-8") as f:
+        host, _, port = f.read().strip().rpartition(":")
+    rpc = ClusterServiceClient(host, int(port), retries=2,
+                               retry_sleep_sec=0.2, timeout_sec=5.0,
+                               auth_token=client.auth_token)
+    try:
+        while time.monotonic() < deadline:
+            try:
+                infos = rpc.get_task_infos()
+            except Exception:  # noqa: BLE001 — AM mid-boot
+                infos = []
+            for info in infos:
+                if info.get("name") == "serving-endpoint":
+                    return info["url"]
+            time.sleep(0.5)
+    finally:
+        rpc.close()
+    raise SystemExit("serving endpoint never registered")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

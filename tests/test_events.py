@@ -5,8 +5,8 @@ import os
 
 from tony_tpu.events import (
     Event, EventType, ApplicationInited, ApplicationFinished,
-    TaskStarted, TaskFinished, EventHandler, JobMetadata,
-    history_file_name, parse_history_file_name,
+    ServingEndpointRegistered, TaskStarted, TaskFinished, EventHandler,
+    JobMetadata, history_file_name, parse_history_file_name,
 )
 from tony_tpu.events.handler import parse_events
 from tony_tpu.events.history import inprogress_file_name
@@ -55,6 +55,28 @@ def test_event_handler_e2e(tmp_path):
         EventType.APPLICATION_INITED, EventType.TASK_STARTED,
         EventType.TASK_FINISHED, EventType.APPLICATION_FINISHED]
     assert events[2].payload.metrics == [{"name": "m", "value": 1.0}]
+
+
+def test_serving_endpoint_event_roundtrip(tmp_path):
+    """The serving subsystem's schema entry: SERVING_ENDPOINT_REGISTERED
+    survives the write→parse roundtrip with its payload intact."""
+    md = JobMetadata(application_id="app_srv", started=7, user="eve")
+    handler = EventHandler(str(tmp_path), md)
+    handler.start()
+    handler.emit(Event(EventType.SERVING_ENDPOINT_REGISTERED,
+                       ServingEndpointRegistered(
+                           "serving", 0, "http://h1:8080")))
+    final = handler.stop("KILLED")
+    events = parse_events(final)
+    assert [e.type for e in events] == [
+        EventType.SERVING_ENDPOINT_REGISTERED]
+    p = events[0].payload
+    assert isinstance(p, ServingEndpointRegistered)
+    assert (p.task_type, p.task_index, p.url) == \
+        ("serving", 0, "http://h1:8080")
+    # dict-level codec (what the portal's event cache serves)
+    back = Event.from_dict(events[0].to_dict())
+    assert back.payload == p
 
 
 def test_emit_after_stop_drops(tmp_path):

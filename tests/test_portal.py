@@ -268,6 +268,47 @@ def test_portal_404(portal):
     assert exc.value.code == 404
 
 
+def test_job_page_renders_serving_endpoint(tmp_path):
+    """A serving job's page shows the registered endpoint URL — and links
+    it through the authenticated proxy when tony.proxy.url is configured
+    — instead of showing nothing actionable for serving jobs."""
+    from tony_tpu.events.schema import ServingEndpointRegistered
+
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    app_dir = os.path.join(inter, "app_srv")
+    os.makedirs(app_dir)
+    md = JobMetadata(application_id="app_srv", started=10, user="alice")
+    handler = EventHandler(app_dir, md)
+    handler.start()
+    handler.emit(Event(EventType.TASK_STARTED,
+                       TaskStarted("serving", 0, "hostB", "container_9")))
+    handler.emit(Event(EventType.SERVING_ENDPOINT_REGISTERED,
+                       ServingEndpointRegistered("serving", 0,
+                                                 "http://hostB:9900")))
+    path = handler.stop("KILLED")
+    want = os.path.join(app_dir, history_file_name(JobMetadata(
+        application_id="app_srv", started=10, completed=20, user="alice",
+        status="KILLED")))
+    os.replace(path, want)
+    with open(os.path.join(app_dir, C.PORTAL_CONFIG_FILE), "w") as f:
+        json.dump({"tony.proxy.url": "http://gateway:7000"}, f)
+
+    server = PortalServer(PortalCache(inter, fin), port=0,
+                          host="127.0.0.1")
+    server.start()
+    try:
+        status, body = _get(server, "/jobs/app_srv")
+    finally:
+        server.stop()
+    assert status == 200
+    assert "Serving endpoints" in body
+    assert "http://hostB:9900" in body
+    # linked THROUGH the configured proxy, raw URL stays visible as text
+    assert 'href="http://gateway:7000"' in body
+    assert "(via proxy)" in body
+
+
 def test_history_store_fetcher_feeds_mover_and_cache(tmp_path, fake_gcs):
     """Off-host AM story: finished jhist published to the store is pulled
     into the intermediate dir, the mover finalizes it into finished/, and

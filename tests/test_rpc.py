@@ -50,6 +50,11 @@ class FakeClusterHandler(ClusterServiceHandler):
         self.tb_url = req["url"]
         return {}
 
+    def register_serving_endpoint(self, req):
+        self.serving_endpoints = getattr(self, "serving_endpoints", {})
+        self.serving_endpoints[req["task_id"]] = req["url"]
+        return {}
+
     def register_execution_result(self, req):
         self.results.append(req)
         return {}

@@ -30,6 +30,9 @@ class FakeHandler:
     def register_tensorboard_url(self, req):
         return {}
 
+    def register_serving_endpoint(self, req):
+        return {}
+
     def register_execution_result(self, req):
         return {}
 

@@ -40,8 +40,8 @@ from tony_tpu.conf import TonyConfiguration, keys as K
 from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
-    ApplicationFinished, ApplicationInited, Event, EventType, TaskFinished,
-    TaskRelaunched, TaskStarted,
+    ApplicationFinished, ApplicationInited, Event, EventType,
+    ServingEndpointRegistered, TaskFinished, TaskRelaunched, TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor
 from tony_tpu.rpc.service import (
@@ -99,7 +99,22 @@ class MetricsStore(MetricsServiceHandler):
         task_type, index = req["task_type"], int(req["index"])
         metrics = req.get("metrics", [])
         with self._lock:
-            self._metrics.setdefault(task_type, {})[index] = metrics
+            # MERGE by metric name, don't replace the list: one task slot
+            # has several pushers at once (executor TaskMonitor: memory/
+            # duty; in-process reporters: trainer HBM, serving TTFT/
+            # throughput) and whole-list replacement had the last writer
+            # clobbering every other source's gauges. Wedge detection
+            # still runs on the RAW incoming sample so its
+            # stopped-reporting-duty dynamics are unchanged.
+            cur = self._metrics.setdefault(task_type, {}).setdefault(
+                index, [])
+            by_name = {m.get("name"): i for i, m in enumerate(cur)}
+            for m in metrics:
+                at = by_name.get(m.get("name"))
+                if at is None:
+                    cur.append(m)
+                else:
+                    cur[at] = m
             self._track_utilization(task_type, index, metrics)
         return {}
 
@@ -194,6 +209,10 @@ class ApplicationMaster(ClusterServiceHandler):
             K.CONTAINER_ALLOCATION_TIMEOUT, 15 * 60 * 1000)
         self._lock = threading.RLock()
         self._tb_url = ""
+        # serving endpoints announced via register_serving_endpoint:
+        # task_id -> url (serve/ subsystem; surfaced in task infos and as
+        # SERVING_ENDPOINT_REGISTERED history events)
+        self._serving_endpoints: dict[str, str] = {}
         self._wake = threading.Event()   # kick the monitor loop early
         # timings (reference cadences, TonyConfigurationKeys.java:143-150)
         self._hb_interval_ms = conf.get_time_ms(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
@@ -791,9 +810,19 @@ class ApplicationMaster(ClusterServiceHandler):
         # (ApplicationMaster.java:753-764)
         if self._model_params is not None:
             env[C.MODEL_PARAMS] = self._model_params
-        # per-jobtype command override, else the global task command
-        command = req.command or self.conf.get_str("tony.task.command") \
-            or os.environ.get(C.TASK_COMMAND, "")
+        # per-jobtype command override, else the global task command —
+        # except `serving`, whose workload is built in: it runs the serve/
+        # subsystem's server (knobs from tony.serving.*) unless
+        # tony.serving.command overrides (e.g. to add --config /
+        # --checkpoint-dir flags). The GLOBAL --executes command never
+        # leaks into a serving task: in a mixed train+serve app it is the
+        # training script.
+        if task.job_name == C.SERVING_JOB_NAME:
+            command = req.command or f"{sys.executable} -m tony_tpu.serve"
+        else:
+            command = req.command \
+                or self.conf.get_str("tony.task.command") \
+                or os.environ.get(C.TASK_COMMAND, "")
         env[C.TASK_COMMAND] = command
         # user-supplied pass-through env (tony.execution.env k=v list)
         for entry in self.conf.get_strings(K.EXECUTION_ENV):
@@ -1049,6 +1078,15 @@ class ApplicationMaster(ClusterServiceHandler):
         if self._tb_url:
             infos.append({"name": "tensorboard", "index": 0,
                           "url": self._tb_url, "status": "RUNNING"})
+        # live serving endpoints ride the same status channel the
+        # reference used for the TB URL, so clients/proxies discover the
+        # inference endpoint without parsing history
+        with self._lock:
+            endpoints = sorted(self._serving_endpoints.items())
+        for i, (task_id, url) in enumerate(endpoints):
+            infos.append({"name": "serving-endpoint", "index": i,
+                          "task_id": task_id, "url": url,
+                          "status": "RUNNING"})
         return infos
 
     def get_cluster_spec(self, req: dict) -> dict:
@@ -1113,6 +1151,29 @@ class ApplicationMaster(ClusterServiceHandler):
     def register_tensorboard_url(self, req: dict) -> dict:
         self._tb_url = req.get("url", "")
         LOG.info("TensorBoard registered at %s", self._tb_url)
+        return {}
+
+    def register_serving_endpoint(self, req: dict) -> dict:
+        """A serving task's HTTP frontend announced its live endpoint:
+        record it (task infos) and persist it to history so the portal job
+        page can render the URL after the AM is gone."""
+        task_id = str(req.get("task_id", ""))
+        url = str(req.get("url", ""))
+        if not task_id or not url:
+            return {}
+        name, _, idx = task_id.rpartition(":")
+        try:
+            index = int(idx)
+        except ValueError:
+            name, index = task_id, 0
+        with self._lock:
+            known = self._serving_endpoints.get(task_id)
+            self._serving_endpoints[task_id] = url
+        if known != url:
+            LOG.info("serving endpoint registered: %s -> %s", task_id, url)
+            self.event_handler.emit(Event(
+                EventType.SERVING_ENDPOINT_REGISTERED,
+                ServingEndpointRegistered(name, index, url)))
         return {}
 
     def register_execution_result(self, req: dict) -> dict:

@@ -160,7 +160,7 @@ class LocalClusterBackend(ClusterBackend):
                 return
             self._killed.add(container_id)
         self._docker_kill(container_id)
-        self._kill_tree(proc)
+        self._terminate_tree(proc)
 
     def _docker_kill(self, container_id: str) -> None:
         """Killing the `docker run` client does not kill the daemon-side
@@ -188,6 +188,34 @@ class LocalClusterBackend(ClusterBackend):
             except ProcessLookupError:
                 pass
 
+    # grace between the TERM and the KILL escalation: enough for the
+    # executor's SIGTERM handler to reap its user process (which runs in
+    # its OWN session, so a bare SIGKILL of the container group would
+    # orphan it — fatal for long-running serving workloads: process and
+    # port would outlive the application)
+    STOP_GRACE_SEC = 5.0
+
+    @classmethod
+    def _terminate_tree(cls, proc: subprocess.Popen) -> None:
+        """TERM-then-KILL container stop, non-blocking for the caller
+        (stop_container runs on AM monitor/relaunch paths): the KILL
+        escalation happens on a daemon timer iff the TERM didn't land."""
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            cls._kill_tree(proc)
+            return
+
+        def _escalate():
+            if proc.poll() is None:
+                LOG.warning("container pid %d ignored SIGTERM for %.0fs "
+                            "— killing", proc.pid, cls.STOP_GRACE_SEC)
+                cls._kill_tree(proc)
+
+        timer = threading.Timer(cls.STOP_GRACE_SEC, _escalate)
+        timer.daemon = True
+        timer.start()
+
     def stop(self) -> None:
         self._stopping = True
         with self._lock:
@@ -195,8 +223,24 @@ class LocalClusterBackend(ClusterBackend):
             cids = list(self._procs)
         for cid in cids:
             self._docker_kill(cid)
+        # TERM first (the executor handler reaps its own-session user
+        # process), escalate to KILL for anything still alive at the
+        # grace deadline — teardown stays bounded either way
         for proc in procs:
             if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    self._kill_tree(proc)
+        # the KILL escalation waits STRICTLY LONGER than the executor's
+        # own 2s user-process grace (_terminate_user_proc): SIGKILLing
+        # the executor's group mid-grace would race its reap of the
+        # own-session user process — the orphan this ladder exists to
+        # prevent
+        for proc in procs:
+            try:
+                proc.wait(timeout=self.STOP_GRACE_SEC)
+            except subprocess.TimeoutExpired:
                 self._kill_tree(proc)
         for proc in procs:
             try:

@@ -36,6 +36,7 @@ NO_DEFAULT_KEYS = frozenset({
     K.TPU_MESH_AXES,
     K.CLUSTER_NODES,
     K.CLUSTER_SSH_OPTS,
+    K.PROXY_URL,
     K.HISTORY_LOCATION,
     K.HISTORY_INTERMEDIATE,
     K.HISTORY_FINISHED,
@@ -101,6 +102,12 @@ DEFAULTS = {
     # portal
     K.PORTAL_PORT: 19886,
     K.PORTAL_CACHE_MAX_ENTRIES: 1000,
+
+    # serving (serve/ subsystem knobs; read by python -m tony_tpu.serve)
+    K.SERVING_SLOTS: 4,
+    K.SERVING_TOKEN_BUDGET: 2048,
+    K.SERVING_QUEUE_DEPTH: 64,
+    K.SERVING_PORT: 0,           # 0 = executor-assigned $SERVING_PORT
 
     # docker
     K.DOCKER_ENABLED: False,

@@ -105,6 +105,27 @@ PORTAL_USER_TOKENS_FILE = "tony.portal.user-tokens-file"
 # other hosts publish jhist there; the reference's HDFS history dir)
 HISTORY_STORE_LOCATION = "tony.history.store-location"
 
+# --- serving (new: online inference jobtype, serve/ subsystem) -----------
+# `serving` is a REGULAR jobtype (declared via tony.serving.instances like
+# any other — deliberately NOT a reserved segment); these static keys are
+# the engine/frontend knobs its default command (python -m tony_tpu.serve)
+# reads from the frozen conf.
+SERVING_SLOTS = "tony.serving.slots"              # concurrent decode slots
+# per-slot prompt+generation budget (the static cache length; capped at
+# the model's max_seq at startup)
+SERVING_TOKEN_BUDGET = "tony.serving.token-budget"
+# bounded pending-request queue; a full queue answers HTTP 429
+SERVING_QUEUE_DEPTH = "tony.serving.queue-depth"
+# explicit HTTP port; 0 = the executor-assigned rendezvous port
+# ($SERVING_PORT), so the cluster-spec entry is the live endpoint
+SERVING_PORT = "tony.serving.port"
+
+# --- proxy ---------------------------------------------------------------
+# externally reachable base URL of an authenticated tony_tpu.proxy fronting
+# in-cluster HTTP endpoints (serving, notebook, TB). When set, the portal
+# links endpoints through it instead of the raw in-cluster host:port.
+PROXY_URL = "tony.proxy.url"
+
 # --- docker (reference: TonyConfigurationKeys.java:227-239,266-268) ------
 DOCKER_ENABLED = "tony.docker.enabled"
 DOCKER_IMAGE = "tony.docker.containers.image"

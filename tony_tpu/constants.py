@@ -39,6 +39,12 @@ CLUSTER_SPEC = "CLUSTER_SPEC"        # JSON {jobtype: ["host:port", ...]}
 TF_CONFIG = "TF_CONFIG"              # TF_CONFIG JSON (TFConfig.java:13-74)
 TB_PORT = "TB_PORT"                  # TensorBoard port, chief only
 
+# Serving (new in this build — no reference equivalent; the reference's
+# lifecycle ended at training): the port a `serving` task's HTTP frontend
+# must bind. Rendered by runtimes.render_framework_env from the task's own
+# cluster-spec entry, so the endpoint the AM gossips IS the live server.
+SERVING_PORT = "SERVING_PORT"
+
 # PyTorch (reference: Constants.java:50-54, Utils.parseClusterSpecForPytorch)
 INIT_METHOD = "INIT_METHOD"          # tcp://<worker0 host:port>
 RANK = "RANK"
@@ -101,6 +107,10 @@ SCHEDULER_JOB_NAME = "scheduler"     # MXNet
 SERVER_JOB_NAME = "server"           # MXNet
 NOTEBOOK_JOB_NAME = "notebook"
 DRIVER_JOB_NAME = "driver"
+SERVING_JOB_NAME = "serving"         # online inference (serve/ subsystem):
+                                     # default command = python -m
+                                     # tony_tpu.serve; endpoint recorded in
+                                     # the cluster spec + history events
 AM_NAME = "am"
 
 # ---------------------------------------------------------------------------

@@ -2,7 +2,7 @@
 
 from tony_tpu.events.schema import (
     Event, EventType, ApplicationInited, ApplicationFinished,
-    TaskStarted, TaskFinished,
+    ServingEndpointRegistered, TaskStarted, TaskFinished,
 )
 from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import (
@@ -11,6 +11,7 @@ from tony_tpu.events.history import (
 
 __all__ = [
     "Event", "EventType", "ApplicationInited", "ApplicationFinished",
-    "TaskStarted", "TaskFinished", "EventHandler",
+    "ServingEndpointRegistered", "TaskStarted", "TaskFinished",
+    "EventHandler",
     "JobMetadata", "history_file_name", "parse_history_file_name",
 ]

@@ -22,6 +22,7 @@ class EventType(str, enum.Enum):
     TASK_STARTED = "TASK_STARTED"
     TASK_FINISHED = "TASK_FINISHED"
     TASK_RELAUNCHED = "TASK_RELAUNCHED"
+    SERVING_ENDPOINT_REGISTERED = "SERVING_ENDPOINT_REGISTERED"
 
 
 @dataclass
@@ -65,6 +66,18 @@ class TaskRelaunched:
 
 
 @dataclass
+class ServingEndpointRegistered:
+    """No reference equivalent (the reference's lifecycle ended at
+    training): a `serving` task's HTTP frontend came up and announced its
+    endpoint. History carries it so the portal job page can render the
+    live URL (through the authenticated proxy when tony.proxy.url is
+    configured) long after the AM's in-memory record is gone."""
+    task_type: str
+    task_index: int
+    url: str
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -79,10 +92,11 @@ _PAYLOADS = {
     EventType.TASK_STARTED: TaskStarted,
     EventType.TASK_FINISHED: TaskFinished,
     EventType.TASK_RELAUNCHED: TaskRelaunched,
+    EventType.SERVING_ENDPOINT_REGISTERED: ServingEndpointRegistered,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
-                TaskFinished, TaskRelaunched]
+                TaskFinished, TaskRelaunched, ServingEndpointRegistered]
 
 
 @dataclass

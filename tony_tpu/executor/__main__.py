@@ -8,8 +8,11 @@ the user process's exit code.
 from __future__ import annotations
 
 import logging
+import os
+import signal
 import sys
 
+from tony_tpu import constants as C
 from tony_tpu.executor.task_executor import TaskExecutor
 
 
@@ -18,6 +21,26 @@ def main() -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     executor = TaskExecutor()
+
+    # Graceful container stop: the backend sends SIGTERM (escalating to
+    # SIGKILL) when the AM stops this container. The user process runs in
+    # its OWN session (launch_shell start_new_session=True), so dying
+    # without reaping it would orphan long-running workloads — a serving
+    # task's HTTP server would keep the port and the process forever.
+    # SIGTERM is forwarded to the user process group (short grace, then
+    # KILL), then this executor exits with the killed-by-AM code (the
+    # backend records EXIT_KILLED_BY_AM regardless; no result is
+    # registered, exactly like the previous hard-kill behavior).
+    def _on_sigterm(signum, frame):
+        logging.getLogger(__name__).warning(
+            "SIGTERM — stopping user process and exiting")
+        try:
+            executor._terminate_user_proc()
+        except Exception:  # noqa: BLE001 — nothing must block the exit
+            pass
+        os._exit(C.EXIT_KILLED_BY_AM & 0xFF)
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     return executor.run()
 
 

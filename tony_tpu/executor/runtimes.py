@@ -161,4 +161,13 @@ def render_framework_env(framework: str, cluster_spec: ClusterSpec,
     # TaskExecutor.java:161-167): role-based gangs (ray-style head/worker)
     # need gang visibility regardless of framework.
     env.setdefault(C.CLUSTER_SPEC, json.dumps(cluster_spec))
+    # serving tasks (serve/ subsystem) bind the port THIS task registered
+    # at the rendezvous barrier, so the endpoint the AM gossips in the
+    # cluster spec is the live HTTP server — framework-independent, like
+    # CLUSTER_SPEC above
+    if job_name == C.SERVING_JOB_NAME:
+        entries = cluster_spec.get(C.SERVING_JOB_NAME, [])
+        if 0 <= index < len(entries):
+            env.setdefault(C.SERVING_PORT,
+                           entries[index].rpartition(":")[2])
     return env

@@ -521,6 +521,24 @@ class TaskExecutor:
             except (ProcessLookupError, PermissionError):
                 proc.kill()
 
+    def _terminate_user_proc(self, grace_sec: float = 2.0) -> None:
+        """TERM the user process group and give it `grace_sec` to exit
+        cleanly before the KILL — long-running workloads (a serving task's
+        HTTP server) get their shutdown hooks; anything that ignores the
+        TERM dies exactly as before."""
+        proc = self._user_proc
+        if proc is None or proc.poll() is not None:
+            return
+        import signal
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            proc.terminate()
+        try:
+            proc.wait(timeout=grace_sec)
+        except Exception:  # noqa: BLE001 — TimeoutExpired and friends
+            self._kill_user_proc()
+
     def _report(self, exit_code: int, barrier_timeout: bool = False) -> None:
         if self.heartbeater is not None:
             self.heartbeater.stop()

@@ -122,10 +122,14 @@ def _cache_attention(q, k_cache, v_cache, cur_len: jax.Array,
     """Single-position attention against the cache.
 
     q: (B, H, 1, hd); caches: (B, Hkv, S_max, hd); positions >= cur_len
-    are masked. GQA grouped einsum — K/V never repeated."""
+    are masked. cur_len is a scalar (whole-batch decode) or (B,) per-row
+    lengths (continuous batching: every slot at its own position).
+    GQA grouped einsum — K/V never repeated."""
     b, nh, _, hd = q.shape
     nkv = k_cache.shape[1]
     rep = nh // nkv
+    if getattr(cur_len, "ndim", 0) == 1:
+        cur_len = cur_len[:, None, None, None]            # (B,1,1,1)
     qg = q.reshape(b, nkv, rep, hd).astype(jnp.float32) * hd ** -0.5
     scores = jnp.einsum("bgrd,bgsd->bgrs", qg,
                         k_cache.astype(jnp.float32))      # (B,G,rep,S)
@@ -191,18 +195,27 @@ def decode_step(params: Params, config: LlamaConfig,
                 cache: dict[str, jax.Array], token: jax.Array,
                 pos: jax.Array) -> tuple[jax.Array, dict[str, jax.Array]]:
     """One decode step. token: (B,) int32; pos: scalar int32 (the position
-    the token occupies). Returns (logits (B, V), updated cache). An int8
-    cache (prefill's quant_cache=True) is detected by tree structure —
-    a static property under jit, so both layouts share this function."""
+    the token occupies) or (B,) int32 per-row positions — the latter is
+    the continuous-batching shape (serve/engine.py), where every batch
+    row is an independent request slot at its own sequence position.
+    Returns (logits (B, V), updated cache). An int8 cache (prefill's
+    quant_cache=True) is detected by tree structure — a static property
+    under jit, so both layouts share this function."""
     quant = "k_scale" in cache
     cache_len = cache["k"].shape[3]
     cos, sin = rope_tables(config, cache_len)
-    cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
-    sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
+    # per-row positions take the gather form of RoPE (ops/rope.py
+    # `positions`); the scalar path keeps the original dynamic-slice —
+    # both read the identical table rows, so the math is bit-identical
+    per_row = getattr(pos, "ndim", 0) == 1
+    if not per_row:
+        cos_p = lax.dynamic_slice_in_dim(cos, pos, 1, axis=0)
+        sin_p = lax.dynamic_slice_in_dim(sin, pos, 1, axis=0)
     x = embed_lookup(params["embed"], token[:, None], config)  # (B, 1, D)
     b = x.shape[0]
 
-    offsets = jnp.broadcast_to(pos, (b,))
+    offsets = pos if per_row else jnp.broadcast_to(pos, (b,))
+    cur_len = pos + 1                     # (B,) or scalar — both broadcast
 
     def body(x, layer_and_cache):
         if quant:
@@ -213,15 +226,19 @@ def decode_step(params: Params, config: LlamaConfig,
         layer = dequantize_layer(layer)
         h = rms_norm(x, layer["attn_norm"], config.norm_eps)
         q, k, v = qkv_proj(h, layer, config)
-        q = apply_rope(q, cos_p, sin_p)
-        k = apply_rope(k, cos_p, sin_p)
+        if per_row:
+            q = apply_rope(q, cos, sin, positions=pos[:, None])
+            k = apply_rope(k, cos, sin, positions=pos[:, None])
+        else:
+            q = apply_rope(q, cos_p, sin_p)
+            k = apply_rope(k, cos_p, sin_p)
         # dequantized views feed straight into the attention einsums:
         # XLA fuses the int8 read + row scale into the operand load
         kc, vc, scales, k_eff, v_eff = write_cache_rows(
             kc, vc, (ksc, vsc) if quant else None, k, v, offsets)
         if quant:
             ksc, vsc = scales
-        attn = _cache_attention(q, k_eff, v_eff, pos + 1, config)
+        attn = _cache_attention(q, k_eff, v_eff, cur_len, config)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         x = x + jnp.einsum("bsh,hd->bsd", attn, layer["wo"])
         h = rms_norm(x, layer["mlp_norm"], config.norm_eps)

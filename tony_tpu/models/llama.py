@@ -205,9 +205,11 @@ def _attention_dispatch(q, k, v, config: LlamaConfig):
     running inside their own batch/heads shard_map (ops/attention.py
     _kernel_shard_axes) — a Mosaic custom call cannot be partitioned by
     XLA's Auto partitioner."""
-    from tony_tpu.ops.vma import manual_axes_of_context
+    from tony_tpu.ops.vma import (
+        ambient_abstract_mesh, manual_axes_of_context,
+    )
 
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_abstract_mesh()
     sp = mesh.shape.get("sp", 1) if mesh is not None and mesh.axis_names else 1
     if sp > 1:
         if config.sp_mode == "ulysses":

@@ -32,7 +32,10 @@ from jax import lax
 
 # vma plumbing for check_vma=True shard_map contexts (the pp pipeline):
 # pallas out_shapes and scan inits need explicit varying annotations
-from tony_tpu.ops.vma import match_vma as _like_vma, vma_of as _vma
+from tony_tpu.ops.vma import (
+    ambient_abstract_mesh, match_vma as _like_vma,
+    shape_dtype as _sds, vma_of as _vma,
+)
 
 # 512x512 measured 2.05x faster than 128x128 on v5e (28.7 vs 14.0 TF/s,
 # B4 H16 S4096 hd128 causal fwd) — bigger q blocks amortize the K/V stream
@@ -144,7 +147,7 @@ def _kernel_shard_axes(batch_dim: int, nh: int, nkv: int):
     dims are excluded."""
     from tony_tpu.ops.vma import manual_axes_of_context
 
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return (), ()
     manual = manual_axes_of_context()
@@ -194,7 +197,7 @@ def _shard_kernel_call(fn, args, n_in: int, n_out: int):
       stages."""
     from tony_tpu.ops.vma import manual_axes_of_context
 
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_abstract_mesh()
     if mesh is None or not mesh.axis_names or mesh.size == 1:
         return fn(*args)
     manual = manual_axes_of_context()
@@ -248,8 +251,8 @@ def _pallas_forward(q, k, v, causal, sm_scale, block_q, block_k, interpret,
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype, vma=_vma(q)),
-            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32, vma=_vma(q)),
+            _sds((bh, s, d), q.dtype, vma=_vma(q)),
+            _sds((bh, 1, s), jnp.float32, vma=_vma(q)),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -492,7 +495,7 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype, vma=_vma(q)),
+        out_shape=_sds((bh, s, d), q.dtype, vma=_vma(q)),
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta)
 
@@ -516,8 +519,8 @@ def _pallas_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype, vma=_vma(k)),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype, vma=_vma(k)),
+            _sds((bh, s, d), k.dtype, vma=_vma(k)),
+            _sds((bh, s, d), v.dtype, vma=_vma(k)),
         ],
         interpret=interpret,
     )(qf, kf, vf, gf, lse_f, delta)
@@ -548,6 +551,29 @@ _FORCE = os.environ.get("TONY_FLASH_FORCE", "")
 # CPU) run through every dispatch layer — segmentation, ring, GQA —
 # instead of only via direct _pallas_* calls
 _INTERPRET = os.environ.get("TONY_FLASH_INTERPRET", "") == "1"
+
+
+def _jax_minor() -> tuple[int, int]:
+    try:
+        major, minor = jax.__version__.split(".")[:2]
+        return int(major), int(minor)
+    except ValueError:           # dev/exotic version strings: assume new
+        return (999, 0)
+
+
+# jax < 0.5 lowers EVERY branch of platform_dependent's underlying cond on
+# the current platform, so the pallas branch explodes at CPU lowering
+# ("Only interpret mode is supported on CPU backend"). There is no
+# multi-platform AOT lowering to preserve on those builds — pick the
+# branch eagerly by the running backend instead.
+_EAGER_PLATFORM_PICK = _jax_minor() < (0, 5)
+
+
+def _platform_dispatch(*args, tpu, default):
+    if _EAGER_PLATFORM_PICK:
+        fn = tpu if jax.default_backend() in ("tpu", "axon") else default
+        return fn(*args)
+    return lax.platform_dependent(*args, tpu=tpu, default=default)
 
 
 # Largest LOCAL sequence whose whole K/V rows the pallas kernels may
@@ -628,8 +654,8 @@ def _forward(q, k, v, causal, sm_scale, block_q, block_k, kv_len):
             return pallas_fwd(qs, ks, vs)
         if eff == "blockwise":
             return blockwise_fwd(qs, ks, vs)
-        return lax.platform_dependent(qs, ks, vs, tpu=pallas_fwd,
-                                      default=blockwise_fwd)
+        return _platform_dispatch(qs, ks, vs, tpu=pallas_fwd,
+                                  default=blockwise_fwd)
 
     def dispatch(qs, ks, vs, force=""):
         eff = force or _FORCE
@@ -679,7 +705,7 @@ def _backward_dispatch(q, k, v, out, lse, g, causal, sm_scale, block_q,
             return pallas_bwd(*args)
         if eff == "blockwise":
             return blockwise_bwd(*args)
-        return lax.platform_dependent(*args, tpu=pallas_bwd,
+        return _platform_dispatch(*args, tpu=pallas_bwd,
                                       default=blockwise_bwd)
 
     def dispatch(qs, ks, vs, outs, lses, gs, force=""):

@@ -20,9 +20,38 @@ import jax
 from jax import lax
 
 
+def ambient_abstract_mesh():
+    """The ambient (jax.set_mesh) abstract mesh, or None when none is
+    active. ONE compat seam for every mesh-dispatch site: on jax builds
+    that predate the `jax.sharding.get_abstract_mesh` API (< 0.5.x, e.g.
+    the CPU CI image's 0.4.37) there is no ambient-mesh concept to query,
+    which is exactly the single-device "no mesh" answer — so the whole
+    model stack (flash attention, constrain, decode/serve) degrades to
+    local semantics instead of dying with AttributeError at trace time."""
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    return get() if get is not None else None
+
+
 def vma_of(x) -> frozenset:
-    """The operand's varying-manual-axes set (empty outside shard_map)."""
-    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    """The operand's varying-manual-axes set (empty outside shard_map —
+    and always empty on pre-typeof jax builds, which also predate
+    check_vma shard_map and so can never be inside a vma context)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return getattr(typeof(x), "vma", frozenset()) or frozenset()
+
+
+def shape_dtype(shape, dtype, vma: frozenset = frozenset()):
+    """jax.ShapeDtypeStruct carrying `vma` when the running jax supports
+    the kwarg; plain struct otherwise (old jax has no vma contexts, and
+    the set is necessarily empty there)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset())
+    except TypeError:        # jax < vma-aware ShapeDtypeStruct
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def varying_over(x: jax.Array, axis_name: str) -> jax.Array:
@@ -41,7 +70,7 @@ def match_vma(x: jax.Array, ref) -> jax.Array:
 
 def manual_axes_of_context() -> frozenset:
     """Mesh axes the ambient context holds Manually (inside shard_map)."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_abstract_mesh()
     if mesh is None or not mesh.axis_names:
         return frozenset()
     return frozenset(

@@ -211,14 +211,50 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _jobs(self, job_id: str) -> None:
         rows = []
-        for ev in self.cache.get_events(job_id):
+        events = self.cache.get_events(job_id)
+        for ev in events:
             rows.append([
                 _fmt_ts(ev["timestamp"]),
                 html.escape(ev["type"]),
                 html.escape(json.dumps(ev["payload"])),
             ])
         self._html(f"events — {job_id}",
-                   _table(["Time", "Event", "Payload"], rows))
+                   self._serving_endpoints_html(job_id, events)
+                   + _table(["Time", "Event", "Payload"], rows))
+
+    def _serving_endpoints_html(self, job_id: str, events: list) -> str:
+        """Registered serving endpoints as links above the event table —
+        previously a serving job's page showed nothing actionable. With
+        tony.proxy.url configured the link goes THROUGH the authenticated
+        proxy (the raw in-cluster address stays visible as text, since
+        the browser usually can't reach it directly)."""
+        # last event per task wins: a relaunched serving task re-registers
+        # at a fresh port, and the dead predecessor's URL must not render
+        # next to the live one
+        by_task: dict[tuple, dict] = {}
+        for ev in events:
+            if ev["type"] == "SERVING_ENDPOINT_REGISTERED":
+                p = ev["payload"]
+                by_task[(p.get("task_type"), p.get("task_index"))] = p
+        endpoints = list(by_task.values())
+        if not endpoints:
+            return ""
+        proxy = str(self.cache.get_config(job_id).get(
+            "tony.proxy.url", "") or "")
+        items = []
+        for p in endpoints:
+            task = html.escape(f'{p.get("task_type", "serving")}:'
+                               f'{p.get("task_index", 0)}')
+            url = str(p.get("url", ""))
+            if proxy:
+                items.append(
+                    f'<li>{task}: <a href="{html.escape(proxy)}">'
+                    f'{html.escape(url)}</a> (via proxy)</li>')
+            else:
+                items.append(f'<li>{task}: <a href="{html.escape(url)}">'
+                             f'{html.escape(url)}</a></li>')
+        return ("<h3>Serving endpoints</h3><ul>"
+                + "".join(items) + "</ul>")
 
     def _config(self, job_id: str) -> None:
         conf = self.cache.get_config(job_id)

@@ -114,7 +114,8 @@ class _JsonRpcClient:
 
 
 class ClusterServiceClient(_JsonRpcClient):
-    """Client for the 7-method cluster control plane."""
+    """Client for the cluster control plane (the reference's 7 RPCs +
+    register_serving_endpoint)."""
 
     def __init__(self, host: str, port: int, **kw):
         super().__init__(CLUSTER_SERVICE, CLUSTER_METHODS, host, port, **kw)
@@ -151,6 +152,12 @@ class ClusterServiceClient(_JsonRpcClient):
 
     def register_tensorboard_url(self, task_id: str, url: str) -> None:
         self.call("register_tensorboard_url", {"task_id": task_id, "url": url})
+
+    def register_serving_endpoint(self, task_id: str, url: str) -> None:
+        """A serving task announces its live HTTP endpoint (serve/):
+        recorded by the AM in history + task infos."""
+        self.call("register_serving_endpoint",
+                  {"task_id": task_id, "url": url})
 
     def register_execution_result(self, exit_code: int, job_name: str,
                                   job_index: int, session_id: int,

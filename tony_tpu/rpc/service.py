@@ -25,12 +25,15 @@ CLUSTER_SERVICE = "tony.ClusterService"
 METRICS_SERVICE = "tony.MetricsService"
 
 # The 7 methods of the reference's TensorFlowClusterService, same names
-# modulo snake_case (proto/tensorflow_cluster_service_protos.proto:11-20).
+# modulo snake_case (proto/tensorflow_cluster_service_protos.proto:11-20),
+# plus register_serving_endpoint (new: the serving jobtype announces its
+# HTTP endpoint — the inference-side sibling of register_tensorboard_url).
 CLUSTER_METHODS = (
     "get_task_infos",
     "get_cluster_spec",
     "register_worker_spec",
     "register_tensorboard_url",
+    "register_serving_endpoint",
     "register_execution_result",
     "finish_application",
     "task_executor_heartbeat",
@@ -70,6 +73,12 @@ class ClusterServiceHandler(abc.ABC):
     @abc.abstractmethod
     def register_tensorboard_url(self, req: dict) -> dict:
         """req: {task_id, url} -> {}."""
+
+    @abc.abstractmethod
+    def register_serving_endpoint(self, req: dict) -> dict:
+        """req: {task_id, url} -> {}. A serving task's HTTP frontend came
+        up at `url`; the AM records it (history event + task infos) so the
+        portal/proxy/client can reach the endpoint."""
 
     @abc.abstractmethod
     def register_execution_result(self, req: dict) -> dict:

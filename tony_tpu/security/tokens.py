@@ -125,6 +125,7 @@ TASK_METHOD_IDENTITY = {
     "get_cluster_spec": ("task_id",),
     "register_worker_spec": ("task_id",),
     "register_tensorboard_url": ("task_id",),
+    "register_serving_endpoint": ("task_id",),
     "task_executor_heartbeat": ("task_id",),
     "register_execution_result": ("job_name", "job_index"),
     "update_metrics": ("task_type", "index"),

@@ -1,0 +1,33 @@
+"""Online serving subsystem: continuous-batching inference over the
+static-shape decode core (models/generate.py), fronted by an HTTP server
+and submitted through the orchestrator as a first-class `serving` jobtype.
+
+The reference orchestrated training and stopped there (docs/SERVING.md:
+"serving was someone else's stack"); this package completes the lifecycle:
+train → checkpoint → `tony.serving.instances=1` → live endpoint registered
+with the AM, metrics on the portal, traffic through the proxy.
+
+Exports resolve lazily (PEP 562): the engine pulls in jax and the model
+stack, and `python -m tony_tpu.serve --help` (or any control-plane import
+of this package) must not pay — or fail on — a jax import just to parse
+flags.
+"""
+
+_EXPORTS = {
+    "BudgetExceededError": "tony_tpu.serve.engine",
+    "ContinuousBatchingEngine": "tony_tpu.serve.engine",
+    "QueueFullError": "tony_tpu.serve.engine",
+    "RequestHandle": "tony_tpu.serve.engine",
+    "ServeFrontend": "tony_tpu.serve.frontend",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(module), name)

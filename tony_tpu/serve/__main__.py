@@ -1,0 +1,196 @@
+"""Serving task entry: ``python -m tony_tpu.serve``.
+
+The default command of the ``serving`` jobtype (AM fills it in when no
+per-jobtype command is configured). Inside an orchestrated container it:
+
+- reads the frozen conf (``TONY_CONF_PATH``) for the ``tony.serving.*``
+  knobs (slots, token budget, queue depth, port) — CLI flags override;
+- binds the executor-registered rendezvous port (``SERVING_PORT``), so the
+  endpoint in the AM's cluster spec IS the live HTTP endpoint;
+- registers the endpoint URL with the AM (``register_serving_endpoint``),
+  which records it as a history event and surfaces it in task infos and on
+  the portal job page;
+- pushes serving metrics (TTFT, inter-token latency, queue depth, slot
+  occupancy, tokens/sec) through the same metrics RPC the trainer uses;
+- shuts down cleanly on SIGTERM (the executor's graceful container stop):
+  frontend first, then the engine — no orphan process, no held port.
+
+Standalone (no orchestrator env) it is a plain local server: all the same
+flags, no registration, metrics exposed on ``/v1/metrics`` only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+LOG = logging.getLogger(__name__)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tony_tpu.serve")
+    p.add_argument("--config", default="tiny",
+                   help="model preset (models/llama.py PRESETS / MoE)")
+    p.add_argument("--checkpoint-dir", default="",
+                   help="restore params from the latest checkpoint here "
+                        "(the examples/llama-pretrain format)")
+    p.add_argument("--quant", default="", choices=("", "int8"),
+                   help="int8 weight-only decode (models/quant.py)")
+    p.add_argument("--quant-cache", action="store_true",
+                   help="per-row int8 KV cache for the shared slot cache")
+    p.add_argument("--slots", type=int, default=0,
+                   help="decode slots (0 = tony.serving.slots)")
+    p.add_argument("--token-budget", type=int, default=0,
+                   help="per-slot prompt+generation budget "
+                        "(0 = tony.serving.token-budget, capped at "
+                        "config.max_seq)")
+    p.add_argument("--queue-depth", type=int, default=0,
+                   help="bounded pending-request queue "
+                        "(0 = tony.serving.queue-depth)")
+    p.add_argument("--port", type=int, default=-1,
+                   help="HTTP port (-1 = tony.serving.port, else the "
+                        "executor-assigned $SERVING_PORT, else ephemeral)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help="eos token id latching a row (-1 = none)")
+    return p
+
+
+def _load_model(args):
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models.moe import is_moe_preset
+
+    if is_moe_preset(args.config):
+        from tony_tpu.models.moe import get_moe_config, moe_init
+        base = get_moe_config(args.config)
+        # no-drop capacity: serve-side decode equals the training forward
+        # (models/generate._mlp docstring)
+        config = get_moe_config(args.config, capacity_factor=max(
+            base.capacity_factor, base.n_experts / base.top_k))
+        params = moe_init(config, jax.random.PRNGKey(0))
+    else:
+        from tony_tpu.models.llama import get_config, llama_init
+        config = get_config(args.config)
+        params = llama_init(config, jax.random.PRNGKey(0))
+    if args.checkpoint_dir:
+        from tony_tpu.train.checkpoint import latest_step, restore_checkpoint
+        step = latest_step(args.checkpoint_dir)
+        if step is None:
+            raise SystemExit(f"no checkpoint in {args.checkpoint_dir}")
+        state = restore_checkpoint(args.checkpoint_dir, step)
+        params = jax.tree.map(jnp.asarray, state["params"])
+        LOG.info("restored checkpoint step %d from %s", step,
+                 args.checkpoint_dir)
+    if args.quant == "int8":
+        from tony_tpu.models.quant import quantize_params
+        params = quantize_params(params)
+        LOG.info("int8 weight-only params")
+    return params, config
+
+
+def _register_endpoint(url: str, env) -> None:
+    """Tell the AM where this server listens (no-op outside the
+    orchestrator). Same lazily-available env contract as the trainer's
+    metrics reporter."""
+    from tony_tpu import constants as C
+    host, port = env.get(C.AM_HOST), env.get(C.AM_PORT)
+    if not host or not port:
+        return
+    from tony_tpu.rpc.client import ClusterServiceClient
+    from tony_tpu.security.tokens import TOKEN_ENV
+    task_id = f"{env.get(C.JOB_NAME, 'serving')}:{env.get(C.TASK_INDEX, '0')}"
+    token = env.get(TOKEN_ENV) or None
+    client = ClusterServiceClient(host, int(port), auth_token=token,
+                                  task_auth_id=task_id if token else None)
+    try:
+        client.register_serving_endpoint(task_id, url)
+        LOG.info("registered serving endpoint %s with the AM", url)
+    except Exception:  # noqa: BLE001 — registration is observability
+        LOG.exception("failed to register serving endpoint")
+    finally:
+        client.close()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    args = build_arg_parser().parse_args(argv)
+    env = os.environ
+
+    from tony_tpu import constants as C
+    from tony_tpu.conf import TonyConfiguration, keys as K
+    conf_path = env.get(C.TONY_CONF_PATH, "")
+    conf = (TonyConfiguration.read(conf_path)
+            if conf_path and os.path.exists(conf_path)
+            else TonyConfiguration())
+
+    slots = args.slots or conf.get_int(K.SERVING_SLOTS, 4)
+    queue_depth = args.queue_depth or conf.get_int(K.SERVING_QUEUE_DEPTH, 64)
+    port = args.port
+    if port < 0:
+        port = conf.get_int(K.SERVING_PORT, 0) \
+            or int(env.get(C.SERVING_PORT, "0") or 0)
+
+    params, config = _load_model(args)
+    # capped at the model's max_seq on BOTH paths (flag and conf) — the
+    # documented contract; an oversized ask serves at max_seq instead of
+    # crashing the container
+    token_budget = min(
+        args.token_budget or conf.get_int(K.SERVING_TOKEN_BUDGET, 2048),
+        config.max_seq)
+
+    from tony_tpu.serve.engine import ContinuousBatchingEngine
+    from tony_tpu.serve.frontend import ServeFrontend
+    engine = ContinuousBatchingEngine(
+        params, config, n_slots=slots, token_budget=token_budget,
+        queue_depth=queue_depth, temperature=args.temperature,
+        top_k=args.top_k, top_p=args.top_p,
+        eos_id=args.eos_id if args.eos_id >= 0 else None,
+        quant_cache=args.quant_cache)
+    engine.start()
+    frontend = ServeFrontend(engine, port=port, host=args.host)
+    frontend.start()
+
+    from tony_tpu.utils.common import current_host
+    url = f"http://{current_host()}:{frontend.port}"
+    # greppable bring-up marker (e2e tests + operators tailing logs)
+    print(f"SERVING_UP {url}", flush=True)
+    _register_endpoint(url, env)
+
+    from tony_tpu.train.metrics import ServingMetricsReporter
+    reporter = ServingMetricsReporter(
+        engine.metrics,
+        interval_sec=conf.get_time_ms(K.TASK_METRICS_INTERVAL_MS,
+                                      5000) / 1000.0)
+    reporter.start()
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        LOG.info("signal %d — shutting down serving", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    try:
+        stop.wait()
+    finally:
+        reporter.close()
+        frontend.stop()
+        engine.stop()
+        LOG.info("serving stopped cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,543 @@
+"""Continuous-batching inference engine over the static-shape decode core.
+
+The concurrency-at-fixed-shapes discipline of the TPU training stack
+(PAPERS.md: "Exploring the limits of Concurrency in ML Training on Google
+TPUs") applied to online traffic: ONE persistent jitted decode step at a
+fixed `(n_slots, token_budget)` shape, forever. Requests flow through it
+without ever changing a shape:
+
+- **Admission**: a request is admitted by prefilling its prompt (batch 1,
+  the same `prefill` the offline path uses) and `dynamic_update_slice`-ing
+  the resulting per-layer K/V into its slot's rows of the shared static
+  cache `(L, n_slots, Hkv, token_budget, hd)`. One compile per distinct
+  prompt length — exactly the offline `generate()` compile discipline.
+- **Decode**: every engine step runs `decode_step` over ALL slots with
+  per-row positions (each slot at its own sequence length); rows are
+  independent, so an active slot's tokens are bit-identical to decoding
+  that request alone — and therefore to the offline `generate()` oracle
+  (pinned by tests/test_serve.py, staggered arrivals included).
+- **Latch + recycle**: per-slot eos/budget latches run host-side on the
+  sampled tokens; the moment a row finishes its slot is recycled for the
+  next queued request. Garbage K/V an idle slot may write is always masked
+  (positions >= the slot's length) and overwritten by the next admission
+  or decode write, so recycling needs no cache scrubbing.
+
+Composes with the offline path's levers: int8 KV cache (`quant_cache`,
+shared `write_cache_rows`), int8 weights (quantized params pass straight
+through), and the MoE/dense MLP dispatch in `models/generate._mlp` (MoE at
+no-drop capacity routes each token independently, preserving row
+independence).
+
+Sampling: greedy (`temperature=0`) is THE contract — bit-identical to
+offline greedy. Temperature/top-k/top-p are engine-wide settings (one
+compiled step, not per-request variants); sampled streams draw per-step
+keys and are reproducible per (seed, admission order) but intentionally
+not pinned against the offline oracle.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tony_tpu.models.generate import (
+    _sample, _warn_moe_below_capacity, decode_step, prefill,
+)
+from tony_tpu.models.llama import LlamaConfig, Params
+
+LOG = logging.getLogger(__name__)
+
+_DONE = object()
+
+
+class QueueFullError(RuntimeError):
+    """Pending-request queue (or its token budget) is full — backpressure;
+    the frontend maps this to HTTP 429."""
+
+
+class BudgetExceededError(ValueError):
+    """prompt + max_new_tokens exceeds the engine's per-slot token budget —
+    a permanent rejection (429 retries would never help); HTTP 400."""
+
+
+class RequestHandle:
+    """Caller-side view of one request: a thread-safe token stream plus
+    completion state and latency timestamps (TTFT / inter-token)."""
+
+    def __init__(self, request_id: int, prompt: list[int],
+                 max_new_tokens: int):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.tokens: list[int] = []
+        self.finish_reason: Optional[str] = None   # "eos"|"length"|"shutdown"
+        self.submitted_at = time.monotonic()
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.done = threading.Event()
+        self.cancelled = threading.Event()
+        self._queue: "queue.Queue" = queue.Queue()
+
+    # engine side -------------------------------------------------------
+    def _push(self, token: int, now: float) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = now
+        self.tokens.append(token)
+        self._queue.put(token)
+
+    def _finish(self, reason: str, now: float) -> None:
+        self.finish_reason = reason
+        self.finished_at = now
+        self.done.set()
+        self._queue.put(_DONE)
+
+    # caller side -------------------------------------------------------
+    def cancel(self) -> None:
+        """Abandon this request: a pending request is dropped at admission
+        time, an in-flight one frees its slot at the next step boundary —
+        a timed-out or disconnected client must not keep the engine
+        generating tokens nobody is waiting on."""
+        self.cancelled.set()
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    def iter_tokens(self, timeout: Optional[float] = None):
+        """Yield tokens as they are generated; returns on completion.
+        Raises TimeoutError when the stream stalls past `timeout`."""
+        while True:
+            try:
+                item = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"request {self.request_id}: no token within "
+                    f"{timeout}s") from None
+            if item is _DONE:
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> list[int]:
+        """Block until the request finishes; returns all generated tokens."""
+        if not self.done.wait(timeout=timeout):
+            raise TimeoutError(f"request {self.request_id} not done "
+                               f"within {timeout}s")
+        return list(self.tokens)
+
+
+@dataclass
+class _Slot:
+    index: int
+    handle: Optional[RequestHandle] = None
+    pos: int = 0          # next cache position the decode writes at
+    emitted: int = 0      # generated tokens so far (incl. the prefill one)
+    last_emit_at: float = 0.0   # inter-token latency anchor
+
+    @property
+    def active(self) -> bool:
+        return self.handle is not None
+
+
+@dataclass
+class EngineStats:
+    """Aggregate serving metrics, guarded by the engine lock. Percentile
+    sources are bounded deques — a gauge window, not an unbounded log."""
+    tokens_emitted: int = 0
+    requests_finished: int = 0
+    queue_depth_max: int = 0
+    started_at: float = field(default_factory=time.monotonic)
+    ttft_s: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=512))
+    itl_s: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=2048))
+
+
+def _percentile(samples, q: float) -> Optional[float]:
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels (module level: one compile cache per (config, shapes))
+# ---------------------------------------------------------------------------
+
+# the shared cache is DONATED through both jitted kernels: the caller
+# rebinds self._cache to the output every call, and without donation XLA
+# would allocate + copy the full multi-GB static cache per decoded token
+# (on backends without buffer donation — CPU tests — jax warns and copies,
+# which is the pre-donation behavior)
+@partial(jax.jit, static_argnames=("config", "temperature", "top_k",
+                                   "top_p"), donate_argnames=("cache",))
+def _decode_sample_step(params: Params, config: LlamaConfig, cache,
+                        tokens: jax.Array, pos: jax.Array, key: jax.Array,
+                        temperature: float, top_k: int, top_p: float):
+    """One continuous-batching step: decode every slot's previous token at
+    its own position, sample the next. ONE compile per (config, n_slots,
+    token_budget) — slot occupancy, positions, and request boundaries are
+    all data, never shapes."""
+    logits, cache = decode_step(params, config, cache, tokens, pos)
+    nxt = _sample(logits, temperature, top_k, key, top_p)
+    return nxt, cache
+
+
+@partial(jax.jit, static_argnames=("config", "temperature", "top_k",
+                                   "top_p", "quant_cache"),
+         donate_argnames=("cache",))
+def _admit_step(params: Params, config: LlamaConfig, cache,
+                prompt: jax.Array, slot: jax.Array, key: jax.Array,
+                temperature: float, top_k: int, top_p: float,
+                quant_cache: bool):
+    """Admission: prefill one prompt (batch 1) at the full token budget and
+    dynamic_update_slice its K/V (+ scales when int8) into the shared
+    cache's `slot` row. Returns (first sampled token, cache). One compile
+    per distinct prompt length — the slot index is data."""
+    cache_len = cache["k"].shape[3]
+    logits, pc = prefill(params, prompt[None, :], config, cache_len,
+                         quant_cache=quant_cache)
+    out = {}
+    for name, arr in cache.items():
+        row = pc[name].astype(arr.dtype)               # (L, 1, Hkv, S, d)
+        out[name] = lax.dynamic_update_slice_in_dim(arr, row, slot, axis=1)
+    tok0 = _sample(logits, temperature, top_k, key, top_p)[0]
+    return tok0, out
+
+
+def decode_step_cache_size() -> int:
+    """Compile count of the persistent decode step (all configs) — the
+    zero-recompile contract's measurement hook (tests/test_serve.py pins
+    that a staggered workload adds no entries after warmup)."""
+    return _decode_sample_step._cache_size()
+
+
+def admit_step_cache_size() -> int:
+    return _admit_step._cache_size()
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+class ContinuousBatchingEngine:
+    """Slot-managed online decode over one shared static KV cache.
+
+    Thread model: `submit()` is called from any number of frontend threads;
+    a single loop thread (`start()`) — or a test driving `step()` directly —
+    owns the device state. The lock guards only the pending queue, slot
+    table, and stats; device arrays are touched exclusively by the stepper.
+    """
+
+    def __init__(self, params: Params, config: LlamaConfig,
+                 n_slots: int = 4, token_budget: int = 0,
+                 queue_depth: int = 64, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_id: Optional[int] = None, quant_cache: bool = False,
+                 seed: int = 0, queue_token_budget: int = 0):
+        if token_budget <= 0:
+            token_budget = config.max_seq
+        if token_budget > config.max_seq:
+            raise ValueError(f"token_budget {token_budget} exceeds "
+                             f"config.max_seq {config.max_seq}")
+        # queued-WORK bound next to the request-count bound: half-budget
+        # average request size by default, so a few near-budget requests
+        # shed load as early as many small ones (a pure count bound lets
+        # queue_depth maximal requests hide an unbounded latency backlog)
+        if queue_token_budget <= 0:
+            queue_token_budget = max(token_budget,
+                                     queue_depth * token_budget // 2)
+        self.queue_token_budget = queue_token_budget
+        _warn_moe_below_capacity(config, who="serve")
+        self.params = params
+        self.config = config
+        self.n_slots = n_slots
+        self.token_budget = token_budget
+        self.queue_depth = queue_depth
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.quant_cache = quant_cache
+        self._cache = self._empty_cache()
+        self._key = jax.random.PRNGKey(seed)
+        # host mirrors of the per-slot device state; re-uploaded per step
+        # (a (B,) int32 H2D per token — noise next to the decode itself)
+        self._tokens_np = np.zeros((n_slots,), np.int32)
+        self._pos_np = np.zeros((n_slots,), np.int32)
+        self._slots = [_Slot(i) for i in range(n_slots)]
+        self._pending: collections.deque[RequestHandle] = collections.deque()
+        self._pending_tokens = 0   # queued prompt+max_new total
+        self._next_id = itertools.count()
+        self._lock = threading.Lock()
+        self._work = threading.Event()      # submit() kicks the loop
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = EngineStats()
+
+    def _empty_cache(self) -> dict[str, jax.Array]:
+        """Zero cache in prefill's exact tree layout (quant included) so
+        decode_step's structure-based int8 detection sees the same tree
+        the offline path builds."""
+        c = self.config
+        shape = (c.n_layers, self.n_slots, c.n_kv_heads,
+                 self.token_budget, c.head_dim)
+        if self.quant_cache:
+            scale = shape[:-1] + (1,)
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(scale, jnp.float32),
+                    "v_scale": jnp.zeros(scale, jnp.float32)}
+        return {"k": jnp.zeros(shape, c.dtype),
+                "v": jnp.zeros(shape, c.dtype)}
+
+    # -- intake ---------------------------------------------------------
+    def submit(self, prompt: list[int],
+               max_new_tokens: int) -> RequestHandle:
+        """Enqueue a request. Raises BudgetExceededError when it can never
+        fit a slot, QueueFullError when the bounded queue (or its token
+        budget) is full — the backpressure the frontend turns into 429."""
+        if max_new_tokens < 1:
+            raise BudgetExceededError("max_new_tokens must be >= 1")
+        if not prompt:
+            raise BudgetExceededError("empty prompt")
+        vocab = self.config.vocab_size
+        if any(t < 0 or t >= vocab for t in prompt):
+            # jax's gather would silently clamp an out-of-range id into a
+            # wrong embedding — a tokenizer bug must be a 400, not garbage
+            raise BudgetExceededError(
+                f"prompt contains token ids outside [0, {vocab})")
+        need = len(prompt) + max_new_tokens
+        if need > self.token_budget:
+            raise BudgetExceededError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"the per-slot token budget {self.token_budget}")
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("engine is stopped")
+            if len(self._pending) >= self.queue_depth:
+                raise QueueFullError(
+                    f"request queue full ({self.queue_depth} pending)")
+            if self._pending_tokens + need > self.queue_token_budget:
+                raise QueueFullError(
+                    f"queued token budget exhausted "
+                    f"({self._pending_tokens} of "
+                    f"{self.queue_token_budget} tokens pending)")
+            handle = RequestHandle(next(self._next_id), list(prompt),
+                                   max_new_tokens)
+            self._pending.append(handle)
+            self._pending_tokens += need
+            self.stats.queue_depth_max = max(self.stats.queue_depth_max,
+                                             len(self._pending))
+        self._work.set()
+        return handle
+
+    def queue_size(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def active_slots(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s.active)
+
+    # -- stepping -------------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: reap cancelled slots, admit as many queued
+        requests as there are free slots, then decode every active slot one
+        token. Returns True when any work happened (the loop's idle
+        signal)."""
+        reaped = False
+        for slot in self._slots:
+            if slot.active and slot.handle.cancelled.is_set():
+                self._finish_slot(slot, "cancelled", time.monotonic())
+                reaped = True
+        admitted = self._admit_pending() or reaped
+        active = [s for s in self._slots if s.active]
+        if not active:
+            return admitted
+        self._key, step_key = jax.random.split(self._key)
+        nxt, self._cache = _decode_sample_step(
+            self.params, self.config, self._cache,
+            jnp.asarray(self._tokens_np), jnp.asarray(self._pos_np),
+            step_key, self.temperature, self.top_k, self.top_p)
+        nxt_np = np.asarray(jax.device_get(nxt))
+        now = time.monotonic()
+        for slot in active:
+            token = int(nxt_np[slot.index])
+            slot.pos += 1
+            self._pos_np[slot.index] = slot.pos
+            self._tokens_np[slot.index] = token
+            slot.emitted += 1
+            slot.handle._push(token, now)
+            with self._lock:
+                self.stats.tokens_emitted += 1
+                self.stats.itl_s.append(now - slot.last_emit_at)
+            slot.last_emit_at = now
+            self._maybe_finish(slot, token, now)
+        return True
+
+    def _admit_pending(self) -> bool:
+        admitted = False
+        while True:
+            free = next((s for s in self._slots if not s.active), None)
+            if free is None:
+                return admitted
+            with self._lock:
+                if not self._pending:
+                    return admitted
+                handle = self._pending.popleft()
+                self._pending_tokens -= (len(handle.prompt)
+                                         + handle.max_new_tokens)
+            if handle.cancelled.is_set():
+                # dropped while still queued: no prefill is ever paid
+                handle._finish("cancelled", time.monotonic())
+                admitted = True
+                continue
+            self._admit(free, handle)
+            admitted = True
+
+    def _admit(self, slot: _Slot, handle: RequestHandle) -> None:
+        self._key, req_key = jax.random.split(self._key)
+        prompt = jnp.asarray(handle.prompt, jnp.int32)
+        tok0_dev, self._cache = _admit_step(
+            self.params, self.config, self._cache, prompt,
+            jnp.int32(slot.index), req_key, self.temperature, self.top_k,
+            self.top_p, self.quant_cache)
+        tok0 = int(jax.device_get(tok0_dev))
+        now = time.monotonic()
+        handle.admitted_at = now
+        slot.handle = handle
+        slot.pos = len(handle.prompt)
+        slot.emitted = 1
+        slot.last_emit_at = now
+        self._pos_np[slot.index] = slot.pos
+        self._tokens_np[slot.index] = tok0
+        handle._push(tok0, now)
+        with self._lock:
+            self.stats.tokens_emitted += 1
+            self.stats.ttft_s.append(now - handle.submitted_at)
+        LOG.debug("admitted request %d into slot %d (prompt %d, max_new "
+                  "%d)", handle.request_id, slot.index, len(handle.prompt),
+                  handle.max_new_tokens)
+        self._maybe_finish(slot, tok0, now)
+
+    def _maybe_finish(self, slot: _Slot, token: int, now: float) -> None:
+        """Per-slot eos/length latch + immediate slot recycling."""
+        reason = None
+        if self.eos_id is not None and token == self.eos_id:
+            reason = "eos"
+        elif slot.emitted >= slot.handle.max_new_tokens:
+            reason = "length"
+        if reason is not None:
+            self._finish_slot(slot, reason, now)
+
+    def _finish_slot(self, slot: _Slot, reason: str, now: float) -> None:
+        """Free a slot (eos/length latch, or a cancelled request) and
+        recycle it immediately."""
+        handle, slot.handle = slot.handle, None
+        # park the freed slot's decode writes at the last budget row:
+        # always masked for the next occupant until its own decode
+        # overwrites it
+        slot.pos = self.token_budget - 1
+        self._pos_np[slot.index] = slot.pos
+        handle._finish(reason, now)
+        with self._lock:
+            self.stats.requests_finished += 1
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-engine", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                busy = self.step()
+            except Exception:  # noqa: BLE001 — a poisoned step must not
+                LOG.exception("engine step failed")    # wedge the server
+                busy = False
+            if not busy:
+                self._work.wait(timeout=0.02)
+                self._work.clear()
+
+    def stop(self) -> None:
+        """Stop the loop and fail outstanding work (pending AND in-flight)
+        with finish_reason='shutdown' so no caller blocks forever."""
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        now = time.monotonic()
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+            self._pending_tokens = 0
+        for handle in pending:
+            handle._finish("shutdown", now)
+        for slot in self._slots:
+            if slot.active:
+                handle, slot.handle = slot.handle, None
+                handle._finish("shutdown", now)
+
+    # -- observability --------------------------------------------------
+    def snapshot(self) -> dict:
+        """Serving gauges for /v1/metrics, the metrics-RPC pusher, and the
+        bench: TTFT, inter-token latency, queue depth, slot occupancy,
+        tokens/sec."""
+        with self._lock:
+            active = sum(1 for s in self._slots if s.active)
+            depth = len(self._pending)
+            elapsed = max(time.monotonic() - self.stats.started_at, 1e-9)
+            snap = {
+                "tokens_emitted": self.stats.tokens_emitted,
+                "requests_finished": self.stats.requests_finished,
+                "tokens_per_sec": self.stats.tokens_emitted / elapsed,
+                "queue_depth": depth,
+                "queue_depth_max": self.stats.queue_depth_max,
+                "active_slots": active,
+                "n_slots": self.n_slots,
+                "slot_occupancy_pct": 100.0 * active / self.n_slots,
+                "ttft_p50_s": _percentile(self.stats.ttft_s, 0.50),
+                "ttft_p95_s": _percentile(self.stats.ttft_s, 0.95),
+                "itl_p50_ms": None,
+                "token_budget": self.token_budget,
+            }
+            itl = _percentile(self.stats.itl_s, 0.50)
+            if itl is not None:
+                snap["itl_p50_ms"] = itl * 1000.0
+            return snap
+
+    def metrics(self) -> list[dict]:
+        """snapshot() as AM metric dicts ({name, value}) — the shape
+        train/metrics.py pushes and the MetricsStore ingests."""
+        names = {
+            "tokens_per_sec": "SERVING_TOKENS_PER_SEC",
+            "queue_depth": "SERVING_QUEUE_DEPTH",
+            "slot_occupancy_pct": "SERVING_SLOT_OCCUPANCY_PCT",
+            "ttft_p50_s": "SERVING_TTFT_P50_S",
+            "ttft_p95_s": "SERVING_TTFT_P95_S",
+            "itl_p50_ms": "SERVING_ITL_P50_MS",
+            "tokens_emitted": "SERVING_TOKENS_TOTAL",
+        }
+        snap = self.snapshot()
+        return [{"name": metric, "value": float(snap[key])}
+                for key, metric in names.items()
+                if snap.get(key) is not None]

@@ -85,6 +85,11 @@ class TpuMetricsReporter:
         metrics = tpu_memory_metrics()
         if not metrics:
             return
+        self._enqueue(metrics)
+
+    def _enqueue(self, metrics: list[dict]) -> None:
+        """Hand one metrics list to the background pusher (shared by the
+        HBM reporter and the serving reporter); never blocks."""
         if self._worker is None:
             # a FRESH queue per worker: after a timed-out close() the old
             # queue may still hold a stale _CLOSE (its wedged worker owns
@@ -136,3 +141,63 @@ class TpuMetricsReporter:
         except queue.Full:
             return   # worker wedged on a slow RPC; it is a daemon thread
         worker.join(timeout)
+
+
+class ServingMetricsReporter(TpuMetricsReporter):
+    """Periodic pusher for the serving subsystem (serve/engine.py): one
+    daemon sampler thread calls `sample_fn()` (the engine's `metrics()` —
+    TTFT, inter-token latency, queue depth, slot occupancy, tokens/sec)
+    every `interval_sec` and hands the result to the SAME non-blocking
+    queue/worker machinery the trainer's HBM reporter uses — one metrics
+    path from both halves of the lifecycle to the AM's MetricsStore, and
+    from there to history events and the portal job page.
+
+    Interval defaults to the task metrics cadence the executor renders
+    (tony.task.metrics-interval-ms). No-op outside the orchestrator, like
+    the parent class."""
+
+    def __init__(self, sample_fn, env: Optional[dict] = None,
+                 interval_sec: Optional[float] = None):
+        super().__init__(env=env)
+        self._sample_fn = sample_fn
+        if interval_sec is None:
+            e = env if env is not None else os.environ
+            interval_sec = float(e.get("TONY_METRICS_INTERVAL_SEC", "5"))
+        self._interval = interval_sec
+        self._sampler_stop = threading.Event()
+        self._sampler: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if not self._enabled or self._sampler is not None:
+            return
+        self._sampler = threading.Thread(target=self._sample_loop,
+                                         name="serving-metrics",
+                                         daemon=True)
+        self._sampler.start()
+
+    def _sample_loop(self) -> None:
+        while not self._sampler_stop.wait(self._interval):
+            self.report_now()
+
+    def report_now(self) -> None:
+        """Sample and enqueue once (the sampler's tick; also callable
+        directly, e.g. right before shutdown)."""
+        if not self._enabled:
+            return
+        try:
+            metrics = self._sample_fn()
+        except Exception:  # noqa: BLE001 — metrics never break serving
+            LOG.debug("serving metrics sample failed", exc_info=True)
+            return
+        if not metrics:
+            return
+        self._enqueue(metrics)
+
+    def close(self, timeout: float = 2.0) -> None:
+        self._sampler_stop.set()
+        if self._sampler is not None:
+            self._sampler.join(timeout)
+            self._sampler = None
+        # final flush so a short-lived server still lands one sample
+        self.report_now()
+        super().close(timeout)

@@ -79,7 +79,8 @@ def make_train_step(loss_fn: Callable[..., jax.Array],
         def _batch_shards() -> int:
             """Devices the batch dim is sharded over under the ambient
             mesh (dp*fsdp), 1 when unmeshed."""
-            mesh = jax.sharding.get_abstract_mesh()
+            from tony_tpu.ops.vma import ambient_abstract_mesh
+            mesh = ambient_abstract_mesh()
             if mesh is None or not mesh.axis_names:
                 return 1
             shape = dict(mesh.shape)

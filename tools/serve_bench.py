@@ -1,0 +1,118 @@
+"""Serving benchmark: open-loop synthetic request stream vs the engine.
+
+Open-loop (arrivals happen on schedule whether or not the server keeps
+up — the honest way to measure a serving system; closed-loop clients
+self-throttle and hide queueing collapse). A deterministic seeded stream
+of requests is fired at the continuous-batching engine on the CPU backend
+and ONE driver-parseable JSON line is printed, carrying the serving
+headline metrics next to bench.py's training MFU:
+
+  {"metric": "serve_tokens_per_sec", "value": ..., "unit": "tok/s",
+   "tokens_per_sec": ..., "ttft_p50_s": ..., "ttft_p95_s": ...,
+   "queue_depth_max": ..., ...}
+
+Run: python tools/serve_bench.py [--requests N] [--rate R] [--slots S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")   # bench contract: CPU
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)    # never claim the tunnel
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="tiny")
+    parser.add_argument("--requests", type=int, default=24)
+    parser.add_argument("--rate", type=float, default=20.0,
+                        help="open-loop arrival rate (req/s)")
+    parser.add_argument("--slots", type=int, default=4)
+    parser.add_argument("--token-budget", type=int, default=64)
+    parser.add_argument("--queue-depth", type=int, default=64)
+    parser.add_argument("--max-new", type=int, default=12)
+    parser.add_argument("--prompt-len", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    import numpy as np
+    import jax
+
+    from tony_tpu.models.llama import get_config, llama_init
+    from tony_tpu.serve.engine import (
+        ContinuousBatchingEngine, QueueFullError,
+        _percentile,
+    )
+
+    config = get_config(args.config)
+    params = llama_init(config, jax.random.PRNGKey(0))
+    engine = ContinuousBatchingEngine(
+        params, config, n_slots=args.slots,
+        token_budget=min(args.token_budget, config.max_seq),
+        queue_depth=args.queue_depth)
+
+    rng = np.random.RandomState(args.seed)
+    prompts = [[int(t) for t in rng.randint(0, config.vocab_size,
+                                            size=args.prompt_len)]
+               for _ in range(args.requests)]
+
+    # warmup outside the measurement: the one-time prefill/decode compiles
+    # are a property of bring-up, not of steady-state serving
+    engine.start()
+    engine.submit(prompts[0], 2).result(timeout=300)
+
+    t0 = time.monotonic()
+    handles, shed = [], 0
+    interval = 1.0 / args.rate if args.rate > 0 else 0.0
+    for i, prompt in enumerate(prompts):
+        target = t0 + i * interval
+        delay = target - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)       # open loop: late arrivals NEVER wait
+        try:
+            handles.append(engine.submit(prompt, args.max_new))
+        except QueueFullError:
+            shed += 1               # 429-equivalent: shed, keep the clock
+    for h in handles:
+        h.result(timeout=300)
+    elapsed = time.monotonic() - t0
+    engine.stop()
+
+    ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+    total_tokens = sum(len(h.tokens) for h in handles)
+    snap = engine.snapshot()
+    tokens_per_sec = round(total_tokens / elapsed, 1)
+    result = {
+        "metric": "serve_tokens_per_sec",
+        "value": tokens_per_sec,
+        "unit": "tok/s",
+        "tokens_per_sec": tokens_per_sec,
+        "ttft_p50_s": round(_percentile(ttfts, 0.50), 4),
+        "ttft_p95_s": round(_percentile(ttfts, 0.95), 4),
+        "queue_depth_max": snap["queue_depth_max"],
+        "itl_p50_ms": (round(snap["itl_p50_ms"], 3)
+                       if snap.get("itl_p50_ms") is not None else None),
+        "requests": len(handles),
+        "requests_shed": shed,
+        "open_loop_rate_rps": args.rate,
+        "slots": args.slots,
+        "token_budget": engine.token_budget,
+        "max_new": args.max_new,
+        "model": args.config,
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(result, separators=(",", ":")), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
