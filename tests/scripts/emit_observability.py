@@ -1,0 +1,39 @@
+"""Fixture: trainer-side observability, exercised exactly through the
+channels the real Trainer uses — a SpanRecorder seeded from the env the
+executor rendered (parent = its user_process span), spans shipped via
+TpuMetricsReporter's non-blocking queue, and two gauge samples pushed
+through the public metrics RPC so the AM's timeseries holds >= 2 points.
+Sleeps long enough for the test to scrape the AM's /metrics mid-run."""
+import os
+import time
+
+from tony_tpu import constants as C
+from tony_tpu.observability.trace import SpanRecorder
+from tony_tpu.rpc.client import MetricsServiceClient
+from tony_tpu.train.metrics import TpuMetricsReporter
+
+rec = SpanRecorder.from_env(os.environ)
+assert rec.enabled, "no trace context in the rendered env"
+assert os.environ.get(C.TONY_PARENT_SPAN), "no parent span in the env"
+
+span = rec.start("trainer_setup")
+time.sleep(0.05)
+rec.end(span)
+
+reporter = TpuMetricsReporter()
+reporter.report_spans(rec.drain())
+
+client = MetricsServiceClient(os.environ[C.AM_HOST],
+                              int(os.environ[C.METRICS_RPC_PORT]))
+task_type = os.environ[C.JOB_NAME]
+index = int(os.environ[C.TASK_INDEX])
+client.update_metrics(task_type, index,
+                      [{"name": "E2E_TEST_GAUGE", "value": 1.0}], attempt=0)
+time.sleep(0.1)
+client.update_metrics(task_type, index,
+                      [{"name": "E2E_TEST_GAUGE", "value": 2.0}], attempt=0)
+
+# window for the test harness to scrape the live AM /metrics endpoint
+time.sleep(2.0)
+reporter.close(timeout=10)
+client.close()

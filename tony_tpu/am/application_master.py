@@ -75,7 +75,12 @@ def session_retry_backoff_sec(app_id: str, attempt: int, base_ms: int,
 
 class MetricsStore(MetricsServiceHandler):
     """AM-side metrics map (rpc/impl/MetricsRpcServer.java:22-56 equivalent):
-    {task_type: {index: [metric dicts]}} holding the latest sample.
+    {task_type: {index: [metric dicts]}} holding the latest sample — plus,
+    per merged gauge, a bounded ring-buffer timeseries
+    (tony.metrics.history-points; observability.metrics.TimeSeries) so the
+    portal serves step-time/tokens-per-sec/HBM/TTFT *trajectories* instead
+    of last-write values, and a Prometheus rendering of the latest gauges
+    for the AM's /metrics scrape endpoint.
 
     Wedge detection (VERDICT r2 item 3): a task whose TPU duty cycle stays
     ~0 across `low_util_intervals` consecutive updates while it keeps
@@ -87,17 +92,27 @@ class MetricsStore(MetricsServiceHandler):
 
     LOW_UTIL_PCT = 1.0
 
-    def __init__(self, low_util_intervals: int = 24):
+    def __init__(self, low_util_intervals: int = 24,
+                 history_points: int = 512):
         self._metrics: dict[str, dict[int, list[dict]]] = {}
         self._low_util_count: dict[tuple[str, int], int] = {}
         self._low_util_flagged: set[tuple[str, int]] = set()
         self._had_util: set[tuple[str, int]] = set()
         self._low_util_intervals = low_util_intervals
+        self._history_points = history_points
+        # (task_type, index) -> {metric name: TimeSeries}
+        self._series: dict[tuple[str, int], dict] = {}
+        # last task attempt a push arrived from (Prometheus label)
+        self._attempts: dict[tuple[str, int], int] = {}
+        # spans piggybacked on metrics pushes land here (the AM wires its
+        # SpanStore.add in); None drops them (standalone store in tests)
+        self.span_sink = None
         self._lock = threading.Lock()
 
     def update_metrics(self, req: dict) -> dict:
         task_type, index = req["task_type"], int(req["index"])
         metrics = req.get("metrics", [])
+        now_ms = int(time.time() * 1000)
         with self._lock:
             # MERGE by metric name, don't replace the list: one task slot
             # has several pushers at once (executor TaskMonitor: memory/
@@ -109,13 +124,34 @@ class MetricsStore(MetricsServiceHandler):
             cur = self._metrics.setdefault(task_type, {}).setdefault(
                 index, [])
             by_name = {m.get("name"): i for i, m in enumerate(cur)}
+            series = self._series.setdefault((task_type, index), {})
             for m in metrics:
-                at = by_name.get(m.get("name"))
+                name = m.get("name")
+                at = by_name.get(name)
                 if at is None:
                     cur.append(m)
                 else:
                     cur[at] = m
-            self._track_utilization(task_type, index, metrics)
+                value = m.get("value")
+                if name and isinstance(value, (int, float)):
+                    ts = series.get(name)
+                    if ts is None:
+                        from tony_tpu.observability.metrics import TimeSeries
+                        ts = series[name] = TimeSeries(self._history_points)
+                    ts.append(now_ms, float(value))
+            attempt = req.get("attempt")
+            if attempt is not None and int(attempt) >= 0:
+                self._attempts[(task_type, index)] = int(attempt)
+            # span-only pushes (metrics=[]) are trace transport, not a
+            # metrics interval — counting them as a missing-duty sample
+            # would inflate the wedge counter during legitimately busy
+            # phases (checkpoint, re-rendezvous) that emit spans
+            if metrics:
+                self._track_utilization(task_type, index, metrics)
+        spans = req.get("spans")
+        sink = self.span_sink
+        if spans and sink is not None:
+            sink(spans)
         return {}
 
     def _track_utilization(self, task_type: str, index: int,
@@ -167,8 +203,51 @@ class MetricsStore(MetricsServiceHandler):
             self._had_util.discard(key)
 
     def get_metrics(self, task_type: str, index: int) -> list[dict]:
+        # copied DICTS, not a shallow list copy: the stored metric dicts
+        # must not alias into callers (a caller mutating a returned metric
+        # — e.g. event post-processing — was corrupting the store)
         with self._lock:
-            return list(self._metrics.get(task_type, {}).get(index, []))
+            return [dict(m)
+                    for m in self._metrics.get(task_type, {}).get(index, [])]
+
+    def get_history(self, task_type: str, index: int) -> dict[str, list]:
+        """{metric name: [[ts_ms, value], ...]} for one task slot."""
+        with self._lock:
+            series = dict(self._series.get((task_type, index), {}))
+        return {name: ts.to_list() for name, ts in sorted(series.items())}
+
+    def timeseries_dict(self) -> dict[str, dict[str, list]]:
+        """Every slot's gauge trajectories, keyed "<task_type>:<index>" —
+        the shape flushed into history as metrics.json and served by the
+        portal's /jobs/:id/metrics.json."""
+        with self._lock:
+            keys = list(self._series)
+        return {f"{t}:{i}": self.get_history(t, i) for t, i in sorted(keys)}
+
+    def prometheus_families(self, app_id: str = "") -> list[dict]:
+        """Latest gauges as Prometheus families with
+        {app_id, task_type, index, attempt} labels (AM /metrics)."""
+        from tony_tpu.observability.prometheus import task_metric_name
+        with self._lock:
+            rows = [(t, i, list(ms))
+                    for t, per_index in self._metrics.items()
+                    for i, ms in per_index.items()]
+            attempts = dict(self._attempts)
+        families: dict[str, dict] = {}
+        for task_type, index, metrics in rows:
+            labels = {"app_id": app_id, "task_type": task_type,
+                      "index": str(index),
+                      "attempt": str(attempts.get((task_type, index), 0))}
+            for m in metrics:
+                value = m.get("value")
+                if not m.get("name") or not isinstance(value, (int, float)):
+                    continue
+                name = task_metric_name(m["name"])
+                fam = families.setdefault(
+                    name, {"name": name, "type": "gauge", "help": "",
+                           "samples": []})
+                fam["samples"].append((labels, float(value)))
+        return [families[k] for k in sorted(families)]
 
 
 class ApplicationMaster(ClusterServiceHandler):
@@ -181,7 +260,25 @@ class ApplicationMaster(ClusterServiceHandler):
         self.session: Optional[TonySession] = None
         self.scheduler: Optional[TaskScheduler] = None
         self.metrics_store = MetricsStore(
-            low_util_intervals=conf.get_int(K.TASK_LOW_UTIL_INTERVALS, 24))
+            low_util_intervals=conf.get_int(K.TASK_LOW_UTIL_INTERVALS, 24),
+            history_points=conf.get_int(K.METRICS_HISTORY_POINTS, 512))
+        # observability: lifecycle spans (trace_id = app_id). The AM
+        # records its own phase boundaries straight into the store;
+        # executor/trainer spans arrive piggybacked on metrics pushes.
+        from tony_tpu.observability.trace import SpanRecorder, SpanStore
+        self._trace_enabled = conf.get_bool(K.TRACE_ENABLED, True)
+        self.span_store = SpanStore(conf.get_int(K.TRACE_MAX_SPANS, 2048))
+        self.tracer = SpanRecorder(
+            trace_id=app_id,
+            sink=self.span_store.add if self._trace_enabled else
+            (lambda spans: None))
+        if self._trace_enabled:
+            self.metrics_store.span_sink = self.span_store.add
+        self._root_span = None
+        self._rendezvous_span = None
+        # (task_id, attempt) -> open task span (allocation → completion)
+        self._task_spans: dict[tuple[str, int], object] = {}
+        self._metrics_http = None
         self._session_id = 0
         self._rpc_server = None
         self.rpc_port = 0
@@ -267,12 +364,118 @@ class ApplicationMaster(ClusterServiceHandler):
         self.hb_monitor.start()
         self.event_handler.start()
         self._write_history_config()
+        self._start_trace()
+        self._start_metrics_endpoint()
         hostport_path = os.path.join(self.app_dir, C.AM_HOSTPORT_FILE)
         tmp = hostport_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(f"{self.host}:{self.rpc_port}")
         os.replace(tmp, hostport_path)
         LOG.info("AM RPC serving at %s:%d", self.host, self.rpc_port)
+
+    def _start_trace(self) -> None:
+        """Open the application root span and back-fill the client-side
+        submit span from the trace seed the client wrote into the app dir
+        (the client process can't push spans to an AM that doesn't exist
+        yet, so the handoff is a file — start = submit time, end = now,
+        i.e. the span covers submission + resource staging + AM boot)."""
+        if not self._trace_enabled:
+            return
+        self._root_span = self.tracer.start("application")
+        seed_path = os.path.join(self.app_dir, C.TRACE_SEED_FILE)
+        try:
+            with open(seed_path, "r", encoding="utf-8") as f:
+                seed = json.load(f)
+            submit_ms = int(seed.get("submit_ms", 0))
+        except (OSError, ValueError, json.JSONDecodeError):
+            submit_ms = 0
+        if submit_ms > 0:
+            submit = self.tracer.start("client_submit",
+                                       parent=self._root_span)
+            submit.start_ms = submit_ms
+            self.tracer.end(submit, attrs={"staged_via": "app_dir"})
+
+    def _start_metrics_endpoint(self) -> None:
+        """Prometheus /metrics scrape endpoint (tony.metrics.port; -1
+        disables). The bound port is written to the app dir so operators
+        and tests can find an ephemeral one."""
+        port = self.conf.get_int(K.METRICS_PORT, 0)
+        if port < 0:
+            return
+        try:
+            from tony_tpu.observability.http import MetricsHTTPServer
+            self._metrics_http = MetricsHTTPServer(self._render_prometheus,
+                                                   port=port)
+            self._metrics_http.start()
+            path = os.path.join(self.app_dir, C.AM_METRICS_PORT_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(self._metrics_http.port))
+            os.replace(tmp, path)
+            LOG.info("AM /metrics on port %d", self._metrics_http.port)
+        except Exception:  # noqa: BLE001 — observability must not kill the AM
+            LOG.exception("could not start the /metrics endpoint")
+            self._metrics_http = None
+
+    def _render_prometheus(self) -> str:
+        """Task gauges (latest values, {app_id,task_type,index,attempt}
+        labels) + this AM process's own health registry."""
+        from tony_tpu.observability.metrics import REGISTRY
+        from tony_tpu.observability.prometheus import render
+        families = self.metrics_store.prometheus_families(self.app_id)
+        families += REGISTRY.families()
+        return render(families)
+
+    def _task_span_start(self, task: Task, container: Container) -> None:
+        """Open the allocation→completion span for one task attempt; its
+        span id is the trace parent rendered into the container env."""
+        if not self._trace_enabled:
+            return
+        span = self.tracer.start(
+            f"task:{task.task_id}", parent=self._root_span,
+            task_id=task.task_id, attempt=task.attempt,
+            attrs={"container_id": container.container_id,
+                   "host": container.host, "job_name": task.job_name})
+        self._task_spans[(task.task_id, task.attempt)] = span
+
+    def _task_span_end(self, task_id: str, attempt: int, status: str,
+                       reason: str = "") -> None:
+        span = self._task_spans.pop((task_id, attempt), None)
+        if span is not None:
+            self.tracer.end(span, status,
+                            attrs={"reason": reason} if reason else None)
+
+    def _rendezvous_span_start(self, reason: str) -> None:
+        if not self._trace_enabled:
+            return
+        if self._rendezvous_span is not None:
+            self.tracer.end(self._rendezvous_span, "ERROR",
+                            attrs={"reason": "superseded"})
+        self._rendezvous_span = self.tracer.start(
+            "rendezvous", parent=self._root_span, attrs={"reason": reason})
+
+    def _rendezvous_span_end(self, status: str = "OK") -> None:
+        if self._rendezvous_span is not None:
+            self.tracer.end(self._rendezvous_span, status)
+            self._rendezvous_span = None
+
+    def _flush_observability(self) -> None:
+        """Spans + metric timeseries into the history dir, next to the
+        event log (the portal's waterfall and metrics.json sources)."""
+        from tony_tpu.events.history import (
+            write_metrics_file, write_spans_file,
+        )
+        try:
+            if self._trace_enabled:
+                for span in list(self._task_spans.values()):
+                    self.tracer.end(span, "ERROR",
+                                    attrs={"reason": "am-shutdown"})
+                self._task_spans.clear()
+                write_spans_file(self.history_dir, self.span_store.to_list())
+            write_metrics_file(self.history_dir,
+                               self.metrics_store.timeseries_dict())
+        except Exception:  # noqa: BLE001 — observability must not fail _finish
+            LOG.exception("failed to flush spans/metrics into history")
 
     def _aggregate_container_logs(self) -> None:
         """Copy every container's stdout/stderr into the history dir
@@ -329,9 +532,11 @@ class ApplicationMaster(ClusterServiceHandler):
             store = staging_store(location, self.app_dir)
             store.put(final_hist,
                       f"history/{os.path.basename(final_hist)}")
-            cfg = os.path.join(self.history_dir, C.PORTAL_CONFIG_FILE)
-            if os.path.exists(cfg):
-                store.put(cfg, f"history/{C.PORTAL_CONFIG_FILE}")
+            for extra in (C.PORTAL_CONFIG_FILE, C.SPANS_FILE,
+                          C.METRICS_FILE):
+                p = os.path.join(self.history_dir, extra)
+                if os.path.exists(p):
+                    store.put(p, f"history/{extra}")
             # aggregated container logs ride along so an off-host portal
             # can serve /logs/:id/:task/:stream without reaching this host
             logs_root = os.path.join(self.history_dir,
@@ -485,6 +690,7 @@ class ApplicationMaster(ClusterServiceHandler):
                 return False
 
         self.scheduler.schedule_tasks()
+        self._rendezvous_span_start(f"session-{self._session_id}")
         if not self.scheduler.dependency_check_passed:
             return False
         if self._unsatisfiable_request:
@@ -554,6 +760,8 @@ class ApplicationMaster(ClusterServiceHandler):
                 if session.all_tasks_registered():
                     # all gang members arrived: stop the registration clock
                     self._registration_deadline = None
+                    # the barrier-wait span covers scheduling → full gang
+                    self._rendezvous_span_end()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
                 LOG.info("all %d tracked tasks completed", total)
@@ -609,6 +817,14 @@ class ApplicationMaster(ClusterServiceHandler):
             status = "KILLED"
         else:
             status = "FAILED"
+        # close the lifecycle trace before flushing it next to the events
+        self._rendezvous_span_end("OK" if succeeded else "ERROR")
+        if self._root_span is not None:
+            self.tracer.end(self._root_span,
+                            "OK" if succeeded else "ERROR",
+                            attrs={"final_status": status})
+            self._root_span = None
+        self._flush_observability()
         if self.session is not None:
             all_metrics = []
             for infos in (self.session.get_task_infos() or []):
@@ -645,6 +861,9 @@ class ApplicationMaster(ClusterServiceHandler):
     def _teardown(self) -> None:
         self.backend.stop()
         self.hb_monitor.stop()
+        if self._metrics_http is not None:
+            self._metrics_http.stop()
+            self._metrics_http = None
         if self._rpc_server is not None:
             self._rpc_server.stop(grace=0.5)
 
@@ -755,6 +974,7 @@ class ApplicationMaster(ClusterServiceHandler):
             self._launched[container.container_id] = (task, session.session_id)
             self._session_containers.setdefault(
                 session.session_id, []).append(container.container_id)
+            self._task_span_start(task, container)
         req = session.requests[task.job_name]
         env = self._container_env(task, req, container)
         cmd = [sys.executable, "-m", "tony_tpu.executor"]
@@ -806,6 +1026,13 @@ class ApplicationMaster(ClusterServiceHandler):
             **({C.TONY_CONF_URI: self._conf_uri} if self._conf_uri else {}),
             "PYTHONPATH": framework_pythonpath(),
         }
+        # trace context: the executor parents its spans under this
+        # attempt's AM-side task span (observability/trace.py env contract)
+        if self._trace_enabled:
+            env[C.TONY_TRACE_ID] = self.app_id
+            span = self._task_spans.get((task.task_id, task.attempt))
+            if span is not None:
+                env[C.TONY_PARENT_SPAN] = span.span_id
         # preprocess-scraped parameters, visible to every task
         # (ApplicationMaster.java:753-764)
         if self._model_params is not None:
@@ -892,6 +1119,10 @@ class ApplicationMaster(ClusterServiceHandler):
         # in the liveliness monitor and expire later
         self.hb_monitor.unregister(task.task_id)
         self.metrics_store.clear_utilization_state(task.job_name, task.index)
+        self._task_span_end(
+            task.task_id, observed_attempt,
+            "OK" if exit_code in (0, C.EXIT_KILLED_BY_AM) else "ERROR",
+            reason=f"exit {exit_code}")
         session.on_task_completed(task.job_name, task.index, exit_code)
         self.scheduler.register_dependency_completed(task.job_name)
         self.event_handler.emit(Event(
@@ -1048,6 +1279,12 @@ class ApplicationMaster(ClusterServiceHandler):
         # and stop_container may block on process teardown
         if old_cid:
             self.backend.stop_container(old_cid)
+        # the failed attempt's span ends here; the gang is back at the
+        # barrier until the replacement registers, so a fresh rendezvous
+        # span opens (waterfall shows relaunch → re-rendezvous wait)
+        self._task_span_end(task.task_id, new_attempt - 1, "ERROR",
+                            reason=reason)
+        self._rendezvous_span_start(f"relaunch:{task.task_id}")
         self.event_handler.emit(Event(
             EventType.TASK_RELAUNCHED,
             TaskRelaunched(task.job_name, task.index, new_attempt,

@@ -20,7 +20,9 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable
+from typing import Callable, Optional
+
+from tony_tpu.observability.metrics import REGISTRY
 
 LOG = logging.getLogger(__name__)
 
@@ -28,11 +30,19 @@ LOG = logging.getLogger(__name__)
 class LivelinessMonitor:
     def __init__(self, hb_interval_ms: int, max_missed: int,
                  on_expired: Callable[[str, int], None]):
+        self._hb_interval_sec = hb_interval_ms / 1000.0
         self._expiry_sec = hb_interval_ms * max(3, max_missed) / 1000.0
         # sweep frequently relative to the expiry window so detection latency
         # stays a fraction of the window even with test-scale intervals
         self._sweep_sec = max(0.05, min(1.0, self._expiry_sec / 10))
         self._on_expired = on_expired
+        # observability (docs/FAULT_TOLERANCE.md failure matrix numbers):
+        # heartbeat round-trip lag = inter-ping gap minus the nominal
+        # cadence (network + AM queueing + executor scheduling jitter);
+        # detection latency = silence start (last ping) → expiry sweep.
+        # Kept as attributes AND pushed into the health registry.
+        self.last_ping_lag_sec: Optional[float] = None
+        self.last_detection_latency_sec: Optional[float] = None
         # task_id -> (last ping, attempt the entry belongs to): the expiry
         # callback reports WHICH attempt went silent, so a stale expiry
         # racing a relaunch can be fenced instead of judging the healthy
@@ -77,13 +87,20 @@ class LivelinessMonitor:
     def ping(self, task_id: str) -> bool:
         """Refresh a registered task's liveness; returns False for unknown
         ids (never resurrects an expired/unregistered entry — a zombie
-        attempt pinging after its slot was relaunched must stay dead)."""
+        attempt pinging after its slot was relaunched must stay dead).
+        Records the ping's lag beyond the nominal heartbeat cadence —
+        the AM-side view of heartbeat round-trip + scheduling delay."""
+        now = time.monotonic()
         with self._lock:
             entry = self._last_ping.get(task_id)
             if entry is not None:
-                self._last_ping[task_id] = (time.monotonic(), entry[1])
-                return True
-            return False
+                lag = max(0.0, (now - entry[0]) - self._hb_interval_sec)
+                self.last_ping_lag_sec = lag
+                self._last_ping[task_id] = (now, entry[1])
+            else:
+                return False
+        REGISTRY.summary("tony_heartbeat_lag_seconds").observe(lag)
+        return True
 
     def registered(self, task_id: str) -> bool:
         with self._lock:
@@ -94,17 +111,32 @@ class LivelinessMonitor:
             self._last_ping.clear()
 
     def _run(self) -> None:
+        last_sweep = time.monotonic()
         while not self._stop.wait(self._sweep_sec):
             now = time.monotonic()
+            # sweep lag: how far past the nominal cadence this sweep ran
+            # (a loaded AM sweeping late ADDS to every detection latency)
+            REGISTRY.gauge("tony_liveliness_sweep_lag_seconds").set(
+                max(0.0, (now - last_sweep) - self._sweep_sec))
+            last_sweep = now
             with self._lock:
-                expired = [(tid, attempt)
+                expired = [(tid, attempt, now - last)
                            for tid, (last, attempt) in self._last_ping.items()
                            if now - last > self._expiry_sec]
-                for tid, _ in expired:
+                for tid, _, _ in expired:
                     del self._last_ping[tid]
-            for tid, attempt in expired:
+            for tid, attempt, silence in expired:
+                # detection latency: last ping → this sweep. Lower bound
+                # is the expiry window (interval * max(3, max_missed));
+                # the excess over it is sweep-cadence + load-induced lag.
+                self.last_detection_latency_sec = silence
+                REGISTRY.summary(
+                    "tony_liveliness_detection_latency_seconds").observe(
+                    silence)
                 LOG.error("task %s (attempt %d) missed heartbeats for %.1fs "
-                          "— expired", tid, attempt, self._expiry_sec)
+                          "— expired (detection latency %.2fs over a %.1fs "
+                          "window)", tid, attempt, self._expiry_sec, silence,
+                          self._expiry_sec)
                 try:
                     self._on_expired(tid, attempt)
                 except Exception:  # noqa: BLE001

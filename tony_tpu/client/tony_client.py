@@ -203,6 +203,16 @@ class TonyClient:
             from tony_tpu.security import generate_token, write_token_file
             self._auth_token = generate_token()
             write_token_file(self.app_dir, self._auth_token)
+        # trace seed: the AM back-fills a client_submit span from this
+        # (start = now, end = AM boot), covering staging + AM launch —
+        # the one phase the AM itself cannot time
+        try:
+            with open(os.path.join(self.app_dir, C.TRACE_SEED_FILE), "w",
+                      encoding="utf-8") as f:
+                json.dump({"trace_id": self.app_id,
+                           "submit_ms": int(time.time() * 1000)}, f)
+        except OSError:
+            LOG.debug("could not write trace seed", exc_info=True)
         self._process_final_conf()
         am_stdout = open(os.path.join(self.app_dir, C.AM_STDOUT), "ab")
         am_stderr = open(os.path.join(self.app_dir, C.AM_STDERR), "ab")

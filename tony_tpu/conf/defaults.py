@@ -99,6 +99,12 @@ DEFAULTS = {
     K.HISTORY_STALE_INPROGRESS_SEC: 24 * 3600,
     K.HISTORY_LOG_MAX_SIZE: "10m",
 
+    # observability
+    K.METRICS_HISTORY_POINTS: 512,
+    K.METRICS_PORT: 0,           # 0 = ephemeral; -1 = no /metrics endpoint
+    K.TRACE_ENABLED: True,
+    K.TRACE_MAX_SPANS: 2048,
+
     # portal
     K.PORTAL_PORT: 19886,
     K.PORTAL_CACHE_MAX_ENTRIES: 1000,

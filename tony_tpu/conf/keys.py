@@ -120,6 +120,23 @@ SERVING_QUEUE_DEPTH = "tony.serving.queue-depth"
 # ($SERVING_PORT), so the cluster-spec entry is the live endpoint
 SERVING_PORT = "tony.serving.port"
 
+# --- observability (observability/ subsystem) ----------------------------
+# per-gauge timeseries ring buffer in the AM's MetricsStore: max points
+# kept per (task, metric); on overflow the buffer compacts (drops every
+# other point, doubling its stride) so memory stays capped while the
+# series still covers the whole run
+METRICS_HISTORY_POINTS = "tony.metrics.history-points"
+# AM Prometheus /metrics HTTP endpoint: 0 = ephemeral port (written to
+# the app dir's am-metrics-port file), -1 = disabled
+METRICS_PORT = "tony.metrics.port"
+# lifecycle span recording (trace_id = app_id) across client/AM/
+# executor/trainer; spans land in history next to the event log and
+# render as the portal job page's waterfall
+TRACE_ENABLED = "tony.trace.enabled"
+# cap on spans held by the AM's SpanStore (and per-process recorders);
+# overflow is counted, never grown
+TRACE_MAX_SPANS = "tony.trace.max-spans"
+
 # --- proxy ---------------------------------------------------------------
 # externally reachable base URL of an authenticated tony_tpu.proxy fronting
 # in-cluster HTTP endpoints (serving, notebook, TB). When set, the portal
@@ -177,7 +194,7 @@ JOBTYPE_INSTANCES_RE = re.compile(r"^tony\.([a-z][a-z0-9_\-]*)\.instances$")
 RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
-    "execution", "other", "queues",
+    "execution", "other", "queues", "metrics", "trace",
 })
 
 

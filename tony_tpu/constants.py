@@ -69,6 +69,14 @@ TPU_MESH_AXES = "TPU_MESH_AXES"      # e.g. "dp,fsdp,tp"
 TPU_SLICE_ID = "TPU_SLICE_ID"        # multi-slice (DCN) slice index
 TPU_NUM_SLICES = "TPU_NUM_SLICES"
 
+# Observability (observability/ subsystem): trace context rendered into
+# every child process env — trace_id = app_id; the parent span id is the
+# AM's task span for executors, the executor's user_process span for the
+# user process, so client→AM→executor→trainer spans chain into one
+# waterfall on the portal job page.
+TONY_TRACE_ID = "TONY_TRACE_ID"
+TONY_PARENT_SPAN = "TONY_PARENT_SPAN"
+
 # Paths handed to AM / executor processes via env
 TONY_CONF_PATH = "TONY_CONF_PATH"    # abs path of the frozen tony-final.json
 TONY_CONF_URI = "TONY_CONF_URI"      # staged conf URI for off-host executors
@@ -93,6 +101,10 @@ HISTORY_SUFFIX = "jhist"
 HISTORY_INPROGRESS_SUFFIX = "jhist.inprogress"
 PORTAL_CONFIG_FILE = "config.json"   # frozen conf copy in each history dir
 HISTORY_LOGS_DIR_NAME = "logs"       # aggregated container logs in history
+SPANS_FILE = "spans.json"            # lifecycle spans flushed next to events
+METRICS_FILE = "metrics.json"        # per-gauge timeseries flushed at finish
+TRACE_SEED_FILE = "trace.json"       # client-written {trace_id, submit_ms}
+AM_METRICS_PORT_FILE = "am-metrics-port"  # bound /metrics scrape port
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------

@@ -1,16 +1,25 @@
-"""History-file naming + job metadata.
+"""History-file naming + job metadata + observability sidecar files.
 
 Equivalent of the reference's util/HistoryFileUtils.java:12-32 filename codec
 and models/JobMetadata.java:35-45: final history files are named
 `<appId>-<started>-<completed>-<user>-<STATUS>.jhist`; in-flight files are
 `<appId>-<started>-<user>.jhist.inprogress`.
+
+The observability subsystem flushes two sidecar files into the same
+per-app history dir (so they travel with the jhist through the portal's
+mover and the staging-store publish): `spans.json` (lifecycle spans,
+the portal waterfall's source) and `metrics.json` (per-gauge timeseries,
+served as /jobs/:id/metrics.json).
 """
 
 from __future__ import annotations
 
 import getpass
+import json
+import os
 import re
 from dataclasses import dataclass, field
+from typing import Any
 
 from tony_tpu import constants as C
 
@@ -42,6 +51,41 @@ _FINAL_RE = re.compile(
 _INPROGRESS_RE = re.compile(
     r"^(?P<app>.+?)-(?P<started>\d+)-(?P<user>.+)\."
     + re.escape(C.HISTORY_INPROGRESS_SUFFIX) + r"$")
+
+
+def _write_json_atomic(path: str, obj: Any) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str, default: Any) -> Any:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return default
+
+
+def write_spans_file(history_dir: str, spans: list[dict]) -> None:
+    _write_json_atomic(os.path.join(history_dir, C.SPANS_FILE), spans)
+
+
+def read_spans_file(history_dir: str) -> list[dict]:
+    out = _read_json(os.path.join(history_dir, C.SPANS_FILE), [])
+    return out if isinstance(out, list) else []
+
+
+def write_metrics_file(history_dir: str, series: dict) -> None:
+    """series: {"<task_type>:<index>": {metric_name: [[ts_ms, value],…]}}."""
+    _write_json_atomic(os.path.join(history_dir, C.METRICS_FILE), series)
+
+
+def read_metrics_file(history_dir: str) -> dict:
+    out = _read_json(os.path.join(history_dir, C.METRICS_FILE), {})
+    return out if isinstance(out, dict) else {}
 
 
 def parse_history_file_name(name: str) -> JobMetadata:

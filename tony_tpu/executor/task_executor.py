@@ -172,6 +172,12 @@ class TaskExecutor:
         self.heartbeater: Optional[Heartbeater] = None
         self.monitor: Optional[TaskMonitor] = None
         self._user_proc = None
+        # lifecycle tracing (observability/trace.py): context arrives in
+        # the env the AM rendered (parent = this attempt's AM task span);
+        # finished spans piggyback on the metrics RPC
+        from tony_tpu.observability.trace import SpanRecorder
+        self.tracer = SpanRecorder.from_env(e, task_id=self.task_id,
+                                            attempt=self.task_attempt)
         # generation-aware re-rendezvous state: the spec generation the
         # running user process was launched with, the newest generation any
         # heartbeat has carried, and whether a newer generation (a peer's
@@ -388,10 +394,15 @@ class TaskExecutor:
         stops only its user process, re-enters the gang barrier, and
         relaunches the user command against the replacement's host:port —
         the container and its localized resources stay alive."""
-        self.localize_resources()
+        with self.tracer.span("executor_localization"):
+            self.localize_resources()
         self.setup_ports()
         try:
+            barrier_span = self.tracer.start("rendezvous_wait")
             cluster_spec = self.register_and_get_cluster_spec()
+            self.tracer.end(barrier_span,
+                            "OK" if cluster_spec is not None else "ERROR")
+            self._push_spans()
             if cluster_spec is None:
                 LOG.error("gang rendezvous timed out after %ds",
                           self.registration_timeout_sec)
@@ -427,7 +438,16 @@ class TaskExecutor:
                 # while the gang is still at the barrier, or the injected
                 # timing (peers running when the victim dies) is lost
                 self._schedule_kill_if_testing()
+                # user-process span: the trace context rendered into the
+                # child env parents trainer-side spans under it
+                proc_span = self.tracer.start(
+                    "user_process",
+                    attrs={"generation": self._spec_generation})
+                env.update(self.tracer.env(proc_span))
                 exit_code = self._execute(env, timeout_ms / 1000.0)
+                self.tracer.end(proc_span,
+                                "OK" if exit_code == 0 else "ERROR",
+                                attrs={"exit_code": exit_code})
                 respec = self._take_respec()
                 if not respec and exit_code != 0:
                     # a dying peer can take this task's collectives down
@@ -454,6 +474,8 @@ class TaskExecutor:
                 # life. A dead AM is covered by the heartbeater's
                 # self-destruct.
                 cluster_spec = None
+                barrier_span = self.tracer.start(
+                    "rendezvous_wait", attrs={"re_entry": True})
                 for _ in range(3):
                     cluster_spec = self.register_and_get_cluster_spec()
                     if cluster_spec is not None:
@@ -462,6 +484,10 @@ class TaskExecutor:
                                 "%ds — retrying (the AM's allocation "
                                 "deadline governs)",
                                 self.registration_timeout_sec)
+                self.tracer.end(
+                    barrier_span,
+                    "OK" if cluster_spec is not None else "ERROR")
+                self._push_spans()
                 if cluster_spec is None:
                     LOG.error("re-rendezvous never completed after 3 "
                               "rounds of %ds — giving up",
@@ -487,6 +513,21 @@ class TaskExecutor:
             self._port_reservation.release()
             self._port_reservation = None
 
+    def _push_spans(self) -> None:
+        """Best-effort ship of finished spans to the AM's SpanStore over
+        the metrics RPC (phase boundaries only — never the hot path)."""
+        if not self.tracer.enabled:
+            return
+        spans = self.tracer.drain()
+        if not spans:
+            return
+        try:
+            self.metrics_client.update_metrics(
+                self.job_name, self.task_index, [], spans=spans,
+                attempt=self.task_attempt)
+        except Exception:  # noqa: BLE001 — tracing must never fail the task
+            LOG.debug("span push failed", exc_info=True)
+
     def _execute(self, env: dict[str, str], timeout_sec: float) -> int:
         if not self.task_command:
             LOG.error("no task command configured")
@@ -506,7 +547,8 @@ class TaskExecutor:
                             if self._user_proc.poll() is None else None),
             interval_sec=self.metrics_interval_sec,
             tpu_sampler=default_tpu_sampler,
-            gpu_sampler=maybe_gpu_sampler(self.conf, self.job_name))
+            gpu_sampler=maybe_gpu_sampler(self.conf, self.job_name),
+            attempt=self.task_attempt)
         self.monitor.start()
         rc = wait_or_kill(self._user_proc, timeout_sec)
         self.monitor.stop()
@@ -542,6 +584,7 @@ class TaskExecutor:
     def _report(self, exit_code: int, barrier_timeout: bool = False) -> None:
         if self.heartbeater is not None:
             self.heartbeater.stop()
+        self._push_spans()
         try:
             self.client.register_execution_result(
                 exit_code, self.job_name, self.task_index, self.session_id,

@@ -180,10 +180,12 @@ class TaskMonitor:
                  index: int, pid_fn: Callable[[], Optional[int]],
                  interval_sec: float = 5.0,
                  tpu_sampler: Optional[Callable[[], dict[str, float]]] = None,
-                 gpu_sampler: Optional[Callable[[], dict[str, float]]] = None):
+                 gpu_sampler: Optional[Callable[[], dict[str, float]]] = None,
+                 attempt: int = -1):
         self._client = client
         self._task_type = task_type
         self._index = index
+        self._attempt = attempt   # Prometheus attempt label at the AM
         self._pid_fn = pid_fn
         self._interval = interval_sec
         self._tpu_sampler = tpu_sampler
@@ -277,6 +279,7 @@ class TaskMonitor:
                 LOG.exception("gpu sampler failed")
         try:
             self._client.update_metrics(self._task_type, self._index,
-                                        self.snapshot())
+                                        self.snapshot(),
+                                        attempt=self._attempt)
         except Exception:  # noqa: BLE001
             LOG.warning("metrics push failed", exc_info=True)

@@ -1,0 +1,33 @@
+"""Shared observability substrate: lifecycle tracing, metric timeseries,
+internal health counters, and Prometheus text exposition.
+
+Four small modules, wired through every layer of the orchestrator:
+
+- ``trace``      — lifecycle spans (trace_id = app_id) recorded at phase
+  boundaries in the client, AM, executor, and trainer; executor/trainer
+  spans ride the existing metrics RPC into the AM's SpanStore and are
+  flushed into history next to the event log, where the portal renders
+  them as a per-job waterfall.
+- ``metrics``    — bounded ring-buffer timeseries (the MetricsStore's
+  gauge trajectories) plus the process-local ``MetricsRegistry`` of
+  internal health counters (RPC latency/retries, heartbeat lag,
+  liveliness sweep/detection latency, prefetch stall, metrics-push
+  drops) — the orchestrator observing itself.
+- ``prometheus`` — the one shared text-exposition encoder (name
+  sanitization, label escaping, NaN/±Inf) used by the AM's ``/metrics``
+  endpoint and the serving frontend's ``/v1/metrics``; includes a
+  parser for tests and the serve bench.
+- ``http``       — tiny stdlib ``/metrics`` scrape server (the AM's).
+
+Design rule inherited from the rest of the codebase: observability must
+never fail or block the thing it observes — every recorder is bounded,
+every push is best-effort, and the hot loop only touches in-process
+counters.
+"""
+
+from tony_tpu.observability.metrics import (  # noqa: F401
+    REGISTRY, MetricsRegistry, TimeSeries,
+)
+from tony_tpu.observability.trace import (  # noqa: F401
+    Span, SpanRecorder, SpanStore,
+)

@@ -52,6 +52,8 @@ class PortalCache:
         # path -> (mtime, parsed events); immutable finals hit by path
         self._events = _LRU(max_entries)
         self._configs = _LRU(max_entries)
+        # observability sidecars (spans.json / metrics.json), same scheme
+        self._sidecars = _LRU(max_entries)
         # finished app dirs are immutable once moved: job_id -> dir
         self._finished_dirs: dict[str, str] = {}
         # a job's queue never changes: job_id -> queue, no re-stat
@@ -179,6 +181,41 @@ class PortalCache:
         with self._lock:
             self._configs.put(path, (mtime, conf))
         return conf
+
+    def _get_sidecar(self, job_id: str, filename: str, default: Any) -> Any:
+        """mtime-cached JSON sidecar from the app's history dir (the AM
+        flushes spans.json/metrics.json next to the jhist)."""
+        d = self._find_app_dir(job_id)
+        if d is None:
+            return default
+        path = os.path.join(d, filename)
+        if not os.path.isfile(path):
+            return default
+        mtime = os.path.getmtime(path)
+        with self._lock:
+            cached = self._sidecars.get(path)
+            if cached is not None and cached[0] == mtime:
+                return cached[1]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except Exception:  # noqa: BLE001 — damaged sidecar, serve default
+            LOG.exception("failed to read %s", path)
+            return default
+        if not isinstance(data, type(default)):
+            return default
+        with self._lock:
+            self._sidecars.put(path, (mtime, data))
+        return data
+
+    def get_spans(self, job_id: str) -> list[dict[str, Any]]:
+        """Lifecycle spans for the job page's waterfall (spans.json)."""
+        return self._get_sidecar(job_id, C.SPANS_FILE, [])
+
+    def get_metrics_timeseries(self, job_id: str) -> dict[str, Any]:
+        """Per-gauge trajectories ({task: {metric: [[ts, v], ...]}}) —
+        the /jobs/:id/metrics.json payload (metrics.json sidecar)."""
+        return self._get_sidecar(job_id, C.METRICS_FILE, {})
 
     def get_log_links(self, job_id: str) -> list[dict[str, Any]]:
         """Per-task log links. The reference synthesized NodeManager

@@ -30,6 +30,8 @@ td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
 th{{background:#eee}}a{{text-decoration:none}}
 .RUNNING{{color:#b8860b}}.SUCCEEDED{{color:green}}.FAILED{{color:red}}
 .KILLED{{color:#555}}
+.waterfall td{{vertical-align:middle}}
+.spanbar{{height:10px;border-radius:2px;min-width:2px}}
 </style></head><body><h2>{title}</h2>{body}</body></html>"""
 
 
@@ -149,6 +151,15 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._index()
             if parts[0] == "api":
                 return self._api(parts[1:])
+            if (len(parts) == 3 and parts[0] == "jobs"
+                    and parts[2] == "metrics.json"):
+                # scrape-friendly alias of /api/jobs/:id/metrics — the
+                # gauge trajectories the AM flushed into history
+                job_id = parts[1]
+                md = self.cache.get_metadata(job_id)
+                if md is None or not self._visible(md.user):
+                    return self._json({"error": "not found"}, 404)
+                return self._json(self.cache.get_metrics_timeseries(job_id))
             if (len(parts) in (2, 4) and parts[0] in ("jobs", "config",
                                                       "logs")):
                 job_id = parts[1]
@@ -185,6 +196,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(self.cache.get_config(job_id))
             if what == "logs":
                 return self._json(self.cache.get_log_links(job_id))
+            if what == "spans":
+                return self._json(self.cache.get_spans(job_id))
+            if what == "metrics":
+                return self._json(self.cache.get_metrics_timeseries(job_id))
         self._json({"error": "not found"}, 404)
 
     # -- pages (reference: 4 page controllers) -----------------------------
@@ -220,7 +235,62 @@ class _Handler(BaseHTTPRequestHandler):
             ])
         self._html(f"events — {job_id}",
                    self._serving_endpoints_html(job_id, events)
+                   + self._waterfall_html(job_id)
                    + _table(["Time", "Event", "Payload"], rows))
+
+    def _waterfall_html(self, job_id: str) -> str:
+        """Lifecycle-span waterfall: one row per span, a bar positioned/
+        sized by start/duration relative to the trace extent, indented by
+        parent depth — where a slow job answers 'which phase ate the
+        time' (submit vs localization vs rendezvous vs compile vs steps)
+        at a glance. Empty string when the job has no spans (pre-
+        observability history stays renderable)."""
+        spans = [s for s in self.cache.get_spans(job_id)
+                 if isinstance(s, dict) and s.get("start_ms")]
+        if not spans:
+            return ""
+        t0 = min(int(s["start_ms"]) for s in spans)
+        t1 = max(max(int(s.get("end_ms") or 0), int(s["start_ms"]))
+                 for s in spans)
+        extent = max(1, t1 - t0)
+        parents = {str(s.get("span_id", "")): str(s.get("parent_id", ""))
+                   for s in spans}
+
+        def _depth(sid: str) -> int:
+            d, cur, seen = 0, parents.get(sid, ""), {sid}
+            while cur and cur in parents and cur not in seen:
+                seen.add(cur)
+                d += 1
+                cur = parents.get(cur, "")
+            return d
+        rows = []
+        for s in spans:
+            sid = str(s.get("span_id", ""))
+            start = int(s["start_ms"])
+            end = int(s.get("end_ms") or 0) or start
+            left = 100.0 * (start - t0) / extent
+            width = max(0.5, 100.0 * (end - start) / extent)
+            color = "#c0392b" if s.get("status") == "ERROR" else "#4a90d9"
+            indent = 1.2 * _depth(sid)
+            label = s.get("name", "")
+            task = s.get("task_id") or ""
+            if task and not label.endswith(task):
+                label = f"{label} [{task}"
+                if int(s.get("attempt", 0)) > 0:
+                    label += f" a{s['attempt']}"
+                label += "]"
+            rows.append(
+                f'<tr><td style="padding-left:{indent:.1f}em">'
+                f'{html.escape(label)}</td>'
+                f"<td>{end - start} ms</td>"
+                f'<td style="min-width:320px"><div class="spanbar" '
+                f'style="margin-left:{left:.2f}%;width:{width:.2f}%;'
+                f'background:{color}" title="{html.escape(str(s.get("status")))}">'
+                f"</div></td></tr>")
+        return ("<h3>Lifecycle waterfall</h3>"
+                '<table class="waterfall"><tr><th>Span</th><th>Duration</th>'
+                f"<th>Timeline ({extent} ms)</th></tr>"
+                + "".join(rows) + "</table>")
 
     def _serving_endpoints_html(self, job_id: str, events: list) -> str:
         """Registered serving endpoints as links above the event table —

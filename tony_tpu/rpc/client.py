@@ -17,6 +17,7 @@ from typing import Any, Optional
 import grpc
 
 from tony_tpu import constants as C
+from tony_tpu.observability.metrics import REGISTRY
 from tony_tpu.utils.common import equal_jitter_backoff_sec
 from tony_tpu.rpc.service import (
     CLUSTER_SERVICE, METRICS_SERVICE, CLUSTER_METHODS, METRICS_METHODS,
@@ -87,16 +88,34 @@ class _JsonRpcClient:
         timeout_sec = self._timeout_sec if timeout_sec is None else timeout_sec
         last_err: Optional[Exception] = None
         for attempt in range(retries):
+            # self-health telemetry (observability registry): PER-ATTEMPT
+            # latency + retry/failure counters — in-process only, never an
+            # RPC. t0 restarts each attempt: the summary must measure the
+            # wire, not the backoff sleeps and dead prior attempts
+            # (tony_rpc_client_retries_total carries the retry signal)
+            t0 = time.monotonic()
             try:
-                return self._stubs[method](req or {}, timeout=timeout_sec,
+                resp = self._stubs[method](req or {}, timeout=timeout_sec,
                                            wait_for_ready=wait_for_ready,
                                            metadata=self._metadata)
+                REGISTRY.summary("tony_rpc_client_latency_seconds",
+                                 method=method).observe(
+                    time.monotonic() - t0)
+                REGISTRY.counter("tony_rpc_client_calls_total",
+                                 method=method, status="ok").inc()
+                return resp
             except grpc.RpcError as e:
                 if e.code() not in self._RETRYABLE:
+                    REGISTRY.counter("tony_rpc_client_calls_total",
+                                     method=method, status="error").inc()
                     raise
                 last_err = e
+                REGISTRY.counter("tony_rpc_client_retries_total",
+                                 method=method).inc()
                 if attempt + 1 < retries:
                     time.sleep(self._backoff_sec(attempt))
+        REGISTRY.counter("tony_rpc_client_calls_total",
+                         method=method, status="exhausted").inc()
         raise ConnectionError(
             f"RPC {method} failed after {retries} attempts: {last_err}")
 
@@ -194,6 +213,15 @@ class MetricsServiceClient(_JsonRpcClient):
         super().__init__(METRICS_SERVICE, METRICS_METHODS, host, port, **kw)
 
     def update_metrics(self, task_type: str, index: int,
-                       metrics: list[dict]) -> None:
-        self.call("update_metrics", {
-            "task_type": task_type, "index": index, "metrics": metrics})
+                       metrics: list[dict],
+                       spans: Optional[list[dict]] = None,
+                       attempt: int = -1) -> None:
+        """`spans` piggybacks finished lifecycle spans (observability/
+        trace.py) on the metrics channel — no extra RPC surface; `attempt`
+        labels this task attempt in the AM's Prometheus exposition."""
+        req = {"task_type": task_type, "index": index, "metrics": metrics}
+        if spans:
+            req["spans"] = spans
+        if attempt >= 0:
+            req["attempt"] = attempt
+        self.call("update_metrics", req)

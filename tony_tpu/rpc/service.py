@@ -105,12 +105,30 @@ class MetricsServiceHandler(abc.ABC):
 
 
 def _generic_handler(service_name: str, handler: Any, methods: tuple[str, ...]):
+    import time
+
+    from tony_tpu.observability.metrics import REGISTRY
+
     rpc_handlers = {}
     for method in methods:
         fn = getattr(handler, method)
 
-        def unary(req, ctx, _fn=fn):
-            return _fn(req)
+        def unary(req, ctx, _fn=fn, _method=method):
+            # self-health telemetry: server-side handler latency +
+            # outcome counters into the process registry (the AM's
+            # /metrics endpoint exposes them)
+            t0 = time.monotonic()
+            try:
+                resp = _fn(req)
+            except Exception:
+                REGISTRY.counter("tony_rpc_server_calls_total",
+                                 method=_method, status="error").inc()
+                raise
+            REGISTRY.summary("tony_rpc_server_latency_seconds",
+                             method=_method).observe(time.monotonic() - t0)
+            REGISTRY.counter("tony_rpc_server_calls_total",
+                             method=_method, status="ok").inc()
+            return resp
 
         rpc_handlers[method] = grpc.unary_unary_rpc_method_handler(
             unary, request_deserializer=_deser, response_serializer=_ser)

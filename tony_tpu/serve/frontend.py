@@ -13,7 +13,15 @@ Routes:
   chunked framing IS the streaming contract — no SSE dependency).
 - ``GET /healthz`` — liveness (tokenless, like the portal's).
 - ``GET /v1/metrics`` — engine gauge snapshot (TTFT, ITL, queue depth,
-  slot occupancy, tokens/sec).
+  slot occupancy, tokens/sec). Default is the JSON snapshot (the wire
+  contract tools already consume); a Prometheus scraper gets text
+  exposition instead — selected by ``?format=prometheus`` or an
+  ``Accept`` header asking for ``text/plain``/OpenMetrics (what a real
+  Prometheus sends). Bare ``GET /metrics`` is always exposition. The
+  exposition carries the engine gauges (labels
+  ``{app_id, task_type, index, attempt}`` when running orchestrated)
+  plus this process's health registry (RPC client latency,
+  metrics-push drops).
 
 Backpressure: the engine's bounded queue + queued-token budget surface as
 HTTP 429 with ``Retry-After`` (clean open-loop shedding); a request that
@@ -25,16 +33,51 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from tony_tpu.serve.engine import (
     BudgetExceededError, ContinuousBatchingEngine, QueueFullError,
 )
 
 LOG = logging.getLogger(__name__)
+
+
+def engine_prometheus_text(engine: ContinuousBatchingEngine) -> str:
+    """Engine snapshot + this process's health registry as Prometheus
+    text exposition — the serving half of the shared encoder contract
+    (observability/prometheus.py). Orchestrated runs label every engine
+    gauge with {app_id, task_type, index, attempt} from the task env."""
+    from tony_tpu import constants as C
+    from tony_tpu.observability.metrics import REGISTRY
+    from tony_tpu.observability.prometheus import render, task_metric_name
+
+    labels = {}
+    for key, env_name in (("app_id", C.APP_ID), ("task_type", C.JOB_NAME),
+                          ("index", C.TASK_INDEX),
+                          ("attempt", C.TASK_ATTEMPT)):
+        value = os.environ.get(env_name)
+        if value:
+            labels[key] = value
+    snap = engine.snapshot()
+    families = []
+    for key in sorted(snap):
+        value = snap[key]
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        name = task_metric_name(f"serving_{key}")
+        families.append({"name": name, "type": "gauge", "help": "",
+                         "samples": [(labels, float(value))]})
+    # None gauges (no traffic yet: ttft/itl) are NaN, not absent — a
+    # scraper's absent-metric alert must not fire on an idle server
+    for key in sorted(k for k, v in snap.items() if v is None):
+        name = task_metric_name(f"serving_{key}")
+        families.append({"name": name, "type": "gauge", "help": "",
+                         "samples": [(labels, float("nan"))]})
+    return render(families + REGISTRY.families())
 
 MAX_BODY_BYTES = 8 * 1024 * 1024
 # streaming stall guard: an engine wedged mid-request must not pin the
@@ -68,12 +111,36 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ---------------------------------------------------------
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-        path = urlparse(self.path).path.rstrip("/")
+        parsed = urlparse(self.path)
+        path = parsed.path.rstrip("/")
         if path == "/healthz":
             return self._json({"ok": True})
         if path in ("/v1/metrics", "/metrics"):
+            if path == "/metrics" or self._wants_prometheus(parsed.query):
+                from tony_tpu.observability.prometheus import CONTENT_TYPE
+                data = engine_prometheus_text(self.engine).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
             return self._json(self.engine.snapshot())
         self._error(404, "not found")
+
+    def _wants_prometheus(self, query: str) -> bool:
+        """Content negotiation on /v1/metrics: JSON stays the default
+        (existing consumers send Accept: */*); a real Prometheus scraper
+        asks for text/plain or OpenMetrics, and ?format=prometheus forces
+        it for curl-by-hand."""
+        fmt = (parse_qs(query).get("format") or [""])[0].lower()
+        if fmt == "prometheus":
+            return True
+        if fmt == "json":
+            return False
+        accept = self.headers.get("Accept", "")
+        return ("text/plain" in accept
+                or "application/openmetrics-text" in accept)
 
     def do_POST(self):  # noqa: N802
         path = urlparse(self.path).path.rstrip("/")

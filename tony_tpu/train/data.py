@@ -198,6 +198,9 @@ class PrefetchIterator:
         self._closed = False
         self.stall_s = 0.0
         self.batches = 0
+        from tony_tpu.observability.metrics import REGISTRY
+        self._stall_counter = REGISTRY.counter(
+            "tony_prefetch_stall_seconds_total")
         # already-transferred batches a predecessor never yielded
         # (its .leftover) — served first, ahead of this queue
         self._initial: list = list(initial)
@@ -263,7 +266,12 @@ class PrefetchIterator:
                         break
                     except queue.Empty:
                         raise StopIteration from None
-        self.stall_s += time.perf_counter() - t0
+        stalled = time.perf_counter() - t0
+        self.stall_s += stalled
+        # self-health: stall seconds into the process registry so a
+        # starved input pipeline shows up on any scrape of this process
+        # (an in-process locked float add — no RPC, no I/O, ~µs)
+        self._stall_counter.inc(stalled)
         if item is _DONE:
             self._closed = True
             raise StopIteration

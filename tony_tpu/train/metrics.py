@@ -70,11 +70,17 @@ class TpuMetricsReporter:
         from tony_tpu.security.tokens import TOKEN_ENV
         self._task_type = e.get(C.JOB_NAME, "")
         self._index = int(e.get(C.TASK_INDEX, "0"))
+        self._attempt = int(e.get(C.TASK_ATTEMPT, "-1") or -1)
         self._token = e.get(TOKEN_ENV) or None
         self._client = None
         self._enabled = bool(self._host and self._port and self._task_type)
         self._queue: queue.Queue = queue.Queue(maxsize=2)
         self._worker: Optional[threading.Thread] = None
+        # self-health: samples dropped because the push queue was full
+        # (a slow/unreachable AM) — visible in the process registry as
+        # tony_metrics_push_dropped_total instead of a debug log no one
+        # reads
+        self.dropped = 0
 
     def report(self) -> None:
         """Enqueue one HBM sample for the background pusher. Never blocks
@@ -85,11 +91,20 @@ class TpuMetricsReporter:
         metrics = tpu_memory_metrics()
         if not metrics:
             return
-        self._enqueue(metrics)
+        self._enqueue({"metrics": metrics})
 
-    def _enqueue(self, metrics: list[dict]) -> None:
-        """Hand one metrics list to the background pusher (shared by the
-        HBM reporter and the serving reporter); never blocks."""
+    def report_spans(self, spans: list[dict]) -> None:
+        """Enqueue finished lifecycle spans (observability/trace.py) for
+        the same non-blocking pusher — trainer phase boundaries ride the
+        metrics channel exactly like the executor's."""
+        if not self._enabled or not spans:
+            return
+        self._enqueue({"metrics": [], "spans": spans})
+
+    def _enqueue(self, payload: dict) -> None:
+        """Hand one push payload ({"metrics": [...], "spans": [...]}) to
+        the background pusher (shared by the HBM reporter and the serving
+        reporter); never blocks."""
         if self._worker is None:
             # a FRESH queue per worker: after a timed-out close() the old
             # queue may still hold a stale _CLOSE (its wedged worker owns
@@ -101,9 +116,13 @@ class TpuMetricsReporter:
                 name="tony-metrics-push", daemon=True)
             self._worker.start()
         try:
-            self._queue.put_nowait(metrics)
+            self._queue.put_nowait(payload)
         except queue.Full:
-            LOG.debug("metrics push queue full; dropping stale sample")
+            self.dropped += 1
+            from tony_tpu.observability.metrics import REGISTRY
+            REGISTRY.counter("tony_metrics_push_dropped_total").inc()
+            LOG.debug("metrics push queue full; dropping stale sample "
+                      "(%d dropped so far)", self.dropped)
 
     def _drain(self, q: queue.Queue) -> None:
         while True:
@@ -112,7 +131,7 @@ class TpuMetricsReporter:
                 return
             self._push(item)
 
-    def _push(self, metrics: list[dict]) -> None:
+    def _push(self, payload: dict) -> None:
         try:
             if self._client is None:
                 from tony_tpu.rpc.client import MetricsServiceClient
@@ -123,23 +142,34 @@ class TpuMetricsReporter:
                 self._client = MetricsServiceClient(
                     self._host, self._port, auth_token=self._token,
                     task_auth_id=task_auth)
-            self._client.call("update_metrics", {
-                "task_type": self._task_type, "index": self._index,
-                "metrics": metrics}, retries=1, timeout_sec=5.0,
-                wait_for_ready=False)
+            req = {"task_type": self._task_type, "index": self._index,
+                   "metrics": payload.get("metrics", [])}
+            if payload.get("spans"):
+                req["spans"] = payload["spans"]
+            if self._attempt >= 0:
+                req["attempt"] = self._attempt
+            self._client.call("update_metrics", req, retries=1,
+                              timeout_sec=5.0, wait_for_ready=False)
         except Exception:  # noqa: BLE001 — metrics never break training
             LOG.debug("tpu metrics push failed", exc_info=True)
 
     def close(self, timeout: float = 2.0) -> None:
         """Flush-and-stop the background pusher (idempotent). Queued
-        samples ahead of the close marker are still delivered."""
+        samples ahead of the close marker are still delivered. A wedged
+        worker (full queue: it is stuck mid-RPC) still gets a BOUNDED
+        join — the close sentinel can't be enqueued, but the caller must
+        not return while the wedged daemon may still be mid-push with
+        the process about to exit underneath it."""
         worker, self._worker = self._worker, None
         if worker is None or not worker.is_alive():
             return
         try:
             self._queue.put(_CLOSE, timeout=timeout)
         except queue.Full:
-            return   # worker wedged on a slow RPC; it is a daemon thread
+            # worker wedged on a slow RPC: give it the same bounded grace
+            # the clean path gets, then abandon it (daemon thread)
+            worker.join(timeout)
+            return
         worker.join(timeout)
 
 
@@ -191,7 +221,7 @@ class ServingMetricsReporter(TpuMetricsReporter):
             return
         if not metrics:
             return
-        self._enqueue(metrics)
+        self._enqueue({"metrics": metrics})
 
     def close(self, timeout: float = 2.0) -> None:
         self._sampler_stop.set()

@@ -110,6 +110,32 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def setup(self) -> None:
+        # lifecycle tracing: parented under the executor's user_process
+        # span via the env it rendered; spans ship through the reporter's
+        # non-blocking queue — the hot loop never gains an RPC
+        from tony_tpu.observability.trace import SpanRecorder
+        self._tracer = SpanRecorder.from_env(
+            os.environ,
+            task_id=(f"{os.environ.get(C.JOB_NAME, '')}:"
+                     f"{os.environ.get(C.TASK_INDEX, '0')}"
+                     if os.environ.get(C.JOB_NAME) else ""),
+            attempt=int(os.environ.get(C.TASK_ATTEMPT, "0") or 0))
+        setup_span = self._tracer.start("trainer_setup")
+        try:
+            self._setup_inner()
+        except BaseException:
+            self._tracer.end(setup_span, "ERROR")
+            raise
+        self._tracer.end(setup_span, attrs={"resumed_step": self.step})
+        self._flush_spans()
+
+    def _flush_spans(self) -> None:
+        tracer = getattr(self, "_tracer", None)
+        reporter = getattr(self, "_metrics_reporter", None)
+        if tracer is not None and reporter is not None and tracer.enabled:
+            reporter.report_spans(tracer.drain())
+
+    def _setup_inner(self) -> None:
         maybe_initialize_distributed()
         # device evidence AFTER distributed init — jax.devices() here
         # would otherwise initialize the local backend first and make a
@@ -191,10 +217,12 @@ class Trainer:
             # regions it overlaps (mmap) — no host ever holds a full leaf,
             # and the checkpoint reshards onto this run's mesh for free
             LOG.info("resuming from checkpoint step %d", resume)
-            state = restore_checkpoint(
-                cfg.checkpoint_dir, resume,
-                template={"params": self.params,
-                          "opt_state": self.opt_state, "step": 0})
+            with self._tracer.span("checkpoint_restore",
+                                   attrs={"step": resume}):
+                state = restore_checkpoint(
+                    cfg.checkpoint_dir, resume,
+                    template={"params": self.params,
+                              "opt_state": self.opt_state, "step": 0})
             self.params = state["params"]
             self.opt_state = state["opt_state"]
             self.step = int(state["step"])
@@ -306,6 +334,13 @@ class Trainer:
                 {"step": step, "loss": loss_f, "elapsed_s": dt})
             LOG.info("step %d loss %.4f (%.1fs)", step, loss_f, dt)
 
+        # first-step span: dispatch of step 1 includes the jit compile —
+        # the single largest cold-start cost the waterfall must show.
+        # Ends after the first dispatch returns (no device sync added).
+        tracer = getattr(self, "_tracer", None)
+        first_span = (tracer.start("first_step")
+                      if tracer is not None and self.step < cfg.num_steps
+                      else None)
         try:
             with jax.set_mesh(self.mesh):
                 t0 = time.monotonic()
@@ -314,6 +349,11 @@ class Trainer:
                     self.params, self.opt_state, loss = self.train_step(
                         self.params, self.opt_state, batch)
                     self.step += 1
+                    if first_span is not None:
+                        tracer.end(first_span,
+                                   attrs={"step": self.step})
+                        first_span = None
+                        self._flush_spans()
                     if cfg.log_every and self.step % cfg.log_every == 0:
                         if pending is not None:
                             _flush(pending)
@@ -359,6 +399,9 @@ class Trainer:
             # revives the pipeline above with no gap in the stream.
             if isinstance(self._global_data_iter, PrefetchIterator):
                 self._global_data_iter.close()
+            if first_span is not None:   # error before the first step
+                tracer.end(first_span, "ERROR")
+            self._flush_spans()
             self._metrics_reporter.close()
         return self.last_loss
 
@@ -384,11 +427,20 @@ class Trainer:
             from tony_tpu.train.checkpoint import AsyncCheckpointer
             self._checkpointer = AsyncCheckpointer(
                 self.config.checkpoint_dir)
+        tracer = getattr(self, "_tracer", None)
+        span = (tracer.start("checkpoint_save",
+                             attrs={"step": self.step, "final": final})
+                if tracer is not None else None)
         self._checkpointer.save(
             self.step, {"params": self.params, "opt_state": self.opt_state,
                         "step": self.step})
         if final:
             self._checkpointer.close()
             self._checkpointer = None
+        if span is not None:
+            # covers the synchronous snapshot (+ commit when final); the
+            # async file IO continues past it by design
+            tracer.end(span)
+            self._flush_spans()
         LOG.info("checkpointed step %d%s", self.step,
                  " (final)" if final else " (async)")

@@ -9,7 +9,13 @@ headline metrics next to bench.py's training MFU:
 
   {"metric": "serve_tokens_per_sec", "value": ..., "unit": "tok/s",
    "tokens_per_sec": ..., "ttft_p50_s": ..., "ttft_p95_s": ...,
-   "queue_depth_max": ..., ...}
+   "queue_depth_max": ..., "slot_occupancy_pct": ...,
+   "scraped_metrics": {...}, ...}
+
+After the load finishes, the bench also stands up the HTTP frontend and
+scrapes `/v1/metrics` (Prometheus text exposition) so the JSON line
+carries the engine-side TTFT/occupancy exactly as a dashboard would see
+them — drift between the bench's own accounting and the scrape is a bug.
 
 Run: python tools/serve_bench.py [--requests N] [--rate R] [--slots S]
 """
@@ -44,6 +50,8 @@ def main() -> int:
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
 
+    import urllib.request
+
     import numpy as np
     import jax
 
@@ -52,6 +60,7 @@ def main() -> int:
         ContinuousBatchingEngine, QueueFullError,
         _percentile,
     )
+    from tony_tpu.serve.frontend import ServeFrontend
 
     config = get_config(args.config)
     params = llama_init(config, jax.random.PRNGKey(0))
@@ -85,6 +94,31 @@ def main() -> int:
     for h in handles:
         h.result(timeout=300)
     elapsed = time.monotonic() - t0
+
+    # engine-side view over the real scrape path: stand the HTTP frontend
+    # up and read /v1/metrics as a Prometheus scraper would — the bench
+    # then reports the same numbers an operator's dashboard shows
+    scraped = {}
+    frontend = ServeFrontend(engine, port=0, host="127.0.0.1")
+    frontend.start()
+    try:
+        from tony_tpu.observability import prometheus as prom
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{frontend.port}/v1/metrics"
+                f"?format=prometheus", timeout=10) as resp:
+            parsed = prom.parse(resp.read().decode("utf-8"))
+        for key in ("ttft_p50_s", "ttft_p95_s", "slot_occupancy_pct",
+                    "tokens_per_sec", "queue_depth_max"):
+            try:
+                value = prom.get_sample(parsed, f"tony_serving_{key}")
+            except KeyError:
+                continue
+            if value == value:          # skip NaN (no-traffic gauges)
+                scraped[key] = round(value, 4)
+    except Exception as e:  # noqa: BLE001 — the scrape must not fail the bench
+        scraped = {"error": str(e)}
+    finally:
+        frontend.stop()
     engine.stop()
 
     ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
@@ -99,8 +133,11 @@ def main() -> int:
         "ttft_p50_s": round(_percentile(ttfts, 0.50), 4),
         "ttft_p95_s": round(_percentile(ttfts, 0.95), 4),
         "queue_depth_max": snap["queue_depth_max"],
+        "slot_occupancy_pct": round(snap["slot_occupancy_pct"], 2),
         "itl_p50_ms": (round(snap["itl_p50_ms"], 3)
                        if snap.get("itl_p50_ms") is not None else None),
+        # engine-side gauges as read off the /v1/metrics scrape
+        "scraped_metrics": scraped,
         "requests": len(handles),
         "requests_shed": shed,
         "open_loop_rate_rps": args.rate,
