@@ -32,35 +32,14 @@ import time
 BUDGET_SEC = float(os.environ.get("TONY_BENCH_WATCHDOG_SEC", "480"))
 METRIC = "llama_pretrain_mfu_single_chip"
 
-# bf16 peak FLOPs/s per chip by device kind substring (public specs).
-PEAK_FLOPS = (
-    ("v6", 918e12),        # Trillium
-    ("v5p", 459e12),
-    ("v5", 197e12),        # v5e / "v5 lite"
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
+# The peak-FLOPs table and MFU formula live in observability/perf.py —
+# ONE definition shared with tools/tune_mfu.py and the trainer's goodput
+# metrics. perf.py is stdlib-only at import time, so the watchdog parent
+# stays unable to hang on backend init. Re-exported here because
+# tune_mfu and older tooling import them from bench.
+from tony_tpu.observability.perf import (  # noqa: F401
+    CPU_PEAK, DEFAULT_PEAK, PEAK_FLOPS, mfu_pct, peak_flops,
 )
-DEFAULT_PEAK = 459e12
-CPU_PEAK = 1e11            # nominal, keeps MFU finite on dev machines
-
-
-def peak_flops(device) -> float:
-    # The axon tunnel's devices report platform "axon" but are real TPU
-    # chips (canonical platform "tpu") — both must take the TPU branch or
-    # the %MFU denominator is the nominal CPU peak (2000x inflation).
-    if device.platform not in ("tpu", "axon"):
-        return CPU_PEAK
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    if device.platform == "axon":
-        # tunneled devices may not expose a real device_kind; the gen the
-        # tunnel was brought up with is authoritative
-        kind = (os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-                or kind)
-    for sub, peak in PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return DEFAULT_PEAK
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,46 @@
+"""Fixture: goodput ledger exercised through the real channels — a
+GoodputLedger seeded from the executor-rendered TONY_GOODPUT_SEED env
+(so the executor's localization/rendezvous phases are in the books),
+driven through the trainer's phase transitions with real sleeps, and
+pushed to the AM over the public metrics RPC via TpuMetricsReporter.
+The e2e test then asserts history's goodput.json: phases sum to
+wall-clock within 1%, input_stall was carved out of train_step, and the
+job-level goodput_pct is derived from these numbers."""
+import os
+import sys
+import time
+
+from tony_tpu import constants as C
+from tony_tpu.observability.perf import GoodputLedger
+from tony_tpu.train.metrics import TpuMetricsReporter
+
+ledger = GoodputLedger.from_env(os.environ)
+seed = os.environ.get(C.TONY_GOODPUT_SEED, "")
+if not seed:
+    print("no TONY_GOODPUT_SEED in the rendered env", file=sys.stderr)
+    sys.exit(1)
+
+reporter = TpuMetricsReporter()
+
+time.sleep(0.05)                     # init
+ledger.transition("compile")
+time.sleep(0.10)
+ledger.transition("train_step")
+time.sleep(0.20)
+ledger.carve("input_stall", 0.05)    # the prefetch counter's seconds
+reporter.report(extra=ledger.metrics()
+                + [{"name": "TRAIN_MFU_PCT", "value": 41.5},
+                   {"name": "TRAIN_TOKENS_PER_SEC_PER_CHIP",
+                    "value": 12345.0}])
+ledger.transition("checkpoint_save")
+time.sleep(0.05)
+ledger.transition("train_step")
+time.sleep(0.05)
+ledger.transition("idle")
+reporter.report(extra=ledger.metrics())
+time.sleep(0.3)                      # let the async push land
+reporter.close(timeout=10)
+
+snap = ledger.snapshot()
+drift = abs(sum(snap["phases"].values()) - snap["wall_s"])
+sys.exit(0 if drift < 0.01 * snap["wall_s"] else 1)

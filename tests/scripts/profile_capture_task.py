@@ -1,0 +1,44 @@
+"""Fixture: the trainer-side half of the request_profile workflow,
+driven through the REAL channels — the executor writes the heartbeat-
+piggybacked request into profile_request.json in this cwd, this process
+polls it with the real ProfileCapture state machine, "captures" via
+stub trace fns (the artifact contract, without dragging jax into the
+fixture), and publishes the completion over the public metrics RPC.
+The e2e test asserts the AM copied the artifact into history and
+emitted exactly one PROFILE_CAPTURED event for the double-requested id.
+"""
+import os
+import sys
+import time
+
+from tony_tpu.observability.perf import ProfileCapture
+from tony_tpu.train.metrics import TpuMetricsReporter
+
+reporter = TpuMetricsReporter()
+state = {"captured": False}
+
+
+def publish(pd):
+    reporter.report_profile_done(pd)
+    state["captured"] = True
+
+
+def start_trace(out_dir):
+    # the stub "trace": what jax.profiler.start_trace would begin writing
+    with open(os.path.join(out_dir, "trace.xplane.pb"), "wb") as f:
+        f.write(b"fake-xplane-trace")
+
+
+pc = ProfileCapture(cwd=os.getcwd(), publish=publish,
+                    start_fn=start_trace, stop_fn=lambda: None)
+
+deadline = time.monotonic() + 40
+while not state["captured"] and time.monotonic() < deadline:
+    pc.poll()                 # the trainer polls at log boundaries
+    if pc.active:
+        pc.on_step()          # one "train step" per tick
+    time.sleep(0.05)
+
+time.sleep(1.0)               # let the async profile_done push land
+reporter.close(timeout=10)
+sys.exit(0 if state["captured"] else 1)

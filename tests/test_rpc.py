@@ -67,6 +67,12 @@ class FakeClusterHandler(ClusterServiceHandler):
         self.heartbeats.append(req["task_id"])
         return {}
 
+    def request_profile(self, req):
+        self.profile_requests = getattr(self, "profile_requests", [])
+        self.profile_requests.append(req)
+        return {"request_id": "fake-req", "task_id": "worker:0",
+                "num_steps": int(req.get("num_steps", 0) or 5)}
+
 
 class FakeMetricsHandler(MetricsServiceHandler):
     def __init__(self):
@@ -113,6 +119,10 @@ def test_all_methods_round_trip(cluster):
                                 "barrier_timeout": False}]
     c.task_executor_heartbeat("worker:1")
     assert handler.heartbeats == ["worker:1"]
+    resp = c.request_profile(task_id="worker:0", num_steps=3)
+    assert resp["request_id"] == "fake-req" and resp["num_steps"] == 3
+    assert handler.profile_requests == [{"task_id": "worker:0",
+                                         "num_steps": 3}]
     c.finish_application()
     assert handler.finished
 

@@ -43,6 +43,9 @@ class FakeHandler:
         self.heartbeats += 1
         return {}
 
+    def request_profile(self, req):
+        return {"request_id": "fake"}
+
 
 def test_token_file_roundtrip_and_mode(tmp_path):
     token = generate_token()

@@ -170,6 +170,39 @@ def test_submit_validation(model):
         engine.submit([1, 2, 3], 4)             # queue_depth=2
 
 
+def test_per_request_latency_breakdown(model):
+    """Every finished request carries queue_wait/prefill/decode stamps;
+    the snapshot exposes p50/p95/p99 per phase and metrics() ships the
+    tails over the AM channel (PR5 pillar 3). A second wave submitted
+    while slots are busy must observe a strictly positive queue wait."""
+    params, cfg = model
+    engine = ContinuousBatchingEngine(params, cfg, n_slots=1,
+                                      token_budget=32, queue_depth=8)
+    prompts = _prompts(cfg, (4, 4), seed=7)
+    finished = []
+    engine.on_request_finished = finished.append
+    h1 = engine.submit(prompts[0], 4)
+    h2 = engine.submit(prompts[1], 4)   # queued behind h1's only slot
+    _drain(engine, [h1, h2])
+    for h in (h1, h2):
+        assert h.queue_wait_s is not None and h.queue_wait_s >= 0
+        assert h.prefill_s is not None and h.prefill_s > 0
+        assert h.decode_s is not None and h.decode_s >= 0
+    # h2 waited for h1's slot: its queue phase is real time, not epsilon
+    assert h2.queue_wait_s > h1.queue_wait_s
+    assert [h.request_id for h in finished] == [h1.request_id,
+                                                h2.request_id]
+    snap = engine.snapshot()
+    for phase in ("queue_wait_s", "prefill_s", "decode_ms_per_token"):
+        for tag in ("p50", "p95", "p99"):
+            assert snap[f"{phase}_{tag}"] is not None, (phase, tag)
+    assert snap["queue_wait_s_p99"] >= snap["queue_wait_s_p50"]
+    names = {m["name"] for m in engine.metrics()}
+    assert {"SERVING_QUEUE_WAIT_P95_S", "SERVING_PREFILL_P95_S",
+            "SERVING_DECODE_P95_MS"} <= names
+    engine.stop()
+
+
 def test_queued_token_budget_sheds_before_request_count(model):
     """The queued-WORK bound: a few near-budget requests shed load even
     while the request-count bound still has room."""

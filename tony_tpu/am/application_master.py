@@ -41,7 +41,8 @@ from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
     ApplicationFinished, ApplicationInited, Event, EventType,
-    ServingEndpointRegistered, TaskFinished, TaskRelaunched, TaskStarted,
+    ProfileCaptured, ServingEndpointRegistered, SloViolation, TaskFinished,
+    TaskRelaunched, TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor
 from tony_tpu.rpc.service import (
@@ -107,6 +108,9 @@ class MetricsStore(MetricsServiceHandler):
         # spans piggybacked on metrics pushes land here (the AM wires its
         # SpanStore.add in); None drops them (standalone store in tests)
         self.span_sink = None
+        # profile-capture completions (update_metrics `profile_done`
+        # field) are forwarded here; the AM wires _on_profile_captured
+        self.profile_sink = None
         self._lock = threading.Lock()
 
     def update_metrics(self, req: dict) -> dict:
@@ -152,6 +156,10 @@ class MetricsStore(MetricsServiceHandler):
         sink = self.span_sink
         if spans and sink is not None:
             sink(spans)
+        profile_done = req.get("profile_done")
+        psink = self.profile_sink
+        if isinstance(profile_done, dict) and psink is not None:
+            psink(task_type, index, profile_done)
         return {}
 
     def _track_utilization(self, task_type: str, index: int,
@@ -216,6 +224,48 @@ class MetricsStore(MetricsServiceHandler):
             series = dict(self._series.get((task_type, index), {}))
         return {name: ts.to_list() for name, ts in sorted(series.items())}
 
+    def drop_perf_gauges(self, task_type: str, index: int) -> None:
+        """Remove the GOODPUT_*/TRAIN_* latest values for one slot (the
+        AM archives them at a relaunch; the successor process pushes a
+        fresh ledger). Timeseries history stays — trajectories across
+        the relaunch are still honest, only the latest-value merge view
+        must not double-count the archived epoch."""
+        with self._lock:
+            cur = self._metrics.get(task_type, {}).get(index)
+            if cur is not None:
+                cur[:] = [m for m in cur
+                          if not str(m.get("name", "")).startswith(
+                              ("GOODPUT_", "TRAIN_"))]
+
+    def latest_gauges(self) -> dict[str, dict[str, float]]:
+        """Every slot's latest numeric gauges, keyed "<task_type>:<index>"
+        — the goodput aggregation's input (observability/perf.py reads
+        the GOODPUT_*/TRAIN_* families out of it)."""
+        with self._lock:
+            rows = [(t, i, list(ms))
+                    for t, per_index in self._metrics.items()
+                    for i, ms in per_index.items()]
+        out: dict[str, dict[str, float]] = {}
+        for task_type, index, metrics in rows:
+            gauges = {m["name"]: float(m["value"]) for m in metrics
+                      if m.get("name")
+                      and isinstance(m.get("value"), (int, float))}
+            if gauges:
+                out[f"{task_type}:{index}"] = gauges
+        return out
+
+    def metric_histories(self, metric_name: str) -> dict[str, list]:
+        """One metric's trajectory across every task slot, keyed
+        "<task_type>:<index>" — the SLO watchdog's step-time input."""
+        with self._lock:
+            keys = list(self._series)
+        out: dict[str, list] = {}
+        for t, i in sorted(keys):
+            series = self.get_history(t, i).get(metric_name)
+            if series:
+                out[f"{t}:{i}"] = series
+        return out
+
     def timeseries_dict(self) -> dict[str, dict[str, list]]:
         """Every slot's gauge trajectories, keyed "<task_type>:<index>" —
         the shape flushed into history as metrics.json and served by the
@@ -274,6 +324,27 @@ class ApplicationMaster(ClusterServiceHandler):
             (lambda spans: None))
         if self._trace_enabled:
             self.metrics_store.span_sink = self.span_store.add
+        # goodput / profiling / SLO (observability/perf.py)
+        from tony_tpu.observability.perf import SloWatchdog
+        self._goodput_enabled = conf.get_bool(K.GOODPUT_ENABLED, True)
+        self._profiling_enabled = conf.get_bool(K.PROFILING_ENABLED, True)
+        # task_id -> {"id", "num_steps", "state": pending|sent|done}
+        self._profile_requests: dict[str, dict] = {}
+        self._profiles_captured: set[str] = set()
+        self.metrics_store.profile_sink = self._on_profile_captured
+        # relaunch downtime: per-slot clock from the relaunch decision to
+        # the re-completed gang barrier; counts AGAINST job goodput
+        self._relaunch_pending_since: dict[str, float] = {}
+        self._relaunch_downtime_s = 0.0
+        # dead attempts' final GOODPUT_*/TRAIN_* gauges, archived at the
+        # relaunch decision — the replacement's pushes overwrite the
+        # MetricsStore slot, and a killed attempt's hour of training must
+        # not vanish from the job's wall/productive accounting
+        self._goodput_archive: dict[str, dict[str, float]] = {}
+        self.slo = SloWatchdog(
+            step_regression_pct=conf.get_int(
+                K.SLO_STEP_TIME_REGRESSION_PCT, 0),
+            goodput_floor_pct=conf.get_int(K.SLO_GOODPUT_FLOOR_PCT, 0))
         self._root_span = None
         self._rendezvous_span = None
         # (task_id, attempt) -> open task span (allocation → completion)
@@ -364,6 +435,7 @@ class ApplicationMaster(ClusterServiceHandler):
         self.hb_monitor.start()
         self.event_handler.start()
         self._write_history_config()
+        self._write_am_info()
         self._start_trace()
         self._start_metrics_endpoint()
         hostport_path = os.path.join(self.app_dir, C.AM_HOSTPORT_FILE)
@@ -372,6 +444,24 @@ class ApplicationMaster(ClusterServiceHandler):
             f.write(f"{self.host}:{self.rpc_port}")
         os.replace(tmp, hostport_path)
         LOG.info("AM RPC serving at %s:%d", self.host, self.rpc_port)
+
+    def _write_am_info(self) -> None:
+        """Publish this AM's RPC address into the history dir so the
+        portal can reach a RUNNING job's control plane (the profile
+        button's POST /api/jobs/:id/profile needs an address the
+        history-based portal can discover)."""
+        try:
+            from tony_tpu.events.history import write_json_atomic
+            write_json_atomic(
+                os.path.join(self.history_dir, C.AM_INFO_FILE),
+                {"host": self.host, "rpc_port": self.rpc_port,
+                 "app_id": self.app_id,
+                 # the portal holds no credential: on a secured cluster
+                 # its profile POST must answer "use the CLI" instead of
+                 # misreporting an AM outage
+                 "security_enabled": bool(self._auth_token)})
+        except Exception:  # noqa: BLE001 — observability must not kill the AM
+            LOG.exception("failed to write AM info file")
 
     def _start_trace(self) -> None:
         """Open the application root span and back-fill the client-side
@@ -419,12 +509,42 @@ class ApplicationMaster(ClusterServiceHandler):
 
     def _render_prometheus(self) -> str:
         """Task gauges (latest values, {app_id,task_type,index,attempt}
-        labels) + this AM process's own health registry."""
+        labels) + job-level goodput + this AM process's own health
+        registry."""
         from tony_tpu.observability.metrics import REGISTRY
         from tony_tpu.observability.prometheus import render
         families = self.metrics_store.prometheus_families(self.app_id)
+        if self._goodput_enabled:
+            job = self.goodput_dict()["job"]
+            labels = {"app_id": self.app_id}
+            for key, name in (
+                    ("goodput_pct", "tony_job_goodput_pct"),
+                    ("productive_s", "tony_job_productive_seconds"),
+                    ("relaunch_downtime_s",
+                     "tony_job_relaunch_downtime_seconds")):
+                families.append({"name": name, "type": "gauge", "help": "",
+                                 "samples": [(labels, float(job[key]))]})
         families += REGISTRY.families()
         return render(families)
+
+    def goodput_dict(self) -> dict:
+        """Job-level time accounting: per-task ledgers (pushed as
+        GOODPUT_* gauges over the metrics RPC) + the fault-tolerance
+        layer's relaunch downtime (observability/perf.py
+        aggregate_goodput) — the shape flushed as goodput.json."""
+        from tony_tpu.observability.perf import aggregate_goodput
+        with self._lock:
+            downtime = self._relaunch_downtime_s
+            now = time.monotonic()
+            # in-flight relaunch gaps count at their elapsed-so-far, so a
+            # live scrape mid-relaunch already shows the bleeding
+            downtime += sum(now - t0
+                            for t0 in self._relaunch_pending_since.values())
+            # superseded attempts appear as their own "<task>@aN" entries
+            # so their wall/productive time stays in the job totals
+            per_task = dict(self._goodput_archive)
+        per_task.update(self.metrics_store.latest_gauges())
+        return aggregate_goodput(per_task, relaunch_downtime_s=downtime)
 
     def _task_span_start(self, task: Task, container: Container) -> None:
         """Open the allocation→completion span for one task attempt; its
@@ -463,7 +583,7 @@ class ApplicationMaster(ClusterServiceHandler):
         """Spans + metric timeseries into the history dir, next to the
         event log (the portal's waterfall and metrics.json sources)."""
         from tony_tpu.events.history import (
-            write_metrics_file, write_spans_file,
+            write_goodput_file, write_metrics_file, write_spans_file,
         )
         try:
             if self._trace_enabled:
@@ -474,6 +594,8 @@ class ApplicationMaster(ClusterServiceHandler):
                 write_spans_file(self.history_dir, self.span_store.to_list())
             write_metrics_file(self.history_dir,
                                self.metrics_store.timeseries_dict())
+            if self._goodput_enabled:
+                write_goodput_file(self.history_dir, self.goodput_dict())
         except Exception:  # noqa: BLE001 — observability must not fail _finish
             LOG.exception("failed to flush spans/metrics into history")
 
@@ -533,10 +655,19 @@ class ApplicationMaster(ClusterServiceHandler):
             store.put(final_hist,
                       f"history/{os.path.basename(final_hist)}")
             for extra in (C.PORTAL_CONFIG_FILE, C.SPANS_FILE,
-                          C.METRICS_FILE):
+                          C.METRICS_FILE, C.GOODPUT_FILE):
                 p = os.path.join(self.history_dir, extra)
                 if os.path.exists(p):
                     store.put(p, f"history/{extra}")
+            # profiler-capture artifacts travel with the history too
+            profiles_root = os.path.join(self.history_dir,
+                                         C.PROFILES_DIR_NAME)
+            if os.path.isdir(profiles_root):
+                for dirpath, _, files in os.walk(profiles_root):
+                    for name in files:
+                        p = os.path.join(dirpath, name)
+                        rel = os.path.relpath(p, self.history_dir)
+                        store.put(p, f"history/{rel}")
             # aggregated container logs ride along so an off-host portal
             # can serve /logs/:id/:task/:stream without reaching this host
             logs_root = os.path.join(self.history_dir,
@@ -762,6 +893,10 @@ class ApplicationMaster(ClusterServiceHandler):
                     self._registration_deadline = None
                     # the barrier-wait span covers scheduling → full gang
                     self._rendezvous_span_end()
+                    # any in-flight relaunch gap closes here: the gang is
+                    # whole again, downtime stops accruing
+                    self._close_relaunch_downtime()
+            self._check_slo()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
                 LOG.info("all %d tracked tasks completed", total)
@@ -777,6 +912,53 @@ class ApplicationMaster(ClusterServiceHandler):
         if not ok:
             LOG.info("session failed: %s", session.final_message)
         return ok
+
+    def _close_relaunch_downtime(self) -> None:
+        """Fold every open relaunch gap into the accumulated downtime
+        (caller holds the AM lock, or the app is single-threadedly
+        finishing). Idempotent: the pending map empties."""
+        now = time.monotonic()
+        for t0 in self._relaunch_pending_since.values():
+            self._relaunch_downtime_s += now - t0
+        self._relaunch_pending_since.clear()
+
+    def _check_slo(self) -> None:
+        """One SLO-watchdog pass (monitor-loop cadence): newly entered
+        violations become WARNING history events; the current latch set
+        is exposed as alert gauges on /metrics."""
+        if (self.slo.step_regression_pct <= 0
+                and self.slo.goodput_floor_pct <= 0):
+            return      # both checks off (the default): no per-tick work
+        try:
+            goodput_pct = None
+            if self.slo.goodput_floor_pct > 0 and self._goodput_enabled:
+                gd = self.goodput_dict()
+                # no ledgers yet (containers still launching/compiling)
+                # reads as 0% — that is absence of data, not a violation
+                if gd["tasks"]:
+                    goodput_pct = gd["job"]["goodput_pct"]
+            step_series = (
+                self.metrics_store.metric_histories("TRAIN_STEP_TIME_MS")
+                if self.slo.step_regression_pct > 0 else {})
+            violations = self.slo.check(step_series,
+                                        goodput_pct=goodput_pct)
+            for v in violations:
+                LOG.warning("SLO violation (%s): %s", v["kind"],
+                            v["message"])
+                self.event_handler.emit(Event(
+                    EventType.SLO_VIOLATION,
+                    SloViolation(kind=v["kind"], message=v["message"],
+                                 task_id=v.get("task_id", ""),
+                                 value=float(v.get("value", 0.0)),
+                                 threshold=float(v.get("threshold", 0.0)))))
+            if (self.slo.step_regression_pct > 0
+                    or self.slo.goodput_floor_pct > 0):
+                from tony_tpu.observability.metrics import REGISTRY
+                REGISTRY.gauge("tony_slo_violations_active",
+                               app_id=self.app_id).set(
+                    len(self.slo.active()))
+        except Exception:  # noqa: BLE001 — the watchdog must never kill the AM
+            LOG.exception("SLO check failed")
 
     def _reset(self) -> None:
         """Stop this session's containers and bump the session id so stale
@@ -818,6 +1000,8 @@ class ApplicationMaster(ClusterServiceHandler):
         else:
             status = "FAILED"
         # close the lifecycle trace before flushing it next to the events
+        with self._lock:
+            self._close_relaunch_downtime()
         self._rendezvous_span_end("OK" if succeeded else "ERROR")
         if self._root_span is not None:
             self.tracer.end(self._root_span,
@@ -1119,6 +1303,7 @@ class ApplicationMaster(ClusterServiceHandler):
         # in the liveliness monitor and expire later
         self.hb_monitor.unregister(task.task_id)
         self.metrics_store.clear_utilization_state(task.job_name, task.index)
+        self._clear_profile_request(task.task_id)
         self._task_span_end(
             task.task_id, observed_attempt,
             "OK" if exit_code in (0, C.EXIT_KILLED_BY_AM) else "ERROR",
@@ -1271,6 +1456,27 @@ class ApplicationMaster(ClusterServiceHandler):
                     time.monotonic() + self._alloc_timeout_ms / 1000.0)
             new_attempt = task.attempt
             new_generation = session.spec_generation
+            # goodput: the relaunch gap starts NOW and closes when the
+            # gang barrier completes again — wall-clock no task process
+            # exists to account for, charged against job goodput
+            self._relaunch_pending_since[task.task_id] = time.monotonic()
+            # ...and EVERY task's ledger is archived under the superseded
+            # generation: the victim's replacement AND each survivor's
+            # relaunched user process start fresh ledgers whose pushes
+            # overwrite the slot (merge-by-name), so the pre-relaunch
+            # epoch would otherwise vanish from the job accounting. The
+            # live perf gauges are dropped after archiving — keeping
+            # both would double-count the epoch until the successor's
+            # first push.
+            epoch = new_generation - 1
+            for tid, gauges in self.metrics_store.latest_gauges().items():
+                if any(k.startswith("GOODPUT_") for k in gauges):
+                    self._goodput_archive[f"{tid}@g{epoch}"] = gauges
+                    name, _, idx = tid.rpartition(":")
+                    self.metrics_store.drop_perf_gauges(name, int(idx))
+            # a pending profiler ask targeting the dead attempt would
+            # wedge the slot forever; the operator re-requests
+            self._clear_profile_request(task.task_id)
             LOG.warning("relaunching task %s (%s): attempt %d/%d, spec "
                         "generation %d, stopping container %s",
                         task.task_id, reason, new_attempt + 1, max_attempts,
@@ -1448,6 +1654,7 @@ class ApplicationMaster(ClusterServiceHandler):
                                       else task.attempt))):
             return {}
         self.hb_monitor.unregister(task_id)
+        self._clear_profile_request(task_id)
         session.on_task_completed(req["job_name"], int(req["job_index"]),
                                   exit_code)
         self._wake.set()
@@ -1489,7 +1696,124 @@ class ApplicationMaster(ClusterServiceHandler):
             # flight — either way the ping must not resurrect it
             LOG.debug("heartbeat from %s has no liveliness entry",
                       req["task_id"])
-        return {"spec_generation": generation}
+        resp = {"spec_generation": generation}
+        # on-demand profiler: a pending request for this task rides its
+        # heartbeat (resent until the capture completes — the executor's
+        # request-file write and the trainer's id-dedup are idempotent)
+        with self._lock:
+            preq = self._profile_requests.get(req["task_id"])
+            if preq is not None and preq["state"] in ("pending", "sent"):
+                preq["state"] = "sent"
+                resp["profile_request"] = {"request_id": preq["id"],
+                                           "num_steps": preq["num_steps"]}
+        return resp
+
+    # an in-flight profiler ask older than this is considered lost (the
+    # trainer's start_trace failed, or the profile_done push was dropped)
+    # and a new request replaces it instead of echoing the dead id forever
+    PROFILE_REQUEST_TTL_SEC = 600.0
+
+    def _clear_profile_request(self, task_id: str) -> None:
+        """Drop a not-yet-completed profiler ask for a task that is gone
+        (completed or relaunched) — it could never be satisfied, and
+        leaving it would wedge request_profile for the slot with
+        duplicate:true for the rest of the application."""
+        with self._lock:
+            entry = self._profile_requests.get(task_id)
+            if entry is not None and entry["state"] != "done":
+                del self._profile_requests[task_id]
+
+    def request_profile(self, req: dict) -> dict:
+        """Operator ask: capture a profiler trace on one task's trainer.
+        Default target is the first running tracked task; the ask rides
+        that task's next heartbeat. Idempotent while in flight: a double
+        request returns the same request_id (until the TTL calls the
+        in-flight one lost)."""
+        from tony_tpu.observability.perf import new_profile_request_id
+        if not self._profiling_enabled:
+            return {"error": "profiling disabled (tony.profiling.enabled)"}
+        session = self.session
+        if session is None:
+            return {"error": "no active session"}
+        task_id = str(req.get("task_id", "") or "")
+        if not task_id:
+            running = [t for tasks in session.job_tasks.values()
+                       for t in tasks
+                       if session.is_tracked(t.job_name)
+                       and not t.completed and t.container_id]
+            if not running:
+                return {"error": "no running tracked task to profile"}
+            task_id = running[0].task_id
+        else:
+            task = session.get_task_by_id(task_id)
+            if task is None:
+                return {"error": f"no such task {task_id!r}"}
+            if task.completed:
+                return {"error": f"task {task_id} already completed"}
+        steps = int(req.get("num_steps", 0) or 0) or self.conf.get_int(
+            K.PROFILING_DEFAULT_STEPS, 5)
+        now = time.monotonic()
+        with self._lock:
+            existing = self._profile_requests.get(task_id)
+            if (existing is not None
+                    and existing["state"] in ("pending", "sent")
+                    and now - existing.get("ts", now)
+                    < self.PROFILE_REQUEST_TTL_SEC):
+                return {"request_id": existing["id"], "task_id": task_id,
+                        "num_steps": existing["num_steps"],
+                        "duplicate": True}
+            rid = new_profile_request_id()
+            self._profile_requests[task_id] = {
+                "id": rid, "num_steps": steps, "state": "pending",
+                "ts": now}
+        LOG.info("profile requested for %s (%d steps, id %s)", task_id,
+                 steps, rid)
+        return {"request_id": rid, "task_id": task_id, "num_steps": steps}
+
+    def _on_profile_captured(self, task_type: str, index: int,
+                             pd: dict) -> None:
+        """A trainer finished its capture (update_metrics profile_done):
+        link the artifact into history — copy the trace dir next to the
+        event log, publish it to the staging store at finish, emit
+        PROFILE_CAPTURED. Idempotent per request_id."""
+        task_id = f"{task_type}:{index}"
+        rid = str(pd.get("request_id", "") or "")
+        if not rid:
+            return
+        with self._lock:
+            if rid in self._profiles_captured:
+                return
+            self._profiles_captured.add(rid)
+            entry = self._profile_requests.get(task_id)
+            if entry is not None and entry["id"] == rid:
+                entry["state"] = "done"
+        rel_dir = os.path.join(C.PROFILES_DIR_NAME, rid)
+        dst = os.path.join(self.history_dir, rel_dir)
+        src = str(pd.get("path", "") or "")
+        try:
+            if src and os.path.isdir(src):
+                import shutil
+                shutil.copytree(src, dst, dirs_exist_ok=True)
+            else:
+                # artifact not reachable from the AM host (off-host
+                # container without a shared fs): the event still links
+                # the source path for operators with node access
+                os.makedirs(dst, exist_ok=True)
+                meta = {"source_path": src, "note": "artifact not "
+                        "reachable from the AM host"}
+                with open(os.path.join(dst, "UNREACHABLE.json"), "w",
+                          encoding="utf-8") as f:
+                    json.dump(meta, f)
+        except Exception:  # noqa: BLE001 — profiling must not fail the app
+            LOG.exception("failed to copy profile artifact %s", src)
+        LOG.info("profile %s captured by %s (%s steps) -> %s", rid,
+                 task_id, pd.get("num_steps", "?"), dst)
+        self.event_handler.emit(Event(
+            EventType.PROFILE_CAPTURED,
+            ProfileCaptured(task_type, index, rid, rel_dir,
+                            num_steps=int(pd.get("num_steps", 0) or 0),
+                            duration_ms=int(pd.get("duration_ms", 0)
+                                            or 0))))
 
 
 class _Requestor(ResourceRequestor):

@@ -1,4 +1,4 @@
-"""`python -m tony_tpu.cli {submit|local|notebook} ...`
+"""`python -m tony_tpu.cli {submit|local|notebook|profile} ...`
 
 - submit   — ClusterSubmitter equivalent (cli/ClusterSubmitter.java:41-94):
              run against the configured cluster workdir; app artifacts
@@ -7,6 +7,10 @@
              ephemeral workdir, removed after the run.
 - notebook — NotebookSubmitter equivalent (cli/NotebookSubmitter.java:46-146):
              single-node app on the AM + local TCP proxy to it.
+- profile  — ask a RUNNING app's AM to capture an XLA profiler trace on
+             one task's trainer (request_profile RPC; the artifact lands
+             in the job's history as profiles/<request_id>/ and a
+             PROFILE_CAPTURED event links it).
 """
 
 from __future__ import annotations
@@ -18,7 +22,54 @@ from tony_tpu.cli.cluster_submitter import submit as cluster_submit
 from tony_tpu.cli.local_submitter import submit as local_submit
 from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
-USAGE = "usage: python -m tony_tpu.cli {submit|local|notebook} [args...]"
+USAGE = ("usage: python -m tony_tpu.cli "
+         "{submit|local|notebook|profile} [args...]")
+
+
+def profile(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli profile <app_dir> [--task-id worker:0]
+    [--steps N]` — the operator verb behind the request_profile RPC."""
+    import argparse
+    import json
+    import os
+
+    from tony_tpu import constants as C
+    from tony_tpu.rpc.client import ClusterServiceClient
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli profile")
+    parser.add_argument("app_dir",
+                        help="the application dir the client created "
+                             "(holds the amhostport file)")
+    parser.add_argument("--task-id", default="",
+                        help="task to profile, e.g. worker:0 (default: "
+                             "the AM picks the first running tracked "
+                             "task)")
+    parser.add_argument("--steps", type=int, default=0,
+                        help="trace length in train steps (0 = "
+                             "tony.profiling.default-steps)")
+    args = parser.parse_args(argv)
+    hostport_path = os.path.join(args.app_dir, C.AM_HOSTPORT_FILE)
+    try:
+        with open(hostport_path, "r", encoding="utf-8") as f:
+            host, _, port = f.read().strip().rpartition(":")
+    except OSError as e:
+        print(f"cannot read {hostport_path}: {e} — is the app running?",
+              file=sys.stderr)
+        return 1
+    from tony_tpu.security import read_token_file
+    token = read_token_file(args.app_dir)
+    client = ClusterServiceClient(host, int(port),
+                                  auth_token=token or None)
+    try:
+        resp = client.request_profile(task_id=args.task_id,
+                                      num_steps=args.steps)
+    except Exception as e:  # noqa: BLE001 — operator tool, report and exit
+        print(f"request_profile failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    print(json.dumps(resp or {}, indent=1))
+    return 0 if not (resp or {}).get("error") else 1
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -36,6 +87,8 @@ def main(argv: list[str] | None = None) -> int:
         return local_submit(rest)
     if cmd == "notebook":
         return notebook_submit(rest)
+    if cmd == "profile":
+        return profile(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

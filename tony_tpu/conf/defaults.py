@@ -104,6 +104,11 @@ DEFAULTS = {
     K.METRICS_PORT: 0,           # 0 = ephemeral; -1 = no /metrics endpoint
     K.TRACE_ENABLED: True,
     K.TRACE_MAX_SPANS: 2048,
+    K.GOODPUT_ENABLED: True,
+    K.PROFILING_ENABLED: True,
+    K.PROFILING_DEFAULT_STEPS: 5,
+    K.SLO_STEP_TIME_REGRESSION_PCT: 0,   # 0 = step-time check disabled
+    K.SLO_GOODPUT_FLOOR_PCT: 0,          # 0 = goodput-floor check disabled
 
     # portal
     K.PORTAL_PORT: 19886,

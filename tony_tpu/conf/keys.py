@@ -136,6 +136,18 @@ TRACE_ENABLED = "tony.trace.enabled"
 # cap on spans held by the AM's SpanStore (and per-process recorders);
 # overflow is counted, never grown
 TRACE_MAX_SPANS = "tony.trace.max-spans"
+# goodput ledger (observability/perf.py): AM-side aggregation of per-task
+# phase accounting into goodput.json + job-level Prometheus gauges
+GOODPUT_ENABLED = "tony.goodput.enabled"
+# on-demand profiler capture (request_profile RPC / CLI verb / portal
+# POST): master switch + trace length when the request doesn't name one
+PROFILING_ENABLED = "tony.profiling.enabled"
+PROFILING_DEFAULT_STEPS = "tony.profiling.default-steps"
+# SLO watchdog (AM monitor loop): WARNING history events + alert gauges
+# when a task's step time regresses past this percentage over its own
+# baseline, or job goodput falls below this floor; 0 disables either check
+SLO_STEP_TIME_REGRESSION_PCT = "tony.slo.step-time-regression-pct"
+SLO_GOODPUT_FLOOR_PCT = "tony.slo.goodput-floor-pct"
 
 # --- proxy ---------------------------------------------------------------
 # externally reachable base URL of an authenticated tony_tpu.proxy fronting
@@ -194,7 +206,8 @@ JOBTYPE_INSTANCES_RE = re.compile(r"^tony\.([a-z][a-z0-9_\-]*)\.instances$")
 RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
-    "execution", "other", "queues", "metrics", "trace",
+    "execution", "other", "queues", "metrics", "trace", "goodput",
+    "profiling", "slo",
 })
 
 

@@ -76,6 +76,11 @@ TPU_NUM_SLICES = "TPU_NUM_SLICES"
 # waterfall on the portal job page.
 TONY_TRACE_ID = "TONY_TRACE_ID"
 TONY_PARENT_SPAN = "TONY_PARENT_SPAN"
+# executor-accounted goodput phases handed to the user process (JSON
+# {"localization": s, "rendezvous_wait": s}) so the trainer's single
+# per-task ledger covers the whole container lifetime without
+# double-counting (observability/perf.py GoodputLedger.from_env)
+TONY_GOODPUT_SEED = "TONY_GOODPUT_SEED"
 
 # Paths handed to AM / executor processes via env
 TONY_CONF_PATH = "TONY_CONF_PATH"    # abs path of the frozen tony-final.json
@@ -103,8 +108,15 @@ PORTAL_CONFIG_FILE = "config.json"   # frozen conf copy in each history dir
 HISTORY_LOGS_DIR_NAME = "logs"       # aggregated container logs in history
 SPANS_FILE = "spans.json"            # lifecycle spans flushed next to events
 METRICS_FILE = "metrics.json"        # per-gauge timeseries flushed at finish
+GOODPUT_FILE = "goodput.json"        # per-task + job time accounting (perf.py)
 TRACE_SEED_FILE = "trace.json"       # client-written {trace_id, submit_ms}
 AM_METRICS_PORT_FILE = "am-metrics-port"  # bound /metrics scrape port
+AM_INFO_FILE = "am.json"             # {host, rpc_port} in the history dir, so
+                                     # the portal can reach a RUNNING job's AM
+                                     # (POST /api/jobs/:id/profile)
+PROFILE_REQUEST_FILE = "profile_request.json"  # executor-written, trainer-read
+                                     # (heartbeat-piggybacked request_profile)
+PROFILES_DIR_NAME = "profiles"       # trace artifacts: container cwd + history
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------

@@ -53,12 +53,18 @@ _INPROGRESS_RE = re.compile(
     + re.escape(C.HISTORY_INPROGRESS_SUFFIX) + r"$")
 
 
-def _write_json_atomic(path: str, obj: Any) -> None:
+def write_json_atomic(path: str, obj: Any) -> None:
+    """Tmp-write + rename JSON — the one atomic-write helper (sidecar
+    files here, the AM's am.json, the executor's profile-request relay
+    file all go through it so a crash-safety fix lands everywhere)."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(obj, f, indent=1, sort_keys=True)
     os.replace(tmp, path)
+
+
+_write_json_atomic = write_json_atomic
 
 
 def _read_json(path: str, default: Any) -> Any:
@@ -85,6 +91,17 @@ def write_metrics_file(history_dir: str, series: dict) -> None:
 
 def read_metrics_file(history_dir: str) -> dict:
     out = _read_json(os.path.join(history_dir, C.METRICS_FILE), {})
+    return out if isinstance(out, dict) else {}
+
+
+def write_goodput_file(history_dir: str, goodput: dict) -> None:
+    """goodput: observability.perf.aggregate_goodput's shape — per-task
+    phase accounting + the job-level goodput_pct."""
+    _write_json_atomic(os.path.join(history_dir, C.GOODPUT_FILE), goodput)
+
+
+def read_goodput_file(history_dir: str) -> dict:
+    out = _read_json(os.path.join(history_dir, C.GOODPUT_FILE), {})
     return out if isinstance(out, dict) else {}
 
 

@@ -23,6 +23,8 @@ class EventType(str, enum.Enum):
     TASK_FINISHED = "TASK_FINISHED"
     TASK_RELAUNCHED = "TASK_RELAUNCHED"
     SERVING_ENDPOINT_REGISTERED = "SERVING_ENDPOINT_REGISTERED"
+    PROFILE_CAPTURED = "PROFILE_CAPTURED"
+    SLO_VIOLATION = "SLO_VIOLATION"
 
 
 @dataclass
@@ -78,6 +80,34 @@ class ServingEndpointRegistered:
 
 
 @dataclass
+class ProfileCaptured:
+    """No reference equivalent: an on-demand profiler capture
+    (request_profile RPC) finished and its trace artifact was linked into
+    history under `path` (relative to the job's history dir) — the
+    operator workflow that turns the always-on profiler *server* into an
+    after-the-fact, remote-container-friendly capture."""
+    task_type: str
+    task_index: int
+    request_id: str
+    path: str           # history-dir-relative artifact dir
+    num_steps: int = 0
+    duration_ms: int = 0
+
+
+@dataclass
+class SloViolation:
+    """No reference equivalent: the AM's SLO watchdog observed a
+    threshold breach (tony.slo.*) — step-time regression against the
+    task's own baseline, or job goodput below the floor. WARNING
+    severity: recorded, never acted on."""
+    kind: str           # "step_time_regression" | "goodput_floor"
+    message: str
+    task_id: str = ""   # "" for job-level conditions
+    value: float = 0.0
+    threshold: float = 0.0
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -93,10 +123,13 @@ _PAYLOADS = {
     EventType.TASK_FINISHED: TaskFinished,
     EventType.TASK_RELAUNCHED: TaskRelaunched,
     EventType.SERVING_ENDPOINT_REGISTERED: ServingEndpointRegistered,
+    EventType.PROFILE_CAPTURED: ProfileCaptured,
+    EventType.SLO_VIOLATION: SloViolation,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
-                TaskFinished, TaskRelaunched, ServingEndpointRegistered]
+                TaskFinished, TaskRelaunched, ServingEndpointRegistered,
+                ProfileCaptured, SloViolation]
 
 
 @dataclass

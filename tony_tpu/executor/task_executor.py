@@ -59,7 +59,8 @@ class Heartbeater(threading.Thread):
 
     def __init__(self, client: ClusterServiceClient, task_id: str,
                  interval_sec: float, on_fatal=None, task_attempt: int = -1,
-                 on_generation=None, silent: bool = False):
+                 on_generation=None, silent: bool = False,
+                 on_profile=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -67,6 +68,9 @@ class Heartbeater(threading.Thread):
         self._interval = interval_sec
         self._on_fatal = on_fatal  # kill the user process before we die
         self._on_generation = on_generation
+        # heartbeat-piggybacked on-demand profiler ask (observability/
+        # perf.py): the executor relays it to the trainer via a cwd file
+        self._on_profile = on_profile
         self._stop = threading.Event()
         # TEST hook: skip the first N heartbeats to simulate missed HBs
         # (TaskExecutor.java:334-344)
@@ -96,6 +100,9 @@ class Heartbeater(threading.Thread):
                 generation = (resp or {}).get("spec_generation")
                 if generation and self._on_generation is not None:
                     self._on_generation(int(generation))
+                profile_req = (resp or {}).get("profile_request")
+                if profile_req and self._on_profile is not None:
+                    self._on_profile(profile_req)
             except Exception:  # noqa: BLE001
                 self._consecutive_failures += 1
                 LOG.warning("heartbeat failed (%d consecutive)",
@@ -219,7 +226,8 @@ class TaskExecutor:
                 on_fatal=self._kill_user_proc,
                 task_attempt=self.task_attempt,
                 on_generation=self._on_generation,
-                silent=self._hb_silent_for_testing())
+                silent=self._hb_silent_for_testing(),
+                on_profile=self._on_profile_request)
             self.heartbeater.start()
         host_port = f"{self.host}:{self.port}"
         LOG.info("registering %s at %s (attempt %d)", self.task_id,
@@ -261,6 +269,27 @@ class TaskExecutor:
                         "was relaunched; re-entering gang rendezvous",
                         generation, launched)
             self._kill_user_proc()
+
+    def _on_profile_request(self, preq: dict) -> None:
+        """Relay a heartbeat-piggybacked request_profile ask to the user
+        process: write it atomically into the container cwd (the
+        trainer's cwd), where ProfileCapture.poll() finds it at log
+        boundaries. Resends of the same request id rewrite the same
+        content — the trainer dedups by id, so this is idempotent."""
+        rid = str(preq.get("request_id", "") or "")
+        if not rid or rid == getattr(self, "_last_profile_request", ""):
+            return
+        self._last_profile_request = rid
+        try:
+            from tony_tpu.events.history import write_json_atomic
+            write_json_atomic(
+                os.path.join(os.getcwd(), C.PROFILE_REQUEST_FILE),
+                {"request_id": rid,
+                 "num_steps": int(preq.get("num_steps", 1) or 1)})
+            LOG.info("profile request %s relayed to the user process "
+                     "(%s steps)", rid, preq.get("num_steps"))
+        except OSError:
+            LOG.exception("could not write the profile request file")
 
     def _take_respec(self) -> bool:
         with self._respec_lock:
@@ -394,14 +423,23 @@ class TaskExecutor:
         stops only its user process, re-enters the gang barrier, and
         relaunches the user command against the replacement's host:port —
         the container and its localized resources stay alive."""
+        # goodput seed: the phases THIS process owns (localization,
+        # barrier wait) are handed to the user process so the trainer's
+        # single per-task ledger covers them (observability/perf.py)
+        self._goodput_seed = {"localization": 0.0, "rendezvous_wait": 0.0}
+        loc_t0 = time.monotonic()
         with self.tracer.span("executor_localization"):
             self.localize_resources()
+        self._goodput_seed["localization"] = time.monotonic() - loc_t0
         self.setup_ports()
         try:
+            barrier_t0 = time.monotonic()
             barrier_span = self.tracer.start("rendezvous_wait")
             cluster_spec = self.register_and_get_cluster_spec()
             self.tracer.end(barrier_span,
                             "OK" if cluster_spec is not None else "ERROR")
+            self._goodput_seed["rendezvous_wait"] += (
+                time.monotonic() - barrier_t0)
             self._push_spans()
             if cluster_spec is None:
                 LOG.error("gang rendezvous timed out after %ds",
@@ -444,6 +482,10 @@ class TaskExecutor:
                     "user_process",
                     attrs={"generation": self._spec_generation})
                 env.update(self.tracer.env(proc_span))
+                import json as _json
+                env[C.TONY_GOODPUT_SEED] = _json.dumps(
+                    {k: round(v, 4)
+                     for k, v in self._goodput_seed.items()})
                 exit_code = self._execute(env, timeout_ms / 1000.0)
                 self.tracer.end(proc_span,
                                 "OK" if exit_code == 0 else "ERROR",
@@ -474,6 +516,7 @@ class TaskExecutor:
                 # life. A dead AM is covered by the heartbeater's
                 # self-destruct.
                 cluster_spec = None
+                barrier_t0 = time.monotonic()
                 barrier_span = self.tracer.start(
                     "rendezvous_wait", attrs={"re_entry": True})
                 for _ in range(3):
@@ -487,6 +530,8 @@ class TaskExecutor:
                 self.tracer.end(
                     barrier_span,
                     "OK" if cluster_spec is not None else "ERROR")
+                self._goodput_seed["rendezvous_wait"] += (
+                    time.monotonic() - barrier_t0)
                 self._push_spans()
                 if cluster_spec is None:
                     LOG.error("re-rendezvous never completed after 3 "

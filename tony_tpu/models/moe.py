@@ -65,6 +65,35 @@ class MoEConfig(LlamaConfig):
                 f"dispatch_mode must be 'sparse' or 'dense', got "
                 f"{self.dispatch_mode!r}")
 
+    def num_params(self) -> int:
+        """Total parameters: the dense count with the single SwiGLU MLP
+        swapped for `n_experts` expert banks + the router."""
+        d, f, L, E = self.dim, self.ffn_dim, self.n_layers, self.n_experts
+        dense = super().num_params()
+        # super() counted ONE 3*d*f MLP per layer; experts add E of them
+        return dense + L * ((E - 1) * 3 * d * f + d * E)
+
+    def active_params(self) -> int:
+        """Parameters a token actually touches: attention + norms +
+        embeddings as dense, but only `top_k` of the `n_experts` MLP
+        banks (+ the router). THE number MFU must be derived from —
+        using total params would flatter a sparse model by counting
+        FLOPs it never executes."""
+        d, f, L = self.dim, self.ffn_dim, self.n_layers
+        dense = super().num_params()
+        # swap the one dense MLP per layer for top_k expert MLPs + router
+        return dense + L * ((self.top_k - 1) * 3 * d * f
+                            + d * self.n_experts)
+
+    def flops_per_token(self, seq_len=None) -> float:
+        """Approx training FLOPs/token on ACTIVE parameters (6N_active +
+        attention term) — without this override MFU/goodput would read
+        the inherited dense accounting, which for a top-k router is
+        wrong by a factor of ~E/k on the MLP term."""
+        s = seq_len or self.max_seq
+        attn = 12 * self.n_layers * self.dim * s
+        return 6.0 * self.active_params() + attn
+
 
 PRESETS = {
     "moe_tiny": MoEConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,

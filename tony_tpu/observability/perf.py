@@ -1,0 +1,520 @@
+"""Performance truth: goodput ledger, MFU accounting, SLO watchdog,
+on-demand profiler capture.
+
+PR 4 gave the orchestrator lifecycle spans and gauge trajectories — this
+module turns those raw signals into *performance* answers:
+
+- **Goodput ledger** (`GoodputLedger`): a per-task time-accounting state
+  machine that attributes every wall-clock second to exactly one
+  exclusive phase (init, localization, rendezvous_wait, compile,
+  train_step, input_stall, checkpoint_save/restore, eval,
+  relaunch_downtime, idle). Transitions happen only at existing span /
+  stall boundaries — the hot loop gains no host sync. By construction
+  the phase durations sum to wall clock exactly; the e2e test pins the
+  flushed `goodput.json` to within 1%.
+- **MFU** (`peak_flops` / `mfu_pct`): the single peak-FLOPs table and
+  MFU formula shared by bench.py, tools/tune_mfu.py, and the trainer's
+  goodput metrics — one definition repo-wide.
+- **Goodput aggregation** (`aggregate_goodput`): the AM folds per-task
+  ledgers (arriving as GOODPUT_* gauges over the metrics RPC) plus the
+  fault-tolerance layer's relaunch downtime into a job-level
+  `goodput_pct` = productive train-step seconds / total wall seconds.
+- **SLO watchdog** (`SloWatchdog`): step-time-regression and
+  goodput-floor thresholds -> latched violations the AM turns into
+  WARNING history events + alert gauges.
+- **Profiler capture** (`ProfileCapture`): the trainer-side half of the
+  `request_profile` operator workflow — polls for the executor-written
+  request file (heartbeat-piggybacked from the AM), runs
+  `jax.profiler` for N steps, and publishes the artifact back through
+  the metrics RPC so the AM can link it into history.
+
+No jax import at module level: bench.py's supervisor process imports
+`peak_flops` from here and must stay pure-stdlib until the measurement
+child runs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+LOG = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# peak FLOPs + MFU — the one definition bench.py / tune_mfu / trainer share
+# ---------------------------------------------------------------------------
+
+# bf16 peak FLOPs/s per chip by device kind substring (public specs).
+PEAK_FLOPS = (
+    ("v6", 918e12),        # Trillium
+    ("v5p", 459e12),
+    ("v5", 197e12),        # v5e / "v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+DEFAULT_PEAK = 459e12
+CPU_PEAK = 1e11            # nominal, keeps MFU finite on dev machines
+
+
+def peak_flops(device) -> float:
+    """Peak bf16 FLOPs/s of one chip. The axon tunnel's devices report
+    platform "axon" but are real TPU chips (canonical platform "tpu") —
+    both must take the TPU branch or the %MFU denominator is the nominal
+    CPU peak (2000x inflation)."""
+    if device.platform not in ("tpu", "axon"):
+        return CPU_PEAK
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    if device.platform == "axon":
+        # tunneled devices may not expose a real device_kind; the gen the
+        # tunnel was brought up with is authoritative
+        kind = (os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+                or kind)
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return DEFAULT_PEAK
+
+
+def mfu_pct(tokens_per_sec_per_chip: float, flops_per_token: float,
+            device=None, peak: float = 0.0) -> float:
+    """Model FLOPs utilization in percent: achieved training FLOPs/s per
+    chip over the chip's peak. Pass either a jax device (`device`) or an
+    explicit `peak` FLOPs/s."""
+    denom = peak or (peak_flops(device) if device is not None else 0.0)
+    if denom <= 0 or flops_per_token <= 0:
+        return 0.0
+    return 100.0 * tokens_per_sec_per_chip * flops_per_token / denom
+
+
+def tokens_in_batch(batch) -> int:
+    """Token count of one training batch (0 when the shape is not
+    token-like). Shape inspection only — reading `.shape` of a jax array
+    never syncs the device."""
+    if not isinstance(batch, dict):
+        return 0
+    for key in ("inputs", "tokens"):
+        arr = batch.get(key)
+        shape = getattr(arr, "shape", None)
+        if shape and len(shape) >= 2:
+            return int(shape[0]) * int(shape[1])
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger
+# ---------------------------------------------------------------------------
+
+# Exclusive phases every wall-clock second is attributed to. `input_stall`
+# and `relaunch_downtime` are carved out of their enclosing phase
+# (train_step / the AM-side gap between attempts) rather than entered by a
+# timeline transition.
+PHASES = (
+    "init", "localization", "rendezvous_wait", "compile", "train_step",
+    "input_stall", "checkpoint_save", "checkpoint_restore", "eval",
+    "relaunch_downtime", "idle",
+)
+
+GOODPUT_METRIC_PREFIX = "GOODPUT_"
+GOODPUT_WALL_METRIC = "GOODPUT_WALL_SECONDS"
+# the phases that count as productive training in goodput_pct
+PRODUCTIVE_PHASES = ("train_step",)
+
+
+def goodput_metric_name(phase: str) -> str:
+    return f"{GOODPUT_METRIC_PREFIX}{phase.upper()}_SECONDS"
+
+
+class GoodputLedger:
+    """Exclusive-phase wall-clock accounting for one task process.
+
+    Exactly one phase is open at any time; `transition` closes it and
+    opens the next, `carve` re-attributes seconds of the open phase to a
+    sibling (the prefetch stall counter's seconds move from `train_step`
+    to `input_stall` at log boundaries). Invariant, by construction:
+    sum(phase seconds) == wall seconds since construction — the snapshot
+    includes the open phase's elapsed-so-far, so the books always
+    balance mid-phase too.
+
+    Thread-safe (the metrics pusher snapshots from its worker thread);
+    mutation cost is a monotonic read + a dict add, fine for phase
+    boundaries (never per-step)."""
+
+    def __init__(self, phase: str = "init",
+                 clock: Callable[[], float] = time.monotonic,
+                 seed: Optional[dict] = None):
+        self._clock = clock
+        self._t0 = clock()
+        self._phase = phase
+        self._phase_start = self._t0
+        self._acc: dict[str, float] = {p: 0.0 for p in PHASES}
+        self._acc.setdefault(phase, 0.0)
+        # phases another process of the same task slot already accounted
+        # (the executor's localization / rendezvous_wait, handed over in
+        # TONY_GOODPUT_SEED): closed durations that extend this ledger's
+        # wall clock, keeping sum(phases) == wall_s across the handoff
+        self._seed_total = 0.0
+        for p, v in (seed or {}).items():
+            v = max(0.0, float(v))
+            self._acc[str(p)] = self._acc.get(str(p), 0.0) + v
+            self._seed_total += v
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, env, phase: str = "init") -> "GoodputLedger":
+        """Ledger seeded with the executor-accounted phases rendered into
+        the user-process env (no seed -> a bare ledger, so direct script
+        runs keep working)."""
+        from tony_tpu import constants as C
+        seed = None
+        raw = env.get(C.TONY_GOODPUT_SEED, "")
+        if raw:
+            try:
+                parsed = json.loads(raw)
+                if isinstance(parsed, dict):
+                    seed = {str(k): float(v) for k, v in parsed.items()
+                            if isinstance(v, (int, float))}
+            except (ValueError, TypeError):
+                seed = None
+        return cls(phase=phase, seed=seed)
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    def transition(self, phase: str) -> None:
+        """Close the open phase, attributing its elapsed time, and open
+        `phase`. Transitioning to the already-open phase is a no-op that
+        still folds the elapsed segment in (safe to call defensively)."""
+        now = self._clock()
+        with self._lock:
+            self._acc[self._phase] = self._acc.get(self._phase, 0.0) + (
+                now - self._phase_start)
+            self._phase = phase
+            self._phase_start = now
+            self._acc.setdefault(phase, 0.0)
+
+    def carve(self, phase: str, seconds: float,
+              source: Optional[str] = None) -> None:
+        """Move `seconds` from `source` (default: the OPEN phase) to
+        `phase` without touching the timeline — wall-clock sum is
+        preserved. Used for quantities measured by counters inside a
+        phase (input stall seconds inside train_step); pass `source`
+        explicitly when the carve may run after the source phase closed
+        (the end-of-run flush happens from idle)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            src = source if source is not None else self._phase
+            self._acc[phase] = self._acc.get(phase, 0.0) + seconds
+            self._acc[src] = self._acc.get(src, 0.0) - seconds
+
+    def snapshot(self) -> dict:
+        """{"phases": {phase: seconds}, "wall_s": seconds} — open phase
+        included at its elapsed-so-far, so sum(phases) == wall_s."""
+        now = self._clock()
+        with self._lock:
+            phases = dict(self._acc)
+            phases[self._phase] = phases.get(self._phase, 0.0) + (
+                now - self._phase_start)
+            wall = (now - self._t0) + self._seed_total
+        return {"phases": phases, "wall_s": wall}
+
+    def metrics(self) -> list[dict]:
+        """The ledger as AM metric dicts ({name, value}) for the existing
+        metrics RPC — GOODPUT_<PHASE>_SECONDS + GOODPUT_WALL_SECONDS."""
+        snap = self.snapshot()
+        out = [{"name": goodput_metric_name(p), "value": round(v, 4)}
+               for p, v in sorted(snap["phases"].items())]
+        out.append({"name": GOODPUT_WALL_METRIC,
+                    "value": round(snap["wall_s"], 4)})
+        return out
+
+
+def parse_goodput_gauges(gauges: dict[str, float]) -> Optional[dict]:
+    """Invert `GoodputLedger.metrics()` from a task's latest-gauge map:
+    -> {"phases": {...}, "wall_s": ...}, or None when the task never
+    pushed a ledger."""
+    phases: dict[str, float] = {}
+    wall = None
+    for name, value in gauges.items():
+        if name == GOODPUT_WALL_METRIC:
+            wall = float(value)
+        elif (name.startswith(GOODPUT_METRIC_PREFIX)
+              and name.endswith("_SECONDS")):
+            phase = name[len(GOODPUT_METRIC_PREFIX):-len("_SECONDS")].lower()
+            phases[phase] = float(value)
+    if wall is None and not phases:
+        return None
+    return {"phases": phases,
+            "wall_s": wall if wall is not None else sum(phases.values())}
+
+
+def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
+                      relaunch_downtime_s: float = 0.0) -> dict:
+    """Fold per-task ledgers + AM-side relaunch downtime into the job
+    view flushed as `goodput.json`:
+
+    {"tasks": {task_id: {"phases", "wall_s", "mfu_pct"?,
+                         "tokens_per_sec_per_chip"?}},
+     "job": {"goodput_pct", "productive_s", "wall_s",
+             "relaunch_downtime_s"}}
+
+    goodput_pct = productive train-step seconds / (summed task wall +
+    relaunch downtime) — downtime the fault-tolerance layer spent
+    between attempts counts AGAINST goodput even though no task process
+    existed to observe it."""
+    tasks: dict[str, dict] = {}
+    productive = 0.0
+    wall_total = 0.0
+    for task_id, gauges in sorted(per_task_gauges.items()):
+        ledger = parse_goodput_gauges(gauges)
+        if ledger is None:
+            continue
+        entry = dict(ledger)
+        for gauge, key in (("TRAIN_MFU_PCT", "mfu_pct"),
+                           ("TRAIN_TOKENS_PER_SEC_PER_CHIP",
+                            "tokens_per_sec_per_chip")):
+            if gauge in gauges:
+                entry[key] = float(gauges[gauge])
+        tasks[task_id] = entry
+        wall_total += entry["wall_s"]
+        productive += sum(entry["phases"].get(p, 0.0)
+                          for p in PRODUCTIVE_PHASES)
+    denom = wall_total + max(0.0, relaunch_downtime_s)
+    return {
+        "tasks": tasks,
+        "job": {
+            "goodput_pct": round(100.0 * productive / denom, 3)
+            if denom > 0 else 0.0,
+            "productive_s": round(productive, 4),
+            "wall_s": round(denom, 4),
+            "relaunch_downtime_s": round(max(0.0, relaunch_downtime_s), 4),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# SLO watchdog (AM-side)
+# ---------------------------------------------------------------------------
+
+class SloWatchdog:
+    """Latched SLO checks over the AM's metric trajectories.
+
+    - step-time regression: a task's latest TRAIN_STEP_TIME_MS exceeds
+      its own baseline (median of its first samples) by more than
+      `step_regression_pct` percent;
+    - goodput floor: job goodput_pct below `goodput_floor_pct`.
+
+    `check()` returns only NEWLY-entered violations (the AM emits one
+    WARNING history event per entry); the latch re-arms when the
+    condition recovers. Current state is exposed for alert gauges via
+    `active()`. Thresholds <= 0 disable the respective check."""
+
+    BASELINE_POINTS = 5
+    MIN_POINTS = 3
+
+    def __init__(self, step_regression_pct: float = 0.0,
+                 goodput_floor_pct: float = 0.0):
+        self.step_regression_pct = step_regression_pct
+        self.goodput_floor_pct = goodput_floor_pct
+        self._latched: set[str] = set()
+
+    @staticmethod
+    def _median(values: list[float]) -> float:
+        ordered = sorted(values)
+        return ordered[len(ordered) // 2]
+
+    def check(self, step_series: dict[str, list],
+              goodput_pct: Optional[float] = None) -> list[dict]:
+        """`step_series`: {task_id: [[ts_ms, step_ms], ...]} (the
+        MetricsStore's TRAIN_STEP_TIME_MS trajectories). Returns newly
+        entered violations as {"kind", "task_id"?, "value",
+        "threshold", "message"} dicts."""
+        fresh: list[dict] = []
+        seen: set[str] = set()
+        if self.step_regression_pct > 0:
+            for task_id, points in sorted(step_series.items()):
+                values = [float(p[1]) for p in points
+                          if isinstance(p, (list, tuple)) and len(p) == 2]
+                if len(values) < max(self.MIN_POINTS,
+                                     self.BASELINE_POINTS // 2 + 1):
+                    continue
+                baseline = self._median(values[:self.BASELINE_POINTS])
+                latest = values[-1]
+                threshold = baseline * (1.0 + self.step_regression_pct
+                                        / 100.0)
+                key = f"step_time:{task_id}"
+                if baseline > 0 and latest > threshold:
+                    seen.add(key)
+                    if key not in self._latched:
+                        self._latched.add(key)
+                        fresh.append({
+                            "kind": "step_time_regression",
+                            "task_id": task_id,
+                            "value": round(latest, 3),
+                            "threshold": round(threshold, 3),
+                            "message": (
+                                f"step time {latest:.1f} ms exceeds "
+                                f"baseline {baseline:.1f} ms by more than "
+                                f"{self.step_regression_pct:.0f}%"),
+                        })
+        if self.goodput_floor_pct > 0 and goodput_pct is not None:
+            key = "goodput_floor"
+            if goodput_pct < self.goodput_floor_pct:
+                seen.add(key)
+                if key not in self._latched:
+                    self._latched.add(key)
+                    fresh.append({
+                        "kind": "goodput_floor",
+                        "value": round(goodput_pct, 3),
+                        "threshold": self.goodput_floor_pct,
+                        "message": (
+                            f"job goodput {goodput_pct:.1f}% below the "
+                            f"{self.goodput_floor_pct:.0f}% floor"),
+                    })
+        # re-arm every latch whose condition recovered this check
+        self._latched &= seen
+        return fresh
+
+    def active(self) -> list[str]:
+        """Currently-latched violation keys (alert gauge source)."""
+        return sorted(self._latched)
+
+
+# ---------------------------------------------------------------------------
+# on-demand profiler capture (trainer-side)
+# ---------------------------------------------------------------------------
+
+def new_profile_request_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+class ProfileCapture:
+    """Trainer-side half of the `request_profile` workflow.
+
+    The AM piggybacks a pending request on the executor's heartbeat; the
+    executor writes it to `profile_request.json` in the container cwd
+    (the trainer's cwd). The trainer calls `poll()` at log boundaries (a
+    stat syscall, never a device sync) and `on_step()` after each step
+    (a host bool check while idle): a new request starts
+    `jax.profiler.start_trace` into `profiles/<request_id>/`, N steps
+    later `stop_trace` runs and `publish` ships
+    {request_id, path, num_steps, duration_ms} back over the metrics
+    RPC for the AM to link into history.
+
+    Idempotent: request ids already seen (including the one currently
+    capturing) never restart a trace. `start_fn`/`stop_fn` default to
+    jax.profiler and exist for tests/fixtures that must not drag jax in.
+    """
+
+    def __init__(self, cwd: str = ".",
+                 publish: Optional[Callable[[dict], None]] = None,
+                 start_fn: Optional[Callable[[str], None]] = None,
+                 stop_fn: Optional[Callable[[], None]] = None):
+        from tony_tpu import constants as C
+        self._cwd = cwd
+        self._request_path = os.path.join(cwd, C.PROFILE_REQUEST_FILE)
+        self._profiles_dir = os.path.join(cwd, C.PROFILES_DIR_NAME)
+        self._publish = publish
+        self._start_fn = start_fn
+        self._stop_fn = stop_fn
+        self._seen: set[str] = set()
+        self._active: Optional[dict] = None
+
+    @property
+    def active(self) -> bool:
+        return self._active is not None
+
+    def poll(self) -> None:
+        """Check for a new request file; start a capture if one names an
+        unseen request id. Called at log boundaries only."""
+        if self._active is not None:
+            return
+        try:
+            with open(self._request_path, "r", encoding="utf-8") as f:
+                req = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return
+        rid = str(req.get("request_id", "") or "")
+        if not rid:
+            return
+        if rid in self._seen:
+            # completed (or failed) earlier in THIS process but the file
+            # outlived it — clear it so a successor process after an
+            # in-place relaunch doesn't re-burn a full capture
+            self._remove_request_file()
+            return
+        self._seen.add(rid)
+        steps = max(1, int(req.get("num_steps", 1) or 1))
+        out_dir = os.path.join(self._profiles_dir, rid)
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            self._trace_start(out_dir)
+        except Exception:  # noqa: BLE001 — profiling must never kill training
+            LOG.exception("could not start profiler trace for request %s",
+                          rid)
+            return
+        LOG.info("profiler capture %s started (%d steps) -> %s", rid,
+                 steps, out_dir)
+        self._active = {"request_id": rid, "remaining": steps,
+                        "num_steps": steps, "dir": out_dir,
+                        "t0": time.monotonic()}
+
+    def on_step(self) -> None:
+        """Count one completed train step against the active capture;
+        stop + publish when the budget is spent."""
+        active = self._active
+        if active is None:
+            return
+        active["remaining"] -= 1
+        if active["remaining"] > 0:
+            return
+        self._active = None
+        # the request is spent either way: remove the relay file so a
+        # relaunched trainer (fresh _seen set, same cwd) never replays it
+        self._remove_request_file()
+        try:
+            self._trace_stop()
+        except Exception:  # noqa: BLE001
+            LOG.exception("profiler stop_trace failed for request %s",
+                          active["request_id"])
+            return
+        duration_ms = int(1000 * (time.monotonic() - active["t0"]))
+        LOG.info("profiler capture %s finished after %d steps (%d ms)",
+                 active["request_id"], active["num_steps"], duration_ms)
+        if self._publish is not None:
+            try:
+                self._publish({
+                    "request_id": active["request_id"],
+                    "path": os.path.abspath(active["dir"]),
+                    "num_steps": active["num_steps"],
+                    "duration_ms": duration_ms,
+                })
+            except Exception:  # noqa: BLE001
+                LOG.exception("profile publish failed")
+
+    def _remove_request_file(self) -> None:
+        try:
+            os.remove(self._request_path)
+        except OSError:
+            pass
+
+    def _trace_start(self, out_dir: str) -> None:
+        if self._start_fn is not None:
+            self._start_fn(out_dir)
+            return
+        import jax
+        jax.profiler.start_trace(out_dir)
+
+    def _trace_stop(self) -> None:
+        if self._stop_fn is not None:
+            self._stop_fn()
+            return
+        import jax
+        jax.profiler.stop_trace()

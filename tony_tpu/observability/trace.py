@@ -182,6 +182,21 @@ class SpanRecorder:
             out, self._finished = self._finished, []
         return out
 
+    def record_complete(self, name: str, start_ms: int, end_ms: int,
+                        status: str = STATUS_OK,
+                        attrs: Optional[dict] = None) -> Span:
+        """Record an already-finished span with caller-supplied
+        timestamps — for events measured on another clock (the serving
+        engine's monotonic request stamps) that are converted to epoch
+        ms after the fact."""
+        span = Span(name=name, trace_id=self.trace_id,
+                    parent_id=self.parent_id, task_id=self.task_id,
+                    attempt=self.attempt, start_ms=int(start_ms),
+                    end_ms=int(end_ms), status=status,
+                    attrs=dict(attrs or {}))
+        self._record(span.to_dict())
+        return span
+
     def env(self, span: Optional[Span] = None) -> dict[str, str]:
         """Trace-context env block for a child process: the trace id and
         the span the child should parent under (default: the ambient

@@ -217,6 +217,18 @@ class PortalCache:
         the /jobs/:id/metrics.json payload (metrics.json sidecar)."""
         return self._get_sidecar(job_id, C.METRICS_FILE, {})
 
+    def get_goodput(self, job_id: str) -> dict[str, Any]:
+        """Time-accounting aggregate ({tasks, job} — see
+        observability/perf.aggregate_goodput); goodput.json sidecar."""
+        return self._get_sidecar(job_id, C.GOODPUT_FILE, {})
+
+    def get_am_info(self, job_id: str) -> dict[str, Any]:
+        """The AM's RPC address ({host, rpc_port}) written into the
+        history dir at prepare — how the portal reaches a RUNNING job's
+        control plane (profile-capture POST). Stale for finished jobs;
+        callers treat connection failures as 'job not running'."""
+        return self._get_sidecar(job_id, C.AM_INFO_FILE, {})
+
     def get_log_links(self, job_id: str) -> list[dict[str, Any]]:
         """Per-task log links. The reference synthesized NodeManager
         containerlogs URLs (models/JobLog.java:27-60) pointing at a live

@@ -200,7 +200,66 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(self.cache.get_spans(job_id))
             if what == "metrics":
                 return self._json(self.cache.get_metrics_timeseries(job_id))
+            if what == "goodput":
+                return self._json(self.cache.get_goodput(job_id))
         self._json({"error": "not found"}, 404)
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        """POST /api/jobs/:id/profile — forward an on-demand profiler
+        request to the RUNNING job's AM (address from the am.json the AM
+        wrote into its history dir). The one write route the portal has;
+        it proxies, never mutates history itself."""
+        path = urlparse(self.path).path.rstrip("/")
+        parts = [p for p in path.split("/") if p]
+        try:
+            if not self._authorized():
+                return self._json({"error": "unauthorized"}, 401)
+            if (len(parts) != 4 or parts[:2] != ["api", "jobs"]
+                    or parts[3] != "profile"):
+                return self._json({"error": "not found"}, 404)
+            job_id = parts[2]
+            md = self.cache.get_metadata(job_id)
+            if md is None or not self._visible(md.user):
+                return self._json({"error": "not found"}, 404)
+            if md.status != "RUNNING":
+                return self._json(
+                    {"error": f"job is {md.status}; profiles can only be "
+                              f"captured on a running job"}, 409)
+            am = self.cache.get_am_info(job_id)
+            if not am.get("host") or not am.get("rpc_port"):
+                return self._json(
+                    {"error": "no AM address recorded for this job"}, 409)
+            if am.get("security_enabled"):
+                # the portal holds no app credential; forwarding would be
+                # rejected UNAUTHENTICATED and read as an AM outage
+                return self._json(
+                    {"error": "application runs with security enabled; "
+                              "use `python -m tony_tpu.cli profile "
+                              "<app_dir>` (it reads the app token)"}, 409)
+            body = {}
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            if 0 < length <= 1 << 20:
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, UnicodeDecodeError):
+                    return self._json({"error": "body must be JSON"}, 400)
+            from tony_tpu.rpc.client import ClusterServiceClient
+            client = ClusterServiceClient(str(am["host"]),
+                                          int(am["rpc_port"]))
+            try:
+                resp = client.request_profile(
+                    task_id=str(body.get("task_id", "") or ""),
+                    num_steps=int(body.get("num_steps", 0) or 0))
+            except Exception as e:  # noqa: BLE001 — AM gone mid-request
+                return self._json(
+                    {"error": f"could not reach the job's AM: {e}"}, 502)
+            finally:
+                client.close()
+            code = 200 if not (resp or {}).get("error") else 409
+            return self._json(resp or {}, code)
+        except Exception:  # noqa: BLE001
+            LOG.exception("portal POST failed: %s", self.path)
+            self._json({"error": "internal error"}, 500)
 
     # -- pages (reference: 4 page controllers) -----------------------------
     def _index(self) -> None:
@@ -235,8 +294,101 @@ class _Handler(BaseHTTPRequestHandler):
             ])
         self._html(f"events — {job_id}",
                    self._serving_endpoints_html(job_id, events)
+                   + self._goodput_html(job_id)
                    + self._waterfall_html(job_id)
                    + _table(["Time", "Event", "Payload"], rows))
+
+    # phase palette: productive train time pops green, stalls/downtime
+    # warn, infrastructure phases stay muted
+    _PHASE_COLORS = {
+        "train_step": "#2e8b57", "compile": "#8e7cc3",
+        "input_stall": "#e69138", "checkpoint_save": "#6fa8dc",
+        "checkpoint_restore": "#9fc5e8", "eval": "#46bdc6",
+        "localization": "#b7b7b7", "rendezvous_wait": "#ffd966",
+        "relaunch_downtime": "#cc0000", "init": "#cccccc",
+        "idle": "#efefef",
+    }
+
+    def _goodput_html(self, job_id: str) -> str:
+        """Stacked time-accounting bar per task (the goodput.json ledger)
+        + an MFU trajectory sparkline from the metrics sidecar — where
+        the wall-clock went, and what the chips sustained while it did.
+        Empty string for pre-goodput history."""
+        goodput = self.cache.get_goodput(job_id)
+        tasks = goodput.get("tasks") or {}
+        if not tasks:
+            return ""
+        job = goodput.get("job") or {}
+        out = ["<h3>Goodput</h3>"]
+        if job:
+            out.append(
+                f"<p><b>{job.get('goodput_pct', 0)}%</b> goodput — "
+                f"{job.get('productive_s', 0)}s productive of "
+                f"{job.get('wall_s', 0)}s wall"
+                + (f", {job['relaunch_downtime_s']}s relaunch downtime"
+                   if job.get("relaunch_downtime_s") else "") + "</p>")
+        rows = []
+        for task_id, entry in sorted(tasks.items()):
+            phases = entry.get("phases") or {}
+            wall = float(entry.get("wall_s") or 0) or 1.0
+            segs = []
+            for phase, secs in sorted(phases.items(),
+                                      key=lambda kv: -kv[1]):
+                if secs <= 0:
+                    continue
+                width = max(0.4, 100.0 * float(secs) / wall)
+                color = self._PHASE_COLORS.get(phase, "#999")
+                segs.append(
+                    f'<div class="spanbar" style="display:inline-block;'
+                    f'width:{width:.2f}%;background:{color}" '
+                    f'title="{html.escape(phase)}: {secs:.2f}s"></div>')
+            mfu = entry.get("mfu_pct")
+            rows.append([
+                html.escape(task_id),
+                f'<div style="min-width:320px;white-space:nowrap">'
+                + "".join(segs) + "</div>",
+                "-" if mfu is None else f"{mfu:.2f}%",
+            ])
+        out.append(_table(["Task", "Time accounting", "MFU"], rows))
+        legend = " ".join(
+            f'<span style="background:{color};padding:0 6px">&nbsp;</span>'
+            f' {html.escape(phase)}'
+            for phase, color in self._PHASE_COLORS.items())
+        out.append(f'<p style="font-size:80%">{legend}</p>')
+        out.append(self._mfu_sparkline_html(job_id))
+        return "".join(out)
+
+    def _mfu_sparkline_html(self, job_id: str) -> str:
+        """Inline-SVG MFU trajectories (TRAIN_MFU_PCT series per task)
+        from the metrics sidecar — flat lines are the goal."""
+        series = self.cache.get_metrics_timeseries(job_id)
+        lines = []
+        peak = 1.0
+        for task_id, metrics in sorted(series.items()):
+            points = metrics.get("TRAIN_MFU_PCT") or []
+            pts = [(int(p[0]), float(p[1])) for p in points
+                   if isinstance(p, (list, tuple)) and len(p) == 2]
+            if len(pts) >= 2:
+                lines.append((task_id, pts))
+                peak = max(peak, max(v for _, v in pts))
+        if not lines:
+            return ""
+        w, h = 420, 80
+        svgs = []
+        for task_id, pts in lines:
+            t0, t1 = pts[0][0], pts[-1][0]
+            extent = max(1, t1 - t0)
+            coords = " ".join(
+                f"{w * (ts - t0) / extent:.1f},"
+                f"{h - h * v / (1.15 * peak):.1f}" for ts, v in pts)
+            svgs.append(
+                f'<polyline points="{coords}" fill="none" '
+                f'stroke="#2e8b57" stroke-width="1.5">'
+                f'<title>{html.escape(task_id)}</title></polyline>')
+        return (f"<p>MFU trajectory (peak {peak:.1f}%)</p>"
+                f'<svg width="{w}" height="{h}" '
+                f'style="border:1px solid #ccc">' + "".join(svgs)
+                + "</svg>")
 
     def _waterfall_html(self, job_id: str) -> str:
         """Lifecycle-span waterfall: one row per span, a bar positioned/

@@ -207,6 +207,15 @@ class ClusterServiceClient(_JsonRpcClient):
                          {"task_id": task_id, "task_attempt": task_attempt},
                          retries=1, timeout_sec=5.0, wait_for_ready=False)
 
+    def request_profile(self, task_id: str = "",
+                        num_steps: int = 0) -> dict:
+        """Ask the AM to capture an XLA profile on one task's trainer
+        (observability/perf.py workflow). Client-plane: operator CLI /
+        portal POST, never a task token."""
+        return self.call("request_profile",
+                         {"task_id": task_id, "num_steps": num_steps},
+                         retries=1, timeout_sec=10.0, wait_for_ready=False)
+
 
 class MetricsServiceClient(_JsonRpcClient):
     def __init__(self, host: str, port: int, **kw):

@@ -37,6 +37,7 @@ CLUSTER_METHODS = (
     "register_execution_result",
     "finish_application",
     "task_executor_heartbeat",
+    "request_profile",
 )
 METRICS_METHODS = ("update_metrics",)
 
@@ -92,10 +93,21 @@ class ClusterServiceHandler(abc.ABC):
 
     @abc.abstractmethod
     def task_executor_heartbeat(self, req: dict) -> dict:
-        """req: {task_id, task_attempt?} -> {"spec_generation": int?}.
-        Pings from a superseded attempt (zombie executor of a relaunched
-        task) are ignored; the response carries the current cluster-spec
-        generation so running executors detect peer relaunches."""
+        """req: {task_id, task_attempt?} -> {"spec_generation": int?,
+        "profile_request": {request_id, num_steps}?}. Pings from a
+        superseded attempt (zombie executor of a relaunched task) are
+        ignored; the response carries the current cluster-spec generation
+        so running executors detect peer relaunches, and piggybacks any
+        pending on-demand profiler request for this task."""
+
+    @abc.abstractmethod
+    def request_profile(self, req: dict) -> dict:
+        """Operator/client plane: req {task_id?, num_steps?} ->
+        {request_id, task_id, num_steps} (or {error}). Asks one task's
+        trainer (default: the first running tracked task) to capture a
+        profiler trace for N steps; the ask rides the task's next
+        heartbeat. Idempotent: a second request while one is in flight
+        for the same task returns the in-flight request_id."""
 
 
 class MetricsServiceHandler(abc.ABC):

@@ -157,6 +157,38 @@ def main(argv=None) -> int:
         top_k=args.top_k, top_p=args.top_p,
         eos_id=args.eos_id if args.eos_id >= 0 else None,
         quant_cache=args.quant_cache)
+    # per-request trace spans: each finished request becomes a
+    # `serve_request` span (queue_wait/prefill/decode attrs) on the same
+    # job waterfall the trainer's phases render into. Only when a trace
+    # context was rendered into this container's env — standalone runs
+    # record nothing.
+    from tony_tpu.observability.trace import SpanRecorder
+    recorder = SpanRecorder.from_env(
+        env,
+        task_id=(f"{env.get(C.JOB_NAME, '')}:{env.get(C.TASK_INDEX, '0')}"
+                 if env.get(C.JOB_NAME) else ""),
+        attempt=int(env.get(C.TASK_ATTEMPT, "0") or 0))
+    if recorder.enabled:
+        import time as _time
+
+        def _record_request_span(handle) -> None:
+            dur_s = max(0.0, (handle.finished_at or 0)
+                        - handle.submitted_at)
+            now_ms = int(_time.time() * 1000)
+            attrs = {"request_id": handle.request_id,
+                     "tokens": len(handle.tokens),
+                     "finish_reason": handle.finish_reason or ""}
+            for key, value in (("queue_wait_ms", handle.queue_wait_s),
+                               ("prefill_ms", handle.prefill_s),
+                               ("decode_ms", handle.decode_s)):
+                if value is not None:
+                    attrs[key] = round(value * 1000.0, 3)
+            recorder.record_complete(
+                "serve_request", now_ms - int(dur_s * 1000), now_ms,
+                attrs=attrs)
+
+        engine.on_request_finished = _record_request_span
+
     engine.start()
     frontend = ServeFrontend(engine, port=port, host=args.host)
     frontend.start()
@@ -171,7 +203,8 @@ def main(argv=None) -> int:
     reporter = ServingMetricsReporter(
         engine.metrics,
         interval_sec=conf.get_time_ms(K.TASK_METRICS_INTERVAL_MS,
-                                      5000) / 1000.0)
+                                      5000) / 1000.0,
+        span_source=recorder.drain if recorder.enabled else None)
     reporter.start()
 
     stop = threading.Event()

@@ -87,6 +87,10 @@ class RequestHandle:
         self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # per-request latency breakdown, stamped by the engine: time spent
+        # queued before a slot freed, and the admission prefill itself
+        self.queue_wait_s: Optional[float] = None
+        self.prefill_s: Optional[float] = None
         self.done = threading.Event()
         self.cancelled = threading.Event()
         self._queue: "queue.Queue" = queue.Queue()
@@ -117,6 +121,13 @@ class RequestHandle:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+    @property
+    def decode_s(self) -> Optional[float]:
+        """Wall time spent decoding past the first token."""
+        if self.first_token_at is None or self.finished_at is None:
+            return None
+        return self.finished_at - self.first_token_at
 
     def iter_tokens(self, timeout: Optional[float] = None):
         """Yield tokens as they are generated; returns on completion.
@@ -165,6 +176,12 @@ class EngineStats:
         default_factory=lambda: collections.deque(maxlen=512))
     itl_s: collections.deque = field(
         default_factory=lambda: collections.deque(maxlen=2048))
+    # per-request phase breakdown (queue_wait / prefill; decode-per-token
+    # is itl_s above) — same bounded-window discipline
+    queue_wait_s: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=512))
+    prefill_s: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=512))
 
 
 def _percentile(samples, q: float) -> Optional[float]:
@@ -172,6 +189,15 @@ def _percentile(samples, q: float) -> Optional[float]:
         return None
     ordered = sorted(samples)
     return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+
+def _phase_percentiles(snap: dict, key: str, samples, scale: float = 1.0
+                       ) -> None:
+    """p50/p95/p99 of one latency phase into the snapshot (None-valued
+    when the window is empty, so idle servers still expose the keys)."""
+    for q, tag in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+        v = _percentile(samples, q)
+        snap[f"{key}_{tag}"] = None if v is None else v * scale
 
 
 # ---------------------------------------------------------------------------
@@ -288,6 +314,10 @@ class ContinuousBatchingEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stats = EngineStats()
+        # observability hook: called (outside the engine lock) with each
+        # RequestHandle as it finishes — serve/__main__ turns these into
+        # per-request trace spans on the job waterfall
+        self.on_request_finished: Optional[callable] = None
 
     def _empty_cache(self) -> dict[str, jax.Array]:
         """Zero cache in prefill's exact tree layout (quant included) so
@@ -411,6 +441,11 @@ class ContinuousBatchingEngine:
             admitted = True
 
     def _admit(self, slot: _Slot, handle: RequestHandle) -> None:
+        # phase stamps: the queue-wait phase ends the moment a free slot
+        # dequeued this request; everything until the first sampled token
+        # lands on the host is the prefill phase
+        t_dequeue = time.monotonic()
+        handle.queue_wait_s = t_dequeue - handle.submitted_at
         self._key, req_key = jax.random.split(self._key)
         prompt = jnp.asarray(handle.prompt, jnp.int32)
         tok0_dev, self._cache = _admit_step(
@@ -419,6 +454,7 @@ class ContinuousBatchingEngine:
             self.top_p, self.quant_cache)
         tok0 = int(jax.device_get(tok0_dev))
         now = time.monotonic()
+        handle.prefill_s = now - t_dequeue
         handle.admitted_at = now
         slot.handle = handle
         slot.pos = len(handle.prompt)
@@ -430,6 +466,8 @@ class ContinuousBatchingEngine:
         with self._lock:
             self.stats.tokens_emitted += 1
             self.stats.ttft_s.append(now - handle.submitted_at)
+            self.stats.queue_wait_s.append(handle.queue_wait_s)
+            self.stats.prefill_s.append(handle.prefill_s)
         LOG.debug("admitted request %d into slot %d (prompt %d, max_new "
                   "%d)", handle.request_id, slot.index, len(handle.prompt),
                   handle.max_new_tokens)
@@ -457,6 +495,12 @@ class ContinuousBatchingEngine:
         handle._finish(reason, now)
         with self._lock:
             self.stats.requests_finished += 1
+        sink = self.on_request_finished
+        if sink is not None:
+            try:
+                sink(handle)
+            except Exception:  # noqa: BLE001 — observability never wedges
+                LOG.debug("request-finished hook failed", exc_info=True)
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -523,6 +567,15 @@ class ContinuousBatchingEngine:
             itl = _percentile(self.stats.itl_s, 0.50)
             if itl is not None:
                 snap["itl_p50_ms"] = itl * 1000.0
+            # per-request phase breakdown: where a request's latency went
+            # (queued behind other work / prefill compute / per-token
+            # decode) — p50/p95/p99 each, the serving answer to "which
+            # phase ate the time"
+            _phase_percentiles(snap, "queue_wait_s",
+                               self.stats.queue_wait_s)
+            _phase_percentiles(snap, "prefill_s", self.stats.prefill_s)
+            _phase_percentiles(snap, "decode_ms_per_token",
+                               self.stats.itl_s, scale=1000.0)
             return snap
 
     def metrics(self) -> list[dict]:
@@ -536,6 +589,14 @@ class ContinuousBatchingEngine:
             "ttft_p95_s": "SERVING_TTFT_P95_S",
             "itl_p50_ms": "SERVING_ITL_P50_MS",
             "tokens_emitted": "SERVING_TOKENS_TOTAL",
+            # phase breakdown (p95s are the alerting-grade tails; the
+            # full p50/p95/p99 set lives on /v1/metrics)
+            "queue_wait_s_p50": "SERVING_QUEUE_WAIT_P50_S",
+            "queue_wait_s_p95": "SERVING_QUEUE_WAIT_P95_S",
+            "prefill_s_p50": "SERVING_PREFILL_P50_S",
+            "prefill_s_p95": "SERVING_PREFILL_P95_S",
+            "decode_ms_per_token_p50": "SERVING_DECODE_P50_MS",
+            "decode_ms_per_token_p95": "SERVING_DECODE_P95_MS",
         }
         snap = self.snapshot()
         return [{"name": metric, "value": float(snap[key])}

@@ -82,16 +82,26 @@ class TpuMetricsReporter:
         # reads
         self.dropped = 0
 
-    def report(self) -> None:
-        """Enqueue one HBM sample for the background pusher. Never blocks
-        the caller: a full queue drops the sample (the next interval's
-        fresher one supersedes it)."""
+    def report(self, extra: Optional[list[dict]] = None) -> None:
+        """Enqueue one HBM sample (+ caller-supplied gauges — the
+        trainer's goodput ledger / MFU metrics ride along) for the
+        background pusher. Never blocks the caller: a full queue drops
+        the sample (the next interval's fresher one supersedes it)."""
         if not self._enabled:
             return
-        metrics = tpu_memory_metrics()
+        metrics = tpu_memory_metrics() + list(extra or [])
         if not metrics:
             return
         self._enqueue({"metrics": metrics})
+
+    def report_profile_done(self, profile_done: dict) -> None:
+        """Enqueue a profiler-capture completion (observability/perf.py
+        ProfileCapture publish): {request_id, path, num_steps,
+        duration_ms} rides the metrics RPC's `profile_done` field for
+        the AM to link the artifact into history."""
+        if not self._enabled or not profile_done:
+            return
+        self._enqueue({"metrics": [], "profile_done": profile_done})
 
     def report_spans(self, spans: list[dict]) -> None:
         """Enqueue finished lifecycle spans (observability/trace.py) for
@@ -146,6 +156,8 @@ class TpuMetricsReporter:
                    "metrics": payload.get("metrics", [])}
             if payload.get("spans"):
                 req["spans"] = payload["spans"]
+            if payload.get("profile_done"):
+                req["profile_done"] = payload["profile_done"]
             if self._attempt >= 0:
                 req["attempt"] = self._attempt
             self._client.call("update_metrics", req, retries=1,
@@ -187,9 +199,13 @@ class ServingMetricsReporter(TpuMetricsReporter):
     the parent class."""
 
     def __init__(self, sample_fn, env: Optional[dict] = None,
-                 interval_sec: Optional[float] = None):
+                 interval_sec: Optional[float] = None,
+                 span_source=None):
         super().__init__(env=env)
         self._sample_fn = sample_fn
+        # optional span drain (a SpanRecorder's .drain): finished
+        # per-request serving spans ride the same periodic push
+        self._span_source = span_source
         if interval_sec is None:
             e = env if env is not None else os.environ
             interval_sec = float(e.get("TONY_METRICS_INTERVAL_SEC", "5"))
@@ -219,9 +235,18 @@ class ServingMetricsReporter(TpuMetricsReporter):
         except Exception:  # noqa: BLE001 — metrics never break serving
             LOG.debug("serving metrics sample failed", exc_info=True)
             return
-        if not metrics:
+        spans: list[dict] = []
+        if self._span_source is not None:
+            try:
+                spans = self._span_source() or []
+            except Exception:  # noqa: BLE001
+                LOG.debug("serving span drain failed", exc_info=True)
+        if not metrics and not spans:
             return
-        self._enqueue({"metrics": metrics})
+        payload: dict = {"metrics": metrics or []}
+        if spans:
+            payload["spans"] = spans
+        self._enqueue(payload)
 
     def close(self, timeout: float = 2.0) -> None:
         self._sampler_stop.set()

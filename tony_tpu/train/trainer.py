@@ -78,6 +78,10 @@ class TrainerConfig:
     # background device-prefetch queue. None = TONY_PREFETCH_DEPTH env
     # (default 2); 0 = synchronous global_batch_iterator (debug knob)
     prefetch_depth: Optional[int] = None
+    # training FLOPs per token for MFU accounting (model config's
+    # flops_per_token(seq); 0 = MFU not reported). Throughput
+    # (tokens/sec/chip) is derived from batch shapes regardless.
+    flops_per_token: float = 0.0
     extra: dict = field(default_factory=dict)
 
 
@@ -120,6 +124,17 @@ class Trainer:
                      f"{os.environ.get(C.TASK_INDEX, '0')}"
                      if os.environ.get(C.JOB_NAME) else ""),
             attempt=int(os.environ.get(C.TASK_ATTEMPT, "0") or 0))
+        # goodput ledger (observability/perf.py): every wall-clock second
+        # of this process lands in exactly one phase. One ledger per
+        # process — a re-setup() (session retry) keeps accounting on the
+        # same clock, it just transitions back to "init".
+        from tony_tpu.observability.perf import GoodputLedger
+        if getattr(self, "ledger", None) is None:
+            # seeded with the executor-accounted localization/barrier
+            # phases, so this one ledger covers the whole task attempt
+            self.ledger = GoodputLedger.from_env(os.environ)
+        else:
+            self.ledger.transition("init")
         setup_span = self._tracer.start("trainer_setup")
         try:
             self._setup_inner()
@@ -146,6 +161,16 @@ class Trainer:
         self._maybe_start_profiler()
         from tony_tpu.train.metrics import TpuMetricsReporter
         self._metrics_reporter = TpuMetricsReporter()
+        # on-demand profiler capture (observability/perf.py): the request
+        # file is polled at log boundaries; the finished artifact rides
+        # the metrics RPC back to the AM. Rebuilt on re-setup so publish
+        # binds the fresh reporter (the AM dedups request ids anyway).
+        from tony_tpu.observability.perf import ProfileCapture
+        self._profile = ProfileCapture(
+            cwd=os.getcwd(),
+            publish=self._metrics_reporter.report_profile_done)
+        self._tokens_per_batch = getattr(self, "_tokens_per_batch", 0)
+        self._last_stall_s = 0.0
         self.mesh = mesh_from_env()
         LOG.info("mesh: %s over %d devices", dict(self.mesh.shape),
                  self.mesh.devices.size)
@@ -217,12 +242,14 @@ class Trainer:
             # regions it overlaps (mmap) — no host ever holds a full leaf,
             # and the checkpoint reshards onto this run's mesh for free
             LOG.info("resuming from checkpoint step %d", resume)
+            self.ledger.transition("checkpoint_restore")
             with self._tracer.span("checkpoint_restore",
                                    attrs={"step": resume}):
                 state = restore_checkpoint(
                     cfg.checkpoint_dir, resume,
                     template={"params": self.params,
                               "opt_state": self.opt_state, "step": 0})
+            self.ledger.transition("init")
             self.params = state["params"]
             self.opt_state = state["opt_state"]
             self.step = int(state["step"])
@@ -287,6 +314,59 @@ class Trainer:
                         self.mesh, depth=n) as stream:
                     self._eval_set = [next(stream) for _ in range(n)]
 
+    def _perf_metrics(self) -> list[dict]:
+        """Log-boundary perf accounting (never per-step): carve the
+        prefetch stall counter's fresh seconds out of the open train_step
+        phase, derive interval step-time / throughput / MFU, and return
+        the goodput-ledger gauges for the metrics push. The only device
+        interaction is reading array shapes — no sync."""
+        from tony_tpu.observability.perf import mfu_pct
+        now = time.monotonic()
+        snap = getattr(self._global_data_iter, "stall_snapshot", None)
+        if snap is not None:
+            stall_s, _ = snap()
+            if stall_s > self._last_stall_s:
+                # stall always comes out of train_step, never the open
+                # phase — the end-of-run flush already sits in idle
+                self.ledger.carve("input_stall",
+                                  stall_s - self._last_stall_s,
+                                  source="train_step")
+            self._last_stall_s = stall_s
+        phases = self.ledger.snapshot()["phases"]
+        out = self.ledger.metrics()
+        prev_t = getattr(self, "_perf_t0", None)
+        prev_step = getattr(self, "_perf_step0", self.step)
+        if prev_t is not None and self.step > prev_step and now > prev_t:
+            dt = now - prev_t
+            d_steps = self.step - prev_step
+            # step time excludes eval/checkpoint time spent inside the
+            # interval (ledger phase deltas) — the SLO watchdog must not
+            # read a periodic eval boundary as a step-time regression.
+            # Throughput below stays on wall dt: achieved tokens/sec is
+            # the honest number, stalls included.
+            prev_phases = getattr(self, "_perf_phases0", {})
+            overhead = sum(
+                phases.get(p, 0.0) - prev_phases.get(p, 0.0)
+                for p in ("eval", "checkpoint_save", "checkpoint_restore"))
+            step_dt = max(dt - max(0.0, overhead), 1e-9)
+            out.append({"name": "TRAIN_STEP_TIME_MS",
+                        "value": round(1000.0 * step_dt / d_steps, 3)})
+            if self._tokens_per_batch:
+                # batch shapes are GLOBAL under the prefetch path, so the
+                # per-chip rate divides by the global device count
+                tok_s = (self._tokens_per_batch * d_steps / dt
+                         / max(1, jax.device_count()))
+                out.append({"name": "TRAIN_TOKENS_PER_SEC_PER_CHIP",
+                            "value": round(tok_s, 2)})
+                if self.config.flops_per_token > 0:
+                    out.append({"name": "TRAIN_MFU_PCT",
+                                "value": round(mfu_pct(
+                                    tok_s, self.config.flops_per_token,
+                                    jax.local_devices()[0]), 3)})
+        self._perf_t0, self._perf_step0 = now, self.step
+        self._perf_phases0 = phases
+        return out
+
     def _evaluate(self) -> float:
         """Mean loss over the fixed held-out eval set (params only — no
         gradients, no optimizer state touched). Losses accumulate ON
@@ -311,6 +391,12 @@ class Trainer:
         The final boundary and the final loss flush after the loop."""
         if self.params is None:
             self.setup()
+        if getattr(self, "ledger", None) is None:
+            # params injected by hand (setup() skipped): account from here
+            from tony_tpu.observability.perf import GoodputLedger
+            self.ledger = GoodputLedger(phase="init")
+            self._tokens_per_batch = 0
+            self._last_stall_s = 0.0
         it = self._global_data_iter
         if (isinstance(it, PrefetchIterator) and it.closed
                 and self.step < self.config.num_steps):
@@ -341,6 +427,12 @@ class Trainer:
         first_span = (tracer.start("first_step")
                       if tracer is not None and self.step < cfg.num_steps
                       else None)
+        # goodput: dispatch of step 1 is the compile phase; a tracer-less
+        # run (params injected by hand) goes straight to train_step
+        profile = getattr(self, "_profile", None)
+        if self.step < cfg.num_steps:
+            self.ledger.transition("compile" if first_span is not None
+                                   else "train_step")
         try:
             with jax.set_mesh(self.mesh):
                 t0 = time.monotonic()
@@ -349,21 +441,33 @@ class Trainer:
                     self.params, self.opt_state, loss = self.train_step(
                         self.params, self.opt_state, batch)
                     self.step += 1
+                    if profile is not None and profile.active:
+                        profile.on_step()
+                    if not self._tokens_per_batch:
+                        from tony_tpu.observability.perf import \
+                            tokens_in_batch
+                        self._tokens_per_batch = tokens_in_batch(batch)
                     if first_span is not None:
                         tracer.end(first_span,
                                    attrs={"step": self.step})
                         first_span = None
                         self._flush_spans()
+                        self.ledger.transition("train_step")
                     if cfg.log_every and self.step % cfg.log_every == 0:
                         if pending is not None:
                             _flush(pending)
                         pending = (self.step, loss,
                                    time.monotonic() - t0)
-                        self._metrics_reporter.report()
+                        self._metrics_reporter.report(
+                            extra=self._perf_metrics())
+                        if profile is not None:
+                            profile.poll()
                     if (cfg.eval_every
                             and self.eval_data_iter is not None
                             and self.step % cfg.eval_every == 0):
+                        self.ledger.transition("eval")
                         self.last_eval_loss = self._evaluate()
+                        self.ledger.transition("train_step")
                         self.metrics_history.append(
                             {"step": self.step,
                              "eval_loss": self.last_eval_loss})
@@ -402,6 +506,14 @@ class Trainer:
             if first_span is not None:   # error before the first step
                 tracer.end(first_span, "ERROR")
             self._flush_spans()
+            # close the goodput books: the run is over, remaining wall
+            # time is idle, and the final ledger ships with the last push
+            # (best-effort — accounting must never mask the real error)
+            try:
+                self.ledger.transition("idle")
+                self._metrics_reporter.report(extra=self._perf_metrics())
+            except Exception:  # noqa: BLE001
+                LOG.debug("final goodput report failed", exc_info=True)
             self._metrics_reporter.close()
         return self.last_loss
 
@@ -431,12 +543,20 @@ class Trainer:
         span = (tracer.start("checkpoint_save",
                              attrs={"step": self.step, "final": final})
                 if tracer is not None else None)
+        ledger = getattr(self, "ledger", None)
+        prev_phase = ledger.phase if ledger is not None else ""
+        if ledger is not None:
+            ledger.transition("checkpoint_save")
         self._checkpointer.save(
             self.step, {"params": self.params, "opt_state": self.opt_state,
                         "step": self.step})
         if final:
             self._checkpointer.close()
             self._checkpointer = None
+        if ledger is not None:
+            # the async file IO continues past this by design — only the
+            # synchronous snapshot (+ final commit) is checkpoint time
+            ledger.transition(prev_phase or "train_step")
         if span is not None:
             # covers the synchronous snapshot (+ commit when final); the
             # async file IO continues past it by design

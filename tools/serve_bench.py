@@ -136,6 +136,15 @@ def main() -> int:
         "slot_occupancy_pct": round(snap["slot_occupancy_pct"], 2),
         "itl_p50_ms": (round(snap["itl_p50_ms"], 3)
                        if snap.get("itl_p50_ms") is not None else None),
+        # per-phase latency breakdown (queue_wait / prefill / per-token
+        # decode, p50/p95/p99) so BENCH trajectories capture serving
+        # latency COMPOSITION, not just the TTFT headline
+        **{key: (round(snap[key], 5) if snap.get(key) is not None
+                 else None)
+           for key in (f"{phase}_{tag}"
+                       for phase in ("queue_wait_s", "prefill_s",
+                                     "decode_ms_per_token")
+                       for tag in ("p50", "p95", "p99"))},
         # engine-side gauges as read off the /v1/metrics scrape
         "scraped_metrics": scraped,
         "requests": len(handles),

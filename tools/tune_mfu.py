@@ -20,8 +20,10 @@ import jax.numpy as jnp
 import optax
 
 sys.path.insert(0, ".")
-from bench import peak_flops  # noqa: E402
 from tony_tpu.models.llama import get_config, llama_init, llama_loss  # noqa: E402
+# the ONE peak-FLOPs table + MFU formula, shared with bench.py and the
+# trainer's goodput metrics (observability/perf.py)
+from tony_tpu.observability.perf import mfu_pct  # noqa: E402
 from tony_tpu.train.step import make_train_step  # noqa: E402
 
 # Measured on v5e (2026-07-30): base_b4 (save_flash remat) 67.8%,
@@ -152,8 +154,8 @@ def run(name: str, spec: dict) -> dict:
             float(loss)
             dt = (time.monotonic() - t0) / n
             tok_s = b * s / dt
-            mfu = 100.0 * tok_s * config.flops_per_token(s) / peak_flops(
-                jax.devices()[0])
+            mfu = mfu_pct(tok_s, config.flops_per_token(s),
+                          jax.devices()[0])
             return {"variant": name, "step_s": round(dt, 4),
                     "tok_s": round(tok_s, 1), "mfu_pct": round(mfu, 2)}
     except Exception as e:  # noqa: BLE001 — report and move on (e.g. OOM)
