@@ -539,6 +539,213 @@ def _width_gang_run(width: int) -> dict:
     return out
 
 
+def _rss_mb() -> float:
+    """Current resident set of THIS process (MB), via /proc (the harness
+    hosts the AM-side stores in-process, so this is 'AM RSS')."""
+    try:
+        with open("/proc/self/statm", "r", encoding="utf-8") as f:
+            pages = int(f.read().split()[1])
+        return round(pages * os.sysconf("SC_PAGE_SIZE") / (1 << 20), 1)
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
+def _control_plane_width(width: int, history_points: int = 64,
+                         max_spans: int = 2048) -> dict:
+    """Synthetic-width control-plane storm (ROADMAP item 3's measuring
+    stick): `width` STUB tasks — real retrying gRPC clients, no
+    containers/user processes — against the REAL AM-side control plane
+    (TonySession gang barrier + MetricsStore + SpanStore behind the
+    genuine JSON-gRPC server). Records submit->all-registered latency,
+    heartbeat round-trip at width, AM-process RSS, and SpanStore/
+    MetricsStore sizes; then drives 3x history_points metric samples per
+    task through MetricsStore.update_metrics and asserts the PR-4
+    stride-doubling decimation actually bounds memory at this width."""
+    import statistics
+    import threading as th
+
+    from tony_tpu.am.application_master import MetricsStore
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.configuration import TonyConfiguration
+    from tony_tpu.observability.trace import SpanStore
+    from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
+    from tony_tpu.rpc.service import ClusterServiceHandler, serve
+    from tony_tpu.session.session import TonySession
+
+    conf = TonyConfiguration()
+    conf.set(K.instances_key("worker"), width, "bench")
+    session = TonySession(conf)
+    session.num_expected_tasks = width
+    store = MetricsStore(history_points=history_points)
+    spans = SpanStore(max_spans)
+    store.span_sink = spans.add
+
+    class _Handler(ClusterServiceHandler):
+        def get_task_infos(self, req):
+            return []
+
+        def get_cluster_spec(self, req):
+            return {"spec": session.cluster_spec_json()}
+
+        def register_worker_spec(self, req):
+            spec, generation, _ = \
+                session.register_worker_spec_with_generation(
+                    req["task_id"], req["spec"])
+            return {"spec": spec, "generation": generation}
+
+        def register_tensorboard_url(self, req):
+            return {}
+
+        def register_serving_endpoint(self, req):
+            return {}
+
+        def register_execution_result(self, req):
+            return {}
+
+        def finish_application(self, req):
+            return {}
+
+        def task_executor_heartbeat(self, req):
+            return {"spec_generation": session.spec_generation}
+
+        def request_profile(self, req):
+            return {"error": "control-plane harness"}
+
+        def read_task_logs(self, req):
+            return {"error": "control-plane harness"}
+
+    server, port = serve(cluster_handler=_Handler(), metrics_handler=store,
+                         max_workers=32)
+    n_clients = min(width, 32)
+    cluster = [ClusterServiceClient("127.0.0.1", port)
+               for _ in range(n_clients)]
+    metrics = [MetricsServiceClient("127.0.0.1", port)
+               for _ in range(n_clients)]
+    errors: list[str] = []
+    hb_times: list[float] = []
+    hb_lock = th.Lock()
+
+    def _stub(task_index: int) -> None:
+        c = cluster[task_index % n_clients]
+        m = metrics[task_index % n_clients]
+        tid = f"worker:{task_index}"
+        try:
+            c.call("register_worker_spec",
+                   {"task_id": tid, "spec": f"stub{task_index}:1"})
+            t0 = time.monotonic()
+            c.call("task_executor_heartbeat",
+                   {"task_id": tid, "task_attempt": 0},
+                   retries=1, timeout_sec=10.0)
+            with hb_lock:
+                hb_times.append(time.monotonic() - t0)
+            m.update_metrics(
+                "worker", task_index,
+                [{"name": "TPU_UTILIZATION", "value": 50.0},
+                 {"name": "TRAIN_STEP_TIME_MS", "value": 100.0}],
+                spans=[{"name": "user_process", "span_id": f"s{task_index}",
+                        "trace_id": "bench", "task_id": tid,
+                        "start_ms": 0, "end_ms": 1, "status": "OK"},
+                       {"name": "rendezvous_wait",
+                        "span_id": f"r{task_index}", "trace_id": "bench",
+                        "task_id": tid, "start_ms": 0, "end_ms": 1,
+                        "status": "OK"}],
+                attempt=0)
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            with hb_lock:
+                errors.append(f"{tid}: {type(e).__name__}: {e}")
+
+    t0 = time.monotonic()
+    threads = []
+    # bounded launcher: at most 64 stub threads in flight
+    sem = th.Semaphore(64)
+
+    def _run(i: int) -> None:
+        try:
+            _stub(i)
+        finally:
+            sem.release()
+
+    for i in range(width):
+        sem.acquire()
+        t = th.Thread(target=_run, args=(i,), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=120)
+    all_registered_s = time.monotonic() - t0
+    registered = session.all_tasks_registered()
+
+    # decimation-boundedness drive: 3x the ring capacity of samples per
+    # task through the REAL store path (in-process — the wire above
+    # already measured RPC cost); the stride-doubling TimeSeries must
+    # hold every series at <= history_points regardless
+    batch = 8   # samples per in-process push (cuts call overhead 8x)
+    for i in range(width):
+        for k in range(3 * history_points // batch):
+            store.update_metrics(
+                {"task_type": "worker", "index": i,
+                 # a live duty sample rides along so the wedge detector
+                 # doesn't (correctly, but noisily) flag the synthetic
+                 # pushes as a stalled task
+                 "metrics": [{"name": "TPU_UTILIZATION", "value": 50.0}]
+                 + [{"name": "TRAIN_STEP_TIME_MS",
+                     "value": float(k * batch + j)}
+                    for j in range(batch)]})
+    series = store.timeseries_dict()
+    max_points = max((len(pts) for per in series.values()
+                      for pts in per.values()), default=0)
+    total_points = sum(len(pts) for per in series.values()
+                       for pts in per.values())
+    bounded = (max_points <= history_points
+               and len(spans) <= max_spans)
+    out = {
+        "width": width,
+        "registered": registered,
+        "submit_to_all_registered_s": round(all_registered_s, 3),
+        "heartbeat_p50_ms": (round(
+            1000 * statistics.median(hb_times), 2) if hb_times else None),
+        "rss_mb": _rss_mb(),
+        "span_store": {"held": len(spans), "dropped": spans.dropped,
+                       "cap": max_spans},
+        "metrics_store": {"series_points_total": total_points,
+                          "series_points_max": max_points,
+                          "history_points_cap": history_points},
+        "bounded": bounded,
+        "errors": len(errors),
+    }
+    if errors:
+        out["first_error"] = errors[0]
+    server.stop(grace=0)
+    for c in cluster + metrics:
+        c.close()
+    return out
+
+
+def control_plane_main() -> None:
+    """`python bench.py --control-plane`: the synthetic-width harness at
+    gang widths {48, 256, 1024} (TONY_CP_WIDTHS overrides). Emits ONE
+    JSON line with a `control_plane` block; exits non-zero if the PR-4
+    decimation fails to bound AM memory at the widest gang."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    widths = [int(w) for w in os.environ.get(
+        "TONY_CP_WIDTHS", "48,256,1024").split(",") if w.strip()]
+    rows = []
+    for width in widths:
+        _mark(f"control-plane width {width}")
+        rows.append(_control_plane_width(width))
+        _mark(f"width {width}: all-registered "
+              f"{rows[-1]['submit_to_all_registered_s']}s rss "
+              f"{rows[-1]['rss_mb']}MB bounded={rows[-1]['bounded']}")
+    result = {"metric": "control_plane", "control_plane": {"widths": rows}}
+    unbounded = [r["width"] for r in rows if not r["bounded"]]
+    if unbounded:
+        result["error"] = (f"span/metrics stores unbounded at width(s) "
+                           f"{unbounded} — decimation regressed")
+    print(json.dumps(result), flush=True)
+    if unbounded:
+        sys.exit(1)
+
+
 def _bench_decode(jax, jnp, config, params, headroom=None) -> dict:
     """KV-cache generation throughput on the bench model (metadata next
     to the training MFU headline: the inference half of the lifecycle).
@@ -1087,5 +1294,7 @@ if __name__ == "__main__":
             child_main(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
         probe_main()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--control-plane":
+        control_plane_main()
     else:
         main()

@@ -206,6 +206,21 @@ class ChaosRun:
         assert len(finals) == 1, f"expected one .jhist, got {finals}"
         return os.path.basename(finals[0]), parse_events(finals[0])
 
+    def app_history_dir(self) -> str:
+        """The per-app history dir (holds the jhist + sidecar files)."""
+        hist_base = os.path.join(self.client.app_dir, C.HISTORY_DIR_NAME)
+        for d, _, files in os.walk(hist_base):
+            if any(f.endswith(C.HISTORY_SUFFIX)
+                   or f.endswith(C.HISTORY_INPROGRESS_SUFFIX)
+                   for f in files):
+                return d
+        return hist_base
+
+    def diagnostics(self) -> dict:
+        """The diagnostics.json root-cause bundle a failed run flushed."""
+        from tony_tpu.events.history import read_diagnostics_file
+        return read_diagnostics_file(self.app_history_dir())
+
     def events_of_type(self, event_type: EventType) -> list:
         _, events = self.history_events()
         return [e for e in events if e.type == event_type]

@@ -1,0 +1,58 @@
+"""Diagnostics-chaos gang member (tests/test_logs.py).
+
+Every instance prints a PLANTED credential and an OOM-shaped error line
+to stderr at startup — the redaction + signature-classification bait.
+The victim ($CHAOS_DIAG_VICTIM, "job:index") then fails once every gang
+member has started (deterministic ordering via the marker files):
+
+- CHAOS_DIAG_MODE=sigkill: the victim kills itself with SIGKILL — the
+  executor reports exit -9 with its own classified diagnostics (the
+  register_execution_result path, signal attribution pinned);
+- otherwise the victim just sleeps and an external injection
+  (TEST_TASK_KILL) hard-crashes its container without a registered
+  result (the AM-side container-completion diagnostics path).
+
+Survivors sleep until the AM stops them (KILLED_BY_AM — never a failure
+record).
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+job = os.environ["JOB_NAME"]
+index = int(os.environ["TASK_INDEX"])
+task_num = int(os.environ.get("TASK_NUM", "1"))
+attempt = int(os.environ.get("TASK_ATTEMPT", "0"))
+marker_dir = os.environ["MARKER_DIR"]
+
+# bait: a credential-shaped value that must NEVER appear in any shipped
+# tail or diagnostics bundle, plus a classifiable failure line
+PLANTED = os.environ.get("CHAOS_PLANTED_TOKEN", "deadbeef" * 8)
+print(f"booting with TONY_SECURITY_TOKEN={PLANTED}", file=sys.stderr)
+print("RESOURCE_EXHAUSTED: out of memory while allocating 16.00G on "
+      "device", file=sys.stderr, flush=True)
+
+os.makedirs(marker_dir, exist_ok=True)
+with open(os.path.join(marker_dir, f"{job}_{index}"), "a") as f:
+    f.write(json.dumps({"attempt": attempt}) + "\n")
+
+
+def peers_started() -> bool:
+    return all(os.path.isfile(os.path.join(marker_dir, f"{job}_{i}"))
+               for i in range(task_num))
+
+
+if os.environ.get("CHAOS_DIAG_VICTIM") == f"{job}:{index}" and attempt == 0:
+    deadline = time.monotonic() + 30
+    while not peers_started() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    if os.environ.get("CHAOS_DIAG_MODE") == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    time.sleep(60)   # TEST_TASK_KILL takes it down mid-run
+    raise SystemExit(1)
+
+time.sleep(60)
+raise SystemExit(1)

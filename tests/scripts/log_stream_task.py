@@ -1,0 +1,17 @@
+"""Live-tail test workload (tests/test_logs.py): prints numbered lines
+to stderr (with a planted credential that must never survive redaction),
+then idles briefly so the follow client can observe the stream live, and
+exits 0."""
+
+import os
+import sys
+import time
+
+planted = os.environ.get("CHAOS_PLANTED_TOKEN", "cafebabe" * 8)
+print(f"api_key={planted}", file=sys.stderr, flush=True)
+for i in range(50):
+    print(f"logline {i}", file=sys.stderr, flush=True)
+    time.sleep(0.02)
+print("stream done", file=sys.stderr, flush=True)
+time.sleep(3.0)
+raise SystemExit(0)

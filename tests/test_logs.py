@@ -1,0 +1,545 @@
+"""Live log streaming + failure diagnostics (observability/logs.py).
+
+Unit tier: redaction shapes, the error-signature table, exit/signal
+decoding, the bounded LogTail cursor contract, structured JSON-lines
+logging, and the control-plane hygiene static checks (no bare print;
+every event type has a renderer).
+
+E2E tier (chaos marker): a TEST_TASK_KILL-ed job's diagnostics.json
+names the correct first-failing task + signature with a redacted tail; a
+self-SIGKILLed victim pins signal attribution through the executor's own
+report; `logs --follow` streams a RUNNING task live through the AM with
+config-bounded chunks on every hop.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.observability.logs import (
+    LogTail, SIGNATURES, StructuredLogHandler, classify,
+    classify_container_failure, configure_structured_logging, decode_exit,
+    parse_structured_line, redact, tail_excerpt,
+)
+
+from tests.chaos import ChaosRun, KillTask, fast_conf, script
+
+pytestmark = pytest.mark.logs
+
+PLANTED = "deadbeef" * 8      # 64-hex: the token scheme's shape
+
+
+# ---------------------------------------------------------------------------
+# redaction
+# ---------------------------------------------------------------------------
+
+def test_redact_token_shapes():
+    assert PLANTED not in redact(f"boot with {PLANTED} inline")
+    assert PLANTED not in redact(f"TONY_SECURITY_TOKEN={PLANTED}")
+    assert PLANTED not in redact(f"Authorization: Bearer {PLANTED}")
+    assert "secret" not in redact("api_key=secret").split("=", 1)[1]
+    assert redact("my-password: hunter2").endswith("<redacted>")
+    # non-credentials survive
+    assert redact("loss at step 100: 2.345") == "loss at step 100: 2.345"
+    # 40-hex (not the token shape) survives — no overzealous scrubbing
+    sha = "a" * 40
+    assert sha in redact(f"commit {sha}")
+
+
+def test_redact_is_idempotent_and_line_safe():
+    once = redact(f"x={PLANTED}\nBearer {PLANTED}\nplain line")
+    assert redact(once) == once
+    assert "plain line" in once
+
+
+# ---------------------------------------------------------------------------
+# signature classification + exit decoding
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("line,expected", [
+    ("RESOURCE_EXHAUSTED: out of memory allocating 16G", "device_oom"),
+    ("jaxlib.xla_extension.XlaRuntimeError: INTERNAL: Mosaic failed",
+     "xla_compile_failure"),
+    ("ERROR: gang rendezvous timed out after 300s", "rendezvous_timeout"),
+    ("step 400: loss became NaN", "nan_loss"),
+    ("bash: line 1: 723 Killed  python train.py", "preempted"),
+    ("ModuleNotFoundError: No module named 'flash_attn'", "import_error"),
+])
+def test_classifier_signatures(line, expected):
+    got = classify(f"benign preamble\n{line}\ntrailing info")
+    assert got is not None and got["signature"] == expected
+    assert got["hint"]
+
+
+def test_classifier_last_match_wins_and_redacts():
+    text = (f"ImportError: early noise\n"
+            f"token={PLANTED}\n"
+            f"RESOURCE_EXHAUSTED: out of memory (token={PLANTED})")
+    got = classify(text)
+    assert got["signature"] == "device_oom"   # the LAST error line wins
+    assert PLANTED not in got["line"]
+
+
+def test_classify_none_on_benign_output():
+    assert classify("step 1 ok\nstep 2 ok\n") is None
+
+
+def test_decode_exit_signal_attribution():
+    assert decode_exit(-9)["signal_name"] == "SIGKILL"
+    assert decode_exit(137)["signal_name"] == "SIGKILL"
+    assert decode_exit(-15)["signal_name"] == "SIGTERM"
+    assert decode_exit(1) == {"exit_code": 1, "signal": 0,
+                              "signal_name": ""}
+    assert decode_exit(None)["signal"] == 0
+
+
+# ---------------------------------------------------------------------------
+# LogTail: bounded offset-cursor reads
+# ---------------------------------------------------------------------------
+
+def test_logtail_cursor_contract(tmp_path):
+    path = tmp_path / "stderr"
+    lines = [f"line {i:04d}" for i in range(200)]
+    path.write_text("\n".join(lines) + "\n")
+    tail = LogTail(str(path), tail_bytes=4096, chunk_bytes=256)
+
+    # fresh cursor starts AT MOST tail_bytes back, never at 0
+    first = tail.read_chunk(offset=-1)
+    assert first["offset"] >= tail.size() - 4096
+    # every chunk obeys the cap no matter what the caller asks
+    big = tail.read_chunk(offset=0, max_bytes=10_000_000)
+    assert big["next_offset"] - big["offset"] <= 256
+
+    # cursor walk reassembles the stream exactly (from the first offset)
+    out, offset = [], first["offset"]
+    for _ in range(100):
+        chunk = tail.read_chunk(offset=offset, final=True)
+        if not chunk["data"] and chunk["eof"]:
+            break
+        out.append(chunk["data"])
+        offset = chunk["next_offset"]
+    text = "".join(out)
+    assert text.endswith("line 0199\n")
+    assert "line 0190" in text
+
+
+def test_logtail_holds_back_partial_lines_until_final(tmp_path):
+    path = tmp_path / "stderr"
+    # credential split across a chunk boundary must never ship
+    # half-redacted: the unterminated line is held back entirely
+    path.write_text(f"complete line\npartial token={PLANTED}")
+    tail = LogTail(str(path), chunk_bytes=1 << 16)
+    live = tail.read_chunk(offset=0, final=False)
+    assert live["data"] == "complete line\n"
+    assert PLANTED not in live["data"]
+    done = tail.read_chunk(offset=0, final=True)
+    assert "partial token=" in done["data"]
+    assert PLANTED not in done["data"]       # redacted once complete
+    assert done["eof"] is True
+
+
+def test_logtail_never_splits_a_credential_across_chunks(tmp_path):
+    """Mid-FILE chunk boundaries (not just EOF) end on line boundaries:
+    a token straddling byte `chunk_bytes` must arrive intact in one
+    chunk and be redacted — both for live follows and for final reads of
+    large completed logs."""
+    path = tmp_path / "stderr"
+    pad = "x" * 240
+    path.write_text(f"{pad}\ntoken={PLANTED}\n" + "tail line\n" * 50)
+    for final in (False, True):
+        out, offset = [], 0
+        for _ in range(100):
+            chunk = LogTail(str(path), chunk_bytes=256).read_chunk(
+                offset=offset, final=final)
+            if not chunk["data"]:
+                break
+            out.append(chunk["data"])
+            offset = chunk["next_offset"]
+        text = "".join(out)
+        assert PLANTED not in text, f"token leaked (final={final})"
+        assert "token=<redacted>" in text, text[:400]
+        assert text.count("tail line") == 50
+
+
+def test_tail_excerpt_and_container_classification(tmp_path):
+    cdir = tmp_path / "worker_1_s0"
+    cdir.mkdir()
+    (cdir / "stdout").write_text("model compiled\n")
+    (cdir / "stderr").write_text(
+        f"TONY_SECURITY_TOKEN={PLANTED}\n"
+        + "\n".join(f"noise {i}" for i in range(300))
+        + "\nRESOURCE_EXHAUSTED: out of memory\n")
+    record = classify_container_failure(str(cdir), exit_code=1,
+                                        max_lines=50)
+    assert record["signature"] == "device_oom"
+    assert record["exit_code"] == 1 and record["signal"] == 0
+    assert len(record["tail"]["stderr"]) == 50       # line budget
+    dumped = json.dumps(record)
+    assert PLANTED not in dumped
+    # SIGKILL with no matching line still classifies as preemption
+    (cdir / "stderr").write_text("running fine\n")
+    record = classify_container_failure(str(cdir), exit_code=-9,
+                                        max_lines=50)
+    assert record["signature"] == "preempted"
+    assert record["signal_name"] == "SIGKILL"
+    # excerpt primitive drops empty/missing streams
+    excerpt = tail_excerpt(str(cdir), 10)
+    assert set(excerpt) == {"stdout", "stderr"}
+
+
+# ---------------------------------------------------------------------------
+# structured JSON-lines logging
+# ---------------------------------------------------------------------------
+
+def test_structured_handler_stamps_context():
+    stream = io.StringIO()
+    logger = logging.getLogger("test.structured")
+    logger.propagate = False
+    handler = StructuredLogHandler(
+        {"app_id": "app_1", "task_type": "worker", "index": 1,
+         "attempt": 2, "trace_id": "app_1"}, stream=stream)
+    logger.addHandler(handler)
+    try:
+        logger.warning("heartbeat failed (%d consecutive)", 3)
+    finally:
+        logger.removeHandler(handler)
+    entry = parse_structured_line(stream.getvalue())
+    assert entry is not None
+    assert entry["message"] == "heartbeat failed (3 consecutive)"
+    assert entry["level"] == "WARNING"
+    assert (entry["app_id"], entry["task_type"], entry["index"],
+            entry["attempt"], entry["trace_id"]) \
+        == ("app_1", "worker", 1, 2, "app_1")
+    assert entry["ts_ms"] > 0
+
+
+def test_configure_structured_logging_reads_env_contract():
+    env = {C.APP_ID: "app_9", C.JOB_NAME: "worker", C.TASK_INDEX: "3",
+           C.TASK_ATTEMPT: "1", C.TONY_TRACE_ID: "app_9"}
+    root = logging.getLogger()
+    saved = root.handlers[:]
+    try:
+        handler = configure_structured_logging(env=env,
+                                               stream=io.StringIO())
+        assert isinstance(handler, StructuredLogHandler)
+        assert handler.context["app_id"] == "app_9"
+        assert handler.context["index"] == 3
+        assert handler.context["attempt"] == 1
+    finally:
+        root.handlers[:] = saved
+
+
+def test_plain_log_opt_out():
+    root = logging.getLogger()
+    saved = root.handlers[:]
+    try:
+        root.handlers[:] = []
+        handler = configure_structured_logging(
+            env={"TONY_LOG_PLAIN": "1"})
+        assert not isinstance(handler, StructuredLogHandler)
+    finally:
+        root.handlers[:] = saved
+
+
+# ---------------------------------------------------------------------------
+# static checks (tier-1 CI hygiene)
+# ---------------------------------------------------------------------------
+
+CONTROL_PLANE_DIRS = ("am", "executor", "rpc", "portal", "serve")
+_PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tony_tpu")
+
+
+def _py_sources():
+    for sub in CONTROL_PLANE_DIRS:
+        for dirpath, _, files in os.walk(os.path.join(_PKG_ROOT, sub)):
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def test_control_plane_emits_through_the_structured_logger():
+    """No bare print() in am/, executor/, rpc/, portal/, serve/ — those
+    processes log through observability/logs.py so records carry the
+    {app_id, task, attempt, trace_id} stamp. Deliberate raw-stdout
+    markers (greppable bring-up lines) carry a `log-ok:` comment on the
+    line or the line above."""
+    bare = re.compile(r"^\s*print\(")
+    offenders = []
+    for path in _py_sources():
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            if not bare.match(line):
+                continue
+            context = line + "".join(lines[max(0, i - 2):i])
+            if "log-ok" in context:
+                continue
+            rel = os.path.relpath(path, _PKG_ROOT)
+            offenders.append(f"{rel}:{i + 1}: {line.strip()}")
+    assert not offenders, (
+        "bare print() in control-plane modules (use the structured "
+        "logger, or tag a deliberate stdout marker with a `log-ok:` "
+        "comment):\n" + "\n".join(offenders))
+
+
+def test_every_event_type_has_a_renderer():
+    from tony_tpu.events.render import RENDERERS, render_event
+    from tony_tpu.events.schema import EventType
+    missing = [e.value for e in EventType if e not in RENDERERS]
+    assert not missing, f"event types without a renderer: {missing}"
+    # renderers produce non-empty text on empty payloads (robustness)
+    for etype in EventType:
+        assert render_event(etype.value, {})
+
+
+def test_log_chunk_message_roundtrip():
+    from tony_tpu.rpc.messages import LogChunk
+    chunk = LogChunk(task_id="worker:0", stream="stdout", data="x\n",
+                     offset=10, next_offset=12, size=12, eof=True,
+                     source="aggregated")
+    assert LogChunk.from_dict(chunk.to_dict()) == chunk
+    assert LogChunk.from_dict({}).stream == "stderr"
+
+
+# ---------------------------------------------------------------------------
+# CLI diagnose (bundle file level)
+# ---------------------------------------------------------------------------
+
+def test_cli_diagnose_prints_bundle(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import diagnose
+    bundle = {
+        "app_id": "app_42", "status": "FAILED", "message": "boom",
+        "first_failure": {
+            "task_id": "worker:1", "attempt": 0, "exit_code": -9,
+            "signal_name": "SIGKILL", "signature": "device_oom",
+            "hint": "shrink the batch",
+            "reason": "executor reported exit -9",
+            "tail": {"stderr": ["RESOURCE_EXHAUSTED: oom",
+                                "TONY_SECURITY_TOKEN=<redacted>"]},
+        },
+        "failures": [
+            {"task_id": "worker:1", "attempt": 0},
+            {"task_id": "worker:0", "attempt": 0,
+             "reason": "collateral", "signature": ""},
+        ],
+    }
+    path = tmp_path / "history" / "app_42" / C.DIAGNOSTICS_FILE
+    path.parent.mkdir(parents=True)
+    path.write_text(json.dumps(bundle))
+    # app-dir resolution (the documented operator entrypoint)
+    assert diagnose([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for needle in ("worker:1", "SIGKILL", "device_oom",
+                   "RESOURCE_EXHAUSTED", "1 further failure"):
+        assert needle in out, out
+    assert diagnose([str(path), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["app_id"] == "app_42"
+    assert diagnose([str(tmp_path / "nosuch")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: the acceptance pins
+# ---------------------------------------------------------------------------
+
+chaos = pytest.mark.chaos
+
+
+@chaos
+def test_chaos_killed_job_diagnostics_bundle(tmp_path):
+    """Acceptance: a TEST_TASK_KILL-ed job (no relaunch budget) FAILS and
+    its diagnostics.json names the correct first-failing task with the
+    matched signature and a REDACTED tail excerpt; DIAGNOSTICS_READY
+    lands in history; `cli diagnose` prints the same story; the portal
+    renders the root-cause panel."""
+    run = ChaosRun(tmp_path, seed=11)
+    run.run(
+        ["--executes", script("chaos_diag_worker.py"),
+         "--conf", "tony.worker.instances=2",
+         # short-circuit on the victim's failure instead of waiting for
+         # the sleeping survivor — keeps the tier-1 case fast
+         "--conf", "tony.application.fail-on-worker-failure-enabled=true"],
+        injections=[KillTask("worker", 1, run.delay_ms(700, 1100),
+                             attempt=0)],
+        extra_env={"CHAOS_DIAG_VICTIM": "worker:1",
+                   "CHAOS_PLANTED_TOKEN": PLANTED})
+    assert run.final_status == "FAILED", run.all_logs()
+
+    bundle = run.diagnostics()
+    assert bundle, "diagnostics.json missing from history"
+    first = bundle["first_failure"]
+    assert first["task_id"] == "worker:1", bundle
+    assert first["attempt"] == 0
+    assert first["signature"] == "device_oom", first
+    assert first["tail"]["stderr"], first
+    dumped = json.dumps(bundle)
+    assert PLANTED not in dumped, "planted token leaked into diagnostics"
+    assert "<redacted>" in dumped
+
+    # DIAGNOSTICS_READY rode the event log
+    from tony_tpu.events.schema import EventType
+    ready = run.events_of_type(EventType.DIAGNOSTICS_READY)
+    assert len(ready) == 1
+    assert ready[0].payload.first_failing_task == "worker:1"
+    assert ready[0].payload.signature == "device_oom"
+    assert ready[0].payload.path == C.DIAGNOSTICS_FILE
+
+    # CLI prints the same bundle
+    from tony_tpu.cli.__main__ import diagnose
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert diagnose([run.client.app_dir]) == 0
+    out = buf.getvalue()
+    assert "worker:1" in out and "device_oom" in out
+    assert PLANTED not in out
+
+    # portal failure panel over the same history tree
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.server import PortalServer
+    app_id = os.path.basename(run.app_history_dir())
+    cache = PortalCache(os.path.dirname(run.app_history_dir()),
+                        str(tmp_path / "finished"))
+    server = PortalServer(cache, port=0, host="127.0.0.1")
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/jobs/{app_id}") as resp:
+            page = resp.read().decode()
+        assert "Root cause" in page
+        assert "worker:1" in page and "device_oom" in page
+        assert PLANTED not in page
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}"
+                f"/api/jobs/{app_id}/diagnostics") as resp:
+            api = json.loads(resp.read())
+        assert api["first_failure"]["task_id"] == "worker:1"
+    finally:
+        server.stop()
+
+
+@chaos
+def test_sigkill_victim_pins_signal_through_executor_report(tmp_path):
+    """A victim that dies BY SIGNAL (self-SIGKILL) reaches the bundle
+    through the executor's own register_execution_result diagnostics:
+    signal attribution, executor source, redacted tail."""
+    run = ChaosRun(tmp_path, seed=12)
+    run.run(
+        ["--executes", script("chaos_diag_worker.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.application.fail-on-worker-failure-enabled=true"],
+        extra_env={"CHAOS_DIAG_VICTIM": "worker:1",
+                   "CHAOS_DIAG_MODE": "sigkill",
+                   "CHAOS_PLANTED_TOKEN": PLANTED})
+    assert run.final_status == "FAILED", run.all_logs()
+    bundle = run.diagnostics()
+    first = bundle["first_failure"]
+    assert first["task_id"] == "worker:1"
+    assert first["signal_name"] == "SIGKILL", first
+    assert first["source"] == "executor", first
+    assert first["signature"] == "device_oom", first
+    assert PLANTED not in json.dumps(bundle)
+
+
+@chaos
+def test_live_follow_streams_running_task(tmp_path):
+    """Acceptance: `logs --follow` semantics against a live job — the
+    offset-cursor loop streams a RUNNING task's stderr through the AM
+    (live from the executor), every chunk stays under the configured
+    cap, planted credentials never ship, and the cursor keeps working
+    across task completion (aggregated source)."""
+    from tony_tpu.client.tony_client import TonyClient
+    from tony_tpu.rpc.client import ClusterServiceClient
+
+    conf = fast_conf(tmp_path, **{"tony.logs.chunk-bytes": 2048})
+    os.environ["CHAOS_PLANTED_TOKEN"] = "cafebabe" * 8
+    try:
+        client = TonyClient(conf)
+        client.init(["--executes", script("log_stream_task.py"),
+                     "--conf", "tony.worker.instances=1"])
+        result = {}
+
+        def _run():
+            result["ok"] = client.run()
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        rpc = None
+        collected, sources = [], set()
+        offset, chunk_caps_ok = -1, True
+        deadline = time.monotonic() + 90
+        try:
+            while time.monotonic() < deadline:
+                if rpc is None:
+                    hostport = os.path.join(client.app_dir or "",
+                                            C.AM_HOSTPORT_FILE)
+                    if not (client.app_dir and os.path.exists(hostport)):
+                        time.sleep(0.1)
+                        continue
+                    with open(hostport, "r", encoding="utf-8") as f:
+                        host, _, port = f.read().strip().rpartition(":")
+                    rpc = ClusterServiceClient(host, int(port))
+                try:
+                    chunk = rpc.read_task_logs(stream="stderr",
+                                               offset=offset)
+                except Exception:  # noqa: BLE001 — AM gone: job finished
+                    break
+                if (chunk or {}).get("error"):
+                    time.sleep(0.1)
+                    continue
+                if chunk.get("data"):
+                    collected.append(chunk["data"])
+                    sources.add(chunk.get("source"))
+                    if chunk["next_offset"] - chunk["offset"] > 2048:
+                        chunk_caps_ok = False
+                offset = int(chunk.get("next_offset", offset))
+                if "stream done" in "".join(collected[-3:]):
+                    break
+                time.sleep(0.05)
+        finally:
+            if rpc is not None:
+                rpc.close()
+        text = "".join(collected)
+        assert "logline 0" in text and "logline 49" in text, text[-2000:]
+        assert "stream done" in text
+        assert "live" in sources, sources
+        assert chunk_caps_ok, "a chunk exceeded tony.logs.chunk-bytes"
+        assert "cafebabe" * 8 not in text
+        assert "api_key=<redacted>" in text
+        t.join(timeout=60)
+        assert result.get("ok") is True
+    finally:
+        os.environ.pop("CHAOS_PLANTED_TOKEN", None)
+
+
+@chaos
+def test_superseded_attempt_logs_aggregated_at_relaunch(tmp_path):
+    """Incremental aggregation: when a relaunch supersedes an attempt,
+    the dead attempt's logs are copied into history AT THAT MOMENT (not
+    only at application finish) — the evidence survives an AM crash. The
+    job itself SUCCEEDS via the relaunch."""
+    run = ChaosRun(tmp_path, seed=13)
+    run.run(
+        ["--executes", script("chaos_gang_worker.py"),
+         "--conf", "tony.worker.instances=2",
+         "--conf", "tony.task.max-task-attempts=2"],
+        injections=[KillTask("worker", 1, run.delay_ms(800, 1200),
+                             attempt=0)])
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    logs_root = os.path.join(run.app_history_dir(),
+                             C.HISTORY_LOGS_DIR_NAME)
+    dirs = sorted(os.listdir(logs_root))
+    # attempt 0's dir and the replacement's attempt-suffixed dir are
+    # both in history
+    assert "worker_1_s0" in dirs, dirs
+    assert "worker_1_s0_a1" in dirs, dirs

@@ -73,6 +73,14 @@ class FakeClusterHandler(ClusterServiceHandler):
         return {"request_id": "fake-req", "task_id": "worker:0",
                 "num_steps": int(req.get("num_steps", 0) or 5)}
 
+    def read_task_logs(self, req):
+        self.log_reads = getattr(self, "log_reads", [])
+        self.log_reads.append(req)
+        return {"task_id": req.get("task_id") or "worker:0",
+                "stream": req.get("stream", "stderr"), "data": "",
+                "offset": 0, "next_offset": 0, "eof": False,
+                "source": "live"}
+
 
 class FakeMetricsHandler(MetricsServiceHandler):
     def __init__(self):
@@ -176,3 +184,35 @@ def test_heartbeat_fails_fast_against_dead_am():
         c.task_executor_heartbeat("worker:0")
     assert time.monotonic() - start < 6.0
     c.close()
+
+
+def test_task_log_service_roundtrip(tmp_path):
+    """The executor-hosted TaskLogService: bounded chunk reads over a
+    stream file through the real gRPC stack (the AM proxy's wire)."""
+    from tony_tpu.observability.logs import LogTail
+    from tony_tpu.rpc.client import TaskLogServiceClient
+    from tony_tpu.rpc.service import TaskLogServiceHandler
+
+    class Handler(TaskLogServiceHandler):
+        def read_log(self, req):
+            chunk = LogTail(str(tmp_path / req["stream"]),
+                            chunk_bytes=256).read_chunk(
+                offset=int(req.get("offset", -1)),
+                max_bytes=int(req.get("max_bytes", 0) or 0), final=True)
+            chunk["stream"] = req["stream"]
+            return chunk
+
+    (tmp_path / "stderr").write_text("hello\nworld\n")
+    server, port = serve(log_handler=Handler())
+    client = TaskLogServiceClient("127.0.0.1", port)
+    try:
+        chunk = client.read_log("stderr", offset=0)
+        assert chunk["data"] == "hello\nworld\n"
+        assert chunk["eof"] is True
+        assert chunk["next_offset"] == 12
+        # cursor continuation returns empty-at-eof
+        again = client.read_log("stderr", offset=chunk["next_offset"])
+        assert again["data"] == "" and again["eof"] is True
+    finally:
+        client.close()
+        server.stop(grace=None)

@@ -46,6 +46,9 @@ class FakeHandler:
     def request_profile(self, req):
         return {"request_id": "fake"}
 
+    def read_task_logs(self, req):
+        return {"data": "", "next_offset": 0, "eof": False}
+
 
 def test_token_file_roundtrip_and_mode(tmp_path):
     token = generate_token()
@@ -159,3 +162,42 @@ def test_secure_job_end_to_end(tmp_path):
     token_path = os.path.join(client.app_dir, ".tony-token")
     assert os.path.isfile(token_path)
     assert stat.S_IMODE(os.stat(token_path).st_mode) == 0o600
+
+
+def test_planted_token_never_ships_in_tails_or_diagnostics(tmp_path):
+    """Redaction contract (observability/logs.py): REAL token-scheme
+    material — the app secret, a derived per-task token, env-assignment
+    and Bearer forms — planted in user-process output never appears in a
+    live tail chunk, a diagnostics tail excerpt, or the assembled
+    failure record. This is the gate that makes shipping tails off the
+    container safe at all."""
+    import json
+
+    from tony_tpu.observability.logs import (
+        LogTail, classify_container_failure,
+    )
+    from tony_tpu.security.tokens import TOKEN_ENV, derive_task_token
+
+    secret = generate_token()
+    task_token = derive_task_token(secret, "worker:0")
+    cdir = tmp_path / "worker_0_s0"
+    cdir.mkdir()
+    (cdir / "stderr").write_text(
+        f"{TOKEN_ENV}={secret}\n"
+        f"curl -H 'Authorization: Bearer {task_token}' http://am:1234\n"
+        f"stray token in a traceback: {task_token}\n"
+        "RuntimeError: RESOURCE_EXHAUSTED: out of memory\n")
+    (cdir / "stdout").write_text(f"debug dump: secret={secret}\n")
+
+    # live-tail chunk (the executor's read_log path)
+    chunk = LogTail(str(cdir / "stderr")).read_chunk(offset=-1, final=True)
+    assert secret not in chunk["data"] and task_token not in chunk["data"]
+    assert "<redacted>" in chunk["data"]
+    assert "RESOURCE_EXHAUSTED" in chunk["data"]   # signal survives
+
+    # diagnostics record (executor failure report / AM fallback path)
+    record = classify_container_failure(str(cdir), exit_code=1,
+                                        max_lines=200)
+    dumped = json.dumps(record)
+    assert secret not in dumped and task_token not in dumped
+    assert record["signature"] == "device_oom"

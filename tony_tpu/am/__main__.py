@@ -8,7 +8,6 @@ success, 1 otherwise.
 from __future__ import annotations
 
 import argparse
-import logging
 import os
 import sys
 
@@ -22,9 +21,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--app_id", required=True)
     parser.add_argument("--app_dir", required=True)
     args = parser.parse_args(argv)
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # structured JSON-lines logging stamped with {app_id, trace_id} so AM
+    # records join the span waterfall (TONY_LOG_PLAIN=1 opts out)
+    from tony_tpu.observability.logs import configure_structured_logging
+    configure_structured_logging(app_id=args.app_id, trace_id=args.app_id)
     conf_path = os.path.join(args.app_dir, C.TONY_FINAL_CONF)
     conf = TonyConfiguration.read(conf_path)
     am = ApplicationMaster(conf, app_id=args.app_id, app_dir=args.app_dir)

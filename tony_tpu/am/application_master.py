@@ -40,9 +40,9 @@ from tony_tpu.conf import TonyConfiguration, keys as K
 from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
-    ApplicationFinished, ApplicationInited, Event, EventType,
-    ProfileCaptured, ServingEndpointRegistered, SloViolation, TaskFinished,
-    TaskRelaunched, TaskStarted,
+    ApplicationFinished, ApplicationInited, DiagnosticsReady, Event,
+    EventType, ProfileCaptured, ServingEndpointRegistered, SloViolation,
+    TaskFinished, TaskRelaunched, TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor
 from tony_tpu.rpc.service import (
@@ -345,6 +345,26 @@ class ApplicationMaster(ClusterServiceHandler):
             step_regression_pct=conf.get_int(
                 K.SLO_STEP_TIME_REGRESSION_PCT, 0),
             goodput_floor_pct=conf.get_int(K.SLO_GOODPUT_FLOOR_PCT, 0))
+        # live logs + failure diagnostics (observability/logs.py):
+        # executors gossip their TaskLogService address on heartbeats
+        # (task_id -> (attempt, "host:port"), attempt-fenced so a zombie
+        # can't hijack the replacement's tail); every observed task
+        # failure becomes one attempt-fenced record — the raw material of
+        # the diagnostics.json root-cause bundle a failed job flushes
+        self._log_tail_bytes = conf.get_int(K.LOGS_TAIL_BYTES, 65536)
+        self._log_chunk_bytes = conf.get_int(K.LOGS_CHUNK_BYTES, 32768)
+        self._diag_lines = conf.get_int(K.LOGS_DIAGNOSTICS_LINES, 200)
+        self._log_addrs: dict[str, tuple[int, str]] = {}
+        # follow-mode polls arrive every ~500 ms per follower: reuse ONE
+        # channel per (task, attempt, addr) instead of a fresh TCP+HTTP/2
+        # handshake per chunk; displaced entries are closed
+        self._log_clients: dict[str, tuple[int, str, object]] = {}
+        # (task_id, attempt) -> failure record; first observer wins (one
+        # crash has up to three observers — result RPC, completion
+        # callback, heartbeat expiry — and the executor's own redacted
+        # report is the best evidence, so it is recorded before the
+        # relaunch decision runs)
+        self._failure_records: dict[tuple[str, int], dict] = {}
         self._root_span = None
         self._rendezvous_span = None
         # (task_id, attempt) -> open task span (allocation → completion)
@@ -601,41 +621,193 @@ class ApplicationMaster(ClusterServiceHandler):
 
     def _aggregate_container_logs(self) -> None:
         """Copy every container's stdout/stderr into the history dir
-        (`<history>/logs/<container-dir>/<stream>`) at finish — the
+        (`<history>/logs/<container-dir>/<stream>`) — the
         YARN-log-aggregation role. The reference's portal linked to live
         NodeManager web servers (models/JobLog.java:27-60); here no such
         server exists after the app dies, so the logs travel WITH the
         history and the portal serves them itself (/logs/:id/:task/:stream).
-        Files are tail-capped at tony.history.log-max-size."""
+        Files are tail-capped at tony.history.log-max-size.
+
+        This is the finish-time sweep; it RE-copies dirs the incremental
+        path already aggregated (cheap — tail-capped files) so the final
+        history always holds the complete stream."""
         src_root = os.path.join(self.app_dir, C.CONTAINERS_DIR_NAME)
         if not os.path.isdir(src_root):
             return
+        try:
+            for cdir in sorted(os.listdir(src_root)):
+                self._aggregate_one_container(cdir)
+        except Exception:  # noqa: BLE001 — observability must not fail the app
+            LOG.exception("container log aggregation failed")
+
+    def _aggregate_one_container(self, cdir: str) -> None:
+        """Aggregate ONE container dir's streams into history. Called at
+        finish (the sweep above), at task completion, and when a relaunch
+        supersedes an attempt — so an AM crash or `kill -9` after that
+        point no longer loses the logs, and the portal's permanent
+        'logs unavailable (not aggregated)' state for such jobs is gone."""
+        src_root = os.path.join(self.app_dir, C.CONTAINERS_DIR_NAME)
         cap = self.conf.get_memory_mb(K.HISTORY_LOG_MAX_SIZE, 10) \
             * 1024 * 1024
         dst_root = os.path.join(self.history_dir, C.HISTORY_LOGS_DIR_NAME)
         try:
-            for cdir in sorted(os.listdir(src_root)):
-                for stream in ("stdout", "stderr"):
-                    src = os.path.join(src_root, cdir, stream)
-                    if not os.path.isfile(src):
-                        continue
-                    dst_dir = os.path.join(dst_root, cdir)
-                    os.makedirs(dst_dir, exist_ok=True)
-                    size = os.path.getsize(src)
-                    with open(src, "rb") as fin, \
-                            open(os.path.join(dst_dir, stream), "wb") as fo:
-                        if size > cap:
-                            # keep the TAIL — failures print last
-                            fin.seek(size - cap)
-                            fo.write(b"[... truncated by log "
-                                     b"aggregation ...]\n")
-                        while True:
-                            chunk = fin.read(1 << 20)
-                            if not chunk:
-                                break
-                            fo.write(chunk)
+            for stream in ("stdout", "stderr"):
+                src = os.path.join(src_root, cdir, stream)
+                if not os.path.isfile(src):
+                    continue
+                dst_dir = os.path.join(dst_root, cdir)
+                os.makedirs(dst_dir, exist_ok=True)
+                size = os.path.getsize(src)
+                with open(src, "rb") as fin, \
+                        open(os.path.join(dst_dir, stream), "wb") as fo:
+                    if size > cap:
+                        # keep the TAIL — failures print last
+                        fin.seek(size - cap)
+                        fo.write(b"[... truncated by log "
+                                 b"aggregation ...]\n")
+                    while True:
+                        chunk = fin.read(1 << 20)
+                        if not chunk:
+                            break
+                        fo.write(chunk)
         except Exception:  # noqa: BLE001 — observability must not fail the app
-            LOG.exception("container log aggregation failed")
+            LOG.exception("log aggregation failed for %s", cdir)
+
+    def _aggregate_task_container(self, task: Task) -> None:
+        """Incremental aggregation for the container a task is (or was)
+        running in, derived from the stdout path recorded at launch."""
+        url = getattr(task, "url", "")
+        if url:
+            self._aggregate_one_container(os.path.basename(
+                os.path.dirname(url)))
+
+    # ------------------------------------------------------------------
+    # failure diagnostics (observability/logs.py)
+    # ------------------------------------------------------------------
+    def _record_task_failure(self, task_id: str, attempt: int, reason: str,
+                             exit_code: Optional[int] = None,
+                             diagnostics: Optional[dict] = None,
+                             container_dir: str = "") -> None:
+        """One attempt-fenced failure record. First observer wins: the
+        executor's own redacted report (register_execution_result
+        `diagnostics`) usually lands first and is the best evidence; a
+        container-completion or heartbeat-expiry observer of the SAME
+        (task, attempt) only fills the slot if nothing did yet, reading
+        the container's files itself (local/shared-fs backends) for the
+        tail + signature."""
+        key = (task_id, max(attempt, 0))
+        with self._lock:
+            if key in self._failure_records:
+                return
+        # build the FULL record outside the lock (the tail read is file
+        # I/O), publish atomically below — a concurrent diagnostics
+        # flush must never snapshot a half-built record
+        record = {
+            "task_id": task_id, "attempt": max(attempt, 0),
+            "ts_ms": int(time.time() * 1000), "reason": reason,
+            "exit_code": exit_code,
+        }
+        try:
+            from tony_tpu.observability import logs as tlogs
+            if diagnostics:
+                body = dict(diagnostics)
+                body.pop("task_id", None)
+                body.pop("attempt", None)
+                record.update(body)
+                record["source"] = "executor"
+            elif container_dir and os.path.isdir(container_dir):
+                record.update(tlogs.classify_container_failure(
+                    container_dir, exit_code, self._diag_lines,
+                    tail_bytes=self._log_tail_bytes))
+                record["source"] = "am"
+            else:
+                record.update(tlogs.decode_exit(exit_code))
+                record["source"] = "am"
+            if "signature" not in record:
+                sig = tlogs.classify(reason)
+                if sig:
+                    record.update(sig)
+        except Exception:  # noqa: BLE001 — diagnostics must not fail the AM
+            LOG.exception("failed to enrich failure record for %s", task_id)
+        with self._lock:
+            # first COMPLETE record wins (two observers may build
+            # concurrently; the executor's shipped report is cheap to
+            # build, so it tends to land first — the preferred evidence)
+            if key in self._failure_records:
+                return
+            self._failure_records[key] = record
+        LOG.warning("recorded failure of %s attempt %d (%s, signature=%s)",
+                    task_id, max(attempt, 0), reason,
+                    record.get("signature", "none"))
+
+    def _assemble_diagnostics(self, status: str) -> Optional[dict]:
+        """The root-cause bundle for a failed/killed job: every failure
+        record ordered by observation time, the FIRST one called out as
+        the root cause (first failure by timestamp across attempts — at
+        gang width every peer dies of the first victim's collapse, so
+        ordering is the diagnosis), plus span links into the waterfall.
+        Written as diagnostics.json next to the event log and announced
+        with a DIAGNOSTICS_READY event."""
+        with self._lock:
+            records = sorted(self._failure_records.values(),
+                             key=lambda r: (r.get("ts_ms", 0),
+                                            r.get("task_id", "")))
+        session = self.session
+        message = session.final_message if session is not None else None
+        if not records and status == "SUCCEEDED":
+            return None
+        first = records[0] if records else None
+        bundle = {
+            "app_id": self.app_id,
+            "status": status,
+            "message": message or "",
+            "generated_ms": int(time.time() * 1000),
+            "line_budget": self._diag_lines,
+            "first_failure": first,
+            "failures": records,
+        }
+        if first is not None:
+            # link the failing task's lifecycle spans so the bundle jumps
+            # straight into the waterfall (same trace_id = app_id)
+            task_id = first.get("task_id", "")
+            spans = [
+                {k: s.get(k) for k in ("name", "span_id", "start_ms",
+                                       "end_ms", "status")}
+                for s in self.span_store.to_list()
+                if s.get("task_id") == task_id
+            ][:32]
+            bundle["first_failure_spans"] = spans
+        return bundle
+
+    def _flush_diagnostics(self, status: str) -> None:
+        """Assemble + persist the bundle and emit DIAGNOSTICS_READY (part
+        of _finish, BEFORE the event log closes). Succeeding jobs write
+        nothing — the file's existence means 'there is a story here'."""
+        if status == "SUCCEEDED":
+            return
+        try:
+            bundle = self._assemble_diagnostics(status)
+            if bundle is None:
+                return
+            from tony_tpu.events.history import write_diagnostics_file
+            write_diagnostics_file(self.history_dir, bundle)
+            first = bundle.get("first_failure") or {}
+            self.event_handler.emit(Event(
+                EventType.DIAGNOSTICS_READY,
+                DiagnosticsReady(
+                    self.app_id,
+                    first_failing_task=first.get("task_id", ""),
+                    attempt=int(first.get("attempt", 0) or 0),
+                    signature=first.get("signature", ""),
+                    exit_code=int(first.get("exit_code") or 0),
+                    signal_name=first.get("signal_name", ""),
+                    num_failures=len(bundle.get("failures", [])),
+                    path=C.DIAGNOSTICS_FILE)))
+            LOG.info("diagnostics bundle written (%d failure records, "
+                     "first: %s)", len(bundle.get("failures", [])),
+                     first.get("task_id", "<none>"))
+        except Exception:  # noqa: BLE001 — diagnostics must not fail _finish
+            LOG.exception("failed to flush the diagnostics bundle")
 
     def _publish_history(self, final_hist: str) -> None:
         """Upload the finalized jhist + config snapshot to the staging
@@ -655,7 +827,8 @@ class ApplicationMaster(ClusterServiceHandler):
             store.put(final_hist,
                       f"history/{os.path.basename(final_hist)}")
             for extra in (C.PORTAL_CONFIG_FILE, C.SPANS_FILE,
-                          C.METRICS_FILE, C.GOODPUT_FILE):
+                          C.METRICS_FILE, C.GOODPUT_FILE,
+                          C.DIAGNOSTICS_FILE):
                 p = os.path.join(self.history_dir, extra)
                 if os.path.exists(p):
                     store.put(p, f"history/{extra}")
@@ -1009,6 +1182,9 @@ class ApplicationMaster(ClusterServiceHandler):
                             attrs={"final_status": status})
             self._root_span = None
         self._flush_observability()
+        # root-cause bundle BEFORE the event log closes: the
+        # DIAGNOSTICS_READY event must land inside the jhist
+        self._flush_diagnostics(status)
         if self.session is not None:
             all_metrics = []
             for infos in (self.session.get_task_infos() or []):
@@ -1045,6 +1221,14 @@ class ApplicationMaster(ClusterServiceHandler):
     def _teardown(self) -> None:
         self.backend.stop()
         self.hb_monitor.stop()
+        with self._lock:
+            log_clients = list(self._log_clients.values())
+            self._log_clients.clear()
+        for _, _, client in log_clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001
+                pass
         if self._metrics_http is not None:
             self._metrics_http.stop()
             self._metrics_http = None
@@ -1287,6 +1471,17 @@ class ApplicationMaster(ClusterServiceHandler):
             # the attempt this completion belongs to, captured while the
             # container ownership check above still holds
             observed_attempt = task.attempt
+        # diagnostics: a crash that never registered a result (hard kill,
+        # os._exit) is only ever seen HERE — read the container's own
+        # files for the tail + signature before the relaunch decision can
+        # recycle the slot (first-wins: an executor-shipped report for
+        # the same attempt already holds the slot)
+        if exit_code not in (0, C.EXIT_KILLED_BY_AM):
+            self._record_task_failure(
+                task.task_id, observed_attempt,
+                f"container exited with code {exit_code}",
+                exit_code=exit_code,
+                container_dir=os.path.dirname(task.url) if task.url else "")
         # within budget, a tracked task's crash replaces only that container
         # instead of failing the session (the reference's all-or-nothing
         # short-circuit, TonySession.java:251-271, becomes the fallback).
@@ -1309,6 +1504,11 @@ class ApplicationMaster(ClusterServiceHandler):
             "OK" if exit_code in (0, C.EXIT_KILLED_BY_AM) else "ERROR",
             reason=f"exit {exit_code}")
         session.on_task_completed(task.job_name, task.index, exit_code)
+        # incremental log aggregation: this container's streams are final
+        # — copy them into history NOW, so an AM crash/kill -9 after this
+        # point no longer loses the logs (previously aggregation only
+        # happened at application finish)
+        self._aggregate_task_container(task)
         self.scheduler.register_dependency_completed(task.job_name)
         self.event_handler.emit(Event(
             EventType.TASK_FINISHED,
@@ -1339,6 +1539,17 @@ class ApplicationMaster(ClusterServiceHandler):
                         task_id)
             self.hb_monitor.unregister(task_id)
             return
+        if (attempt < 0 or task.attempt == attempt) and not task.completed \
+                and task.container_id:
+            # a wedge the liveliness monitor caught: no exit code exists,
+            # but the container's files often hold the story (hung
+            # collective, stalled input) — snapshot the tail now, before
+            # a relaunch recycles the dir name
+            self._record_task_failure(
+                task_id, attempt if attempt >= 0 else task.attempt,
+                f"missed {self._max_missed_hb} heartbeats",
+                container_dir=(os.path.dirname(task.url)
+                               if task.url else ""))
         if attempt >= 0 and task.attempt != attempt:
             # stale expiry: the silent attempt was already relaunched past
             LOG.info("ignoring expiry of %s attempt %d (slot now at "
@@ -1442,6 +1653,7 @@ class ApplicationMaster(ClusterServiceHandler):
                           self._total_task_failures, max_total)
                 return False
             old_cid = task.container_id
+            old_url = task.url
             if session.relaunch_task(task.job_name, task.index) is None:
                 return False
             # the dead attempt must not linger in liveliness or wedge
@@ -1485,6 +1697,12 @@ class ApplicationMaster(ClusterServiceHandler):
         # and stop_container may block on process teardown
         if old_cid:
             self.backend.stop_container(old_cid)
+        # relaunch supersession: the dead attempt's logs are evidence —
+        # aggregate them into history NOW (its dir name is attempt-unique,
+        # so the replacement can never overwrite them)
+        if old_url:
+            self._aggregate_one_container(
+                os.path.basename(os.path.dirname(old_url)))
         # the failed attempt's span ends here; the gang is back at the
         # barrier until the replacement registers, so a fresh rendezvous
         # span opens (waterfall shows relaunch → re-rendezvous wait)
@@ -1641,6 +1859,19 @@ class ApplicationMaster(ClusterServiceHandler):
                      task.attempt)
             return {}
         exit_code = int(req["exit_code"])
+        # diagnostics: the executor's own classified, redacted post-mortem
+        # is the best failure evidence — record it FIRST (attempt-fenced,
+        # first-wins) so neither the relaunch decision nor a racing
+        # completion callback can beat it to the record slot
+        if exit_code not in (0, C.EXIT_KILLED_BY_AM) and task is not None:
+            self._record_task_failure(
+                task_id, attempt if attempt >= 0 else task.attempt,
+                ("gang rendezvous timed out" if req.get("barrier_timeout")
+                 else f"executor reported exit {exit_code}"),
+                exit_code=exit_code,
+                diagnostics=req.get("diagnostics")
+                if isinstance(req.get("diagnostics"), dict) else None,
+                container_dir=os.path.dirname(task.url) if task.url else "")
         # barrier_timeout marks a rendezvous timeout — an allocation
         # problem, not a task fault: replacing healthy containers cannot
         # conjure the missing allocation, so no relaunch budget is spent.
@@ -1689,6 +1920,13 @@ class ApplicationMaster(ClusterServiceHandler):
                 # zombie ping from a relaunched-past attempt: must not keep
                 # the replacement's liveliness entry fresh
                 return {"spec_generation": generation}
+        # live-tail surface: remember where this attempt's TaskLogService
+        # listens (attempt-fenced above — a zombie's address can never
+        # displace the replacement's)
+        log_addr = str(req.get("log_addr", "") or "")
+        if log_addr:
+            with self._lock:
+                self._log_addrs[req["task_id"]] = (max(attempt, 0), log_addr)
         if not self.hb_monitor.ping(req["task_id"]):
             # an alive executor with no liveliness entry: it either has not
             # registered yet (entries are planted at register_worker_spec)
@@ -1769,6 +2007,102 @@ class ApplicationMaster(ClusterServiceHandler):
         LOG.info("profile requested for %s (%d steps, id %s)", task_id,
                  steps, rid)
         return {"request_id": rid, "task_id": task_id, "num_steps": steps}
+
+    def _log_client(self, task_id: str, attempt: int, addr: str):
+        """Cached TaskLogServiceClient for one executor's log service,
+        keyed to (attempt, addr) — a relaunch (new attempt/port)
+        displaces and closes the stale channel."""
+        from tony_tpu.rpc.client import TaskLogServiceClient
+        from tony_tpu.security.tokens import derive_task_token
+        with self._lock:
+            cached = self._log_clients.get(task_id)
+            if cached is not None and cached[0] == attempt \
+                    and cached[1] == addr:
+                return cached[2]
+        token = (derive_task_token(self._auth_token, task_id)
+                 if self._auth_token else None)
+        host, _, port = addr.rpartition(":")
+        client = TaskLogServiceClient(host, int(port), auth_token=token)
+        stale = None
+        with self._lock:
+            stale = self._log_clients.get(task_id)
+            self._log_clients[task_id] = (attempt, addr, client)
+        if stale is not None:
+            try:
+                stale[2].close()
+            except Exception:  # noqa: BLE001
+                pass
+        return client
+
+    def read_task_logs(self, req: dict) -> dict:
+        """Operator plane: one bounded log chunk for a task. RUNNING task
+        → proxied live from its executor's TaskLogService (address from
+        heartbeat gossip, authenticated with the task's re-derived
+        token); completed task (or unreachable executor) → served from
+        the logs aggregated into history at task completion. Chunk size
+        is capped at tony.logs.chunk-bytes either way."""
+        from tony_tpu.observability.logs import STREAMS, LogTail
+        session = self.session
+        if session is None:
+            return {"error": "no active session"}
+        stream = str(req.get("stream", "stderr") or "stderr")
+        if stream not in STREAMS:
+            return {"error": f"unknown stream {stream!r}"}
+        offset = int(req.get("offset", -1))
+        max_bytes = min(int(req.get("max_bytes", 0) or 0)
+                        or self._log_chunk_bytes, self._log_chunk_bytes)
+        task_id = str(req.get("task_id", "") or "")
+        if not task_id:
+            running = [t for tasks in session.job_tasks.values()
+                       for t in tasks
+                       if session.is_tracked(t.job_name)
+                       and not t.completed and t.container_id]
+            if not running:
+                return {"error": "no running tracked task to tail"}
+            task_id = running[0].task_id
+        task = session.get_task_by_id(task_id)
+        if task is None:
+            return {"error": f"no such task {task_id!r}"}
+        with self._lock:
+            entry = self._log_addrs.get(task_id)
+        if (not task.completed and entry is not None
+                and entry[0] == task.attempt):
+            client = self._log_client(task_id, entry[0], entry[1])
+            try:
+                chunk = client.read_log(stream, offset, max_bytes)
+                if "error" not in chunk:
+                    chunk["task_id"] = task_id
+                    chunk["source"] = "live"
+                    return chunk
+            except Exception:  # noqa: BLE001 — degrade to aggregated logs
+                LOG.warning("live log read from %s (%s) failed; falling "
+                            "back to aggregated logs", task_id, entry[1],
+                            exc_info=True)
+        # aggregated / shared-fs path: the container's own file when this
+        # host can see it, else the tail-capped copy in history
+        path = None
+        if task.url:
+            candidate = os.path.join(os.path.dirname(task.url), stream)
+            if os.path.isfile(candidate):
+                path = candidate
+        if path is None:
+            cdir = (os.path.basename(os.path.dirname(task.url))
+                    if task.url else "")
+            if cdir:
+                candidate = os.path.join(
+                    self.history_dir, C.HISTORY_LOGS_DIR_NAME, cdir, stream)
+                if os.path.isfile(candidate):
+                    path = candidate
+        if path is None:
+            return {"error": f"no logs available for {task_id} ({stream})"}
+        tail = LogTail(path, tail_bytes=self._log_tail_bytes,
+                       chunk_bytes=self._log_chunk_bytes)
+        chunk = tail.read_chunk(offset=offset, max_bytes=max_bytes,
+                                final=task.completed)
+        chunk["stream"] = stream
+        chunk["task_id"] = task_id
+        chunk["source"] = "aggregated"
+        return chunk
 
     def _on_profile_captured(self, task_type: str, index: int,
                              pd: dict) -> None:

@@ -1,4 +1,4 @@
-"""`python -m tony_tpu.cli {submit|local|notebook|profile} ...`
+"""`python -m tony_tpu.cli {submit|local|notebook|profile|logs|diagnose} ...`
 
 - submit   — ClusterSubmitter equivalent (cli/ClusterSubmitter.java:41-94):
              run against the configured cluster workdir; app artifacts
@@ -11,6 +11,13 @@
              one task's trainer (request_profile RPC; the artifact lands
              in the job's history as profiles/<request_id>/ and a
              PROFILE_CAPTURED event links it).
+- logs     — stream a task's stdout/stderr through the app's AM
+             (read_task_logs RPC): live from the executor while the task
+             runs, from history-aggregated logs after; `--follow` polls
+             with an offset cursor (bounded chunks on every hop).
+- diagnose — print a failed app's root-cause bundle (diagnostics.json):
+             first-failing task, exit signal, matched error signature,
+             redacted last-lines excerpt.
 """
 
 from __future__ import annotations
@@ -23,7 +30,222 @@ from tony_tpu.cli.local_submitter import submit as local_submit
 from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
-         "{submit|local|notebook|profile} [args...]")
+         "{submit|local|notebook|profile|logs|diagnose} [args...]")
+
+
+def _am_client(app_dir: str):
+    """(client, error) for the app's AM, from the amhostport file +
+    token the client left in the app dir — the same plumbing as the
+    `profile` verb."""
+    import os
+
+    from tony_tpu import constants as C
+    from tony_tpu.rpc.client import ClusterServiceClient
+    from tony_tpu.security import read_token_file
+
+    hostport_path = os.path.join(app_dir, C.AM_HOSTPORT_FILE)
+    try:
+        with open(hostport_path, "r", encoding="utf-8") as f:
+            host, _, port = f.read().strip().rpartition(":")
+    except OSError as e:
+        return None, f"cannot read {hostport_path}: {e} — is the app running?"
+    token = read_token_file(app_dir)
+    return ClusterServiceClient(host, int(port),
+                                auth_token=token or None), None
+
+
+def logs(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli logs <app_dir> [task] [--stream stderr]
+    [--follow]` — live task log streaming through the AM. Both sides are
+    bounded: a fresh cursor starts at most tony.logs.tail-bytes back,
+    every chunk is capped at tony.logs.chunk-bytes, and --follow polls
+    at tony.logs.follow-poll-ms (flag-overridable)."""
+    import argparse
+    import time
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli logs")
+    parser.add_argument("app_dir",
+                        help="the application dir the client created "
+                             "(holds the amhostport file)")
+    parser.add_argument("task", nargs="?", default="",
+                        help="task to tail, e.g. worker:0 (default: the "
+                             "AM picks the first running tracked task)")
+    parser.add_argument("--stream", default="stderr",
+                        choices=("stdout", "stderr"))
+    parser.add_argument("--follow", "-f", action="store_true",
+                        help="keep polling for new output until the "
+                             "stream ends (or Ctrl-C)")
+    parser.add_argument("--poll-ms", type=int, default=500,
+                        help="--follow poll interval")
+    parser.add_argument("--max-bytes", type=int, default=0,
+                        help="per-chunk byte cap (0 = server default; "
+                             "the server enforces tony.logs.chunk-bytes "
+                             "regardless)")
+    args = parser.parse_args(argv)
+    from tony_tpu.rpc.messages import LogChunk
+
+    client, err = _am_client(args.app_dir)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
+    offset = -1
+    task_id = args.task
+    # --follow rides out transient blips (AM busy, relaunch window):
+    # only this many CONSECUTIVE failed polls end the stream — a single
+    # deadline miss must not kill a tail mid-incident
+    max_consecutive_failures = 10 if args.follow else 1
+    failures = 0
+    got_any = False
+    try:
+        while True:
+            chunk = None
+            try:
+                resp = client.read_task_logs(
+                    task_id=task_id, stream=args.stream, offset=offset,
+                    max_bytes=args.max_bytes)
+                if (resp or {}).get("error"):
+                    print(f"error: {resp['error']}", file=sys.stderr)
+                else:
+                    chunk = LogChunk.from_dict(resp or {})
+            except Exception as e:  # noqa: BLE001 — transient or AM gone
+                print(f"log read failed: {e}", file=sys.stderr)
+            if chunk is None:
+                failures += 1
+                if failures >= max_consecutive_failures:
+                    if args.follow:
+                        print("log stream ended", file=sys.stderr)
+                    return 0 if got_any else 1
+                time.sleep(max(50, args.poll_ms) / 1000.0)
+                continue
+            failures = 0
+            if chunk.data:
+                got_any = True
+                sys.stdout.write(chunk.data)
+                sys.stdout.flush()
+            # lock onto the task the AM picked so the cursor never
+            # migrates between tasks mid-stream
+            task_id = chunk.task_id or task_id
+            offset = chunk.next_offset
+            if not args.follow and not chunk.data:
+                return 0
+            if chunk.eof:
+                return 0
+            if not chunk.data:
+                time.sleep(max(50, args.poll_ms) / 1000.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
+def _find_diagnostics(target: str):
+    """Resolve a diagnostics.json from an app dir, a history dir, or a
+    direct file path; returns (bundle dict | None, searched paths)."""
+    import glob
+    import json
+    import os
+
+    from tony_tpu import constants as C
+
+    candidates = []
+    if os.path.isfile(target):
+        candidates = [target]
+    else:
+        candidates = (
+            [os.path.join(target, C.DIAGNOSTICS_FILE)]
+            + sorted(glob.glob(os.path.join(
+                target, C.HISTORY_DIR_NAME, "*", C.DIAGNOSTICS_FILE)))
+            + sorted(glob.glob(os.path.join(target, "*",
+                                            C.DIAGNOSTICS_FILE))))
+        # an app dir with a configured tony.history.intermediate keeps
+        # its history elsewhere — follow the frozen conf there
+        frozen = os.path.join(target, C.TONY_FINAL_CONF)
+        if os.path.isfile(frozen):
+            try:
+                from tony_tpu.conf import TonyConfiguration, keys as K
+                intermediate = TonyConfiguration.read(frozen).get_str(
+                    K.HISTORY_INTERMEDIATE, "")
+            except Exception:  # noqa: BLE001 — conf damage ≠ no diagnosis
+                intermediate = ""
+            if intermediate:
+                app_id = os.path.basename(os.path.normpath(target))
+                candidates += (
+                    [os.path.join(intermediate, app_id,
+                                  C.DIAGNOSTICS_FILE)]
+                    + sorted(glob.glob(os.path.join(
+                        intermediate, "*", C.DIAGNOSTICS_FILE))))
+    for path in candidates:
+        if os.path.isfile(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    return json.load(f), candidates
+            except (OSError, ValueError):
+                continue
+    return None, candidates
+
+
+def diagnose(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli diagnose <app_dir>` — print the job's
+    root-cause bundle (the same diagnostics.json the portal's failure
+    panel renders)."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli diagnose")
+    parser.add_argument("target",
+                        help="app dir, history dir, or a diagnostics.json")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw bundle instead of a summary")
+    args = parser.parse_args(argv)
+    bundle, searched = _find_diagnostics(args.target)
+    if bundle is None:
+        print("no diagnostics bundle found (searched: "
+              + ", ".join(searched[:4])
+              + "). The job may have succeeded, still be running, or "
+                "predate diagnostics.", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(bundle, indent=1, sort_keys=True))
+        return 0
+    first = bundle.get("first_failure") or {}
+    print(f"application {bundle.get('app_id', '?')}: "
+          f"{bundle.get('status', '?')}")
+    if bundle.get("message"):
+        print(f"  {bundle['message']}")
+    if not first:
+        print("no task failure records — the failure was not task-level "
+              "(preprocess, allocation, or client stop)")
+        return 0
+    sigdesc = first.get("signal_name") or (
+        f"exit {first.get('exit_code')}"
+        if first.get("exit_code") is not None else "no exit code")
+    print(f"first failing task: {first.get('task_id', '?')} "
+          f"(attempt {first.get('attempt', 0)}, {sigdesc})")
+    print(f"  reason: {first.get('reason', '')}")
+    if first.get("signature"):
+        print(f"  signature: {first['signature']}")
+        if first.get("hint"):
+            print(f"  hint: {first['hint']}")
+    if first.get("line"):
+        print(f"  matched: {first['line']}")
+    tails = first.get("tail") or {}
+    for stream in ("stderr", "stdout"):
+        lines = tails.get(stream) or []
+        if not lines:
+            continue
+        print(f"--- {stream} (last {len(lines)} lines, redacted) ---")
+        for ln in lines:
+            print(f"  {ln}")
+    others = [r for r in (bundle.get("failures") or [])
+              if (r.get("task_id"), r.get("attempt"))
+              != (first.get("task_id"), first.get("attempt"))]
+    if others:
+        print(f"{len(others)} further failure record(s):")
+        for r in others:
+            rsig = r.get("signature") or "no signature"
+            print(f"  {r.get('task_id', '?')} attempt "
+                  f"{r.get('attempt', 0)}: {r.get('reason', '')} ({rsig})")
+    return 0
 
 
 def profile(argv: list[str]) -> int:
@@ -77,6 +299,14 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # `tony logs ... | head` must not traceback when the pager closes
+    # the pipe — restore the default SIGPIPE disposition for this
+    # operator-facing process
+    import signal as _signal
+    try:
+        _signal.signal(_signal.SIGPIPE, _signal.SIG_DFL)
+    except (AttributeError, ValueError):
+        pass    # non-POSIX, or not the main thread
     if not argv:
         print(USAGE, file=sys.stderr)
         return 2
@@ -89,6 +319,10 @@ def main(argv: list[str] | None = None) -> int:
         return notebook_submit(rest)
     if cmd == "profile":
         return profile(rest)
+    if cmd == "logs":
+        return logs(rest)
+    if cmd == "diagnose":
+        return diagnose(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

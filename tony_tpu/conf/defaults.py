@@ -109,6 +109,11 @@ DEFAULTS = {
     K.PROFILING_DEFAULT_STEPS: 5,
     K.SLO_STEP_TIME_REGRESSION_PCT: 0,   # 0 = step-time check disabled
     K.SLO_GOODPUT_FLOOR_PCT: 0,          # 0 = goodput-floor check disabled
+    # live log streaming / diagnostics (observability/logs.py)
+    K.LOGS_TAIL_BYTES: 65536,
+    K.LOGS_CHUNK_BYTES: 32768,
+    K.LOGS_FOLLOW_POLL_MS: 500,
+    K.LOGS_DIAGNOSTICS_LINES: 200,
 
     # portal
     K.PORTAL_PORT: 19886,

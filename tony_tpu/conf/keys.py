@@ -148,6 +148,18 @@ PROFILING_DEFAULT_STEPS = "tony.profiling.default-steps"
 # baseline, or job goodput falls below this floor; 0 disables either check
 SLO_STEP_TIME_REGRESSION_PCT = "tony.slo.step-time-regression-pct"
 SLO_GOODPUT_FLOOR_PCT = "tony.slo.goodput-floor-pct"
+# live log streaming + failure diagnostics (observability/logs.py):
+# how far back a fresh tail cursor starts into a stream file (bytes) —
+# the "ring buffer" bound on what a live tail can ever replay
+LOGS_TAIL_BYTES = "tony.logs.tail-bytes"
+# hard per-chunk cap on read_task_logs / read_log responses (bytes);
+# clients may ask for less, never get more
+LOGS_CHUNK_BYTES = "tony.logs.chunk-bytes"
+# CLI/portal --follow polling cadence between chunk reads
+LOGS_FOLLOW_POLL_MS = "tony.logs.follow-poll-ms"
+# redacted last-lines budget per failing task in failure reports and the
+# job's diagnostics.json bundle
+LOGS_DIAGNOSTICS_LINES = "tony.logs.diagnostics-lines"
 
 # --- proxy ---------------------------------------------------------------
 # externally reachable base URL of an authenticated tony_tpu.proxy fronting
@@ -207,7 +219,7 @@ RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
-    "profiling", "slo",
+    "profiling", "slo", "logs",
 })
 
 

@@ -109,6 +109,10 @@ HISTORY_LOGS_DIR_NAME = "logs"       # aggregated container logs in history
 SPANS_FILE = "spans.json"            # lifecycle spans flushed next to events
 METRICS_FILE = "metrics.json"        # per-gauge timeseries flushed at finish
 GOODPUT_FILE = "goodput.json"        # per-task + job time accounting (perf.py)
+DIAGNOSTICS_FILE = "diagnostics.json"  # root-cause bundle on job failure:
+                                     # first-failing task, exit signal,
+                                     # matched signature, redacted tails
+                                     # (observability/logs.py)
 TRACE_SEED_FILE = "trace.json"       # client-written {trace_id, submit_ms}
 AM_METRICS_PORT_FILE = "am-metrics-port"  # bound /metrics scrape port
 AM_INFO_FILE = "am.json"             # {host, rpc_port} in the history dir, so

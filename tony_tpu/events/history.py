@@ -100,6 +100,19 @@ def write_goodput_file(history_dir: str, goodput: dict) -> None:
     _write_json_atomic(os.path.join(history_dir, C.GOODPUT_FILE), goodput)
 
 
+def write_diagnostics_file(history_dir: str, diagnostics: dict) -> None:
+    """diagnostics: the AM's root-cause bundle — {app_id, status,
+    first_failure, failures[], ...} with redacted tail excerpts (see
+    ApplicationMaster._assemble_diagnostics)."""
+    _write_json_atomic(os.path.join(history_dir, C.DIAGNOSTICS_FILE),
+                       diagnostics)
+
+
+def read_diagnostics_file(history_dir: str) -> dict:
+    out = _read_json(os.path.join(history_dir, C.DIAGNOSTICS_FILE), {})
+    return out if isinstance(out, dict) else {}
+
+
 def read_goodput_file(history_dir: str) -> dict:
     out = _read_json(os.path.join(history_dir, C.GOODPUT_FILE), {})
     return out if isinstance(out, dict) else {}

@@ -1,0 +1,102 @@
+"""Human-readable one-liners for every history event type.
+
+The portal's job page and the CLI's diagnose output both need a readable
+"what happened" line per event; raw payload JSON stays available but the
+summary is what an operator scans. The static-coverage test
+(tests/test_logs.py) pins that EVERY EventType in events/schema.py has a
+renderer here — adding an event without a summary is a tier-1 failure,
+so history never grows entries the operator surfaces can't explain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from tony_tpu.events.schema import EventType
+
+
+def _application_inited(p: dict) -> str:
+    return (f"application {p.get('application_id', '?')} started on "
+            f"{p.get('host', '?')} ({p.get('num_tasks', 0)} tasks)")
+
+
+def _application_finished(p: dict) -> str:
+    failed = p.get("num_failed_tasks", 0)
+    tail = f", {failed} failed task(s)" if failed else ""
+    return f"application {p.get('application_id', '?')} " \
+           f"{p.get('status', '?')}{tail}"
+
+
+def _task_started(p: dict) -> str:
+    return (f"task {p.get('task_type', '?')}:{p.get('task_index', '?')} "
+            f"launched on {p.get('host', '?')} "
+            f"({p.get('container_id', '') or 'container ?'})")
+
+
+def _task_finished(p: dict) -> str:
+    return (f"task {p.get('task_type', '?')}:{p.get('task_index', '?')} "
+            f"finished {p.get('status', '?')}")
+
+
+def _task_relaunched(p: dict) -> str:
+    return (f"task {p.get('task_type', '?')}:{p.get('task_index', '?')} "
+            f"relaunched as attempt {p.get('attempt', '?')} at spec "
+            f"generation {p.get('generation', '?')}: "
+            f"{p.get('reason', '') or 'unspecified'}")
+
+
+def _serving_endpoint(p: dict) -> str:
+    return (f"serving endpoint {p.get('task_type', '?')}:"
+            f"{p.get('task_index', '?')} up at {p.get('url', '?')}")
+
+
+def _profile_captured(p: dict) -> str:
+    return (f"profile {p.get('request_id', '?')} captured on "
+            f"{p.get('task_type', '?')}:{p.get('task_index', '?')} "
+            f"({p.get('num_steps', 0)} steps) -> {p.get('path', '?')}")
+
+
+def _slo_violation(p: dict) -> str:
+    task = p.get("task_id") or "job"
+    return f"SLO violation ({p.get('kind', '?')}) on {task}: " \
+           f"{p.get('message', '')}"
+
+
+def _diagnostics_ready(p: dict) -> str:
+    sig = p.get("signature") or "no matched signature"
+    who = p.get("first_failing_task") or "unknown task"
+    sigdesc = p.get("signal_name") or f"exit {p.get('exit_code', '?')}"
+    return (f"root-cause bundle ready: first failure {who} "
+            f"(attempt {p.get('attempt', 0)}, {sigdesc}, {sig}; "
+            f"{p.get('num_failures', 0)} failure record(s)) -> "
+            f"{p.get('path', '?')}")
+
+
+RENDERERS: dict[EventType, Callable[[dict], str]] = {
+    EventType.APPLICATION_INITED: _application_inited,
+    EventType.APPLICATION_FINISHED: _application_finished,
+    EventType.TASK_STARTED: _task_started,
+    EventType.TASK_FINISHED: _task_finished,
+    EventType.TASK_RELAUNCHED: _task_relaunched,
+    EventType.SERVING_ENDPOINT_REGISTERED: _serving_endpoint,
+    EventType.PROFILE_CAPTURED: _profile_captured,
+    EventType.SLO_VIOLATION: _slo_violation,
+    EventType.DIAGNOSTICS_READY: _diagnostics_ready,
+}
+
+
+def render_event(event_type: Any, payload: dict) -> str:
+    """One-line summary for an event dict ({"type", "payload"}); unknown
+    types degrade to the type name instead of raising — the portal must
+    render history written by a newer AM."""
+    try:
+        etype = EventType(event_type)
+    except ValueError:
+        return str(event_type)
+    renderer = RENDERERS.get(etype)
+    if renderer is None:
+        return etype.value
+    try:
+        return renderer(payload or {})
+    except Exception:  # noqa: BLE001 — rendering must never break a page
+        return etype.value

@@ -25,6 +25,7 @@ class EventType(str, enum.Enum):
     SERVING_ENDPOINT_REGISTERED = "SERVING_ENDPOINT_REGISTERED"
     PROFILE_CAPTURED = "PROFILE_CAPTURED"
     SLO_VIOLATION = "SLO_VIOLATION"
+    DIAGNOSTICS_READY = "DIAGNOSTICS_READY"
 
 
 @dataclass
@@ -108,6 +109,24 @@ class SloViolation:
 
 
 @dataclass
+class DiagnosticsReady:
+    """No reference equivalent (the reference surfaced a one-line AM
+    diagnostics string through YARN): the AM assembled the job's
+    root-cause bundle — first-failing task across attempts, exit
+    code/signal, matched error signature, redacted tail excerpts — into
+    `diagnostics.json` next to the event log. The portal renders it as
+    the failure panel; `python -m tony_tpu.cli diagnose` prints it."""
+    application_id: str
+    first_failing_task: str = ""    # "worker:1"
+    attempt: int = 0                # the failing attempt number
+    signature: str = ""             # matched error signature ("" = none)
+    exit_code: int = 0
+    signal_name: str = ""
+    num_failures: int = 0           # failure records in the bundle
+    path: str = ""                  # history-dir-relative bundle file
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -125,11 +144,12 @@ _PAYLOADS = {
     EventType.SERVING_ENDPOINT_REGISTERED: ServingEndpointRegistered,
     EventType.PROFILE_CAPTURED: ProfileCaptured,
     EventType.SLO_VIOLATION: SloViolation,
+    EventType.DIAGNOSTICS_READY: DiagnosticsReady,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 TaskFinished, TaskRelaunched, ServingEndpointRegistered,
-                ProfileCaptured, SloViolation]
+                ProfileCaptured, SloViolation, DiagnosticsReady]
 
 
 @dataclass

@@ -17,9 +17,11 @@ from tony_tpu.executor.task_executor import TaskExecutor
 
 
 def main() -> int:
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # structured JSON-lines logging: every record stamped with
+    # {app_id, task_type, index, attempt, trace_id} so executor log lines
+    # correlate with the span waterfall (TONY_LOG_PLAIN=1 opts out)
+    from tony_tpu.observability.logs import configure_structured_logging
+    configure_structured_logging()
     executor = TaskExecutor()
 
     # Graceful container stop: the backend sends SIGTERM (escalating to

@@ -60,11 +60,14 @@ class Heartbeater(threading.Thread):
     def __init__(self, client: ClusterServiceClient, task_id: str,
                  interval_sec: float, on_fatal=None, task_attempt: int = -1,
                  on_generation=None, silent: bool = False,
-                 on_profile=None):
+                 on_profile=None, log_addr: str = ""):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
         self._task_attempt = task_attempt
+        # this executor's TaskLogService host:port, gossiped to the AM on
+        # every heartbeat (the live-tail read surface; observability/logs)
+        self._log_addr = log_addr
         self._interval = interval_sec
         self._on_fatal = on_fatal  # kill the user process before we die
         self._on_generation = on_generation
@@ -95,7 +98,8 @@ class Heartbeater(threading.Thread):
                 continue
             try:
                 resp = self._client.task_executor_heartbeat(
-                    self._task_id, self._task_attempt)
+                    self._task_id, self._task_attempt,
+                    log_addr=self._log_addr)
                 self._consecutive_failures = 0
                 generation = (resp or {}).get("spec_generation")
                 if generation and self._on_generation is not None:
@@ -169,6 +173,7 @@ class TaskExecutor:
         from tony_tpu.security.tokens import TOKEN_ENV
         token = e.get(TOKEN_ENV) or None
         task_auth = self.task_id if token else None
+        self._task_token = token
         self.client = ClusterServiceClient(self.am_host, self.am_port,
                                            auth_token=token,
                                            task_auth_id=task_auth)
@@ -194,10 +199,83 @@ class TaskExecutor:
         self._respec_pending = False
         self._respec_lock = threading.Lock()
         self._test_kill_scheduled = False
+        # live-log service (observability/logs.py): this executor serves
+        # bounded offset-cursor reads over its own container stdout/stderr
+        # files (the backend redirects both into the cwd); the AM proxies
+        # operator tails to it. Bounds come from the frozen conf.
+        self._log_tail_bytes = self.conf.get_int(K.LOGS_TAIL_BYTES, 65536)
+        self._log_chunk_bytes = self.conf.get_int(K.LOGS_CHUNK_BYTES, 32768)
+        self._diag_lines = self.conf.get_int(K.LOGS_DIAGNOSTICS_LINES, 200)
+        self._log_server = None
+        self._log_port = 0
 
     @property
     def task_id(self) -> str:
         return f"{self.job_name}:{self.task_index}"
+
+    # ------------------------------------------------------------------
+    # live-log service (observability/logs.py)
+    # ------------------------------------------------------------------
+    def _start_log_service(self) -> None:
+        """Serve bounded log-chunk reads over this container's own
+        stdout/stderr. With security on, the service requires this task's
+        derived token — exactly the credential the AM can re-derive to
+        authenticate its proxy reads; nothing new ships in the env."""
+        try:
+            from tony_tpu.rpc.service import serve
+            self._log_server, self._log_port = serve(
+                log_handler=self, auth_token=self._task_token)
+            LOG.info("task log service on port %d", self._log_port)
+        except Exception:  # noqa: BLE001 — observability must not kill the task
+            LOG.exception("could not start the task log service")
+            self._log_server, self._log_port = None, 0
+
+    def _stop_log_service(self) -> None:
+        if self._log_server is not None:
+            self._log_server.stop(grace=0.2)
+            self._log_server = None
+
+    @property
+    def log_addr(self) -> str:
+        return f"{self.host}:{self._log_port}" if self._log_port else ""
+
+    def read_log(self, req: dict) -> dict:
+        """TaskLogServiceHandler: one redacted chunk of stdout/stderr.
+        Chunk size is capped at tony.logs.chunk-bytes no matter what the
+        caller asks; a fresh cursor never reaches further back than
+        tony.logs.tail-bytes — bounded memory on both ends."""
+        from tony_tpu.observability.logs import STREAMS, LogTail
+        stream = str(req.get("stream", "stderr") or "stderr")
+        if stream not in STREAMS:
+            return {"error": f"unknown stream {stream!r}"}
+        proc = self._user_proc
+        final = proc is not None and proc.poll() is not None
+        tail = LogTail(os.path.join(os.getcwd(), stream),
+                       tail_bytes=self._log_tail_bytes,
+                       chunk_bytes=self._log_chunk_bytes)
+        chunk = tail.read_chunk(offset=int(req.get("offset", -1)),
+                                max_bytes=int(req.get("max_bytes", 0) or 0),
+                                final=final)
+        chunk["stream"] = stream
+        chunk["task_id"] = self.task_id
+        return chunk
+
+    def _failure_diagnostics(self, exit_code: int) -> dict:
+        """Classified + redacted failure summary shipped with the
+        execution result: exit/signal decoding, matched error signature,
+        last tony.logs.diagnostics-lines lines per stream."""
+        from tony_tpu.observability.logs import classify_container_failure
+        try:
+            diag = classify_container_failure(
+                os.getcwd(), exit_code, self._diag_lines,
+                tail_bytes=self._log_tail_bytes)
+            diag["task_id"] = self.task_id
+            diag["attempt"] = self.task_attempt
+            return diag
+        except Exception:  # noqa: BLE001 — diagnostics must not mask the exit
+            LOG.exception("failed to build failure diagnostics")
+            return {"exit_code": exit_code, "task_id": self.task_id,
+                    "attempt": self.task_attempt}
 
     # ------------------------------------------------------------------
     def setup_ports(self) -> None:
@@ -227,7 +305,8 @@ class TaskExecutor:
                 task_attempt=self.task_attempt,
                 on_generation=self._on_generation,
                 silent=self._hb_silent_for_testing(),
-                on_profile=self._on_profile_request)
+                on_profile=self._on_profile_request,
+                log_addr=self.log_addr)
             self.heartbeater.start()
         host_port = f"{self.host}:{self.port}"
         LOG.info("registering %s at %s (attempt %d)", self.task_id,
@@ -427,6 +506,10 @@ class TaskExecutor:
         # barrier wait) are handed to the user process so the trainer's
         # single per-task ledger covers them (observability/perf.py)
         self._goodput_seed = {"localization": 0.0, "rendezvous_wait": 0.0}
+        # the live-tail surface comes up FIRST: a task stuck in
+        # localization or at the barrier is exactly the one an operator
+        # needs to tail
+        self._start_log_service()
         loc_t0 = time.monotonic()
         with self.tracer.span("executor_localization"):
             self.localize_resources()
@@ -552,6 +635,7 @@ class TaskExecutor:
             # or the SO_REUSEPORT socket stays held for the executor's
             # remaining lifetime
             self._release_port_reservation()
+            self._stop_log_service()
 
     def _release_port_reservation(self) -> None:
         if self._port_reservation is not None:
@@ -630,10 +714,18 @@ class TaskExecutor:
         if self.heartbeater is not None:
             self.heartbeater.stop()
         self._push_spans()
+        # a failing exit ships its own post-mortem: classified signature +
+        # redacted tail ride the result RPC, so the AM's diagnostics
+        # bundle works even when it can't reach this container's files
+        # (off-host backends)
+        diagnostics = None
+        if exit_code not in (C.EXIT_SUCCESS, C.EXIT_KILLED_BY_AM):
+            diagnostics = self._failure_diagnostics(exit_code)
         try:
             self.client.register_execution_result(
                 exit_code, self.job_name, self.task_index, self.session_id,
                 task_attempt=self.task_attempt,
-                barrier_timeout=barrier_timeout)
+                barrier_timeout=barrier_timeout,
+                diagnostics=diagnostics)
         except Exception:  # noqa: BLE001
             LOG.exception("failed to register execution result")

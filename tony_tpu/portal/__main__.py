@@ -8,7 +8,6 @@ server, then blocks until interrupted.
 from __future__ import annotations
 
 import argparse
-import logging
 import os
 import time
 
@@ -40,7 +39,10 @@ def main(argv=None) -> int:
                              "(overrides tony.history.store-location)")
     args = parser.parse_args(argv)
 
-    logging.basicConfig(level=logging.INFO)
+    # structured JSON-lines logging like the rest of the control plane
+    # (TONY_LOG_PLAIN=1 opts out)
+    from tony_tpu.observability.logs import configure_structured_logging
+    configure_structured_logging()
     conf = TonyConfiguration.read(args.conf) if args.conf \
         else TonyConfiguration()
     location = (args.history_location or conf.get_str(K.HISTORY_LOCATION)
@@ -91,6 +93,7 @@ def main(argv=None) -> int:
     mover.start()
     purger.start()
     server.start()
+    # log-ok: interactive bootstrap banner for the operator's terminal
     print(f"tony-tpu portal: http://localhost:{server.port}/")
     try:
         while True:

@@ -13,6 +13,7 @@ import collections
 import json
 import logging
 import os
+import re
 import threading
 from dataclasses import asdict
 from typing import Any, Optional
@@ -222,6 +223,12 @@ class PortalCache:
         observability/perf.aggregate_goodput); goodput.json sidecar."""
         return self._get_sidecar(job_id, C.GOODPUT_FILE, {})
 
+    def get_diagnostics(self, job_id: str) -> dict[str, Any]:
+        """Root-cause bundle a failed job's AM flushed
+        (diagnostics.json sidecar): first-failing task, exit signal,
+        matched signature, redacted tails. {} for succeeded/old jobs."""
+        return self._get_sidecar(job_id, C.DIAGNOSTICS_FILE, {})
+
     def get_am_info(self, job_id: str) -> dict[str, Any]:
         """The AM's RPC address ({host, rpc_port}) written into the
         history dir at prepare — how the portal reaches a RUNNING job's
@@ -257,11 +264,12 @@ class PortalCache:
                                os.path.join(logs_root, cdir, s))]
                 if not streams:
                     continue
-                task = self._task_label(cdir)
+                task, attempt = self._task_label(cdir)
                 p = started.get(task, {})
                 seen.add(task)
                 links.append({
                     "task": task,
+                    "attempt": attempt,
                     "host": p.get("host", ""),
                     "container_id": p.get("container_id", ""),
                     "url": f"/logs/{job_id}/{cdir}/stdout",
@@ -272,21 +280,29 @@ class PortalCache:
         for task, p in started.items():
             if task not in seen:       # running / not yet aggregated
                 links.append({
-                    "task": task, "host": p.get("host", ""),
+                    "task": task, "attempt": 0, "host": p.get("host", ""),
                     "container_id": p.get("container_id", ""),
                     "url": "", "streams": {},
                 })
         return links
 
-    @staticmethod
-    def _task_label(container_dir: str) -> str:
-        """`worker_0_s1` -> `worker:0` (the AM's container-dir naming);
-        non-task dirs (`am`) pass through unchanged."""
-        parts = container_dir.rsplit("_", 2)
-        if (len(parts) == 3 and parts[1].isdigit()
-                and parts[2].startswith("s")):
-            return f"{parts[0]}:{parts[1]}"
-        return container_dir
+    # `worker_0_s1` / `worker_0_s1_a2` (relaunched attempts get an
+    # attempt-suffixed dir, application_master._on_container_allocated)
+    _CDIR_RE = re.compile(
+        r"^(?P<job>.+)_(?P<idx>\d+)_s\d+(?:_a(?P<attempt>\d+))?$")
+
+    @classmethod
+    def _task_label(cls, container_dir: str) -> tuple[str, int]:
+        """`worker_0_s1` -> ("worker:0", 0); `worker_0_s1_a2` ->
+        ("worker:0", 2). Non-task dirs (`am`) pass through as
+        (name, 0) — ALL attempts of a slot share one task label, with
+        the attempt number carried separately so callers can pick the
+        newest evidence."""
+        m = cls._CDIR_RE.match(container_dir)
+        if m is None:
+            return container_dir, 0
+        return (f"{m.group('job')}:{m.group('idx')}",
+                int(m.group("attempt") or 0))
 
     def get_log_file(self, job_id: str, container_dir: str,
                      stream: str) -> Optional[str]:

@@ -202,7 +202,82 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(self.cache.get_metrics_timeseries(job_id))
             if what == "goodput":
                 return self._json(self.cache.get_goodput(job_id))
+            if what == "diagnostics":
+                return self._json(self.cache.get_diagnostics(job_id))
+        if len(parts) == 4 and parts[0] == "jobs" and parts[2] == "logs":
+            # /api/jobs/:id/logs/:task[?stream=&offset=&max_bytes=&follow]
+            # — one bounded chunk; followers poll with the returned
+            # next_offset as their cursor
+            job_id, task = parts[1], parts[3]
+            md = self.cache.get_metadata(job_id)
+            if md is None or not self._visible(md.user):
+                return self._json({"error": "not found"}, 404)
+            return self._api_log_chunk(job_id, task,
+                                       md.status == "RUNNING")
         self._json({"error": "not found"}, 404)
+
+    def _api_log_chunk(self, job_id: str, task: str,
+                       running: bool) -> None:
+        """Live-tail proxy: a RUNNING job's chunk is fetched through its
+        AM (read_task_logs, address from am.json — same plumbing as the
+        profile POST); otherwise (or when the AM is unreachable) the
+        chunk comes from the aggregated history logs. Offsets are a
+        shared cursor contract either way, so a follower that starts
+        live degrades to aggregated reads without restarting."""
+        qs = parse_qs(urlparse(self.path).query)
+
+        def _q(name: str, default: int) -> int:
+            try:
+                return int((qs.get(name) or [default])[0])
+            except (TypeError, ValueError):
+                return default
+
+        stream = (qs.get("stream") or ["stderr"])[0]
+        if stream not in ("stdout", "stderr"):
+            return self._json({"error": f"unknown stream {stream!r}"}, 400)
+        offset = _q("offset", -1)
+        max_bytes = _q("max_bytes", 0)
+        am = self.cache.get_am_info(job_id) if running else {}
+        if running and am.get("host") and am.get("rpc_port") \
+                and not am.get("security_enabled"):
+            from tony_tpu.rpc.client import ClusterServiceClient
+            client = ClusterServiceClient(str(am["host"]),
+                                          int(am["rpc_port"]))
+            try:
+                chunk = client.read_task_logs(
+                    task_id=task, stream=stream, offset=offset,
+                    max_bytes=max_bytes)
+                if not (chunk or {}).get("error"):
+                    return self._json(chunk)
+            except Exception:  # noqa: BLE001 — degrade to aggregated logs
+                LOG.debug("live log proxy to the AM failed", exc_info=True)
+            finally:
+                client.close()
+        # aggregated fallback: resolve the task's container dir through
+        # the same links the /logs page renders — NEWEST attempt first
+        # (a relaunched slot has one dir per attempt; the latest holds
+        # the evidence an operator is after)
+        matches = [link for link in self.cache.get_log_links(job_id)
+                   if link.get("task") == task
+                   and (link.get("streams") or {}).get(stream)]
+        matches.sort(key=lambda lk: int(lk.get("attempt", 0)),
+                     reverse=True)
+        for link in matches:
+            url = link["streams"][stream]
+            cdir = url.rsplit("/", 2)[-2]
+            path = self.cache.get_log_file(job_id, cdir, stream)
+            if path is None:
+                continue
+            from tony_tpu.observability.logs import LogTail
+            chunk = LogTail(path).read_chunk(offset=offset,
+                                             max_bytes=max_bytes,
+                                             final=not running)
+            chunk.update({"task_id": task, "stream": stream,
+                          "attempt": int(link.get("attempt", 0)),
+                          "source": "aggregated"})
+            return self._json(chunk)
+        self._json({"error": f"no logs available for {task} ({stream})"},
+                   404)
 
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
         """POST /api/jobs/:id/profile — forward an on-demand profiler
@@ -284,19 +359,72 @@ class _Handler(BaseHTTPRequestHandler):
                            "Status", ""], rows))
 
     def _jobs(self, job_id: str) -> None:
+        from tony_tpu.events.render import render_event
         rows = []
         events = self.cache.get_events(job_id)
         for ev in events:
             rows.append([
                 _fmt_ts(ev["timestamp"]),
                 html.escape(ev["type"]),
+                html.escape(render_event(ev["type"], ev["payload"])),
                 html.escape(json.dumps(ev["payload"])),
             ])
         self._html(f"events — {job_id}",
-                   self._serving_endpoints_html(job_id, events)
+                   self._diagnostics_html(job_id)
+                   + self._serving_endpoints_html(job_id, events)
                    + self._goodput_html(job_id)
                    + self._waterfall_html(job_id)
-                   + _table(["Time", "Event", "Payload"], rows))
+                   + _table(["Time", "Event", "Summary", "Payload"], rows))
+
+    def _diagnostics_html(self, job_id: str) -> str:
+        """Root-cause panel for failed jobs (the diagnostics.json bundle
+        the AM flushed): first-failing task, exit signal, matched error
+        signature + hint, and the redacted tail excerpt — the
+        one-screen answer to 'which of N tasks broke first and why'.
+        Empty string when no bundle exists (succeeded / pre-diagnostics
+        history)."""
+        diag = self.cache.get_diagnostics(job_id)
+        first = diag.get("first_failure") or {}
+        if not diag or not first:
+            return ""
+        sig = first.get("signature", "")
+        sigdesc = first.get("signal_name") \
+            or (f"exit {first.get('exit_code')}"
+                if first.get("exit_code") is not None else "no exit code")
+        out = ['<h3 style="color:#c0392b">Root cause</h3>']
+        out.append(
+            "<p>first failing task <b>"
+            + html.escape(str(first.get("task_id", "?")))
+            + f"</b> (attempt {int(first.get('attempt', 0) or 0)}, "
+            + html.escape(str(sigdesc))
+            + (", signature <b>" + html.escape(sig) + "</b>" if sig else "")
+            + ")</p>")
+        if first.get("hint"):
+            out.append(f"<p><i>{html.escape(str(first['hint']))}</i></p>")
+        if first.get("line"):
+            out.append(f"<p><code>{html.escape(str(first['line']))}</code>"
+                       "</p>")
+        tails = first.get("tail") or {}
+        for stream in ("stderr", "stdout"):
+            lines = tails.get(stream) or []
+            if not lines:
+                continue
+            excerpt = "\n".join(str(ln) for ln in lines[-40:])
+            out.append(
+                f"<p>{html.escape(stream)} (last {len(lines)} lines, "
+                "redacted):</p><pre style=\"background:#f8f8f8;"
+                "border:1px solid #ddd;padding:8px;max-height:320px;"
+                f"overflow:auto\">{html.escape(excerpt)}</pre>")
+        others = [r for r in (diag.get("failures") or [])
+                  if (r.get("task_id"), r.get("attempt"))
+                  != (first.get("task_id"), first.get("attempt"))]
+        if others:
+            out.append(
+                "<p>"
+                + html.escape(f"{len(others)} further failure record(s)")
+                + f' — <a href="/api/jobs/{html.escape(job_id)}'
+                  '/diagnostics">full bundle (JSON)</a></p>')
+        return "".join(out)
 
     # phase palette: productive train time pops green, stalls/downtime
     # warn, infrastructure phases stay muted

@@ -20,7 +20,8 @@ from tony_tpu import constants as C
 from tony_tpu.observability.metrics import REGISTRY
 from tony_tpu.utils.common import equal_jitter_backoff_sec
 from tony_tpu.rpc.service import (
-    CLUSTER_SERVICE, METRICS_SERVICE, CLUSTER_METHODS, METRICS_METHODS,
+    CLUSTER_SERVICE, METRICS_SERVICE, TASK_LOG_SERVICE,
+    CLUSTER_METHODS, METRICS_METHODS, TASK_LOG_METHODS,
     _ser, _deser,
 )
 
@@ -181,30 +182,46 @@ class ClusterServiceClient(_JsonRpcClient):
     def register_execution_result(self, exit_code: int, job_name: str,
                                   job_index: int, session_id: int,
                                   task_attempt: int = -1,
-                                  barrier_timeout: bool = False) -> None:
+                                  barrier_timeout: bool = False,
+                                  diagnostics: Optional[dict] = None
+                                  ) -> None:
         """barrier_timeout marks a gang-rendezvous timeout: an allocation
         problem, not a task fault — the AM must not spend relaunch budget
         on it. An explicit flag because exit codes can't carry it: every
-        0-255 value is reachable by the user process itself."""
-        self.call("register_execution_result", {
+        0-255 value is reachable by the user process itself.
+        `diagnostics` (failures only) carries the executor's classified,
+        REDACTED post-mortem — exit/signal decoding, matched error
+        signature, bounded tail excerpt (observability/logs.py) — so the
+        AM's root-cause bundle never depends on reading this container's
+        filesystem."""
+        req = {
             "exit_code": exit_code, "job_name": job_name,
             "job_index": job_index, "session_id": session_id,
             "task_attempt": task_attempt,
-            "barrier_timeout": barrier_timeout})
+            "barrier_timeout": barrier_timeout}
+        if diagnostics:
+            req["diagnostics"] = diagnostics
+        self.call("register_execution_result", req)
 
     def finish_application(self) -> None:
         self.call("finish_application", {})
 
     def task_executor_heartbeat(self, task_id: str,
-                                task_attempt: int = -1) -> dict:
+                                task_attempt: int = -1,
+                                log_addr: str = "") -> dict:
         # liveness signal: one attempt, short deadline, no wait_for_ready —
         # the Heartbeater counts consecutive failures and kills the executor
         # when the AM is gone (reference: TaskExecutor.java:358-368; with
         # the default retry proxy a dead AM would take ~27 min to detect).
         # The response piggybacks the AM's current spec_generation so
         # running executors learn about relaunches without extra polling.
-        return self.call("task_executor_heartbeat",
-                         {"task_id": task_id, "task_attempt": task_attempt},
+        # log_addr gossips this executor's TaskLogService host:port (the
+        # live-tail read surface) — piggybacked here so gang width adds
+        # zero extra RPCs.
+        req = {"task_id": task_id, "task_attempt": task_attempt}
+        if log_addr:
+            req["log_addr"] = log_addr
+        return self.call("task_executor_heartbeat", req,
                          retries=1, timeout_sec=5.0, wait_for_ready=False)
 
     def request_profile(self, task_id: str = "",
@@ -215,6 +232,34 @@ class ClusterServiceClient(_JsonRpcClient):
         return self.call("request_profile",
                          {"task_id": task_id, "num_steps": num_steps},
                          retries=1, timeout_sec=10.0, wait_for_ready=False)
+
+    def read_task_logs(self, task_id: str = "", stream: str = "stderr",
+                       offset: int = -1, max_bytes: int = 0) -> dict:
+        """One bounded log chunk for a task (live when running, from
+        aggregated history otherwise). Operator plane: CLI `logs
+        [--follow]` and the portal's log proxy poll this with the
+        returned next_offset as their cursor."""
+        return self.call("read_task_logs",
+                         {"task_id": task_id, "stream": stream,
+                          "offset": int(offset),
+                          "max_bytes": int(max_bytes)},
+                         retries=1, timeout_sec=10.0, wait_for_ready=False)
+
+
+class TaskLogServiceClient(_JsonRpcClient):
+    """Client for an EXECUTOR's log service (the AM's proxy side of
+    read_task_logs). Short deadlines, no retries beyond 1: a wedged
+    executor must degrade a tail read, never hold the AM handler."""
+
+    def __init__(self, host: str, port: int, **kw):
+        super().__init__(TASK_LOG_SERVICE, TASK_LOG_METHODS, host, port, **kw)
+
+    def read_log(self, stream: str = "stderr", offset: int = -1,
+                 max_bytes: int = 0) -> dict:
+        return self.call("read_log",
+                         {"stream": stream, "offset": int(offset),
+                          "max_bytes": int(max_bytes)},
+                         retries=1, timeout_sec=5.0, wait_for_ready=False)
 
 
 class MetricsServiceClient(_JsonRpcClient):

@@ -64,6 +64,36 @@ class Metric:
         return cls(name=d["name"], value=float(d["value"]))
 
 
+@dataclass
+class LogChunk:
+    """One bounded slice of a task's stdout/stderr (observability/logs.py
+    LogTail shape, carried by read_task_logs / read_log). `next_offset`
+    is the follow cursor; `source` says whether the bytes came live from
+    the executor or from history-aggregated logs."""
+    task_id: str = ""
+    stream: str = "stderr"
+    data: str = ""
+    offset: int = 0
+    next_offset: int = 0
+    size: int = 0
+    eof: bool = False
+    source: str = "live"          # "live" | "aggregated"
+
+    def to_dict(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "LogChunk":
+        return cls(task_id=str(d.get("task_id", "")),
+                   stream=str(d.get("stream", "stderr")),
+                   data=str(d.get("data", "")),
+                   offset=int(d.get("offset", 0) or 0),
+                   next_offset=int(d.get("next_offset", 0) or 0),
+                   size=int(d.get("size", 0) or 0),
+                   eof=bool(d.get("eof", False)),
+                   source=str(d.get("source", "live")))
+
+
 def parse_task_id(task_id: str) -> tuple[str, int]:
     """'worker:1' -> ('worker', 1)."""
     name, _, idx = task_id.rpartition(":")

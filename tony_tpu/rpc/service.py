@@ -23,6 +23,11 @@ import grpc
 
 CLUSTER_SERVICE = "tony.ClusterService"
 METRICS_SERVICE = "tony.MetricsService"
+# Executor-hosted live-log service (observability/logs.py): the one RPC
+# surface a container SERVES instead of calling. The AM proxies operator
+# reads (CLI `logs --follow`, portal job page) to it; offset-cursor
+# chunk reads keep both sides' memory bounded.
+TASK_LOG_SERVICE = "tony.TaskLogService"
 
 # The 7 methods of the reference's TensorFlowClusterService, same names
 # modulo snake_case (proto/tensorflow_cluster_service_protos.proto:11-20),
@@ -38,8 +43,10 @@ CLUSTER_METHODS = (
     "finish_application",
     "task_executor_heartbeat",
     "request_profile",
+    "read_task_logs",
 )
 METRICS_METHODS = ("update_metrics",)
+TASK_LOG_METHODS = ("read_log",)
 
 
 def _ser(obj: Any) -> bytes:
@@ -101,6 +108,18 @@ class ClusterServiceHandler(abc.ABC):
         pending on-demand profiler request for this task."""
 
     @abc.abstractmethod
+    def read_task_logs(self, req: dict) -> dict:
+        """Operator/client plane: req {task_id?, stream?, offset?,
+        max_bytes?} -> one bounded log chunk {task_id, stream, data,
+        offset, next_offset, eof, source} (or {error}). A RUNNING task's
+        chunk is proxied live from its executor's TaskLogService; a
+        completed task's comes from the logs the AM aggregated into
+        history at task completion. offset < 0 starts a tail cursor
+        (size - tony.logs.tail-bytes); callers pass next_offset back to
+        follow. Chunk size is capped server-side at
+        tony.logs.chunk-bytes regardless of max_bytes."""
+
+    @abc.abstractmethod
     def request_profile(self, req: dict) -> dict:
         """Operator/client plane: req {task_id?, num_steps?} ->
         {request_id, task_id, num_steps} (or {error}). Asks one task's
@@ -114,6 +133,17 @@ class MetricsServiceHandler(abc.ABC):
     @abc.abstractmethod
     def update_metrics(self, req: dict) -> dict:
         """req: {task_type, index, metrics: [Metric dict]} -> {}."""
+
+
+class TaskLogServiceHandler(abc.ABC):
+    """Executor-side live-log read surface (observability/logs.LogTail
+    over the container's own stdout/stderr files)."""
+
+    @abc.abstractmethod
+    def read_log(self, req: dict) -> dict:
+        """req: {stream, offset?, max_bytes?} -> {data, offset,
+        next_offset, size, eof} — one bounded, redacted chunk. offset < 0
+        opens a tail cursor at (size - tail window)."""
 
 
 def _generic_handler(service_name: str, handler: Any, methods: tuple[str, ...]):
@@ -151,7 +181,9 @@ def serve(cluster_handler: Optional[ClusterServiceHandler] = None,
           metrics_handler: Optional[MetricsServiceHandler] = None,
           host: str = "0.0.0.0", port: int = 0,
           max_workers: int = 16,
-          auth_token: Optional[str] = None) -> tuple[grpc.Server, int]:
+          auth_token: Optional[str] = None,
+          log_handler: Optional[TaskLogServiceHandler] = None
+          ) -> tuple[grpc.Server, int]:
     """Start a gRPC server hosting either or both services on `port`
     (0 = ephemeral, the reference's random-port behavior,
     ApplicationRpcServer.java:118-127). With `auth_token`, every call must
@@ -173,6 +205,13 @@ def serve(cluster_handler: Optional[ClusterServiceHandler] = None,
     if metrics_handler is not None:
         server.add_generic_rpc_handlers(
             (_generic_handler(METRICS_SERVICE, metrics_handler, METRICS_METHODS),))
+    if log_handler is not None:
+        # executor-hosted: with security on, `auth_token` is THIS task's
+        # derived token (the only credential the container holds); the AM
+        # re-derives it per task to authenticate its proxy reads
+        server.add_generic_rpc_handlers(
+            (_generic_handler(TASK_LOG_SERVICE, log_handler,
+                              TASK_LOG_METHODS),))
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise RuntimeError(f"failed to bind RPC server on {host}:{port}")

@@ -121,9 +121,10 @@ def _register_endpoint(url: str, env) -> None:
 
 
 def main(argv=None) -> int:
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    # structured JSON-lines logging (stamped with the serving task's
+    # identity from the container env; TONY_LOG_PLAIN=1 opts out)
+    from tony_tpu.observability.logs import configure_structured_logging
+    configure_structured_logging()
     args = build_arg_parser().parse_args(argv)
     env = os.environ
 
@@ -195,7 +196,8 @@ def main(argv=None) -> int:
 
     from tony_tpu.utils.common import current_host
     url = f"http://{current_host()}:{frontend.port}"
-    # greppable bring-up marker (e2e tests + operators tailing logs)
+    # log-ok: greppable bring-up marker on RAW stdout (e2e tests + bench
+    # drivers grep for it; it must not be wrapped in a JSON log line)
     print(f"SERVING_UP {url}", flush=True)
     _register_endpoint(url, env)
 
