@@ -287,6 +287,9 @@ def child_main(backend: str) -> None:
     def headline(stats):
         return {
             "metric": METRIC,
+            # self-description (the r04-r05 blind-trajectory fix): every
+            # result line says which backend actually measured it
+            "backend": "tpu" if on_tpu else "cpu",
             "value": stats["value"],
             "unit": "%MFU",
             "vs_baseline": round(stats["value"] / 40.0, 3),
@@ -442,7 +445,7 @@ def startup_main() -> None:
             to_done.append(r["total_s"])
             if "all_running_s" in r:
                 to_running.append(r["all_running_s"])
-    result = {"runs": len(to_done)}
+    result = {"runs": len(to_done), "backend": "cpu"}
     if len(to_done) < runs:
         result["failed_runs"] = runs - len(to_done)
         result["error"] = (f"{runs - len(to_done)}/{runs} gang runs did "
@@ -560,13 +563,19 @@ def _control_plane_width(width: int, history_points: int = 64,
     heartbeat round-trip at width, AM-process RSS, and SpanStore/
     MetricsStore sizes; then drives 3x history_points metric samples per
     task through MetricsStore.update_metrics and asserts the PR-4
-    stride-doubling decimation actually bounds memory at this width."""
+    stride-doubling decimation actually bounds memory at this width.
+    The same drive feeds the cross-task skew path (observability/
+    skew.py) through the store's skew_sink, then rolls + analyzes 3
+    windows with one injected 3x straggler — asserting the sketch state
+    is O(buckets) (identical at every width) and reporting the
+    analyzer's per-pass latency."""
     import statistics
     import threading as th
 
     from tony_tpu.am.application_master import MetricsStore
     from tony_tpu.conf import keys as K
     from tony_tpu.conf.configuration import TonyConfiguration
+    from tony_tpu.observability.skew import SkewTracker, StragglerAnalyzer
     from tony_tpu.observability.trace import SpanStore
     from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
     from tony_tpu.rpc.service import ClusterServiceHandler, serve
@@ -579,6 +588,15 @@ def _control_plane_width(width: int, history_points: int = 64,
     store = MetricsStore(history_points=history_points)
     spans = SpanStore(max_spans)
     store.span_sink = spans.add
+    # cross-task skew path (observability/skew.py), wired exactly like
+    # the AM wires it: every numeric gauge the decimation drive below
+    # pushes through update_metrics also folds into the tracker's
+    # windowed sketches — so the skew bench measures the REAL ingest path
+    skew_buckets = 96
+    tracker = SkewTracker(buckets=skew_buckets, heatmap_windows=8)
+    analyzer = StragglerAnalyzer(threshold_pct=50, windows=2,
+                                 min_tasks=3)
+    store.skew_sink = tracker.observe_metric
 
     class _Handler(ClusterServiceHandler):
         def get_task_infos(self, req):
@@ -612,6 +630,9 @@ def _control_plane_width(width: int, history_points: int = 64,
             return {"error": "control-plane harness"}
 
         def read_task_logs(self, req):
+            return {"error": "control-plane harness"}
+
+        def get_skew(self, req):
             return {"error": "control-plane harness"}
 
     server, port = serve(cluster_handler=_Handler(), metrics_handler=store,
@@ -696,8 +717,42 @@ def _control_plane_width(width: int, history_points: int = 64,
                       for pts in per.values()), default=0)
     total_points = sum(len(pts) for per in series.values()
                        for pts in per.values())
+
+    # skew-analyzer drive: the decimation loop above already folded
+    # 3 x history_points step-time samples per task into the tracker's
+    # open window; roll + analyze across 3 windows (feeding one fresh
+    # sample per task per window, with the last task injected 3x slower
+    # so the analyzer has something to latch) and time the pass. The
+    # assertions are ROADMAP item 3's: sketch state is O(buckets) —
+    # identical at width 48 and 1024 — and per-task retained state is a
+    # few scalars per window, never a sample list.
+    pass_ms: list[float] = []
+    detected = 0
+    sketch_cells = 0
+    for _ in range(3):
+        for i in range(width):
+            value = 300.0 if i == width - 1 else 100.0
+            tracker.observe(f"worker:{i}", "step_time_ms", value)
+        # MEASURED open-window sketch footprint, sampled while the
+        # window is populated (a roll clears it) — this is the number
+        # that must stay identical across widths
+        sketch_cells = max(sketch_cells, tracker.sketch_cells())
+        t0 = time.monotonic()
+        closed = tracker.maybe_roll(window_ms=0.0, force=True)
+        actions, _rem = analyzer.analyze(closed or {},
+                                         tracker.startup_values())
+        pass_ms.append(1000.0 * (time.monotonic() - t0))
+        detected += sum(1 for a in actions if a["action"] == "detected")
+    per_task_cells = tracker.per_task_cells()
+    # per task: <= 1 heatmap mean per retained window per signal, plus
+    # O(1) open-window scalars — 64 cells/task is a generous ceiling
+    skew_bounded = (0 < sketch_cells <= tracker.max_sketch_cells()
+                    and per_task_cells <= 64 * width
+                    and detected >= 1)
+
     bounded = (max_points <= history_points
-               and len(spans) <= max_spans)
+               and len(spans) <= max_spans
+               and skew_bounded)
     out = {
         "width": width,
         "registered": registered,
@@ -710,6 +765,14 @@ def _control_plane_width(width: int, history_points: int = 64,
         "metrics_store": {"series_points_total": total_points,
                           "series_points_max": max_points,
                           "history_points_cap": history_points},
+        "skew": {"analyzer_pass_ms": round(max(pass_ms), 3),
+                 "analyzer_pass_ms_p50": round(
+                     statistics.median(pass_ms), 3),
+                 "sketch_cells": sketch_cells,
+                 "sketch_cells_cap": tracker.max_sketch_cells(),
+                 "per_task_cells": per_task_cells,
+                 "stragglers_detected": detected,
+                 "bounded": skew_bounded},
         "bounded": bounded,
         "errors": len(errors),
     }
@@ -736,11 +799,13 @@ def control_plane_main() -> None:
         _mark(f"width {width}: all-registered "
               f"{rows[-1]['submit_to_all_registered_s']}s rss "
               f"{rows[-1]['rss_mb']}MB bounded={rows[-1]['bounded']}")
-    result = {"metric": "control_plane", "control_plane": {"widths": rows}}
+    result = {"metric": "control_plane", "backend": "cpu",
+              "control_plane": {"widths": rows}}
     unbounded = [r["width"] for r in rows if not r["bounded"]]
     if unbounded:
-        result["error"] = (f"span/metrics stores unbounded at width(s) "
-                           f"{unbounded} — decimation regressed")
+        result["error"] = (f"span/metrics/skew state unbounded at "
+                           f"width(s) {unbounded} — decimation or the "
+                           f"skew sketches regressed")
     print(json.dumps(result), flush=True)
     if unbounded:
         sys.exit(1)
@@ -993,6 +1058,12 @@ def _emit(result: dict) -> None:
     drop_order = ("tpu_error", "cpu_error", "alt_config",
                   "head_partial_tpu_measurement",
                   "last_good_tpu_measurement", "am_startup_latency", "error")
+    # self-description floor: even a line assembled by an older path
+    # says which backend measured it (device "cpu"/"" => cpu)
+    result.setdefault(
+        "backend",
+        "cpu" if str(result.get("device", "")).lower() in ("cpu", "")
+        else "tpu")
     line = json.dumps(result, separators=(",", ":"))
     for key in drop_order:
         if len(line) <= 1400:
@@ -1148,6 +1219,13 @@ def _to_cpu_fallback(result: dict, tpu_error: str) -> None:
     tpu-child-landed-on-cpu path, so the two records can't diverge."""
     result.update({
         "value": 0.0, "vs_baseline": 0.0,
+        # explicit self-description: the r04-r05 failure mode was a CPU
+        # number riding an unlabeled line — the driver charted a blind
+        # trajectory. backend + tpu_unavailable_reason make the fallback
+        # state machine-readable even if the long tpu_error is truncated
+        # away by _emit's drop order.
+        "backend": "cpu",
+        "tpu_unavailable_reason": _compact(tpu_error, 160),
         "error": "tpu backend init/compile wedged; cpu-backend "
                  "fallback measurement in cpu_* fields",
         "tpu_error": tpu_error,
@@ -1279,6 +1357,8 @@ def main() -> None:
     final = {
         "metric": METRIC, "value": 0.0, "unit": "%MFU",
         "vs_baseline": 0.0,
+        "backend": "none",
+        "tpu_unavailable_reason": _compact(tpu_error, 160),
         "error": "tpu wedged AND cpu fallback failed",
         "tpu_error": tpu_error, "cpu_error": _compact(diag, 200),
     }

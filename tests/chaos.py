@@ -123,6 +123,23 @@ class Skew(Injection):
         return {C.TEST_TASK_EXECUTOR_SKEW: f"{self.job}#{self.index}#{self.ms}"}
 
 
+class StepDelay(Injection):
+    """Slow EVERY train step of one task attempt by `ms` — the
+    steady-state straggler (executor hook TEST_TRAINER_STEP_DELAY,
+    rendered into the matching task's user-process env as
+    TONY_TRAINER_STEP_DELAY_MS). attempt='*' slows every attempt;
+    attempt=0 lets a relaunched replacement run healthy, which is what
+    the relaunch-then-clear remediation case needs."""
+
+    def __init__(self, job: str, index: int, ms: int,
+                 attempt: "int | str" = "*"):
+        self.job, self.index, self.ms, self.attempt = job, index, ms, attempt
+
+    def env(self) -> dict:
+        return {C.TEST_TRAINER_STEP_DELAY:
+                f"{self.job}#{self.index}#{self.ms}#{self.attempt}"}
+
+
 # ---------------------------------------------------------------------------
 # the harness
 # ---------------------------------------------------------------------------

@@ -1,0 +1,58 @@
+"""Chaos-harness gang member for the straggler suite (tests/test_skew.py).
+
+Simulates a synchronous training loop without jax: every "step" sleeps
+SKEW_STEP_MS (plus the TONY_TRAINER_STEP_DELAY_MS the executor renders
+for a TEST_TRAINER_STEP_DELAY-matched task — the same seam the real
+Trainer honors), and on a ~SKEW_PUSH_MS cadence pushes the measured
+TRAIN_STEP_TIME_MS plus the goodput ledger's phase gauges over the
+public metrics RPC — exactly the signals the AM's skew tracker folds
+into its windowed sketches.
+
+All tasks run until the shared wall deadline (SKEW_RUN_SECONDS from
+launch) so a slowed task does fewer, slower steps instead of running
+longer than its peers; a post-relaunch generation (> 1) runs a short
+healthy tail so the remediation case converges to SUCCEEDED.
+"""
+
+import os
+import time
+
+from tony_tpu import constants as C
+from tony_tpu.observability.perf import GoodputLedger
+from tony_tpu.train.metrics import TpuMetricsReporter
+
+step_s = int(os.environ.get("SKEW_STEP_MS", "30")) / 1000.0
+push_s = int(os.environ.get("SKEW_PUSH_MS", "150")) / 1000.0
+run_s = float(os.environ.get("SKEW_RUN_SECONDS", "4"))
+generation = int(os.environ.get("SPEC_GENERATION", "0"))
+delay_s = float(os.environ.get(C.TRAINER_STEP_DELAY_MS, "0") or 0) / 1000.0
+
+if generation > 1:
+    # a relaunch already happened; the re-rendezvoused gang just needs a
+    # short healthy epoch so the application converges
+    run_s = min(run_s, 1.5)
+
+ledger = GoodputLedger.from_env(os.environ)
+reporter = TpuMetricsReporter()
+ledger.transition("compile")
+time.sleep(0.02)
+ledger.transition("train_step")
+
+deadline = time.monotonic() + run_s
+last_push = time.monotonic()
+steps_since_push = 0
+while time.monotonic() < deadline:
+    time.sleep(step_s + delay_s)
+    steps_since_push += 1
+    now = time.monotonic()
+    if now - last_push >= push_s and steps_since_push:
+        step_ms = 1000.0 * (now - last_push) / steps_since_push
+        reporter.report(extra=ledger.metrics()
+                        + [{"name": "TRAIN_STEP_TIME_MS",
+                            "value": round(step_ms, 3)}])
+        last_push, steps_since_push = now, 0
+
+ledger.transition("idle")
+reporter.report(extra=ledger.metrics())
+reporter.close(timeout=5)
+raise SystemExit(0)

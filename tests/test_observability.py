@@ -125,6 +125,46 @@ def test_timeseries_ignores_non_finite():
     assert ts.to_list() == []
 
 
+def test_timeseries_decimation_under_width_1k_load():
+    """ROADMAP item 3's 'verify it under load': a width-1024 gang's worth
+    of MetricsStore series, each appended 8x past its cap, stays pinned
+    at <= max_points per series (+ the live tail) with the run's start
+    and newest sample both retained."""
+    from tony_tpu.am.application_master import MetricsStore
+    width, cap = 1024, 64
+    store = MetricsStore(history_points=cap)
+    batch = 16
+    for i in range(width):
+        for k in range(8 * cap // batch):
+            store.update_metrics(
+                {"task_type": "worker", "index": i,
+                 "metrics": [{"name": "TRAIN_STEP_TIME_MS",
+                              "value": float(k * batch + j)}
+                             for j in range(batch)]})
+    series = store.timeseries_dict()
+    assert len(series) == width
+    max_pts = max(len(per["TRAIN_STEP_TIME_MS"]) for per in series.values())
+    assert max_pts <= cap + 1, max_pts
+    # the series still covers the whole run, not just the last N minutes
+    sample = series["worker:0"]["TRAIN_STEP_TIME_MS"]
+    assert sample[0][1] == 0.0
+    assert sample[-1][1] == float(8 * cap - 1)
+
+
+def test_span_store_bounded_under_width_1k_load():
+    """SpanStore at width-1k: 1024 tasks x 16 spans against a 512 cap —
+    held count pinned at the cap, every overflow counted, never grown."""
+    cap = 512
+    store = SpanStore(max_spans=cap)
+    for i in range(1024):
+        store.add([{"name": "user_process", "span_id": f"s{i}-{j}",
+                    "trace_id": "t", "task_id": f"worker:{i}",
+                    "start_ms": j, "end_ms": j + 1, "status": "OK"}
+                   for j in range(16)])
+    assert len(store) == cap
+    assert store.dropped == 1024 * 16 - cap
+
+
 def test_registry_families_and_snapshot():
     reg = MetricsRegistry()
     reg.counter("tony_x_total", status="ok").inc()
@@ -141,6 +181,29 @@ def test_registry_families_and_snapshot():
                            method="m") == pytest.approx(0.6)
     assert prom.get_sample(parsed, "tony_lat_seconds_max",
                            method="m") == pytest.approx(0.4)
+
+
+def test_summary_quantiles_bounded_and_exposed():
+    """ISSUE 7 satellite: Summary tracks p50/p95/p99 through the
+    fixed-width sketch (never a sample list) and exposes them as
+    quantile-labeled samples that round-trip the exposition."""
+    reg = MetricsRegistry()
+    s = reg.summary("tony_rt_seconds", method="m")
+    for i in range(1, 1001):
+        s.observe(i / 1000.0)           # 1ms .. 1s, uniform
+    assert s.sketch.cells() == s.SKETCH_BUCKETS + 2   # memory is fixed
+    assert s.quantile(0.5) == pytest.approx(0.5, rel=0.35)
+    assert s.quantile(0.99) == pytest.approx(0.99, rel=0.35)
+    parsed = prom.parse(prom.render(reg.families()))
+    p50 = prom.get_sample(parsed, "tony_rt_seconds",
+                          method="m", quantile="0.5")
+    p99 = prom.get_sample(parsed, "tony_rt_seconds",
+                          method="m", quantile="0.99")
+    assert p50 == pytest.approx(s.quantile(0.5))
+    assert p99 == pytest.approx(s.quantile(0.99))
+    assert p50 < p99
+    # quantiles sit inside the observed range
+    assert 0.001 <= p50 <= 1.0 and 0.001 <= p99 <= 1.0
 
 
 # ---------------------------------------------------------------------------

@@ -81,6 +81,10 @@ class FakeClusterHandler(ClusterServiceHandler):
                 "offset": 0, "next_offset": 0, "eof": False,
                 "source": "live"}
 
+    def get_skew(self, req):
+        return {"signals": {}, "heatmap": {"tasks": {}},
+                "stragglers": [], "detections": []}
+
 
 class FakeMetricsHandler(MetricsServiceHandler):
     def __init__(self):

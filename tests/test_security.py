@@ -46,6 +46,9 @@ class FakeHandler:
     def request_profile(self, req):
         return {"request_id": "fake"}
 
+    def get_skew(self, req):
+        return {"stragglers": []}
+
     def read_task_logs(self, req):
         return {"data": "", "next_offset": 0, "eof": False}
 

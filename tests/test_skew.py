@@ -1,0 +1,580 @@
+"""Cross-task skew analytics + straggler detection (ISSUE 7).
+
+Unit layer: the QuantileSketch's accuracy/memory contract, the
+SkewTracker's windowing (cumulative deltas, heatmap, O(buckets)
+accounting), the StragglerAnalyzer's latch/clear/remediation state
+machine, event schema + renderers, the MetricsStore skew sink, the
+portal's /api/jobs/:id/skew, and the CLI `stragglers` offline renderer.
+
+E2E layer (chaos): a TEST_TRAINER_STEP_DELAY-injected straggler in an
+8-task gang on the genuine client → AM → executor → user-python chain —
+detected with the right task id and steady-state phase attribution,
+rendered by portal + CLI from history; a healthy gang of the same width
+stays silent; and with the remediation knob set, the straggler is
+relaunched through the task-attempt machinery and the latch clears.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import urllib.request
+
+import pytest
+
+from tony_tpu.events.schema import EventType
+from tony_tpu.observability.skew import (
+    QuantileSketch, SkewTracker, StragglerAnalyzer,
+)
+
+pytestmark = pytest.mark.stragglers
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+def script(name: str) -> str:
+    return os.path.join(SCRIPTS, name)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, s: float) -> None:
+        self.t += s
+
+
+# ---------------------------------------------------------------------------
+# quantile sketch
+# ---------------------------------------------------------------------------
+
+def test_sketch_quantiles_within_bucket_error():
+    sk = QuantileSketch(buckets=96)
+    rng = random.Random(7)
+    values = sorted(rng.uniform(50.0, 5000.0) for _ in range(20_000))
+    for v in values:
+        sk.add(v)
+    for q in (0.5, 0.95, 0.99):
+        exact = values[int(q * len(values)) - 1]
+        assert sk.quantile(q) == pytest.approx(exact, rel=0.15), q
+    assert sk.count == len(values)
+    assert sk.mean == pytest.approx(sum(values) / len(values), rel=1e-6)
+
+
+def test_sketch_memory_is_buckets_not_samples():
+    sk = QuantileSketch(buckets=64)
+    cells0 = sk.cells()
+    for i in range(100_000):
+        sk.add(float(i % 977) + 0.5)
+    assert sk.cells() == cells0 == 66       # 64 + under/overflow
+    assert sk.count == 100_000
+
+
+def test_sketch_under_overflow_and_merge():
+    sk = QuantileSketch(buckets=16, lo=1.0, hi=1000.0)
+    sk.add(0.001)           # underflow
+    sk.add(5e6)             # overflow
+    sk.add(100.0)
+    assert sk.count == 3
+    assert sk.quantile(0.0) == pytest.approx(0.001)
+    assert sk.quantile(1.0) == pytest.approx(5e6)
+    other = QuantileSketch(buckets=16, lo=1.0, hi=1000.0)
+    other.add(200.0)
+    sk.merge(other)
+    assert sk.count == 4
+    with pytest.raises(ValueError):
+        sk.merge(QuantileSketch(buckets=8, lo=1.0, hi=1000.0))
+
+
+def test_sketch_ignores_nan_inf():
+    sk = QuantileSketch()
+    sk.add(float("nan"))
+    sk.add(float("inf"))
+    assert sk.count == 0
+    assert sk.quantile(0.5) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# skew tracker
+# ---------------------------------------------------------------------------
+
+def _fill_window(tr, width=8, slow_index=None, slow_ms=300.0,
+                 base_ms=100.0, samples=4):
+    for i in range(width):
+        v = slow_ms if i == slow_index else base_ms
+        for _ in range(samples):
+            tr.observe_metric(f"worker:{i}", "TRAIN_STEP_TIME_MS", v)
+
+
+def test_tracker_windows_and_heatmap():
+    clock = FakeClock()
+    tr = SkewTracker(buckets=32, heatmap_windows=3, clock=clock)
+    for w in range(5):
+        _fill_window(tr, slow_index=7)
+        clock.tick(1.0)
+        closed = tr.maybe_roll(window_ms=500)
+        assert closed is not None
+        gang = closed["step_time_ms"]["gang"]
+        assert gang["count"] == 32
+        assert closed["step_time_ms"]["tasks"]["worker:7"] == 300.0
+    hm = tr.heatmap("step_time_ms")
+    # bounded by heatmap_windows, newest retained
+    assert len(hm["window_ends_ms"]) == 3
+    assert hm["tasks"]["worker:0"] == [100.0, 100.0, 100.0]
+    assert hm["tasks"]["worker:7"] == [300.0, 300.0, 300.0]
+
+
+def test_tracker_roll_respects_window_age():
+    clock = FakeClock()
+    tr = SkewTracker(clock=clock)
+    tr.observe("worker:0", "step_time_ms", 10.0)
+    # window just opened: too young to close
+    assert tr.maybe_roll(window_ms=5000) is None
+    clock.tick(10.0)
+    assert tr.maybe_roll(window_ms=5000) is not None
+    # nothing observed since: nothing to roll even with force
+    assert tr.maybe_roll(window_ms=0, force=True) is None
+
+
+def test_tracker_cumulative_gauge_folds_deltas():
+    clock = FakeClock()
+    tr = SkewTracker(clock=clock)
+    # GOODPUT_INPUT_STALL_SECONDS is cumulative: 1.0s then 1.5s -> the
+    # second window must see the 0.5s delta (500 ms), not 1500 ms
+    tr.observe_metric("worker:0", "GOODPUT_INPUT_STALL_SECONDS", 1.0)
+    clock.tick(1.0)
+    first = tr.maybe_roll(window_ms=500)
+    assert first["input_stall_ms"]["tasks"]["worker:0"] == 1000.0
+    tr.observe_metric("worker:0", "GOODPUT_INPUT_STALL_SECONDS", 1.5)
+    clock.tick(1.0)
+    second = tr.maybe_roll(window_ms=500)
+    assert second["input_stall_ms"]["tasks"]["worker:0"] == \
+        pytest.approx(500.0)
+
+
+def test_tracker_startup_values_and_clear_task():
+    tr = SkewTracker()
+    tr.observe_metric("worker:3", "GOODPUT_LOCALIZATION_SECONDS", 9.0)
+    tr.observe_metric("worker:3", "GOODPUT_COMPILE_SECONDS", 2.0)
+    sv = tr.startup_values()
+    assert sv["localization_ms"]["worker:3"] == 9000.0
+    assert sv["compile_ms"]["worker:3"] == 2000.0
+    tr.clear_task("worker:3")
+    assert tr.startup_values()["localization_ms"] == {}
+
+
+def test_tracker_state_is_o_buckets_not_o_width():
+    """The tentpole's memory contract at width 1024: sketch cells pinned
+    at the width-independent ceiling, per-task retention a few scalars
+    per window — never a sample list."""
+    clock = FakeClock()
+    buckets = 64
+    tr = SkewTracker(buckets=buckets, heatmap_windows=4, clock=clock)
+    width = 1024
+    for w in range(6):
+        for i in range(width):
+            for _ in range(50):     # 50 samples/task/window
+                tr.observe_metric(f"worker:{i}", "TRAIN_STEP_TIME_MS",
+                                  100.0 + i % 7)
+        assert tr.sketch_cells() <= tr.max_sketch_cells()
+        assert tr.max_sketch_cells() == 3 * (buckets + 2)
+        clock.tick(1.0)
+        tr.maybe_roll(window_ms=500)
+    # retained per task: heatmap means only (windows are closed) — far
+    # below the 50 samples/window that were offered
+    assert tr.per_task_cells() <= width * 4 * 3
+
+
+# ---------------------------------------------------------------------------
+# straggler analyzer
+# ---------------------------------------------------------------------------
+
+def _closed(width=8, slow_index=None, slow_ms=300.0, base_ms=100.0):
+    tasks = {f"worker:{i}": (slow_ms if i == slow_index else base_ms)
+             for i in range(width)}
+    return {"step_time_ms": {"start_ms": 0, "end_ms": 1000,
+                             "gang": {}, "tasks": tasks}}
+
+
+def test_analyzer_latches_after_consecutive_windows():
+    an = StragglerAnalyzer(threshold_pct=50, windows=3, min_tasks=3)
+    for w in range(2):
+        actions, rem = an.analyze(_closed(slow_index=5))
+        assert actions == [] and rem == []
+    actions, _ = an.analyze(_closed(slow_index=5))
+    assert len(actions) == 1
+    a = actions[0]
+    assert (a["action"], a["task_id"], a["phase"]) == \
+        ("detected", "worker:5", "steady_state")
+    assert a["signal"] == "step_time_ms"
+    assert a["value_ms"] == 300.0
+    assert a["gang_median_ms"] == 100.0
+    assert a["z_score"] > 2
+    assert an.active()[0]["task_id"] == "worker:5"
+    # latched: no duplicate event while the condition persists
+    actions, _ = an.analyze(_closed(slow_index=5))
+    assert actions == []
+
+
+def test_analyzer_clears_after_recovery():
+    an = StragglerAnalyzer(threshold_pct=50, windows=2, min_tasks=3)
+    an.analyze(_closed(slow_index=1))
+    an.analyze(_closed(slow_index=1))
+    assert an.active()
+    an.analyze(_closed())               # healthy window 1
+    actions, _ = an.analyze(_closed())  # healthy window 2 -> cleared
+    assert [a["action"] for a in actions] == ["cleared"]
+    assert actions[0]["reason"] == "recovered"
+    # the clear reports the lagging streak that was latched, not the 0
+    # the healthy run-up reset lag_windows to
+    assert actions[0]["windows"] == 2
+    assert an.active() == []
+    log = an.log()
+    assert [e["action"] for e in log] == ["detected", "cleared"]
+
+
+def test_analyzer_false_positive_guards():
+    # below min_tasks: silence
+    an = StragglerAnalyzer(threshold_pct=50, windows=1, min_tasks=4)
+    actions, _ = an.analyze(_closed(width=3, slow_index=0))
+    assert actions == []
+    # tiny absolute excess over a ~0 median: silence (min_excess_ms)
+    an = StragglerAnalyzer(threshold_pct=50, windows=1, min_tasks=3)
+    actions, _ = an.analyze(_closed(slow_index=2, slow_ms=0.04,
+                                    base_ms=0.01))
+    assert actions == []
+    # healthy jitter under the threshold: silence
+    actions, _ = an.analyze(_closed(slow_index=2, slow_ms=130.0))
+    assert actions == []
+
+
+def test_analyzer_startup_attribution():
+    an = StragglerAnalyzer(threshold_pct=50, windows=2, min_tasks=3)
+    startup = {"localization_ms": {f"worker:{i}": 500.0 for i in range(8)},
+               "compile_ms": {f"worker:{i}": 1000.0 for i in range(8)}}
+    startup["localization_ms"]["worker:6"] = 9000.0
+    actions, _ = an.analyze({}, startup)
+    assert len(actions) == 1
+    a = actions[0]
+    assert (a["action"], a["task_id"], a["phase"], a["signal"]) == \
+        ("detected", "worker:6", "startup", "startup_ms")
+    # one-shot: the same startup evidence never re-fires
+    actions, _ = an.analyze({}, startup)
+    assert actions == []
+    # ...INCLUDING after a recovered-clear: healthy steady-state windows
+    # release the latch, but the unchanged startup totals must not
+    # re-detect (the clear/detect pair would otherwise flap forever)
+    an.analyze(_closed(), startup)
+    actions, _ = an.analyze(_closed(), startup)
+    assert [x["action"] for x in actions] == ["cleared"]
+    for _ in range(3):
+        actions, _ = an.analyze(_closed(), startup)
+        assert actions == []
+    # a relaunch (clear_task) re-arms startup detection for the fresh
+    # attempt — it localizes and compiles anew
+    an.clear_task("worker:6")
+    actions, _ = an.analyze({}, startup)
+    assert [x["action"] for x in actions] == ["detected"]
+
+
+def test_analyzer_startup_jitter_below_floor_is_silent():
+    an = StragglerAnalyzer(threshold_pct=50, windows=1, min_tasks=3)
+    startup = {"localization_ms": {f"worker:{i}": 20.0 for i in range(8)},
+               "compile_ms": {}}
+    startup["localization_ms"]["worker:1"] = 500.0   # < 1s absolute floor
+    actions, _ = an.analyze({}, startup)
+    assert actions == []
+
+
+def test_tracker_rejects_non_finite_observations():
+    clock = FakeClock()
+    tr = SkewTracker(clock=clock)
+    tr.observe("worker:0", "step_time_ms", float("-inf"))
+    tr.observe("worker:0", "step_time_ms", float("nan"))
+    tr.observe_metric("worker:0", "TRAIN_STEP_TIME_MS", float("inf"))
+    assert tr.maybe_roll(window_ms=0, force=True) is None
+    tr.observe("worker:0", "step_time_ms", 10.0)
+    clock.tick(1.0)
+    closed = tr.maybe_roll(window_ms=0, force=True)
+    assert closed["step_time_ms"]["tasks"]["worker:0"] == 10.0
+
+
+def test_analyzer_remediation_nomination_and_clear_task():
+    an = StragglerAnalyzer(threshold_pct=50, windows=2, min_tasks=3,
+                           relaunch_after_windows=4)
+    rem = []
+    for _ in range(4):
+        _, rem = an.analyze(_closed(slow_index=3))
+    assert [r["task_id"] for r in rem] == ["worker:3"]
+    assert rem[0]["windows"] == 4
+    cleared = an.clear_task("worker:3", reason="relaunched")
+    assert cleared["action"] == "cleared"
+    assert cleared["reason"] == "relaunched"
+    assert an.active() == []
+    # clearing an already-cleared slot is silent
+    assert an.clear_task("worker:3") is None
+
+
+def test_analyzer_latch_survives_gang_shrinking_below_min_tasks():
+    """A still-slow latched straggler must not be auto-'recovered' when
+    its healthy peers complete and the reporting gang falls below
+    min_tasks — sub-min_tasks windows can neither latch nor clear."""
+    an = StragglerAnalyzer(threshold_pct=50, windows=2, min_tasks=3)
+    an.analyze(_closed(slow_index=2))
+    an.analyze(_closed(slow_index=2))
+    assert an.active()
+    # peers finished: only the straggler still reports, at 300 ms
+    shrunk = {"step_time_ms": {"start_ms": 0, "end_ms": 1000, "gang": {},
+                               "tasks": {"worker:2": 300.0}}}
+    for _ in range(5):
+        actions, _ = an.analyze(shrunk)
+        assert actions == []
+    assert an.active(), "latch must survive an unjudgeable gang"
+
+
+def test_analyzer_relaunch_disabled_by_default():
+    an = StragglerAnalyzer(threshold_pct=50, windows=1, min_tasks=3)
+    for _ in range(10):
+        _, rem = an.analyze(_closed(slow_index=0))
+        assert rem == []
+
+
+# ---------------------------------------------------------------------------
+# events + renderers + metrics-store sink
+# ---------------------------------------------------------------------------
+
+def test_straggler_events_roundtrip_and_render():
+    from tony_tpu.events.render import render_event
+    from tony_tpu.events.schema import (
+        Event, StragglerCleared, StragglerDetected,
+    )
+    ev = Event(EventType.STRAGGLER_DETECTED,
+               StragglerDetected("worker", 5, attempt=1,
+                                 signal="step_time_ms",
+                                 phase="steady_state", value_ms=300.0,
+                                 gang_median_ms=100.0, z_score=2.6,
+                                 windows=3, span_ids=["abc"]))
+    back = Event.from_dict(ev.to_dict())
+    assert back.payload == ev.payload
+    text = render_event(ev.type.value, ev.to_dict()["payload"])
+    assert "worker:5" in text and "steady_state" in text
+    ev2 = Event(EventType.STRAGGLER_CLEARED,
+                StragglerCleared("worker", 5, reason="relaunched",
+                                 windows_lagging=4))
+    assert "relaunched" in render_event(ev2.type.value,
+                                        ev2.to_dict()["payload"])
+
+
+def test_metrics_store_feeds_skew_sink():
+    from tony_tpu.am.application_master import MetricsStore
+    clock = FakeClock()
+    tr = SkewTracker(clock=clock)
+    store = MetricsStore()
+    store.skew_sink = tr.observe_metric
+    store.update_metrics(
+        {"task_type": "worker", "index": 2,
+         "metrics": [{"name": "TRAIN_STEP_TIME_MS", "value": 123.0},
+                     {"name": "SOMETHING_ELSE", "value": 1.0},
+                     {"name": "GOODPUT_COMPILE_SECONDS", "value": 3.0}]})
+    clock.tick(1.0)
+    closed = tr.maybe_roll(window_ms=500)
+    assert closed["step_time_ms"]["tasks"]["worker:2"] == 123.0
+    assert tr.startup_values()["compile_ms"]["worker:2"] == 3000.0
+
+
+def test_bundle_shape_for_surfaces():
+    clock = FakeClock()
+    tr = SkewTracker(clock=clock)
+    an = StragglerAnalyzer(threshold_pct=50, windows=1, min_tasks=3)
+    _fill_window(tr, slow_index=4)
+    clock.tick(1.0)
+    an.analyze(tr.maybe_roll(window_ms=500), tr.startup_values())
+    bundle = tr.bundle(an)
+    assert bundle["heatmap"]["signal"] == "step_time_ms"
+    assert "worker:4" in bundle["heatmap"]["tasks"]
+    assert bundle["stragglers"][0]["task_id"] == "worker:4"
+    assert bundle["detections"][0]["action"] == "detected"
+    gang = bundle["signals"]["step_time_ms"]["windows"][-1]["gang"]
+    assert gang["count"] == 32
+    assert json.loads(json.dumps(bundle)) == bundle   # JSON-clean
+
+
+# ---------------------------------------------------------------------------
+# surfaces: CLI + portal (sidecar level)
+# ---------------------------------------------------------------------------
+
+def _sample_bundle():
+    clock = FakeClock()
+    tr = SkewTracker(clock=clock)
+    an = StragglerAnalyzer(threshold_pct=50, windows=1, min_tasks=3)
+    for _ in range(3):
+        _fill_window(tr, slow_index=4)
+        clock.tick(1.0)
+        an.analyze(tr.maybe_roll(window_ms=500), tr.startup_values())
+    return tr.bundle(an)
+
+
+def test_cli_stragglers_renders_bundle_offline(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import stragglers
+    from tony_tpu.events.history import write_skew_file
+    hist = tmp_path / "history" / "application_x_1"
+    write_skew_file(str(hist), _sample_bundle())
+    assert stragglers([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "worker:4" in out
+    assert "steady_state" in out
+    assert "heatmap" in out
+    # --json dumps the raw bundle
+    assert stragglers([str(tmp_path), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["stragglers"]
+
+
+def test_cli_stragglers_missing_bundle(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import stragglers
+    assert stragglers([str(tmp_path)]) == 1
+    assert "no skew bundle" in capsys.readouterr().err
+
+
+def test_portal_serves_skew_api_and_panel(tmp_path):
+    from tony_tpu.events.handler import EventHandler
+    from tony_tpu.events.history import JobMetadata, write_skew_file
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.server import PortalServer
+    inter = tmp_path / "inter"
+    app = "application_skew_1"
+    md = JobMetadata(application_id=app, started=1000)
+    handler = EventHandler(str(inter / app), md)
+    handler.start()
+    handler.stop("SUCCEEDED")
+    write_skew_file(str(inter / app), _sample_bundle())
+    cache = PortalCache(str(inter), str(tmp_path / "fin"))
+    server = PortalServer(cache, port=0, host="127.0.0.1")
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/api/jobs/{app}/skew",
+                timeout=10) as resp:
+            bundle = json.loads(resp.read())
+        assert bundle["source"] == "history"
+        assert bundle["stragglers"][0]["task_id"] == "worker:4"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/jobs/{app}",
+                timeout=10) as resp:
+            page = resp.read().decode()
+        assert "Cross-task skew" in page
+        assert "worker:4" in page
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: detection, attribution, silence, remediation
+# ---------------------------------------------------------------------------
+
+def _skew_overrides(**extra):
+    over = {
+        "tony.straggler.window-ms": 400,
+        "tony.straggler.windows": 2,
+        "tony.straggler.threshold-pct": 50,
+        "tony.straggler.min-tasks": 3,
+    }
+    over.update(extra)
+    return over
+
+
+def _skew_env(run_seconds=4.0):
+    return {"SKEW_STEP_MS": 30, "SKEW_PUSH_MS": 150,
+            "SKEW_RUN_SECONDS": run_seconds}
+
+
+@pytest.mark.chaos
+def test_straggler_detected_with_attribution_e2e(tmp_path):
+    """Acceptance: a TEST_TRAINER_STEP_DELAY-injected straggler in an
+    8-task gang is detected within tony.straggler.windows windows with
+    the correct task id and steady-state phase attribution; the event
+    carries the evidence; skew.json renders through the CLI."""
+    from tests.chaos import ChaosRun, StepDelay
+    run = ChaosRun(tmp_path, seed=21)
+    run.run(
+        ["--executes", script("skew_gang_worker.py"),
+         "--conf", "tony.worker.instances=8"],
+        injections=[StepDelay("worker", 5, 120)],
+        conf_overrides=_skew_overrides(),
+        extra_env=_skew_env(run_seconds=4.0))
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    detected = [e for e in run.events_of_type(EventType.STRAGGLER_DETECTED)
+                if e.payload.phase == "steady_state"]
+    assert detected, run.all_logs()
+    p = detected[0].payload
+    assert (p.task_type, p.task_index) == ("worker", 5)
+    assert p.phase == "steady_state"
+    assert p.signal == "step_time_ms"
+    assert p.value_ms > p.gang_median_ms * 1.5
+    assert p.windows >= 2
+    # no relaunch without the remediation knob
+    assert run.relaunches() == []
+    # the bundle landed in history and the CLI renders it offline
+    from tony_tpu.events.history import read_skew_file
+    bundle = read_skew_file(run.app_history_dir())
+    assert any(s["task_id"] == "worker:5"
+               for s in bundle.get("stragglers", [])), bundle
+    assert "worker:5" in bundle["heatmap"]["tasks"]
+    from tony_tpu.cli.__main__ import stragglers as cli_stragglers
+    assert cli_stragglers([run.app_history_dir()]) == 0
+
+
+@pytest.mark.chaos
+def test_healthy_gang_produces_zero_detections_e2e(tmp_path):
+    """Acceptance (false-positive silence): an equal-width healthy gang
+    over the same run produces zero STRAGGLER_* events."""
+    from tests.chaos import ChaosRun
+    run = ChaosRun(tmp_path, seed=22)
+    run.run(
+        ["--executes", script("skew_gang_worker.py"),
+         "--conf", "tony.worker.instances=8"],
+        conf_overrides=_skew_overrides(),
+        extra_env=_skew_env(run_seconds=4.0))
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    assert run.events_of_type(EventType.STRAGGLER_DETECTED) == []
+    assert run.events_of_type(EventType.STRAGGLER_CLEARED) == []
+
+
+@pytest.mark.chaos
+def test_straggler_relaunched_and_latch_clears_e2e(tmp_path):
+    """Acceptance (remediation): with tony.straggler.relaunch-after-
+    windows set, the persistent steady-state straggler is relaunched
+    through the task-attempt machinery (reason on the TASK_RELAUNCHED
+    event), STRAGGLER_CLEARED lands with reason=relaunched, the healthy
+    replacement keeps the gang green, and the job SUCCEEDS."""
+    from tests.chaos import ChaosRun, StepDelay
+    run = ChaosRun(tmp_path, seed=23)
+    run.run(
+        ["--executes", script("skew_gang_worker.py"),
+         "--conf", "tony.worker.instances=8",
+         "--conf", "tony.task.max-task-attempts=2"],
+        injections=[StepDelay("worker", 2, 120, attempt=0)],
+        conf_overrides=_skew_overrides(
+            **{"tony.straggler.relaunch-after-windows": 3}),
+        extra_env=_skew_env(run_seconds=6.0))
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    detected = [e for e in run.events_of_type(EventType.STRAGGLER_DETECTED)
+                if e.payload.phase == "steady_state"]
+    assert detected and detected[0].payload.task_type == "worker"
+    assert detected[0].payload.task_index == 2
+    relaunches = run.relaunches()
+    assert len(relaunches) == 1, run.all_logs()
+    assert relaunches[0].task_index == 2
+    assert "straggler" in relaunches[0].reason
+    cleared = run.events_of_type(EventType.STRAGGLER_CLEARED)
+    assert cleared, run.all_logs()
+    assert cleared[0].payload.reason == "relaunched"
+    assert cleared[0].payload.task_index == 2
+    # the replacement ran healthy: exactly one relaunch, no re-detection
+    # of the replacement attempt afterwards
+    post = [e for e in detected
+            if e.timestamp > cleared[0].timestamp]
+    assert post == [], post

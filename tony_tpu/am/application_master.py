@@ -42,7 +42,8 @@ from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
     ApplicationFinished, ApplicationInited, DiagnosticsReady, Event,
     EventType, ProfileCaptured, ServingEndpointRegistered, SloViolation,
-    TaskFinished, TaskRelaunched, TaskStarted,
+    StragglerCleared, StragglerDetected, TaskFinished, TaskRelaunched,
+    TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor
 from tony_tpu.rpc.service import (
@@ -111,12 +112,19 @@ class MetricsStore(MetricsServiceHandler):
         # profile-capture completions (update_metrics `profile_done`
         # field) are forwarded here; the AM wires _on_profile_captured
         self.profile_sink = None
+        # cross-task skew analytics (observability/skew.py): every
+        # numeric gauge push is offered to this sink (the SkewTracker's
+        # observe_metric — unwatched names are a single dict miss), so
+        # the straggler analyzer never re-reads the O(width x points)
+        # trajectories above; None drops them (standalone store in tests)
+        self.skew_sink = None
         self._lock = threading.Lock()
 
     def update_metrics(self, req: dict) -> dict:
         task_type, index = req["task_type"], int(req["index"])
         metrics = req.get("metrics", [])
         now_ms = int(time.time() * 1000)
+        numeric: list[tuple[str, float]] = []
         with self._lock:
             # MERGE by metric name, don't replace the list: one task slot
             # has several pushers at once (executor TaskMonitor: memory/
@@ -143,6 +151,7 @@ class MetricsStore(MetricsServiceHandler):
                         from tony_tpu.observability.metrics import TimeSeries
                         ts = series[name] = TimeSeries(self._history_points)
                     ts.append(now_ms, float(value))
+                    numeric.append((name, float(value)))
             attempt = req.get("attempt")
             if attempt is not None and int(attempt) >= 0:
                 self._attempts[(task_type, index)] = int(attempt)
@@ -156,6 +165,13 @@ class MetricsStore(MetricsServiceHandler):
         sink = self.span_sink
         if spans and sink is not None:
             sink(spans)
+        # outside the store lock (the tracker has its own): fold watched
+        # gauges into the skew windows
+        skew_sink = self.skew_sink
+        if numeric and skew_sink is not None:
+            task_id = f"{task_type}:{index}"
+            for name, value in numeric:
+                skew_sink(task_id, name, value)
         profile_done = req.get("profile_done")
         psink = self.profile_sink
         if isinstance(profile_done, dict) and psink is not None:
@@ -345,6 +361,19 @@ class ApplicationMaster(ClusterServiceHandler):
             step_regression_pct=conf.get_int(
                 K.SLO_STEP_TIME_REGRESSION_PCT, 0),
             goodput_floor_pct=conf.get_int(K.SLO_GOODPUT_FLOOR_PCT, 0))
+        # cross-task skew analytics + straggler detection
+        # (observability/skew.py): the MetricsStore offers every numeric
+        # gauge to the tracker's windowed sketches (O(buckets) per
+        # signal-window, independent of gang width); the analyzer runs on
+        # the monitor-loop cadence next to _check_slo. Remediation
+        # (tony.straggler.relaunch-after-windows > 0) routes a persistent
+        # steady-state straggler through the task-attempt relaunch
+        # machinery — attempt-fenced, budget-counted, downtime attributed
+        # like any other relaunch.
+        self._straggler_enabled = conf.get_bool(K.STRAGGLER_ENABLED, True)
+        self._straggler_window_ms = conf.get_time_ms(
+            K.STRAGGLER_WINDOW_MS, 15_000)
+        self._build_skew_state()
         # live logs + failure diagnostics (observability/logs.py):
         # executors gossip their TaskLogService address on heartbeats
         # (task_id -> (attempt, "host:port"), attempt-fenced so a zombie
@@ -408,6 +437,11 @@ class ApplicationMaster(ClusterServiceHandler):
         self._monitor_interval = conf.get_time_ms(K.AM_MONITOR_INTERVAL_MS, 5000) / 1000.0
         self.hb_monitor = LivelinessMonitor(
             self._hb_interval_ms, self._max_missed_hb, self._on_task_deemed_dead)
+        if self._straggler_enabled:
+            # heartbeat lag is one of the skew signals (ms, per ping)
+            self.hb_monitor.lag_sink = (
+                lambda task_id, lag_sec: self.skew_tracker.observe(
+                    task_id, "heartbeat_lag_ms", lag_sec * 1000.0))
         # event history → per-app subdir of the intermediate dir; the
         # portal's mover later relocates finished apps into finished/y/M/d
         # (reference: tony.history.intermediate + setupJobDir,
@@ -603,7 +637,8 @@ class ApplicationMaster(ClusterServiceHandler):
         """Spans + metric timeseries into the history dir, next to the
         event log (the portal's waterfall and metrics.json sources)."""
         from tony_tpu.events.history import (
-            write_goodput_file, write_metrics_file, write_spans_file,
+            write_goodput_file, write_metrics_file, write_skew_file,
+            write_spans_file,
         )
         try:
             if self._trace_enabled:
@@ -616,6 +651,13 @@ class ApplicationMaster(ClusterServiceHandler):
                                self.metrics_store.timeseries_dict())
             if self._goodput_enabled:
                 write_goodput_file(self.history_dir, self.goodput_dict())
+            if self._straggler_enabled:
+                # fold the still-open window in first so a short run's
+                # skew story isn't lost to an unclosed window
+                self.skew_tracker.maybe_roll(self._straggler_window_ms,
+                                             force=True)
+                write_skew_file(self.history_dir,
+                                self.skew_tracker.bundle(self.straggler))
         except Exception:  # noqa: BLE001 — observability must not fail _finish
             LOG.exception("failed to flush spans/metrics into history")
 
@@ -828,7 +870,7 @@ class ApplicationMaster(ClusterServiceHandler):
                       f"history/{os.path.basename(final_hist)}")
             for extra in (C.PORTAL_CONFIG_FILE, C.SPANS_FILE,
                           C.METRICS_FILE, C.GOODPUT_FILE,
-                          C.DIAGNOSTICS_FILE):
+                          C.DIAGNOSTICS_FILE, C.SKEW_FILE):
                 p = os.path.join(self.history_dir, extra)
                 if os.path.exists(p):
                     store.put(p, f"history/{extra}")
@@ -1070,6 +1112,7 @@ class ApplicationMaster(ClusterServiceHandler):
                     # whole again, downtime stops accruing
                     self._close_relaunch_downtime()
             self._check_slo()
+            self._check_stragglers()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
                 LOG.info("all %d tracked tasks completed", total)
@@ -1133,6 +1176,157 @@ class ApplicationMaster(ClusterServiceHandler):
         except Exception:  # noqa: BLE001 — the watchdog must never kill the AM
             LOG.exception("SLO check failed")
 
+    def _build_skew_state(self) -> None:
+        """(Re)construct the skew tracker + straggler analyzer from the
+        frozen conf and rewire the metrics-store sink onto the fresh
+        tracker. Called at construction AND from _reset(): a new session
+        is a new gang — the dead session's latched stragglers, one-shot
+        startup flags, and declined-remediation slots must not judge it.
+        (The heartbeat lag sink needs no rewiring: its lambda reads
+        self.skew_tracker at call time.)"""
+        from tony_tpu.observability.skew import SkewTracker, StragglerAnalyzer
+        conf = self.conf
+        self.skew_tracker = SkewTracker(
+            buckets=conf.get_int(K.STRAGGLER_SKETCH_BUCKETS, 96),
+            heatmap_windows=conf.get_int(K.STRAGGLER_HEATMAP_WINDOWS, 32))
+        self.straggler = StragglerAnalyzer(
+            threshold_pct=conf.get_int(K.STRAGGLER_THRESHOLD_PCT, 50),
+            windows=conf.get_int(K.STRAGGLER_WINDOWS, 3),
+            min_tasks=conf.get_int(K.STRAGGLER_MIN_TASKS, 3),
+            relaunch_after_windows=conf.get_int(
+                K.STRAGGLER_RELAUNCH_AFTER_WINDOWS, 0))
+        # slots whose straggler remediation was declined (budget/peers):
+        # never re-asked — the latch stays, the relaunch machinery is
+        # left alone
+        self._straggler_no_remediate: set[str] = set()
+        if self._straggler_enabled:
+            self.metrics_store.skew_sink = self.skew_tracker.observe_metric
+
+    def _task_span_ids(self, task_id: str, limit: int = 8) -> list[str]:
+        """Span ids of one task's lifecycle spans — the STRAGGLER event's
+        link into the waterfall (same trace_id = app_id)."""
+        return [str(s.get("span_id"))
+                for s in self.span_store.to_list()
+                if s.get("task_id") == task_id and s.get("span_id")
+                ][:limit]
+
+    def _check_stragglers(self) -> None:
+        """One skew-analyzer pass (monitor-loop cadence): close the open
+        window when it has aged past tony.straggler.window-ms, latch /
+        clear stragglers against the gang distribution, refresh the skew
+        gauges, and — with the remediation knob set — route a persistent
+        steady-state straggler through the task-attempt relaunch path."""
+        if not self._straggler_enabled:
+            return
+        try:
+            closed = self.skew_tracker.maybe_roll(self._straggler_window_ms)
+            if closed is None:
+                return
+            actions, remediate = self.straggler.analyze(
+                closed, self.skew_tracker.startup_values())
+            # pin each remediation candidate to the attempt whose lag the
+            # evidence describes NOW — a crash observer relaunching the
+            # slot between this snapshot and the relaunch call below must
+            # be fenced out, not handed a healthy replacement to kill
+            session = self.session
+            nominated = []
+            for r in remediate:
+                task = (session.get_task_by_id(r["task_id"])
+                        if session is not None else None)
+                if task is not None and not task.completed:
+                    nominated.append((r, task, task.attempt))
+            for a in actions:
+                task_id = a["task_id"]
+                name, _, idx = task_id.rpartition(":")
+                try:
+                    index = int(idx)
+                except ValueError:
+                    name, index = task_id, 0
+                if a["action"] == "detected":
+                    session = self.session
+                    task = (session.get_task_by_id(task_id)
+                            if session is not None else None)
+                    LOG.warning(
+                        "straggler detected: %s (%s via %s) %.1f ms vs "
+                        "gang median %.1f ms (z=%.1f, %d window(s))",
+                        task_id, a["phase"], a["signal"], a["value_ms"],
+                        a["gang_median_ms"], a["z_score"], a["windows"])
+                    self.event_handler.emit(Event(
+                        EventType.STRAGGLER_DETECTED,
+                        StragglerDetected(
+                            name, index,
+                            attempt=task.attempt if task is not None else 0,
+                            signal=a["signal"], phase=a["phase"],
+                            value_ms=a["value_ms"],
+                            gang_median_ms=a["gang_median_ms"],
+                            z_score=a["z_score"], windows=a["windows"],
+                            span_ids=self._task_span_ids(task_id))))
+                else:
+                    LOG.info("straggler cleared: %s (%s)", task_id,
+                             a.get("reason", "recovered"))
+                    self.event_handler.emit(Event(
+                        EventType.STRAGGLER_CLEARED,
+                        StragglerCleared(name, index,
+                                         reason=a.get("reason",
+                                                      "recovered"),
+                                         windows_lagging=a["windows"])))
+            # alert gauges: latched count + the gang's step-time spread
+            # from the window that just closed (AM /metrics exposition)
+            from tony_tpu.observability.metrics import REGISTRY
+            REGISTRY.gauge("tony_job_straggler_count",
+                           app_id=self.app_id).set(
+                len(self.straggler.active()))
+            gang = (closed.get("step_time_ms") or {}).get("gang") or {}
+            for q in ("p50", "p95", "p99"):
+                if q in gang:
+                    REGISTRY.gauge(f"tony_job_step_time_{q}_ms",
+                                   app_id=self.app_id).set(gang[q])
+            for r, task, attempt in nominated:
+                self._remediate_straggler(r, task, attempt)
+        except Exception:  # noqa: BLE001 — skew must never kill the AM
+            LOG.exception("straggler check failed")
+
+    def _remediate_straggler(self, evidence: dict, task: Task,
+                             observed_attempt: int) -> None:
+        """The opt-in recovery hook: a steady-state straggler that kept
+        lagging past tony.straggler.relaunch-after-windows is relaunched
+        through the SAME machinery a crash uses — attempt-fenced
+        (`observed_attempt` is the attempt the lag evidence belongs to,
+        pinned at nomination time), counted against the attempt budget,
+        its gap attributed to the goodput ledger's relaunch_downtime. A
+        declined relaunch (budget exhausted, peers completed) leaves the
+        latch in place: detection stays on the record even when recovery
+        is off the table."""
+        task_id = evidence["task_id"]
+        # decline-once: a slot whose relaunch was refused (attempt budget
+        # exhausted, completed peers) is refused forever — re-asking every
+        # window would spam the log and, worse, re-enter the relaunch
+        # decision each time for a task that never actually failed
+        if task_id in self._straggler_no_remediate:
+            return
+        reason = (f"persistent steady-state straggler "
+                  f"({evidence['signal']} {evidence['value_ms']} ms vs "
+                  f"gang median {evidence['gang_median_ms']} ms for "
+                  f"{evidence['windows']} windows)")
+        if not self._maybe_relaunch_task(task, reason,
+                                         observed_attempt=observed_attempt,
+                                         count_failure=False):
+            self._straggler_no_remediate.add(task_id)
+            LOG.warning("straggler %s not relaunched (budget/peers) — "
+                        "detection stays latched, remediation disabled "
+                        "for this slot: %s", task_id, reason)
+        # on success the relaunch path itself released the latch and
+        # emitted STRAGGLER_CLEARED(relaunched) — nothing left to do
+
+    def get_skew(self, req: dict) -> dict:
+        """Operator plane: the live cross-task skew bundle (portal
+        /api/jobs/:id/skew proxy + CLI). Same shape as the skew.json
+        flushed into history at finish."""
+        if not self._straggler_enabled:
+            return {"error": "straggler detection disabled "
+                             "(tony.straggler.enabled)"}
+        return self.skew_tracker.bundle(self.straggler)
+
     def _reset(self) -> None:
         """Stop this session's containers and bump the session id so stale
         completion callbacks are ignored (ApplicationMaster.reset,
@@ -1142,6 +1336,11 @@ class ApplicationMaster(ClusterServiceHandler):
         for cid in cids:
             self.backend.stop_container(cid)
         self.hb_monitor.clear()
+        # fresh gang, fresh skew books: the dead session's latches,
+        # startup flags, and declined-remediation slots must not carry
+        # into the retry (the task-relaunch path clears per-slot; a
+        # session reset clears everything)
+        self._build_skew_state()
         self._session_id += 1
 
     def _drain_completion_callbacks(self, timeout_sec: float = 5.0) -> None:
@@ -1579,7 +1778,8 @@ class ApplicationMaster(ClusterServiceHandler):
         self._wake.set()
 
     def _maybe_relaunch_task(self, task: Task, reason: str,
-                             observed_attempt: int = -1) -> bool:
+                             observed_attempt: int = -1,
+                             count_failure: bool = True) -> bool:
         """The relaunch decision path: on a tracked task's crash or
         heartbeat expiry, stop only that container, recycle the slot
         (bumping the cluster-spec generation so survivors re-rendezvous
@@ -1636,7 +1836,12 @@ class ApplicationMaster(ClusterServiceHandler):
                             task.task_id, reason,
                             session.num_completed_tracked_tasks())
                 return False
-            self._total_task_failures += 1
+            # count_failure=False marks a non-failure relaunch (straggler
+            # remediation): it still spends the attempt budget below, but
+            # a slow-yet-alive task must not burn the application's
+            # task-FAILURE circuit breaker
+            if count_failure:
+                self._total_task_failures += 1
             max_attempts = session.max_task_attempts(task.job_name)
             if task.attempt + 1 >= max_attempts:
                 if max_attempts > 1:
@@ -1703,6 +1908,22 @@ class ApplicationMaster(ClusterServiceHandler):
         if old_url:
             self._aggregate_one_container(
                 os.path.basename(os.path.dirname(old_url)))
+        # skew state for the slot starts clean: the replacement attempt
+        # must not inherit the dead attempt's lag windows or startup
+        # values. A latched straggler's latch releases HERE — whatever
+        # triggered the relaunch (remediation or an ordinary crash), the
+        # slot it was latched on no longer exists — so the CLEARED event
+        # is emitted by the one path every relaunch funnels through.
+        if self._straggler_enabled:
+            self.skew_tracker.clear_task(task.task_id)
+            cleared = self.straggler.clear_task(task.task_id,
+                                                reason="relaunched")
+            if cleared is not None:
+                self.event_handler.emit(Event(
+                    EventType.STRAGGLER_CLEARED,
+                    StragglerCleared(
+                        task.job_name, task.index, reason="relaunched",
+                        windows_lagging=int(cleared["windows"]))))
         # the failed attempt's span ends here; the gang is back at the
         # barrier until the replacement registers, so a fresh rendezvous
         # span opens (waterfall shows relaunch → re-rendezvous wait)

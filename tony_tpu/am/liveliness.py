@@ -43,6 +43,10 @@ class LivelinessMonitor:
         # Kept as attributes AND pushed into the health registry.
         self.last_ping_lag_sec: Optional[float] = None
         self.last_detection_latency_sec: Optional[float] = None
+        # per-task lag consumer (the AM wires the skew tracker in):
+        # called OUTSIDE the monitor lock as lag_sink(task_id, lag_sec) —
+        # heartbeat lag is one of the cross-task straggler signals
+        self.lag_sink: Optional[Callable[[str, float], None]] = None
         # task_id -> (last ping, attempt the entry belongs to): the expiry
         # callback reports WHICH attempt went silent, so a stale expiry
         # racing a relaunch can be fenced instead of judging the healthy
@@ -100,6 +104,12 @@ class LivelinessMonitor:
             else:
                 return False
         REGISTRY.summary("tony_heartbeat_lag_seconds").observe(lag)
+        sink = self.lag_sink
+        if sink is not None:
+            try:
+                sink(task_id, lag)
+            except Exception:  # noqa: BLE001 — skew must never break pings
+                LOG.debug("heartbeat lag sink failed", exc_info=True)
         return True
 
     def registered(self, task_id: str) -> bool:

@@ -1,4 +1,5 @@
-"""`python -m tony_tpu.cli {submit|local|notebook|profile|logs|diagnose} ...`
+"""`python -m tony_tpu.cli
+{submit|local|notebook|profile|logs|diagnose|stragglers} ...`
 
 - submit   — ClusterSubmitter equivalent (cli/ClusterSubmitter.java:41-94):
              run against the configured cluster workdir; app artifacts
@@ -18,6 +19,9 @@
 - diagnose — print a failed app's root-cause bundle (diagnostics.json):
              first-failing task, exit signal, matched error signature,
              redacted last-lines excerpt.
+- stragglers — render a job's cross-task skew bundle (skew.json) offline
+             from history: latched stragglers with evidence, gang
+             quantiles per signal, and the step-time heatmap.
 """
 
 from __future__ import annotations
@@ -30,7 +34,8 @@ from tony_tpu.cli.local_submitter import submit as local_submit
 from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
-         "{submit|local|notebook|profile|logs|diagnose} [args...]")
+         "{submit|local|notebook|profile|logs|diagnose|stragglers} "
+         "[args...]")
 
 
 def _am_client(app_dir: str):
@@ -138,9 +143,9 @@ def logs(argv: list[str]) -> int:
         client.close()
 
 
-def _find_diagnostics(target: str):
-    """Resolve a diagnostics.json from an app dir, a history dir, or a
-    direct file path; returns (bundle dict | None, searched paths)."""
+def _find_history_json(target: str, fname: str):
+    """Resolve a history sidecar (`fname`) from an app dir, a history
+    dir, or a direct file path; returns (dict | None, searched paths)."""
     import glob
     import json
     import os
@@ -152,11 +157,10 @@ def _find_diagnostics(target: str):
         candidates = [target]
     else:
         candidates = (
-            [os.path.join(target, C.DIAGNOSTICS_FILE)]
+            [os.path.join(target, fname)]
             + sorted(glob.glob(os.path.join(
-                target, C.HISTORY_DIR_NAME, "*", C.DIAGNOSTICS_FILE)))
-            + sorted(glob.glob(os.path.join(target, "*",
-                                            C.DIAGNOSTICS_FILE))))
+                target, C.HISTORY_DIR_NAME, "*", fname)))
+            + sorted(glob.glob(os.path.join(target, "*", fname))))
         # an app dir with a configured tony.history.intermediate keeps
         # its history elsewhere — follow the frozen conf there
         frozen = os.path.join(target, C.TONY_FINAL_CONF)
@@ -170,10 +174,9 @@ def _find_diagnostics(target: str):
             if intermediate:
                 app_id = os.path.basename(os.path.normpath(target))
                 candidates += (
-                    [os.path.join(intermediate, app_id,
-                                  C.DIAGNOSTICS_FILE)]
+                    [os.path.join(intermediate, app_id, fname)]
                     + sorted(glob.glob(os.path.join(
-                        intermediate, "*", C.DIAGNOSTICS_FILE))))
+                        intermediate, "*", fname))))
     for path in candidates:
         if os.path.isfile(path):
             try:
@@ -182,6 +185,11 @@ def _find_diagnostics(target: str):
             except (OSError, ValueError):
                 continue
     return None, candidates
+
+
+def _find_diagnostics(target: str):
+    from tony_tpu import constants as C
+    return _find_history_json(target, C.DIAGNOSTICS_FILE)
 
 
 def diagnose(argv: list[str]) -> int:
@@ -245,6 +253,80 @@ def diagnose(argv: list[str]) -> int:
             rsig = r.get("signature") or "no signature"
             print(f"  {r.get('task_id', '?')} attempt "
                   f"{r.get('attempt', 0)}: {r.get('reason', '')} ({rsig})")
+    return 0
+
+
+def stragglers(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli stragglers <target>` — render the job's
+    cross-task skew bundle (the same skew.json the portal's skew panel
+    reads) offline from history: latched stragglers with their evidence,
+    the detection log, per-signal gang quantiles, and an ASCII step-time
+    heatmap."""
+    import argparse
+    import json
+
+    from tony_tpu import constants as C
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli stragglers")
+    parser.add_argument("target",
+                        help="app dir, history dir, or a skew.json")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw bundle instead of a summary")
+    args = parser.parse_args(argv)
+    bundle, searched = _find_history_json(args.target, C.SKEW_FILE)
+    if bundle is None:
+        print("no skew bundle found (searched: "
+              + ", ".join(searched[:4])
+              + "). The job may predate skew analytics or never closed "
+                "an analysis window.", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(bundle, indent=1, sort_keys=True))
+        return 0
+    latched = bundle.get("stragglers") or []
+    if latched:
+        print(f"{len(latched)} latched straggler(s):")
+        for s in latched:
+            print(f"  {s.get('task_id', '?')}: {s.get('phase', '?')} via "
+                  f"{s.get('signal', '?')} — {s.get('value_ms', 0)} ms vs "
+                  f"gang median {s.get('gang_median_ms', 0)} ms "
+                  f"(z={s.get('z_score', 0)}, "
+                  f"{s.get('windows', 0)} window(s))")
+    else:
+        print("no latched stragglers")
+    detections = bundle.get("detections") or []
+    if detections:
+        print(f"{len(detections)} detection-log entr(ies):")
+        for d in detections[-10:]:
+            print(f"  [{d.get('ts_ms', 0)}] {d.get('action', '?')} "
+                  f"{d.get('task_id', '?')} ({d.get('phase', '?')} via "
+                  f"{d.get('signal', '?')}, {d.get('value_ms', 0)} ms vs "
+                  f"{d.get('gang_median_ms', 0)} ms"
+                  + (f", {d['reason']}" if d.get("reason") else "") + ")")
+    for signal, entry in sorted((bundle.get("signals") or {}).items()):
+        windows = entry.get("windows") or []
+        if not windows:
+            continue
+        gang = windows[-1].get("gang") or {}
+        print(f"{signal}: last window p50={gang.get('p50', 0)} "
+              f"p95={gang.get('p95', 0)} p99={gang.get('p99', 0)} ms "
+              f"over {gang.get('count', 0)} sample(s) "
+              f"({len(windows)} window(s) retained)")
+    heatmap = bundle.get("heatmap") or {}
+    tasks = heatmap.get("tasks") or {}
+    if tasks:
+        peak = max((v for row in tasks.values() for v in row
+                    if isinstance(v, (int, float))), default=0.0)
+        if peak > 0:
+            blocks = " ▁▂▃▄▅▆▇█"
+            print(f"{heatmap.get('signal', 'step_time_ms')} heatmap "
+                  f"(darker = slower; peak {peak:.1f} ms):")
+            for tid in sorted(tasks):
+                cells = "".join(
+                    blocks[min(8, 1 + int(7.999 * v / peak))]
+                    if isinstance(v, (int, float)) else "."
+                    for v in tasks[tid])
+                print(f"  {tid:>16} {cells}")
     return 0
 
 
@@ -323,6 +405,8 @@ def main(argv: list[str] | None = None) -> int:
         return logs(rest)
     if cmd == "diagnose":
         return diagnose(rest)
+    if cmd == "stragglers":
+        return stragglers(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

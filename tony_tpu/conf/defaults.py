@@ -114,6 +114,15 @@ DEFAULTS = {
     K.LOGS_CHUNK_BYTES: 32768,
     K.LOGS_FOLLOW_POLL_MS: 500,
     K.LOGS_DIAGNOSTICS_LINES: 200,
+    # cross-task skew / straggler detection (observability/skew.py)
+    K.STRAGGLER_ENABLED: True,
+    K.STRAGGLER_THRESHOLD_PCT: 50,
+    K.STRAGGLER_WINDOWS: 3,
+    K.STRAGGLER_WINDOW_MS: 15_000,
+    K.STRAGGLER_SKETCH_BUCKETS: 96,
+    K.STRAGGLER_HEATMAP_WINDOWS: 32,
+    K.STRAGGLER_MIN_TASKS: 3,
+    K.STRAGGLER_RELAUNCH_AFTER_WINDOWS: 0,   # 0 = detect only
 
     # portal
     K.PORTAL_PORT: 19886,

@@ -160,6 +160,31 @@ LOGS_FOLLOW_POLL_MS = "tony.logs.follow-poll-ms"
 # redacted last-lines budget per failing task in failure reports and the
 # job's diagnostics.json bundle
 LOGS_DIAGNOSTICS_LINES = "tony.logs.diagnostics-lines"
+# cross-task skew analytics + straggler detection (observability/skew.py):
+# master switch for the AM-side windowed sketches, analyzer pass, skew
+# gauges, and the skew.json / get_skew surfaces
+STRAGGLER_ENABLED = "tony.straggler.enabled"
+# a task whose windowed step-time/stall mean exceeds the gang median by
+# more than this percentage counts as lagging in that window
+STRAGGLER_THRESHOLD_PCT = "tony.straggler.threshold-pct"
+# consecutive lagging windows before STRAGGLER_DETECTED latches (and
+# consecutive healthy windows before the latch clears)
+STRAGGLER_WINDOWS = "tony.straggler.windows"
+# length of one analysis window (per-task means + one gang sketch per
+# signal are folded per window; the analyzer runs when a window closes)
+STRAGGLER_WINDOW_MS = "tony.straggler.window-ms"
+# fixed bucket count of the gang distribution sketch — the O(buckets)
+# memory bound that replaces O(width x points) trajectories at width 1k
+STRAGGLER_SKETCH_BUCKETS = "tony.straggler.sketch-buckets"
+# closed windows retained for the tasks x windows step-time heatmap
+STRAGGLER_HEATMAP_WINDOWS = "tony.straggler.heatmap-windows"
+# minimum reporting tasks before any skew verdict (a gang of two has no
+# meaningful median)
+STRAGGLER_MIN_TASKS = "tony.straggler.min-tasks"
+# opt-in remediation: a steady-state straggler still lagging after this
+# many consecutive windows is routed through the task-attempt relaunch
+# machinery (attempt-fenced, budget-counted); 0 = detect only
+STRAGGLER_RELAUNCH_AFTER_WINDOWS = "tony.straggler.relaunch-after-windows"
 
 # --- proxy ---------------------------------------------------------------
 # externally reachable base URL of an authenticated tony_tpu.proxy fronting
@@ -219,7 +244,7 @@ RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
-    "profiling", "slo", "logs",
+    "profiling", "slo", "logs", "straggler",
 })
 
 

@@ -121,6 +121,11 @@ AM_INFO_FILE = "am.json"             # {host, rpc_port} in the history dir, so
 PROFILE_REQUEST_FILE = "profile_request.json"  # executor-written, trainer-read
                                      # (heartbeat-piggybacked request_profile)
 PROFILES_DIR_NAME = "profiles"       # trace artifacts: container cwd + history
+SKEW_FILE = "skew.json"              # cross-task skew bundle flushed next to
+                                     # the event log (observability/skew.py):
+                                     # gang sketch summaries, step-time
+                                     # heatmap, latched stragglers +
+                                     # detection log
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------
@@ -178,6 +183,17 @@ TEST_TASK_KILL = "TEST_TASK_KILL"
 # process keeps running — exercises the heartbeat-expiry relaunch path.
 # Format: "type#index#attempt".
 TEST_TASK_HB_SILENCE = "TEST_TASK_HB_SILENCE"
+# steady-state straggler injection: slow EVERY train step of one specific
+# task attempt by a fixed delay (the complement of the startup-only
+# TEST_TASK_EXECUTOR_SKEW above). Format: "type#index#ms[#attempt]";
+# attempt defaults to '*' (every attempt). The executor renders the
+# matching task's delay into its user-process env as
+# TONY_TRAINER_STEP_DELAY_MS; the trainer (and the chaos gang scripts)
+# sleep that long per step.
+TEST_TRAINER_STEP_DELAY = "TEST_TRAINER_STEP_DELAY"
+# the rendered per-process form of the hook above (ms per step; unset or
+# 0 = no delay) — read by the trainer hot loop's test seam
+TRAINER_STEP_DELAY_MS = "TONY_TRAINER_STEP_DELAY_MS"
 # seed for jittered backoff/injection randomness so chaos failures replay
 # exactly (propagates into AM + executor child processes)
 TEST_SEED = "TONY_TEST_SEED"

@@ -118,6 +118,18 @@ def read_goodput_file(history_dir: str) -> dict:
     return out if isinstance(out, dict) else {}
 
 
+def write_skew_file(history_dir: str, skew: dict) -> None:
+    """skew: observability.skew.SkewTracker.bundle's shape — gang sketch
+    summaries per signal, the tasks x windows step-time heatmap, startup
+    values, latched stragglers + detection log."""
+    _write_json_atomic(os.path.join(history_dir, C.SKEW_FILE), skew)
+
+
+def read_skew_file(history_dir: str) -> dict:
+    out = _read_json(os.path.join(history_dir, C.SKEW_FILE), {})
+    return out if isinstance(out, dict) else {}
+
+
 def parse_history_file_name(name: str) -> JobMetadata:
     """Parse either a final or an in-progress history file name back into
     JobMetadata (reference: JobMetadata constructor parsing,

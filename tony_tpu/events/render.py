@@ -72,6 +72,23 @@ def _diagnostics_ready(p: dict) -> str:
             f"{p.get('path', '?')}")
 
 
+def _straggler_detected(p: dict) -> str:
+    return (f"straggler detected: {p.get('task_type', '?')}:"
+            f"{p.get('task_index', '?')} "
+            f"({p.get('phase', '?')} via {p.get('signal', '?')}) — "
+            f"{p.get('value_ms', 0)} ms vs gang median "
+            f"{p.get('gang_median_ms', 0)} ms "
+            f"(z={p.get('z_score', 0)}, "
+            f"{p.get('windows', 0)} consecutive window(s))")
+
+
+def _straggler_cleared(p: dict) -> str:
+    return (f"straggler cleared: {p.get('task_type', '?')}:"
+            f"{p.get('task_index', '?')} "
+            f"({p.get('reason', '') or 'recovered'} after "
+            f"{p.get('windows_lagging', 0)} lagging window(s))")
+
+
 RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.APPLICATION_INITED: _application_inited,
     EventType.APPLICATION_FINISHED: _application_finished,
@@ -82,6 +99,8 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.PROFILE_CAPTURED: _profile_captured,
     EventType.SLO_VIOLATION: _slo_violation,
     EventType.DIAGNOSTICS_READY: _diagnostics_ready,
+    EventType.STRAGGLER_DETECTED: _straggler_detected,
+    EventType.STRAGGLER_CLEARED: _straggler_cleared,
 }
 
 

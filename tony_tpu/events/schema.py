@@ -26,6 +26,8 @@ class EventType(str, enum.Enum):
     PROFILE_CAPTURED = "PROFILE_CAPTURED"
     SLO_VIOLATION = "SLO_VIOLATION"
     DIAGNOSTICS_READY = "DIAGNOSTICS_READY"
+    STRAGGLER_DETECTED = "STRAGGLER_DETECTED"
+    STRAGGLER_CLEARED = "STRAGGLER_CLEARED"
 
 
 @dataclass
@@ -127,6 +129,38 @@ class DiagnosticsReady:
 
 
 @dataclass
+class StragglerDetected:
+    """No reference equivalent: the AM's cross-task skew analyzer
+    (observability/skew.py) latched one task as the gang's straggler —
+    its windowed signal exceeded the gang median by tony.straggler.
+    threshold-pct for tony.straggler.windows consecutive windows. The
+    evidence rides along: which signal, startup vs steady-state phase
+    attribution (goodput-ledger phases), z-score against the gang, and
+    span ids linking into the lifecycle waterfall."""
+    task_type: str
+    task_index: int
+    attempt: int = 0
+    signal: str = ""        # step_time_ms | input_stall_ms | startup_ms
+    phase: str = ""         # "startup" | "steady_state"
+    value_ms: float = 0.0   # the task's windowed mean
+    gang_median_ms: float = 0.0
+    z_score: float = 0.0
+    windows: int = 0        # consecutive lagging windows at latch time
+    span_ids: list[str] = field(default_factory=list)
+
+
+@dataclass
+class StragglerCleared:
+    """The straggler latch for a task released: the task recovered
+    (windowed signal back within the gang band for tony.straggler.windows
+    consecutive windows) or the remediation hook relaunched it."""
+    task_type: str
+    task_index: int
+    reason: str = ""        # "recovered" | "relaunched"
+    windows_lagging: int = 0
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -145,11 +179,14 @@ _PAYLOADS = {
     EventType.PROFILE_CAPTURED: ProfileCaptured,
     EventType.SLO_VIOLATION: SloViolation,
     EventType.DIAGNOSTICS_READY: DiagnosticsReady,
+    EventType.STRAGGLER_DETECTED: StragglerDetected,
+    EventType.STRAGGLER_CLEARED: StragglerCleared,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 TaskFinished, TaskRelaunched, ServingEndpointRegistered,
-                ProfileCaptured, SloViolation, DiagnosticsReady]
+                ProfileCaptured, SloViolation, DiagnosticsReady,
+                StragglerDetected, StragglerCleared]
 
 
 @dataclass

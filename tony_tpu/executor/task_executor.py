@@ -464,6 +464,32 @@ class TaskExecutor:
         except ValueError:
             LOG.error("bad TEST_TASK_EXECUTOR_SKEW spec: %r", spec)
 
+    def _step_delay_if_testing(self, env: dict) -> None:
+        """TEST_TRAINER_STEP_DELAY='type#index#ms[#attempt]': render a
+        per-step delay into THIS task's user-process env — the
+        steady-state straggler injection (the hook above is startup-only;
+        a one-shot sleep before exec can never exercise the windowed
+        skew analyzer). Attempt-scoped like TEST_TASK_KILL, so a
+        relaunch-then-clear chaos case can slow attempt 0 and let the
+        replacement run healthy ('*' matches every attempt)."""
+        spec = os.environ.get(C.TEST_TRAINER_STEP_DELAY)
+        if not spec:
+            return
+        try:
+            parts = spec.split("#")
+            jtype, idx, ms = parts[0], parts[1], parts[2]
+            attempt = parts[3] if len(parts) > 3 else "*"
+            if (jtype != self.job_name or int(idx) != self.task_index
+                    or attempt not in ("*", str(self.task_attempt))):
+                return
+            delay_ms = int(ms)
+        except (ValueError, IndexError):
+            LOG.error("bad TEST_TRAINER_STEP_DELAY spec: %r", spec)
+            return
+        LOG.warning("TEST hook: attempt %d runs with a %d ms per-step "
+                    "delay", self.task_attempt, delay_ms)
+        env[C.TRAINER_STEP_DELAY_MS] = str(delay_ms)
+
     # ------------------------------------------------------------------
     def localize_resources(self) -> None:
         """Materialize staged src/venv/resources into this container's cwd
@@ -549,6 +575,7 @@ class TaskExecutor:
                 if self.tb_port is not None:
                     env[C.TB_PORT] = str(self.tb_port)
                 self._skew_if_testing()
+                self._step_delay_if_testing(env)
                 # hand the reserved port over to the user process right
                 # before exec (TaskExecutor.java:227-235 release-or-keep
                 # logic); re-rendezvous keeps the SAME host:port, the

@@ -107,16 +107,29 @@ class Gauge(_Metric):
 
 
 class Summary(_Metric):
-    """count/sum/max — enough for latency telemetry without histogram
-    bucket bookkeeping; exposed as _count/_sum/_max samples."""
+    """count/sum/max plus bounded p50/p95/p99 via a fixed-width
+    log-bucketed sketch (observability/skew.QuantileSketch — 34 counter
+    cells per summary, never a sample list). Exposed as
+    _count/_sum/_max samples and quantile-labeled gauges; observe() cost
+    is one lock + a handful of float ops, safe on the per-RPC paths."""
 
-    __slots__ = ("count", "sum", "max")
+    __slots__ = ("count", "sum", "max", "sketch")
+
+    # latencies arrive in seconds; the shared ms-domain sketch geometry
+    # would round microsecond RPCs into its underflow cell, so summaries
+    # get their own domain (1 µs .. ~28 h, 32 buckets -> ~±20%/bucket)
+    SKETCH_BUCKETS = 32
+    SKETCH_LO = 1e-6
+    SKETCH_HI = 1e5
 
     def __init__(self, name: str, labels: dict):
         super().__init__(name, labels)
+        from tony_tpu.observability.skew import QuantileSketch
         self.count = 0
         self.sum = 0.0
         self.max = 0.0
+        self.sketch = QuantileSketch(buckets=self.SKETCH_BUCKETS,
+                                     lo=self.SKETCH_LO, hi=self.SKETCH_HI)
 
     def observe(self, v: Number) -> None:
         v = float(v)
@@ -125,6 +138,11 @@ class Summary(_Metric):
             self.sum += v
             if v > self.max:
                 self.max = v
+            self.sketch.add(v)
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self.sketch.quantile(q)
 
 
 class MetricsRegistry:
@@ -184,6 +202,13 @@ class MetricsRegistry:
                     (m.labels, m.sum))
                 fam(m.name + "_max", "gauge")["samples"].append(
                     (m.labels, m.max))
+                if m.count:
+                    # Prometheus summary convention: the base family
+                    # carries quantile-labeled samples
+                    for q in (0.5, 0.95, 0.99):
+                        fam(m.name, "gauge")["samples"].append(
+                            ({**m.labels, "quantile": str(q)},
+                             m.quantile(q)))
         return [by_name[k] for k in sorted(by_name)]
 
     def snapshot(self) -> dict:

@@ -223,6 +223,12 @@ class PortalCache:
         observability/perf.aggregate_goodput); goodput.json sidecar."""
         return self._get_sidecar(job_id, C.GOODPUT_FILE, {})
 
+    def get_skew(self, job_id: str) -> dict[str, Any]:
+        """Cross-task skew bundle (skew.json sidecar): gang sketch
+        summaries per signal, the tasks x windows step-time heatmap,
+        latched stragglers + detection log. {} for old jobs."""
+        return self._get_sidecar(job_id, C.SKEW_FILE, {})
+
     def get_diagnostics(self, job_id: str) -> dict[str, Any]:
         """Root-cause bundle a failed job's AM flushed
         (diagnostics.json sidecar): first-failing task, exit signal,

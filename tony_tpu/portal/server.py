@@ -204,6 +204,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(self.cache.get_goodput(job_id))
             if what == "diagnostics":
                 return self._json(self.cache.get_diagnostics(job_id))
+            if what == "skew":
+                # RUNNING job: live bundle from the AM (same plumbing as
+                # the log/profile proxies); else — or when the AM is
+                # unreachable — the skew.json the AM flushed at finish
+                return self._json(self._skew_bundle(
+                    job_id, md.status == "RUNNING"))
         if len(parts) == 4 and parts[0] == "jobs" and parts[2] == "logs":
             # /api/jobs/:id/logs/:task[?stream=&offset=&max_bytes=&follow]
             # — one bounded chunk; followers poll with the returned
@@ -278,6 +284,32 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(chunk)
         self._json({"error": f"no logs available for {task} ({stream})"},
                    404)
+
+    def _skew_bundle(self, job_id: str, running: bool) -> dict:
+        """Live-then-history skew bundle: a RUNNING job's bundle comes
+        from its AM's get_skew RPC (address from am.json, like the log
+        and profile proxies); anything else falls back to the skew.json
+        sidecar. Degrades silently — skew must never 500 a job page."""
+        am = self.cache.get_am_info(job_id) if running else {}
+        if running and am.get("host") and am.get("rpc_port") \
+                and not am.get("security_enabled"):
+            from tony_tpu.rpc.client import ClusterServiceClient
+            client = ClusterServiceClient(str(am["host"]),
+                                          int(am["rpc_port"]))
+            try:
+                bundle = client.get_skew()
+                if isinstance(bundle, dict) and not bundle.get("error"):
+                    bundle["source"] = "live"
+                    return bundle
+            except Exception:  # noqa: BLE001 — degrade to the sidecar
+                LOG.debug("live skew proxy to the AM failed", exc_info=True)
+            finally:
+                client.close()
+        bundle = self.cache.get_skew(job_id)
+        if bundle:
+            bundle = dict(bundle)
+            bundle["source"] = "history"
+        return bundle
 
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
         """POST /api/jobs/:id/profile — forward an on-demand profiler
@@ -372,6 +404,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._html(f"events — {job_id}",
                    self._diagnostics_html(job_id)
                    + self._serving_endpoints_html(job_id, events)
+                   + self._skew_html(job_id)
                    + self._goodput_html(job_id)
                    + self._waterfall_html(job_id)
                    + _table(["Time", "Event", "Summary", "Payload"], rows))
@@ -436,6 +469,72 @@ class _Handler(BaseHTTPRequestHandler):
         "relaunch_downtime": "#cc0000", "init": "#cccccc",
         "idle": "#efefef",
     }
+
+    def _skew_html(self, job_id: str) -> str:
+        """Cross-task skew panel: top-k outliers (latched stragglers
+        first, then the worst last-window step times) + the tasks x
+        windows step-time heatmap — cell shade = that task's windowed
+        mean relative to the gang's worst. Sidecar-only, like every
+        sibling panel: the page render must never block on a live AM RPC
+        (a wedged AM would hold a handler thread for the full deadline)
+        — live bundles are the /api/jobs/:id/skew endpoint's job. Empty
+        string for jobs with no skew bundle (pre-skew history, gangs
+        below min-tasks)."""
+        bundle = self.cache.get_skew(job_id)
+        heatmap = (bundle or {}).get("heatmap") or {}
+        tasks = heatmap.get("tasks") or {}
+        stragglers = (bundle or {}).get("stragglers") or []
+        if not tasks and not stragglers:
+            return ""
+        out = ["<h3>Cross-task skew</h3>"]
+        if stragglers:
+            rows = [[html.escape(str(s.get("task_id", "?"))),
+                     html.escape(str(s.get("phase", "?"))),
+                     html.escape(str(s.get("signal", "?"))),
+                     f"{s.get('value_ms', 0)} ms",
+                     f"{s.get('gang_median_ms', 0)} ms",
+                     str(s.get("z_score", 0)),
+                     str(s.get("windows", 0))]
+                    for s in stragglers]
+            out.append("<p><b>latched stragglers</b></p>")
+            out.append(_table(["Task", "Phase", "Signal", "Windowed",
+                               "Gang median", "z", "Windows"], rows))
+        if tasks:
+            peak = max((v for row in tasks.values() for v in row
+                        if isinstance(v, (int, float))), default=0.0)
+            # top-k outliers by last reported window
+            last_vals = []
+            for tid, row in tasks.items():
+                vals = [v for v in row if isinstance(v, (int, float))]
+                if vals:
+                    last_vals.append((vals[-1], tid))
+            last_vals.sort(reverse=True)
+            if last_vals:
+                top = ", ".join(f"{html.escape(t)} ({v:.1f} ms)"
+                                for v, t in last_vals[:5])
+                out.append(f"<p>slowest last window: {top}</p>")
+            cells_rows = []
+            for tid in sorted(tasks):
+                cells = []
+                for v in tasks[tid]:
+                    if not isinstance(v, (int, float)) or peak <= 0:
+                        cells.append(
+                            '<td style="background:#f5f5f5">&nbsp;</td>')
+                        continue
+                    # white → red ramp on the gang's worst windowed mean
+                    frac = max(0.0, min(1.0, v / peak))
+                    g = int(255 - 180 * frac)
+                    cells.append(
+                        f'<td style="background:rgb(255,{g},{g});'
+                        f'min-width:14px" title="{v:.1f} ms">&nbsp;</td>')
+                cells_rows.append(
+                    f"<tr><td>{html.escape(tid)}</td>"
+                    + "".join(cells) + "</tr>")
+            out.append(
+                '<p>step-time heatmap (tasks &times; windows, darker = '
+                'slower)</p><table border="0" cellspacing="1">'
+                + "".join(cells_rows) + "</table>")
+        return "".join(out)
 
     def _goodput_html(self, job_id: str) -> str:
         """Stacked time-accounting bar per task (the goodput.json ledger)

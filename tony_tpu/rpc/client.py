@@ -233,6 +233,14 @@ class ClusterServiceClient(_JsonRpcClient):
                          {"task_id": task_id, "num_steps": num_steps},
                          retries=1, timeout_sec=10.0, wait_for_ready=False)
 
+    def get_skew(self) -> dict:
+        """The AM's live cross-task skew bundle (observability/skew.py)
+        — gang quantiles, step-time heatmap, latched stragglers.
+        Operator plane: the portal's /api/jobs/:id/skew proxy and the
+        CLI's live view poll this."""
+        return self.call("get_skew", {}, retries=1, timeout_sec=10.0,
+                         wait_for_ready=False)
+
     def read_task_logs(self, task_id: str = "", stream: str = "stderr",
                        offset: int = -1, max_bytes: int = 0) -> dict:
         """One bounded log chunk for a task (live when running, from

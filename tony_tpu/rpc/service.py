@@ -44,6 +44,7 @@ CLUSTER_METHODS = (
     "task_executor_heartbeat",
     "request_profile",
     "read_task_logs",
+    "get_skew",
 )
 METRICS_METHODS = ("update_metrics",)
 TASK_LOG_METHODS = ("read_log",)
@@ -118,6 +119,15 @@ class ClusterServiceHandler(abc.ABC):
         (size - tony.logs.tail-bytes); callers pass next_offset back to
         follow. Chunk size is capped server-side at
         tony.logs.chunk-bytes regardless of max_bytes."""
+
+    @abc.abstractmethod
+    def get_skew(self, req: dict) -> dict:
+        """Operator/client plane: req {} -> the live cross-task skew
+        bundle (observability/skew.py SkewTracker.bundle): gang sketch
+        summaries per signal, the tasks x windows step-time heatmap,
+        startup values, latched stragglers + the detection log. The
+        portal's /api/jobs/:id/skew proxies this for RUNNING jobs; the
+        same shape is flushed to history as skew.json at finish."""
 
     @abc.abstractmethod
     def request_profile(self, req: dict) -> dict:

@@ -171,6 +171,11 @@ class Trainer:
             publish=self._metrics_reporter.report_profile_done)
         self._tokens_per_batch = getattr(self, "_tokens_per_batch", 0)
         self._last_stall_s = 0.0
+        # chaos seam (TEST_TRAINER_STEP_DELAY, rendered per-task by the
+        # executor): a fixed per-step host sleep that turns this task
+        # into a steady-state straggler for the AM's skew analyzer
+        self._test_step_delay_s = float(
+            os.environ.get(C.TRAINER_STEP_DELAY_MS, "0") or 0) / 1000.0
         self.mesh = mesh_from_env()
         LOG.info("mesh: %s over %d devices", dict(self.mesh.shape),
                  self.mesh.devices.size)
@@ -441,6 +446,10 @@ class Trainer:
                     self.params, self.opt_state, loss = self.train_step(
                         self.params, self.opt_state, batch)
                     self.step += 1
+                    if getattr(self, "_test_step_delay_s", 0.0):
+                        # compiled-in fault injection, like the executor's
+                        # TEST_* hooks — zero cost when unset
+                        time.sleep(self._test_step_delay_s)
                     if profile is not None and profile.active:
                         profile.on_step()
                     if not self._tokens_per_batch:
