@@ -1,0 +1,22 @@
+"""Fixture task for the fleet e2e: stay alive long enough for the AM's
+jobstate publisher to land a RUNNING entry in the shared staging store,
+pushing a goodput ledger + MFU gauge so the fleet summary carries real
+job-level numbers. Sleep length via FLEET_TASK_SLEEP_SEC."""
+import os
+import time
+
+from tony_tpu.observability.perf import GoodputLedger
+from tony_tpu.train.metrics import TpuMetricsReporter
+
+sleep_sec = float(os.environ.get("FLEET_TASK_SLEEP_SEC", "2"))
+ledger = GoodputLedger.from_env(os.environ)
+reporter = TpuMetricsReporter()
+
+ledger.transition("train_step")
+deadline = time.monotonic() + sleep_sec
+while time.monotonic() < deadline:
+    reporter.report(extra=ledger.metrics()
+                    + [{"name": "TRAIN_MFU_PCT", "value": 33.3}])
+    time.sleep(0.2)
+reporter.report(extra=ledger.metrics())
+reporter.close(timeout=10)

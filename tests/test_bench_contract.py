@@ -31,6 +31,8 @@ def _isolated_bench_paths(tmp_path, monkeypatch):
                         str(tools / "bench_diag.log"))
     monkeypatch.setattr(bench, "_HEAD_PARTIAL_AUTO_PATH",
                         str(tools / "bench_head_partial_auto.json"))
+    monkeypatch.setattr(bench, "_HISTORY_PATH",
+                        str(tools / "bench_history.jsonl"))
     monkeypatch.setattr(bench, "_commit_stamp", lambda: "testhead")
     yield tools
 
@@ -262,6 +264,40 @@ def test_compact_last_good_keeps_headline_only():
     assert out["value"] == 68.08 and out["commit"] == "abc"
     assert "llama3_8b_layer_step_ms" not in out
     assert len(json.dumps(out)) < 300
+
+
+def test_history_append_and_regression_verdict(_isolated_bench_paths,
+                                               capsys):
+    """Self-defending bench: every _emit appends a commit-stamped line
+    to bench_history.jsonl, and bench_compare flags a >2% drop vs the
+    best same-backend baseline (value<=0 fallback markers are skipped
+    both as baseline and as the judged entry)."""
+    from tools.bench_compare import compare, load_history
+    good = {"metric": bench.METRIC, "value": 68.08, "unit": "%MFU",
+            "vs_baseline": 1.702, "device": "TPU v5 lite"}
+    wedged = {"metric": bench.METRIC, "value": 0.0, "unit": "%MFU",
+              "vs_baseline": 0.0, "backend": "tpu"}
+    bad = {"metric": bench.METRIC, "value": 60.0, "unit": "%MFU",
+           "vs_baseline": 1.5, "device": "TPU v5 lite"}
+    for r in (good, wedged, bad):
+        bench._emit(r)
+    capsys.readouterr()
+    entries = load_history(str(_isolated_bench_paths
+                               / "bench_history.jsonl"))
+    assert len(entries) == 3
+    assert all(e["commit"] == "testhead" for e in entries)
+    verdicts = compare(entries, threshold_pct=2.0)
+    assert len(verdicts) == 1          # one (metric, backend) group
+    v = verdicts[0]
+    assert v["backend"] == "tpu" and v["regression"] is True
+    assert v["baseline"] == 68.08 and v["value"] == 60.0
+    # within threshold → no regression
+    ok = compare([good, dict(good, value=67.5)], threshold_pct=2.0)
+    assert ok[0]["regression"] is False
+    # lower-is-better units judge in the other direction
+    lat = [{"metric": "p99", "value": 1.0, "unit": "s", "backend": "cpu"},
+           {"metric": "p99", "value": 1.5, "unit": "s", "backend": "cpu"}]
+    assert compare(lat, threshold_pct=2.0)[0]["regression"] is True
 
 
 if __name__ == "__main__":

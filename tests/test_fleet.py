@@ -1,0 +1,574 @@
+"""Fleet observability (PR 8): cross-job registry, chip-hour accounting,
+cluster portal/CLI surfaces.
+
+Unit layer: the fleet.py registry/ledger state machines with fake
+clocks + synthetic stores (staleness → LOST, boundedness at 1k job
+summaries, chip-second math against the conf/queues.py quota math,
+prometheus round-trip with {app_id, queue, user} labels). Static layer:
+every `tony_job_*` gauge literal the AM exports must be a key of
+fleet.JOB_GAUGES — the fleet re-exposition can never silently drop a
+job gauge. E2e layer: two concurrent mini-cluster apps in distinct
+queues visible live on /api/fleet with correct per-queue attribution,
+and an AM killed -9 whose entry goes LOST yet still lands in the final
+accounting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.observability import fleet
+from tony_tpu.storage import location_store, staging_store
+
+pytestmark = pytest.mark.fleet
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+def script(name: str) -> str:
+    return os.path.join(SCRIPTS, name)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, s: float) -> None:
+        self.t += s
+
+
+def summary(app_id: str, state: str = "RUNNING", queue: str = "default",
+            user: str = "alice", chips: int = 4, hb_ms: int = 0,
+            started_ms: int = 0, **kw) -> dict:
+    return fleet.job_summary(
+        app_id, user, queue, state, gang_width=2, requested_chips=chips,
+        started_ms=started_ms, heartbeat_ms=hb_ms, **kw)
+
+
+# ---------------------------------------------------------------------------
+# registry units
+# ---------------------------------------------------------------------------
+
+def test_registry_demotes_stale_running_to_lost():
+    clock = FakeClock(1000.0)
+    reg = fleet.FleetRegistry(stale_after_ms=2000, clock=clock)
+    reg.observe(summary("app_a", hb_ms=1_000_000))
+    assert reg.jobs()[0]["state"] == "RUNNING"
+    clock.tick(1.0)                       # inside stale-after
+    reg.refresh(force=True)
+    assert reg.jobs()[0]["state"] == "RUNNING"
+    clock.tick(2.0)                       # past it
+    reg.refresh(force=True)
+    job = reg.jobs()[0]
+    assert job["state"] == fleet.LOST_STATE
+    assert job["demoted_ms"] == int(clock() * 1000)
+
+
+def test_registry_terminal_state_never_regresses():
+    clock = FakeClock()
+    reg = fleet.FleetRegistry(clock=clock)
+    reg.observe(summary("app_a", state="SUCCEEDED", hb_ms=2_000_000))
+    # a stale RUNNING file listed after the terminal entry must not
+    # resurrect the job — nor may an older heartbeat overwrite a newer
+    reg.observe(summary("app_a", state="RUNNING", hb_ms=3_000_000))
+    reg.observe(summary("app_a", state="SUCCEEDED", hb_ms=1_000_000))
+    assert reg.jobs()[0]["state"] == "SUCCEEDED"
+    assert reg.jobs()[0]["heartbeat_ms"] == 2_000_000
+
+
+def test_registry_and_ledger_bounded_at_1k_summaries():
+    """Acceptance: memory stays bounded when 1k synthetic job summaries
+    flow through — the registry caps entries (non-live evicted oldest
+    first), the ledger caps per-job entries while folding evictions
+    into the rollups so chip-hours are conserved."""
+    clock = FakeClock()
+    reg = fleet.FleetRegistry(stale_after_ms=10_000, max_jobs=64,
+                              clock=clock)
+    ledger = fleet.FleetLedger(history_jobs=32, clock=clock)
+    total_chip_s = 0.0
+    for i in range(1000):
+        state = "SUCCEEDED" if i % 2 else "RUNNING"
+        s = summary(f"app_{i:04d}", state=state, queue=f"q{i % 3}",
+                    user=f"u{i % 5}", chips=2,
+                    started_ms=i * 1000, hb_ms=i * 1000 + 10_000)
+        reg.observe(s)
+        entry = ledger.fold(s)
+        if entry is not None:
+            total_chip_s += entry["chip_seconds"]
+    assert len(reg) <= 64
+    assert len(ledger) <= 32
+    acct = ledger.accounting()
+    assert acct["folded_jobs"] == 500 - 32
+    accounted = sum(b["chip_seconds"] for b in acct["queues"].values())
+    assert accounted == pytest.approx(total_chip_s)
+    # users rollup conserves the same total
+    assert sum(b["chip_seconds"] for b in acct["users"].values()) == \
+        pytest.approx(total_chip_s)
+    # timeline is a decimating ring buffer, not an unbounded list
+    reg.refresh(force=True)
+    assert len(reg.timeline()) <= 257
+
+
+def test_sort_jobs_state_then_start_time():
+    jobs = [summary("a", state="SUCCEEDED", started_ms=50),
+            summary("b", state="RUNNING", started_ms=10),
+            summary("c", state="RUNNING", started_ms=20),
+            summary("d", state=fleet.LOST_STATE, started_ms=99)]
+    order = [j["app_id"] for j in fleet.sort_jobs(jobs)]
+    assert order == ["c", "b", "d", "a"]
+
+
+# ---------------------------------------------------------------------------
+# ledger + quota math
+# ---------------------------------------------------------------------------
+
+def test_ledger_chip_second_math_prefers_final_goodput():
+    clock = FakeClock()
+    ledger = fleet.FleetLedger(clock=clock)
+    s = summary("app_x", state="SUCCEEDED", queue="qa", user="bob",
+                chips=4, started_ms=1000, hb_ms=101_000, goodput_pct=50.0)
+    entry = ledger.fold(s, goodput={"job": {"goodput_pct": 75.0}})
+    assert entry["chip_seconds"] == pytest.approx(4 * 100.0)
+    # the published goodput.json bundle wins over the live-pushed pct
+    assert entry["productive_chip_seconds"] == pytest.approx(300.0)
+    assert entry["overhead_chip_seconds"] == pytest.approx(100.0)
+    # idempotent per app_id
+    assert ledger.fold(s) is None
+    acct = ledger.accounting()
+    assert acct["queues"]["qa"]["chip_hours"] == pytest.approx(400 / 3600,
+                                                               abs=1e-4)
+    assert acct["users"]["bob"]["jobs"] == 1
+
+
+def test_ledger_refolds_lost_job_on_real_terminal(tmp_path):
+    """A job provisionally folded as LOST (stalled publisher, portal
+    demoted it) whose AM turns out alive and later publishes a real
+    terminal state is re-accounted at its true extent — the 30-second
+    stale snapshot must not stand in for hours of chip-time."""
+    ledger = fleet.FleetLedger()
+    lost = summary("app_r", state=fleet.LOST_STATE, queue="qa",
+                   chips=4, started_ms=1000, hb_ms=41_000)
+    assert ledger.fold(lost)["chip_seconds"] == pytest.approx(160.0)
+    done = summary("app_r", state="SUCCEEDED", queue="qa", chips=4,
+                   started_ms=1000, hb_ms=3_601_000, goodput_pct=90.0)
+    assert ledger.should_fold(done)
+    entry = ledger.fold(done)
+    assert entry["state"] == "SUCCEEDED"
+    assert entry["chip_seconds"] == pytest.approx(4 * 3600.0)
+    # exactly one entry; totals reflect the replacement, not the sum
+    acct = ledger.accounting()
+    assert acct["queues"]["qa"]["jobs"] == 1
+    assert acct["queues"]["qa"]["chip_seconds"] == pytest.approx(14400.0)
+    # a second SUCCEEDED publish stays idempotent
+    assert not ledger.should_fold(done)
+    assert ledger.fold(done) is None
+
+
+def test_ledger_unfolds_evicted_lost_ghost_without_double_count():
+    """Even after the provisional LOST entry was evicted into the
+    rollup accumulators, the real terminal state un-folds the stale
+    extent first — totals stay conserved, never double-counted."""
+    ledger = fleet.FleetLedger(history_jobs=1)
+    lost = summary("app_g", state=fleet.LOST_STATE, queue="qa",
+                   chips=2, started_ms=1000, hb_ms=31_000)
+    ledger.fold(lost)
+    # a second fold with a NEWER end evicts app_g (oldest-ended first)
+    # into the rollup accumulators (history_jobs=1)
+    ledger.fold(summary("app_other", state="SUCCEEDED", queue="qa",
+                        chips=2, started_ms=1000, hb_ms=41_000))
+    assert not ledger.has("app_g")
+    done = summary("app_g", state="SUCCEEDED", queue="qa", chips=2,
+                   started_ms=1000, hb_ms=3_601_000)
+    assert ledger.should_fold(done)
+    ledger.fold(done)
+    acct = ledger.accounting()
+    # 2 chips × 3600s (app_g, true extent) + 2 × 40s (app_other) —
+    # the 60 chip-seconds of the stale LOST snapshot are gone
+    assert acct["queues"]["qa"]["chip_seconds"] == pytest.approx(7280.0)
+    assert acct["queues"]["qa"]["jobs"] == 2
+
+
+def test_refresh_skips_settled_terminal_jobstate_files(tmp_path,
+                                                       monkeypatch):
+    """A non-LOST terminal jobstate file is immutable; the scan reads
+    it once and never again (on GCS every read is a subprocess), while
+    a LOST entry stays hot so a resurrected AM's republish is seen."""
+    staging = str(tmp_path / "staging")
+    for app, state in (("app_s", "SUCCEEDED"), ("app_l", "RUNNING")):
+        store = staging_store(staging, str(tmp_path / "apps" / app))
+        fleet.publish_job_state(
+            store, summary(app, state=state, hb_ms=1_000), str(tmp_path))
+    reg = fleet.FleetRegistry(staging, refresh_interval_ms=0,
+                              stale_after_ms=1)   # RUNNING → LOST fast
+    reads = []
+    orig = fleet._read_json_key
+    monkeypatch.setattr(
+        fleet, "_read_json_key",
+        lambda store, key: (reads.append(key), orig(store, key))[1])
+    reg.refresh(force=True)
+    states = {j["app_id"]: j["state"] for j in reg.jobs()}
+    assert states["app_s"] == "SUCCEEDED"
+    assert states["app_l"] == fleet.LOST_STATE
+    first = reads.count(f"app_s/{fleet.JOBSTATE_KEY}")
+    assert first == 1
+    reg.refresh(force=True)
+    reg.refresh(force=True)
+    # settled file: no further reads; the LOST one is re-read each pass
+    assert reads.count(f"app_s/{fleet.JOBSTATE_KEY}") == first
+    assert reads.count(f"app_l/{fleet.JOBSTATE_KEY}") == 3
+
+
+def test_ledger_durable_roundtrip(tmp_path):
+    loc = str(tmp_path / "store")
+    ledger = fleet.FleetLedger(loc)
+    ledger.fold(summary("app_d", state="FAILED", queue="qz", user="eve",
+                        chips=2, started_ms=1000, hb_ms=31_000))
+    ledger.save()
+    assert os.path.isfile(os.path.join(loc, fleet.ACCOUNTING_KEY))
+    reborn = fleet.FleetLedger(loc)
+    assert reborn.has("app_d")
+    assert reborn.accounting()["queues"]["qz"]["chip_seconds"] == \
+        pytest.approx(60.0)
+
+
+def test_quota_utilization_matches_queue_conf_math():
+    """The portal's quota bars and conf/queues.py must agree: a queue at
+    exactly its max-tpus reads 100%."""
+    from tony_tpu.conf.queues import configured_queues
+    conf = TonyConfiguration()
+    conf.set("tony.queues.qa.max-tpus", 8, "test")
+    conf.set("tony.queues.qb.max-tpus", 4, "test")
+    queues = configured_queues(conf)
+    live = [summary("a1", queue="qa", chips=4),
+            summary("a2", queue="qa", chips=4),
+            summary("a3", queue="qb", chips=2),
+            summary("a4", queue="undeclared", chips=1)]
+    util = fleet.quota_utilization(queues, live)
+    assert util["qa"] == {"max_tpus": 8, "chips_in_use": 8,
+                          "live_jobs": 2, "utilization_pct": 100.0}
+    assert util["qb"]["utilization_pct"] == 50.0
+    assert util["undeclared"]["max_tpus"] == 0
+    assert "utilization_pct" not in util["undeclared"]
+
+
+def test_chips_of_prefers_allocation_over_ask():
+    s = summary("a", chips=8)
+    assert fleet.chips_of(s) == 8
+    s["allocated_chips"] = 6
+    assert fleet.chips_of(s) == 6
+
+
+# ---------------------------------------------------------------------------
+# prometheus re-exposition
+# ---------------------------------------------------------------------------
+
+def test_fleet_families_roundtrip_with_labels():
+    """Acceptance: the fleet /metrics payload round-trips through the
+    shared prometheus parser and every job gauge carries the
+    {app_id, queue, user} label set."""
+    from tony_tpu.observability.prometheus import get_sample, parse, render
+    live = [summary("app_1", queue="qa", user="alice",
+                    gauges={"tony_job_goodput_pct": 81.5,
+                            "tony_job_straggler_count": 1.0}),
+            summary("app_2", queue="qb", user="bob",
+                    gauges={"tony_job_goodput_pct": 40.0})]
+    text = render(fleet.fleet_families(live, queues={"qa": 8, "qb": 8}))
+    parsed = parse(text)
+    assert get_sample(parsed, "tony_job_goodput_pct",
+                      app_id="app_1", queue="qa", user="alice") == 81.5
+    assert get_sample(parsed, "tony_job_goodput_pct",
+                      app_id="app_2", queue="qb", user="bob") == 40.0
+    assert get_sample(parsed, "tony_job_straggler_count",
+                      app_id="app_1") == 1.0
+    assert get_sample(parsed, "tony_fleet_live_jobs") == 2.0
+    assert get_sample(parsed, "tony_fleet_chips_in_use") == 8.0
+    assert get_sample(parsed, "tony_fleet_queue_quota_tpus",
+                      queue="qa") == 8.0
+
+
+# ---------------------------------------------------------------------------
+# tier-1 static check: the AM's job gauges vs fleet's aggregation map
+# ---------------------------------------------------------------------------
+
+def test_every_am_job_gauge_is_in_the_fleet_aggregation_map():
+    """Every `tony_job_*` gauge name the AM source mentions must be a
+    key of fleet.JOB_GAUGES — otherwise the fleet /metrics silently
+    drops it from the cross-job view. Interpolated names (f-strings)
+    are rejected outright: job gauges must be literal, registered
+    names (fleet.STEP_TIME_GAUGES exists for exactly this reason)."""
+    am_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tony_tpu", "am",
+        "application_master.py")
+    with open(am_path, "r", encoding="utf-8") as f:
+        source = f.read()
+    names = set(re.findall(r"tony_job_[a-z0-9_{}]+", source))
+    interpolated = sorted(n for n in names if "{" in n)
+    assert not interpolated, (
+        "f-string-assembled job gauge names in the AM — register a "
+        f"literal name in fleet.JOB_GAUGES instead: {interpolated}")
+    missing = sorted(names - set(fleet.JOB_GAUGES))
+    assert not missing, (
+        "tony_job_* gauges the AM exports but fleet.JOB_GAUGES does not "
+        f"aggregate (the fleet /metrics would drop them): {missing}")
+    # ...and the step-time helper map stays consistent with it
+    assert set(fleet.STEP_TIME_GAUGES.values()) <= set(fleet.JOB_GAUGES)
+
+
+# ---------------------------------------------------------------------------
+# e2e: real apps on the local backend, shared staging store
+# ---------------------------------------------------------------------------
+
+def _fleet_conf(tmp_path, staging: str, queue: str,
+                **overrides) -> TonyConfiguration:
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_WORKDIR, str(tmp_path / "work"), "test")
+    conf.set(K.AM_MONITOR_INTERVAL_MS, 100, "test")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "test")
+    conf.set(K.TASK_METRICS_INTERVAL_MS, 300, "test")
+    conf.set(K.TASK_REGISTRATION_TIMEOUT_SEC, 60, "test")
+    conf.set(K.CONTAINER_ALLOCATION_TIMEOUT, 60_000, "test")
+    conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 3000, "test")
+    conf.set(K.STAGING_LOCATION, staging, "test")
+    conf.set(K.FLEET_PUBLISH_INTERVAL_MS, 200, "test")
+    conf.set(K.APPLICATION_QUEUE, queue, "test")
+    conf.set("tony.queues.qa.max-tpus", 4, "test")
+    conf.set("tony.queues.qb.max-tpus", 8, "test")
+    for k, v in overrides.items():
+        conf.set(k, v, "test")
+    return conf
+
+
+def _get_json(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_two_concurrent_jobs_visible_and_accounted(tmp_path):
+    """Acceptance: two concurrent mini-cluster apps in distinct queues
+    → /api/fleet shows both live with correct queue/user attribution
+    and quota bars matching the queues.py math; the fleet /metrics
+    round-trips through the shared prometheus parser with
+    {app_id,queue,user} labels; after completion the chip-hours land
+    in fleet/accounting.json under the right queue and user."""
+    from tony_tpu.client.tony_client import TonyClient
+    from tony_tpu.conf.queues import configured_queues
+    from tony_tpu.observability.prometheus import get_sample, parse
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.server import PortalServer
+
+    staging = str(tmp_path / "staging")
+    clients, threads, results = [], [], {}
+    for i, queue in enumerate(("qa", "qb")):
+        conf = _fleet_conf(tmp_path, staging, queue)
+        client = TonyClient(conf)
+        client.init(["--executes", script("fleet_task.py"),
+                     "--conf", "tony.worker.instances=1",
+                     "--conf", "tony.worker.tpus=2",
+                     "--shell_env", "FLEET_TASK_SLEEP_SEC=4"])
+        clients.append(client)
+
+        def _run(c=client, q=queue):
+            results[q] = c.run()
+
+        threads.append(threading.Thread(target=_run, daemon=True))
+    view = fleet.FleetView(
+        staging,
+        queues=configured_queues(_fleet_conf(tmp_path, staging, "qa")),
+        stale_after_ms=30_000, refresh_interval_ms=100)
+    cache = PortalCache(str(tmp_path / "int"), str(tmp_path / "fin"))
+    portal = PortalServer(cache, port=0, fleet=view)
+    portal.start()
+    base = f"http://127.0.0.1:{portal.port}"
+    try:
+        for t in threads:
+            t.start()
+        # ...until both jobs are live on /api/fleet
+        deadline = time.monotonic() + 60
+        live_by_queue = {}
+        while time.monotonic() < deadline:
+            payload = _get_json(f"{base}/api/fleet")
+            live_by_queue = {j["queue"]: j for j in payload["jobs"]
+                             if j["state"] == "RUNNING"}
+            if {"qa", "qb"} <= set(live_by_queue):
+                break
+            time.sleep(0.1)
+        assert {"qa", "qb"} <= set(live_by_queue), payload
+        for queue, job in live_by_queue.items():
+            assert job["gang_width"] == 1
+            assert fleet.chips_of(job) == 2
+            assert job["user"]            # stamped with the submitter
+        # quota bars match the queues.py math: 2 of 4 / 2 of 8
+        queues_payload = _get_json(f"{base}/api/fleet/queues")["queues"]
+        assert queues_payload["qa"]["chips_in_use"] == 2
+        assert queues_payload["qa"]["utilization_pct"] == 50.0
+        assert queues_payload["qb"]["utilization_pct"] == 25.0
+        # fleet /metrics: shared-encoder round-trip with full labels
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            parsed = parse(r.read().decode())
+        for queue, job in live_by_queue.items():
+            get_sample(parsed, "tony_job_goodput_pct",
+                       app_id=job["app_id"], queue=queue,
+                       user=job["user"])
+        assert get_sample(parsed, "tony_fleet_chips_in_use") == 4.0
+        # index page renders the cluster panels + bounded directory
+        with urllib.request.urlopen(f"{base}/", timeout=10) as r:
+            page = r.read().decode()
+        assert "fleet registry" in page and "showing" in page
+    finally:
+        for t in threads:
+            t.join(timeout=120)
+        portal.stop()
+    assert results == {"qa": True, "qb": True}, \
+        [c.final_message for c in clients]
+    # terminal states replace the live entries; accounting settles
+    view.refresh(force=True)
+    states = {j["queue"]: j["state"] for j in view.registry.jobs()}
+    assert states == {"qa": "SUCCEEDED", "qb": "SUCCEEDED"}
+    acct = view.ledger.accounting()
+    by_queue = acct["queues"]
+    assert by_queue["qa"]["jobs"] == 1 and by_queue["qb"]["jobs"] == 1
+    for q in ("qa", "qb"):
+        assert by_queue[q]["chip_seconds"] > 0
+        # the fleet_task pushed a real train_step ledger: some of the
+        # chip-seconds are attributed productive
+        assert by_queue[q]["productive_chip_seconds"] > 0
+    import getpass
+    assert acct["users"][getpass.getuser()]["jobs"] == 2
+    # durable: the accounting file exists in the store and reloads
+    assert os.path.isfile(os.path.join(staging, fleet.ACCOUNTING_KEY))
+    reborn = fleet.FleetLedger(staging)
+    assert len(reborn) == 2
+
+
+@pytest.mark.chaos
+def test_am_killed_minus9_goes_lost_then_accounted(tmp_path):
+    """Acceptance: an AM killed -9 mid-run never publishes a terminal
+    jobstate — its registry entry is demoted to LOST once the heartbeat
+    stamp ages past tony.fleet.stale-after-ms, and the ledger still
+    folds its chip-hours at the last known extent."""
+    import signal
+
+    from tony_tpu.client.tony_client import TonyClient
+
+    staging = str(tmp_path / "staging")
+    conf = _fleet_conf(tmp_path, staging, "qa")
+    client = TonyClient(conf)
+    client.init(["--executes", script("fleet_task.py"),
+                 "--conf", "tony.worker.instances=1",
+                 "--conf", "tony.worker.tpus=2",
+                 "--shell_env", "FLEET_TASK_SLEEP_SEC=30"])
+    done = {}
+    t = threading.Thread(target=lambda: done.update(ok=client.run()),
+                         daemon=True)
+    t.start()
+    view = fleet.FleetView(staging, stale_after_ms=1200,
+                           refresh_interval_ms=100)
+    try:
+        deadline = time.monotonic() + 60
+        seen_running = False
+        while time.monotonic() < deadline and not seen_running:
+            view.refresh(force=True)
+            jobs = view.registry.jobs()
+            seen_running = any(j["state"] == "RUNNING" for j in jobs)
+            time.sleep(0.1)
+        assert seen_running, "job never appeared live in the registry"
+        # kill the AM's whole process group — no terminal publish
+        os.killpg(os.getpgid(client._am_proc.pid), signal.SIGKILL)
+        t.join(timeout=60)
+        assert done.get("ok") is False
+        deadline = time.monotonic() + 30
+        lost = None
+        while time.monotonic() < deadline and lost is None:
+            view.refresh(force=True)
+            jobs = view.registry.jobs()
+            lost = next((j for j in jobs
+                         if j["state"] == fleet.LOST_STATE), None)
+            time.sleep(0.2)
+        assert lost is not None, view.registry.jobs()
+        # ...and the final accounting still lands
+        acct = view.ledger.accounting()
+        entry = acct["jobs"].get(lost["app_id"])
+        assert entry is not None and entry["state"] == fleet.LOST_STATE
+        assert entry["queue"] == "qa"
+        assert entry["chip_seconds"] > 0
+    finally:
+        client.cleanup()
+        t.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# portal index bound + cli top (file level, no live apps)
+# ---------------------------------------------------------------------------
+
+def _fake_history(tmp_path, n: int) -> tuple[str, str]:
+    intermediate = str(tmp_path / "int")
+    finished = str(tmp_path / "fin")
+    os.makedirs(intermediate, exist_ok=True)
+    for i in range(n):
+        d = os.path.join(intermediate, f"app_{i:03d}")
+        os.makedirs(d, exist_ok=True)
+        name = f"app_{i:03d}-{1000 + i}-{2000 + i}-alice-SUCCEEDED.jhist"
+        with open(os.path.join(d, name), "w", encoding="utf-8") as f:
+            f.write("[]")
+    return intermediate, finished
+
+
+def test_index_is_bounded_with_count_footer(tmp_path):
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.server import PortalServer
+    intermediate, finished = _fake_history(tmp_path, 7)
+    portal = PortalServer(PortalCache(intermediate, finished), port=0,
+                          history_jobs=3)
+    portal.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{portal.port}/", timeout=10) as r:
+            page = r.read().decode()
+    finally:
+        portal.stop()
+    assert "showing 3 of 7 job(s)" in page
+    # newest first within the bound: app_006 renders, app_000 doesn't
+    assert "app_006" in page and "app_000" not in page
+
+
+def test_cli_top_renders_registry(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import top
+    staging = str(tmp_path / "staging")
+    store = staging_store(staging, str(tmp_path / "apps" / "app_live"))
+    fleet.publish_job_state(
+        store, summary("app_live", queue="qa", chips=2,
+                       hb_ms=int(time.time() * 1000)), str(tmp_path))
+    assert top([staging, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "app_live" in out and "RUNNING" in out and "1 live job(s)" in out
+    assert top([staging, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["jobs"][0]["app_id"] == "app_live"
+    assert payload["chips_in_use"] == 2
+
+
+def test_fleet_store_glob_matches_only_jobstate_keys(tmp_path):
+    """The registry scan must not trip over unrelated per-app keys
+    (staged confs, history uploads) sharing the location."""
+    staging = str(tmp_path / "staging")
+    store = staging_store(staging, str(tmp_path / "apps" / "app_1"))
+    fleet.publish_job_state(store, summary("app_1"), str(tmp_path))
+    conf_file = tmp_path / "tony-final.json"
+    conf_file.write_text("{}")
+    store.put(str(conf_file), C.TONY_FINAL_CONF)
+    store.put(str(conf_file), "history/config.json")
+    root = location_store(staging)
+    keys = root.glob(f"*/{fleet.JOBSTATE_KEY}")
+    assert keys == [f"app_1/{fleet.JOBSTATE_KEY}"]

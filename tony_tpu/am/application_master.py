@@ -374,6 +374,18 @@ class ApplicationMaster(ClusterServiceHandler):
         self._straggler_window_ms = conf.get_time_ms(
             K.STRAGGLER_WINDOW_MS, 15_000)
         self._build_skew_state()
+        # fleet registry (observability/fleet.py): with a staging
+        # location configured, a compact heartbeat-stamped jobstate.json
+        # summary is republished at tony.fleet.publish-interval-ms —
+        # the live cross-job view rides the store, not a new RPC
+        self._fleet_store = None     # built in prepare()
+        self._fleet_interval_s = conf.get_time_ms(
+            K.FLEET_PUBLISH_INTERVAL_MS, 5000) / 1000.0
+        self._fleet_last_publish = 0.0
+        # last closed window's gang step-time spread (set by
+        # _check_stragglers; mirrored into the jobstate gauges so the
+        # fleet /metrics carries the same numbers as the AM /metrics)
+        self._step_time_quantiles: dict[str, float] = {}
         # live logs + failure diagnostics (observability/logs.py):
         # executors gossip their TaskLogService address on heartbeats
         # (task_id -> (attempt, "host:port"), attempt-fenced so a zombie
@@ -483,6 +495,10 @@ class ApplicationMaster(ClusterServiceHandler):
             conf_file = os.path.join(self.app_dir, C.TONY_FINAL_CONF)
             if os.path.exists(conf_file):
                 self._conf_uri = store.put(conf_file, C.TONY_FINAL_CONF)
+            # the fleet registry publishes into the same per-app
+            # namespace ("" = app-local staging stays registry-less:
+            # there is no shared location a portal could scan)
+            self._fleet_store = store
         self.backend.set_callbacks(self._on_container_allocated,
                                    self._on_container_completed)
         self.backend.start()
@@ -599,6 +615,87 @@ class ApplicationMaster(ClusterServiceHandler):
             per_task = dict(self._goodput_archive)
         per_task.update(self.metrics_store.latest_gauges())
         return aggregate_goodput(per_task, relaunch_downtime_s=downtime)
+
+    def fleet_summary(self, state: str) -> dict:
+        """The compact jobstate entry this AM contributes to the live
+        cross-job registry (observability/fleet.py): identity (app,
+        queue, user), gang shape, chip occupancy, and the job-level
+        health numbers — every `tony_job_*` gauge the AM exports lands
+        in the `gauges` map so the fleet /metrics re-exposition carries
+        exactly what the per-job /metrics does."""
+        from tony_tpu.conf.queues import app_queue, total_requested_tpus
+        from tony_tpu.observability import fleet
+        session = self.session
+        gang_width = session.total_tracked_tasks() \
+            if session is not None else 0
+        allocated = 0
+        if session is not None:
+            for job_name, req in session.requests.items():
+                live = sum(1 for t in session.job_tasks.get(job_name, [])
+                           if t.container_id and not t.completed)
+                allocated += live * req.tpus
+        gauges: dict[str, float] = {}
+        goodput_pct = mfu = None
+        if self._goodput_enabled:
+            gd = self.goodput_dict()
+            job = gd["job"]
+            if gd["tasks"]:
+                goodput_pct = job["goodput_pct"]
+            gauges["tony_job_goodput_pct"] = float(job["goodput_pct"])
+            gauges["tony_job_productive_seconds"] = float(
+                job["productive_s"])
+            gauges["tony_job_relaunch_downtime_seconds"] = float(
+                job["relaunch_downtime_s"])
+            mfus = [e["mfu_pct"] for e in gd["tasks"].values()
+                    if isinstance(e.get("mfu_pct"), (int, float))]
+            if mfus:
+                mfu = round(sum(mfus) / len(mfus), 3)
+        straggler_count = (len(self.straggler.active())
+                           if self._straggler_enabled else 0)
+        gauges["tony_job_straggler_count"] = float(straggler_count)
+        for q, gauge_name in fleet.STEP_TIME_GAUGES.items():
+            if q in self._step_time_quantiles:
+                gauges[gauge_name] = self._step_time_quantiles[q]
+        # serving throughput, summed across serving slots (the closest
+        # live QPS signal the engine exports)
+        serving_tps = None
+        tps = [g["SERVING_TOKENS_PER_SEC"]
+               for g in self.metrics_store.latest_gauges().values()
+               if isinstance(g.get("SERVING_TOKENS_PER_SEC"),
+                             (int, float))]
+        if tps:
+            serving_tps = round(sum(tps), 3)
+        return fleet.job_summary(
+            self.app_id, self.metadata.user, app_queue(self.conf), state,
+            gang_width=gang_width,
+            requested_chips=total_requested_tpus(self.conf),
+            allocated_chips=allocated,
+            started_ms=self.metadata.started,
+            goodput_pct=goodput_pct, mfu_pct=mfu,
+            straggler_count=straggler_count,
+            serving_tokens_per_sec=serving_tps,
+            gauges=gauges)
+
+    def _publish_fleet_state(self, state: str = "RUNNING",
+                             force: bool = False) -> None:
+        """Republish this job's registry entry (throttled to
+        tony.fleet.publish-interval-ms; monitor-loop cadence). No-op
+        without a shared staging location — there is no store another
+        process could scan."""
+        if self._fleet_store is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._fleet_last_publish \
+                < self._fleet_interval_s:
+            return
+        self._fleet_last_publish = now
+        try:
+            from tony_tpu.observability import fleet
+            fleet.publish_job_state(self._fleet_store,
+                                    self.fleet_summary(state),
+                                    self.app_dir)
+        except Exception:  # noqa: BLE001 — fleet must never kill the AM
+            LOG.exception("fleet jobstate publish failed")
 
     def _task_span_start(self, task: Task, container: Container) -> None:
         """Open the allocation→completion span for one task attempt; its
@@ -870,7 +967,8 @@ class ApplicationMaster(ClusterServiceHandler):
                       f"history/{os.path.basename(final_hist)}")
             for extra in (C.PORTAL_CONFIG_FILE, C.SPANS_FILE,
                           C.METRICS_FILE, C.GOODPUT_FILE,
-                          C.DIAGNOSTICS_FILE, C.SKEW_FILE):
+                          C.DIAGNOSTICS_FILE, C.SKEW_FILE,
+                          C.JOBSTATE_FILE):
                 p = os.path.join(self.history_dir, extra)
                 if os.path.exists(p):
                     store.put(p, f"history/{extra}")
@@ -1113,6 +1211,7 @@ class ApplicationMaster(ClusterServiceHandler):
                     self._close_relaunch_downtime()
             self._check_slo()
             self._check_stragglers()
+            self._publish_fleet_state()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
                 LOG.info("all %d tracked tasks completed", total)
@@ -1277,9 +1376,13 @@ class ApplicationMaster(ClusterServiceHandler):
                            app_id=self.app_id).set(
                 len(self.straggler.active()))
             gang = (closed.get("step_time_ms") or {}).get("gang") or {}
-            for q in ("p50", "p95", "p99"):
+            from tony_tpu.observability.fleet import STEP_TIME_GAUGES
+            for q, gauge_name in STEP_TIME_GAUGES.items():
                 if q in gang:
-                    REGISTRY.gauge(f"tony_job_step_time_{q}_ms",
+                    # mirrored into the jobstate gauges so the fleet
+                    # /metrics re-exposition matches the AM /metrics
+                    self._step_time_quantiles[q] = float(gang[q])
+                    REGISTRY.gauge(gauge_name,
                                    app_id=self.app_id).set(gang[q])
             for r, task, attempt in nominated:
                 self._remediate_straggler(r, task, attempt)
@@ -1384,6 +1487,16 @@ class ApplicationMaster(ClusterServiceHandler):
         # root-cause bundle BEFORE the event log closes: the
         # DIAGNOSTICS_READY event must land inside the jhist
         self._flush_diagnostics(status)
+        # fleet: the terminal jobstate replaces the live registry entry
+        # (so the entry settles instead of going stale → LOST) and a
+        # copy travels with the history for the ledger's final read
+        try:
+            from tony_tpu.events.history import write_jobstate_file
+            write_jobstate_file(self.history_dir,
+                                self.fleet_summary(status))
+            self._publish_fleet_state(status, force=True)
+        except Exception:  # noqa: BLE001 — fleet must never fail _finish
+            LOG.exception("failed to flush the terminal fleet jobstate")
         if self.session is not None:
             all_metrics = []
             for infos in (self.session.get_task_infos() or []):

@@ -1,5 +1,5 @@
 """`python -m tony_tpu.cli
-{submit|local|notebook|profile|logs|diagnose|stragglers} ...`
+{submit|local|notebook|profile|logs|diagnose|stragglers|top} ...`
 
 - submit   — ClusterSubmitter equivalent (cli/ClusterSubmitter.java:41-94):
              run against the configured cluster workdir; app artifacts
@@ -22,6 +22,10 @@
 - stragglers — render a job's cross-task skew bundle (skew.json) offline
              from history: latched stragglers with evidence, gang
              quantiles per signal, and the step-time heatmap.
+- top      — polling text view of the live fleet over a shared staging
+             location (the jobstate.json registry every AM publishes
+             into): per-job state/chips/goodput plus per-queue
+             quota-utilization rollups. `--once` prints one frame.
 """
 
 from __future__ import annotations
@@ -34,7 +38,7 @@ from tony_tpu.cli.local_submitter import submit as local_submit
 from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
-         "{submit|local|notebook|profile|logs|diagnose|stragglers} "
+         "{submit|local|notebook|profile|logs|diagnose|stragglers|top} "
          "[args...]")
 
 
@@ -330,6 +334,114 @@ def stragglers(argv: list[str]) -> int:
     return 0
 
 
+def _render_fleet_frame(view) -> str:
+    """One `cli top` frame: the live jobs table (state-then-start
+    order, like the portal index) + per-queue quota rollups."""
+    from tony_tpu.observability.fleet import chips_of, quota_utilization
+    import time as _time
+
+    lines = []
+    jobs = view.registry.jobs()
+    live = [j for j in jobs if j.get("state") == "RUNNING"]
+    now_ms = int(_time.time() * 1000)
+    lines.append(f"fleet @ {view.location} — {len(live)} live job(s), "
+                 f"{sum(chips_of(j) for j in live)} chip(s) in use")
+    header = (f"{'APP':<36} {'QUEUE':<10} {'USER':<10} {'STATE':<9} "
+              f"{'W':>3} {'CHIPS':>5} {'GOOD%':>6} {'MFU%':>6} "
+              f"{'STRAG':>5} {'TOK/S':>7} {'HB':>5}")
+    lines.append(header)
+    for j in jobs:
+        age = max(0.0, (now_ms - int(j.get("heartbeat_ms", 0) or 0))
+                  / 1000.0)
+
+        def _pct(v):
+            return "-" if v is None else f"{float(v):.1f}"
+
+        lines.append(
+            f"{str(j.get('app_id', ''))[:36]:<36} "
+            f"{str(j.get('queue', ''))[:10]:<10} "
+            f"{str(j.get('user', ''))[:10]:<10} "
+            f"{str(j.get('state', '?')):<9} "
+            f"{int(j.get('gang_width', 0) or 0):>3} "
+            f"{chips_of(j):>5} "
+            f"{_pct(j.get('goodput_pct')):>6} "
+            f"{_pct(j.get('mfu_pct')):>6} "
+            f"{int(j.get('straggler_count', 0) or 0):>5} "
+            + (f"{float(j['serving_tokens_per_sec']):>7.0f} "
+               if j.get("serving_tokens_per_sec") is not None
+               else f"{'-':>7} ")
+            + f"{age:>4.0f}s")
+    util = quota_utilization(view.queues, live)
+    if util:
+        lines.append("queues:")
+        for q in sorted(util):
+            b = util[q]
+            if b["max_tpus"] > 0:
+                lines.append(
+                    f"  {q:<12} {b['chips_in_use']}/{b['max_tpus']} chips "
+                    f"({b.get('utilization_pct', 0.0):.0f}% of quota), "
+                    f"{b['live_jobs']} live job(s)")
+            else:
+                lines.append(f"  {q:<12} {b['chips_in_use']} chips "
+                             f"(no quota), {b['live_jobs']} live job(s)")
+    return "\n".join(lines)
+
+
+def top(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli top <staging-location> [--interval-ms N]
+    [--once] [--json]` — the live fleet, polled straight off the
+    registry files (no portal required)."""
+    import argparse
+    import json
+    import time
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli top")
+    parser.add_argument("location",
+                        help="shared staging location the AMs publish "
+                             "jobstate into (tony.staging.location)")
+    parser.add_argument("--interval-ms", type=int, default=2000,
+                        help="poll cadence")
+    parser.add_argument("--once", action="store_true",
+                        help="print a single frame and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the /api/fleet payload instead of "
+                             "the table (implies --once)")
+    parser.add_argument("--queues-conf", default="",
+                        help="conf file declaring tony.queues.<name>."
+                             "max-tpus quotas for the utilization rollup")
+    args = parser.parse_args(argv)
+    from tony_tpu.conf import TonyConfiguration
+    from tony_tpu.conf.queues import configured_queues
+    from tony_tpu.observability.fleet import FleetView
+
+    queues = {}
+    if args.queues_conf:
+        queues = configured_queues(TonyConfiguration.read(args.queues_conf))
+    # read-only observer: top renders the registry + quotas but never
+    # folds/saves the durable accounting (that's the portal's job, run
+    # with the cluster's configured staleness/bounds)
+    view = FleetView(args.location, queues=queues,
+                     refresh_interval_ms=max(200, args.interval_ms // 2),
+                     settle_accounting=False)
+    try:
+        while True:
+            view.refresh(force=True)
+            if args.json:
+                print(json.dumps(view.api_fleet(), indent=1,
+                                 sort_keys=True))
+                return 0
+            frame = _render_fleet_frame(view)
+            if not args.once:
+                # ANSI home+clear keeps the frame in place like top(1)
+                print("\x1b[H\x1b[2J", end="")
+            print(frame, flush=True)
+            if args.once:
+                return 0
+            time.sleep(max(200, args.interval_ms) / 1000.0)
+    except KeyboardInterrupt:
+        return 0
+
+
 def profile(argv: list[str]) -> int:
     """`python -m tony_tpu.cli profile <app_dir> [--task-id worker:0]
     [--steps N]` — the operator verb behind the request_profile RPC."""
@@ -407,6 +519,8 @@ def main(argv: list[str] | None = None) -> int:
         return diagnose(rest)
     if cmd == "stragglers":
         return stragglers(rest)
+    if cmd == "top":
+        return top(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

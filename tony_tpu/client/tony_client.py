@@ -18,6 +18,7 @@ the AM status artifact + the task-info RPC exactly like the reference polled
 from __future__ import annotations
 
 import argparse
+import itertools
 import logging
 import os
 import shutil
@@ -190,8 +191,18 @@ class TonyClient:
         finally:
             self.cleanup()
 
+    # process-wide submission counter: two clients submitting from one
+    # process in the same millisecond (multi-job drivers, the fleet e2e)
+    # must never mint the same application id and clobber each other's
+    # app dir
+    _submit_seq = itertools.count()
+
     def submit(self) -> str:
-        self.app_id = f"application_{int(time.time() * 1000)}_{os.getpid():05d}"
+        # explicit separator: pid+seq concatenated without one is
+        # ambiguous once either field outgrows its padding
+        self.app_id = (f"application_{int(time.time() * 1000)}"
+                       f"_{os.getpid():05d}"
+                       f"_{next(TonyClient._submit_seq):03d}")
         workdir = self.conf.get_str(K.CLUSTER_WORKDIR) or os.path.join(
             tempfile.gettempdir(), "tony_tpu")
         self.app_dir = os.path.join(workdir, self.app_id)

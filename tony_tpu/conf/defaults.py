@@ -123,6 +123,10 @@ DEFAULTS = {
     K.STRAGGLER_HEATMAP_WINDOWS: 32,
     K.STRAGGLER_MIN_TASKS: 3,
     K.STRAGGLER_RELAUNCH_AFTER_WINDOWS: 0,   # 0 = detect only
+    # fleet registry / chip-hour accounting (observability/fleet.py)
+    K.FLEET_PUBLISH_INTERVAL_MS: 5000,
+    K.FLEET_STALE_AFTER_MS: 30_000,
+    K.FLEET_HISTORY_JOBS: 200,
 
     # portal
     K.PORTAL_PORT: 19886,

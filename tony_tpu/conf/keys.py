@@ -185,6 +185,20 @@ STRAGGLER_MIN_TASKS = "tony.straggler.min-tasks"
 # many consecutive windows is routed through the task-attempt relaunch
 # machinery (attempt-fenced, budget-counted); 0 = detect only
 STRAGGLER_RELAUNCH_AFTER_WINDOWS = "tony.straggler.relaunch-after-windows"
+# fleet layer (observability/fleet.py): cross-job registry + chip-hour
+# accounting over the staging store. With a staging location configured,
+# each AM republishes its heartbeat-stamped jobstate.json summary at
+# this cadence (the live registry has no new RPC surface — it's files)
+FLEET_PUBLISH_INTERVAL_MS = "tony.fleet.publish-interval-ms"
+# a RUNNING registry entry whose heartbeat stamp is older than this is
+# demoted to LOST (its AM died without publishing a terminal state);
+# LOST jobs still fold into the chip-hour accounting at their last
+# known extent
+FLEET_STALE_AFTER_MS = "tony.fleet.stale-after-ms"
+# bound on jobs held by the registry / per-job accounting entries / the
+# portal index table; evicted ledger entries fold into the per-queue and
+# per-user running totals so chip-hours are never lost, only coarsened
+FLEET_HISTORY_JOBS = "tony.fleet.history-jobs"
 
 # --- proxy ---------------------------------------------------------------
 # externally reachable base URL of an authenticated tony_tpu.proxy fronting
@@ -244,7 +258,7 @@ RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
-    "profiling", "slo", "logs", "straggler",
+    "profiling", "slo", "logs", "straggler", "fleet",
 })
 
 

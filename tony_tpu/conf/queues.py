@@ -39,6 +39,13 @@ def configured_queues(conf) -> dict[str, int]:
     return out
 
 
+def app_queue(conf) -> str:
+    """The queue an application is (or was) submitted into — the one
+    normalization of `tony.application.queue` shared by quota
+    validation, the AM's fleet jobstate summary, and the portal."""
+    return conf.get_str(K.APPLICATION_QUEUE, "default") or "default"
+
+
 def total_requested_tpus(conf) -> int:
     return sum(conf.get_int(K.instances_key(j), 0)
                * conf.get_int(K.tpus_key(j), 0)
@@ -52,7 +59,7 @@ def validate_queue_quota(conf) -> None:
     queues = configured_queues(conf)
     if not queues:
         return
-    queue = conf.get_str(K.APPLICATION_QUEUE, "default") or "default"
+    queue = app_queue(conf)
     if queue not in queues:
         raise ValueError(
             f"unknown queue {queue!r}: configured queues are "

@@ -126,6 +126,15 @@ SKEW_FILE = "skew.json"              # cross-task skew bundle flushed next to
                                      # gang sketch summaries, step-time
                                      # heatmap, latched stragglers +
                                      # detection log
+JOBSTATE_FILE = "jobstate.json"      # compact heartbeat-stamped job summary
+                                     # (observability/fleet.py): published to
+                                     # the staging store while the job runs
+                                     # (the live cross-job registry's source)
+                                     # and flushed into history at finish
+FLEET_DIR_NAME = "fleet"             # staging-store namespace of the fleet
+                                     # layer: <app_id>/fleet/jobstate.json
+                                     # per job, fleet/accounting.json at the
+                                     # store root (durable chip-hour ledger)
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------

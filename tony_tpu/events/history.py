@@ -118,6 +118,19 @@ def read_goodput_file(history_dir: str) -> dict:
     return out if isinstance(out, dict) else {}
 
 
+def write_jobstate_file(history_dir: str, summary: dict) -> None:
+    """summary: observability.fleet.job_summary's shape — the compact
+    heartbeat-stamped cross-job registry entry. The terminal copy lands
+    in history so the fleet ledger's final accounting can outlive the
+    staging store's live entry."""
+    _write_json_atomic(os.path.join(history_dir, C.JOBSTATE_FILE), summary)
+
+
+def read_jobstate_file(history_dir: str) -> dict:
+    out = _read_json(os.path.join(history_dir, C.JOBSTATE_FILE), {})
+    return out if isinstance(out, dict) else {}
+
+
 def write_skew_file(history_dir: str, skew: dict) -> None:
     """skew: observability.skew.SkewTracker.bundle's shape — gang sketch
     summaries per signal, the tasks x windows step-time heatmap, startup

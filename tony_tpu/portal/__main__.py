@@ -79,11 +79,30 @@ def main(argv=None) -> int:
         if not user_tokens:
             raise SystemExit(
                 f"empty portal user-tokens file: {user_tokens_file}")
-    server = PortalServer(cache, port=port, token=token,
-                          user_tokens=user_tokens)
-    fetcher = None
     store_location = args.history_store or conf.get_str(
-        K.HISTORY_STORE_LOCATION)
+        K.HISTORY_STORE_LOCATION) or conf.get_str(K.STAGING_LOCATION)
+    # fleet view (observability/fleet.py): the live cross-job registry,
+    # chip-hour accounting, and quota bars need a shared store the AMs
+    # publish jobstate into — the same location the history fetcher
+    # pulls from. Quotas come from this portal's own conf (the same
+    # tony.queues.<name>.max-tpus keys the client/AM validate against).
+    fleet = None
+    if store_location:
+        from tony_tpu.conf.queues import configured_queues
+        from tony_tpu.observability.fleet import FleetView
+        fleet = FleetView(
+            store_location,
+            queues=configured_queues(conf),
+            stale_after_ms=conf.get_time_ms(K.FLEET_STALE_AFTER_MS, 30_000),
+            history_jobs=conf.get_int(K.FLEET_HISTORY_JOBS, 200),
+            refresh_interval_ms=max(
+                500, conf.get_time_ms(K.FLEET_PUBLISH_INTERVAL_MS,
+                                      5000) // 2))
+    server = PortalServer(cache, port=port, token=token,
+                          user_tokens=user_tokens, fleet=fleet,
+                          history_jobs=conf.get_int(K.FLEET_HISTORY_JOBS,
+                                                    200))
+    fetcher = None
     if store_location:
         fetcher = HistoryStoreFetcher(store_location, intermediate,
                                       finished=finished)

@@ -17,15 +17,9 @@ import os
 import threading
 
 from tony_tpu import constants as C
-from tony_tpu.storage import GCSStore, LocalDirStore, StagingStore
+from tony_tpu.storage import location_store
 
 LOG = logging.getLogger(__name__)
-
-
-def _store_for_location(location: str) -> StagingStore:
-    if location.startswith("gs://"):
-        return GCSStore(location)
-    return LocalDirStore(location)
 
 
 class HistoryStoreFetcher:
@@ -63,7 +57,7 @@ class HistoryStoreFetcher:
 
     def fetch_once(self) -> list[str]:
         """One sync pass; returns newly fetched destination paths."""
-        store = _store_for_location(self._location)
+        store = location_store(self._location)
         fetched = []
         try:
             keys = store.list_keys()

@@ -29,7 +29,7 @@ body{{font-family:sans-serif;margin:2em}}table{{border-collapse:collapse}}
 td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
 th{{background:#eee}}a{{text-decoration:none}}
 .RUNNING{{color:#b8860b}}.SUCCEEDED{{color:green}}.FAILED{{color:red}}
-.KILLED{{color:#555}}
+.KILLED{{color:#555}}.LOST{{color:#c0392b;font-style:italic}}
 .waterfall td{{vertical-align:middle}}
 .spanbar{{height:10px;border-radius:2px;min-width:2px}}
 </style></head><body><h2>{title}</h2>{body}</body></html>"""
@@ -58,6 +58,11 @@ def _table(headers: list[str], rows: list[list[str]]) -> str:
     return f"<table><tr>{head}</tr>{body}</table>"
 
 
+def _now_ms() -> int:
+    import time
+    return int(time.time() * 1000)
+
+
 def _fmt_ts(ms: int) -> str:
     import datetime
     if not ms:
@@ -76,6 +81,14 @@ class _Handler(BaseHTTPRequestHandler):
     # (TonyPolicyProvider.java:23, TokenCache.java:44-72) re-based on the
     # rebuild's token scheme.
     user_tokens: dict[str, str] = {}
+    # fleet layer (observability/fleet.py FleetView) — None when no
+    # staging/history-store location is configured: the live cross-job
+    # registry, chip-hour accounting, and quota views behind /, /metrics,
+    # /api/fleet and /api/fleet/queues
+    fleet = None
+    # index-table bound (tony.fleet.history-jobs): newest N rows render,
+    # the footer carries the full count
+    history_jobs: int = 200
 
     # -- plumbing ----------------------------------------------------------
     def log_message(self, fmt, *args):  # route through logging, not stderr
@@ -149,6 +162,8 @@ class _Handler(BaseHTTPRequestHandler):
                                   "<p>401: missing or invalid token</p>", 401)
             if path == "/":
                 return self._index()
+            if path == "/metrics":
+                return self._metrics()
             if parts[0] == "api":
                 return self._api(parts[1:])
             if (len(parts) == 3 and parts[0] == "jobs"
@@ -181,10 +196,72 @@ class _Handler(BaseHTTPRequestHandler):
             LOG.exception("portal request failed: %s", self.path)
             self._html("error", "<p>internal error</p>", 500)
 
+    def _metrics(self) -> None:
+        """Fleet-level Prometheus exposition: every live job's
+        `tony_job_*` gauges with {app_id, queue, user} labels (see
+        fleet.fleet_families) + this portal process's own health
+        registry — one scrape target for the whole cluster."""
+        from tony_tpu.observability.metrics import REGISTRY
+        from tony_tpu.observability.prometheus import render
+        families = []
+        if self.fleet is not None:
+            from tony_tpu.observability.fleet import fleet_families
+            self.fleet.refresh()
+            # owner scoping holds on the scrape too: a user-scoped token
+            # must not read another tenant's labeled job gauges
+            live = [j for j in self._fleet_jobs_visible()
+                    if j.get("state") == "RUNNING"]
+            families += fleet_families(live, self.fleet.queues)
+        families += REGISTRY.families()
+        self._send(200, render(families), "text/plain; version=0.0.4")
+
+    def _fleet_jobs_visible(self) -> list[dict]:
+        """The registry's jobs this credential may see (owner scoping
+        matches the history routes: a named user sees only their own)."""
+        return [j for j in self.fleet.registry.jobs()
+                if self._visible(j.get("user"))]
+
     def _api(self, parts: list[str]) -> None:
         if parts == ["jobs"]:
             return self._json([d for d in self.cache.metadata_dicts()
                                if self._visible(d["user"])])
+        if parts and parts[0] == "fleet":
+            if self.fleet is None:
+                return self._json(
+                    {"error": "fleet view disabled (no history-store/"
+                              "staging location configured)"}, 404)
+            self.fleet.refresh()
+            if parts == ["fleet"]:
+                from tony_tpu.observability.fleet import chips_of
+                payload = self.fleet.api_fleet()
+                jobs = [j for j in payload["jobs"]
+                        if self._visible(j.get("user"))]
+                payload["jobs"] = jobs
+                payload["live_jobs"] = sum(
+                    1 for j in jobs if j.get("state") == "RUNNING")
+                if self._auth_user is not None:
+                    # a scoped token's headline numbers must agree with
+                    # the jobs it can see — and the cluster-wide
+                    # timeline would leak other tenants' occupancy
+                    payload["chips_in_use"] = sum(
+                        chips_of(j) for j in jobs
+                        if j.get("state") == "RUNNING")
+                    payload["timeline"] = []
+                return self._json(payload)
+            if parts == ["fleet", "queues"]:
+                payload = self.fleet.api_queues()
+                if self._auth_user is not None:
+                    # scoped tokens get the quota view but only their own
+                    # rows of the accounting
+                    acct = payload["accounting"]
+                    acct["jobs"] = {k: v
+                                    for k, v in acct["jobs"].items()
+                                    if self._visible(v.get("user"))}
+                    acct["users"] = {k: v
+                                     for k, v in acct["users"].items()
+                                     if self._visible(k)}
+                return self._json(payload)
+            return self._json({"error": "not found"}, 404)
         if len(parts) == 3 and parts[0] == "jobs":
             job_id, what = parts[1], parts[2]
             md = self.cache.get_metadata(job_id)
@@ -370,11 +447,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- pages (reference: 4 page controllers) -----------------------------
     def _index(self) -> None:
-        rows = []
         qs = getattr(self, "_link_qs", "")
-        for m in self.cache.list_metadata():
-            if not self._visible(m.user):
-                continue
+        body = []
+        if self.fleet is not None:
+            try:
+                self.fleet.refresh()
+                body.append(self._fleet_html(qs))
+            except Exception:  # noqa: BLE001 — fleet must not 500 the index
+                LOG.exception("fleet panel render failed")
+        visible = [m for m in self.cache.list_metadata()
+                   if self._visible(m.user)]
+        # state-then-start-time: RUNNING jobs surface first, newest
+        # first within each bucket — a directory of hundreds of
+        # finished jobs must not bury the live ones
+        visible.sort(key=lambda m: (m.status != "RUNNING", -m.started))
+        total = len(visible)
+        rows = []
+        for m in visible[:max(1, self.history_jobs)]:
             app = html.escape(m.application_id)
             queue = self.cache.get_queue(m.application_id)
             rows.append([
@@ -386,9 +475,98 @@ class _Handler(BaseHTTPRequestHandler):
                 f'<a href="/config/{app}{qs}">config</a> '
                 f'<a href="/logs/{app}{qs}">logs</a>',
             ])
-        self._html("TonY-TPU jobs",
-                   _table(["Job", "User", "Queue", "Started", "Completed",
-                           "Status", ""], rows))
+        body.append(_table(["Job", "User", "Queue", "Started", "Completed",
+                            "Status", ""], rows))
+        # the bound is visible, never silent: the footer always carries
+        # the full directory count
+        body.append(f"<p>showing {len(rows)} of {total} job(s)</p>")
+        self._html("TonY-TPU jobs", "".join(body))
+
+    def _fleet_html(self, qs: str) -> str:
+        """The cluster panels above the job directory: live jobs table,
+        per-queue quota/utilization bars, and the chip-utilization
+        timeline — the whole pool on one screen (the reference portal's
+        reason to exist, rebuilt over the fleet registry)."""
+        from tony_tpu.observability.fleet import chips_of, quota_utilization
+        jobs = self._fleet_jobs_visible()
+        live = [j for j in jobs if j.get("state") == "RUNNING"]
+        out = ["<h3>Cluster</h3>"]
+        chips = sum(chips_of(j) for j in live)
+        out.append(f"<p><b>{len(live)}</b> live job(s), <b>{chips}</b> "
+                   "chip(s) in use</p>")
+        util = quota_utilization(self.fleet.queues, live)
+        if util:
+            bars = []
+            for q in sorted(util):
+                b = util[q]
+                cap = b["max_tpus"]
+                used = b["chips_in_use"]
+                pct = b.get("utilization_pct")
+                width = min(100.0, pct if pct is not None else
+                            (100.0 if used else 0.0))
+                color = "#cc0000" if width >= 95 else "#2e8b57"
+                label = (f"{used}/{cap} chips ({pct:.0f}%)"
+                         if pct is not None else f"{used} chips (no quota)")
+                bars.append(
+                    f"<tr><td>{html.escape(q)}</td>"
+                    f'<td style="min-width:240px"><div class="spanbar" '
+                    f'style="width:{width:.1f}%;background:{color}">'
+                    f"</div></td><td>{html.escape(label)} — "
+                    f"{b['live_jobs']} job(s)</td></tr>")
+            out.append("<p><b>queues</b></p><table>"
+                       + "".join(bars) + "</table>")
+        out.append(self._fleet_timeline_html())
+        if jobs:
+            rows = []
+            for j in jobs:
+                app = html.escape(str(j.get("app_id", "")))
+                state = html.escape(str(j.get("state", "?")))
+                age_s = max(0.0, (_now_ms() - int(
+                    j.get("heartbeat_ms", 0) or 0)) / 1000.0)
+                rows.append([
+                    f'<a href="/jobs/{app}{qs}">{app}</a>',
+                    html.escape(str(j.get("queue", ""))),
+                    html.escape(str(j.get("user", ""))),
+                    f'<span class="{state}">{state}</span>',
+                    str(j.get("gang_width", 0)),
+                    str(chips_of(j)),
+                    ("-" if j.get("goodput_pct") is None
+                     else f"{j['goodput_pct']:.1f}%"),
+                    ("-" if j.get("mfu_pct") is None
+                     else f"{j['mfu_pct']:.1f}%"),
+                    str(j.get("straggler_count", 0)),
+                    ("-" if j.get("serving_tokens_per_sec") is None
+                     else f"{j['serving_tokens_per_sec']:.0f}"),
+                    f"{age_s:.0f}s",
+                ])
+            out.append("<p><b>fleet registry</b></p>")
+            out.append(_table(
+                ["Job", "Queue", "User", "State", "Width", "Chips",
+                 "Goodput", "MFU", "Strag", "Serve tok/s", "HB age"],
+                rows))
+        out.append("<h3>Job directory</h3>")
+        return "".join(out)
+
+    def _fleet_timeline_html(self) -> str:
+        """Inline-SVG cluster chip-utilization timeline (the registry's
+        chips-in-use series, sampled per refresh)."""
+        points = [(int(p[0]), float(p[1]))
+                  for p in self.fleet.registry.timeline()
+                  if isinstance(p, (list, tuple)) and len(p) == 2]
+        if len(points) < 2:
+            return ""
+        w, h = 420, 60
+        t0, t1 = points[0][0], points[-1][0]
+        extent = max(1, t1 - t0)
+        peak = max(1.0, max(v for _, v in points))
+        coords = " ".join(
+            f"{w * (ts - t0) / extent:.1f},{h - h * v / (1.15 * peak):.1f}"
+            for ts, v in points)
+        return (f"<p>chips in use over time (peak {peak:.0f})</p>"
+                f'<svg width="{w}" height="{h}" '
+                'style="border:1px solid #ccc">'
+                f'<polyline points="{coords}" fill="none" '
+                'stroke="#4a90d9" stroke-width="1.5"></polyline></svg>')
 
     def _jobs(self, job_id: str) -> None:
         from tony_tpu.events.render import render_event
@@ -762,11 +940,15 @@ class PortalServer:
 
     def __init__(self, cache: PortalCache, port: int = 0,
                  host: str = "0.0.0.0", token: Optional[str] = None,
-                 user_tokens: Optional[dict[str, str]] = None):
+                 user_tokens: Optional[dict[str, str]] = None,
+                 fleet=None, history_jobs: int = 200):
         self.cache = cache
+        self.fleet = fleet
         handler = type("BoundHandler", (_Handler,),
                        {"cache": cache, "token": token,
-                        "user_tokens": dict(user_tokens or {})})
+                        "user_tokens": dict(user_tokens or {}),
+                        "fleet": fleet,
+                        "history_jobs": max(1, int(history_jobs))})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,

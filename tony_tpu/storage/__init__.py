@@ -1,3 +1,4 @@
 from tony_tpu.storage.store import (   # noqa: F401
-    GCSStore, LocalDirStore, StagingStore, fetch_uri, staging_store,
+    GCSStore, LocalDirStore, StagingStore, fetch_uri, location_store,
+    staging_store,
 )

@@ -73,7 +73,13 @@ class LocalDirStore(StagingStore):
         dest = os.path.join(self.root, key)
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         if os.path.abspath(local_path) != dest:
-            shutil.copy2(local_path, dest)
+            # copy-to-tmp + rename: readers polling a store key (the
+            # fleet registry's jobstate scan, the durable accounting)
+            # must never observe a half-written file — GCS puts are
+            # server-side atomic, the local twin has to earn it
+            tmp = f"{dest}.put-tmp-{os.getpid()}"
+            shutil.copy2(local_path, tmp)
+            os.replace(tmp, dest)
         return dest
 
     def fetch(self, uri: str, dest_path: str) -> str:
@@ -202,6 +208,17 @@ def staging_store(location: str, app_dir: str) -> StagingStore:
     if location.startswith("gs://"):
         return GCSStore(f"{location.rstrip('/')}/{app_id}")
     return LocalDirStore(os.path.join(location, app_id))
+
+
+def location_store(location: str) -> StagingStore:
+    """A store rooted at a staging LOCATION itself (no per-app subdir) —
+    the reader-side twin of `staging_store`: the portal's history
+    fetcher and the fleet registry scan `<location>/<app_id>/...` keys
+    across ALL applications, so their store must sit at the root the
+    per-app writers namespaced under."""
+    if location.startswith("gs://"):
+        return GCSStore(location)
+    return LocalDirStore(location)
 
 
 def store_for_uri(uri: str) -> StagingStore:
