@@ -1,0 +1,105 @@
+"""Chaos-harness gang member for the alerting suite (tests/test_alerts.py).
+
+Simulates a synchronous training loop without a model, pushing the
+measured TRAIN_STEP_TIME_MS plus the goodput ledger's phase gauges over
+the public metrics RPC — exactly the signals the AM's alert engine reads
+on its monitor cadence. Fault seams are STEP-COUNT based, not
+wall-clock based: the sandboxed CI environment distorts sleeps by
+integer factors, so "slow between steps A and B" is deterministic where
+"slow between seconds X and Y" is not.
+
+- **transient steady-state fault** (`ALERT_FAULT` = "start_step:
+  end_step:extra_ms"): steps in [start, end) are slowed by `extra_ms`,
+  with the extra carved into the ledger's `input_stall` phase — so BOTH
+  the step-time-regression rule and the goodput-floor rule see a fault
+  that later clears (pending → firing → resolved). Attempt 0 only; a
+  relaunched attempt runs clean.
+- **recompile tail** (`ALERT_RECOMPILE_STEPS` / `ALERT_RECOMPILE_MS`):
+  a relaunched attempt (TASK_ATTEMPT > 0) runs its first N steps slow —
+  the seam the attempt-aware step-regression baseline is pinned
+  against: those slow steps must become the NEW baseline, not trip the
+  latch against attempt 0's steady state.
+
+Tasks run until the wall deadline (ALERT_RUN_SECONDS) AND at least
+ALERT_MIN_STEPS steps — guaranteeing baseline, fault, and recovery
+pushes all exist no matter how the clock stretches. The first report is
+primed before the step clock starts so the one-time jax import inside
+the reporter never pollutes a step-time sample.
+"""
+
+import os
+import time
+
+from tony_tpu import constants as C
+from tony_tpu.observability.perf import GoodputLedger
+from tony_tpu.train.metrics import TpuMetricsReporter
+
+step_s = int(os.environ.get("ALERT_STEP_MS", "30")) / 1000.0
+push_steps = int(os.environ.get("ALERT_PUSH_STEPS", "4"))
+run_s = float(os.environ.get("ALERT_RUN_SECONDS", "4"))
+min_steps = int(os.environ.get("ALERT_MIN_STEPS", "45"))
+attempt = int(os.environ.get(C.TASK_ATTEMPT, "0") or 0)
+generation = int(os.environ.get(C.SPEC_GENERATION, "0") or 0)
+
+fault_start = fault_end = 0
+fault_extra_s = 0.0
+fault = os.environ.get("ALERT_FAULT", "")
+if fault and attempt == 0:
+    start_step, end_step, extra_ms = fault.split(":")
+    fault_start, fault_end = int(start_step), int(end_step)
+    fault_extra_s = float(extra_ms) / 1000.0
+
+recompile_steps = int(os.environ.get("ALERT_RECOMPILE_STEPS", "0") or 0) \
+    if attempt > 0 else 0
+recompile_extra_s = int(os.environ.get("ALERT_RECOMPILE_MS", "220")) \
+    / 1000.0
+
+if generation > 1:
+    # a relaunch already happened; the re-rendezvoused gang just needs a
+    # short healthy epoch so the application converges
+    run_s = min(run_s, 2.0)
+    min_steps = min(min_steps, 25)
+
+ledger = GoodputLedger.from_env(os.environ)
+reporter = TpuMetricsReporter()
+ledger.transition("compile")
+# priming push: pays the reporter's one-time jax import (seconds under
+# CI load) inside the compile phase, BEFORE the step clock starts
+reporter.report(extra=ledger.metrics())
+ledger.transition("train_step")
+
+deadline = time.monotonic() + run_s
+last_push = time.monotonic()
+steps_since_push = 0
+stall_since_push = 0.0
+step_no = 0
+while time.monotonic() < deadline or step_no < min_steps:
+    extra = 0.0
+    faulted = fault_extra_s and fault_start <= step_no < fault_end
+    if faulted:
+        extra += fault_extra_s
+    if step_no < recompile_steps:
+        extra += recompile_extra_s
+    time.sleep(step_s + extra)
+    step_no += 1
+    steps_since_push += 1
+    if faulted:
+        # the transient fault is a stall, not compute: carve it out of
+        # train_step so the goodput ledger (and the goodput-floor rule)
+        # see the drop
+        stall_since_push += fault_extra_s
+    if steps_since_push >= push_steps:
+        now = time.monotonic()
+        if stall_since_push > 0:
+            ledger.carve("input_stall", stall_since_push)
+            stall_since_push = 0.0
+        step_ms = 1000.0 * (now - last_push) / steps_since_push
+        reporter.report(extra=ledger.metrics()
+                        + [{"name": "TRAIN_STEP_TIME_MS",
+                            "value": round(step_ms, 3)}])
+        last_push, steps_since_push = now, 0
+
+ledger.transition("idle")
+reporter.report(extra=ledger.metrics())
+reporter.close(timeout=5)
+raise SystemExit(0)

@@ -1,0 +1,964 @@
+"""Rule-driven alerting engine (tony_tpu/observability/alerts.py).
+
+Covers: the lifecycle state machine (pending → firing → resolved, dedup,
+for-duration, flap suppression), the burn-rate math (counter windows,
+gauge exceed-fractions, fast+slow multi-window evaluation — unit-pinned),
+rule-spec parsing, the attempt-aware step-regression baseline (the
+SloWatchdog false-positive fix), sinks, the fleet-scope rules + portal
+surfaces, `cli alerts`, two tier-1 static checks (registered-rule table
+coverage; no alert work on the hot loop), and the chaos e2e acceptance:
+an injected steady-state step delay + goodput drop drives
+pending → firing (event, webhook + file sink, /api, portal timeline)
+and → resolved once the fault clears.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from tony_tpu.events.schema import EventType
+from tony_tpu.observability import alerts as A
+
+pytestmark = pytest.mark.alerts
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "scripts")
+
+
+def script(name: str) -> str:
+    return os.path.join(SCRIPTS, name)
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, sec: float) -> None:
+        self.t += sec
+
+
+def _engine(rules, clock, **kw):
+    kw.setdefault("default_for_ms", 0)
+    kw.setdefault("flap_suppress_ms", 0)
+    return A.AlertEngine(rules, clock=clock, **kw)
+
+
+def _ctx(clock, **kw):
+    return A.AlertContext(now_ms=int(clock.t * 1000), **kw)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle state machine
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_pending_firing_resolved_with_for_duration():
+    clock = _Clock()
+    eng = _engine([A.threshold_rule("t.hot", "M", ">", 5, for_ms=1000)],
+                  clock)
+
+    def tick(value):
+        return eng.evaluate(_ctx(clock, gauges={"worker:0": {"M": value}}))
+
+    assert tick(10) == []                      # condition true -> pending
+    assert eng.firing() == []
+    clock.advance(0.5)
+    assert tick(10) == []                      # still inside for-duration
+    clock.advance(0.6)
+    fired = tick(10)
+    assert [t["status"] for t in fired] == ["firing"]
+    assert fired[0]["rule_id"] == "t.hot"
+    assert fired[0]["key"] == "worker:0"
+    assert fired[0]["for_ms"] >= 1000
+    assert len(eng.firing()) == 1
+    clock.advance(0.1)
+    assert tick(10) == []                      # steady firing: no re-event
+    clock.advance(0.1)
+    resolved = tick(1)
+    assert [t["status"] for t in resolved] == ["resolved"]
+    assert resolved[0]["active_ms"] > 0
+    assert eng.firing() == []
+    # the whole story is in the bounded log
+    assert [t["status"] for t in eng.log()] == ["firing", "resolved"]
+
+
+def test_condition_evaporating_before_for_duration_never_alerts():
+    clock = _Clock()
+    eng = _engine([A.threshold_rule("t.blip", "M", ">", 5, for_ms=1000)],
+                  clock)
+    eng.evaluate(_ctx(clock, gauges={"w:0": {"M": 9}}))
+    clock.advance(0.5)
+    eng.evaluate(_ctx(clock, gauges={"w:0": {"M": 1}}))   # blip cleared
+    clock.advance(1.0)
+    eng.evaluate(_ctx(clock, gauges={"w:0": {"M": 1}}))
+    assert eng.log() == [] and eng.firing() == []
+
+
+def test_dedup_one_state_per_rule_and_key():
+    clock = _Clock()
+    eng = _engine([A.threshold_rule("t.hot", "M", ">", 5, for_ms=0)],
+                  clock)
+    gauges = {"w:0": {"M": 9}, "w:1": {"M": 9}, "w:2": {"M": 1}}
+    fired = eng.evaluate(_ctx(clock, gauges=gauges))
+    assert sorted(t["key"] for t in fired) == ["w:0", "w:1"]
+    # repeated evaluation: same firing instances, zero new transitions
+    for _ in range(3):
+        clock.advance(0.1)
+        assert eng.evaluate(_ctx(clock, gauges=gauges)) == []
+    assert len(eng.firing()) == 2
+    assert eng.firing_counts() == {("t.hot", "warning"): 2}
+
+
+def test_flap_suppression_latches_but_mutes():
+    clock = _Clock()
+    eng = _engine([A.threshold_rule("t.flap", "M", ">", 5, for_ms=0)],
+                  clock, flap_suppress_ms=60_000)
+
+    def tick(value):
+        return eng.evaluate(_ctx(clock, gauges={"w:0": {"M": value}}))
+
+    assert tick(9)[0]["suppressed"] is False
+    clock.advance(1)
+    assert tick(1)[0]["status"] == "resolved"
+    clock.advance(1)
+    refire = tick(9)         # re-fire 1s after resolve: a flap
+    assert refire[0]["status"] == "firing"
+    assert refire[0]["suppressed"] is True
+    # the state still latched (visible in firing()), just not notified
+    assert len(eng.firing()) == 1
+    assert eng.firing()[0]["flaps"] == 1
+
+
+def test_flap_that_persists_late_notifies():
+    """A re-fire inside the suppression window is muted — but if the
+    'flap' then stays bad past the window it is a sustained incident:
+    one late firing notification goes out, and the eventual resolve
+    notifies normally instead of inheriting the suppression."""
+    clock = _Clock()
+    eng = _engine([A.threshold_rule("t.sus", "M", ">", 5, for_ms=0)],
+                  clock, flap_suppress_ms=60_000)
+
+    def tick(value):
+        return eng.evaluate(_ctx(clock, gauges={"w:0": {"M": value}}))
+
+    tick(9)
+    clock.advance(1)
+    tick(1)                                   # resolved
+    clock.advance(1)
+    assert tick(9)[0]["suppressed"] is True   # flap: muted
+    clock.advance(30)
+    assert tick(9) == []                      # still inside the window
+    clock.advance(31)
+    late = tick(9)                            # outlived the window
+    assert [t["status"] for t in late] == ["firing"]
+    assert late[0]["suppressed"] is False
+    assert late[0]["late_notify"] is True
+    clock.advance(1)
+    resolved = tick(1)
+    assert resolved[0]["status"] == "resolved"
+    assert resolved[0]["suppressed"] is False
+
+
+def test_log_is_bounded():
+    clock = _Clock()
+    eng = _engine([A.threshold_rule("t.hot", "M", ">", 5, for_ms=0)],
+                  clock, log_max=8)
+    for i in range(20):
+        clock.advance(1)
+        eng.evaluate(_ctx(clock, gauges={"w:0": {"M": 9}}))
+        clock.advance(1)
+        eng.evaluate(_ctx(clock, gauges={"w:0": {"M": 1}}))
+    assert len(eng.log()) == 8
+
+
+def test_broken_rule_never_kills_the_pass():
+    clock = _Clock()
+
+    def boom(ctx):
+        raise RuntimeError("bad rule")
+
+    eng = _engine([A.AlertRule("t.boom", boom),
+                   A.threshold_rule("t.ok", "M", ">", 5, for_ms=0)],
+                  clock)
+    fired = eng.evaluate(_ctx(clock, gauges={"w:0": {"M": 9}}))
+    assert [t["rule_id"] for t in fired] == ["t.ok"]
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (unit-pinned)
+# ---------------------------------------------------------------------------
+
+def test_counter_window_delta_pinned():
+    pts = [[0, 0.0], [30_000, 10.0], [60_000, 30.0]]
+    # full window: baseline is the sample at the window start
+    assert A.counter_window_delta(pts, 60_000, 60_000) == 30.0
+    # half window: baseline = value at/before t=30s -> 10
+    assert A.counter_window_delta(pts, 60_000, 30_000) == 20.0
+    # window opening between samples: latest sample at/before start wins
+    assert A.counter_window_delta(pts, 60_000, 20_000) == 20.0
+    # series younger than the window: earliest sample is the baseline
+    assert A.counter_window_delta(pts[1:], 60_000, 600_000) == 20.0
+    # counter reset clamps to 0, never negative
+    assert A.counter_window_delta([[0, 50.0], [60_000, 5.0]],
+                                  60_000, 60_000) == 0.0
+    assert A.counter_window_delta([], 60_000, 60_000) == 0.0
+
+
+def test_gauge_exceed_fraction_pinned():
+    pts = [[t * 10_000, 1.0 if t % 2 else 10.0] for t in range(6)]
+    # samples at 0..50s alternate 10,1,10,1,10,1 over threshold 5
+    assert A.gauge_exceed_fraction(pts, 50_000, 60_000, 5.0) == 0.5
+    # trailing 20s window holds ts=30s,40s,50s -> values 1,10,1
+    assert A.gauge_exceed_fraction(pts, 50_000, 20_000, 5.0) \
+        == pytest.approx(1 / 3)
+    assert A.gauge_exceed_fraction([], 50_000, 20_000, 5.0) == 0.0
+
+
+def test_burn_rate_pinned():
+    # 30% bad over a 1% budget burns 30x; zero budget never divides
+    assert A.burn_rate(0.3, 0.01) == pytest.approx(30.0)
+    assert A.burn_rate(0.0, 0.01) == 0.0
+    assert A.burn_rate(0.5, 0.0) == 0.0
+
+
+def test_ratio_burn_rule_fast_and_slow_windows_must_agree():
+    # cumulative counters sampled each 10s over 120s. First 60s: clean
+    # (0 rejects); last 60s: heavy rejects -> slow window dilutes.
+    bad, ok = [], []
+    total_bad = total_ok = 0
+    for t in range(13):
+        ts = t * 10_000
+        if t > 6:
+            total_bad += 30
+            total_ok += 70
+        else:
+            total_ok += 100
+        bad.append([ts, float(total_bad)])
+        ok.append([ts, float(total_ok)])
+    series = {"SERVING_REJECTED_TOTAL": {"serving:0": bad},
+              "SERVING_SUBMITTED_TOTAL": {"serving:0": ok}}
+    rule = A.ratio_burn_rule(
+        "serve.reject_rate_burn", "SERVING_REJECTED_TOTAL",
+        "SERVING_SUBMITTED_TOTAL", budget_fraction=0.01,
+        fast_ms=60_000, slow_ms=120_000, factor=14.0)
+    ctx = A.AlertContext(now_ms=120_000, history_fn=series.get)
+    obs = rule.evaluate(ctx)
+    # fast window: 180/600 = 30% -> 30x; slow: 180/1200 = 15% -> 15x;
+    # both >= 14 -> fires, with the evidence in the annotations
+    assert len(obs) == 1
+    assert obs[0]["key"] == "serving:0"
+    assert obs[0]["annotations"]["burn_fast"] == pytest.approx(30.0)
+    assert obs[0]["annotations"]["burn_slow"] == pytest.approx(15.0)
+    # a factor between the two windows' burns must NOT fire (slow-window
+    # filter: a fast blip alone never pages)
+    strict = A.ratio_burn_rule(
+        "serve.reject_rate_burn", "SERVING_REJECTED_TOTAL",
+        "SERVING_SUBMITTED_TOTAL", budget_fraction=0.01,
+        fast_ms=60_000, slow_ms=120_000, factor=20.0)
+    assert strict.evaluate(ctx) == []
+
+
+def test_gauge_burn_rule_ttft_ceiling():
+    # TTFT p95 above the 0.5s ceiling for the whole back half of the
+    # run: the fast window (t=60..120s: 7 samples, 6 bad) burns
+    # (6/7)/0.01 ≈ 85.7x budget, the slow (13 samples, 6 bad) ≈ 46x —
+    # both over the factor, so the rule fires with pinned evidence
+    pts = [[t * 10_000, 0.1 if t <= 6 else 0.9] for t in range(13)]
+    series = {"SERVING_TTFT_P95_S": {"serving:0": pts}}
+    rule = A.gauge_burn_rule("serve.ttft_p95_burn", "SERVING_TTFT_P95_S",
+                             0.5, fast_ms=60_000, slow_ms=120_000,
+                             factor=14.0)
+    obs = rule.evaluate(A.AlertContext(now_ms=120_000,
+                                       history_fn=series.get))
+    assert len(obs) == 1
+    assert obs[0]["annotations"]["burn_fast"] == pytest.approx(
+        round(600.0 / 7.0, 3))
+    assert obs[0]["annotations"]["burn_slow"] == pytest.approx(
+        round(600.0 / 13.0, 3))
+
+
+# ---------------------------------------------------------------------------
+# rule specs + conf builders
+# ---------------------------------------------------------------------------
+
+def test_parse_duration_and_rule_spec():
+    assert A.parse_duration_ms("500ms") == 500
+    assert A.parse_duration_ms("30s") == 30_000
+    assert A.parse_duration_ms("5m") == 300_000
+    rule = A.parse_rule_spec(
+        "hbm.high:TPU_MEMORY_USAGE_PCT>95:for=30s:severity=critical")
+    assert rule.rule_id == "hbm.high"
+    assert rule.severity == "critical" and rule.for_ms == 30_000
+    obs = rule.evaluate(A.AlertContext(
+        now_ms=0, gauges={"worker:1": {"TPU_MEMORY_USAGE_PCT": 97.0}}))
+    assert obs[0]["key"] == "worker:1"
+    for bad in ("nonsense", "id:METRIC~5", "id:M>5:for=xx",
+                "id:M>5:severity=shouty", "id:M>5:scope=galaxy"):
+        with pytest.raises(ValueError):
+            A.parse_rule_spec(bad)
+
+
+def test_am_gates_legacy_slo_checks_when_engine_subsumes_them(tmp_path):
+    """One condition, one notifier: with only legacy tony.slo.* keys
+    set, the engine inherits the thresholds AND the AM zeroes the
+    legacy watchdog's matching checks — a regression must not produce
+    SLO_VIOLATION and ALERT_FIRING in parallel every tick."""
+    from tony_tpu.am.application_master import ApplicationMaster
+    from tony_tpu.cluster.backend import ClusterBackend
+    from tony_tpu.conf import TonyConfiguration, keys as K
+
+    class _NullBackend(ClusterBackend):
+        off_host = False
+
+        def set_callbacks(self, *a, **k): ...
+        def start(self): ...
+        def stop(self): ...
+        def request_containers(self, *a, **k): ...
+        def release_container(self, *a, **k): ...
+        def launch_container(self, *a, **k): ...
+        def stop_container(self, *a, **k): ...
+        def validate_coresident(self, *a, **k): ...
+
+    conf = TonyConfiguration()
+    conf.set(K.SLO_STEP_TIME_REGRESSION_PCT, 40, "t")
+    conf.set(K.SLO_GOODPUT_FLOOR_PCT, 60, "t")
+    am = ApplicationMaster.__new__(ApplicationMaster)
+    try:
+        ApplicationMaster.__init__(am, conf, "app_gate_test",
+                                   str(tmp_path), backend=_NullBackend())
+    except TypeError:
+        pytest.skip("backend stub drifted from ClusterBackend ABC")
+    assert am.alert_engine is not None
+    rules = {r.rule_id for r in am.alert_engine.rules}
+    assert {"train.step_time_regression",
+            "train.goodput_floor"} <= rules
+    assert am.slo.step_regression_pct == 0
+    assert am.slo.goodput_floor_pct == 0
+
+
+def test_engine_from_conf_builds_rules_and_slo_fallback():
+    from tony_tpu.conf import TonyConfiguration, keys as K
+    conf = TonyConfiguration()
+    conf.set(K.SLO_STEP_TIME_REGRESSION_PCT, 50, "t")   # legacy key
+    conf.set(K.ALERTS_GOODPUT_FLOOR_PCT, 70, "t")
+    conf.set(K.ALERTS_TTFT_P95_SLO_MS, 500, "t")
+    conf.set(K.ALERTS_REJECT_RATE_BUDGET_PCT, 1.0, "t")
+    conf.set(K.ALERTS_RULES, "hbm.high:TPU_MEMORY_USAGE_PCT>95", "t")
+    eng = A.engine_from_conf(conf)
+    assert sorted(r.rule_id for r in eng.rules) == [
+        "hbm.high", "serve.reject_rate_burn", "serve.ttft_p95_burn",
+        "train.goodput_floor", "train.step_time_regression"]
+    # disabled entirely
+    off = TonyConfiguration()
+    off.set(K.ALERTS_ENABLED, False, "t")
+    off.set(K.ALERTS_GOODPUT_FLOOR_PCT, 70, "t")
+    assert A.engine_from_conf(off) is None
+    # no live thresholds -> no engine, no per-tick work
+    assert A.engine_from_conf(TonyConfiguration()) is None
+
+
+# ---------------------------------------------------------------------------
+# attempt-aware step-regression baseline (the SloWatchdog fix)
+# ---------------------------------------------------------------------------
+
+def test_step_regression_baseline_resets_on_attempt_bump():
+    from tony_tpu.observability.perf import SloWatchdog
+    dog = SloWatchdog(step_regression_pct=50)
+    steady = [[i, 100.0] for i in range(8)]
+    assert dog.current_step_regressions({"worker:0": steady}) == []
+    # a real regression within attempt 0 is detected
+    regressed = steady + [[8, 400.0]]
+    out = dog.current_step_regressions({"worker:0": regressed})
+    assert out and out[0]["task_id"] == "worker:0"
+    # relaunch: attempt 1's recompile steps land in the SAME series.
+    # Pre-fix these tripped the latch against attempt 0's baseline;
+    # now the bump resets the baseline window to the new attempt.
+    recompile = regressed + [[i, 400.0] for i in range(9, 14)]
+    assert dog.current_step_regressions(
+        {"worker:0": recompile}, attempts={"worker:0": 1}) == []
+    # ...and the new attempt's own baseline IS the slow recompile pace,
+    # so a further regression within attempt 1 still fires
+    worse = recompile + [[14, 400.0], [15, 1200.0]]
+    out = dog.current_step_regressions(
+        {"worker:0": worse}, attempts={"worker:0": 1})
+    assert out and out[0]["value"] == 1200.0
+    assert "attempt 1" in out[0]["message"]
+
+
+def test_step_regression_baseline_survives_series_decimation():
+    """The baseline mark is a timestamp, not an index: the TimeSeries
+    behind the trajectories halves itself in place when full, so an
+    index recorded at the attempt bump would drift (or point past the
+    end forever). Detection must keep working on a series that
+    decimated after the bump."""
+    from tony_tpu.observability.perf import SloWatchdog
+    dog = SloWatchdog(step_regression_pct=50)
+    attempt0 = [[i, 100.0] for i in range(8)]
+    assert dog.current_step_regressions({"w:0": attempt0}) == []
+    # attempt bump observed with one new-attempt point at the tail
+    bump = attempt0 + [[8, 400.0]]
+    assert dog.current_step_regressions({"w:0": bump},
+                                        attempts={"w:0": 1}) == []
+    # the series then DECIMATES (every other point) while attempt 1
+    # keeps appending: the boundary timestamp still cuts correctly
+    decimated = attempt0[::2] + [[8, 400.0], [9, 400.0], [10, 400.0]]
+    assert dog.current_step_regressions({"w:0": decimated},
+                                        attempts={"w:0": 1}) == []
+    # ...and a genuine regression within attempt 1 still fires on the
+    # decimated series
+    worse = decimated + [[11, 400.0], [12, 1800.0]]
+    out = dog.current_step_regressions({"w:0": worse},
+                                       attempts={"w:0": 1})
+    assert out and out[0]["value"] == 1800.0
+
+
+def test_legacy_check_rearms_latch_on_attempt_bump():
+    from tony_tpu.observability.perf import SloWatchdog
+    dog = SloWatchdog(step_regression_pct=50)
+    series = {"worker:0": [[i, 100.0] for i in range(7)] + [[8, 400.0]]}
+    assert len(dog.check(series)) == 1
+    assert dog.check(series) == []            # latched
+    # the relaunch resets both baseline and latch: no violation reported
+    # for the replacement's identical-looking slow tail
+    series2 = {"worker:0": series["worker:0"]
+               + [[i, 400.0] for i in range(9, 15)]}
+    assert dog.check(series2, attempts={"worker:0": 1}) == []
+    assert dog.active() == []
+
+
+def test_step_regression_rule_wraps_watchdog():
+    rule = A.step_regression_rule(50.0)
+    series = {"TRAIN_STEP_TIME_MS": {
+        "worker:3": [[i, 100.0] for i in range(7)] + [[8, 300.0]]}}
+    obs = rule.evaluate(A.AlertContext(now_ms=0,
+                                       history_fn=series.get))
+    assert obs[0]["key"] == "worker:3"
+    assert rule.rule_id == "train.step_time_regression"
+
+
+# ---------------------------------------------------------------------------
+# job + fleet rules
+# ---------------------------------------------------------------------------
+
+def test_goodput_and_mfu_floor_rules():
+    good = A.goodput_floor_rule(60.0)
+    assert good.evaluate(A.AlertContext(
+        now_ms=0, job={"goodput_pct": 45.0}))[0]["key"] == "job"
+    assert good.evaluate(A.AlertContext(
+        now_ms=0, job={"goodput_pct": 75.0})) == []
+    # absence of data is never a violation
+    assert good.evaluate(A.AlertContext(now_ms=0)) == []
+    mfu = A.mfu_floor_rule(30.0)
+    assert mfu.evaluate(A.AlertContext(
+        now_ms=0, job={"mfu_pct": 12.0}))[0]["value"] == 12.0
+
+
+def _job(app, state="RUNNING", queue="prod", requested=8, allocated=8,
+         **extra):
+    from tony_tpu.observability import fleet
+    summary = fleet.job_summary(app, "u", queue, state, gang_width=2,
+                                requested_chips=requested,
+                                allocated_chips=allocated,
+                                started_ms=1000)
+    summary.update(extra)
+    return summary
+
+
+def test_fleet_rules_quota_lost_and_idle_chips():
+    ctx = A.AlertContext(now_ms=0, fleet={
+        "queues": {"prod": 32, "dev": 100},
+        "jobs": [
+            _job("app_a", allocated=31),            # prod at 97%
+            _job("app_b", state="LOST"),
+            _job("app_c", queue="dev", requested=16, allocated=0),
+        ]})
+    quota = A.queue_quota_rule(95.0).evaluate(ctx)
+    assert [o["key"] for o in quota] == ["queue:prod"]
+    assert quota[0]["value"] == pytest.approx(96.88, abs=0.01)
+    lost = A.job_lost_rule().evaluate(ctx)
+    assert [o["key"] for o in lost] == ["job:app_b"]
+    idle = A.idle_chips_rule().evaluate(ctx)
+    assert [o["key"] for o in idle] == ["job:app_c"]
+    # a saturated queue excuses the wait: no idle-chips observation
+    ctx2 = A.AlertContext(now_ms=0, fleet={
+        "queues": {"prod": 31},
+        "jobs": [_job("app_a", allocated=31),
+                 _job("app_d", requested=16, allocated=0)]})
+    assert A.idle_chips_rule().evaluate(ctx2) == []
+
+
+def test_fleet_view_alerts_and_families(tmp_path):
+    from tony_tpu.observability.fleet import FleetView
+    from tony_tpu.observability.prometheus import get_sample, parse, render
+    eng = _engine([A.queue_quota_rule(95.0), A.job_lost_rule()],
+                  _Clock())
+    view = FleetView(str(tmp_path), queues={"prod": 32},
+                     settle_accounting=False, alert_engine=eng)
+    view.registry.observe(_job("app_a", allocated=31,
+                               alerts_firing=2))
+    view.refresh(force=True)
+    firing = eng.firing()
+    assert [a["rule_id"] for a in firing] == [
+        "fleet.queue_quota_saturated"]
+    payload = view.api_alerts()
+    assert payload["firing"][0]["key"] == "queue:prod"
+    # jobs reporting their own firing alerts surface too
+    assert payload["jobs"][0]["app_id"] == "app_a"
+    assert payload["jobs"][0]["alerts_firing"] == 2
+    parsed = parse(render(view.families()))
+    assert get_sample(parsed, "tony_alert_firing",
+                      rule="fleet.queue_quota_saturated",
+                      severity="warning") == 1.0
+    # the per-job gauge republished through the fleet exposition
+    assert get_sample(parsed, "tony_job_alerts_firing",
+                      app_id="app_a", queue="prod", user="u") == 2.0
+
+
+# ---------------------------------------------------------------------------
+# bundle + timeline + portal + CLI surfaces
+# ---------------------------------------------------------------------------
+
+def _alerts_bundle():
+    return {
+        "firing": [{"rule_id": "train.goodput_floor", "key": "job",
+                    "severity": "warning", "scope": "job",
+                    "since_ms": 5000, "value": 42.0, "threshold": 60.0,
+                    "message": "job goodput 42.0% below the 60% floor",
+                    "flaps": 0}],
+        "log": [
+            {"ts_ms": 5000, "rule_id": "train.goodput_floor",
+             "key": "job", "status": "firing", "severity": "warning",
+             "scope": "job", "value": 42.0, "threshold": 60.0,
+             "message": "job goodput 42.0% below the 60% floor",
+             "suppressed": False, "for_ms": 1000},
+            {"ts_ms": 9000, "rule_id": "train.goodput_floor",
+             "key": "job", "status": "resolved", "severity": "warning",
+             "scope": "job", "value": 65.0, "threshold": 60.0,
+             "message": "", "suppressed": False, "active_ms": 4000},
+        ],
+        "rules": ["train.goodput_floor"],
+        "generated_ms": 9000,
+    }
+
+
+def test_alerts_file_roundtrip(tmp_path):
+    from tony_tpu.events.history import read_alerts_file, write_alerts_file
+    write_alerts_file(str(tmp_path), _alerts_bundle())
+    assert read_alerts_file(str(tmp_path)) == _alerts_bundle()
+    assert read_alerts_file(str(tmp_path / "missing")) == {}
+
+
+def test_alert_event_roundtrip_and_render():
+    from tony_tpu.events.render import render_event
+    from tony_tpu.events.schema import AlertFiring, AlertResolved, Event
+    ev = Event(EventType.ALERT_FIRING,
+               AlertFiring(rule_id="serve.ttft_p95_burn", key="serving:0",
+                           severity="page", scope="task", value=28.0,
+                           threshold=14.0, message="burning", for_ms=900))
+    back = Event.from_dict(ev.to_dict())
+    assert back.payload.rule_id == "serve.ttft_p95_burn"
+    text = render_event("ALERT_FIRING", ev.to_dict()["payload"])
+    assert "serve.ttft_p95_burn" in text and "page" in text
+    ev2 = Event(EventType.ALERT_RESOLVED,
+                AlertResolved(rule_id="serve.ttft_p95_burn",
+                              key="serving:0", active_ms=1234))
+    assert "1234" in render_event("ALERT_RESOLVED",
+                                  ev2.to_dict()["payload"])
+
+
+def test_incident_timeline_orders_and_correlates():
+    events = [
+        {"type": "TASK_RELAUNCHED", "timestamp": 4000,
+         "payload": {"task_type": "worker", "task_index": 1,
+                     "attempt": 1, "generation": 2, "reason": "crash"}},
+        {"type": "STRAGGLER_DETECTED", "timestamp": 7000,
+         "payload": {"task_type": "worker", "task_index": 2,
+                     "signal": "step_time_ms", "phase": "steady_state",
+                     "span_ids": ["abc123"]}},
+        # the same firing the alert log carries: must dedup
+        {"type": "ALERT_FIRING", "timestamp": 5000,
+         "payload": {"rule_id": "train.goodput_floor", "key": "job",
+                     "severity": "warning"}},
+        {"type": "TASK_FINISHED", "timestamp": 8000,
+         "payload": {"task_type": "worker", "task_index": 0,
+                     "status": "SUCCEEDED"}},
+    ]
+    diagnostics = {"first_failure": {"task_id": "worker:1", "attempt": 0,
+                                     "ts_ms": 3500, "reason": "exit 1",
+                                     "signature": "device_oom"},
+                   "first_failure_spans": [{"span_id": "def456"}]}
+    timeline = A.build_incident_timeline(
+        events=events, alerts_bundle=_alerts_bundle(),
+        diagnostics=diagnostics)
+    ts = [r["ts_ms"] for r in timeline]
+    assert ts == sorted(ts)
+    kinds = [r["kind"] for r in timeline]
+    assert kinds.count("diagnosis") == 1
+    # alert log entry at 5000 deduped against the ALERT_FIRING event
+    firing_rows = [r for r in timeline
+                   if "train.goodput_floor" in r["summary"]
+                   and "FIRING" in r["summary"]]
+    assert len(firing_rows) == 1
+    # healthy TASK_FINISHED stays out; span links survive
+    assert not any("SUCCEEDED" in r["summary"] for r in timeline)
+    spans = [r.get("span_ids") for r in timeline if r.get("span_ids")]
+    assert ["abc123"] in spans and ["def456"] in spans
+
+
+def _history_app(tmp_path, app, bundle=None, status="SUCCEEDED"):
+    from tony_tpu.events.handler import EventHandler
+    from tony_tpu.events.history import JobMetadata, write_alerts_file
+    inter = tmp_path / "inter"
+    md = JobMetadata(application_id=app, started=1000)
+    handler = EventHandler(str(inter / app), md)
+    handler.start()
+    handler.stop(status)
+    if bundle is not None:
+        write_alerts_file(str(inter / app), bundle)
+    return inter
+
+
+def test_portal_serves_alerts_api_timeline_and_panel(tmp_path):
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.server import PortalServer
+    app = "application_alerts_1"
+    inter = _history_app(tmp_path, app, bundle=_alerts_bundle())
+    cache = PortalCache(str(inter), str(tmp_path / "fin"))
+    server = PortalServer(cache, port=0, host="127.0.0.1")
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/api/jobs/{app}/alerts",
+                                    timeout=10) as resp:
+            bundle = json.loads(resp.read())
+        assert bundle["source"] == "history"
+        assert bundle["firing"][0]["rule_id"] == "train.goodput_floor"
+        with urllib.request.urlopen(f"{base}/api/jobs/{app}/timeline",
+                                    timeout=10) as resp:
+            timeline = json.loads(resp.read())
+        assert any("train.goodput_floor" in r["summary"]
+                   for r in timeline)
+        with urllib.request.urlopen(f"{base}/jobs/{app}",
+                                    timeout=10) as resp:
+            page = resp.read().decode()
+        assert "Firing alerts" in page
+        assert "Incident timeline" in page
+        assert "train.goodput_floor" in page
+    finally:
+        server.stop()
+
+
+def test_portal_fleet_alerts_api_and_index_panel(tmp_path):
+    from tony_tpu.observability.fleet import FleetView
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.server import PortalServer
+    eng = _engine([A.job_lost_rule()], _Clock())
+    view = FleetView(str(tmp_path / "store"), queues={"prod": 32},
+                     settle_accounting=False, alert_engine=eng)
+    view.registry.observe(_job("app_lost", state="LOST",
+                               alerts_firing=0))
+    view.registry.observe(_job("app_hot", alerts_firing=3))
+    cache = PortalCache(str(tmp_path / "inter"), str(tmp_path / "fin"))
+    server = PortalServer(cache, port=0, host="127.0.0.1", fleet=view)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/api/fleet/alerts",
+                                    timeout=10) as resp:
+            payload = json.loads(resp.read())
+        assert [a["rule_id"] for a in payload["firing"]] == [
+            "fleet.job_lost"]
+        apps = {j["app_id"]: j for j in payload["jobs"]}
+        assert apps["app_hot"]["alerts_firing"] == 3
+        assert "app_lost" in apps
+        with urllib.request.urlopen(base, timeout=10) as resp:
+            page = resp.read().decode()
+        assert "firing alerts" in page
+        assert "fleet.job_lost" in page
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=10) as resp:
+            exposition = resp.read().decode()
+        assert "tony_alert_firing" in exposition
+    finally:
+        server.stop()
+
+
+def test_cli_alerts_renders_bundle_offline(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import alerts as cli_alerts
+    app = "application_alerts_cli"
+    inter = _history_app(tmp_path, app, bundle=_alerts_bundle())
+    assert cli_alerts([str(inter / app)]) == 0
+    out = capsys.readouterr().out
+    assert "1 firing alert(s):" in out
+    assert "train.goodput_floor" in out
+    assert "incident timeline" in out
+    assert cli_alerts([str(inter / app), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["rules"] == [
+        "train.goodput_floor"]
+
+
+def test_cli_alerts_missing_bundle(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import alerts as cli_alerts
+    assert cli_alerts([str(tmp_path)]) == 1
+    assert "no alert bundle" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# static checks (tier-1 CI hygiene)
+# ---------------------------------------------------------------------------
+
+_PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tony_tpu")
+
+
+def _source(*rel):
+    with open(os.path.join(_PKG_ROOT, *rel), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_every_rule_id_literal_is_registered():
+    """No silently-dead rules: every quoted built-in rule id appearing
+    in the control-plane sources must be a key of BUILTIN_RULES — a
+    renamed or removed rule cannot leave a dangling reference that
+    no engine would ever evaluate."""
+    import re
+    sources = ["am/application_master.py", "portal/server.py",
+               "portal/__main__.py", "cli/__main__.py",
+               "observability/alerts.py", "observability/fleet.py"]
+    referenced = set()
+    for rel in sources:
+        referenced |= set(re.findall(
+            r"[\"']((?:train|serve|fleet)\.[a-z0-9_]+)[\"']",
+            _source(*rel.split("/"))))
+    unknown = sorted(referenced - set(A.BUILTIN_RULES))
+    assert not unknown, (
+        "rule-id literals not registered in alerts.BUILTIN_RULES "
+        f"(silently dead): {unknown}")
+    # and the table itself stays honest: every entry is buildable from
+    # a conf that enables everything
+    from tony_tpu.conf import TonyConfiguration, keys as K
+    conf = TonyConfiguration()
+    for key, value in ((K.ALERTS_STEP_REGRESSION_PCT, 50),
+                       (K.ALERTS_GOODPUT_FLOOR_PCT, 60),
+                       (K.ALERTS_MFU_FLOOR_PCT, 30),
+                       (K.ALERTS_TTFT_P95_SLO_MS, 500),
+                       (K.ALERTS_QUEUE_DEPTH_SLO, 32),
+                       (K.ALERTS_REJECT_RATE_BUDGET_PCT, 1.0)):
+        conf.set(key, value, "t")
+    built = {r.rule_id for r in A.engine_from_conf(conf).rules}
+    built |= {r.rule_id for r in A.fleet_engine_from_conf(conf).rules}
+    assert built == set(A.BUILTIN_RULES)
+
+
+def test_alert_engine_never_touches_the_hot_loop():
+    """The acceptance bound: the engine runs only on the AM monitor
+    cadence and the portal fleet-scan cadence. No module on the trainer/
+    executor/serving hot paths may import or evaluate it."""
+    hot_paths = []
+    for sub in ("train", "executor"):
+        for dirpath, _, files in os.walk(os.path.join(_PKG_ROOT, sub)):
+            hot_paths += [os.path.join(dirpath, f) for f in sorted(files)
+                          if f.endswith(".py")]
+    hot_paths += [os.path.join(_PKG_ROOT, "serve", f)
+                  for f in ("engine.py", "frontend.py", "__main__.py")]
+    offenders = []
+    for path in hot_paths:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        if "observability.alerts" in src or "AlertEngine" in src \
+                or "import alerts" in src:
+            offenders.append(os.path.relpath(path, _PKG_ROOT))
+    assert not offenders, (
+        "alerting reached a hot-loop module (the engine must run only "
+        f"on monitor/fleet cadence): {offenders}")
+    # positive control: the two sanctioned evaluate() call sites exist
+    assert "_check_alerts" in _source("am", "application_master.py")
+    assert "alert_engine.evaluate" in _source("observability", "fleet.py")
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e
+# ---------------------------------------------------------------------------
+
+class _WebhookServer:
+    def __init__(self):
+        self.received: list[dict] = []
+        outer = self
+
+        class _Hook(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                outer.received.append(
+                    json.loads(self.rfile.read(length).decode()))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.HTTPServer(("127.0.0.1", 0), _Hook)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.httpd.server_port}/hook"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def _alert_overrides(sink_file, webhook_url, **extra):
+    over = {
+        # threshold = 5x the per-attempt baseline: wide enough that CI
+        # scheduling jitter on clean 30ms steps never crosses it, while
+        # the injected +300ms stall clears it by >2x
+        "tony.alerts.step-regression-pct": 400,
+        "tony.alerts.for-ms": 200,
+        "tony.alerts.flap-suppress-ms": 0,
+        "tony.alerts.file": sink_file,
+        "tony.alerts.webhook-url": webhook_url,
+        "tony.alerts.webhook-timeout-ms": 1000,
+    }
+    over.update(extra)
+    return over
+
+
+@pytest.mark.chaos
+def test_alert_fires_and_resolves_e2e(tmp_path):
+    """Acceptance: an injected steady-state step delay + goodput drop
+    (every gang member stalls +300ms/step for steps 40-56, carved into
+    input_stall) drives the step-regression AND goodput-floor rules
+    pending → firing — ALERT_FIRING history events, webhook + file-sink
+    delivery, alerts.json, /api/jobs/:id/alerts, the portal incident
+    timeline — and the step rule → resolved after the fault clears."""
+    from tests.chaos import ChaosRun
+    webhook = _WebhookServer()
+    sink_file = str(tmp_path / "alert-sink.jsonl")
+    run = ChaosRun(tmp_path, seed=31)
+    try:
+        run.run(
+            ["--executes", script("alert_gang_worker.py"),
+             "--conf", "tony.worker.instances=3"],
+            conf_overrides=_alert_overrides(
+                sink_file, webhook.url,
+                **{"tony.alerts.goodput-floor-pct": 55}),
+            extra_env={"ALERT_STEP_MS": 30, "ALERT_PUSH_STEPS": 4,
+                       "ALERT_RUN_SECONDS": 4.0,
+                       "ALERT_MIN_STEPS": 84,
+                       "ALERT_FAULT": "40:56:300"})
+    finally:
+        webhook.stop()
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+
+    firing = run.events_of_type(EventType.ALERT_FIRING)
+    resolved = run.events_of_type(EventType.ALERT_RESOLVED)
+    fired_rules = {e.payload.rule_id for e in firing}
+    assert "train.step_time_regression" in fired_rules, run.all_logs()
+    assert "train.goodput_floor" in fired_rules, run.all_logs()
+    step_fired = [e for e in firing
+                  if e.payload.rule_id == "train.step_time_regression"]
+    assert step_fired[0].payload.key.startswith("worker:")
+    assert step_fired[0].payload.for_ms >= 200
+    # the fault cleared: the step-regression alert resolved before the
+    # run ended (the goodput floor is cumulative — whether it climbs
+    # back above the floor inside the run depends on wall-clock load,
+    # so only its FIRING is pinned)
+    resolved_rules = {e.payload.rule_id for e in resolved}
+    assert "train.step_time_regression" in resolved_rules, run.all_logs()
+
+    # delivery: webhook received the firing transition(s), file sink
+    # appended them, and both carry the evidence
+    assert webhook.received, run.all_logs()
+    assert any(p.get("status") == "firing" for p in webhook.received)
+    with open(sink_file, "r", encoding="utf-8") as f:
+        sunk = [json.loads(line) for line in f if line.strip()]
+    assert any(p["status"] == "resolved" for p in sunk)
+
+    # alerts.json landed in history with the full transition log
+    from tony_tpu.events.history import read_alerts_file
+    bundle = read_alerts_file(run.app_history_dir())
+    statuses = [t["status"] for t in bundle.get("log", [])]
+    assert "firing" in statuses and "resolved" in statuses
+    # no step-regression alert stays latched after the fault cleared
+    assert not any(a["rule_id"] == "train.step_time_regression"
+                   for a in bundle.get("firing", []))
+
+    # surfaces: /api/jobs/:id/alerts + the portal incident timeline
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.server import PortalServer
+    hist_root = os.path.dirname(run.app_history_dir())
+    cache = PortalCache(hist_root, str(tmp_path / "fin"))
+    server = PortalServer(cache, port=0, host="127.0.0.1")
+    server.start()
+    try:
+        app_id = os.path.basename(run.app_history_dir())
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(
+                f"{base}/api/jobs/{app_id}/alerts", timeout=10) as resp:
+            api_bundle = json.loads(resp.read())
+        assert [t["status"] for t in api_bundle["log"]] == statuses
+        with urllib.request.urlopen(f"{base}/jobs/{app_id}",
+                                    timeout=10) as resp:
+            page = resp.read().decode()
+        assert "Incident timeline" in page
+        assert "train.step_time_regression" in page
+    finally:
+        server.stop()
+
+    # ...and the CLI renders the same bundle offline
+    from tony_tpu.cli.__main__ import alerts as cli_alerts
+    assert cli_alerts([run.app_history_dir()]) == 0
+
+
+@pytest.mark.chaos
+def test_step_regression_no_false_positive_after_relaunch_e2e(tmp_path):
+    """The SloWatchdog fix, pinned under chaos: a task killed mid-run
+    relaunches and its replacement runs slow recompile steps. The
+    attempt-aware baseline makes those steps the NEW baseline — no
+    step-regression alert fires for the relaunched slot, and the job
+    converges to SUCCEEDED."""
+    from tests.chaos import ChaosRun, KillTask
+    run = ChaosRun(tmp_path, seed=32)
+    run.run(
+        ["--executes", script("alert_gang_worker.py"),
+         "--conf", "tony.worker.instances=3",
+         "--conf", "tony.task.max-task-attempts=2"],
+        injections=[KillTask("worker", 1, after_ms=1200, attempt=0)],
+        conf_overrides={
+            # 4x threshold: the +250ms recompile steps over a ~30ms
+            # attempt-0 baseline WOULD fire without the attempt-aware
+            # reset — the counterfactual this test exists to rule out
+            "tony.alerts.step-regression-pct": 300,
+            "tony.alerts.for-ms": 200,
+        },
+        extra_env={"ALERT_STEP_MS": 30, "ALERT_PUSH_STEPS": 4,
+                   "ALERT_RUN_SECONDS": 4.0,
+                   "ALERT_MIN_STEPS": 48,
+                   "ALERT_RECOMPILE_STEPS": 8,
+                   "ALERT_RECOMPILE_MS": 250})
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    relaunches = run.relaunches()
+    assert len(relaunches) == 1 and relaunches[0].task_index == 1, \
+        run.all_logs()
+    # the engine WAS alive with the rule registered (the no-alert
+    # assertion below must not pass vacuously)
+    from tony_tpu.events.history import read_alerts_file
+    bundle = read_alerts_file(run.app_history_dir())
+    assert "train.step_time_regression" in bundle.get("rules", []), bundle
+    # the replacement's slow recompile tail must NOT read as a
+    # regression against the dead attempt's steady state
+    step_alerts = [
+        e for e in run.events_of_type(EventType.ALERT_FIRING)
+        if e.payload.rule_id == "train.step_time_regression"]
+    assert step_alerts == [], (
+        [f"{e.payload.key}: {e.payload.message}" for e in step_alerts],
+        run.all_logs())

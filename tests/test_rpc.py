@@ -85,6 +85,9 @@ class FakeClusterHandler(ClusterServiceHandler):
         return {"signals": {}, "heatmap": {"tasks": {}},
                 "stragglers": [], "detections": []}
 
+    def get_alerts(self, req):
+        return {"firing": [], "log": [], "rules": []}
+
 
 class FakeMetricsHandler(MetricsServiceHandler):
     def __init__(self):

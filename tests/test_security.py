@@ -49,6 +49,9 @@ class FakeHandler:
     def get_skew(self, req):
         return {"stragglers": []}
 
+    def get_alerts(self, req):
+        return {"firing": [], "log": []}
+
     def read_task_logs(self, req):
         return {"data": "", "next_offset": 0, "eof": False}
 
@@ -204,3 +207,80 @@ def test_planted_token_never_ships_in_tails_or_diagnostics(tmp_path):
     dumped = json.dumps(record)
     assert secret not in dumped and task_token not in dumped
     assert record["signature"] == "device_oom"
+
+
+def test_planted_token_never_ships_through_alert_sinks(tmp_path):
+    """Webhook-sink security (observability/alerts.py): REAL
+    token-scheme material — a 64-hex app secret and a Bearer credential
+    — planted in an alert annotation/message must be redacted in the
+    payload delivered to BOTH the webhook POST body and the file sink;
+    and a webhook pointed at a dead endpoint retries a bounded number
+    of times within bounded time, then gives up."""
+    import http.server
+    import json
+    import threading
+    import time
+
+    from tony_tpu.observability.alerts import (
+        AlertContext, AlertEngine, AlertRule, FileSink, WebhookSink,
+    )
+    from tony_tpu.security.tokens import derive_task_token
+
+    secret = generate_token()
+    task_token = derive_task_token(secret, "worker:0")
+
+    received = []
+
+    class _Hook(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            received.append(self.rfile.read(length).decode())
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _Hook)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    sink_file = tmp_path / "alerts.jsonl"
+
+    def leaky(ctx):
+        return [{"key": "worker:0", "value": 1.0, "threshold": 0.0,
+                 "message": f"task env held TONY_SECURITY_TOKEN={secret}",
+                 "annotations": {
+                     "header": f"Authorization: Bearer {task_token}",
+                     "stray": task_token}}]
+
+    engine = AlertEngine(
+        [AlertRule("leak.test", leaky, for_ms=0)],
+        default_for_ms=0, flap_suppress_ms=0,
+        sinks=[WebhookSink(f"http://127.0.0.1:{httpd.server_port}/hook",
+                           timeout_s=5.0, retries=0),
+               FileSink(str(sink_file))])
+    try:
+        transitions = engine.evaluate(AlertContext(now_ms=1000))
+        assert [t["status"] for t in transitions] == ["firing"]
+        assert engine.drain(timeout_s=10.0)
+        deadline = time.monotonic() + 10.0
+        while (not received or not sink_file.exists()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert received and sink_file.exists()
+        for shipped in (received[0], sink_file.read_text()):
+            assert secret not in shipped
+            assert task_token not in shipped
+            assert "<redacted>" in shipped
+            payload = json.loads(shipped)
+            assert payload["rule_id"] == "leak.test"   # shape survives
+    finally:
+        engine.close()
+        httpd.shutdown()
+
+    # bounded retry-then-give-up: nothing listens on the target; 2
+    # retries at 0.2s timeout + 0.05s backoff must fail within ~2s
+    dead = WebhookSink("http://127.0.0.1:9/never", timeout_s=0.2,
+                       retries=2, backoff_s=0.05)
+    t0 = time.monotonic()
+    assert dead.deliver({"rule_id": "x"}) is False
+    assert time.monotonic() - t0 < 5.0

@@ -282,10 +282,26 @@ def test_frontend_backpressure_fills_429_then_drains_and_accepts(model):
             _post(frontend.port, {"prompt": prompt, "max_new_tokens": 3})
         assert e.value.code == 429
         assert e.value.headers.get("Retry-After")
-        # a never-fits request is a 400, not a retryable 429
+        # the shed request is a first-class SLI now: the admission
+        # counters feed the reject-rate burn-rate alert rule, and the
+        # scrape carries them (serve_bench's scraped-metrics contract)
+        snap = engine.snapshot()
+        assert snap["requests_rejected"] == 1
+        assert snap["requests_submitted"] == 2     # the two held ones
+        names = {m["name"]: m["value"] for m in engine.metrics()}
+        assert names["SERVING_REJECTED_TOTAL"] == 1.0
+        assert names["SERVING_SUBMITTED_TOTAL"] == 2.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{frontend.port}/v1/metrics"
+                f"?format=prometheus", timeout=10) as resp:
+            exposition = resp.read().decode()
+        assert "tony_serving_requests_rejected" in exposition
+        # a never-fits request is a 400, not a retryable 429 — and not
+        # a reject-rate SLI event either (retrying can never help)
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(frontend.port, {"prompt": prompt, "max_new_tokens": 99})
         assert e.value.code == 400
+        assert engine.snapshot()["requests_rejected"] == 1
         # drain, then the same request is accepted and served
         engine.start()
         _drain_started(held)

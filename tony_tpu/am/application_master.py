@@ -40,10 +40,10 @@ from tony_tpu.conf import TonyConfiguration, keys as K
 from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
-    ApplicationFinished, ApplicationInited, DiagnosticsReady, Event,
-    EventType, ProfileCaptured, ServingEndpointRegistered, SloViolation,
-    StragglerCleared, StragglerDetected, TaskFinished, TaskRelaunched,
-    TaskStarted,
+    AlertFiring, AlertResolved, ApplicationFinished, ApplicationInited,
+    DiagnosticsReady, Event, EventType, ProfileCaptured,
+    ServingEndpointRegistered, SloViolation, StragglerCleared,
+    StragglerDetected, TaskFinished, TaskRelaunched, TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor
 from tony_tpu.rpc.service import (
@@ -270,6 +270,13 @@ class MetricsStore(MetricsServiceHandler):
                 out[f"{task_type}:{index}"] = gauges
         return out
 
+    def attempts(self) -> dict[str, int]:
+        """Latest attempt a push arrived from, keyed "<type>:<index>" —
+        the SLO watchdog's / alert engine's attempt-aware baseline
+        input."""
+        with self._lock:
+            return {f"{t}:{i}": a for (t, i), a in self._attempts.items()}
+
     def metric_histories(self, metric_name: str) -> dict[str, list]:
         """One metric's trajectory across every task slot, keyed
         "<task_type>:<index>" — the SLO watchdog's step-time input."""
@@ -361,6 +368,28 @@ class ApplicationMaster(ClusterServiceHandler):
             step_regression_pct=conf.get_int(
                 K.SLO_STEP_TIME_REGRESSION_PCT, 0),
             goodput_floor_pct=conf.get_int(K.SLO_GOODPUT_FLOOR_PCT, 0))
+        # rule-driven alerting (observability/alerts.py): declarative
+        # rules over the SAME trajectories/ledgers the dashboards read,
+        # evaluated only on the monitor cadence (_check_alerts) — never
+        # from the trainer hot loop. None when disabled or no rule has a
+        # live threshold.
+        from tony_tpu.observability.alerts import engine_from_conf
+        self.alert_engine = engine_from_conf(conf)
+        # subsumption, not duplication: when the engine carries the
+        # step-regression / goodput-floor rule (its thresholds inherit
+        # the legacy tony.slo.* keys), the legacy watchdog's matching
+        # check is disabled — one condition must not notify twice per
+        # tick through two parallel event streams
+        if self.alert_engine is not None:
+            engine_rules = {r.rule_id for r in self.alert_engine.rules}
+            if "train.step_time_regression" in engine_rules:
+                self.slo.step_regression_pct = 0
+            if "train.goodput_floor" in engine_rules:
+                self.slo.goodput_floor_pct = 0
+        # (rule_id, severity) combos currently exported as
+        # tony_alert_firing gauges, so a rule that stops firing zeroes
+        # its sample instead of freezing at the last count
+        self._alert_gauge_combos: set[tuple[str, str]] = set()
         # cross-task skew analytics + straggler detection
         # (observability/skew.py): the MetricsStore offers every numeric
         # gauge to the tracker's windowed sketches (O(buckets) per
@@ -653,6 +682,9 @@ class ApplicationMaster(ClusterServiceHandler):
         straggler_count = (len(self.straggler.active())
                            if self._straggler_enabled else 0)
         gauges["tony_job_straggler_count"] = float(straggler_count)
+        alerts_firing = (len(self.alert_engine.firing())
+                         if self.alert_engine is not None else 0)
+        gauges["tony_job_alerts_firing"] = float(alerts_firing)
         for q, gauge_name in fleet.STEP_TIME_GAUGES.items():
             if q in self._step_time_quantiles:
                 gauges[gauge_name] = self._step_time_quantiles[q]
@@ -673,6 +705,7 @@ class ApplicationMaster(ClusterServiceHandler):
             started_ms=self.metadata.started,
             goodput_pct=goodput_pct, mfu_pct=mfu,
             straggler_count=straggler_count,
+            alerts_firing=alerts_firing,
             serving_tokens_per_sec=serving_tps,
             gauges=gauges)
 
@@ -734,8 +767,8 @@ class ApplicationMaster(ClusterServiceHandler):
         """Spans + metric timeseries into the history dir, next to the
         event log (the portal's waterfall and metrics.json sources)."""
         from tony_tpu.events.history import (
-            write_goodput_file, write_metrics_file, write_skew_file,
-            write_spans_file,
+            write_alerts_file, write_goodput_file, write_metrics_file,
+            write_skew_file, write_spans_file,
         )
         try:
             if self._trace_enabled:
@@ -755,6 +788,12 @@ class ApplicationMaster(ClusterServiceHandler):
                                              force=True)
                 write_skew_file(self.history_dir,
                                 self.skew_tracker.bundle(self.straggler))
+            if self.alert_engine is not None:
+                # final bundle, then a bounded drain so in-flight sink
+                # deliveries land before the process exits
+                write_alerts_file(self.history_dir,
+                                  self.alert_engine.bundle())
+                self.alert_engine.drain(timeout_s=3.0)
         except Exception:  # noqa: BLE001 — observability must not fail _finish
             LOG.exception("failed to flush spans/metrics into history")
 
@@ -968,7 +1007,7 @@ class ApplicationMaster(ClusterServiceHandler):
             for extra in (C.PORTAL_CONFIG_FILE, C.SPANS_FILE,
                           C.METRICS_FILE, C.GOODPUT_FILE,
                           C.DIAGNOSTICS_FILE, C.SKEW_FILE,
-                          C.JOBSTATE_FILE):
+                          C.JOBSTATE_FILE, C.ALERTS_FILE):
                 p = os.path.join(self.history_dir, extra)
                 if os.path.exists(p):
                     store.put(p, f"history/{extra}")
@@ -1211,6 +1250,7 @@ class ApplicationMaster(ClusterServiceHandler):
                     self._close_relaunch_downtime()
             self._check_slo()
             self._check_stragglers()
+            self._check_alerts()
             self._publish_fleet_state()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
@@ -1255,8 +1295,9 @@ class ApplicationMaster(ClusterServiceHandler):
             step_series = (
                 self.metrics_store.metric_histories("TRAIN_STEP_TIME_MS")
                 if self.slo.step_regression_pct > 0 else {})
-            violations = self.slo.check(step_series,
-                                        goodput_pct=goodput_pct)
+            violations = self.slo.check(
+                step_series, goodput_pct=goodput_pct,
+                attempts=self.metrics_store.attempts())
             for v in violations:
                 LOG.warning("SLO violation (%s): %s", v["kind"],
                             v["message"])
@@ -1274,6 +1315,92 @@ class ApplicationMaster(ClusterServiceHandler):
                     len(self.slo.active()))
         except Exception:  # noqa: BLE001 — the watchdog must never kill the AM
             LOG.exception("SLO check failed")
+
+    def _check_alerts(self) -> None:
+        """One alert-engine pass (monitor-loop cadence; the engine's
+        only AM-side call site — the hot loop never pays for alerting):
+        evaluate every rule over the existing store snapshots, emit
+        ALERT_FIRING / ALERT_RESOLVED history events for non-suppressed
+        transitions, refresh the tony_alert_firing gauges, and — on any
+        transition — refresh the alerts.json sidecar so the portal's
+        fallback tracks a RUNNING job."""
+        engine = self.alert_engine
+        if engine is None:
+            return
+        try:
+            from tony_tpu.observability.alerts import AlertContext
+            job: dict = {}
+            if self._goodput_enabled:
+                gd = self.goodput_dict()
+                # no ledgers yet = absence of data, not a violation
+                if gd["tasks"]:
+                    job["goodput_pct"] = gd["job"]["goodput_pct"]
+                    mfus = [e["mfu_pct"] for e in gd["tasks"].values()
+                            if isinstance(e.get("mfu_pct"),
+                                          (int, float))]
+                    if mfus:
+                        job["mfu_pct"] = round(sum(mfus) / len(mfus), 3)
+            ctx = AlertContext(
+                gauges=self.metrics_store.latest_gauges(),
+                history_fn=self.metrics_store.metric_histories,
+                attempts=self.metrics_store.attempts(),
+                job=job)
+            transitions = engine.evaluate(ctx)
+            for t in transitions:
+                if t.get("suppressed"):
+                    continue
+                if t["status"] == "firing":
+                    LOG.warning("alert FIRING [%s] %s on %s: %s",
+                                t["severity"], t["rule_id"], t["key"],
+                                t["message"])
+                    self.event_handler.emit(Event(
+                        EventType.ALERT_FIRING,
+                        AlertFiring(
+                            rule_id=t["rule_id"], key=t["key"],
+                            severity=t["severity"], scope=t["scope"],
+                            value=float(t.get("value", 0.0) or 0.0),
+                            threshold=float(t.get("threshold", 0.0)
+                                            or 0.0),
+                            message=t.get("message", ""),
+                            for_ms=int(t.get("for_ms", 0) or 0))))
+                else:
+                    LOG.info("alert resolved [%s] %s on %s",
+                             t["severity"], t["rule_id"], t["key"])
+                    self.event_handler.emit(Event(
+                        EventType.ALERT_RESOLVED,
+                        AlertResolved(
+                            rule_id=t["rule_id"], key=t["key"],
+                            severity=t["severity"], scope=t["scope"],
+                            active_ms=int(t.get("active_ms", 0) or 0),
+                            message=t.get("message", ""))))
+            self._refresh_alert_gauges()
+            if transitions:
+                from tony_tpu.events.history import write_alerts_file
+                write_alerts_file(self.history_dir, engine.bundle())
+        except Exception:  # noqa: BLE001 — alerting must never kill the AM
+            LOG.exception("alert check failed")
+
+    def _refresh_alert_gauges(self) -> None:
+        """tony_alert_firing{rule, severity} per-combo counts into the
+        process registry (AM /metrics); combos that stopped firing zero
+        out instead of freezing at their last count."""
+        from tony_tpu.observability.metrics import REGISTRY
+        counts = self.alert_engine.firing_counts()
+        for rule, severity in self._alert_gauge_combos - set(counts):
+            REGISTRY.gauge("tony_alert_firing", rule=rule,
+                           severity=severity, app_id=self.app_id).set(0)
+        for (rule, severity), n in counts.items():
+            REGISTRY.gauge("tony_alert_firing", rule=rule,
+                           severity=severity, app_id=self.app_id).set(n)
+        self._alert_gauge_combos = set(counts)
+
+    def get_alerts(self, req: dict) -> dict:
+        """Operator plane: the live alert bundle (portal
+        /api/jobs/:id/alerts proxy + CLI --follow). Same shape as the
+        alerts.json flushed into history."""
+        if self.alert_engine is None:
+            return {"error": "alerting disabled (tony.alerts.enabled)"}
+        return self.alert_engine.bundle()
 
     def _build_skew_state(self) -> None:
         """(Re)construct the skew tracker + straggler analyzer from the
@@ -1533,6 +1660,8 @@ class ApplicationMaster(ClusterServiceHandler):
     def _teardown(self) -> None:
         self.backend.stop()
         self.hb_monitor.stop()
+        if self.alert_engine is not None:
+            self.alert_engine.close()
         with self._lock:
             log_clients = list(self._log_clients.values())
             self._log_clients.clear()

@@ -1,5 +1,5 @@
 """`python -m tony_tpu.cli
-{submit|local|notebook|profile|logs|diagnose|stragglers|top} ...`
+{submit|local|notebook|profile|logs|diagnose|stragglers|alerts|top} ...`
 
 - submit   — ClusterSubmitter equivalent (cli/ClusterSubmitter.java:41-94):
              run against the configured cluster workdir; app artifacts
@@ -22,6 +22,10 @@
 - stragglers — render a job's cross-task skew bundle (skew.json) offline
              from history: latched stragglers with evidence, gang
              quantiles per signal, and the step-time heatmap.
+- alerts   — render a job's alert bundle (alerts.json) offline from
+             history: firing alerts, the transition log, and the
+             incident timeline correlated with events + diagnostics;
+             `--follow` polls for new transitions.
 - top      — polling text view of the live fleet over a shared staging
              location (the jobstate.json registry every AM publishes
              into): per-job state/chips/goodput plus per-queue
@@ -38,8 +42,8 @@ from tony_tpu.cli.local_submitter import submit as local_submit
 from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
-         "{submit|local|notebook|profile|logs|diagnose|stragglers|top} "
-         "[args...]")
+         "{submit|local|notebook|profile|logs|diagnose|stragglers"
+         "|alerts|top} [args...]")
 
 
 def _am_client(app_dir: str):
@@ -334,6 +338,116 @@ def stragglers(argv: list[str]) -> int:
     return 0
 
 
+def _print_alert_line(t: dict) -> None:
+    status = str(t.get("status", "?")).upper()
+    print(f"  [{t.get('ts_ms', 0)}] {status:<8} "
+          f"[{t.get('severity', 'warning')}] {t.get('rule_id', '?')} "
+          f"on {t.get('key', '?')}"
+          + (f": {t['message']}" if t.get("message") else ""))
+
+
+def alerts(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli alerts <target> [--json] [--follow]` —
+    render a job's alert bundle offline from history (the same
+    alerts.json the portal's panel reads): firing alerts, the bounded
+    transition log, and the incident timeline correlated from the event
+    log + diagnostics bundle when they sit next to it. `--follow`
+    re-polls the bundle and prints new transitions as the AM appends
+    them (the AM refreshes alerts.json on every transition)."""
+    import argparse
+    import glob as _glob
+    import json
+    import os
+    import time
+
+    from tony_tpu import constants as C
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli alerts")
+    parser.add_argument("target",
+                        help="app dir, history dir, or an alerts.json")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the raw bundle instead of a summary")
+    parser.add_argument("--follow", "-f", action="store_true",
+                        help="keep polling for new transitions until "
+                             "Ctrl-C")
+    parser.add_argument("--poll-ms", type=int, default=1000,
+                        help="--follow poll interval")
+    args = parser.parse_args(argv)
+    bundle, searched = _find_history_json(args.target, C.ALERTS_FILE)
+    if bundle is None:
+        print("no alert bundle found (searched: "
+              + ", ".join(searched[:4])
+              + "). The job may predate alerting, have no live rules, "
+                "or never have evaluated one.", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(bundle, indent=1, sort_keys=True))
+        return 0
+    firing = bundle.get("firing") or []
+    if firing:
+        print(f"{len(firing)} firing alert(s):")
+        for a in firing:
+            print(f"  [{a.get('severity', 'warning')}] "
+                  f"{a.get('rule_id', '?')} on {a.get('key', '?')} "
+                  f"since {a.get('since_ms', 0)}: "
+                  f"{a.get('message', '')} "
+                  f"(value {a.get('value', 0)} vs threshold "
+                  f"{a.get('threshold', 0)})")
+    else:
+        print("no firing alerts")
+    log = bundle.get("log") or []
+    if log:
+        print(f"{len(log)} transition(s) in the log:")
+        for t in log[-20:]:
+            _print_alert_line(t)
+    # incident timeline when the bundle sits inside a history dir that
+    # also holds the event log / diagnostics bundle
+    bundle_path = next((p for p in searched if os.path.isfile(p)), None)
+    if bundle_path is not None:
+        hist_dir = os.path.dirname(os.path.abspath(bundle_path))
+        events = []
+        for jhist in sorted(_glob.glob(os.path.join(
+                hist_dir, "*." + C.HISTORY_SUFFIX))):
+            try:
+                from tony_tpu.events.handler import parse_events
+                events = [e.to_dict() for e in parse_events(jhist)]
+                break
+            except Exception:  # noqa: BLE001 — timeline is best-effort
+                continue
+        diagnostics, _ = _find_history_json(hist_dir, C.DIAGNOSTICS_FILE)
+        from tony_tpu.observability.alerts import build_incident_timeline
+        timeline = build_incident_timeline(
+            events=events, alerts_bundle=bundle,
+            diagnostics=diagnostics)
+        if timeline:
+            print(f"incident timeline ({len(timeline)} entr(ies)):")
+            for r in timeline:
+                spans = r.get("span_ids") or []
+                print(f"  [{r.get('ts_ms', 0)}] "
+                      f"{r.get('severity', 'info'):<8} "
+                      f"{r.get('kind', '?'):<9} "
+                      f"{r.get('summary', '')}"
+                      + (f" (spans: {', '.join(spans)})"
+                         if spans else ""))
+    if not args.follow:
+        return 0
+    last_ts = max((int(t.get("ts_ms", 0) or 0) for t in log), default=0)
+    try:
+        while True:
+            time.sleep(max(100, args.poll_ms) / 1000.0)
+            bundle, _ = _find_history_json(args.target, C.ALERTS_FILE)
+            if bundle is None:
+                continue
+            fresh = [t for t in bundle.get("log") or []
+                     if int(t.get("ts_ms", 0) or 0) > last_ts]
+            for t in fresh:
+                _print_alert_line(t)
+                last_ts = max(last_ts, int(t.get("ts_ms", 0) or 0))
+            sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
+
+
 def _render_fleet_frame(view) -> str:
     """One `cli top` frame: the live jobs table (state-then-start
     order, like the portal index) + per-queue quota rollups."""
@@ -348,7 +462,7 @@ def _render_fleet_frame(view) -> str:
                  f"{sum(chips_of(j) for j in live)} chip(s) in use")
     header = (f"{'APP':<36} {'QUEUE':<10} {'USER':<10} {'STATE':<9} "
               f"{'W':>3} {'CHIPS':>5} {'GOOD%':>6} {'MFU%':>6} "
-              f"{'STRAG':>5} {'TOK/S':>7} {'HB':>5}")
+              f"{'STRAG':>5} {'ALRT':>4} {'TOK/S':>7} {'HB':>5}")
     lines.append(header)
     for j in jobs:
         age = max(0.0, (now_ms - int(j.get("heartbeat_ms", 0) or 0))
@@ -367,6 +481,7 @@ def _render_fleet_frame(view) -> str:
             f"{_pct(j.get('goodput_pct')):>6} "
             f"{_pct(j.get('mfu_pct')):>6} "
             f"{int(j.get('straggler_count', 0) or 0):>5} "
+            f"{int(j.get('alerts_firing', 0) or 0):>4} "
             + (f"{float(j['serving_tokens_per_sec']):>7.0f} "
                if j.get("serving_tokens_per_sec") is not None
                else f"{'-':>7} ")
@@ -519,6 +634,8 @@ def main(argv: list[str] | None = None) -> int:
         return diagnose(rest)
     if cmd == "stragglers":
         return stragglers(rest)
+    if cmd == "alerts":
+        return alerts(rest)
     if cmd == "top":
         return top(rest)
     print(USAGE, file=sys.stderr)

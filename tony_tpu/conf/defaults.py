@@ -37,6 +37,9 @@ NO_DEFAULT_KEYS = frozenset({
     K.CLUSTER_NODES,
     K.CLUSTER_SSH_OPTS,
     K.PROXY_URL,
+    K.ALERTS_RULES,
+    K.ALERTS_WEBHOOK_URL,
+    K.ALERTS_FILE_SINK,
     K.HISTORY_LOCATION,
     K.HISTORY_INTERMEDIATE,
     K.HISTORY_FINISHED,
@@ -123,6 +126,24 @@ DEFAULTS = {
     K.STRAGGLER_HEATMAP_WINDOWS: 32,
     K.STRAGGLER_MIN_TASKS: 3,
     K.STRAGGLER_RELAUNCH_AFTER_WINDOWS: 0,   # 0 = detect only
+    # alerting engine (observability/alerts.py)
+    K.ALERTS_ENABLED: True,
+    K.ALERTS_FOR_MS: 10_000,
+    K.ALERTS_FLAP_SUPPRESS_MS: 60_000,
+    K.ALERTS_LOG_MAX_ENTRIES: 256,
+    K.ALERTS_WEBHOOK_TIMEOUT_MS: 2000,
+    K.ALERTS_WEBHOOK_RETRIES: 2,
+    K.ALERTS_FAST_WINDOW_MS: 300_000,     # 5 min
+    K.ALERTS_SLOW_WINDOW_MS: 3_600_000,   # 1 h
+    K.ALERTS_BURN_RATE_FACTOR: 14.0,      # classic fast-burn page factor
+    K.ALERTS_TTFT_P95_SLO_MS: 0,          # 0 = rule disabled
+    K.ALERTS_QUEUE_DEPTH_SLO: 0,          # 0 = rule disabled
+    K.ALERTS_REJECT_RATE_BUDGET_PCT: 0.0,  # 0 = rule disabled
+    K.ALERTS_STEP_REGRESSION_PCT: 0,      # 0 = inherit tony.slo.*
+    K.ALERTS_GOODPUT_FLOOR_PCT: 0,        # 0 = inherit tony.slo.*
+    K.ALERTS_MFU_FLOOR_PCT: 0,            # 0 = rule disabled
+    K.ALERTS_QUEUE_QUOTA_PCT: 95,
+    K.ALERTS_IDLE_CHIPS_FOR_MS: 120_000,
     # fleet registry / chip-hour accounting (observability/fleet.py)
     K.FLEET_PUBLISH_INTERVAL_MS: 5000,
     K.FLEET_STALE_AFTER_MS: 30_000,

@@ -185,6 +185,56 @@ STRAGGLER_MIN_TASKS = "tony.straggler.min-tasks"
 # many consecutive windows is routed through the task-attempt relaunch
 # machinery (attempt-fenced, budget-counted); 0 = detect only
 STRAGGLER_RELAUNCH_AFTER_WINDOWS = "tony.straggler.relaunch-after-windows"
+# alerting engine (observability/alerts.py): declarative rules evaluated
+# on the AM monitor cadence (and the portal's fleet-scan cadence for
+# fleet-scope rules) over the EXISTING metric trajectories / goodput
+# ledger / fleet registry — no new collection, zero hot-loop work.
+ALERTS_ENABLED = "tony.alerts.enabled"
+# custom rules (multi-value, appended across conf layers). Spec grammar:
+#   <rule-id>:<METRIC><op><threshold>[:for=<dur>][:severity=<sev>]
+#   [:scope=task|job]
+# e.g. "hbm.high:TPU_MEMORY_USAGE_PCT>95:for=30s:severity=critical"
+ALERTS_RULES = "tony.alerts.rules"
+# default pending duration: a rule's condition must hold this long
+# before pending escalates to firing (per-rule `for=` overrides)
+ALERTS_FOR_MS = "tony.alerts.for-ms"
+# a resolved alert that re-fires within this window is a flap: the
+# transition still latches and lands in the alert log, but sinks and
+# history events are suppressed until the signal stabilizes
+ALERTS_FLAP_SUPPRESS_MS = "tony.alerts.flap-suppress-ms"
+# bound on retained alert-transition log entries (alerts.json `log`)
+ALERTS_LOG_MAX_ENTRIES = "tony.alerts.log-max-entries"
+# delivery sinks: webhook POST (bounded retry on a daemon worker — the
+# monitor thread never blocks on delivery) and an append-only JSON-lines
+# file; every outbound payload passes through logs.redact()
+ALERTS_WEBHOOK_URL = "tony.alerts.webhook-url"
+ALERTS_WEBHOOK_TIMEOUT_MS = "tony.alerts.webhook-timeout-ms"
+ALERTS_WEBHOOK_RETRIES = "tony.alerts.webhook-retries"
+ALERTS_FILE_SINK = "tony.alerts.file"
+# multi-window burn-rate evaluation (serving SLO rules): both the fast
+# and the slow trailing window must burn the error budget at >= this
+# factor for the rule to fire — fast catches the page-worthy cliff,
+# slow filters the blip
+ALERTS_FAST_WINDOW_MS = "tony.alerts.fast-window-ms"
+ALERTS_SLOW_WINDOW_MS = "tony.alerts.slow-window-ms"
+ALERTS_BURN_RATE_FACTOR = "tony.alerts.burn-rate-factor"
+# serving SLO thresholds (0 disables the respective built-in rule):
+# TTFT p95 ceiling (ms), engine queue-depth ceiling, and the 429/reject
+# error budget in percent of submitted requests
+ALERTS_TTFT_P95_SLO_MS = "tony.alerts.ttft-p95-slo-ms"
+ALERTS_QUEUE_DEPTH_SLO = "tony.alerts.queue-depth-slo"
+ALERTS_REJECT_RATE_BUDGET_PCT = "tony.alerts.reject-rate-budget-pct"
+# training SLO thresholds; 0 falls back to the legacy tony.slo.* keys
+# (the engine's rules subsume the SloWatchdog's checks)
+ALERTS_STEP_REGRESSION_PCT = "tony.alerts.step-regression-pct"
+ALERTS_GOODPUT_FLOOR_PCT = "tony.alerts.goodput-floor-pct"
+ALERTS_MFU_FLOOR_PCT = "tony.alerts.mfu-floor-pct"
+# fleet-scope rules (evaluated by the portal's FleetView refresh):
+# queue-quota saturation percentage, and how long a RUNNING job may sit
+# with zero allocated chips (while its queue has headroom) before the
+# chips-idle-while-queued rule fires
+ALERTS_QUEUE_QUOTA_PCT = "tony.alerts.queue-quota-saturation-pct"
+ALERTS_IDLE_CHIPS_FOR_MS = "tony.alerts.idle-chips-for-ms"
 # fleet layer (observability/fleet.py): cross-job registry + chip-hour
 # accounting over the staging store. With a staging location configured,
 # each AM republishes its heartbeat-stamped jobstate.json summary at
@@ -246,6 +296,7 @@ MULTI_VALUE_CONF = frozenset({
     CONTAINERS_RESOURCES,
     EXECUTION_ENV,
     APPLICATION_UNTRACKED_JOBTYPES,
+    ALERTS_RULES,
 })
 
 # --- dynamic per-jobtype keys -------------------------------------------
@@ -258,7 +309,7 @@ RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
-    "profiling", "slo", "logs", "straggler", "fleet",
+    "profiling", "slo", "logs", "straggler", "fleet", "alerts",
 })
 
 

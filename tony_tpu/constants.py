@@ -135,6 +135,12 @@ FLEET_DIR_NAME = "fleet"             # staging-store namespace of the fleet
                                      # layer: <app_id>/fleet/jobstate.json
                                      # per job, fleet/accounting.json at the
                                      # store root (durable chip-hour ledger)
+ALERTS_FILE = "alerts.json"          # alert-engine bundle flushed next to
+                                     # the event log (observability/alerts.py):
+                                     # currently-firing alerts + the bounded
+                                     # transition log; refreshed on every
+                                     # transition so the portal's sidecar
+                                     # fallback stays live-ish mid-run
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------

@@ -143,6 +143,19 @@ def read_skew_file(history_dir: str) -> dict:
     return out if isinstance(out, dict) else {}
 
 
+def write_alerts_file(history_dir: str, alerts: dict) -> None:
+    """alerts: observability.alerts.AlertEngine.bundle's shape —
+    currently-firing alerts + the bounded transition log. Refreshed on
+    every transition (not just at finish) so the portal's sidecar
+    fallback tracks a RUNNING job's alert state."""
+    _write_json_atomic(os.path.join(history_dir, C.ALERTS_FILE), alerts)
+
+
+def read_alerts_file(history_dir: str) -> dict:
+    out = _read_json(os.path.join(history_dir, C.ALERTS_FILE), {})
+    return out if isinstance(out, dict) else {}
+
+
 def parse_history_file_name(name: str) -> JobMetadata:
     """Parse either a final or an in-progress history file name back into
     JobMetadata (reference: JobMetadata constructor parsing,

@@ -89,6 +89,22 @@ def _straggler_cleared(p: dict) -> str:
             f"{p.get('windows_lagging', 0)} lagging window(s))")
 
 
+def _alert_firing(p: dict) -> str:
+    where = p.get("key") or p.get("scope", "job")
+    return (f"alert FIRING [{p.get('severity', 'warning')}] "
+            f"{p.get('rule_id', '?')} on {where}: "
+            f"{p.get('message', '') or 'condition held'} "
+            f"(value {p.get('value', 0)} vs threshold "
+            f"{p.get('threshold', 0)})")
+
+
+def _alert_resolved(p: dict) -> str:
+    where = p.get("key") or p.get("scope", "job")
+    return (f"alert resolved [{p.get('severity', 'warning')}] "
+            f"{p.get('rule_id', '?')} on {where} after "
+            f"{p.get('active_ms', 0)} ms firing")
+
+
 RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.APPLICATION_INITED: _application_inited,
     EventType.APPLICATION_FINISHED: _application_finished,
@@ -101,6 +117,8 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.DIAGNOSTICS_READY: _diagnostics_ready,
     EventType.STRAGGLER_DETECTED: _straggler_detected,
     EventType.STRAGGLER_CLEARED: _straggler_cleared,
+    EventType.ALERT_FIRING: _alert_firing,
+    EventType.ALERT_RESOLVED: _alert_resolved,
 }
 
 

@@ -28,6 +28,8 @@ class EventType(str, enum.Enum):
     DIAGNOSTICS_READY = "DIAGNOSTICS_READY"
     STRAGGLER_DETECTED = "STRAGGLER_DETECTED"
     STRAGGLER_CLEARED = "STRAGGLER_CLEARED"
+    ALERT_FIRING = "ALERT_FIRING"
+    ALERT_RESOLVED = "ALERT_RESOLVED"
 
 
 @dataclass
@@ -161,6 +163,36 @@ class StragglerCleared:
 
 
 @dataclass
+class AlertFiring:
+    """No reference equivalent: the alert engine
+    (observability/alerts.py) escalated one rule's pending condition to
+    firing — the condition held for the rule's `for`-duration. The
+    evidence travels with the event: observed value vs threshold, the
+    scope key (task id / queue / job), and severity. The matching
+    ALERT_RESOLVED shares the (rule_id, key) identity."""
+    rule_id: str            # e.g. "train.step_time_regression"
+    key: str = ""           # scope instance, e.g. "worker:3" or "queue:prod"
+    severity: str = "warning"   # info | warning | critical | page
+    scope: str = "job"      # job | task | queue | fleet
+    value: float = 0.0
+    threshold: float = 0.0
+    message: str = ""
+    for_ms: int = 0         # how long the condition held before firing
+
+
+@dataclass
+class AlertResolved:
+    """The firing alert's condition went false: the (rule_id, key)
+    instance resolved. `active_ms` is how long it was firing."""
+    rule_id: str
+    key: str = ""
+    severity: str = "warning"
+    scope: str = "job"
+    active_ms: int = 0
+    message: str = ""
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -181,12 +213,15 @@ _PAYLOADS = {
     EventType.DIAGNOSTICS_READY: DiagnosticsReady,
     EventType.STRAGGLER_DETECTED: StragglerDetected,
     EventType.STRAGGLER_CLEARED: StragglerCleared,
+    EventType.ALERT_FIRING: AlertFiring,
+    EventType.ALERT_RESOLVED: AlertResolved,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 TaskFinished, TaskRelaunched, ServingEndpointRegistered,
                 ProfileCaptured, SloViolation, DiagnosticsReady,
-                StragglerDetected, StragglerCleared]
+                StragglerDetected, StragglerCleared, AlertFiring,
+                AlertResolved]
 
 
 @dataclass

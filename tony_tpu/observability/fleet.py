@@ -74,6 +74,7 @@ JOB_GAUGES = {
     "tony_job_productive_seconds": "productive_s",
     "tony_job_relaunch_downtime_seconds": "relaunch_downtime_s",
     "tony_job_straggler_count": "straggler_count",
+    "tony_job_alerts_firing": "alerts_firing",
     "tony_job_step_time_p50_ms": "step_time_p50_ms",
     "tony_job_step_time_p95_ms": "step_time_p95_ms",
     "tony_job_step_time_p99_ms": "step_time_p99_ms",
@@ -95,6 +96,7 @@ def job_summary(app_id: str, user: str, queue: str, state: str, *,
                 goodput_pct: Optional[float] = None,
                 mfu_pct: Optional[float] = None,
                 straggler_count: int = 0,
+                alerts_firing: int = 0,
                 serving_tokens_per_sec: Optional[float] = None,
                 gauges: Optional[dict] = None,
                 heartbeat_ms: Optional[int] = None) -> dict:
@@ -116,6 +118,7 @@ def job_summary(app_id: str, user: str, queue: str, state: str, *,
         "goodput_pct": goodput_pct,
         "mfu_pct": mfu_pct,
         "straggler_count": int(straggler_count),
+        "alerts_firing": int(alerts_firing),
         "serving_tokens_per_sec": serving_tokens_per_sec,
         "gauges": dict(gauges or {}),
     }
@@ -615,8 +618,12 @@ def fleet_families(live_jobs: list[dict],
                   "user": str(job.get("user", "") or "")}
         chips += chips_of(job)
         gauges = job.get("gauges") or {}
-        for name in JOB_GAUGES:
+        for name, summary_field in JOB_GAUGES.items():
+            # the gauges map is authoritative; the named summary field
+            # backfills entries published before the gauge existed
             value = gauges.get(name)
+            if not isinstance(value, (int, float)):
+                value = job.get(summary_field)
             if isinstance(value, (int, float)):
                 fam = per_gauge.setdefault(
                     name, {"name": name, "type": "gauge", "help": "",
@@ -654,9 +661,16 @@ class FleetView:
                  stale_after_ms: int = 30_000, history_jobs: int = 200,
                  refresh_interval_ms: int = 1000,
                  clock: Callable[[], float] = time.time,
-                 settle_accounting: bool = True):
+                 settle_accounting: bool = True,
+                 alert_engine=None):
         self.location = location
         self.queues = {str(q): int(cap) for q, cap in (queues or {}).items()}
+        # fleet-scope alerting (observability/alerts.py: queue-quota
+        # saturation, job LOST, chips idle while queued), evaluated on
+        # THIS refresh cadence over the registry snapshot — the portal
+        # passes an engine built from its conf; `cli top` and tests may
+        # run without one
+        self.alert_engine = alert_engine
         # observers (cli top) read the durable accounting but never
         # advance it: ONE writer — the portal, running with the
         # cluster's configured staleness/bounds — owns the fold-and-save
@@ -672,6 +686,7 @@ class FleetView:
 
     def refresh(self, force: bool = False) -> None:
         self.registry.refresh(force=force)
+        self._check_alerts()
         if not self._settle_accounting:
             return
         for job in self.registry.jobs():
@@ -684,6 +699,21 @@ class FleetView:
                     f"{job.get('app_id', '')}/history/{C.GOODPUT_FILE}")
             self.ledger.fold(job, goodput=goodput)
         self.ledger.save()
+
+    def _check_alerts(self) -> None:
+        """One fleet-scope alert pass (the engine's only fleet-side
+        call site — fleet-scan cadence, nothing hotter). Transitions go
+        to the engine's sinks; the portal reads firing state via
+        api_alerts()/families()."""
+        if self.alert_engine is None:
+            return
+        try:
+            from tony_tpu.observability.alerts import AlertContext
+            self.alert_engine.evaluate(AlertContext(
+                fleet={"jobs": self.registry.jobs(),
+                       "queues": self.queues}))
+        except Exception:  # noqa: BLE001 — alerting must not break refresh
+            LOG.exception("fleet alert check failed")
 
     # -- API payloads (portal /api/fleet + /api/fleet/queues) ---------
     def api_fleet(self) -> dict:
@@ -705,5 +735,28 @@ class FleetView:
             "accounting": accounting,
         }
 
+    def api_alerts(self) -> dict:
+        """GET /api/fleet/alerts payload: the fleet-scope engine's
+        bundle plus every registry job's own firing count (the
+        tony_job_alerts_firing gauge each AM publishes in its
+        jobstate) — one endpoint answering 'what is paging, anywhere'."""
+        out: dict = {"firing": [], "log": [], "rules": []}
+        if self.alert_engine is not None:
+            out = self.alert_engine.bundle()
+        out["jobs"] = [
+            {"app_id": j.get("app_id", ""), "state": j.get("state", ""),
+             "queue": j.get("queue", ""), "user": j.get("user", ""),
+             "alerts_firing": int(j.get("alerts_firing", 0) or 0)}
+            for j in self.registry.jobs()
+            if int(j.get("alerts_firing", 0) or 0) > 0
+            or j.get("state") == LOST_STATE]
+        return out
+
     def families(self) -> list[dict]:
-        return fleet_families(self.registry.live_jobs(), self.queues)
+        families = fleet_families(self.registry.live_jobs(), self.queues)
+        if self.alert_engine is not None:
+            from tony_tpu.observability.alerts import (
+                alert_firing_families,
+            )
+            families += alert_firing_families(self.alert_engine.firing())
+        return families

@@ -307,14 +307,20 @@ class SloWatchdog:
     """Latched SLO checks over the AM's metric trajectories.
 
     - step-time regression: a task's latest TRAIN_STEP_TIME_MS exceeds
-      its own baseline (median of its first samples) by more than
-      `step_regression_pct` percent;
+      its own baseline (median of the first samples **of its current
+      attempt**) by more than `step_regression_pct` percent. The
+      baseline is attempt-aware: a task relaunch (attempt bump) resets
+      the baseline window to the new attempt's own samples, so a
+      replacement's recompile steps become the new baseline instead of
+      tripping the latch against the dead attempt's steady state;
     - goodput floor: job goodput_pct below `goodput_floor_pct`.
 
     `check()` returns only NEWLY-entered violations (the AM emits one
     WARNING history event per entry); the latch re-arms when the
-    condition recovers. Current state is exposed for alert gauges via
-    `active()`. Thresholds <= 0 disable the respective check."""
+    condition recovers. `current_step_regressions()` exposes the raw
+    currently-violating set without the latch — the alert engine's
+    step-regression rule reads that and runs its own lifecycle.
+    Thresholds <= 0 disable the respective check."""
 
     BASELINE_POINTS = 5
     MIN_POINTS = 3
@@ -324,46 +330,95 @@ class SloWatchdog:
         self.step_regression_pct = step_regression_pct
         self.goodput_floor_pct = goodput_floor_pct
         self._latched: set[str] = set()
+        # task_id -> (attempt the baseline belongs to, boundary
+        # timestamp: samples at or before it belong to dead attempts).
+        # A TIMESTAMP, not an index — the TimeSeries behind the series
+        # decimates in place when full, so an absolute index would
+        # drift (or point past the end forever) after a halving; the
+        # boundary survives decimation because surviving points keep
+        # their timestamps.
+        self._baseline_marks: dict[str, tuple[int, int]] = {}
 
     @staticmethod
     def _median(values: list[float]) -> float:
         ordered = sorted(values)
         return ordered[len(ordered) // 2]
 
+    def _baseline_boundary(self, task_id: str, attempt: int,
+                           points: list) -> int:
+        """Timestamp before which samples are excluded from the current
+        attempt's baseline window. First sighting of a slot keeps the
+        whole series; an attempt bump cuts at the series tail (the
+        trajectories survive a relaunch, so the dead attempt's points
+        must stay out of the new baseline) while keeping the newest
+        point — the push that announced the new attempt; monitor
+        cadence is at least as fast as the push cadence, so at most one
+        new-attempt point predates the bump being observed."""
+        mark = self._baseline_marks.get(task_id)
+        if mark is not None and mark[0] == attempt:
+            return mark[1]
+        boundary = -1
+        if mark is not None and len(points) >= 2:
+            boundary = int(points[-2][0])
+        self._baseline_marks[task_id] = (attempt, boundary)
+        # the old attempt's latched violation (if any) describes a task
+        # that no longer exists — re-arm
+        self._latched.discard(f"step_time:{task_id}")
+        return boundary
+
+    def current_step_regressions(
+            self, step_series: dict[str, list],
+            attempts: Optional[dict[str, int]] = None) -> list[dict]:
+        """The CURRENTLY-violating tasks (no latch): {"kind",
+        "task_id", "value", "threshold", "message"} dicts. `attempts`
+        maps task_id -> its latest attempt number (the MetricsStore's
+        per-slot attempt tracking); absent entries read as attempt 0."""
+        if self.step_regression_pct <= 0:
+            return []
+        attempts = attempts or {}
+        out: list[dict] = []
+        for task_id, points in sorted(step_series.items()):
+            points = [p for p in points
+                      if isinstance(p, (list, tuple)) and len(p) == 2]
+            attempt = int(attempts.get(task_id, 0) or 0)
+            boundary = self._baseline_boundary(task_id, attempt, points)
+            values = [float(v) for ts, v in points if ts > boundary]
+            if len(values) < max(self.MIN_POINTS,
+                                 self.BASELINE_POINTS // 2 + 1):
+                continue
+            baseline = self._median(values[:self.BASELINE_POINTS])
+            latest = values[-1]
+            threshold = baseline * (1.0 + self.step_regression_pct
+                                    / 100.0)
+            if baseline > 0 and latest > threshold:
+                out.append({
+                    "kind": "step_time_regression",
+                    "task_id": task_id,
+                    "value": round(latest, 3),
+                    "threshold": round(threshold, 3),
+                    "message": (
+                        f"step time {latest:.1f} ms exceeds baseline "
+                        f"{baseline:.1f} ms (attempt {attempt}) by more "
+                        f"than {self.step_regression_pct:.0f}%"),
+                })
+        return out
+
     def check(self, step_series: dict[str, list],
-              goodput_pct: Optional[float] = None) -> list[dict]:
+              goodput_pct: Optional[float] = None,
+              attempts: Optional[dict[str, int]] = None) -> list[dict]:
         """`step_series`: {task_id: [[ts_ms, step_ms], ...]} (the
         MetricsStore's TRAIN_STEP_TIME_MS trajectories). Returns newly
         entered violations as {"kind", "task_id"?, "value",
         "threshold", "message"} dicts."""
         fresh: list[dict] = []
         seen: set[str] = set()
-        if self.step_regression_pct > 0:
-            for task_id, points in sorted(step_series.items()):
-                values = [float(p[1]) for p in points
-                          if isinstance(p, (list, tuple)) and len(p) == 2]
-                if len(values) < max(self.MIN_POINTS,
-                                     self.BASELINE_POINTS // 2 + 1):
-                    continue
-                baseline = self._median(values[:self.BASELINE_POINTS])
-                latest = values[-1]
-                threshold = baseline * (1.0 + self.step_regression_pct
-                                        / 100.0)
-                key = f"step_time:{task_id}"
-                if baseline > 0 and latest > threshold:
-                    seen.add(key)
-                    if key not in self._latched:
-                        self._latched.add(key)
-                        fresh.append({
-                            "kind": "step_time_regression",
-                            "task_id": task_id,
-                            "value": round(latest, 3),
-                            "threshold": round(threshold, 3),
-                            "message": (
-                                f"step time {latest:.1f} ms exceeds "
-                                f"baseline {baseline:.1f} ms by more than "
-                                f"{self.step_regression_pct:.0f}%"),
-                        })
+        for violation in self.current_step_regressions(step_series,
+                                                       attempts=attempts):
+            key = f"step_time:{violation['task_id']}"
+            seen.add(key)
+            if key not in self._latched:
+                self._latched.add(key)
+                fresh.append(violation)
         if self.goodput_floor_pct > 0 and goodput_pct is not None:
             key = "goodput_floor"
             if goodput_pct < self.goodput_floor_pct:

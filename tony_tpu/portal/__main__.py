@@ -89,6 +89,7 @@ def main(argv=None) -> int:
     fleet = None
     if store_location:
         from tony_tpu.conf.queues import configured_queues
+        from tony_tpu.observability.alerts import fleet_engine_from_conf
         from tony_tpu.observability.fleet import FleetView
         fleet = FleetView(
             store_location,
@@ -97,7 +98,12 @@ def main(argv=None) -> int:
             history_jobs=conf.get_int(K.FLEET_HISTORY_JOBS, 200),
             refresh_interval_ms=max(
                 500, conf.get_time_ms(K.FLEET_PUBLISH_INTERVAL_MS,
-                                      5000) // 2))
+                                      5000) // 2),
+            # fleet-scope alert rules (queue saturation, job LOST, chips
+            # idle while queued) run on this view's refresh cadence;
+            # webhook/file sinks come from the same tony.alerts.* keys
+            # the AMs use
+            alert_engine=fleet_engine_from_conf(conf))
     server = PortalServer(cache, port=port, token=token,
                           user_tokens=user_tokens, fleet=fleet,
                           history_jobs=conf.get_int(K.FLEET_HISTORY_JOBS,

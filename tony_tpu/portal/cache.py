@@ -229,6 +229,13 @@ class PortalCache:
         latched stragglers + detection log. {} for old jobs."""
         return self._get_sidecar(job_id, C.SKEW_FILE, {})
 
+    def get_alerts(self, job_id: str) -> dict[str, Any]:
+        """Alert bundle (alerts.json sidecar): currently-firing alerts
+        + the bounded transition log. The AM refreshes it on every
+        transition, so this is live-ish even mid-run. {} for old jobs
+        or jobs that never alerted."""
+        return self._get_sidecar(job_id, C.ALERTS_FILE, {})
+
     def get_diagnostics(self, job_id: str) -> dict[str, Any]:
         """Root-cause bundle a failed job's AM flushed
         (diagnostics.json sidecar): first-failing task, exit signal,

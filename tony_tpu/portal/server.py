@@ -212,6 +212,15 @@ class _Handler(BaseHTTPRequestHandler):
             live = [j for j in self._fleet_jobs_visible()
                     if j.get("state") == "RUNNING"]
             families += fleet_families(live, self.fleet.queues)
+            if self.fleet.alert_engine is not None \
+                    and self._auth_user is None:
+                # cluster-level firing alerts (queues, LOST jobs) are
+                # admin-plane: a scoped token's scrape stays job-only
+                from tony_tpu.observability.alerts import (
+                    alert_firing_families,
+                )
+                families += alert_firing_families(
+                    self.fleet.alert_engine.firing())
         families += REGISTRY.families()
         self._send(200, render(families), "text/plain; version=0.0.4")
 
@@ -247,6 +256,17 @@ class _Handler(BaseHTTPRequestHandler):
                         chips_of(j) for j in jobs
                         if j.get("state") == "RUNNING")
                     payload["timeline"] = []
+                return self._json(payload)
+            if parts == ["fleet", "alerts"]:
+                payload = self.fleet.api_alerts()
+                if self._auth_user is not None:
+                    # scoped tokens see only their own jobs' counts;
+                    # cluster-level firing alerts (queues, LOST jobs)
+                    # would leak other tenants' state
+                    payload = {
+                        "firing": [], "log": [], "rules": [],
+                        "jobs": [j for j in payload.get("jobs", [])
+                                 if self._visible(j.get("user"))]}
                 return self._json(payload)
             if parts == ["fleet", "queues"]:
                 payload = self.fleet.api_queues()
@@ -287,6 +307,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # unreachable — the skew.json the AM flushed at finish
                 return self._json(self._skew_bundle(
                     job_id, md.status == "RUNNING"))
+            if what == "alerts":
+                # same live-then-sidecar ladder as skew
+                return self._json(self._alerts_bundle(
+                    job_id, md.status == "RUNNING"))
+            if what == "timeline":
+                return self._json(self._incident_timeline(job_id))
         if len(parts) == 4 and parts[0] == "jobs" and parts[2] == "logs":
             # /api/jobs/:id/logs/:task[?stream=&offset=&max_bytes=&follow]
             # — one bounded chunk; followers poll with the returned
@@ -387,6 +413,44 @@ class _Handler(BaseHTTPRequestHandler):
             bundle = dict(bundle)
             bundle["source"] = "history"
         return bundle
+
+    def _alerts_bundle(self, job_id: str, running: bool) -> dict:
+        """Live-then-sidecar alert bundle: a RUNNING job's bundle comes
+        from its AM's get_alerts RPC; anything else falls back to the
+        alerts.json the AM refreshes on every transition. Degrades
+        silently — alerting must never 500 a job page."""
+        am = self.cache.get_am_info(job_id) if running else {}
+        if running and am.get("host") and am.get("rpc_port") \
+                and not am.get("security_enabled"):
+            from tony_tpu.rpc.client import ClusterServiceClient
+            client = ClusterServiceClient(str(am["host"]),
+                                          int(am["rpc_port"]))
+            try:
+                bundle = client.get_alerts()
+                if isinstance(bundle, dict) and not bundle.get("error"):
+                    bundle["source"] = "live"
+                    return bundle
+            except Exception:  # noqa: BLE001 — degrade to the sidecar
+                LOG.debug("live alerts proxy to the AM failed",
+                          exc_info=True)
+            finally:
+                client.close()
+        bundle = self.cache.get_alerts(job_id)
+        if bundle:
+            bundle = dict(bundle)
+            bundle["source"] = "history"
+        return bundle
+
+    def _incident_timeline(self, job_id: str) -> list[dict]:
+        """Alerts + history events + straggler/SLO detections + the
+        diagnostics bundle correlated into one ordered view with span
+        links (observability/alerts.build_incident_timeline). Sidecar
+        sources only — the page render never blocks on a live RPC."""
+        from tony_tpu.observability.alerts import build_incident_timeline
+        return build_incident_timeline(
+            events=self.cache.get_events(job_id),
+            alerts_bundle=self.cache.get_alerts(job_id),
+            diagnostics=self.cache.get_diagnostics(job_id))
 
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
         """POST /api/jobs/:id/profile — forward an on-demand profiler
@@ -515,6 +579,7 @@ class _Handler(BaseHTTPRequestHandler):
                     f"{b['live_jobs']} job(s)</td></tr>")
             out.append("<p><b>queues</b></p><table>"
                        + "".join(bars) + "</table>")
+        out.append(self._fleet_alerts_html())
         out.append(self._fleet_timeline_html())
         if jobs:
             rows = []
@@ -535,6 +600,9 @@ class _Handler(BaseHTTPRequestHandler):
                     ("-" if j.get("mfu_pct") is None
                      else f"{j['mfu_pct']:.1f}%"),
                     str(j.get("straggler_count", 0)),
+                    (f'<span style="color:#c0392b"><b>'
+                     f"{int(j.get('alerts_firing', 0) or 0)}</b></span>"
+                     if int(j.get("alerts_firing", 0) or 0) else "0"),
                     ("-" if j.get("serving_tokens_per_sec") is None
                      else f"{j['serving_tokens_per_sec']:.0f}"),
                     f"{age_s:.0f}s",
@@ -542,9 +610,51 @@ class _Handler(BaseHTTPRequestHandler):
             out.append("<p><b>fleet registry</b></p>")
             out.append(_table(
                 ["Job", "Queue", "User", "State", "Width", "Chips",
-                 "Goodput", "MFU", "Strag", "Serve tok/s", "HB age"],
+                 "Goodput", "MFU", "Strag", "Alerts", "Serve tok/s",
+                 "HB age"],
                 rows))
         out.append("<h3>Job directory</h3>")
+        return "".join(out)
+
+    def _fleet_alerts_html(self) -> str:
+        """Cluster firing-alerts panel: the fleet-scope engine's firing
+        set (queue saturation, LOST jobs, queued gangs) + every
+        registry job that reports its own firing alerts. Admin/open
+        portals only — a scoped token's index stays job-scoped."""
+        if self._auth_user is not None:
+            return ""
+        out = []
+        rows = []
+        engine = getattr(self.fleet, "alert_engine", None)
+        if engine is not None:
+            for a in engine.firing():
+                sev = str(a.get("severity", "warning"))
+                color = self._SEVERITY_COLORS.get(sev, "#555")
+                rows.append([
+                    f'<span style="color:{color}"><b>{html.escape(sev)}'
+                    f"</b></span>",
+                    html.escape(str(a.get("rule_id", "?"))),
+                    html.escape(str(a.get("key", ""))),
+                    html.escape(str(a.get("message", ""))),
+                ])
+        job_rows = [
+            (str(j.get("app_id", "")), int(j.get("alerts_firing", 0)
+                                           or 0))
+            for j in self.fleet.registry.jobs()
+            if int(j.get("alerts_firing", 0) or 0) > 0]
+        if not rows and not job_rows:
+            return ""
+        out.append('<p><b style="color:#c0392b">firing alerts</b></p>')
+        if rows:
+            out.append(_table(["Severity", "Rule", "On", "Evidence"],
+                              rows))
+        if job_rows:
+            qs = getattr(self, "_link_qs", "")
+            items = "".join(
+                f'<li><a href="/jobs/{html.escape(app)}{qs}">'
+                f"{html.escape(app)}</a>: {n} firing</li>"
+                for app, n in job_rows)
+            out.append(f"<ul>{items}</ul>")
         return "".join(out)
 
     def _fleet_timeline_html(self) -> str:
@@ -581,9 +691,11 @@ class _Handler(BaseHTTPRequestHandler):
             ])
         self._html(f"events — {job_id}",
                    self._diagnostics_html(job_id)
+                   + self._alerts_html(job_id)
                    + self._serving_endpoints_html(job_id, events)
                    + self._skew_html(job_id)
                    + self._goodput_html(job_id)
+                   + self._timeline_html(job_id)
                    + self._waterfall_html(job_id)
                    + _table(["Time", "Event", "Summary", "Payload"], rows))
 
@@ -647,6 +759,59 @@ class _Handler(BaseHTTPRequestHandler):
         "relaunch_downtime": "#cc0000", "init": "#cccccc",
         "idle": "#efefef",
     }
+
+    # severity → display color on the alert/timeline panels
+    _SEVERITY_COLORS = {"info": "#555", "warning": "#b8860b",
+                        "critical": "#c0392b", "page": "#8e0000"}
+
+    def _alerts_html(self, job_id: str) -> str:
+        """Firing-alerts panel (alerts.json sidecar): rule, scope key,
+        severity, evidence. Empty string when nothing fires and nothing
+        ever fired — quiet jobs stay quiet."""
+        bundle = self.cache.get_alerts(job_id)
+        firing = (bundle or {}).get("firing") or []
+        if not firing:
+            return ""
+        rows = []
+        for a in firing:
+            sev = str(a.get("severity", "warning"))
+            color = self._SEVERITY_COLORS.get(sev, "#555")
+            rows.append([
+                f'<span style="color:{color}"><b>{html.escape(sev)}'
+                f"</b></span>",
+                html.escape(str(a.get("rule_id", "?"))),
+                html.escape(str(a.get("key", ""))),
+                html.escape(str(a.get("message", ""))),
+                _fmt_ts(int(a.get("since_ms", 0) or 0)),
+            ])
+        return ('<h3 style="color:#c0392b">Firing alerts</h3>'
+                + _table(["Severity", "Rule", "On", "Evidence", "Since"],
+                         rows))
+
+    def _timeline_html(self, job_id: str) -> str:
+        """Incident timeline: alerts + events + detections + diagnosis
+        in one time-ordered table with span links into the waterfall.
+        Renders only when the job has a story (an alert, a failure, a
+        straggler, a relaunch) — healthy histories skip it."""
+        timeline = self._incident_timeline(job_id)
+        if not any(r.get("severity") in ("warning", "critical", "page")
+                   for r in timeline):
+            return ""
+        rows = []
+        for r in timeline:
+            sev = str(r.get("severity", "info"))
+            color = self._SEVERITY_COLORS.get(sev, "#555")
+            spans = ", ".join(r.get("span_ids") or [])
+            rows.append([
+                _fmt_ts(int(r.get("ts_ms", 0) or 0)),
+                f'<span style="color:{color}">{html.escape(sev)}</span>',
+                html.escape(str(r.get("kind", ""))),
+                html.escape(str(r.get("summary", ""))),
+                f"<code>{html.escape(spans)}</code>" if spans else "",
+            ])
+        return ("<h3>Incident timeline</h3>"
+                + _table(["Time", "Severity", "Kind", "What happened",
+                          "Spans"], rows))
 
     def _skew_html(self, job_id: str) -> str:
         """Cross-task skew panel: top-k outliers (latched stragglers

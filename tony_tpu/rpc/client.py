@@ -241,6 +241,14 @@ class ClusterServiceClient(_JsonRpcClient):
         return self.call("get_skew", {}, retries=1, timeout_sec=10.0,
                          wait_for_ready=False)
 
+    def get_alerts(self) -> dict:
+        """The AM's live alert bundle (observability/alerts.py) —
+        currently-firing alerts + the bounded transition log. Operator
+        plane: the portal's /api/jobs/:id/alerts proxy and
+        `cli alerts --follow` poll this."""
+        return self.call("get_alerts", {}, retries=1, timeout_sec=10.0,
+                         wait_for_ready=False)
+
     def read_task_logs(self, task_id: str = "", stream: str = "stderr",
                        offset: int = -1, max_bytes: int = 0) -> dict:
         """One bounded log chunk for a task (live when running, from

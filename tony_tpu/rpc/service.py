@@ -45,6 +45,7 @@ CLUSTER_METHODS = (
     "request_profile",
     "read_task_logs",
     "get_skew",
+    "get_alerts",
 )
 METRICS_METHODS = ("update_metrics",)
 TASK_LOG_METHODS = ("read_log",)
@@ -128,6 +129,15 @@ class ClusterServiceHandler(abc.ABC):
         startup values, latched stragglers + the detection log. The
         portal's /api/jobs/:id/skew proxies this for RUNNING jobs; the
         same shape is flushed to history as skew.json at finish."""
+
+    @abc.abstractmethod
+    def get_alerts(self, req: dict) -> dict:
+        """Operator/client plane: req {} -> the live alert bundle
+        (observability/alerts.py AlertEngine.bundle): currently-firing
+        alerts + the bounded transition log. The portal's
+        /api/jobs/:id/alerts proxies this for RUNNING jobs; the same
+        shape is flushed to history as alerts.json on every
+        transition."""
 
     @abc.abstractmethod
     def request_profile(self, req: dict) -> dict:

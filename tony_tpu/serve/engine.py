@@ -171,6 +171,12 @@ class EngineStats:
     tokens_emitted: int = 0
     requests_finished: int = 0
     queue_depth_max: int = 0
+    # admission accounting: queue-eligible submissions that were accepted
+    # vs shed with QueueFullError (the frontend's 429) — the first-class
+    # SLI behind the reject-rate burn-rate alert rule. Cumulative
+    # counters, never reset while the engine lives.
+    requests_submitted: int = 0
+    requests_rejected: int = 0
     started_at: float = field(default_factory=time.monotonic)
     ttft_s: collections.deque = field(
         default_factory=lambda: collections.deque(maxlen=512))
@@ -360,13 +366,16 @@ class ContinuousBatchingEngine:
             if self._stop.is_set():
                 raise RuntimeError("engine is stopped")
             if len(self._pending) >= self.queue_depth:
+                self.stats.requests_rejected += 1
                 raise QueueFullError(
                     f"request queue full ({self.queue_depth} pending)")
             if self._pending_tokens + need > self.queue_token_budget:
+                self.stats.requests_rejected += 1
                 raise QueueFullError(
                     f"queued token budget exhausted "
                     f"({self._pending_tokens} of "
                     f"{self.queue_token_budget} tokens pending)")
+            self.stats.requests_submitted += 1
             handle = RequestHandle(next(self._next_id), list(prompt),
                                    max_new_tokens)
             self._pending.append(handle)
@@ -553,6 +562,8 @@ class ContinuousBatchingEngine:
             snap = {
                 "tokens_emitted": self.stats.tokens_emitted,
                 "requests_finished": self.stats.requests_finished,
+                "requests_submitted": self.stats.requests_submitted,
+                "requests_rejected": self.stats.requests_rejected,
                 "tokens_per_sec": self.stats.tokens_emitted / elapsed,
                 "queue_depth": depth,
                 "queue_depth_max": self.stats.queue_depth_max,
@@ -589,6 +600,9 @@ class ContinuousBatchingEngine:
             "ttft_p95_s": "SERVING_TTFT_P95_S",
             "itl_p50_ms": "SERVING_ITL_P50_MS",
             "tokens_emitted": "SERVING_TOKENS_TOTAL",
+            # admission counters: the reject-rate burn-rate rule's SLI
+            "requests_submitted": "SERVING_SUBMITTED_TOTAL",
+            "requests_rejected": "SERVING_REJECTED_TOTAL",
             # phase breakdown (p95s are the alerting-grade tails; the
             # full p50/p95/p99 set lives on /v1/metrics)
             "queue_wait_s_p50": "SERVING_QUEUE_WAIT_P50_S",

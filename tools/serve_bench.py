@@ -108,7 +108,8 @@ def main() -> int:
                 f"?format=prometheus", timeout=10) as resp:
             parsed = prom.parse(resp.read().decode("utf-8"))
         for key in ("ttft_p50_s", "ttft_p95_s", "slot_occupancy_pct",
-                    "tokens_per_sec", "queue_depth_max"):
+                    "tokens_per_sec", "queue_depth_max",
+                    "requests_submitted", "requests_rejected"):
             try:
                 value = prom.get_sample(parsed, f"tony_serving_{key}")
             except KeyError:
