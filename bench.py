@@ -635,6 +635,12 @@ def _control_plane_width(width: int, history_points: int = 64,
         def get_skew(self, req):
             return {"error": "control-plane harness"}
 
+        def get_alerts(self, req):
+            return {"error": "control-plane harness"}
+
+        def request_preemption(self, req):
+            return {"error": "control-plane harness"}
+
     server, port = serve(cluster_handler=_Handler(), metrics_handler=store,
                          max_workers=32)
     n_clients = min(width, 32)

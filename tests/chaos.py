@@ -123,6 +123,23 @@ class Skew(Injection):
         return {C.TEST_TASK_EXECUTOR_SKEW: f"{self.job}#{self.index}#{self.ms}"}
 
 
+class Preempt(Injection):
+    """The AM preempts ITSELF `after_ms` after prepare(), exactly as if
+    an arbiter's request_preemption RPC had arrived — the drain ask
+    rides the heartbeats, executors TERM their user processes, trainers
+    emergency-checkpoint within `grace_ms`, and the application finishes
+    PREEMPTED (AM hook TEST_TASK_PREEMPT)."""
+
+    def __init__(self, after_ms: int, grace_ms: int = 0):
+        self.after_ms, self.grace_ms = after_ms, grace_ms
+
+    def env(self) -> dict:
+        spec = str(self.after_ms)
+        if self.grace_ms:
+            spec += f"#{self.grace_ms}"
+        return {C.TEST_TASK_PREEMPT: spec}
+
+
 class StepDelay(Injection):
     """Slow EVERY train step of one task attempt by `ms` — the
     steady-state straggler (executor hook TEST_TRAINER_STEP_DELAY,

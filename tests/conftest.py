@@ -107,6 +107,13 @@ case "$cmd" in
       p=$(map "$1"); [[ -e $p ]] || { echo "CommandException: no URLs matched" >&2; exit 1; }
     fi
     ;;
+  rm)
+    # single-object delete (checkpoint retention GC); already-gone is
+    # the real CLI's "No URLs matched" failure
+    p=$(map "$1")
+    [[ -f $p ]] || { echo "CommandException: No URLs matched" >&2; exit 1; }
+    rm -f "$p"
+    ;;
   *) echo "unsupported: $cmd" >&2; exit 2 ;;
 esac
 """

@@ -1,0 +1,58 @@
+"""Fixture: checkpoint-then-evict preemption target (tests/test_preemption.py).
+
+A real sharded Trainer (mnist MLP, params fsdp-sharded over the mesh the
+executor rendered) that checkpoints every step and runs long enough for a
+mid-run drain to land. On SIGTERM the Trainer's emergency path commits one
+synchronous checkpoint and exits EXIT_PREEMPTED; this wrapper records the
+evidence (stopped step, preempted flag, per-step loss trajectory) in a
+report file either way, so the e2e can assert no-data-loss and
+bit-consistent resume."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+
+from tony_tpu.models.mnist import mnist_init, mnist_loss  # noqa: E402
+from tony_tpu.train.data import synthetic_mnist  # noqa: E402
+from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
+
+ckpt_dir = os.environ["CKPT_DIR"]           # may be gs:// (store protocol)
+report_dir = os.environ.get("REPORT_DIR", ckpt_dir)
+report_name = os.environ.get("REPORT_NAME", "report")
+total = int(os.environ.get("TOTAL_STEPS", "500"))
+
+# params sharded over the mesh's fsdp axis ("embed" logical dim), so a
+# width-2 run writes 2 shards per leaf and a width-1 resume exercises the
+# resharding restore (2 saved regions pasted into 1 target shard)
+param_axes = {f"w{i}": ("embed", None) for i in range(3)}
+param_axes.update({f"b{i}": (None,) for i in range(3)})
+
+trainer = Trainer(
+    loss_fn=mnist_loss, init_fn=mnist_init,
+    data_iter=synthetic_mnist(32),
+    config=TrainerConfig(num_steps=total, log_every=1,
+                         checkpoint_every=1, checkpoint_dir=ckpt_dir,
+                         learning_rate=1e-2, warmup_steps=1,
+                         prefetch_depth=0),
+    param_axes=param_axes)
+trainer.setup()
+resumed_from = trainer.step
+
+rc = 0
+try:
+    trainer.run()
+except SystemExit as e:                      # the preempted exit path
+    rc = int(e.code or 0)
+
+os.makedirs(report_dir, exist_ok=True)
+with open(os.path.join(report_dir, f"{report_name}.json"), "w") as f:
+    json.dump({"resumed_from": resumed_from,
+               "stopped_at": trainer.step,
+               "preempted": trainer.preempted,
+               "losses": [[m["step"], m["loss"]]
+                          for m in trainer.metrics_history
+                          if "loss" in m]}, f)
+print(f"trainer stopped at step {trainer.step} "
+      f"(preempted={trainer.preempted}, rc={rc})", flush=True)
+sys.exit(rc)

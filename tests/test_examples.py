@@ -169,10 +169,13 @@ def test_multirole_example(tmp_path):
     assert client.final_status == "SUCCEEDED", _logs(client)
 
 
+@pytest.mark.slow
 def test_train_then_generate_lifecycle(tmp_path):
     """Full model lifecycle through the real chain: pretrain with
     checkpointing, then a second app restores that checkpoint and runs
-    the KV-cache decode loop (examples/llama-generate)."""
+    the KV-cache decode loop (examples/llama-generate). slow: two full
+    apps incl. a CPU decode loop (~25 s) — the lifecycle's fast
+    coverage lives in test_llama_pretrain_example_tiny + test_generate."""
     ckpt = str(tmp_path / "ckpts")
     client = run_example(
         tmp_path,
@@ -226,10 +229,12 @@ def test_train_then_generate_lifecycle(tmp_path):
     assert "GENERATE_OK" in logs and "speculative: draft=tiny" in logs
 
 
+@pytest.mark.slow
 def test_moe_train_then_generate_lifecycle(tmp_path):
     """The expert family end to end through the real chain: MoE pretrain
     (router + expert banks, aux loss) checkpoints, then the generate
-    demo restores it and runs the shared KV-cache decode stack."""
+    demo restores it and runs the shared KV-cache decode stack. slow:
+    two full apps incl. a CPU MoE decode loop (~14 s)."""
     ckpt = str(tmp_path / "ckpts")
     client = run_example(
         tmp_path,

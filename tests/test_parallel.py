@@ -229,10 +229,16 @@ def test_trainer_opt_state_sharded_on_mesh(tmp_path, monkeypatch):
                 partial(llama_init, config),
                 data(), cfg, param_axes=llama_param_axes(config))
     t.setup()
-    master_embed = t.opt_state["master"]["embed"]
-    spec = master_embed.sharding.spec
-    assert any(ax is not None for ax in spec), (
-        f"master embed replicated: {spec}")
+    try:
+        master_embed = t.opt_state["master"]["embed"]
+        spec = master_embed.sharding.spec
+        assert any(ax is not None for ax in spec), (
+            f"master embed replicated: {spec}")
+    finally:
+        # setup() started the prefetch pipeline; without a run() (whose
+        # finally owns the close) the thread would outlive this test and
+        # trip test_prefetch's leak detector later in the process
+        t._global_data_iter.close()
 
 
 def test_ring_attention_pallas_interpret_mode(monkeypatch):

@@ -88,6 +88,13 @@ class FakeClusterHandler(ClusterServiceHandler):
     def get_alerts(self, req):
         return {"firing": [], "log": [], "rules": []}
 
+    def request_preemption(self, req):
+        self.preemptions = getattr(self, "preemptions", [])
+        self.preemptions.append(req)
+        return {"app_id": "fake-app",
+                "grace_ms": int(req.get("grace_ms", 0) or 30_000),
+                "deadline_ms": int(req.get("grace_ms", 0) or 30_000)}
+
 
 class FakeMetricsHandler(MetricsServiceHandler):
     def __init__(self):
@@ -131,9 +138,15 @@ def test_all_methods_round_trip(cluster):
     assert handler.results == [{"exit_code": 0, "job_name": "worker",
                                 "job_index": 1, "session_id": 0,
                                 "task_attempt": -1,
-                                "barrier_timeout": False}]
+                                "barrier_timeout": False,
+                                "preempted": False}]
     c.task_executor_heartbeat("worker:1")
     assert handler.heartbeats == ["worker:1"]
+    resp = c.request_preemption(grace_ms=5000, reason="drain",
+                                requested_by="operator")
+    assert resp["grace_ms"] == 5000
+    assert handler.preemptions == [{"grace_ms": 5000, "reason": "drain",
+                                    "requested_by": "operator"}]
     resp = c.request_profile(task_id="worker:0", num_steps=3)
     assert resp["request_id"] == "fake-req" and resp["num_steps"] == 3
     assert handler.profile_requests == [{"task_id": "worker:0",

@@ -55,6 +55,9 @@ class FakeHandler:
     def read_task_logs(self, req):
         return {"data": "", "next_offset": 0, "eof": False}
 
+    def request_preemption(self, req):
+        return {"app_id": "fake", "grace_ms": 1000, "deadline_ms": 1000}
+
 
 def test_token_file_roundtrip_and_mode(tmp_path):
     token = generate_token()

@@ -41,9 +41,10 @@ from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
     AlertFiring, AlertResolved, ApplicationFinished, ApplicationInited,
-    DiagnosticsReady, Event, EventType, ProfileCaptured,
-    ServingEndpointRegistered, SloViolation, StragglerCleared,
-    StragglerDetected, TaskFinished, TaskRelaunched, TaskStarted,
+    DiagnosticsReady, Event, EventType, Preempted, PreemptionRequested,
+    ProfileCaptured, Resumed, ServingEndpointRegistered, SloViolation,
+    StragglerCleared, StragglerDetected, TaskFinished, TaskRelaunched,
+    TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor
 from tony_tpu.rpc.service import (
@@ -359,6 +360,23 @@ class ApplicationMaster(ClusterServiceHandler):
         # the re-completed gang barrier; counts AGAINST job goodput
         self._relaunch_pending_since: dict[str, float] = {}
         self._relaunch_downtime_s = 0.0
+        # checkpoint-then-evict preemption (cluster/arbiter.py's
+        # eviction edge): set once by request_preemption — {reason,
+        # grace_ms, deadline (monotonic), requested (monotonic),
+        # requested_by}; the drain ask rides every heartbeat response
+        # from then on and the application finishes PREEMPTED
+        self._preemption: Optional[dict] = None
+        self._preempt_forced = False
+        self._preempt_event_emitted = False
+        # resume lineage: a re-admitted application inherits its
+        # predecessor's preemption count and prices the eviction→now gap
+        # into the goodput ledger as preemption downtime
+        self._preempt_count = conf.get_int(K.APPLICATION_PREEMPT_COUNT, 0)
+        self._resumed_from = conf.get_str(K.APPLICATION_RESUMED_FROM, "")
+        preempted_at_ms = conf.get_int(K.APPLICATION_PREEMPTED_AT_MS, 0)
+        self._preemption_downtime_s = (
+            max(0.0, time.time() * 1000 - preempted_at_ms) / 1000.0
+            if preempted_at_ms > 0 else 0.0)
         # dead attempts' final GOODPUT_*/TRAIN_* gauges, archived at the
         # relaunch decision — the replacement's pushes overwrite the
         # MetricsStore slot, and a killed attempt's hour of training must
@@ -623,6 +641,11 @@ class ApplicationMaster(ClusterServiceHandler):
                      "tony_job_relaunch_downtime_seconds")):
                 families.append({"name": name, "type": "gauge", "help": "",
                                  "samples": [(labels, float(job[key]))]})
+            families.append({
+                "name": "tony_job_preemptions_total", "type": "gauge",
+                "help": "", "samples": [(labels, float(
+                    self._preempt_count
+                    + (1 if self._preemption is not None else 0)))]})
         families += REGISTRY.families()
         return render(families)
 
@@ -643,7 +666,9 @@ class ApplicationMaster(ClusterServiceHandler):
             # so their wall/productive time stays in the job totals
             per_task = dict(self._goodput_archive)
         per_task.update(self.metrics_store.latest_gauges())
-        return aggregate_goodput(per_task, relaunch_downtime_s=downtime)
+        return aggregate_goodput(
+            per_task, relaunch_downtime_s=downtime,
+            preemption_downtime_s=self._preemption_downtime_s)
 
     def fleet_summary(self, state: str) -> dict:
         """The compact jobstate entry this AM contributes to the live
@@ -685,6 +710,9 @@ class ApplicationMaster(ClusterServiceHandler):
         alerts_firing = (len(self.alert_engine.firing())
                          if self.alert_engine is not None else 0)
         gauges["tony_job_alerts_firing"] = float(alerts_firing)
+        preemptions = self._preempt_count \
+            + (1 if self._preemption is not None else 0)
+        gauges["tony_job_preemptions_total"] = float(preemptions)
         for q, gauge_name in fleet.STEP_TIME_GAUGES.items():
             if q in self._step_time_quantiles:
                 gauges[gauge_name] = self._step_time_quantiles[q]
@@ -697,6 +725,7 @@ class ApplicationMaster(ClusterServiceHandler):
                              (int, float))]
         if tps:
             serving_tps = round(sum(tps), 3)
+        from tony_tpu.conf.queues import app_priority
         return fleet.job_summary(
             self.app_id, self.metadata.user, app_queue(self.conf), state,
             gang_width=gang_width,
@@ -707,6 +736,12 @@ class ApplicationMaster(ClusterServiceHandler):
             straggler_count=straggler_count,
             alerts_firing=alerts_firing,
             serving_tokens_per_sec=serving_tps,
+            preemptions=preemptions,
+            priority=app_priority(self.conf),
+            # the arbiter reaches a victim's control plane through the
+            # registry entry — no extra discovery file
+            am_addr=(f"{self.host}:{self.rpc_port}"
+                     if self.rpc_port else ""),
             gauges=gauges)
 
     def _publish_fleet_state(self, state: str = "RUNNING",
@@ -1053,6 +1088,7 @@ class ApplicationMaster(ClusterServiceHandler):
         (ApplicationMaster.run, ApplicationMaster.java:311-386).
         Returns overall success."""
         self.prepare()
+        self._schedule_preempt_if_testing()
         # TEST_AM_CRASH: die before doing anything useful, simulating an AM
         # container crash (reference: ApplicationMaster.java:337-342)
         if os.environ.get(C.TEST_AM_CRASH):
@@ -1068,6 +1104,11 @@ class ApplicationMaster(ClusterServiceHandler):
                 if succeeded or attempt >= max_retries:
                     break
                 if self._client_signal_stop.is_set():
+                    break
+                if self._preemption is not None:
+                    # checkpoint-then-evict: the pool wants these chips —
+                    # a session retry would re-occupy them. The job
+                    # resumes from its checkpoint when re-admitted.
                     break
                 if self._unsatisfiable_request:
                     # deterministic placement failure: a retry would hit
@@ -1130,6 +1171,25 @@ class ApplicationMaster(ClusterServiceHandler):
                                   sum(r.num_instances
                                       for r in self.session.requests.values()),
                                   self.host)))
+            if self._resumed_from:
+                # checkpoint-then-evict resume: this application
+                # continues a PREEMPTED predecessor from its checkpoint
+                # — possibly at a different gang width (the resharding
+                # restore maps saved shards onto the new mesh); the
+                # downtime gap is priced into the goodput ledger
+                from tony_tpu.conf.queues import total_requested_tpus
+                LOG.info("resumed from preempted %s after %.1f s "
+                         "downtime", self._resumed_from,
+                         self._preemption_downtime_s)
+                self.event_handler.emit(Event(
+                    EventType.RESUMED,
+                    Resumed(self.app_id,
+                            resumed_from=self._resumed_from,
+                            downtime_ms=int(
+                                self._preemption_downtime_s * 1000),
+                            gang_width=self.session.total_tracked_tasks(),
+                            requested_chips=total_requested_tpus(
+                                self.conf))))
 
         if self._single_node or self.conf.get_bool(
                 K.APPLICATION_ENABLE_PREPROCESS, False):
@@ -1215,6 +1275,8 @@ class ApplicationMaster(ClusterServiceHandler):
                     FinalStatus.FAILED,
                     f"Preprocess failed with exit code: {self._preprocess_exit_code}")
                 break
+            if self._preemption is not None and self._check_preemption():
+                break
             if self._task_missed_hb:
                 break
             if self._untracked_task_failed:
@@ -1254,6 +1316,14 @@ class ApplicationMaster(ClusterServiceHandler):
             self._publish_fleet_state()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
+                if self._preemption is not None:
+                    # the last drain completion can land between this
+                    # iteration's _check_preemption and here — settle
+                    # the PREEMPTED terminal state (+ event) before
+                    # breaking, or the generic aggregation below would
+                    # read the drained gang as SUCCEEDED
+                    self._check_preemption()
+                    break
                 LOG.info("all %d tracked tasks completed", total)
                 break
             self._wake.wait(self._monitor_interval)
@@ -1267,6 +1337,82 @@ class ApplicationMaster(ClusterServiceHandler):
         if not ok:
             LOG.info("session failed: %s", session.final_message)
         return ok
+
+    def _check_preemption(self) -> bool:
+        """One monitor-loop pass of the checkpoint-then-evict drain.
+        Returns True when the drain is complete (the monitor breaks and
+        the application finishes PREEMPTED). Phases: (1) wait for every
+        tracked task to stop — executors TERM their user processes on
+        the heartbeat-piggybacked drain ask and trainers
+        emergency-checkpoint inside the grace window; (2) at the
+        deadline, force-stop the stragglers' containers (the backend's
+        TERM→KILL ladder still gives their trainers the term-grace
+        window); (3) a bounded tail wait for completion callbacks, so a
+        lost callback can't wedge the drain forever."""
+        session = self.session
+        preemption = self._preemption
+        if session is None or preemption is None:
+            return False
+        now = time.monotonic()
+        if session.all_tracked_tasks_completed():
+            self._finish_preemption("drained")
+            return True
+        if now > preemption["deadline"] and not self._preempt_forced:
+            self._preempt_forced = True
+            with self._lock:
+                cids = [cid for cid, (task, sid) in self._launched.items()
+                        if sid == session.session_id and not task.completed
+                        and cid not in self._finished_containers]
+            LOG.warning("preemption grace expired — force-stopping %d "
+                        "container(s)", len(cids))
+            for cid in cids:
+                self.backend.stop_container(cid)
+        # bounded tail: the force-stop's TERM→KILL ladder + callback
+        # delivery; past it, settle PREEMPTED with whatever completed
+        # (remaining slots are recorded killed-by-AM by the backend)
+        ladder_s = self.conf.get_time_ms(K.TASK_TERM_GRACE_MS,
+                                         15_000) / 1000.0 + 10.0
+        if now > preemption["deadline"] + ladder_s:
+            LOG.error("preemption drain wedged past the stop ladder — "
+                      "finishing PREEMPTED with %d/%d tasks completed",
+                      session.num_completed_tracked_tasks(),
+                      session.total_tracked_tasks())
+            self._finish_preemption("drain timed out")
+            return True
+        return False
+
+    def _finish_preemption(self, how: str) -> None:
+        """Settle the PREEMPTED terminal state + emit the PREEMPTED
+        event (once) with the drain evidence."""
+        session = self.session
+        preemption = self._preemption
+        reason = preemption.get("reason", "") or "preempted"
+        session.set_final_status(
+            FinalStatus.PREEMPTED,
+            f"Preempted ({how}): {reason}")
+        if self._preempt_event_emitted:
+            return
+        self._preempt_event_emitted = True
+        from tony_tpu.rpc.messages import TaskStatus
+        drained = killed = 0
+        for tasks in session.job_tasks.values():
+            for t in tasks:
+                if not session.is_tracked(t.job_name):
+                    continue
+                if t.status == TaskStatus.PREEMPTED:
+                    drained += 1
+                elif t.status == TaskStatus.FINISHED \
+                        or (not t.completed and t.container_id):
+                    killed += 1
+        drain_ms = int((time.monotonic() - preemption["requested"]) * 1000)
+        self.event_handler.emit(Event(
+            EventType.PREEMPTED,
+            Preempted(self.app_id, reason=reason,
+                      drained_tasks=drained, killed_tasks=killed,
+                      drain_ms=drain_ms)))
+        LOG.warning("application preempted: %d task(s) drained "
+                    "gracefully, %d force-stopped (%d ms)", drained,
+                    killed, drain_ms)
 
     def _close_relaunch_downtime(self) -> None:
         """Fold every open relaunch gap into the accumulated downtime
@@ -1599,6 +1745,11 @@ class ApplicationMaster(ClusterServiceHandler):
         elif (self.session is not None
               and self.session.final_status == FinalStatus.KILLED):
             status = "KILLED"
+        elif (self.session is not None
+              and self.session.final_status == FinalStatus.PREEMPTED):
+            # terminal-but-resumable: the fleet registry settles the
+            # entry as PREEMPTED and the arbiter can re-admit it later
+            status = "PREEMPTED"
         else:
             status = "FAILED"
         # close the lifecycle trace before flushing it next to the events
@@ -1912,12 +2063,18 @@ class ApplicationMaster(ClusterServiceHandler):
             # the attempt this completion belongs to, captured while the
             # container ownership check above still holds
             observed_attempt = task.attempt
+        # an exit observed while a preemption drain is in flight is the
+        # drain completing (or the deadline force-stop), never a fault:
+        # no failure record, no relaunch, and the completion below is
+        # stamped preempted so the aggregation can't read it as a
+        # worker failure
+        draining = self._preemption is not None
         # diagnostics: a crash that never registered a result (hard kill,
         # os._exit) is only ever seen HERE — read the container's own
         # files for the tail + signature before the relaunch decision can
         # recycle the slot (first-wins: an executor-shipped report for
         # the same attempt already holds the slot)
-        if exit_code not in (0, C.EXIT_KILLED_BY_AM):
+        if exit_code not in (0, C.EXIT_KILLED_BY_AM) and not draining:
             self._record_task_failure(
                 task.task_id, observed_attempt,
                 f"container exited with code {exit_code}",
@@ -1929,7 +2086,7 @@ class ApplicationMaster(ClusterServiceHandler):
         # (Rendezvous timeouts are fenced at register_execution_result via
         # the barrier_timeout flag; an executor that died before reporting
         # is indistinguishable from a crash here, which is the safe side.)
-        if (exit_code not in (0, C.EXIT_KILLED_BY_AM)
+        if (exit_code not in (0, C.EXIT_KILLED_BY_AM) and not draining
                 and session.is_tracked(task.job_name)
                 and self._maybe_relaunch_task(
                     task, f"container exited with code {exit_code}",
@@ -1944,7 +2101,10 @@ class ApplicationMaster(ClusterServiceHandler):
             task.task_id, observed_attempt,
             "OK" if exit_code in (0, C.EXIT_KILLED_BY_AM) else "ERROR",
             reason=f"exit {exit_code}")
-        session.on_task_completed(task.job_name, task.index, exit_code)
+        session.on_task_completed(task.job_name, task.index, exit_code,
+                                  preempted=(draining
+                                             and exit_code not in
+                                             (0, C.EXIT_KILLED_BY_AM)))
         # incremental log aggregation: this container's streams are final
         # — copy them into history NOW, so an AM crash/kill -9 after this
         # point no longer loses the logs (previously aggregation only
@@ -1958,8 +2118,8 @@ class ApplicationMaster(ClusterServiceHandler):
                                                         task.index))))
         # untracked-crash detection prevents application hang-ups
         # (ApplicationMaster.java:1192-1195)
-        if not session.is_tracked(task.job_name) and exit_code not in (
-                0, C.EXIT_KILLED_BY_AM):
+        if not session.is_tracked(task.job_name) and not draining \
+                and exit_code not in (0, C.EXIT_KILLED_BY_AM):
             self._untracked_task_failed = True
         self._wake.set()
 
@@ -1978,6 +2138,14 @@ class ApplicationMaster(ClusterServiceHandler):
             # session, so its silence must not fail the new session
             LOG.warning("ignoring heartbeat expiry for stale task %s",
                         task_id)
+            self.hb_monitor.unregister(task_id)
+            return
+        if self._preemption is not None:
+            # silence during a drain is the drain (the executor stops
+            # heartbeating on its way out): the deadline force-stop owns
+            # cleanup — never a relaunch, never a session failure
+            LOG.info("ignoring heartbeat expiry of %s during preemption "
+                     "drain", task_id)
             self.hb_monitor.unregister(task_id)
             return
         if (attempt < 0 or task.attempt == attempt) and not task.completed \
@@ -2043,7 +2211,8 @@ class ApplicationMaster(ClusterServiceHandler):
             session = self.session
             if (session is None or session.training_finished
                     or session.final_status != FinalStatus.UNDEFINED
-                    or self._client_signal_stop.is_set()):
+                    or self._client_signal_stop.is_set()
+                    or self._preemption is not None):
                 return False
             if task.session_id != session.session_id:
                 # a stale-session observer racing an AM session retry: the
@@ -2322,11 +2491,40 @@ class ApplicationMaster(ClusterServiceHandler):
                      task.attempt)
             return {}
         exit_code = int(req["exit_code"])
+        # checkpoint-then-evict drain: the executor TERMed its user
+        # process on the drain ask and the trainer emergency-checkpointed
+        # — terminal, not a fault: no failure record, no relaunch budget,
+        # PREEMPTED task status (acknowledged only while a drain is
+        # actually in flight; the flag alone must not let a crashing
+        # executor dress a real failure up as a preemption)
+        if req.get("preempted") and self._preemption is not None \
+                and task is not None:
+            LOG.info("task %s drained for preemption (rc=%d)", task_id,
+                     exit_code)
+            self.hb_monitor.unregister(task_id)
+            self._clear_profile_request(task_id)
+            self._task_span_end(task_id,
+                                attempt if attempt >= 0 else task.attempt,
+                                "OK", reason="preempted")
+            session.on_task_completed(req["job_name"],
+                                      int(req["job_index"]), exit_code,
+                                      preempted=True)
+            self._wake.set()
+            return {}
+        # a non-zero exit observed while a drain is in flight is part of
+        # the drain (the executor may simply not have seen the drain ask
+        # yet when its user process died of the TERM) — mirror the
+        # container-completion path: no failure record, no relaunch, and
+        # the completion below is stamped preempted so a mid-drain crash
+        # can't trip the chief/stop-on-failure short-circuit and turn
+        # the PREEMPTED terminal state into FAILED
+        draining = self._preemption is not None
         # diagnostics: the executor's own classified, redacted post-mortem
         # is the best failure evidence — record it FIRST (attempt-fenced,
         # first-wins) so neither the relaunch decision nor a racing
         # completion callback can beat it to the record slot
-        if exit_code not in (0, C.EXIT_KILLED_BY_AM) and task is not None:
+        if exit_code not in (0, C.EXIT_KILLED_BY_AM) and task is not None \
+                and not draining:
             self._record_task_failure(
                 task_id, attempt if attempt >= 0 else task.attempt,
                 ("gang rendezvous timed out" if req.get("barrier_timeout")
@@ -2341,6 +2539,7 @@ class ApplicationMaster(ClusterServiceHandler):
         # (An explicit flag, not an exit code: every 0-255 value is
         # reachable by the user process itself.)
         if (task is not None and not req.get("barrier_timeout")
+                and not draining
                 and exit_code not in (0, C.EXIT_KILLED_BY_AM)
                 and self._maybe_relaunch_task(
                     task, f"executor reported exit {exit_code}",
@@ -2350,7 +2549,10 @@ class ApplicationMaster(ClusterServiceHandler):
         self.hb_monitor.unregister(task_id)
         self._clear_profile_request(task_id)
         session.on_task_completed(req["job_name"], int(req["job_index"]),
-                                  exit_code)
+                                  exit_code,
+                                  preempted=(draining
+                                             and exit_code not in
+                                             (0, C.EXIT_KILLED_BY_AM)))
         self._wake.set()
         return {}
 
@@ -2398,6 +2600,18 @@ class ApplicationMaster(ClusterServiceHandler):
             LOG.debug("heartbeat from %s has no liveliness entry",
                       req["task_id"])
         resp = {"spec_generation": generation}
+        # checkpoint-then-evict: the drain ask rides every heartbeat
+        # while a preemption is in flight (resends are harmless — the
+        # executor's drain is one-shot); grace_ms is the REMAINING
+        # window, so a late-heartbeating task doesn't overshoot the
+        # deadline every earlier task is held to
+        preemption = self._preemption
+        if preemption is not None:
+            resp["drain"] = {
+                "grace_ms": max(
+                    0, int((preemption["deadline"] - time.monotonic())
+                           * 1000)),
+                "reason": preemption.get("reason", "")}
         # on-demand profiler: a pending request for this task rides its
         # heartbeat (resent until the capture completes — the executor's
         # request-file write and the trainer's id-dedup are idempotent)
@@ -2408,6 +2622,72 @@ class ApplicationMaster(ClusterServiceHandler):
                 resp["profile_request"] = {"request_id": preq["id"],
                                            "num_steps": preq["num_steps"]}
         return resp
+
+    def request_preemption(self, req: dict) -> dict:
+        """Arbiter/operator ask: checkpoint-then-evict this application.
+        Sets the one-shot drain state (idempotent — a second ask returns
+        the in-flight drain's deadline), emits PREEMPTION_REQUESTED, and
+        wakes the monitor; from here the drain ask rides every task
+        heartbeat, executors TERM their user processes, trainers
+        emergency-checkpoint inside the grace window, and the
+        application finishes PREEMPTED (see _check_preemption)."""
+        session = self.session
+        if session is None:
+            return {"error": "no active session"}
+        grace_ms = int(req.get("grace_ms", 0) or 0) or self.conf.get_time_ms(
+            K.ARBITER_GRACE_MS, 30_000)
+        reason = str(req.get("reason", "") or "")
+        requested_by = str(req.get("requested_by", "") or "operator")
+        with self._lock:
+            if self._preemption is not None:
+                p = self._preemption
+                return {"app_id": self.app_id, "duplicate": True,
+                        "grace_ms": p["grace_ms"],
+                        "deadline_ms": max(0, int(
+                            (p["deadline"] - time.monotonic()) * 1000))}
+            self._preemption = {
+                "reason": reason, "grace_ms": grace_ms,
+                "requested_by": requested_by,
+                "requested": time.monotonic(),
+                "requested_ms": int(time.time() * 1000),
+                "deadline": time.monotonic() + grace_ms / 1000.0,
+            }
+        LOG.warning("preemption requested by %s (%d ms grace): %s",
+                    requested_by, grace_ms, reason or "unspecified")
+        self.event_handler.emit(Event(
+            EventType.PREEMPTION_REQUESTED,
+            PreemptionRequested(self.app_id, reason=reason,
+                                grace_ms=grace_ms,
+                                requested_by=requested_by)))
+        # the registry shows the bumped preemption count right away
+        self._publish_fleet_state(force=True)
+        self._wake.set()
+        return {"app_id": self.app_id, "grace_ms": grace_ms,
+                "deadline_ms": grace_ms}
+
+    def _schedule_preempt_if_testing(self) -> None:
+        """TEST_TASK_PREEMPT='after_ms[#grace_ms]': the AM preempts
+        itself after_ms after prepare(), exactly as if an arbiter's
+        request_preemption had arrived — the chaos harness's
+        checkpoint-then-evict injection (tests/chaos.py Preempt)."""
+        spec = os.environ.get(C.TEST_TASK_PREEMPT)
+        if not spec:
+            return
+        try:
+            parts = spec.split("#")
+            after_s = int(parts[0]) / 1000.0
+            grace_ms = int(parts[1]) if len(parts) > 1 else 0
+        except (ValueError, IndexError):
+            LOG.error("bad TEST_TASK_PREEMPT spec: %r", spec)
+            return
+        LOG.warning("TEST hook: preempting this application in %d ms",
+                    int(after_s * 1000))
+        timer = threading.Timer(
+            after_s, lambda: self.request_preemption(
+                {"grace_ms": grace_ms, "reason": "TEST_TASK_PREEMPT",
+                 "requested_by": "test"}))
+        timer.daemon = True
+        timer.start()
 
     # an in-flight profiler ask older than this is considered lost (the
     # trainer's start_trace failed, or the profile_done push was dropped)

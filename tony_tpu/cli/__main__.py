@@ -43,7 +43,7 @@ from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
          "{submit|local|notebook|profile|logs|diagnose|stragglers"
-         "|alerts|top} [args...]")
+         "|alerts|top|preempt|arbiter} [args...]")
 
 
 def _am_client(app_dir: str):
@@ -603,6 +603,103 @@ def profile(argv: list[str]) -> int:
     return 0 if not (resp or {}).get("error") else 1
 
 
+def preempt(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli preempt <app_dir> [--grace-ms N]
+    [--reason ...]` — checkpoint-then-evict one running application:
+    the AM drains its gang (trainers emergency-checkpoint within the
+    grace window) and finishes PREEMPTED, resumable from the
+    checkpoint. The operator edge of the arbiter's eviction path."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli preempt")
+    parser.add_argument("app_dir",
+                        help="the application dir the client created "
+                             "(holds the amhostport file)")
+    parser.add_argument("--grace-ms", type=int, default=0,
+                        help="emergency-checkpoint window before the "
+                             "force-stop (0 = tony.arbiter.grace-ms)")
+    parser.add_argument("--reason", default="operator preemption")
+    args = parser.parse_args(argv)
+    client, err = _am_client(args.app_dir)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
+    try:
+        resp = client.request_preemption(grace_ms=args.grace_ms,
+                                         reason=args.reason,
+                                         requested_by="operator")
+    except Exception as e:  # noqa: BLE001 — operator tool, report and exit
+        print(f"request_preemption failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    print(json.dumps(resp or {}, indent=1))
+    return 0 if not (resp or {}).get("error") else 1
+
+
+def arbiter(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli arbiter <staging-location> --chips N
+    [--queue q --user u --priority p] [--queues-conf file] [--evict]`
+    — one gang-admission verdict against the LIVE fleet registry:
+    prints admit / queue / preempt (with the minimal victim set); with
+    --evict, delivers request_preemption to each victim's AM."""
+    import argparse
+    import json
+
+    from tony_tpu.cluster.arbiter import (
+        Arbiter, GangAsk, execute_preemption,
+    )
+    from tony_tpu.conf import TonyConfiguration
+    from tony_tpu.observability.fleet import FleetRegistry
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli arbiter")
+    parser.add_argument("location",
+                        help="staging-store location the fleet registry "
+                             "scans (tony.staging.location)")
+    parser.add_argument("--chips", type=int, required=True,
+                        help="the gang's summed chip ask (all-or-nothing)")
+    parser.add_argument("--queue", default="default")
+    parser.add_argument("--user", default="")
+    parser.add_argument("--priority", type=int, default=0)
+    parser.add_argument("--app-id", default="ask")
+    parser.add_argument("--queues-conf", default="",
+                        help="conf file declaring tony.queues.* / "
+                             "tony.arbiter.* (defaults apply otherwise)")
+    parser.add_argument("--evict", action="store_true",
+                        help="on a preempt verdict, actually deliver "
+                             "request_preemption to the victim AMs")
+    parser.add_argument("--grace-ms", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    conf = TonyConfiguration()
+    if args.queues_conf:
+        conf.merge_file(args.queues_conf, "arbiter-cli")
+    arb = Arbiter.from_conf(conf)
+    registry = FleetRegistry(location=args.location)
+    registry.refresh(force=True)
+    arb.sync_from_fleet(registry.live_jobs())
+    ask = GangAsk(app_id=args.app_id, chips=args.chips, queue=args.queue,
+                  user=args.user, priority=args.priority)
+    decision = arb.decide(ask)
+    out = {"action": decision.action, "reason": decision.reason,
+           "victims": [v.app_id for v in decision.victims],
+           "free_chips": (arb.free_chips() if arb.total_chips > 0
+                          else None),
+           "total_chips": arb.total_chips or None,
+           "running": sorted(arb.running)}
+    if decision.action == "preempt" and args.evict:
+        from tony_tpu.conf import keys as K
+        out["evicted"] = execute_preemption(
+            decision.victims,
+            grace_ms=args.grace_ms
+            or conf.get_time_ms(K.ARBITER_GRACE_MS, 30_000),
+            reason=f"preempted to admit {args.app_id} "
+                   f"(priority {args.priority}, {args.chips} chips)")
+    print(json.dumps(out, indent=1))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     logging.basicConfig(
@@ -638,6 +735,10 @@ def main(argv: list[str] | None = None) -> int:
         return alerts(rest)
     if cmd == "top":
         return top(rest)
+    if cmd == "preempt":
+        return preempt(rest)
+    if cmd == "arbiter":
+        return arbiter(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

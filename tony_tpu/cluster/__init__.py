@@ -23,7 +23,13 @@ def backend_from_conf(conf, app_id: str) -> ClusterBackend:
 
     kind = conf.get_str(K.CLUSTER_BACKEND, "local") or "local"
     if kind == "local":
-        return LocalClusterBackend(app_id=app_id)
+        # the TERM→KILL escalation must outlast the executor's
+        # user-process grace (tony.task.term-grace-ms) — SIGKILLing the
+        # container group mid-grace would cut the trainer's emergency
+        # checkpoint short and orphan the own-session user process
+        grace = conf.get_time_ms(K.TASK_TERM_GRACE_MS, 15_000) / 1000.0
+        return LocalClusterBackend(app_id=app_id,
+                                   stop_grace_sec=grace + 5.0)
     if kind == "remote":
         from tony_tpu.cluster.remote import (
             ExecTransport, SSHTransport, parse_nodes,

@@ -1,0 +1,309 @@
+"""Gang-aware admission arbiter: queues, quotas, priorities, preemption.
+
+The TonY paper's YARN-queue story (arxiv 1904.01631) rebuilt TPU-native:
+the reference submitted into a YARN queue and inherited the capacity
+scheduler's cross-application arbitration for free; this build has no RM
+process, so the arbitration layer lives here — a deterministic decision
+engine over a modeled chip inventory plus the fleet registry's live view
+(observability/fleet.py jobstate summaries carry queue, user, priority,
+chips, and the AM's control-plane address).
+
+Core invariants:
+
+- **All-or-nothing gang admission.** A gang ask is granted whole or not
+  at all — chips are never incrementally held while waiting for the
+  rest, so a 48-wide ask can never deadlock against two 32-wide ones:
+  whichever fits whole runs; the other queues at zero held chips.
+- **Hierarchical queues with capacity shares.** `tony.queues.<q>.*`
+  declares the tree (conf/queues.py QueueSpec): `capacity-share` is a
+  percentage of the parent's capacity (root: of the inventory) a queue
+  may hold across RUNNING jobs; `max-tpus-per-user` caps one user
+  inside the queue; usage charges every ancestor.
+- **Priority + minimal preemption.** When a higher-priority gang does
+  not fit whole, victims are selected lowest-priority-first, youngest
+  first within a priority (the cheapest work to replay), accumulating
+  until the ask fits — then a reverse pass drops any victim whose
+  eviction turns out unnecessary, so the set is minimal under the
+  policy order. Victims are checkpoint-then-evicted via their AM's
+  request_preemption RPC (graceful drain → emergency checkpoint →
+  PREEMPTED jobstate), never killed.
+
+The engine is pure (decide() has no side effects); `Arbiter.admit()`
+applies a grant to the book, `sync_from_fleet()` rebuilds the book from
+live registry summaries, and `execute_preemption()` is the one
+side-effecting edge — it delivers request_preemption to each victim AM.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.queues import QueueSpec, queue_ancestry, queue_specs
+
+LOG = logging.getLogger(__name__)
+
+ADMIT = "admit"
+QUEUE = "queue"
+PREEMPT = "preempt"
+
+
+@dataclass
+class GangAsk:
+    """One application's atomic chip ask (or granted allocation)."""
+    app_id: str
+    chips: int
+    queue: str = "default"
+    user: str = ""
+    priority: int = 0
+    started_ms: int = 0
+    am_addr: str = ""           # victim control plane (fleet registry)
+
+    @classmethod
+    def from_summary(cls, summary: dict) -> "GangAsk":
+        """A fleet-registry jobstate entry as a running allocation."""
+        from tony_tpu.observability.fleet import chips_of
+        return cls(
+            app_id=str(summary.get("app_id", "") or ""),
+            chips=chips_of(summary),
+            queue=str(summary.get("queue", "default") or "default"),
+            user=str(summary.get("user", "") or ""),
+            priority=int(summary.get("priority", 0) or 0),
+            started_ms=int(summary.get("started_ms", 0) or 0),
+            am_addr=str(summary.get("am_addr", "") or ""))
+
+
+@dataclass
+class Decision:
+    """decide()'s verdict: ADMIT (fits now), PREEMPT (fits after
+    evicting `victims`, already policy-minimal), or QUEUE (cannot fit
+    whole even with every eligible victim gone — the ask waits; nothing
+    is partially granted)."""
+    action: str
+    reason: str = ""
+    victims: list = field(default_factory=list)   # [GangAsk]
+
+    @property
+    def admitted(self) -> bool:
+        return self.action == ADMIT
+
+
+class Arbiter:
+    """Deterministic admission book over a modeled inventory.
+
+    total_chips <= 0 models an unbounded pool (admission is then
+    constrained only by queue capacities/quotas — useful when the real
+    bound is enforced elsewhere)."""
+
+    def __init__(self, total_chips: int = 0,
+                 queues: Optional[dict[str, QueueSpec]] = None,
+                 preemption_enabled: bool = True):
+        self.total_chips = int(total_chips)
+        self.queues = dict(queues or {})
+        self.preemption_enabled = preemption_enabled
+        self.running: dict[str, GangAsk] = {}
+
+    @classmethod
+    def from_conf(cls, conf) -> "Arbiter":
+        """tony.arbiter.* + the tony.queues.* tree. With no explicit
+        inventory, the summed ROOT-queue max-tpus quotas stand in (the
+        closest declared statement of pool size)."""
+        queues = queue_specs(conf)
+        total = conf.get_int(K.ARBITER_TOTAL_TPUS, 0)
+        if total <= 0:
+            total = sum(q.max_tpus for q in queues.values()
+                        if q.parent is None and q.max_tpus > 0)
+        return cls(total_chips=total, queues=queues,
+                   preemption_enabled=conf.get_bool(
+                       K.ARBITER_PREEMPTION_ENABLED, True))
+
+    # -- book ----------------------------------------------------------
+    def sync_from_fleet(self, summaries: list[dict]) -> None:
+        """Rebuild the running book from live fleet-registry entries
+        (state RUNNING; terminal/LOST jobs hold no chips)."""
+        from tony_tpu.observability.fleet import LIVE_STATES
+        self.running = {}
+        for s in summaries:
+            if s.get("state") not in LIVE_STATES:
+                continue
+            ask = GangAsk.from_summary(s)
+            if ask.app_id and ask.chips > 0:
+                self.running[ask.app_id] = ask
+
+    def release(self, app_id: str) -> None:
+        self.running.pop(app_id, None)
+
+    def used_chips(self, exclude: frozenset = frozenset()) -> int:
+        return sum(a.chips for a in self.running.values()
+                   if a.app_id not in exclude)
+
+    def free_chips(self, exclude: frozenset = frozenset()) -> int:
+        if self.total_chips <= 0:
+            return 1 << 30
+        return self.total_chips - self.used_chips(exclude)
+
+    # -- constraints ---------------------------------------------------
+    def _queue_usage(self, queue: str, exclude: frozenset) -> int:
+        """Chips running in `queue` or any of its descendants (usage
+        charges every ancestor, so a parent's view sums its subtree)."""
+        total = 0
+        for a in self.running.values():
+            if a.app_id in exclude:
+                continue
+            if queue in queue_ancestry(a.queue, self.queues):
+                total += a.chips
+        return total
+
+    def _user_usage(self, queue: str, user: str,
+                    exclude: frozenset) -> int:
+        return sum(a.chips for a in self.running.values()
+                   if a.app_id not in exclude and a.user == user
+                   and queue in queue_ancestry(a.queue, self.queues))
+
+    def _constraint_violation(self, ask: GangAsk,
+                              exclude: frozenset) -> Optional[str]:
+        """First violated constraint for granting `ask` with `exclude`d
+        jobs gone, or None when it fits whole."""
+        if self.queues and ask.queue not in self.queues:
+            return (f"unknown queue {ask.queue!r} (configured: "
+                    f"{sorted(self.queues)})")
+        if self.free_chips(exclude) < ask.chips:
+            return (f"pool: {ask.chips} chips asked, "
+                    f"{max(0, self.free_chips(exclude))} free of "
+                    f"{self.total_chips}")
+        for level in queue_ancestry(ask.queue, self.queues):
+            spec = self.queues.get(level)
+            if spec is None:
+                continue
+            cap = (spec.capacity_chips(self.total_chips, self.queues)
+                   if self.total_chips > 0 and spec.capacity_share >= 0
+                   else (1 << 30))
+            used = self._queue_usage(level, exclude)
+            if used + ask.chips > cap:
+                return (f"queue {level!r} capacity: {used} running + "
+                        f"{ask.chips} asked > {cap} chips "
+                        f"({spec.capacity_share:g}% share)")
+            if spec.max_tpus_per_user >= 0 and ask.user:
+                uused = self._user_usage(level, ask.user, exclude)
+                if uused + ask.chips > spec.max_tpus_per_user:
+                    return (f"user {ask.user!r} quota in queue "
+                            f"{level!r}: {uused} running + {ask.chips} "
+                            f"asked > {spec.max_tpus_per_user}")
+        return None
+
+    # -- decisions -----------------------------------------------------
+    def decide(self, ask: GangAsk) -> Decision:
+        """Pure verdict for one gang ask against the current book."""
+        violation = self._constraint_violation(ask, frozenset())
+        if violation is None:
+            return Decision(ADMIT, "fits whole")
+        victims = self._select_victims(ask)
+        if victims is not None:
+            return Decision(
+                PREEMPT,
+                f"fits after checkpoint-then-evicting "
+                f"{[v.app_id for v in victims]} ({violation})",
+                victims=victims)
+        return Decision(QUEUE, violation)
+
+    def admit(self, ask: GangAsk) -> Decision:
+        """decide() + apply: an ADMIT grants the chips in the book (the
+        atomic all-or-nothing grant); PREEMPT/QUEUE change nothing —
+        the caller evicts victims (execute_preemption), re-syncs, and
+        asks again once the registry shows them gone."""
+        decision = self.decide(ask)
+        if decision.admitted:
+            self.running[ask.app_id] = ask
+        return decision
+
+    def _select_victims(self, ask: GangAsk) -> Optional[list[GangAsk]]:
+        """Minimal preemption set under the policy order: only jobs with
+        STRICTLY lower priority are eligible, taken lowest-priority
+        first and youngest-first within a priority (cheapest replay),
+        until the ask fits whole; a reverse pass then drops any victim
+        whose eviction the later picks made unnecessary. None = no
+        eligible set satisfies the ask (gang stays atomic — queue it)."""
+        if not self.preemption_enabled:
+            return None
+        eligible = sorted(
+            (a for a in self.running.values()
+             if a.priority < ask.priority),
+            key=lambda a: (a.priority, -a.started_ms))
+        chosen: list[GangAsk] = []
+        excluded: set[str] = set()
+        fits = False
+        for victim in eligible:
+            chosen.append(victim)
+            excluded.add(victim.app_id)
+            if self._constraint_violation(ask,
+                                          frozenset(excluded)) is None:
+                fits = True
+                break
+        if not fits:
+            return None
+        # minimality pass: try dropping victims newest-pick-first (the
+        # LEAST preferred under the policy order) — whatever still fits
+        # without one is kept running, so no job is evicted that the
+        # final set doesn't actually need
+        for victim in list(reversed(chosen)):
+            trial = excluded - {victim.app_id}
+            if self._constraint_violation(ask, frozenset(trial)) is None:
+                excluded = trial
+                chosen.remove(victim)
+        return chosen
+
+
+# ---------------------------------------------------------------------------
+# side-effecting edges: evict via the victim AMs, resume lineage conf
+# ---------------------------------------------------------------------------
+
+def execute_preemption(victims: list[GangAsk], grace_ms: int = 0,
+                       reason: str = "", requested_by: str = "arbiter",
+                       auth_token: Optional[str] = None) -> list[str]:
+    """Deliver request_preemption to every victim's AM (address from its
+    fleet-registry entry). Returns the app ids actually reached; a
+    victim whose AM is unreachable is skipped (its registry entry will
+    go LOST and release the chips anyway)."""
+    from tony_tpu.rpc.client import ClusterServiceClient
+    reached = []
+    for victim in victims:
+        host, _, port = victim.am_addr.rpartition(":")
+        if not host or not port.isdigit():
+            LOG.warning("victim %s has no am_addr in its registry "
+                        "entry — skipping", victim.app_id)
+            continue
+        client = ClusterServiceClient(host, int(port),
+                                      auth_token=auth_token)
+        try:
+            resp = client.request_preemption(
+                grace_ms=grace_ms, reason=reason,
+                requested_by=requested_by)
+            if not (resp or {}).get("error"):
+                reached.append(victim.app_id)
+                LOG.info("preemption delivered to %s (%s)",
+                         victim.app_id, victim.am_addr)
+        except Exception:  # noqa: BLE001 — a dead AM releases via LOST
+            LOG.warning("could not reach victim %s at %s",
+                        victim.app_id, victim.am_addr, exc_info=True)
+        finally:
+            client.close()
+    return reached
+
+
+def resume_conf_overrides(preempted_summary: dict) -> dict[str, str]:
+    """The conf keys a re-submission must carry to continue a PREEMPTED
+    application: lineage (resumed-from), the eviction timestamp the
+    goodput ledger prices into preemption_downtime_s, and the
+    cumulative preemption count. The caller picks the new gang width —
+    the resharding restore (train/checkpoint.py) maps the saved shards
+    onto whatever mesh the re-admitted width builds."""
+    return {
+        K.APPLICATION_RESUMED_FROM:
+            str(preempted_summary.get("app_id", "") or ""),
+        K.APPLICATION_PREEMPTED_AT_MS:
+            str(int(preempted_summary.get("heartbeat_ms", 0) or 0)),
+        K.APPLICATION_PREEMPT_COUNT:
+            str(int(preempted_summary.get("preemptions", 0) or 0)),
+    }

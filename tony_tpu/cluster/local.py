@@ -30,11 +30,17 @@ LOG = logging.getLogger(__name__)
 
 
 class LocalClusterBackend(ClusterBackend):
-    def __init__(self, app_id: str = "local", capacity: int = 0):
+    def __init__(self, app_id: str = "local", capacity: int = 0,
+                 stop_grace_sec: float = 0.0):
         """capacity > 0 caps concurrently-allocated containers (MiniCluster's
-        bounded NodeManagers); 0 = unbounded."""
+        bounded NodeManagers); 0 = unbounded. stop_grace_sec > 0 widens
+        the TERM→KILL escalation past the default (backend_from_conf
+        sizes it to outlast tony.task.term-grace-ms, so an emergency
+        checkpoint is never SIGKILLed mid-write)."""
         self._app_id = app_id
         self._capacity = capacity
+        if stop_grace_sec > 0:
+            self.STOP_GRACE_SEC = stop_grace_sec   # instance override
         self._seq = 0
         self._host = current_host()
         self._procs: dict[str, subprocess.Popen] = {}
@@ -195,24 +201,26 @@ class LocalClusterBackend(ClusterBackend):
     # port would outlive the application)
     STOP_GRACE_SEC = 5.0
 
-    @classmethod
-    def _terminate_tree(cls, proc: subprocess.Popen) -> None:
+    def _terminate_tree(self, proc: subprocess.Popen) -> None:
         """TERM-then-KILL container stop, non-blocking for the caller
         (stop_container runs on AM monitor/relaunch paths): the KILL
-        escalation happens on a daemon timer iff the TERM didn't land."""
+        escalation happens on a daemon timer iff the TERM didn't land.
+        Instance method so the conf-derived STOP_GRACE_SEC override
+        (sized past tony.task.term-grace-ms) governs the timer."""
+        grace = self.STOP_GRACE_SEC
         try:
             os.killpg(proc.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
-            cls._kill_tree(proc)
+            self._kill_tree(proc)
             return
 
         def _escalate():
             if proc.poll() is None:
                 LOG.warning("container pid %d ignored SIGTERM for %.0fs "
-                            "— killing", proc.pid, cls.STOP_GRACE_SEC)
-                cls._kill_tree(proc)
+                            "— killing", proc.pid, grace)
+                self._kill_tree(proc)
 
-        timer = threading.Timer(cls.STOP_GRACE_SEC, _escalate)
+        timer = threading.Timer(grace, _escalate)
         timer.daemon = True
         timer.start()
 
@@ -233,10 +241,11 @@ class LocalClusterBackend(ClusterBackend):
                 except (ProcessLookupError, PermissionError):
                     self._kill_tree(proc)
         # the KILL escalation waits STRICTLY LONGER than the executor's
-        # own 2s user-process grace (_terminate_user_proc): SIGKILLing
-        # the executor's group mid-grace would race its reap of the
-        # own-session user process — the orphan this ladder exists to
-        # prevent
+        # own user-process grace (tony.task.term-grace-ms; backend_from_
+        # conf sizes STOP_GRACE_SEC past it): SIGKILLing the executor's
+        # group mid-grace would race its reap of the own-session user
+        # process — the orphan this ladder exists to prevent — and cut
+        # an in-flight emergency checkpoint short
         for proc in procs:
             try:
                 proc.wait(timeout=self.STOP_GRACE_SEC)

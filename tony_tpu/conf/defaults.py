@@ -13,6 +13,9 @@ from tony_tpu.conf import keys as K
 # Mirrors the reference's configurationPropsToSkipCompare set.
 NO_DEFAULT_KEYS = frozenset({
     K.APPLICATION_NODE_LABEL,
+    K.APPLICATION_RESUMED_FROM,
+    K.APPLICATION_PREEMPTED_AT_MS,
+    K.APPLICATION_PREEMPT_COUNT,
     K.APPLICATION_HDFS_CONF_LOCATION,
     K.APPLICATION_YARN_CONF_LOCATION,
     K.APPLICATION_PREPARE_STAGE,
@@ -49,6 +52,7 @@ DEFAULTS = {
     # application
     K.APPLICATION_NAME: "tony_tpu",
     K.APPLICATION_QUEUE: "default",
+    K.APPLICATION_PRIORITY: 0,
     K.APPLICATION_TIMEOUT: 0,
     K.APPLICATION_SECURITY_ENABLED: False,
     K.APPLICATION_FRAMEWORK: "jax",
@@ -89,6 +93,13 @@ DEFAULTS = {
     K.CONTAINER_ALLOCATION_TIMEOUT: 15 * 60 * 1000,
     K.TASK_REGISTRATION_TIMEOUT_SEC: 300,
     K.TASK_REGISTRATION_RETRY_COUNT: 0,
+    # TERM→KILL grace on every user-process termination path, sized to
+    # cover an emergency checkpoint (AsyncCheckpointer.wait + one
+    # synchronous sharded save); the wait returns as soon as the process
+    # exits, so well-behaved shutdowns never pay the full window
+    K.TASK_TERM_GRACE_MS: 15_000,
+    # checkpoint retention: committed step dirs kept (0 = unlimited)
+    K.CHECKPOINT_KEEP: 3,
 
     # limits: -1 = unlimited (reference: TonyClient.java:598-667)
     K.MAX_TOTAL_INSTANCES: -1,
@@ -144,6 +155,10 @@ DEFAULTS = {
     K.ALERTS_MFU_FLOOR_PCT: 0,            # 0 = rule disabled
     K.ALERTS_QUEUE_QUOTA_PCT: 95,
     K.ALERTS_IDLE_CHIPS_FOR_MS: 120_000,
+    # admission arbiter (cluster/arbiter.py)
+    K.ARBITER_TOTAL_TPUS: 0,          # 0 = sum of declared queue quotas
+    K.ARBITER_GRACE_MS: 30_000,
+    K.ARBITER_PREEMPTION_ENABLED: True,
     # fleet registry / chip-hour accounting (observability/fleet.py)
     K.FLEET_PUBLISH_INTERVAL_MS: 5000,
     K.FLEET_STALE_AFTER_MS: 30_000,

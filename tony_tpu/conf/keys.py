@@ -28,6 +28,19 @@ APPLICATION_STOP_ON_FAILURE_JOBTYPES = "tony.application.stop-on-failure.jobtype
 APPLICATION_FAIL_ON_WORKER_FAILURE = "tony.application.fail-on-worker-failure-enabled"
 APPLICATION_HDFS_CONF_LOCATION = "tony.application.hdfs-conf-path"
 APPLICATION_YARN_CONF_LOCATION = "tony.application.yarn-conf-path"
+# arbitration priority (higher wins): the admission arbiter
+# (cluster/arbiter.py) admits higher-priority gangs first and selects
+# preemption victims lowest-priority-first
+APPLICATION_PRIORITY = "tony.application.priority"
+# checkpoint-then-evict resume lineage: a re-admitted application names
+# the PREEMPTED application it continues (`resumed-from`) and the epoch
+# millis its predecessor was evicted at (`preempted-at-ms`) — the AM
+# emits a RESUMED history event and prices the downtime gap into the
+# goodput ledger (preemption_downtime_s). `preempt-count` carries the
+# lineage's cumulative preemption count into tony_job_preemptions_total.
+APPLICATION_RESUMED_FROM = "tony.application.resumed-from"
+APPLICATION_PREEMPTED_AT_MS = "tony.application.preempted-at-ms"
+APPLICATION_PREEMPT_COUNT = "tony.application.preempt-count"
 
 # --- am ------------------------------------------------------------------
 AM_RETRY_COUNT = "tony.am.retry-count"
@@ -66,6 +79,18 @@ CONTAINER_ALLOCATION_TIMEOUT = "tony.container.allocation.timeout"  # ms
 CONTAINERS_RESOURCES = "tony.containers.resources"        # multi-value append key
 TASK_REGISTRATION_TIMEOUT_SEC = "tony.task.registration-timeout-sec"
 TASK_REGISTRATION_RETRY_COUNT = "tony.task.registration-retry-count"
+# TERM→KILL grace window (ms) the executor gives its user process group
+# on any termination path — graceful drain (preemption), backend
+# container stop, SIGTERM from the substrate. Sized to cover the
+# trainer's emergency checkpoint (AsyncCheckpointer.wait + one
+# synchronous save); the wait returns the moment the process exits, so
+# a clean shutdown never sleeps the full window.
+TASK_TERM_GRACE_MS = "tony.task.term-grace-ms"
+# checkpoint retention: committed step_N dirs kept per checkpoint dir
+# (pruned oldest-first after each successful commit, on both the
+# local-rename and the gs:// COMMIT-marker protocols; the step a restore
+# resumed from is never deleted). 0 = keep everything.
+CHECKPOINT_KEEP = "tony.checkpoint.keep"
 
 # --- limits (reference: TonyClient.validateTonyConf, TonyClient.java:598-667)
 MAX_TOTAL_INSTANCES = "tony.application.max-total-instances"
@@ -250,6 +275,18 @@ FLEET_STALE_AFTER_MS = "tony.fleet.stale-after-ms"
 # per-user running totals so chip-hours are never lost, only coarsened
 FLEET_HISTORY_JOBS = "tony.fleet.history-jobs"
 
+# --- arbiter (cluster/arbiter.py): gang-aware admission + preemption -----
+# modeled TPU inventory the arbiter admits gangs against (chips); 0 =
+# derive from the summed declared queue quotas
+ARBITER_TOTAL_TPUS = "tony.arbiter.total-tpus"
+# drain window handed to a preemption victim's AM when the arbiter (or
+# `cli preempt`) doesn't name one: the victim's tasks get this long to
+# emergency-checkpoint before containers are force-stopped
+ARBITER_GRACE_MS = "tony.arbiter.grace-ms"
+# safety valve: when false, decide() never returns preemption victims —
+# asks that don't fit whole simply queue (admission stays gang-atomic)
+ARBITER_PREEMPTION_ENABLED = "tony.arbiter.preemption-enabled"
+
 # --- proxy ---------------------------------------------------------------
 # externally reachable base URL of an authenticated tony_tpu.proxy fronting
 # in-cluster HTTP endpoints (serving, notebook, TB). When set, the portal
@@ -310,6 +347,7 @@ RESERVED_SEGMENTS = frozenset({
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
     "profiling", "slo", "logs", "straggler", "fleet", "alerts",
+    "arbiter", "checkpoint",
 })
 
 
@@ -317,8 +355,29 @@ def queue_max_tpus_key(queue: str) -> str:
     """Cap on a SINGLE application's summed TPU ask when submitted into
     this queue (the capacity-scheduler slice the reference inherited
     from YARN queues, TonyClient.java:249-251 — aggregate cross-app
-    capacity needs a shared RM, which this rebuild doesn't have)."""
+    capacity is enforced by the admission arbiter, cluster/arbiter.py)."""
     return f"tony.queues.{queue}.max-tpus"
+
+
+def queue_capacity_share_key(queue: str) -> str:
+    """Percentage of the arbiter's chip inventory this queue (or, for a
+    child queue, of its parent's capacity) may hold across RUNNING
+    applications — the capacity-scheduler share of the reference's YARN
+    queue story, enforced cross-app by cluster/arbiter.py."""
+    return f"tony.queues.{queue}.capacity-share"
+
+
+def queue_max_tpus_per_user_key(queue: str) -> str:
+    """Cap on one user's summed chips across RUNNING applications in
+    this queue (arbiter-enforced per-user quota)."""
+    return f"tony.queues.{queue}.max-tpus-per-user"
+
+
+def queue_parent_key(queue: str) -> str:
+    """Names this queue's parent, making tony.queues.* a hierarchy: a
+    child's capacity-share is a slice of the parent's capacity, and its
+    usage counts against every ancestor."""
+    return f"tony.queues.{queue}.parent"
 
 
 def jobtype_key(jobtype: str, attr: str) -> str:

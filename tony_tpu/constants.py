@@ -81,6 +81,11 @@ TONY_PARENT_SPAN = "TONY_PARENT_SPAN"
 # per-task ledger covers the whole container lifetime without
 # double-counting (observability/perf.py GoodputLedger.from_env)
 TONY_GOODPUT_SEED = "TONY_GOODPUT_SEED"
+# checkpoint retention (tony.checkpoint.keep rendered into every user
+# process env): the trainer's checkpointer prunes committed step dirs
+# beyond this count after each successful commit (train/checkpoint.py
+# prune_checkpoints; 0 = keep everything)
+CHECKPOINT_KEEP = "TONY_CHECKPOINT_KEEP"
 
 # Paths handed to AM / executor processes via env
 TONY_CONF_PATH = "TONY_CONF_PATH"    # abs path of the frozen tony-final.json
@@ -198,6 +203,12 @@ TEST_TASK_KILL = "TEST_TASK_KILL"
 # process keeps running — exercises the heartbeat-expiry relaunch path.
 # Format: "type#index#attempt".
 TEST_TASK_HB_SILENCE = "TEST_TASK_HB_SILENCE"
+# preemption injection (chaos harness): the AM preempts ITSELF
+# `after_ms` after prepare(), exactly as if an arbiter's
+# request_preemption RPC had arrived — drain ask rides the heartbeats,
+# executors TERM their user processes, trainers emergency-checkpoint
+# within the grace window. Format: "after_ms[#grace_ms]".
+TEST_TASK_PREEMPT = "TEST_TASK_PREEMPT"
 # steady-state straggler injection: slow EVERY train step of one specific
 # task attempt by a fixed delay (the complement of the startup-only
 # TEST_TASK_EXECUTOR_SKEW above). Format: "type#index#ms[#attempt]";
@@ -226,6 +237,13 @@ EXIT_HEARTBEAT_FAILURE = 9  # executor killed itself after missed heartbeats
 # register_execution_result, NOT this value — every 0-255 exit code is
 # also reachable by the user process, so the code alone proves nothing
 EXIT_RENDEZVOUS_TIMEOUT = 10
+# trainer exited through its SIGTERM-driven emergency-checkpoint path
+# (checkpoint-then-evict preemption, real TPU maintenance/spot eviction,
+# or an operator stop). Observability only, like the rendezvous code
+# above: the AM's no-fault decision rides the `preempted` flag on
+# register_execution_result, NOT this value — every 0-255 exit code is
+# also reachable by the user process itself.
+EXIT_PREEMPTED = 12
 # Exit code reported when the AM itself stops a container; matches YARN's
 # ContainerExitStatus.KILLED_BY_APPMASTER used by the reference
 # (TonySession.java:485-488). Single source of truth for all modules.

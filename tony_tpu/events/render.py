@@ -105,6 +105,30 @@ def _alert_resolved(p: dict) -> str:
             f"{p.get('active_ms', 0)} ms firing")
 
 
+def _preemption_requested(p: dict) -> str:
+    return (f"preemption requested for "
+            f"{p.get('application_id', '?')} by "
+            f"{p.get('requested_by', '') or 'operator'} "
+            f"({p.get('grace_ms', 0)} ms checkpoint grace): "
+            f"{p.get('reason', '') or 'unspecified'}")
+
+
+def _preempted(p: dict) -> str:
+    return (f"application {p.get('application_id', '?')} preempted: "
+            f"{p.get('drained_tasks', 0)} task(s) drained gracefully, "
+            f"{p.get('killed_tasks', 0)} force-stopped at the deadline "
+            f"({p.get('drain_ms', 0)} ms drain) — "
+            f"{p.get('reason', '') or 'unspecified'}")
+
+
+def _resumed(p: dict) -> str:
+    return (f"application {p.get('application_id', '?')} resumed from "
+            f"preempted {p.get('resumed_from', '?')} after "
+            f"{p.get('downtime_ms', 0)} ms downtime "
+            f"(gang width {p.get('gang_width', 0)}, "
+            f"{p.get('requested_chips', 0)} chips)")
+
+
 RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.APPLICATION_INITED: _application_inited,
     EventType.APPLICATION_FINISHED: _application_finished,
@@ -119,6 +143,9 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.STRAGGLER_CLEARED: _straggler_cleared,
     EventType.ALERT_FIRING: _alert_firing,
     EventType.ALERT_RESOLVED: _alert_resolved,
+    EventType.PREEMPTION_REQUESTED: _preemption_requested,
+    EventType.PREEMPTED: _preempted,
+    EventType.RESUMED: _resumed,
 }
 
 

@@ -30,6 +30,9 @@ class EventType(str, enum.Enum):
     STRAGGLER_CLEARED = "STRAGGLER_CLEARED"
     ALERT_FIRING = "ALERT_FIRING"
     ALERT_RESOLVED = "ALERT_RESOLVED"
+    PREEMPTION_REQUESTED = "PREEMPTION_REQUESTED"
+    PREEMPTED = "PREEMPTED"
+    RESUMED = "RESUMED"
 
 
 @dataclass
@@ -193,6 +196,49 @@ class AlertResolved:
 
 
 @dataclass
+class PreemptionRequested:
+    """No reference equivalent (the reference inherited preemption from
+    YARN's capacity scheduler, invisible to TonY itself): the admission
+    arbiter (cluster/arbiter.py) or an operator asked this application
+    to checkpoint-then-evict. The drain ask rides task heartbeats from
+    here on; `grace_ms` is how long tasks get to emergency-checkpoint
+    before containers are force-stopped."""
+    application_id: str
+    reason: str = ""
+    grace_ms: int = 0
+    requested_by: str = ""      # "arbiter" | "operator" | "test"
+
+
+@dataclass
+class Preempted:
+    """The drain completed: every tracked task stopped and the
+    application left the pool in state PREEMPTED (a terminal state that
+    is neither FAILED nor KILLED — it is expected to resume from its
+    checkpoint). `drained_tasks` exited through the graceful path
+    within the grace window; `killed_tasks` had to be force-stopped at
+    the deadline."""
+    application_id: str
+    reason: str = ""
+    drained_tasks: int = 0
+    killed_tasks: int = 0
+    drain_ms: int = 0           # request → last task stopped
+
+
+@dataclass
+class Resumed:
+    """A preempted application was re-admitted and restarted from its
+    latest checkpoint — possibly at a different gang width (the
+    resharding restore maps the saved shards onto the new mesh).
+    `downtime_ms` is the eviction→resume gap the goodput ledger prices
+    as preemption_downtime_s."""
+    application_id: str
+    resumed_from: str = ""      # the PREEMPTED predecessor's app id
+    downtime_ms: int = 0
+    gang_width: int = 0
+    requested_chips: int = 0
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -215,13 +261,16 @@ _PAYLOADS = {
     EventType.STRAGGLER_CLEARED: StragglerCleared,
     EventType.ALERT_FIRING: AlertFiring,
     EventType.ALERT_RESOLVED: AlertResolved,
+    EventType.PREEMPTION_REQUESTED: PreemptionRequested,
+    EventType.PREEMPTED: Preempted,
+    EventType.RESUMED: Resumed,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 TaskFinished, TaskRelaunched, ServingEndpointRegistered,
                 ProfileCaptured, SloViolation, DiagnosticsReady,
                 StragglerDetected, StragglerCleared, AlertFiring,
-                AlertResolved]
+                AlertResolved, PreemptionRequested, Preempted, Resumed]
 
 
 @dataclass

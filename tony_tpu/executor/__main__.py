@@ -25,14 +25,19 @@ def main() -> int:
     executor = TaskExecutor()
 
     # Graceful container stop: the backend sends SIGTERM (escalating to
-    # SIGKILL) when the AM stops this container. The user process runs in
-    # its OWN session (launch_shell start_new_session=True), so dying
-    # without reaping it would orphan long-running workloads — a serving
-    # task's HTTP server would keep the port and the process forever.
-    # SIGTERM is forwarded to the user process group (short grace, then
-    # KILL), then this executor exits with the killed-by-AM code (the
-    # backend records EXIT_KILLED_BY_AM regardless; no result is
-    # registered, exactly like the previous hard-kill behavior).
+    # SIGKILL) when the AM stops this container — and the substrate
+    # sends the same signal on a real TPU maintenance/spot eviction.
+    # The user process runs in its OWN session (launch_shell
+    # start_new_session=True), so dying without reaping it would orphan
+    # long-running workloads — a serving task's HTTP server would keep
+    # the port and the process forever. SIGTERM is forwarded to the
+    # user process group with the tony.task.term-grace-ms window (the
+    # TERM→checkpoint→KILL contract: a Trainer's SIGTERM handler
+    # commits an emergency checkpoint inside it — docs/
+    # FAULT_TOLERANCE.md), then this executor exits with the
+    # killed-by-AM code (the backend records EXIT_KILLED_BY_AM
+    # regardless; no result is registered, exactly like the previous
+    # hard-kill behavior).
     def _on_sigterm(signum, frame):
         logging.getLogger(__name__).warning(
             "SIGTERM — stopping user process and exiting")

@@ -60,7 +60,7 @@ class Heartbeater(threading.Thread):
     def __init__(self, client: ClusterServiceClient, task_id: str,
                  interval_sec: float, on_fatal=None, task_attempt: int = -1,
                  on_generation=None, silent: bool = False,
-                 on_profile=None, log_addr: str = ""):
+                 on_profile=None, log_addr: str = "", on_drain=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -71,6 +71,10 @@ class Heartbeater(threading.Thread):
         self._interval = interval_sec
         self._on_fatal = on_fatal  # kill the user process before we die
         self._on_generation = on_generation
+        # checkpoint-then-evict: a preemption drain ask piggybacked on
+        # the heartbeat response (the AM never opens a connection TO a
+        # container — asks always ride this channel)
+        self._on_drain = on_drain
         # heartbeat-piggybacked on-demand profiler ask (observability/
         # perf.py): the executor relays it to the trainer via a cwd file
         self._on_profile = on_profile
@@ -107,6 +111,9 @@ class Heartbeater(threading.Thread):
                 profile_req = (resp or {}).get("profile_request")
                 if profile_req and self._on_profile is not None:
                     self._on_profile(profile_req)
+                drain = (resp or {}).get("drain")
+                if drain and self._on_drain is not None:
+                    self._on_drain(drain)
             except Exception:  # noqa: BLE001
                 self._consecutive_failures += 1
                 LOG.warning("heartbeat failed (%d consecutive)",
@@ -162,6 +169,17 @@ class TaskExecutor:
             K.TASK_METRICS_INTERVAL_MS, 5000) / 1000.0
         self.registration_timeout_sec = self.conf.get_int(
             K.TASK_REGISTRATION_TIMEOUT_SEC, 300)
+        # TERM→KILL grace on every user-process termination path
+        # (tony.task.term-grace-ms), sized to cover the trainer's
+        # emergency checkpoint; proc.wait returns the moment the
+        # process exits, so clean shutdowns never pay the full window
+        self._term_grace_sec = self.conf.get_time_ms(
+            K.TASK_TERM_GRACE_MS, 15_000) / 1000.0
+        # checkpoint-then-evict drain state: set once when a preemption
+        # ask arrives (heartbeat piggyback), read by the run loop to
+        # report a PREEMPTED (not failed) result
+        self._drain_requested = False
+        self._drain_lock = threading.Lock()
         self.host = current_host()
         self.port = 0
         self.tb_port: Optional[int] = None
@@ -306,7 +324,8 @@ class TaskExecutor:
                 on_generation=self._on_generation,
                 silent=self._hb_silent_for_testing(),
                 on_profile=self._on_profile_request,
-                log_addr=self.log_addr)
+                log_addr=self.log_addr,
+                on_drain=self._on_drain_request)
             self.heartbeater.start()
         host_port = f"{self.host}:{self.port}"
         LOG.info("registering %s at %s (attempt %d)", self.task_id,
@@ -369,6 +388,34 @@ class TaskExecutor:
                      "(%s steps)", rid, preq.get("num_steps"))
         except OSError:
             LOG.exception("could not write the profile request file")
+
+    def _on_drain_request(self, drain: dict) -> None:
+        """Checkpoint-then-evict: the heartbeat response carried the
+        AM's drain ask. One-shot: forward SIGTERM to the user process
+        group on a helper thread (never the heartbeater — it must keep
+        pinging so the AM sees this task alive while it drains), give
+        it the grace window to emergency-checkpoint, then KILL anything
+        still running. The run loop observes the exit with
+        _drain_requested set and registers a PREEMPTED result instead
+        of a failure."""
+        with self._drain_lock:
+            if self._drain_requested:
+                return
+            self._drain_requested = True
+        # the AM sends the REMAINING grace; 0 means the deadline already
+        # passed — TERM then immediate KILL, never the full local
+        # default (a late-heartbeating task must not overshoot the
+        # window every earlier task was held to). The conf default only
+        # covers an ask that carries no window at all.
+        raw = drain.get("grace_ms")
+        grace = (self._term_grace_sec if raw is None
+                 else max(0, int(raw)) / 1000.0)
+        LOG.warning("preemption drain requested (%s): TERM→%0.fs "
+                    "grace→KILL", drain.get("reason", "") or "unspecified",
+                    grace)
+        threading.Thread(
+            target=lambda: self._terminate_user_proc(grace),
+            name="drain", daemon=True).start()
 
     def _take_respec(self) -> bool:
         with self._respec_lock:
@@ -572,6 +619,11 @@ class TaskExecutor:
                 env[C.IS_CHIEF] = str(self.is_chief).lower()
                 env[C.TASK_ATTEMPT] = str(self.task_attempt)
                 env[C.SPEC_GENERATION] = str(self._spec_generation)
+                # checkpoint retention knob for the trainer's GC
+                # (tony.checkpoint.keep; train/checkpoint.py prunes
+                # committed steps past it after each commit)
+                env[C.CHECKPOINT_KEEP] = str(
+                    self.conf.get_int(K.CHECKPOINT_KEEP, 3))
                 if self.tb_port is not None:
                     env[C.TB_PORT] = str(self.tb_port)
                 self._skew_if_testing()
@@ -601,6 +653,14 @@ class TaskExecutor:
                                 "OK" if exit_code == 0 else "ERROR",
                                 attrs={"exit_code": exit_code})
                 respec = self._take_respec()
+                if self._drain_requested:
+                    # checkpoint-then-evict: the user process was TERMed
+                    # on the AM's drain ask and (a Trainer) committed its
+                    # emergency checkpoint — this exit is the drain
+                    # completing, never a fault and never a re-rendezvous
+                    LOG.info("user process drained for preemption "
+                             "(rc=%d)", exit_code)
+                    break
                 if not respec and exit_code != 0:
                     # a dying peer can take this task's collectives down
                     # BEFORE the next heartbeat delivers the AM's
@@ -654,7 +714,8 @@ class TaskExecutor:
             # a given-up re-rendezvous is a barrier problem, not a task
             # fault — flag it so the AM spends no relaunch budget on it
             # (a superseded attempt's report is attempt-fenced anyway)
-            self._report(exit_code, barrier_timeout=rendezvous_gave_up)
+            self._report(exit_code, barrier_timeout=rendezvous_gave_up,
+                         preempted=self._drain_requested)
             return exit_code
         finally:
             # every exit path — including the rendezvous-timeout returns
@@ -695,6 +756,11 @@ class TaskExecutor:
             # found no live process) and this launch — take the fresh
             # process down so the respec loop re-enters the barrier
             self._kill_user_proc()
+        if self._drain_requested:
+            # a drain ask landed before this launch (e.g. while still at
+            # the barrier): there is no progress to checkpoint — stop
+            # the fresh process so the drain completes immediately
+            self._kill_user_proc()
         from tony_tpu.executor.gpu_metrics import maybe_gpu_sampler
         from tony_tpu.executor.task_monitor import default_tpu_sampler
         self.monitor = TaskMonitor(
@@ -719,14 +785,22 @@ class TaskExecutor:
             except (ProcessLookupError, PermissionError):
                 proc.kill()
 
-    def _terminate_user_proc(self, grace_sec: float = 2.0) -> None:
-        """TERM the user process group and give it `grace_sec` to exit
-        cleanly before the KILL — long-running workloads (a serving task's
-        HTTP server) get their shutdown hooks; anything that ignores the
-        TERM dies exactly as before."""
+    def _terminate_user_proc(self,
+                             grace_sec: Optional[float] = None) -> None:
+        """TERM the user process group and give it the grace window to
+        exit cleanly before the KILL. The default is
+        tony.task.term-grace-ms, sized to cover a trainer's emergency
+        checkpoint (the TERM→checkpoint→KILL contract,
+        docs/FAULT_TOLERANCE.md); long-running workloads (a serving
+        task's HTTP server) get their shutdown hooks; anything that
+        ignores the TERM dies at the deadline exactly as before. The
+        wait returns the moment the process exits — a clean shutdown
+        never sleeps the full window."""
         proc = self._user_proc
         if proc is None or proc.poll() is not None:
             return
+        if grace_sec is None:
+            grace_sec = self._term_grace_sec
         import signal
         try:
             os.killpg(proc.pid, signal.SIGTERM)
@@ -737,22 +811,26 @@ class TaskExecutor:
         except Exception:  # noqa: BLE001 — TimeoutExpired and friends
             self._kill_user_proc()
 
-    def _report(self, exit_code: int, barrier_timeout: bool = False) -> None:
+    def _report(self, exit_code: int, barrier_timeout: bool = False,
+                preempted: bool = False) -> None:
         if self.heartbeater is not None:
             self.heartbeater.stop()
         self._push_spans()
         # a failing exit ships its own post-mortem: classified signature +
         # redacted tail ride the result RPC, so the AM's diagnostics
         # bundle works even when it can't reach this container's files
-        # (off-host backends)
+        # (off-host backends). A preempted drain is not a failure — no
+        # post-mortem to ship.
         diagnostics = None
-        if exit_code not in (C.EXIT_SUCCESS, C.EXIT_KILLED_BY_AM):
+        if not preempted and exit_code not in (C.EXIT_SUCCESS,
+                                               C.EXIT_KILLED_BY_AM):
             diagnostics = self._failure_diagnostics(exit_code)
         try:
             self.client.register_execution_result(
                 exit_code, self.job_name, self.task_index, self.session_id,
                 task_attempt=self.task_attempt,
                 barrier_timeout=barrier_timeout,
+                preempted=preempted,
                 diagnostics=diagnostics)
         except Exception:  # noqa: BLE001
             LOG.exception("failed to register execution result")

@@ -999,6 +999,12 @@ _TIMELINE_EVENTS = {
     "DIAGNOSTICS_READY": "critical",
     "ALERT_FIRING": None,       # severity comes from the payload
     "ALERT_RESOLVED": "info",
+    # checkpoint-then-evict lifecycle (cluster/arbiter.py + AM drain):
+    # the preemption story is exactly what an incident timeline must
+    # carry — why the job stopped, and that its successor resumed
+    "PREEMPTION_REQUESTED": "warning",
+    "PREEMPTED": "warning",
+    "RESUMED": "info",
 }
 
 

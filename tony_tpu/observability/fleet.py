@@ -58,10 +58,16 @@ ACCOUNTING_KEY = f"{C.FLEET_DIR_NAME}/accounting.json"
 
 LIVE_STATES = ("RUNNING",)
 LOST_STATE = "LOST"
-TERMINAL_STATES = ("SUCCEEDED", "FAILED", "KILLED", LOST_STATE)
+# PREEMPTED is terminal-but-resumable: the AM drained its gang on a
+# checkpoint-then-evict request and the arbiter may re-admit the job
+# later (the successor is a NEW app id carrying resumed-from lineage)
+PREEMPTED_STATE = "PREEMPTED"
+TERMINAL_STATES = ("SUCCEEDED", "FAILED", "KILLED", PREEMPTED_STATE,
+                   LOST_STATE)
 
 # display/sort order of states on the portal index + `cli top`
-STATE_ORDER = ("RUNNING", LOST_STATE, "FAILED", "KILLED", "SUCCEEDED")
+STATE_ORDER = ("RUNNING", LOST_STATE, PREEMPTED_STATE, "FAILED", "KILLED",
+               "SUCCEEDED")
 
 # The aggregation map: every job-level Prometheus gauge the AM exports →
 # the jobstate summary field it is published under. The fleet /metrics
@@ -75,6 +81,7 @@ JOB_GAUGES = {
     "tony_job_relaunch_downtime_seconds": "relaunch_downtime_s",
     "tony_job_straggler_count": "straggler_count",
     "tony_job_alerts_firing": "alerts_firing",
+    "tony_job_preemptions_total": "preemptions",
     "tony_job_step_time_p50_ms": "step_time_p50_ms",
     "tony_job_step_time_p95_ms": "step_time_p95_ms",
     "tony_job_step_time_p99_ms": "step_time_p99_ms",
@@ -98,6 +105,9 @@ def job_summary(app_id: str, user: str, queue: str, state: str, *,
                 straggler_count: int = 0,
                 alerts_firing: int = 0,
                 serving_tokens_per_sec: Optional[float] = None,
+                preemptions: int = 0,
+                priority: int = 0,
+                am_addr: str = "",
                 gauges: Optional[dict] = None,
                 heartbeat_ms: Optional[int] = None) -> dict:
     """The one jobstate schema (writer: AM; readers: registry, ledger,
@@ -120,6 +130,12 @@ def job_summary(app_id: str, user: str, queue: str, state: str, *,
         "straggler_count": int(straggler_count),
         "alerts_firing": int(alerts_firing),
         "serving_tokens_per_sec": serving_tokens_per_sec,
+        # arbitration surface: the admission arbiter reads victim
+        # priority from the registry entry and reaches the AM's control
+        # plane at am_addr to deliver request_preemption
+        "preemptions": int(preemptions),
+        "priority": int(priority),
+        "am_addr": am_addr,
         "gauges": dict(gauges or {}),
     }
 

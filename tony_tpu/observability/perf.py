@@ -256,19 +256,22 @@ def parse_goodput_gauges(gauges: dict[str, float]) -> Optional[dict]:
 
 
 def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
-                      relaunch_downtime_s: float = 0.0) -> dict:
+                      relaunch_downtime_s: float = 0.0,
+                      preemption_downtime_s: float = 0.0) -> dict:
     """Fold per-task ledgers + AM-side relaunch downtime into the job
     view flushed as `goodput.json`:
 
     {"tasks": {task_id: {"phases", "wall_s", "mfu_pct"?,
                          "tokens_per_sec_per_chip"?}},
      "job": {"goodput_pct", "productive_s", "wall_s",
-             "relaunch_downtime_s"}}
+             "relaunch_downtime_s", "preemption_downtime_s"}}
 
     goodput_pct = productive train-step seconds / (summed task wall +
-    relaunch downtime) — downtime the fault-tolerance layer spent
-    between attempts counts AGAINST goodput even though no task process
-    existed to observe it."""
+    relaunch downtime + preemption downtime) — downtime the
+    fault-tolerance layer spent between attempts, and the
+    eviction→resume gap a checkpoint-then-evict preemption cost this
+    job's lineage, both count AGAINST goodput even though no task
+    process existed to observe them."""
     tasks: dict[str, dict] = {}
     productive = 0.0
     wall_total = 0.0
@@ -286,7 +289,8 @@ def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
         wall_total += entry["wall_s"]
         productive += sum(entry["phases"].get(p, 0.0)
                           for p in PRODUCTIVE_PHASES)
-    denom = wall_total + max(0.0, relaunch_downtime_s)
+    denom = wall_total + max(0.0, relaunch_downtime_s) \
+        + max(0.0, preemption_downtime_s)
     return {
         "tasks": tasks,
         "job": {
@@ -295,6 +299,8 @@ def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
             "productive_s": round(productive, 4),
             "wall_s": round(denom, 4),
             "relaunch_downtime_s": round(max(0.0, relaunch_downtime_s), 4),
+            "preemption_downtime_s": round(
+                max(0.0, preemption_downtime_s), 4),
         },
     }
 

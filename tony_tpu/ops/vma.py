@@ -32,6 +32,20 @@ def ambient_abstract_mesh():
     return get() if get is not None else None
 
 
+def use_mesh(mesh):
+    """Enter `mesh` as the ambient mesh — `jax.set_mesh(mesh)` where it
+    exists (>= 0.5.x sharding-in-types), else the Mesh's own 0.4.x
+    context manager. The trainer's compat seam: on old builds there is
+    no abstract-mesh concept for constraints to consult (see
+    ambient_abstract_mesh above), so the legacy resource-env context is
+    the closest equivalent and explicit NamedShardings keep doing the
+    actual placement work."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh
+
+
 def vma_of(x) -> frozenset:
     """The operand's varying-manual-axes set (empty outside shard_map —
     and always empty on pre-typeof jax builds, which also predate

@@ -30,6 +30,7 @@ td,th{{border:1px solid #ccc;padding:4px 10px;text-align:left}}
 th{{background:#eee}}a{{text-decoration:none}}
 .RUNNING{{color:#b8860b}}.SUCCEEDED{{color:green}}.FAILED{{color:red}}
 .KILLED{{color:#555}}.LOST{{color:#c0392b;font-style:italic}}
+.PREEMPTED{{color:#8e44ad}}
 .waterfall td{{vertical-align:middle}}
 .spanbar{{height:10px;border-radius:2px;min-width:2px}}
 </style></head><body><h2>{title}</h2>{body}</body></html>"""

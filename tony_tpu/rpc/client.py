@@ -183,12 +183,16 @@ class ClusterServiceClient(_JsonRpcClient):
                                   job_index: int, session_id: int,
                                   task_attempt: int = -1,
                                   barrier_timeout: bool = False,
+                                  preempted: bool = False,
                                   diagnostics: Optional[dict] = None
                                   ) -> None:
         """barrier_timeout marks a gang-rendezvous timeout: an allocation
         problem, not a task fault — the AM must not spend relaunch budget
-        on it. An explicit flag because exit codes can't carry it: every
-        0-255 value is reachable by the user process itself.
+        on it. `preempted` marks a graceful-drain exit (the executor
+        TERMed its user process on a drain ask): terminal, not a fault,
+        no relaunch, PREEMPTED task status. Both are explicit flags
+        because exit codes can't carry them: every 0-255 value is
+        reachable by the user process itself.
         `diagnostics` (failures only) carries the executor's classified,
         REDACTED post-mortem — exit/signal decoding, matched error
         signature, bounded tail excerpt (observability/logs.py) — so the
@@ -198,7 +202,8 @@ class ClusterServiceClient(_JsonRpcClient):
             "exit_code": exit_code, "job_name": job_name,
             "job_index": job_index, "session_id": session_id,
             "task_attempt": task_attempt,
-            "barrier_timeout": barrier_timeout}
+            "barrier_timeout": barrier_timeout,
+            "preempted": preempted}
         if diagnostics:
             req["diagnostics"] = diagnostics
         self.call("register_execution_result", req)
@@ -223,6 +228,18 @@ class ClusterServiceClient(_JsonRpcClient):
             req["log_addr"] = log_addr
         return self.call("task_executor_heartbeat", req,
                          retries=1, timeout_sec=5.0, wait_for_ready=False)
+
+    def request_preemption(self, grace_ms: int = 0, reason: str = "",
+                           requested_by: str = "operator") -> dict:
+        """Begin checkpoint-then-evict on this AM (cluster/arbiter.py's
+        eviction edge + the `cli preempt` operator verb): the drain ask
+        rides every task's next heartbeat, trainers emergency-checkpoint
+        within `grace_ms`, and the application finishes PREEMPTED.
+        Client-plane: never a task token."""
+        return self.call("request_preemption",
+                         {"grace_ms": int(grace_ms), "reason": reason,
+                          "requested_by": requested_by},
+                         retries=1, timeout_sec=10.0, wait_for_ready=False)
 
     def request_profile(self, task_id: str = "",
                         num_steps: int = 0) -> dict:

@@ -21,10 +21,12 @@ class TaskStatus(str, enum.Enum):
     SUCCEEDED = "SUCCEEDED"
     FAILED = "FAILED"
     FINISHED = "FINISHED"  # killed by the AM; completed but not a failure
+    PREEMPTED = "PREEMPTED"  # checkpoint-then-evict drain: stopped on
+                             # request, expected to resume from checkpoint
 
     def is_terminal(self) -> bool:
         return self in (TaskStatus.SUCCEEDED, TaskStatus.FAILED,
-                        TaskStatus.FINISHED)
+                        TaskStatus.FINISHED, TaskStatus.PREEMPTED)
 
 
 @dataclass

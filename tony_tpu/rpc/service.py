@@ -46,6 +46,7 @@ CLUSTER_METHODS = (
     "read_task_logs",
     "get_skew",
     "get_alerts",
+    "request_preemption",
 )
 METRICS_METHODS = ("update_metrics",)
 TASK_LOG_METHODS = ("read_log",)
@@ -138,6 +139,18 @@ class ClusterServiceHandler(abc.ABC):
         /api/jobs/:id/alerts proxies this for RUNNING jobs; the same
         shape is flushed to history as alerts.json on every
         transition."""
+
+    @abc.abstractmethod
+    def request_preemption(self, req: dict) -> dict:
+        """Arbiter/operator plane: req {grace_ms?, reason?,
+        requested_by?} -> {app_id, grace_ms, deadline_ms} (or {error}).
+        Begins checkpoint-then-evict: the drain ask rides every task's
+        next heartbeat, executors TERM their user processes (trainers
+        emergency-checkpoint within the grace window), and the
+        application finishes PREEMPTED once the gang has stopped —
+        containers still running at the deadline are force-stopped.
+        Idempotent: a second request returns the in-flight drain's
+        deadline. Client-plane only; task tokens fail closed."""
 
     @abc.abstractmethod
     def request_profile(self, req: dict) -> dict:

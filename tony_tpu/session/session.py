@@ -37,6 +37,11 @@ class FinalStatus(str, enum.Enum):
     SUCCEEDED = "SUCCEEDED"
     FAILED = "FAILED"
     KILLED = "KILLED"
+    # checkpoint-then-evict: the application was drained on an arbiter/
+    # operator preemption request and is expected to RESUME from its
+    # checkpoint later — terminal for this AM, but neither a failure
+    # nor an operator kill
+    PREEMPTED = "PREEMPTED"
 
 
 class Task:
@@ -77,14 +82,18 @@ class Task:
         host, _, port = host_port.rpartition(":")
         self.host, self.port = host, int(port)
 
-    def set_exit_status(self, status: int) -> None:
+    def set_exit_status(self, status: int, preempted: bool = False) -> None:
         """Settable exactly once — late container-completion callbacks must not
-        overwrite the executor-registered result (TonySession.java:480-497)."""
+        overwrite the executor-registered result (TonySession.java:480-497).
+        `preempted` marks a checkpoint-then-evict drain exit: terminal but
+        not a failure, whatever the exit code."""
         with self._lock:
             if self._exit_status is not None:
                 return
             self._exit_status = status
-            if status == 0:
+            if preempted:
+                self.status = TaskStatus.PREEMPTED
+            elif status == 0:
                 self.status = TaskStatus.SUCCEEDED
             elif status == EXIT_KILLED_BY_AM:
                 self.status = TaskStatus.FINISHED
@@ -299,17 +308,21 @@ class TonySession:
     # ------------------------------------------------------------------
     # completion + final status
     # ------------------------------------------------------------------
-    def on_task_completed(self, job_name: str, index: int, exit_code: int) -> None:
+    def on_task_completed(self, job_name: str, index: int, exit_code: int,
+                          preempted: bool = False) -> None:
         """Record an exit code; short-circuit the session on chief failure,
         stop-on-failure jobtypes, or fail-on-worker-failure
-        (TonySession.onTaskCompleted, TonySession.java:251-271)."""
+        (TonySession.onTaskCompleted, TonySession.java:251-271). A
+        `preempted` exit (graceful drain) is terminal-but-not-a-failure:
+        it never short-circuits and never counts in the aggregation."""
         task = self.get_task(job_name, index)
         if task is None:
             LOG.error("completion for unknown task %s:%s", job_name, index)
             return
-        LOG.info("task %s exited with %d", task.task_id, exit_code)
-        task.set_exit_status(exit_code)
-        if exit_code not in (0, EXIT_KILLED_BY_AM):
+        LOG.info("task %s exited with %d%s", task.task_id, exit_code,
+                 " (preempted)" if preempted else "")
+        task.set_exit_status(exit_code, preempted=preempted)
+        if not preempted and exit_code not in (0, EXIT_KILLED_BY_AM):
             if (self.is_chief(job_name, index)
                     or job_name in self._stop_on_failure
                     or self._fail_on_worker_failure):
@@ -319,8 +332,11 @@ class TonySession:
 
     def update_session_status(self) -> None:
         """Aggregate the final status over tracked tasks
-        (TonySession.updateSessionStatus, TonySession.java:276-330)."""
-        if self.final_status == FinalStatus.FAILED:
+        (TonySession.updateSessionStatus, TonySession.java:276-330).
+        PREEMPTED is sticky like FAILED: the drain path set it with full
+        knowledge of the task states, and a preempted task's non-zero
+        exit must never be re-read as a worker failure."""
+        if self.final_status in (FinalStatus.FAILED, FinalStatus.PREEMPTED):
             return
         failure_count = 0
         for job, tasks in self.job_tasks.items():
@@ -332,6 +348,8 @@ class TonySession:
                         FinalStatus.FAILED,
                         f"Task {task.task_id} hasn't finished yet.")
                     return
+                if task.status == TaskStatus.PREEMPTED:
+                    continue
                 if task.exit_status != 0:
                     failure_count += 1
         if failure_count > 0:

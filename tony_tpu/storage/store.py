@@ -59,6 +59,11 @@ class StagingStore(abc.ABC):
         (e.g. "step_*/COMMIT") — targeted enumeration so callers don't
         have to list an entire tree to find a handful of markers."""
 
+    def delete(self, key: str) -> None:
+        """Remove one object by key (relative to the base). Checkpoint
+        retention GC needs this; stores that can't delete may raise."""
+        raise NotImplementedError(f"{type(self).__name__} cannot delete")
+
 
 class LocalDirStore(StagingStore):
     """Shared-filesystem store rooted at a directory (the round-1 layout:
@@ -111,6 +116,12 @@ class LocalDirStore(StagingStore):
         hits = _glob.glob(os.path.join(self.root, pattern))
         return sorted(os.path.relpath(h, self.root) for h in hits
                       if os.path.isfile(h))
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(os.path.join(self.root, key))
+        except FileNotFoundError:
+            pass
 
 
 class GCSStore(StagingStore):
@@ -192,6 +203,20 @@ class GCSStore(StagingStore):
 
     def uri(self, key: str) -> str:
         return f"{self.base}/{key}"
+
+    def delete(self, key: str) -> None:
+        cmd = [*self._cli, "rm", f"{self.base}/{key}"]
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=600)
+        if out.returncode != 0:
+            err = out.stderr.lower()
+            # already gone = done (GC is idempotent across racing hosts)
+            if "matched no objects" in err or "no urls matched" in err \
+                    or "not found" in err:
+                return
+            raise RuntimeError(
+                f"{' '.join(cmd[:2])} {self.base}/{key} failed "
+                f"rc={out.returncode}: {out.stderr.strip()[-500:]}")
 
 
 def staging_store(location: str, app_dir: str) -> StagingStore:

@@ -206,21 +206,88 @@ def _write_snapshot_store(base: str, step: int, treedef, metas,
 
 
 def _write_any(ckpt_dir: str, step: int, treedef, metas,
-               records) -> Optional[str]:
+               records, keep: int = 0,
+               pinned: Optional[int] = None) -> Optional[str]:
     if _is_store_path(ckpt_dir):
-        return _write_snapshot_store(ckpt_dir, step, treedef, metas,
-                                     records)
-    return _write_snapshot(ckpt_dir, step, treedef, metas, records)
+        result = _write_snapshot_store(ckpt_dir, step, treedef, metas,
+                                       records)
+    else:
+        result = _write_snapshot(ckpt_dir, step, treedef, metas, records)
+    # retention GC runs on the committing process only (result is
+    # non-None exactly on process 0, after the rename/COMMIT landed) —
+    # the just-written step is always in the kept set, so a failed
+    # prune can never invalidate the commit that triggered it
+    if result is not None and keep > 0:
+        try:
+            prune_checkpoints(ckpt_dir, keep, pinned=pinned)
+        except Exception:  # noqa: BLE001 — GC must never fail a commit
+            LOG.exception("checkpoint retention prune failed under %s",
+                          ckpt_dir)
+    return result
 
 
-def save_checkpoint(ckpt_dir: str, step: int, state: Any) -> Optional[str]:
+def save_checkpoint(ckpt_dir: str, step: int, state: Any,
+                    keep: int = 0,
+                    pinned: Optional[int] = None) -> Optional[str]:
     """Write `state` (any pytree) as step `step`. Every process must call
     this (it barriers before the commit in multi-process jobs); each
     writes only its own shards. `ckpt_dir` may be a local/NFS directory
     (tmp+rename protocol) or a gs:// location (upload + COMMIT-marker
     protocol — no shared filesystem needed). Returns the final
-    path/URI on process 0."""
-    return _write_any(ckpt_dir, step, *_snapshot(state))
+    path/URI on process 0.
+
+    keep > 0 prunes older committed steps down to the newest `keep`
+    after a successful commit (tony.checkpoint.keep); `pinned` names a
+    step that must survive GC regardless of age — the step the current
+    run restored from, still a live rollback target."""
+    return _write_any(ckpt_dir, step, *_snapshot(state), keep=keep,
+                      pinned=pinned)
+
+
+def committed_steps(ckpt_dir: str) -> list[int]:
+    """Every complete checkpoint step, ascending (the retention GC's
+    and `latest_step`'s shared source of truth)."""
+    if _is_store_path(ckpt_dir):
+        return sorted(int(m.group(1))
+                      for key in _ckpt_store(ckpt_dir).glob("step_*/COMMIT")
+                      if (m := _COMMIT_KEY_RE.match(key)))
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(m.group(1)) for name in os.listdir(ckpt_dir)
+                  if (m := _STEP_RE.match(name)))
+
+
+def prune_checkpoints(ckpt_dir: str, keep: int,
+                      pinned: Optional[int] = None) -> list[int]:
+    """Delete committed `step_N` dirs beyond the newest `keep` (oldest
+    first), never touching `pinned` — the step a restore is anchored to
+    stays a valid rollback target until enough NEWER checkpoints exist.
+    Works on both protocols: local dirs are rmtree'd; on an object store
+    the COMMIT marker is deleted FIRST, so a reader that races the GC
+    sees a cleanly-uncommitted step (invisible), never a half checkpoint.
+    Returns the pruned step numbers."""
+    if keep <= 0:
+        return []
+    steps = committed_steps(ckpt_dir)
+    victims = [s for s in steps[:-keep] if s != pinned] \
+        if len(steps) > keep else []
+    if not victims:
+        return []
+    if _is_store_path(ckpt_dir):
+        store = _ckpt_store(ckpt_dir)
+        for step in victims:
+            prefix = f"step_{step}"
+            store.delete(f"{prefix}/{_COMMIT_FILE}")
+            for key in store.list_keys(prefix):
+                if key != f"{prefix}/{_COMMIT_FILE}":
+                    store.delete(key)
+    else:
+        for step in victims:
+            shutil.rmtree(os.path.join(ckpt_dir, f"step_{step}"),
+                          ignore_errors=True)
+    LOG.info("checkpoint GC pruned step(s) %s (keep=%d%s)", victims, keep,
+             f", pinned={pinned}" if pinned is not None else "")
+    return victims
 
 
 def _barrier() -> None:
@@ -498,8 +565,13 @@ class AsyncCheckpointer:
     `latest_step` on the same process and `close()` at shutdown (the
     Trainer does)."""
 
-    def __init__(self, ckpt_dir: str):
+    def __init__(self, ckpt_dir: str, keep: int = 0,
+                 pinned: Optional[int] = None):
         self.ckpt_dir = ckpt_dir
+        # retention: prune past the newest `keep` commits, never the
+        # `pinned` step (what this run restored from)
+        self.keep = keep
+        self.pinned = pinned
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
 
@@ -509,7 +581,8 @@ class AsyncCheckpointer:
 
         def work():
             try:
-                _write_any(self.ckpt_dir, step, treedef, metas, records)
+                _write_any(self.ckpt_dir, step, treedef, metas, records,
+                           keep=self.keep, pinned=self.pinned)
             except BaseException as e:  # noqa: BLE001 — surfaced in wait()
                 self._error = e
                 LOG.exception("async checkpoint step %d failed", step)

@@ -34,6 +34,15 @@ from tony_tpu.train.step import make_train_step
 LOG = logging.getLogger(__name__)
 
 
+class TrainerPreempted(BaseException):
+    """Raised by the Trainer's SIGTERM handler in the main thread:
+    checkpoint-then-evict preemption (or a real TPU maintenance/spot
+    eviction — the handler is signal-driven, not arbiter-specific).
+    BaseException so user-level `except Exception` blocks can't swallow
+    the drain; run() converts it into an emergency checkpoint +
+    SystemExit(EXIT_PREEMPTED)."""
+
+
 def maybe_initialize_distributed() -> None:
     """Call jax.distributed.initialize iff the orchestrator rendered a
     multi-process env; single-process runs skip it. Idempotent: user code
@@ -82,6 +91,11 @@ class TrainerConfig:
     # flops_per_token(seq); 0 = MFU not reported). Throughput
     # (tokens/sec/chip) is derived from batch shapes regardless.
     flops_per_token: float = 0.0
+    # checkpoint retention: committed step dirs kept after each commit
+    # (never the step this run restored from). None = the
+    # TONY_CHECKPOINT_KEEP env the executor renders from
+    # tony.checkpoint.keep (default 3); 0 = keep everything.
+    checkpoint_keep: Optional[int] = None
     extra: dict = field(default_factory=dict)
 
 
@@ -111,9 +125,20 @@ class Trainer:
         self.last_loss: Optional[float] = None
         self.metrics_history: list[dict] = []
         self._checkpointer = None
+        # the step this run restored from — pinned against retention GC
+        # (still the only rollback target until newer commits exist)
+        self._restore_pinned: Optional[int] = None
+        # set by the SIGTERM-driven emergency path (read by callers that
+        # want to distinguish a preempted exit from a completed run)
+        self.preempted = False
 
     # ------------------------------------------------------------------
     def setup(self) -> None:
+        # the drain contract arms as early as possible: a SIGTERM during
+        # setup/restore still routes through the emergency path instead
+        # of the default kill (run() re-installs for setup()-skipping
+        # callers)
+        self._install_sigterm_handler()
         # lifecycle tracing: parented under the executor's user_process
         # span via the env it rendered; spans ship through the reporter's
         # non-blocking queue — the hot loop never gains an RPC
@@ -236,7 +261,8 @@ class Trainer:
             pspecs = jax.tree.map(lambda _: PartitionSpec(), self.params)
         ospecs = opt_state_specs(
             jax.eval_shape(self.optimizer.init, self.params), pspecs)
-        with jax.set_mesh(self.mesh):
+        from tony_tpu.ops.vma import use_mesh
+        with use_mesh(self.mesh):
             opt_state = jax.jit(
                 self.optimizer.init,
                 out_shardings=jax.tree.map(
@@ -247,6 +273,7 @@ class Trainer:
             # regions it overlaps (mmap) — no host ever holds a full leaf,
             # and the checkpoint reshards onto this run's mesh for free
             LOG.info("resuming from checkpoint step %d", resume)
+            self._restore_pinned = resume
             self.ledger.transition("checkpoint_restore")
             with self._tracer.span("checkpoint_restore",
                                    attrs={"step": resume}):
@@ -396,6 +423,7 @@ class Trainer:
         The final boundary and the final loss flush after the loop."""
         if self.params is None:
             self.setup()
+        self._install_sigterm_handler()
         if getattr(self, "ledger", None) is None:
             # params injected by hand (setup() skipped): account from here
             from tony_tpu.observability.perf import GoodputLedger
@@ -438,8 +466,9 @@ class Trainer:
         if self.step < cfg.num_steps:
             self.ledger.transition("compile" if first_span is not None
                                    else "train_step")
+        from tony_tpu.ops.vma import use_mesh
         try:
-            with jax.set_mesh(self.mesh):
+            with use_mesh(self.mesh):
                 t0 = time.monotonic()
                 while self.step < cfg.num_steps:
                     batch = next(self._global_data_iter)
@@ -495,6 +524,23 @@ class Trainer:
                 elif self._checkpointer is not None:
                     self._checkpointer.close()
                     self._checkpointer = None
+        except BaseException as e:
+            # emergency save: the SIGTERM-driven drain (TrainerPreempted
+            # — checkpoint-then-evict preemption, TPU maintenance, spot
+            # eviction) AND any unhandled mid-run exception land here,
+            # so a run that dies mid-epoch keeps its progress instead of
+            # only its cadence checkpoints. Best-effort by construction:
+            # the save must never mask the real error.
+            preempting = isinstance(e, TrainerPreempted)
+            self._emergency_checkpoint(
+                reason="preemption" if preempting else type(e).__name__)
+            if preempting:
+                self.preempted = True
+                LOG.warning("preempted at step %d — emergency checkpoint "
+                            "committed; exiting %d", self.step,
+                            C.EXIT_PREEMPTED)
+                raise SystemExit(C.EXIT_PREEMPTED) from e
+            raise
         finally:
             # an error mid-loop must not lose the already-queued log
             # boundary the synchronous loop would have recorded (the
@@ -540,6 +586,76 @@ class Trainer:
         except Exception:  # noqa: BLE001 — profiling must never kill training
             LOG.exception("could not start profiler server")
 
+    def _checkpoint_keep(self) -> int:
+        """Retention count: config wins, else the executor-rendered
+        TONY_CHECKPOINT_KEEP (tony.checkpoint.keep), else 3."""
+        keep = self.config.checkpoint_keep
+        if keep is None:
+            try:
+                keep = int(os.environ.get(C.CHECKPOINT_KEEP, "") or 3)
+            except ValueError:
+                keep = 3
+        return max(0, keep)
+
+    def _install_sigterm_handler(self) -> None:
+        """Arm the checkpoint-then-evict drain: SIGTERM (forwarded by
+        the executor on a preemption drain, or delivered directly by a
+        TPU maintenance/spot eviction) raises TrainerPreempted in the
+        main thread, and run()'s emergency path commits one synchronous
+        checkpoint before exiting EXIT_PREEMPTED. Signal handlers only
+        install from the main thread; anywhere else (unit tests driving
+        run() from a worker thread) the drain falls back to whatever
+        the process-level default does."""
+        import signal
+        import threading as _threading
+        if _threading.current_thread() is not _threading.main_thread():
+            return
+        try:
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        except (ValueError, OSError):  # non-main interpreter contexts
+            LOG.debug("could not install SIGTERM handler", exc_info=True)
+
+    def _on_sigterm(self, signum, frame) -> None:
+        LOG.warning("SIGTERM — draining for emergency checkpoint at "
+                    "step %d", self.step)
+        raise TrainerPreempted()
+
+    def _emergency_checkpoint(self, reason: str = "") -> None:
+        """One synchronous save of the current state: wait out any
+        in-flight async write (its commit is newer evidence than a
+        crash), then commit this step unless it is already on disk.
+        Every failure is swallowed — this runs on the way out of a
+        dying process and must never mask the original error."""
+        cfg = self.config
+        if not cfg.checkpoint_dir or self.params is None or self.step <= 0:
+            return
+        try:
+            from tony_tpu.train.checkpoint import save_checkpoint
+            if self._checkpointer is not None:
+                try:
+                    self._checkpointer.wait()
+                except Exception:  # noqa: BLE001 — prior async failure
+                    LOG.exception("in-flight async checkpoint failed "
+                                  "during emergency drain")
+            if latest_step(cfg.checkpoint_dir) == self.step:
+                LOG.info("emergency checkpoint: step %d already "
+                         "committed", self.step)
+                return
+            ledger = getattr(self, "ledger", None)
+            if ledger is not None:
+                ledger.transition("checkpoint_save")
+            save_checkpoint(
+                cfg.checkpoint_dir, self.step,
+                {"params": self.params, "opt_state": self.opt_state,
+                 "step": self.step},
+                keep=self._checkpoint_keep(), pinned=self._restore_pinned)
+            if ledger is not None:
+                ledger.transition("idle")
+            LOG.warning("emergency checkpoint committed at step %d (%s)",
+                        self.step, reason or "unhandled error")
+        except BaseException:  # noqa: BLE001 — never mask the real error
+            LOG.exception("emergency checkpoint failed")
+
     def _checkpoint(self, final: bool = False) -> None:
         """Mid-training saves are async (file IO overlaps the next steps;
         the device->host snapshot inside save() is synchronous because the
@@ -547,7 +663,9 @@ class Trainer:
         if self._checkpointer is None:
             from tony_tpu.train.checkpoint import AsyncCheckpointer
             self._checkpointer = AsyncCheckpointer(
-                self.config.checkpoint_dir)
+                self.config.checkpoint_dir,
+                keep=self._checkpoint_keep(),
+                pinned=self._restore_pinned)
         tracer = getattr(self, "_tracer", None)
         span = (tracer.start("checkpoint_save",
                              attrs={"step": self.step, "final": final})
