@@ -553,62 +553,30 @@ def _rss_mb() -> float:
         return 0.0
 
 
-def _control_plane_width(width: int, history_points: int = 64,
-                         max_spans: int = 2048) -> dict:
-    """Synthetic-width control-plane storm (ROADMAP item 3's measuring
-    stick): `width` STUB tasks — real retrying gRPC clients, no
-    containers/user processes — against the REAL AM-side control plane
-    (TonySession gang barrier + MetricsStore + SpanStore behind the
-    genuine JSON-gRPC server). Records submit->all-registered latency,
-    heartbeat round-trip at width, AM-process RSS, and SpanStore/
-    MetricsStore sizes; then drives 3x history_points metric samples per
-    task through MetricsStore.update_metrics and asserts the PR-4
-    stride-doubling decimation actually bounds memory at this width.
-    The same drive feeds the cross-task skew path (observability/
-    skew.py) through the store's skew_sink, then rolls + analyzes 3
-    windows with one injected 3x straggler — asserting the sketch state
-    is O(buckets) (identical at every width) and reporting the
-    analyzer's per-pass latency."""
-    import statistics
-    import threading as th
-
-    from tony_tpu.am.application_master import MetricsStore
-    from tony_tpu.conf import keys as K
-    from tony_tpu.conf.configuration import TonyConfiguration
-    from tony_tpu.observability.skew import SkewTracker, StragglerAnalyzer
-    from tony_tpu.observability.trace import SpanStore
-    from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
-    from tony_tpu.rpc.service import ClusterServiceHandler, serve
-    from tony_tpu.session.session import TonySession
-
-    conf = TonyConfiguration()
-    conf.set(K.instances_key("worker"), width, "bench")
-    session = TonySession(conf)
-    session.num_expected_tasks = width
-    store = MetricsStore(history_points=history_points)
-    spans = SpanStore(max_spans)
-    store.span_sink = spans.add
-    # cross-task skew path (observability/skew.py), wired exactly like
-    # the AM wires it: every numeric gauge the decimation drive below
-    # pushes through update_metrics also folds into the tracker's
-    # windowed sketches — so the skew bench measures the REAL ingest path
-    skew_buckets = 96
-    tracker = SkewTracker(buckets=skew_buckets, heatmap_windows=8)
-    analyzer = StragglerAnalyzer(threshold_pct=50, windows=2,
-                                 min_tasks=3)
-    store.skew_sink = tracker.observe_metric
+def _make_cp_handler(session, monitor, on_result=None):
+    """The AM's control-plane surface over a real TonySession + sharded
+    LivelinessMonitor, mirroring ApplicationMaster's handlers (attempt
+    fence, liveliness plant/ping, generation-keyed spec-diff piggyback) —
+    shared by the stub storm and the real-executor gang legs."""
+    from tony_tpu.rpc.service import ClusterServiceHandler
 
     class _Handler(ClusterServiceHandler):
         def get_task_infos(self, req):
             return []
 
         def get_cluster_spec(self, req):
-            return {"spec": session.cluster_spec_json()}
+            spec = session.cluster_spec_json()
+            if spec is not None:
+                session.note_full_serve(spec)
+            return {"spec": spec, "generation": session.spec_generation}
 
         def register_worker_spec(self, req):
-            spec, generation, _ = \
+            attempt = int(req.get("task_attempt", -1))
+            spec, generation, accepted = \
                 session.register_worker_spec_with_generation(
-                    req["task_id"], req["spec"])
+                    req["task_id"], req["spec"], expected_attempt=attempt)
+            if accepted and monitor is not None:
+                monitor.register(req["task_id"], max(0, attempt))
             return {"spec": spec, "generation": generation}
 
         def register_tensorboard_url(self, req):
@@ -618,13 +586,31 @@ def _control_plane_width(width: int, history_points: int = 64,
             return {}
 
         def register_execution_result(self, req):
+            if monitor is not None:
+                monitor.unregister(
+                    f"{req['job_name']}:{req['job_index']}")
+            if on_result is not None:
+                on_result(req)
             return {}
 
         def finish_application(self, req):
             return {}
 
         def task_executor_heartbeat(self, req):
-            return {"spec_generation": session.spec_generation}
+            generation = session.spec_generation
+            attempt = int(req.get("task_attempt", -1))
+            if attempt >= 0:
+                task = session.get_task_by_id(req["task_id"])
+                if task is not None and attempt != task.attempt:
+                    return {"spec_generation": generation}
+            if monitor is not None:
+                monitor.ping(req["task_id"])
+            resp = {"spec_generation": generation}
+            exec_gen = int(req.get("spec_generation", -1) or -1)
+            # the ONE shared piggyback implementation — the bench measures
+            # the protocol production runs, never a hand-copied drift
+            resp.update(session.heartbeat_spec_fields(exec_gen))
+            return resp
 
         def request_profile(self, req):
             return {"error": "control-plane harness"}
@@ -641,8 +627,70 @@ def _control_plane_width(width: int, history_points: int = 64,
         def request_preemption(self, req):
             return {"error": "control-plane harness"}
 
-    server, port = serve(cluster_handler=_Handler(), metrics_handler=store,
-                         max_workers=32)
+    return _Handler()
+
+
+def _control_plane_width(width: int, history_points: int = 64,
+                         max_spans: int = 2048,
+                         relaunch_rounds: int = 12) -> dict:
+    """Synthetic-width control-plane storm (ROADMAP item 3's measuring
+    stick): `width` STUB tasks — real retrying gRPC clients, no
+    containers/user processes — against the REAL AM-side control plane
+    (TonySession gang barrier + sharded LivelinessMonitor + MetricsStore
+    + SpanStore behind the genuine JSON-gRPC server). Records
+    submit->all-registered latency, heartbeat round-trip p50/p95 at
+    width, AM-process RSS, and SpanStore/MetricsStore sizes; then drives
+    3x history_points metric samples per task through
+    MetricsStore.update_metrics and asserts the PR-4 stride-doubling
+    decimation actually bounds memory at this width (plus the skew
+    sketch/analyzer drive, as before).
+
+    New (coalesced control plane): after rendezvous every stub fetches
+    the full spec once (the real launch-time fan-out), then
+    `relaunch_rounds` relaunch generations propagate to every survivor
+    via heartbeat-piggybacked spec DIFFS alone. spec_bytes_sent counts
+    actual wire bytes; spec_bytes_full_equiv is what the pre-diff
+    protocol would have fanned out ((1+rounds) x width x full-spec) —
+    the O(width^2)->O(width) acceptance ratio."""
+    import statistics
+    import threading as th
+
+    from tony_tpu.am.application_master import MetricsStore
+    from tony_tpu.am.liveliness import (
+        LivelinessMonitor, auto_liveliness_shards,
+    )
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.configuration import TonyConfiguration
+    from tony_tpu.executor.task_executor import apply_spec_diff
+    from tony_tpu.observability.skew import SkewTracker, StragglerAnalyzer
+    from tony_tpu.observability.trace import SpanStore
+    from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
+    from tony_tpu.rpc.service import auto_rpc_workers, serve
+    from tony_tpu.session.session import TonySession
+
+    conf = TonyConfiguration()
+    conf.set(K.instances_key("worker"), width, "bench")
+    session = TonySession(conf)
+    session.num_expected_tasks = width
+    store = MetricsStore(history_points=history_points)
+    spans = SpanStore(max_spans)
+    store.span_sink = spans.add
+    monitor = LivelinessMonitor(1000, 25, lambda tid, att: None,
+                                shards=auto_liveliness_shards(width))
+    monitor.start()
+    # cross-task skew path (observability/skew.py), wired exactly like
+    # the AM wires it: every numeric gauge the decimation drive below
+    # pushes through update_metrics also folds into the tracker's
+    # windowed sketches — so the skew bench measures the REAL ingest path
+    skew_buckets = 96
+    tracker = SkewTracker(buckets=skew_buckets, heatmap_windows=8)
+    analyzer = StragglerAnalyzer(threshold_pct=50, windows=2,
+                                 min_tasks=3)
+    store.skew_sink = tracker.observe_metric
+
+    server, port = serve(cluster_handler=_make_cp_handler(session, monitor),
+                         metrics_handler=store,
+                         max_workers=auto_rpc_workers(width))
     n_clients = min(width, 32)
     cluster = [ClusterServiceClient("127.0.0.1", port)
                for _ in range(n_clients)]
@@ -702,6 +750,84 @@ def _control_plane_width(width: int, history_points: int = 64,
     all_registered_s = time.monotonic() - t0
     registered = session.all_tasks_registered()
 
+    # ---- launch-time spec fan-out + relaunch/diff storm ----------------
+    # Every task fetches the full spec exactly once (what a real executor
+    # needs to render its user-process env) ...
+    def _parallel(fn, items, pool=64):
+        ts, sem2 = [], th.Semaphore(pool)
+
+        def _go(item):
+            try:
+                fn(item)
+            except Exception as e:  # noqa: BLE001
+                with hb_lock:
+                    errors.append(f"{item}: {type(e).__name__}: {e}")
+            finally:
+                sem2.release()
+
+        for item in items:
+            sem2.acquire()
+            t2 = th.Thread(target=_go, args=(item,), daemon=True)
+            t2.start()
+            ts.append(t2)
+        for t2 in ts:
+            t2.join(timeout=120)
+
+    _parallel(lambda i: cluster[i % n_clients].call(
+        "get_cluster_spec", {"task_id": f"worker:{i}"}), range(width))
+    full_spec_json = session.cluster_spec_json() or "{}"
+    # ... then `relaunch_rounds` generations: each relaunch reaches every
+    # survivor as a heartbeat-piggybacked DIFF (O(changed) bytes), never
+    # a full-spec re-fetch. A sample of survivors applies its diffs
+    # locally; bit-identical convergence is asserted at the end.
+    held_gen = {i: 1 for i in range(width)}
+    sample = {i: json.loads(full_spec_json) for i in range(min(8, width))}
+    diff_misses = [0]
+
+    def _survive(i):
+        t1 = time.monotonic()
+        resp = cluster[i % n_clients].call(
+            "task_executor_heartbeat",
+            {"task_id": f"worker:{i}", "task_attempt": 0,
+             "spec_generation": held_gen[i]},
+            retries=1, timeout_sec=10.0)
+        with hb_lock:
+            hb_times.append(time.monotonic() - t1)
+        diff = (resp or {}).get("spec_diff")
+        if not diff:
+            with hb_lock:
+                diff_misses[0] += 1
+            return
+        held_gen[i] = diff["generation"]
+        if i in sample:
+            sample[i] = apply_spec_diff(sample[i], diff["changed"])
+
+    victim = 0
+    for r in range(1, relaunch_rounds + 1):
+        task = session.relaunch_task("worker", victim)
+        monitor.unregister(f"worker:{victim}")
+        cluster[0].call("register_worker_spec",
+                        {"task_id": f"worker:{victim}",
+                         "spec": f"repl{r}:1",
+                         "task_attempt": task.attempt})
+        held_gen[victim] = session.spec_generation
+        if victim in sample:
+            sample[victim][
+                "worker"][victim] = f"repl{r}:1"
+        _parallel(_survive, [i for i in range(width) if i != victim])
+    final_spec = session.cluster_spec_json() or "{}"
+    diff_converged = (diff_misses[0] == 0
+                      and all(held_gen[i] == session.spec_generation
+                              for i in range(width))
+                      and all(json.dumps(s) == final_spec
+                              for s in sample.values()))
+    stats = dict(session.spec_stats)
+    spec_bytes_sent = stats["full_bytes"] + stats["diff_bytes"]
+    # the pre-diff protocol's fan-out: every task re-fetches the full
+    # spec at rendezvous AND after every relaunch generation
+    spec_bytes_full_equiv = (1 + relaunch_rounds) * width \
+        * len(full_spec_json)
+
     # decimation-boundedness drive: 3x the ring capacity of samples per
     # task through the REAL store path (in-process — the wire above
     # already measured RPC cost); the stride-doubling TimeSeries must
@@ -758,13 +884,29 @@ def _control_plane_width(width: int, history_points: int = 64,
 
     bounded = (max_points <= history_points
                and len(spans) <= max_spans
-               and skew_bounded)
+               and skew_bounded
+               and diff_converged)
+    hb_sorted = sorted(hb_times)
     out = {
         "width": width,
         "registered": registered,
         "submit_to_all_registered_s": round(all_registered_s, 3),
         "heartbeat_p50_ms": (round(
             1000 * statistics.median(hb_times), 2) if hb_times else None),
+        "heartbeat_p95_ms": (round(
+            1000 * hb_sorted[int(0.95 * (len(hb_sorted) - 1))], 2)
+            if hb_sorted else None),
+        "spec": {
+            "relaunch_rounds": relaunch_rounds,
+            "renders": stats["renders"],
+            "full_serves": stats["full_serves"],
+            "diff_serves": stats["diff_serves"],
+            "bytes_sent": spec_bytes_sent,
+            "bytes_full_equiv": spec_bytes_full_equiv,
+            "fanout_reduction_x": round(
+                spec_bytes_full_equiv / max(1, spec_bytes_sent), 1),
+            "diff_converged": diff_converged,
+        },
         "rss_mb": _rss_mb(),
         "span_store": {"held": len(spans), "dropped": spans.dropped,
                        "cap": max_spans},
@@ -784,17 +926,285 @@ def _control_plane_width(width: int, history_points: int = 64,
     }
     if errors:
         out["first_error"] = errors[0]
+    monitor.stop()
     server.stop(grace=0)
     for c in cluster + metrics:
         c.close()
     return out
 
 
+def _control_plane_real(width: int, sleep_sec: float = 6.0,
+                        deadline_sec: float = 0.0) -> dict:
+    """Real-executor gang at `width`: pool subprocesses host REAL
+    `TaskExecutor` instances (jittered Heartbeater, backoff barrier
+    poll, TaskMonitor metric pushes, result registration — everything
+    except the per-executor log-service gRPC server, stubbed because
+    width x servers is not what this leg measures) whose user processes
+    are `sleep`s; the bench process hosts ONLY the AM side (session +
+    sharded liveliness + MetricsStore behind the width-sized gRPC pool),
+    so its RSS is genuinely "AM RSS under sustained width-k load".
+    Records submit->all-registered and ->all-running latency, heartbeat
+    RTT p50/p95 measured executor-side, sustained AM RSS, spec fan-out
+    bytes, and how many executors completed cleanly."""
+    import subprocess as sp
+    import tempfile
+    import threading as th
+
+    from tony_tpu.am.application_master import MetricsStore
+    from tony_tpu.am.liveliness import (
+        LivelinessMonitor, auto_liveliness_shards,
+    )
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.configuration import TonyConfiguration
+    from tony_tpu.rpc.service import auto_rpc_workers, serve
+    from tony_tpu.session.session import TonySession
+    from tony_tpu.utils.common import current_host
+
+    # the harness box may be far smaller than a production AM host (the
+    # CI container has 2 cores): bound the run generously per width and
+    # give the barrier the prod-default patience — the LATENCY numbers
+    # say how fast it actually was
+    if deadline_sec <= 0:
+        deadline_sec = max(240.0, 0.75 * width)
+    # width-1k sizing guidance (docs/OBSERVABILITY.md): past ~256 tasks
+    # the heartbeat cadence lengthens — a pure-python AM on a small box
+    # cannot serve 1024 JSON-RPCs/s, and a 1k gang gains nothing from
+    # 1 s liveliness when its expiry window is 25 intervals anyway. The
+    # row reports the cadence it measured under.
+    hb_ms = 1000 if width <= 256 else 3000
+    conf = TonyConfiguration()
+    conf.set(K.instances_key("worker"), width, "bench")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, hb_ms, "bench")
+    conf.set(K.TASK_METRICS_INTERVAL_MS, max(5000, 4 * hb_ms), "bench")
+    conf.set(K.TASK_REGISTRATION_TIMEOUT_SEC, 300, "bench")
+    session = TonySession(conf)
+    session.num_expected_tasks = width
+    store = MetricsStore(history_points=64)
+    monitor = LivelinessMonitor(hb_ms, 25, lambda tid, att: None,
+                                shards=auto_liveliness_shards(width))
+    monitor.start()
+    completed: set[str] = set()
+    clean: list[int] = []
+    done = th.Event()
+
+    def _on_result(req):
+        completed.add(f"{req['job_name']}:{req['job_index']}")
+        if int(req.get("exit_code", 1)) == 0:
+            clean.append(1)
+        if len(completed) >= width:
+            done.set()
+
+    server, port = serve(
+        cluster_handler=_make_cp_handler(session, monitor, _on_result),
+        metrics_handler=store, max_workers=auto_rpc_workers(width))
+    workdir = tempfile.mkdtemp(prefix="tony_cp_real_")
+    conf_path = os.path.join(workdir, "tony-final.json")
+    conf.write(conf_path)
+
+    pools = max(1, min(8, width // 64)) if width >= 64 else 1
+    per_pool = [width // pools + (1 if i < width % pools else 0)
+                for i in range(pools)]
+    host = current_host()
+    procs, results, running_at = [], [], []
+    lock = th.Lock()
+
+    def _reader(proc):
+        for raw in proc.stdout:
+            line = raw.strip()
+            if line.startswith("CP-POOL-RUNNING"):
+                with lock:
+                    running_at.append(time.monotonic())
+            elif line.startswith("CP-POOL-RESULT "):
+                try:
+                    with lock:
+                        results.append(json.loads(line.split(" ", 1)[1]))
+                except ValueError:
+                    pass
+
+    t0 = time.monotonic()
+    start = 0
+    for count in per_pool:
+        proc = sp.Popen(
+            [sys.executable, os.path.abspath(__file__), "--cp-pool",
+             host, str(port), str(start), str(count), str(width),
+             conf_path, str(sleep_sec)],
+            stdout=sp.PIPE, stderr=sys.stderr, text=True, cwd=workdir)
+        th.Thread(target=_reader, args=(proc,), daemon=True).start()
+        procs.append(proc)
+        start += count
+    all_registered_s = all_running_s = None
+    rss_peak = 0.0
+    deadline = t0 + deadline_sec
+    while time.monotonic() < deadline:
+        if all_registered_s is None and session.all_tasks_registered():
+            all_registered_s = time.monotonic() - t0
+        with lock:
+            pools_running = len(running_at)
+        if all_running_s is None and pools_running >= pools:
+            all_running_s = max(running_at) - t0
+            _mark(f"real width {width}: all-running "
+                  f"{all_running_s:.2f}s")
+        rss_peak = max(rss_peak, _rss_mb())
+        if done.is_set() and all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.25)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    hb_p50s = [r["hb_p50_ms"] for r in results if r.get("hb_p50_ms")]
+    hb_p95s = [r["hb_p95_ms"] for r in results if r.get("hb_p95_ms")]
+    errors = sum(r.get("errors", 0) for r in results)
+    stats = dict(session.spec_stats)
+    out = {
+        "width": width,
+        "pools": pools,
+        "hb_interval_ms": hb_ms,
+        "all_registered_s": (round(all_registered_s, 3)
+                             if all_registered_s is not None else None),
+        "submit_to_all_running_s": (round(all_running_s, 3)
+                                    if all_running_s is not None else None),
+        "hb_p50_ms": round(max(hb_p50s), 2) if hb_p50s else None,
+        "hb_p95_ms": round(max(hb_p95s), 2) if hb_p95s else None,
+        "rss_mb_sustained": rss_peak,
+        "spec": {"renders": stats["renders"],
+                 "full_serves": stats["full_serves"],
+                 "diff_serves": stats["diff_serves"],
+                 "bytes_sent": stats["full_bytes"] + stats["diff_bytes"]},
+        "completed": len(completed),
+        "completed_clean": len(clean),
+        "errors": errors,
+        "ok": (all_running_s is not None and len(completed) >= width),
+    }
+    monitor.stop()
+    server.stop(grace=0)
+    return out
+
+
+def cp_pool_main() -> None:
+    """`bench.py --cp-pool host port start count width conf sleep_sec`:
+    one executor-pool subprocess of the real-gang control-plane bench —
+    hosts `count` REAL TaskExecutor instances on threads (sharing this
+    process's interpreter: 1024 full python processes would measure the
+    OS, not the control plane). Emits CP-POOL-RUNNING when every
+    executor's user process has launched and CP-POOL-RESULT {json} with
+    executor-side heartbeat RTT quantiles at exit."""
+    import tempfile
+    import threading as th
+
+    (host, port, start, count, width, conf_path, sleep_sec) = (
+        sys.argv[2], int(sys.argv[3]), int(sys.argv[4]), int(sys.argv[5]),
+        int(sys.argv[6]), sys.argv[7], float(sys.argv[8]))
+    os.chdir(tempfile.mkdtemp(prefix="cp_pool_"))
+
+    from tony_tpu import constants as TC
+    from tony_tpu.executor.task_executor import TaskExecutor
+    from tony_tpu.observability.metrics import REGISTRY
+    from tony_tpu.rpc.client import (
+        ClusterServiceClient, MetricsServiceClient,
+    )
+
+    # shared channels: a python process cannot drive 2 x count
+    # independent gRPC channels (each costs pollers + memory); the RPC
+    # traffic itself — every register/heartbeat/metrics call — is still
+    # one per executor, multiplexed as HTTP/2 streams like any wide
+    # client fleet behind a connection pool
+    n_chan = max(2, min(8, count // 16))
+    shared_cluster = [ClusterServiceClient(host, port)
+                      for _ in range(n_chan)]
+    shared_metrics = [MetricsServiceClient(host, port)
+                      for _ in range(n_chan)]
+
+    launched = th.Semaphore(0)
+
+    class _PoolExecutor(TaskExecutor):
+        # the one withheld piece: a per-executor log-service gRPC server
+        # (width x servers measures grpc, not the control plane)
+        _cp_launched = False
+        # many executors share this process: one executor's 5-strike
+        # heartbeat self-destruct (os._exit) would take the whole pool
+        # down on a load-induced latency spike — widen the budget; the
+        # parent's per-width deadline still bounds a truly dead AM
+        HB_FAILURE_BUDGET = 60
+
+        def _start_log_service(self):
+            self._log_server, self._log_port = None, 0
+
+        def _execute(self, env, timeout_sec):
+            if not self._cp_launched:   # respec may re-enter
+                self._cp_launched = True
+                launched.release()
+            return super()._execute(env, timeout_sec)
+
+    errors: list[str] = []
+    rcs: list[int] = []
+    lock = th.Lock()
+
+    def _run_one(i: int) -> None:
+        env = {TC.JOB_NAME: "worker", TC.TASK_INDEX: str(i),
+               TC.TASK_NUM: str(width), TC.IS_CHIEF: "false",
+               TC.SESSION_ID: "0", TC.TASK_ATTEMPT: "0",
+               TC.AM_HOST: host, TC.AM_PORT: str(port),
+               TC.TASK_COMMAND: f"exec sleep {sleep_sec}",
+               TC.TONY_APP_DIR: os.getcwd(),
+               TC.TONY_CONF_PATH: conf_path}
+        ex = None
+        try:
+            ex = _PoolExecutor(env=env,
+                               client=shared_cluster[i % n_chan],
+                               metrics_client=shared_metrics[i % n_chan])
+            rc = ex.run()
+            with lock:
+                rcs.append(rc)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"worker:{i}: {type(e).__name__}: {e}")
+        finally:
+            # never wedge the RUNNING latch: an executor that died (or
+            # timed out at the barrier) before launching still releases
+            if ex is None or not ex._cp_launched:
+                launched.release()
+
+    threads = [th.Thread(target=_run_one, args=(start + k,), daemon=True)
+               for k in range(count)]
+    for t in threads:
+        t.start()
+    for _ in range(count):
+        launched.acquire()
+    print("CP-POOL-RUNNING", flush=True)
+    for t in threads:
+        t.join(timeout=600)
+    for c in shared_cluster + shared_metrics:
+        try:
+            c.close()
+        except Exception:  # noqa: BLE001
+            pass
+    hb = REGISTRY.summary("tony_rpc_client_latency_seconds",
+                          method="task_executor_heartbeat")
+    out = {"count": count, "errors": len(errors),
+           "clean_exits": sum(1 for rc in rcs if rc == 0),
+           "hb_p50_ms": (round(1000 * hb.quantile(0.5), 2)
+                         if hb.count else None),
+           "hb_p95_ms": (round(1000 * hb.quantile(0.95), 2)
+                         if hb.count else None)}
+    if errors:
+        out["first_error"] = errors[0][:200]
+    print("CP-POOL-RESULT " + json.dumps(out, separators=(",", ":")),
+          flush=True)
+
+
 def control_plane_main() -> None:
-    """`python bench.py --control-plane`: the synthetic-width harness at
-    gang widths {48, 256, 1024} (TONY_CP_WIDTHS overrides). Emits ONE
-    JSON line with a `control_plane` block; exits non-zero if the PR-4
-    decimation fails to bound AM memory at the widest gang."""
+    """`python bench.py --control-plane`: the control-plane harness —
+    the synthetic-width stub storm at gang widths {48, 256, 1024}
+    (TONY_CP_WIDTHS overrides) PLUS real-executor gangs at
+    TONY_CP_REAL_WIDTHS (default the same; "" skips the real leg).
+    Emits ONE JSON line with a `control_plane` block and the widest
+    width's spec_bytes_sent / hb_p95_ms at top level; appends gated
+    entries (control_plane_spec_bytes [bytes], control_plane_hb_p95
+    [ms], control_plane_all_registered [s],
+    control_plane_real_all_running [s] — all lower-is-better) to
+    tools/bench_history.jsonl for tools/bench_compare.py. Exits
+    non-zero if AM-side state is unbounded, the diff protocol failed to
+    converge, or a real gang never reached all-running."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     widths = [int(w) for w in os.environ.get(
         "TONY_CP_WIDTHS", "48,256,1024").split(",") if w.strip()]
@@ -804,16 +1214,62 @@ def control_plane_main() -> None:
         rows.append(_control_plane_width(width))
         _mark(f"width {width}: all-registered "
               f"{rows[-1]['submit_to_all_registered_s']}s rss "
-              f"{rows[-1]['rss_mb']}MB bounded={rows[-1]['bounded']}")
+              f"{rows[-1]['rss_mb']}MB bounded={rows[-1]['bounded']} "
+              f"spec-fanout-x{rows[-1]['spec']['fanout_reduction_x']}")
+    real_rows = []
+    for width in [int(w) for w in os.environ.get(
+            "TONY_CP_REAL_WIDTHS", "48,256,1024").split(",") if w.strip()]:
+        _mark(f"control-plane REAL executors width {width}")
+        real_rows.append(_control_plane_real(width))
+        _mark(f"real width {width}: all-running "
+              f"{real_rows[-1]['submit_to_all_running_s']}s "
+              f"hb-p95 {real_rows[-1]['hb_p95_ms']}ms rss "
+              f"{real_rows[-1]['rss_mb_sustained']}MB "
+              f"ok={real_rows[-1]['ok']}")
+    widest = rows[-1] if rows else {}
     result = {"metric": "control_plane", "backend": "cpu",
-              "control_plane": {"widths": rows}}
+              "spec_bytes_sent": widest.get("spec", {}).get("bytes_sent"),
+              "hb_p95_ms": widest.get("heartbeat_p95_ms"),
+              "control_plane": {"widths": rows, "real": real_rows}}
     unbounded = [r["width"] for r in rows if not r["bounded"]]
+    real_failed = [r["width"] for r in real_rows if not r["ok"]]
+    # gated history entries: a future chatty regression (spec fan-out,
+    # heartbeat tail, rendezvous latency) fails bench_compare loudly.
+    # Only a PASSING run may append — a diverged/failed run's numbers
+    # must never become the baseline the next run is judged against.
+    if not unbounded and not real_failed:
+        for metric, value, unit in (
+                ("control_plane_spec_bytes",
+                 widest.get("spec", {}).get("bytes_sent"), "bytes"),
+                ("control_plane_hb_p95",
+                 widest.get("heartbeat_p95_ms"), "ms"),
+                ("control_plane_all_registered",
+                 widest.get("submit_to_all_registered_s"), "s"),
+                ("control_plane_real_all_running",
+                 (real_rows[-1].get("submit_to_all_running_s")
+                  if real_rows else None), "s"),
+        ):
+            if value:
+                _append_history({"metric": metric, "backend": "cpu",
+                                 "value": value, "unit": unit,
+                                 "width": widest.get("width"),
+                                 "vs_baseline": 0.0})
     if unbounded:
-        result["error"] = (f"span/metrics/skew state unbounded at "
-                           f"width(s) {unbounded} — decimation or the "
-                           f"skew sketches regressed")
-    print(json.dumps(result), flush=True)
-    if unbounded:
+        result["error"] = (f"span/metrics/skew/spec-diff state unbounded "
+                           f"or diverged at width(s) {unbounded} — "
+                           f"decimation, the skew sketches, or the diff "
+                           f"protocol regressed")
+    if real_failed:
+        result["real_error"] = (f"real-executor gang(s) at width(s) "
+                                f"{real_failed} never reached all-running")
+    line = json.dumps(result)
+    if len(line) > 4000:
+        # keep the driver-facing line bounded; full rows went to stderr
+        result["control_plane"] = {"widths": rows[-1:],
+                                   "real": real_rows[-1:]}
+        line = json.dumps(result)
+    print(line, flush=True)
+    if unbounded or real_failed:
         sys.exit(1)
 
 
@@ -1413,5 +1869,7 @@ if __name__ == "__main__":
         probe_main()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--control-plane":
         control_plane_main()
+    elif len(sys.argv) >= 9 and sys.argv[1] == "--cp-pool":
+        cp_pool_main()
     else:
         main()

@@ -298,6 +298,15 @@ def test_history_append_and_regression_verdict(_isolated_bench_paths,
     lat = [{"metric": "p99", "value": 1.0, "unit": "s", "backend": "cpu"},
            {"metric": "p99", "value": 1.5, "unit": "s", "backend": "cpu"}]
     assert compare(lat, threshold_pct=2.0)[0]["regression"] is True
+    # bytes (the control-plane spec fan-out gate) are lower-is-better
+    # too: a chatty regression — spec bytes creeping back up — must fail
+    fanout = [{"metric": "control_plane_spec_bytes", "value": 1.0e6,
+               "unit": "bytes", "backend": "cpu"},
+              {"metric": "control_plane_spec_bytes", "value": 1.2e6,
+               "unit": "bytes", "backend": "cpu"}]
+    assert compare(fanout, threshold_pct=2.0)[0]["regression"] is True
+    assert compare(list(reversed(fanout)),
+                   threshold_pct=2.0)[0]["regression"] is False
 
 
 if __name__ == "__main__":

@@ -1,0 +1,566 @@
+"""Coalesced O(width) control plane (ROADMAP item 3).
+
+Pins the width-1k rebuild's mechanics:
+
+- the cluster-spec render is cached per generation (barrier release and
+  every later poll serve ONE json.dumps, never one per caller);
+- generation-keyed spec DIFFS ride heartbeat responses: a survivor of a
+  peer's relaunch patches its held spec with O(changed) bytes instead of
+  re-fetching the full O(width) spec — including under a mid-poll
+  generation bump, attempt-fenced like register_worker_spec;
+- the liveliness sweep is sharded (per-shard locks, one shard per tick)
+  with detection latency pinned <= the unsharded monitor's within one
+  sweep period;
+- heartbeat start phases are jittered deterministically from the task
+  index, the barrier poll backs off exponentially, and the RPC pool /
+  shard counts size themselves from gang width;
+- chaos e2e: a relaunch at width 256 propagates the new generation to
+  every survivor via heartbeat-piggybacked diffs ALONE (zero full-spec
+  re-fetches after the initial rendezvous), with a bit-identical final
+  cluster spec on every executor.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.am.liveliness import LivelinessMonitor, auto_liveliness_shards
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.executor.task_executor import (
+    TaskExecutor, apply_spec_diff, heartbeat_jitter_sec,
+)
+from tony_tpu.rpc.client import ClusterServiceClient
+from tony_tpu.rpc.service import ClusterServiceHandler, auto_rpc_workers, serve
+from tony_tpu.session.session import SPEC_DIFF_WINDOW, TonySession
+
+chaos = pytest.mark.chaos
+
+
+def _session(width: int) -> TonySession:
+    conf = TonyConfiguration()
+    conf.set("tony.worker.instances", width, "test")
+    s = TonySession(conf)
+    s.num_expected_tasks = width
+    return s
+
+
+# ---------------------------------------------------------------------------
+# cached render + diff protocol (session layer)
+# ---------------------------------------------------------------------------
+
+def test_spec_render_cached_per_generation():
+    """Barrier release and every subsequent poll serve the SAME rendered
+    string: one O(width) json.dumps per generation, not one per caller."""
+    s = _session(8)
+    for i in range(8):
+        s.register_worker_spec(f"worker:{i}", f"h{i}:{1000 + i}")
+    assert s.spec_stats["renders"] == 1
+    first = s.cluster_spec_json()
+    # repeated barrier polls + get_cluster_spec calls: zero new renders,
+    # and the exact same object comes back (cache, not a re-render)
+    for i in range(8):
+        assert s.register_worker_spec(f"worker:{i}", f"h{i}:{1000 + i}") \
+            is first
+    assert s.cluster_spec_json() is first
+    assert s.spec_stats["renders"] == 1
+    # a generation bump invalidates; the re-closed barrier renders ONCE
+    s.relaunch_task("worker", 3)
+    assert s.cluster_spec_json() is None
+    s.register_worker_spec_with_generation("worker:3", "r3:2000",
+                                           expected_attempt=1)
+    assert s.spec_stats["renders"] == 2
+    assert json.loads(s.cluster_spec_json())["worker"][3] == "r3:2000"
+    assert s.spec_stats["renders"] == 2
+
+
+def test_spec_diff_single_and_multi_bump_union():
+    s = _session(4)
+    for i in range(4):
+        s.register_worker_spec(f"worker:{i}", f"h{i}:{1000 + i}")
+    base = json.loads(s.cluster_spec_json())
+    assert s.spec_diff_since(1) == (None, False)      # up to date
+    s.relaunch_task("worker", 2)
+    # barrier open: no diff yet, but NOT a refetch verdict — the executor
+    # keeps waiting and the diff rides a later heartbeat
+    assert s.spec_diff_since(1) == (None, False)
+    s.register_worker_spec_with_generation("worker:2", "r2:2000",
+                                           expected_attempt=1)
+    diff, refetch = s.spec_diff_since(1)
+    assert not refetch
+    assert diff == {"generation": 2, "changed": {"worker": {"2": "r2:2000"}}}
+    # a second bump: the diff from generation 1 is the UNION of both
+    s.relaunch_task("worker", 0)
+    s.register_worker_spec_with_generation("worker:0", "r0:3000",
+                                           expected_attempt=1)
+    diff2, _ = s.spec_diff_since(1)
+    assert diff2["generation"] == 3
+    assert diff2["changed"] == {"worker": {"0": "r0:3000", "2": "r2:2000"}}
+    # from generation 2 only the newer bump is included
+    diff3, _ = s.spec_diff_since(2)
+    assert diff3["changed"] == {"worker": {"0": "r0:3000"}}
+    # applying the union to the generation-1 spec is bit-identical to the
+    # AM's full render at generation 3
+    assert json.dumps(apply_spec_diff(base, diff2["changed"])) \
+        == s.cluster_spec_json()
+    # a generation the window cannot cover -> full-refetch verdict
+    assert s.spec_diff_since(0) == (None, True)
+
+
+def test_rebind_without_relaunch_rides_next_diff():
+    """An executor re-registering at a NEW host:port without a relaunch
+    bumps no generation, so no diff can carry the rebind on its own — it
+    must fold into the NEXT bump's diff material, or survivors patching
+    by diff would keep a dead address while believing they are current
+    (and their spec would not be bit-identical to the AM's render)."""
+    s = _session(4)
+    for i in range(4):
+        s.register_worker_spec(f"worker:{i}", f"h{i}:{1000 + i}")
+    base = json.loads(s.cluster_spec_json())
+    # worker:1 restarts and rebinds — same attempt, no generation bump
+    s.register_worker_spec("worker:1", "rebound:9999")
+    assert s.spec_generation == 1
+    # now a peer relaunch bumps to generation 2: the diff from 1 must
+    # include BOTH the replacement and the earlier rebind
+    s.relaunch_task("worker", 2)
+    s.register_worker_spec_with_generation("worker:2", "r2:2000",
+                                           expected_attempt=1)
+    diff, refetch = s.spec_diff_since(1)
+    assert not refetch
+    assert diff["changed"] == {"worker": {"1": "rebound:9999",
+                                          "2": "r2:2000"}}
+    assert json.dumps(apply_spec_diff(base, diff["changed"])) \
+        == s.cluster_spec_json()
+    # the mirror ordering — rebind AFTER the bump, BEFORE the next one: a
+    # trailing survivor's diff must still carry the rebind (a full fetch
+    # would have re-rendered it), not mark the survivor current with a
+    # dead address
+    s.register_worker_spec("worker:3", "rebound2:8888")
+    diff2, refetch2 = s.spec_diff_since(1)
+    assert not refetch2
+    assert diff2["changed"]["worker"]["3"] == "rebound2:8888"
+    assert json.dumps(apply_spec_diff(base, diff2["changed"])) \
+        == s.cluster_spec_json()
+
+
+def test_spec_diff_window_eviction():
+    s = _session(2)
+    for i in range(2):
+        s.register_worker_spec(f"worker:{i}", f"h{i}:{1000 + i}")
+    conf_attempts = SPEC_DIFF_WINDOW + 4
+    for n in range(conf_attempts):
+        t = s.relaunch_task("worker", 1)
+        s.register_worker_spec_with_generation(
+            "worker:1", f"r:{3000 + n}", expected_attempt=t.attempt)
+    # generation 1 fell out of the bounded window -> refetch, but a
+    # recent generation still diffs
+    assert s.spec_diff_since(1) == (None, True)
+    diff, refetch = s.spec_diff_since(s.spec_generation - 2)
+    assert not refetch and diff["generation"] == s.spec_generation
+
+
+def test_spec_diff_mid_poll_generation_bump_attempt_fenced(tmp_path):
+    """Relaunch during an in-flight re-rendezvous: the heartbeat diff an
+    executor finally receives must belong to the NEWEST generation (never
+    a half-open intermediate one), and a superseded attempt's heartbeat —
+    fenced exactly like its register_worker_spec — gets no diff at all."""
+    from tests.test_fault_tolerance import _make_am
+    am = _make_am(tmp_path, **{"tony.worker.instances": 3,
+                               "tony.task.max-task-attempts": 4})
+    session = am.session
+    session.num_expected_tasks = 3
+    for i in range(3):
+        am.register_worker_spec({"task_id": f"worker:{i}",
+                                 "spec": f"h{i}:{1000 + i}", "session_id": 0,
+                                 "task_attempt": 0})
+    # survivor worker:0 holds generation 1; worker:1 is relaunched
+    session.relaunch_task("worker", 1)                      # -> generation 2
+    resp = am.task_executor_heartbeat({"task_id": "worker:0",
+                                       "task_attempt": 0,
+                                       "spec_generation": 1})
+    assert resp["spec_generation"] == 2
+    assert "spec_diff" not in resp and "spec_refetch" not in resp
+    assert resp["spec_ready"] is False
+    # mid-poll second bump: worker:2 relaunched too     -> generation 3
+    session.relaunch_task("worker", 2)
+    # zombie: worker:1's dead attempt 0 pings — fenced, no diff ever
+    zombie = am.task_executor_heartbeat({"task_id": "worker:1",
+                                         "task_attempt": 0,
+                                         "spec_generation": 1})
+    assert zombie == {"spec_generation": 3}
+    # replacements register (attempt-fenced: the stale attempt bounces)
+    stale = am.register_worker_spec({"task_id": "worker:1",
+                                     "spec": "zombie:1", "session_id": 0,
+                                     "task_attempt": 0})
+    assert stale["spec"] is None
+    am.register_worker_spec({"task_id": "worker:1", "spec": "r1:2001",
+                             "session_id": 0, "task_attempt": 1})
+    am.register_worker_spec({"task_id": "worker:2", "spec": "r2:2002",
+                             "session_id": 0, "task_attempt": 1})
+    # the survivor's next heartbeat carries ONE diff for the newest
+    # generation, covering BOTH bumps
+    resp = am.task_executor_heartbeat({"task_id": "worker:0",
+                                       "task_attempt": 0,
+                                       "spec_generation": 1})
+    assert resp["spec_ready"] is True
+    diff = resp["spec_diff"]
+    assert diff["generation"] == 3
+    assert diff["changed"] == {"worker": {"1": "r1:2001", "2": "r2:2002"}}
+    # up to date after applying: no further diff
+    resp = am.task_executor_heartbeat({"task_id": "worker:0",
+                                       "task_attempt": 0,
+                                       "spec_generation": 3})
+    assert "spec_diff" not in resp
+    am.hb_monitor.stop()
+
+
+# ---------------------------------------------------------------------------
+# sharded liveliness sweep
+# ---------------------------------------------------------------------------
+
+def _detection_latencies(shards: int, n_tasks: int = 12,
+                         hb_ms: int = 40) -> list[float]:
+    detected = {}
+    mon = LivelinessMonitor(hb_ms, 3, lambda tid, att:
+                            detected.setdefault(tid, time.monotonic()),
+                            shards=shards)
+    t0 = time.monotonic()
+    for i in range(n_tasks):
+        mon.register(f"worker:{i}", 0)
+    mon.start()
+    deadline = t0 + 5.0
+    while len(detected) < n_tasks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    mon.stop()
+    assert len(detected) == n_tasks
+    return [ts - t0 for ts in detected.values()]
+
+
+def test_sharded_sweep_detection_latency_within_one_sweep_period():
+    """Sharding must not slow detection: every entry is still examined
+    once per sweep period (one shard per tick), so a sharded monitor's
+    worst detection latency stays within one sweep period of the
+    unsharded monitor's."""
+    unsharded = _detection_latencies(shards=1)
+    sharded = _detection_latencies(shards=4)
+    # expiry window 0.12s, sweep period 0.05s; allow scheduling slop
+    sweep_period = 0.05
+    assert max(sharded) <= max(unsharded) + sweep_period + 0.15, \
+        (max(sharded), max(unsharded))
+
+
+def test_sharded_monitor_ping_unregister_and_zombie_semantics():
+    mon = LivelinessMonitor(1000, 3, lambda tid, att: None, shards=8)
+    for i in range(32):
+        mon.register(f"worker:{i}", attempt=i % 3)
+    assert len(mon) == 32
+    assert mon.ping("worker:17") is True
+    assert mon.ping("worker:99") is False        # never resurrects
+    assert mon.entry("worker:5")[1] == 2
+    mon.unregister("worker:17")
+    assert not mon.registered("worker:17") and len(mon) == 31
+    mon.clear()
+    assert len(mon) == 0
+
+
+def test_width_aware_auto_sizing():
+    assert auto_liveliness_shards(48) == 1
+    assert auto_liveliness_shards(256) == 4
+    assert auto_liveliness_shards(1024) == 16
+    assert auto_liveliness_shards(10_000) == 16          # capped
+    assert auto_rpc_workers(0) == 16
+    assert auto_rpc_workers(48) == 19
+    assert auto_rpc_workers(256) == 32
+    assert auto_rpc_workers(1024) == 64
+    assert auto_rpc_workers(10_000) == 64                # capped
+
+
+def test_am_wires_width_sized_shards(tmp_path):
+    from tests.test_fault_tolerance import _make_am
+    am = _make_am(tmp_path, **{"tony.worker.instances": 256})
+    assert am.hb_monitor.num_shards == 4
+    am2 = _make_am(tmp_path, **{"tony.worker.instances": 256,
+                                "tony.am.liveliness-shards": 2})
+    assert am2.hb_monitor.num_shards == 2
+
+
+# ---------------------------------------------------------------------------
+# executor side: jitter, backoff, diff-applied respec
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_jitter_deterministic_and_spread():
+    phases = [heartbeat_jitter_sec(i, 1.0) for i in range(1024)]
+    assert phases == [heartbeat_jitter_sec(i, 1.0) for i in range(1024)]
+    assert all(0.0 <= p < 1.0 for p in phases)
+    # low-discrepancy: no 100ms phase bucket hoards the gang
+    buckets = [0] * 10
+    for p in phases:
+        buckets[int(p * 10)] += 1
+    assert max(buckets) <= 2 * (1024 // 10), buckets
+    assert heartbeat_jitter_sec(0, 1.0) == 0.0
+
+
+def _executor(env_extra=None) -> TaskExecutor:
+    env = {C.JOB_NAME: "worker", C.TASK_INDEX: "0", C.TASK_NUM: "2",
+           C.AM_HOST: "127.0.0.1", C.AM_PORT: "1", C.TASK_COMMAND: "true"}
+    env.update(env_extra or {})
+    return TaskExecutor(env=env)
+
+
+class _AliveHB:
+    _silent = False
+
+    def is_alive(self):
+        return True
+
+
+def test_executor_applies_diff_without_reregister(monkeypatch):
+    """A survivor whose heartbeater delivered the diff re-joins the gang
+    by patching its held spec — register_worker_spec is never called."""
+    ex = _executor()
+    ex.heartbeater = _AliveHB()
+    ex._cluster_spec = {"worker": ["h0:1000", "h1:1001"]}
+    ex._spec_generation = 1
+    monkeypatch.setattr(
+        ex, "register_and_get_cluster_spec",
+        lambda: pytest.fail("survivor re-polled the rendezvous barrier"))
+    ex._on_spec_diff({"generation": 2,
+                      "changed": {"worker": {"1": "r1:2001"}}})
+    assert ex._respec_pending or ex._latest_generation == 2
+    spec = ex._await_respec_spec()
+    assert spec == {"worker": ["h0:1000", "r1:2001"]}
+    assert ex._spec_generation == 2 and not ex._respec_pending
+    ex.client.close()
+    ex.metrics_client.close()
+
+
+def test_executor_refetch_verdict_falls_back(monkeypatch):
+    ex = _executor()
+    ex.heartbeater = _AliveHB()
+    ex._cluster_spec = {"worker": ["h0:1000", "h1:1001"]}
+    ex._spec_generation = 1
+    ex._on_generation(2)
+    ex._on_spec_refetch()
+    assert ex._await_respec_spec() is None       # falls back to the barrier
+    ex.client.close()
+    ex.metrics_client.close()
+
+
+def test_executor_without_heartbeater_skips_diff_wait():
+    ex = _executor()
+    assert ex._await_respec_spec() is None
+    ex.client.close()
+    ex.metrics_client.close()
+
+
+def test_stale_diff_is_ignored():
+    ex = _executor()
+    ex.heartbeater = _AliveHB()
+    ex._cluster_spec = {"worker": ["h0:1000"]}
+    ex._spec_generation = 3
+    ex._on_spec_diff({"generation": 2, "changed": {"worker": {"0": "x:1"}}})
+    assert ex._pending_diff is None and not ex._respec_pending
+    ex.client.close()
+    ex.metrics_client.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: width-256 relaunch propagates via diffs alone
+# ---------------------------------------------------------------------------
+
+class _HarnessHandler(ClusterServiceHandler):
+    """The AM's control-plane surface over a real TonySession + sharded
+    LivelinessMonitor, mirroring ApplicationMaster's register/heartbeat
+    handlers (attempt fence, liveliness plant/ping, diff piggyback)."""
+
+    def __init__(self, session: TonySession, monitor: LivelinessMonitor):
+        self.session = session
+        self.monitor = monitor
+
+    def get_task_infos(self, req):
+        return []
+
+    def get_cluster_spec(self, req):
+        spec = self.session.cluster_spec_json()
+        if spec is not None:
+            self.session.spec_stats["full_serves"] += 1
+            self.session.spec_stats["full_bytes"] += len(spec)
+        return {"spec": spec, "generation": self.session.spec_generation}
+
+    def register_worker_spec(self, req):
+        attempt = int(req.get("task_attempt", -1))
+        spec, generation, accepted = \
+            self.session.register_worker_spec_with_generation(
+                req["task_id"], req["spec"], expected_attempt=attempt)
+        if accepted:
+            self.monitor.register(req["task_id"], max(0, attempt))
+        return {"spec": spec, "generation": generation}
+
+    def task_executor_heartbeat(self, req):
+        session = self.session
+        generation = session.spec_generation
+        attempt = int(req.get("task_attempt", -1))
+        if attempt >= 0:
+            task = session.get_task_by_id(req["task_id"])
+            if task is not None and attempt != task.attempt:
+                return {"spec_generation": generation}
+        self.monitor.ping(req["task_id"])
+        resp = {"spec_generation": generation,
+                "spec_ready": session.all_tasks_registered()}
+        exec_gen = int(req.get("spec_generation", -1) or -1)
+        if 0 < exec_gen < generation:
+            diff, refetch = session.spec_diff_since(exec_gen)
+            if diff is not None:
+                resp["spec_diff"] = diff
+            elif refetch:
+                resp["spec_refetch"] = True
+        return resp
+
+    def register_execution_result(self, req):
+        self.monitor.unregister(f"{req['job_name']}:{req['job_index']}")
+        return {}
+
+    def register_tensorboard_url(self, req):
+        return {}
+
+    def register_serving_endpoint(self, req):
+        return {}
+
+    def finish_application(self, req):
+        return {}
+
+    def request_profile(self, req):
+        return {"error": "harness"}
+
+    def read_task_logs(self, req):
+        return {"error": "harness"}
+
+    def get_skew(self, req):
+        return {"error": "harness"}
+
+    def get_alerts(self, req):
+        return {"error": "harness"}
+
+    def request_preemption(self, req):
+        return {"error": "harness"}
+
+
+@chaos
+def test_width256_relaunch_propagates_via_diffs_alone():
+    """A relaunch at width 256 reaches every survivor through
+    heartbeat-piggybacked diffs ALONE: after the initial rendezvous the
+    AM serves exactly ONE more full spec (the replacement's own barrier
+    release), every survivor ends at the new generation, and each
+    patched spec is bit-identical to the AM's render."""
+    width = 256
+    session = _session(width)
+    monitor = LivelinessMonitor(
+        1000, 25, lambda tid, att: None,
+        shards=auto_liveliness_shards(width))
+    monitor.start()
+    handler = _HarnessHandler(session, monitor)
+    server, port = serve(cluster_handler=handler,
+                         max_workers=auto_rpc_workers(width))
+    n_clients = 32
+    clients = [ClusterServiceClient("127.0.0.1", port)
+               for _ in range(n_clients)]
+    held: dict[int, tuple[int, dict]] = {}   # index -> (generation, spec)
+    lock = threading.Lock()
+    errors: list[str] = []
+
+    def _register(i: int) -> None:
+        c = clients[i % n_clients]
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                result = c.register_worker_spec(
+                    f"worker:{i}", f"h{i}:{10_000 + i}", 0,
+                    task_attempt=0, with_generation=True)
+                if result is not None:
+                    spec, gen = result
+                    with lock:
+                        held[i] = (gen, spec)
+                    return
+                time.sleep(0.05)
+            raise TimeoutError("barrier never closed")
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"worker:{i}: {e}")
+
+    threads = [threading.Thread(target=_register, args=(i,), daemon=True)
+               for i in range(width)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert not errors, errors[:3]
+    assert len(held) == width and session.all_tasks_registered()
+    full_serves_after_rendezvous = session.spec_stats["full_serves"]
+    assert full_serves_after_rendezvous == width
+
+    # relaunch worker:7 (its executor dies: no more heartbeats from it)
+    victim = 7
+    t = session.relaunch_task("worker", victim)
+    assert t.attempt == 1 and session.spec_generation == 2
+    # the replacement registers (closing the barrier -> ONE full serve)
+    repl = clients[0].register_worker_spec(
+        f"worker:{victim}", f"r{victim}:20_007".replace("_", ""), 0,
+        task_attempt=1, with_generation=True)
+    assert repl is not None and repl[1] == 2
+
+    # every survivor heartbeats with its held generation and applies the
+    # piggybacked diff — the ONLY channel it learns the new spec from
+    def _survive(i: int) -> None:
+        c = clients[i % n_clients]
+        gen, spec = held[i]
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                resp = c.task_executor_heartbeat(
+                    f"worker:{i}", 0, spec_generation=gen)
+                diff = resp.get("spec_diff")
+                if diff:
+                    assert not resp.get("spec_refetch")
+                    spec = apply_spec_diff(spec, diff["changed"])
+                    gen = diff["generation"]
+                    with lock:
+                        held[i] = (gen, spec)
+                    return
+                time.sleep(0.02)
+            raise TimeoutError("diff never arrived")
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"worker:{i}: {e}")
+
+    survivors = [i for i in range(width) if i != victim]
+    threads = [threading.Thread(target=_survive, args=(i,), daemon=True)
+               for i in survivors]
+    for t2 in threads:
+        t2.start()
+    for t2 in threads:
+        t2.join(timeout=60)
+    assert not errors, errors[:3]
+
+    final = session.cluster_spec_json()
+    for i in survivors:
+        gen, spec = held[i]
+        assert gen == 2, f"worker:{i} stuck at generation {gen}"
+        assert json.dumps(spec) == final, f"worker:{i} diverged"
+    # THE acceptance number: zero full-spec re-fetches after the initial
+    # rendezvous beyond the replacement's own barrier release
+    assert session.spec_stats["full_serves"] == width + 1, \
+        session.spec_stats
+    assert session.spec_stats["diff_serves"] == len(survivors)
+    # O(width**2) -> O(width): the diff fan-out cost a tiny fraction of
+    # re-serving the full spec to every survivor
+    full_len = len(final)
+    assert session.spec_stats["diff_bytes"] < 0.1 * full_len * len(survivors)
+
+    monitor.stop()
+    server.stop(grace=0)
+    for c in clients:
+        c.close()

@@ -405,9 +405,9 @@ def test_liveliness_register_is_attempt_monotonic():
                             on_expired=lambda tid, att: None)
     mon.register("worker:0", attempt=1)      # the replacement
     mon.register("worker:0", attempt=0)      # stale thread resumes late
-    assert mon._last_ping["worker:0"][1] == 1
+    assert mon.entry("worker:0")[1] == 1
     mon.register("worker:0", attempt=2)      # a newer attempt upgrades
-    assert mon._last_ping["worker:0"][1] == 2
+    assert mon.entry("worker:0")[1] == 2
 
 
 def test_stale_session_failure_is_absorbed_not_relaunched(tmp_path):

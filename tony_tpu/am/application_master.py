@@ -46,9 +46,9 @@ from tony_tpu.events.schema import (
     StragglerCleared, StragglerDetected, TaskFinished, TaskRelaunched,
     TaskStarted,
 )
-from tony_tpu.am.liveliness import LivelinessMonitor
+from tony_tpu.am.liveliness import LivelinessMonitor, auto_liveliness_shards
 from tony_tpu.rpc.service import (
-    ClusterServiceHandler, MetricsServiceHandler, serve,
+    ClusterServiceHandler, MetricsServiceHandler, auto_rpc_workers, serve,
 )
 from tony_tpu.session.scheduler import ResourceRequestor, TaskScheduler
 from tony_tpu.session.session import FinalStatus, Task, TonySession
@@ -494,8 +494,23 @@ class ApplicationMaster(ClusterServiceHandler):
         self._hb_interval_ms = conf.get_time_ms(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
         self._max_missed_hb = conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS, 25)
         self._monitor_interval = conf.get_time_ms(K.AM_MONITOR_INTERVAL_MS, 5000) / 1000.0
+        # control-plane sizing scales with gang width (coalesced control
+        # plane, ROADMAP item 3): liveliness shards so 1 s pings never
+        # contend with the expiry scan, and (in prepare) the RPC handler
+        # pool so width heartbeats don't queue behind a fixed 16 threads
+        try:
+            from tony_tpu.session.requests import parse_container_requests
+            self._gang_width = sum(
+                r.num_instances
+                for r in parse_container_requests(conf).values())
+        except Exception:  # noqa: BLE001 — sizing must not block AM boot
+            self._gang_width = 0
+        shards = conf.get_int(K.AM_LIVELINESS_SHARDS, 0)
+        if shards <= 0:
+            shards = auto_liveliness_shards(self._gang_width)
         self.hb_monitor = LivelinessMonitor(
-            self._hb_interval_ms, self._max_missed_hb, self._on_task_deemed_dead)
+            self._hb_interval_ms, self._max_missed_hb,
+            self._on_task_deemed_dead, shards=shards)
         if self._straggler_enabled:
             # heartbeat lag is one of the skew signals (ms, per ping)
             self.hb_monitor.lag_sink = (
@@ -527,9 +542,12 @@ class ApplicationMaster(ClusterServiceHandler):
             if not self._auth_token:
                 raise RuntimeError(
                     "security enabled but no token file in app dir")
+        rpc_workers = self.conf.get_int(K.AM_RPC_WORKERS, 0)
+        if rpc_workers <= 0:
+            rpc_workers = auto_rpc_workers(self._gang_width)
         self._rpc_server, self.rpc_port = serve(
             cluster_handler=self, metrics_handler=self.metrics_store,
-            auth_token=self._auth_token)
+            auth_token=self._auth_token, max_workers=rpc_workers)
         # off-host executors can't read the client's app dir — publish the
         # frozen conf through the staging store and hand its URI to every
         # container (the reference localized tony-final.xml from HDFS into
@@ -2385,7 +2403,13 @@ class ApplicationMaster(ClusterServiceHandler):
     def get_cluster_spec(self, req: dict) -> dict:
         if self.session is None:
             return {"spec": None}
-        return {"spec": self.session.cluster_spec_json(),
+        spec = self.session.cluster_spec_json()
+        if spec is not None:
+            # a full O(width) payload on the wire — counted like a
+            # barrier-release serve so spec_bytes accounting covers every
+            # fan-out path (the diff protocol exists to keep this rare)
+            self.session.note_full_serve(spec)
+        return {"spec": spec,
                 "generation": self.session.spec_generation}
 
     def register_worker_spec(self, req: dict) -> dict:
@@ -2576,6 +2600,10 @@ class ApplicationMaster(ClusterServiceHandler):
         self._wake.set()
 
     def task_executor_heartbeat(self, req: dict) -> dict:
+        """The width-scaled hot path: at gang width W this runs W times per
+        heartbeat interval, so it must stay a cheap dict-update — all
+        O(width) work (expiry scans, diff rendering) is deferred to the
+        sharded liveliness sweep and the session's per-generation caches."""
         session = self.session
         generation = session.spec_generation if session is not None else 0
         attempt = int(req.get("task_attempt", -1))
@@ -2583,15 +2611,22 @@ class ApplicationMaster(ClusterServiceHandler):
             task = session.get_task_by_id(req["task_id"])
             if task is not None and attempt != task.attempt:
                 # zombie ping from a relaunched-past attempt: must not keep
-                # the replacement's liveliness entry fresh
+                # the replacement's liveliness entry fresh (and must never
+                # be handed a spec diff — it has no live spec to patch)
                 return {"spec_generation": generation}
         # live-tail surface: remember where this attempt's TaskLogService
         # listens (attempt-fenced above — a zombie's address can never
-        # displace the replacement's)
+        # displace the replacement's). Lock-free fast path: the address is
+        # identical on every ping after the first, so the AM lock — shared
+        # with the monitor loop's O(width) passes — is only taken when the
+        # gossiped address actually changes.
         log_addr = str(req.get("log_addr", "") or "")
         if log_addr:
-            with self._lock:
-                self._log_addrs[req["task_id"]] = (max(attempt, 0), log_addr)
+            known = self._log_addrs.get(req["task_id"])
+            if known is None or known != (max(attempt, 0), log_addr):
+                with self._lock:
+                    self._log_addrs[req["task_id"]] = (max(attempt, 0),
+                                                       log_addr)
         if not self.hb_monitor.ping(req["task_id"]):
             # an alive executor with no liveliness entry: it either has not
             # registered yet (entries are planted at register_worker_spec)
@@ -2600,6 +2635,16 @@ class ApplicationMaster(ClusterServiceHandler):
             LOG.debug("heartbeat from %s has no liveliness entry",
                       req["task_id"])
         resp = {"spec_generation": generation}
+        # coalesced control plane: the executor reports the generation of
+        # the spec it holds; a survivor behind the current generation gets
+        # the generation-keyed diff (changed tasks only) piggybacked HERE
+        # instead of re-polling register_worker_spec for the full O(width)
+        # spec. While the re-rendezvous barrier is still open nothing is
+        # attached (the diff rides a later heartbeat); only an executor
+        # whose generation fell outside the diff window is told to refetch.
+        if session is not None:
+            exec_gen = int(req.get("spec_generation", -1) or -1)
+            resp.update(session.heartbeat_spec_fields(exec_gen))
         # checkpoint-then-evict: the drain ask rides every heartbeat
         # while a preemption is in flight (resends are harmless — the
         # executor's drain is one-shot); grace_ms is the REMAINING
@@ -2614,13 +2659,18 @@ class ApplicationMaster(ClusterServiceHandler):
                 "reason": preemption.get("reason", "")}
         # on-demand profiler: a pending request for this task rides its
         # heartbeat (resent until the capture completes — the executor's
-        # request-file write and the trainer's id-dedup are idempotent)
-        with self._lock:
-            preq = self._profile_requests.get(req["task_id"])
-            if preq is not None and preq["state"] in ("pending", "sent"):
-                preq["state"] = "sent"
-                resp["profile_request"] = {"request_id": preq["id"],
-                                           "num_steps": preq["num_steps"]}
+        # request-file write and the trainer's id-dedup are idempotent).
+        # Lock-free emptiness pre-check: profile requests are rare
+        # operator asks, and W heartbeats/interval must not serialize on
+        # the AM lock to discover an empty dict.
+        if self._profile_requests and \
+                self._profile_requests.get(req["task_id"]) is not None:
+            with self._lock:
+                preq = self._profile_requests.get(req["task_id"])
+                if preq is not None and preq["state"] in ("pending", "sent"):
+                    preq["state"] = "sent"
+                    resp["profile_request"] = {"request_id": preq["id"],
+                                               "num_steps": preq["num_steps"]}
         return resp
 
     def request_preemption(self, req: dict) -> dict:

@@ -1,4 +1,4 @@
-"""Heartbeat liveliness monitor.
+"""Heartbeat liveliness monitor (sharded).
 
 Equivalent of the reference's use of YARN's AbstractLivelinessMonitor
 (ApplicationMaster.java:183-208): tasks ping on every heartbeat RPC; a
@@ -13,6 +13,15 @@ attempt budget the dead task's container is replaced and the gang
 re-rendezvouses; only an exhausted budget escalates to session failure. The
 expired entry is dropped before the callback fires, so the replacement
 attempt re-registers under the same task id with a clean slate.
+
+Sharding (the width-1k rebuild): with one lock over one dict, every 1 s
+ping from every task contended with the full-table expiry scan — at width
+1024 the sweep held the lock for an O(width) pass while 1k pings/s queued
+behind it. Entries are now hashed across N shards, each with its own lock,
+and the sweep thread touches ONE shard per tick (tick = sweep_period /
+shards), so per-entry examination cadence — and therefore detection
+latency — is unchanged from the unsharded monitor while any single lock
+hold is O(width / shards) and contends with only 1/N of pings.
 """
 
 from __future__ import annotations
@@ -27,14 +36,26 @@ from tony_tpu.observability.metrics import REGISTRY
 LOG = logging.getLogger(__name__)
 
 
+def auto_liveliness_shards(width: int) -> int:
+    """Width-aware default for tony.am.liveliness-shards: one shard per
+    ~64 tasks, capped at 16 (width 1024 → 16 shards; small test gangs
+    keep the unsharded single-lock behavior)."""
+    return max(1, min(16, int(width) // 64))
+
+
 class LivelinessMonitor:
     def __init__(self, hb_interval_ms: int, max_missed: int,
-                 on_expired: Callable[[str, int], None]):
+                 on_expired: Callable[[str, int], None],
+                 shards: int = 1):
         self._hb_interval_sec = hb_interval_ms / 1000.0
         self._expiry_sec = hb_interval_ms * max(3, max_missed) / 1000.0
         # sweep frequently relative to the expiry window so detection latency
         # stays a fraction of the window even with test-scale intervals
         self._sweep_sec = max(0.05, min(1.0, self._expiry_sec / 10))
+        self.num_shards = max(1, int(shards))
+        # one shard is examined per tick; a full rotation covers every
+        # entry once per _sweep_sec — same cadence as the unsharded sweep
+        self._tick_sec = self._sweep_sec / self.num_shards
         self._on_expired = on_expired
         # observability (docs/FAULT_TOLERANCE.md failure matrix numbers):
         # heartbeat round-trip lag = inter-ping gap minus the nominal
@@ -47,15 +68,20 @@ class LivelinessMonitor:
         # called OUTSIDE the monitor lock as lag_sink(task_id, lag_sec) —
         # heartbeat lag is one of the cross-task straggler signals
         self.lag_sink: Optional[Callable[[str, float], None]] = None
-        # task_id -> (last ping, attempt the entry belongs to): the expiry
-        # callback reports WHICH attempt went silent, so a stale expiry
-        # racing a relaunch can be fenced instead of judging the healthy
-        # replacement by the dead attempt's silence
-        self._last_ping: dict[str, tuple[float, int]] = {}
-        self._lock = threading.Lock()
+        # per shard: task_id -> (last ping, attempt the entry belongs to).
+        # The expiry callback reports WHICH attempt went silent, so a
+        # stale expiry racing a relaunch can be fenced instead of judging
+        # the healthy replacement by the dead attempt's silence.
+        self._shards: list[dict[str, tuple[float, int]]] = [
+            {} for _ in range(self.num_shards)]
+        self._locks = [threading.Lock() for _ in range(self.num_shards)]
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="hb-monitor",
                                         daemon=True)
+
+    def _shard_of(self, task_id: str) -> int:
+        # stable within the process; cross-process stability is not needed
+        return hash(task_id) % self.num_shards
 
     def start(self) -> None:
         self._thread.start()
@@ -71,36 +97,41 @@ class LivelinessMonitor:
         after the replacement registered must not downgrade the entry's
         attempt — a downgraded attempt would make the replacement's real
         expiry look stale and be fenced off forever."""
-        with self._lock:
-            entry = self._last_ping.get(task_id)
+        idx = self._shard_of(task_id)
+        with self._locks[idx]:
+            entry = self._shards[idx].get(task_id)
             if entry is not None and entry[1] > attempt:
                 LOG.warning("ignoring stale registration of %s attempt %d "
                             "(entry is at attempt %d)", task_id, attempt,
                             entry[1])
                 return
-            self._last_ping[task_id] = (time.monotonic(), attempt)
+            self._shards[idx][task_id] = (time.monotonic(), attempt)
 
     def unregister(self, task_id: str) -> None:
         """Must be called when an executor registers its result, BEFORE the
         container-completion callback arrives — otherwise a task that exited
         cleanly but whose completion notification is delayed would be deemed
         dead (reference rationale: ApplicationMaster.java:890-902)."""
-        with self._lock:
-            self._last_ping.pop(task_id, None)
+        idx = self._shard_of(task_id)
+        with self._locks[idx]:
+            self._shards[idx].pop(task_id, None)
 
     def ping(self, task_id: str) -> bool:
         """Refresh a registered task's liveness; returns False for unknown
         ids (never resurrects an expired/unregistered entry — a zombie
         attempt pinging after its slot was relaunched must stay dead).
         Records the ping's lag beyond the nominal heartbeat cadence —
-        the AM-side view of heartbeat round-trip + scheduling delay."""
+        the AM-side view of heartbeat round-trip + scheduling delay.
+        Touches only this task's shard lock: a ping never waits behind
+        an expiry scan of the other shards."""
         now = time.monotonic()
-        with self._lock:
-            entry = self._last_ping.get(task_id)
+        idx = self._shard_of(task_id)
+        with self._locks[idx]:
+            entry = self._shards[idx].get(task_id)
             if entry is not None:
                 lag = max(0.0, (now - entry[0]) - self._hb_interval_sec)
                 self.last_ping_lag_sec = lag
-                self._last_ping[task_id] = (now, entry[1])
+                self._shards[idx][task_id] = (now, entry[1])
             else:
                 return False
         REGISTRY.summary("tony_heartbeat_lag_seconds").observe(lag)
@@ -113,28 +144,44 @@ class LivelinessMonitor:
         return True
 
     def registered(self, task_id: str) -> bool:
-        with self._lock:
-            return task_id in self._last_ping
+        idx = self._shard_of(task_id)
+        with self._locks[idx]:
+            return task_id in self._shards[idx]
+
+    def entry(self, task_id: str) -> Optional[tuple[float, int]]:
+        """(last ping, attempt) for a registered task, else None —
+        introspection for tests and the control-plane bench."""
+        idx = self._shard_of(task_id)
+        with self._locks[idx]:
+            return self._shards[idx].get(task_id)
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
 
     def clear(self) -> None:
-        with self._lock:
-            self._last_ping.clear()
+        for idx in range(self.num_shards):
+            with self._locks[idx]:
+                self._shards[idx].clear()
 
     def _run(self) -> None:
-        last_sweep = time.monotonic()
-        while not self._stop.wait(self._sweep_sec):
+        last_tick = time.monotonic()
+        shard_idx = 0
+        while not self._stop.wait(self._tick_sec):
             now = time.monotonic()
-            # sweep lag: how far past the nominal cadence this sweep ran
+            # sweep lag: how far past the nominal cadence this tick ran
             # (a loaded AM sweeping late ADDS to every detection latency)
             REGISTRY.gauge("tony_liveliness_sweep_lag_seconds").set(
-                max(0.0, (now - last_sweep) - self._sweep_sec))
-            last_sweep = now
-            with self._lock:
+                max(0.0, (now - last_tick) - self._tick_sec))
+            last_tick = now
+            idx = shard_idx
+            shard_idx = (shard_idx + 1) % self.num_shards
+            with self._locks[idx]:
+                shard = self._shards[idx]
                 expired = [(tid, attempt, now - last)
-                           for tid, (last, attempt) in self._last_ping.items()
+                           for tid, (last, attempt) in shard.items()
                            if now - last > self._expiry_sec]
                 for tid, _, _ in expired:
-                    del self._last_ping[tid]
+                    del shard[tid]
             for tid, attempt, silence in expired:
                 # detection latency: last ping → this sweep. Lower bound
                 # is the expiry window (interval * max(3, max_missed));

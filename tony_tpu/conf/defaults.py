@@ -73,6 +73,10 @@ DEFAULTS = {
     # how long the AM waits for the client's finish signal before
     # unregistering (ApplicationMaster.stop poll, ApplicationMaster.java:669-710)
     K.AM_STOP_POLL_TIMEOUT_MS: 30_000,
+    # control-plane sizing; 0 = width-aware auto (rpc/service.py
+    # auto_rpc_workers, am/liveliness.py auto_liveliness_shards)
+    K.AM_RPC_WORKERS: 0,
+    K.AM_LIVELINESS_SHARDS: 0,
 
     # task cadences (reference: TonyConfigurationKeys.java:143-150)
     K.TASK_HEARTBEAT_INTERVAL_MS: 1000,

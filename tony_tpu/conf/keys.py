@@ -53,6 +53,13 @@ AM_VCORES = "tony.am.vcores"
 AM_GANG_MAX_WAIT_MS = "tony.am.gang-allocation-timeout-ms"
 AM_MONITOR_INTERVAL_MS = "tony.am.monitor-interval-ms"
 AM_STOP_POLL_TIMEOUT_MS = "tony.am.stop-poll-timeout-ms"
+# control-plane sizing (both width-aware when 0 = auto): gRPC handler
+# threads serving the cluster/metrics RPCs — auto is min(64, width//16+16)
+# so 1 s heartbeats from a 1k gang never queue behind a fixed 16-thread
+# pool — and the number of liveliness shards (per-shard locks, the sweep
+# examines one shard per tick) — auto is min(16, width//64)
+AM_RPC_WORKERS = "tony.am.rpc-workers"
+AM_LIVELINESS_SHARDS = "tony.am.liveliness-shards"
 
 # --- task / containers ---------------------------------------------------
 TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"

@@ -38,7 +38,7 @@ from tony_tpu.conf import TonyConfiguration, keys as K
 from tony_tpu.executor.runtimes import render_framework_env
 from tony_tpu.executor.task_monitor import TaskMonitor
 from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
-from tony_tpu.utils.common import current_host, pick_free_port, poll_till_non_null
+from tony_tpu.utils.common import current_host, pick_free_port
 from tony_tpu.utils.fs import unzip
 from tony_tpu.utils.localization import (
     fetch_remote_spec, localize_resource,
@@ -47,6 +47,32 @@ from tony_tpu.utils.ports import reserve_port
 from tony_tpu.utils.shell import launch_shell, wait_or_kill
 
 LOG = logging.getLogger(__name__)
+
+
+def heartbeat_jitter_sec(task_index: int, interval_sec: float) -> float:
+    """Deterministic start-phase offset for a task's heartbeater, spread
+    low-discrepancy across indices (golden-ratio sequence): a barrier
+    release would otherwise synchronize 1,024 heartbeats into the same
+    1 s phase and hammer the AM with width-sized bursts forever."""
+    return ((max(0, int(task_index)) * 0.6180339887498949) % 1.0) \
+        * max(0.0, interval_sec)
+
+
+def apply_spec_diff(spec: dict, changed: dict) -> dict:
+    """Patch a held cluster spec with a generation-keyed diff
+    ({jobtype: {index: host_port}}) — the executor-side half of the
+    heartbeat-piggybacked spec-diff protocol. Returns a NEW dict whose
+    JSON render is bit-identical to the AM's full render at the diff's
+    generation (same job order, same entry order by index)."""
+    out = {job: list(entries) for job, entries in spec.items()}
+    for job, updates in (changed or {}).items():
+        entries = out.setdefault(job, [])
+        for idx_s, host_port in updates.items():
+            i = int(idx_s)
+            while len(entries) <= i:
+                entries.append("")
+            entries[i] = host_port
+    return out
 
 
 class Heartbeater(threading.Thread):
@@ -60,11 +86,30 @@ class Heartbeater(threading.Thread):
     def __init__(self, client: ClusterServiceClient, task_id: str,
                  interval_sec: float, on_fatal=None, task_attempt: int = -1,
                  on_generation=None, silent: bool = False,
-                 on_profile=None, log_addr: str = "", on_drain=None):
+                 on_profile=None, log_addr: str = "", on_drain=None,
+                 jitter_sec: float = 0.0, gen_source=None,
+                 on_spec_diff=None, on_spec_ready=None,
+                 on_spec_refetch=None,
+                 failure_budget: int = C.MAX_CONSECUTIVE_FAILED_HEARTBEATS):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
         self._task_attempt = task_attempt
+        # start-phase desynchronization: slept once before the first ping
+        # (deterministic from the task index — see heartbeat_jitter_sec)
+        self._jitter_sec = max(0.0, jitter_sec)
+        # reports the generation of the spec this executor currently
+        # holds; the AM piggybacks the matching spec DIFF on the response
+        self._gen_source = gen_source
+        self._on_spec_diff = on_spec_diff
+        self._on_spec_ready = on_spec_ready
+        self._on_spec_refetch = on_spec_refetch
+        # consecutive-failure self-destruct threshold (the reference's
+        # MAX_CONSECUTIVE_FAILED_HEARTBEATS=5); overridable so harnesses
+        # hosting many executors per process can tolerate load-induced
+        # heartbeat timeouts without one executor's os._exit taking the
+        # whole pool down
+        self._failure_budget = max(1, int(failure_budget))
         # this executor's TaskLogService host:port, gossiped to the AM on
         # every heartbeat (the live-tail read surface; observability/logs)
         self._log_addr = log_addr
@@ -92,6 +137,8 @@ class Heartbeater(threading.Thread):
         self._stop.set()
 
     def run(self) -> None:
+        if self._jitter_sec and self._stop.wait(self._jitter_sec):
+            return
         while not self._stop.wait(self._interval):
             if self._silent:
                 continue
@@ -101,13 +148,27 @@ class Heartbeater(threading.Thread):
                             self._skip_remaining)
                 continue
             try:
+                held_gen = int(self._gen_source()) if self._gen_source else -1
                 resp = self._client.task_executor_heartbeat(
                     self._task_id, self._task_attempt,
-                    log_addr=self._log_addr)
+                    log_addr=self._log_addr,
+                    spec_generation=held_gen)
                 self._consecutive_failures = 0
                 generation = (resp or {}).get("spec_generation")
                 if generation and self._on_generation is not None:
                     self._on_generation(int(generation))
+                # generation-keyed spec diff / full-refetch verdict / the
+                # barrier-ready hint — the coalesced control plane's whole
+                # survivor-side re-rendezvous rides these three fields
+                spec_diff = (resp or {}).get("spec_diff")
+                if spec_diff and self._on_spec_diff is not None:
+                    self._on_spec_diff(spec_diff)
+                if (resp or {}).get("spec_refetch") \
+                        and self._on_spec_refetch is not None:
+                    self._on_spec_refetch()
+                if (resp or {}).get("spec_ready") \
+                        and self._on_spec_ready is not None:
+                    self._on_spec_ready()
                 profile_req = (resp or {}).get("profile_request")
                 if profile_req and self._on_profile is not None:
                     self._on_profile(profile_req)
@@ -118,8 +179,7 @@ class Heartbeater(threading.Thread):
                 self._consecutive_failures += 1
                 LOG.warning("heartbeat failed (%d consecutive)",
                             self._consecutive_failures)
-                if (self._consecutive_failures
-                        >= C.MAX_CONSECUTIVE_FAILED_HEARTBEATS):
+                if self._consecutive_failures >= self._failure_budget:
                     # the AM is unreachable: take the user process down with
                     # us — there is no NodeManager to reap the tree here —
                     # then exit (TaskExecutor.java:358-368)
@@ -134,7 +194,19 @@ class Heartbeater(threading.Thread):
 
 
 class TaskExecutor:
-    def __init__(self, env: Optional[dict] = None):
+    # heartbeat self-destruct budget handed to the Heartbeater; a class
+    # attr so multi-executor-per-process harnesses (bench --cp-pool) can
+    # widen it — in production each executor owns its process and the
+    # reference's 5-strike exit is exactly right
+    HB_FAILURE_BUDGET = C.MAX_CONSECUTIVE_FAILED_HEARTBEATS
+
+    def __init__(self, env: Optional[dict] = None,
+                 client: Optional[ClusterServiceClient] = None,
+                 metrics_client: Optional[MetricsServiceClient] = None):
+        """`client`/`metrics_client` let a harness hosting many executors
+        in one process (bench --cp-pool) share gRPC channels — a python
+        process cannot drive 2 x width independent channels. Production
+        executors own their process and build their own (default)."""
         e = env if env is not None else os.environ
         # -- init_configs (TaskExecutor.java:255-293) ----------------------
         self.job_name = e[C.JOB_NAME]
@@ -192,13 +264,14 @@ class TaskExecutor:
         token = e.get(TOKEN_ENV) or None
         task_auth = self.task_id if token else None
         self._task_token = token
-        self.client = ClusterServiceClient(self.am_host, self.am_port,
-                                           auth_token=token,
-                                           task_auth_id=task_auth)
-        self.metrics_client = MetricsServiceClient(self.am_host,
-                                                   self.metrics_port,
-                                                   auth_token=token,
-                                                   task_auth_id=task_auth)
+        self.client = client if client is not None else \
+            ClusterServiceClient(self.am_host, self.am_port,
+                                 auth_token=token,
+                                 task_auth_id=task_auth)
+        self.metrics_client = metrics_client if metrics_client is not None \
+            else MetricsServiceClient(self.am_host, self.metrics_port,
+                                      auth_token=token,
+                                      task_auth_id=task_auth)
         self.heartbeater: Optional[Heartbeater] = None
         self.monitor: Optional[TaskMonitor] = None
         self._user_proc = None
@@ -216,6 +289,20 @@ class TaskExecutor:
         self._latest_generation = 0
         self._respec_pending = False
         self._respec_lock = threading.Lock()
+        # coalesced re-rendezvous: the spec this executor currently holds
+        # and the newest heartbeat-piggybacked diff against it. A survivor
+        # re-enters the gang by PATCHING its held spec with the diff —
+        # zero register_worker_spec re-polls, zero full-spec re-fetches.
+        self._cluster_spec: Optional[dict] = None
+        self._pending_diff: Optional[dict] = None
+        self._diff_event = threading.Event()
+        # AM verdict: this executor's generation fell outside the diff
+        # window — patching is impossible, fall back to a full fetch
+        self._spec_refetch = False
+        # barrier-ready hint piggybacked on heartbeats: lets the
+        # registration poll back off exponentially and still fetch the
+        # spec within ~one heartbeat of the gang completing
+        self._spec_ready_event = threading.Event()
         self._test_kill_scheduled = False
         # live-log service (observability/logs.py): this executor serves
         # bounded offset-cursor reads over its own container stdout/stderr
@@ -315,7 +402,13 @@ class TaskExecutor:
         """Gang barrier (TaskExecutor.java:295-309): start heartbeating, then
         poll register_worker_spec until every expected task has registered.
         Re-entrant: a generation bump (peer relaunch) sends the executor back
-        here; the heartbeater keeps running across re-entries."""
+        here; the heartbeater keeps running across re-entries.
+
+        The poll backs off exponentially while the gang fills (0.2 s
+        doubling to ~1.6 s, phase-jittered by task index) — at width 1k a
+        fixed 0.2 s cadence meant ~5k barrier polls/s against the AM —
+        and the heartbeat-piggybacked spec_ready hint short-circuits the
+        backoff so the completing spec is still fetched promptly."""
         if self.heartbeater is None:
             self.heartbeater = Heartbeater(
                 self.client, self.task_id, self.hb_interval_sec,
@@ -325,26 +418,54 @@ class TaskExecutor:
                 silent=self._hb_silent_for_testing(),
                 on_profile=self._on_profile_request,
                 log_addr=self.log_addr,
-                on_drain=self._on_drain_request)
+                on_drain=self._on_drain_request,
+                jitter_sec=heartbeat_jitter_sec(self.task_index,
+                                                self.hb_interval_sec),
+                gen_source=lambda: self._spec_generation,
+                on_spec_diff=self._on_spec_diff,
+                on_spec_ready=self._spec_ready_event.set,
+                on_spec_refetch=self._on_spec_refetch,
+                failure_budget=self.HB_FAILURE_BUDGET)
             self.heartbeater.start()
         host_port = f"{self.host}:{self.port}"
         LOG.info("registering %s at %s (attempt %d)", self.task_id,
                  host_port, self.task_attempt)
-        result = poll_till_non_null(
-            lambda: self.client.register_worker_spec(
+        # deterministic per-task phase factor in [0.8, 1.2): decorrelates
+        # same-length backoffs across the gang without an RNG
+        phase = 0.8 + 0.4 * ((self.task_index * 0.6180339887498949) % 1.0)
+        deadline = time.monotonic() + self.registration_timeout_sec
+        interval, cap = 0.2, 1.6
+        result = None
+        while True:
+            result = self.client.register_worker_spec(
                 self.task_id, host_port, self.session_id,
-                task_attempt=self.task_attempt, with_generation=True),
-            interval_sec=0.2,
-            timeout_sec=self.registration_timeout_sec)
-        if result is None:
-            return None
+                task_attempt=self.task_attempt, with_generation=True)
+            if result is not None:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            self._spec_ready_event.clear()
+            self._spec_ready_event.wait(min(interval * phase, remaining))
+            interval = min(cap, interval * 2)
         spec, generation = result
         with self._respec_lock:
             self._spec_generation = generation
+            self._cluster_spec = spec
             # a bump observed mid-poll that is NEWER than the spec we just
             # got keeps the respec flag armed; anything older is already
             # satisfied by this spec
             self._respec_pending = self._latest_generation > generation
+            # a diff the heartbeater delivered while this full fetch was
+            # in flight is satisfied by the fetched spec unless it is
+            # strictly newer; a stale one left behind would be applied by
+            # a LATER respec and roll the held spec backwards
+            pending = self._pending_diff
+            if (pending is not None
+                    and int(pending.get("generation", 0)) <= generation):
+                self._pending_diff = None
+            # likewise any refetch verdict: this WAS the full fetch
+            self._spec_refetch = False
         return spec
 
     def _on_generation(self, generation: int) -> None:
@@ -367,6 +488,89 @@ class TaskExecutor:
                         "was relaunched; re-entering gang rendezvous",
                         generation, launched)
             self._kill_user_proc()
+
+    def _on_spec_diff(self, diff: dict) -> None:
+        """Heartbeat-piggybacked generation-keyed spec diff: the AM saw
+        this executor's held generation behind the current one and sent
+        the changed entries. Stash it for the respec loop (which patches
+        the held spec instead of re-fetching O(width) bytes) and make
+        sure the re-entry is armed — the diff can arrive in the same
+        response as the generation bump itself."""
+        try:
+            gen = int(diff.get("generation", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        if gen <= 0:
+            return
+        self._on_generation(gen)
+        with self._respec_lock:
+            if gen <= self._spec_generation:
+                return  # stale diff (already applied a newer spec)
+            pending = self._pending_diff
+            if pending is None or gen >= int(pending.get("generation", 0)):
+                self._pending_diff = diff
+        self._diff_event.set()
+
+    def _on_spec_refetch(self) -> None:
+        """AM verdict: our generation fell outside the retained diff
+        window — patching is impossible; the respec wait falls back to
+        the full register_worker_spec fetch."""
+        self._spec_refetch = True
+        self._diff_event.set()
+
+    def _await_respec_spec(self) -> Optional[dict]:
+        """Survivor-side re-rendezvous via the diff channel: wait for the
+        heartbeater to deliver the generation-keyed spec diff and patch
+        the held spec with it. Returns the patched spec, or None to fall
+        back to the full register_worker_spec poll (no live heartbeater,
+        a silenced-for-testing one, an AM refetch verdict, or timeout).
+        Survivors' registrations stay valid across a peer's relaunch, so
+        this path re-enters the gang with ZERO barrier re-polls and
+        O(changed) instead of O(width) bytes."""
+        hb = self.heartbeater
+        if (hb is None or not hb.is_alive() or hb._silent
+                or self._cluster_spec is None):
+            return None
+        deadline = time.monotonic() + self.registration_timeout_sec
+        while True:
+            with self._respec_lock:
+                diff = self._pending_diff
+                self._pending_diff = None
+                if (diff is not None and int(diff.get("generation", 0))
+                        <= self._spec_generation):
+                    # stale leftover (a newer spec was installed since it
+                    # was stashed): applying it would downgrade the held
+                    # generation and resurrect a dead peer address
+                    diff = None
+            if diff is not None:
+                patched = apply_spec_diff(self._cluster_spec,
+                                          diff.get("changed") or {})
+                gen = int(diff["generation"])
+                with self._respec_lock:
+                    self._spec_generation = gen
+                    self._cluster_spec = patched
+                    self._respec_pending = self._latest_generation > gen
+                LOG.info("applied spec diff for generation %d (%d task(s) "
+                         "changed) — re-joined the gang without re-fetching "
+                         "the cluster spec", gen,
+                         sum(len(v) for v in
+                             (diff.get("changed") or {}).values()))
+                return patched
+            if self._spec_refetch:
+                self._spec_refetch = False
+                LOG.warning("AM says our spec generation is outside the "
+                            "diff window — falling back to a full fetch")
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                LOG.warning("no spec diff arrived within %ds — falling "
+                            "back to the rendezvous barrier poll",
+                            self.registration_timeout_sec)
+                return None
+            if not hb.is_alive():
+                return None
+            self._diff_event.wait(min(1.0, remaining))
+            self._diff_event.clear()
 
     def _on_profile_request(self, preq: dict) -> None:
         """Relay a heartbeat-piggybacked request_profile ask to the user
@@ -689,14 +893,22 @@ class TaskExecutor:
                 barrier_t0 = time.monotonic()
                 barrier_span = self.tracer.start(
                     "rendezvous_wait", attrs={"re_entry": True})
-                for _ in range(3):
-                    cluster_spec = self.register_and_get_cluster_spec()
-                    if cluster_spec is not None:
-                        break
-                    LOG.warning("re-rendezvous barrier still open after "
-                                "%ds — retrying (the AM's allocation "
-                                "deadline governs)",
-                                self.registration_timeout_sec)
+                # coalesced path first: this survivor's registration is
+                # still valid at the AM, so the replacement's address
+                # arrives as a heartbeat-piggybacked diff — no barrier
+                # re-poll, no O(width) re-fetch. The barrier poll below
+                # is the fallback (no live heartbeater, refetch verdict,
+                # or the diff never arriving within the timeout).
+                cluster_spec = self._await_respec_spec()
+                if cluster_spec is None:
+                    for _ in range(3):
+                        cluster_spec = self.register_and_get_cluster_spec()
+                        if cluster_spec is not None:
+                            break
+                        LOG.warning("re-rendezvous barrier still open after "
+                                    "%ds — retrying (the AM's allocation "
+                                    "deadline governs)",
+                                    self.registration_timeout_sec)
                 self.tracer.end(
                     barrier_span,
                     "OK" if cluster_spec is not None else "ERROR")

@@ -213,7 +213,8 @@ class ClusterServiceClient(_JsonRpcClient):
 
     def task_executor_heartbeat(self, task_id: str,
                                 task_attempt: int = -1,
-                                log_addr: str = "") -> dict:
+                                log_addr: str = "",
+                                spec_generation: int = -1) -> dict:
         # liveness signal: one attempt, short deadline, no wait_for_ready —
         # the Heartbeater counts consecutive failures and kills the executor
         # when the AM is gone (reference: TaskExecutor.java:358-368; with
@@ -222,10 +223,16 @@ class ClusterServiceClient(_JsonRpcClient):
         # running executors learn about relaunches without extra polling.
         # log_addr gossips this executor's TaskLogService host:port (the
         # live-tail read surface) — piggybacked here so gang width adds
-        # zero extra RPCs.
+        # zero extra RPCs. spec_generation (>0) reports the generation of
+        # the cluster spec this executor currently holds: a survivor
+        # behind the AM's generation receives the generation-keyed spec
+        # DIFF in the response instead of ever re-fetching the full
+        # O(width) spec (coalesced control plane).
         req = {"task_id": task_id, "task_attempt": task_attempt}
         if log_addr:
             req["log_addr"] = log_addr
+        if spec_generation > 0:
+            req["spec_generation"] = spec_generation
         return self.call("task_executor_heartbeat", req,
                          retries=1, timeout_sec=5.0, wait_for_ready=False)
 

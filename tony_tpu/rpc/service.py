@@ -52,6 +52,16 @@ METRICS_METHODS = ("update_metrics",)
 TASK_LOG_METHODS = ("read_log",)
 
 
+def auto_rpc_workers(width: int) -> int:
+    """Width-aware default for tony.am.rpc-workers: the AM's handler pool
+    must absorb `width` 1 s heartbeats plus metric pushes without queueing
+    — a fixed 16-thread pool at width 1024 meant every ping waited behind
+    63 others. min(64, width//16 + 16): small gangs keep the old 16-ish
+    pool, width 1024 gets the full 64 (threads are parked in epoll when
+    idle; past ~64 the GIL, not the pool, is the ceiling)."""
+    return min(64, max(16, int(width) // 16 + 16))
+
+
 def _ser(obj: Any) -> bytes:
     return json.dumps(obj).encode("utf-8")
 

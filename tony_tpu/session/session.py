@@ -14,6 +14,7 @@ import enum
 import json
 import logging
 import threading
+from collections import OrderedDict
 from typing import Optional
 
 from tony_tpu import constants as C
@@ -22,6 +23,12 @@ from tony_tpu.rpc.messages import TaskInfo, TaskStatus
 from tony_tpu.session.requests import JobContainerRequest, parse_container_requests
 
 LOG = logging.getLogger(__name__)
+
+# How many generation bumps the session retains diff material for. An
+# executor whose held generation fell further behind than this gets a
+# spec_refetch verdict (full-spec fallback) instead of a diff — bounded
+# memory beats a perfectly complete diff history.
+SPEC_DIFF_WINDOW = 64
 
 # Exit code the AM uses when it kills a container itself. Such exits get
 # status FINISHED (not FAILED) and never trigger the failure short-circuit,
@@ -149,6 +156,29 @@ class TonySession:
         # generation their running spec came from; a newer generation means
         # "re-enter the rendezvous barrier" (without restarting containers).
         self.spec_generation = 1
+        # coalesced control plane: the rendered cluster-spec JSON is cached
+        # per (generation, registration state) — barrier release and
+        # get_cluster_spec serve the SAME string to every caller instead of
+        # an O(width) json.dumps per poll. Invalidation points: any
+        # registration change and every generation bump.
+        self._spec_cache: Optional[str] = None
+        # generation -> task_ids whose registration was invalidated at the
+        # bump TO that generation (the diff material); bounded to
+        # SPEC_DIFF_WINDOW bumps
+        self._gen_changes: OrderedDict[int, set[str]] = OrderedDict()
+        # from_generation -> (rendered diff dict, serialized byte size)
+        # for the CURRENT generation (cleared with the spec cache)
+        self._diff_cache: dict[int, tuple[dict, int]] = {}
+        # tasks that re-registered at a NEW host:port without a relaunch
+        # (no generation bump): folded into the next bump's diff material
+        # so survivors patching by diff still pick up the rebind
+        self._pending_rebinds: set[str] = set()
+        # control-plane self-accounting (the bench's spec_bytes_sent and
+        # the chaos e2e's zero-full-refetch assertion read these):
+        # renders = distinct O(width) json.dumps calls; full/diff serves
+        # count payloads actually handed to a caller.
+        self.spec_stats = {"renders": 0, "full_serves": 0, "full_bytes": 0,
+                           "diff_serves": 0, "diff_bytes": 0}
         self._lock = threading.RLock()
 
     # ------------------------------------------------------------------
@@ -200,13 +230,23 @@ class TonySession:
             if task_id not in self._registered:
                 LOG.info("registered %s at %s (%d/%d)", task_id, host_port,
                          len(self._registered) + 1, self.num_expected_tasks)
+                self._invalidate_spec_cache()
             elif self._registered[task_id] != task.host_port:
                 # executor restarted and rebound: refresh the address so the
                 # spec never points peers at a dead port
                 LOG.warning("task %s re-registered at %s (was %s)", task_id,
                             task.host_port, self._registered[task_id])
+                # no generation bump here, so no diff ever carries this
+                # rebind on its own — remember it and fold it into the
+                # NEXT bump's diff material, matching what a survivor's
+                # full re-fetch at that bump would have picked up
+                self._pending_rebinds.add(task_id)
+                self._invalidate_spec_cache()
             self._registered[task_id] = task.host_port
-            return self.cluster_spec_json()
+            spec = self.cluster_spec_json()
+            if spec is not None:
+                self.note_full_serve(spec)   # RLock: safe under self._lock
+            return spec
 
     def register_worker_spec_with_generation(
             self, task_id: str, host_port: str,
@@ -249,6 +289,16 @@ class TonySession:
             self._registered.pop(task.task_id, None)
             task.reset_for_relaunch()
             self.spec_generation += 1
+            # diff material: survivors holding the previous generation get
+            # {this task: replacement host:port} piggybacked on heartbeats
+            # once the barrier re-closes, instead of re-fetching the full
+            # O(width) spec
+            self._gen_changes[self.spec_generation] = \
+                {task.task_id} | self._pending_rebinds
+            self._pending_rebinds = set()
+            while len(self._gen_changes) > SPEC_DIFF_WINDOW:
+                self._gen_changes.popitem(last=False)
+            self._invalidate_spec_cache()
             LOG.info("task %s recycled for attempt %d (spec generation %d)",
                      task.task_id, task.attempt, self.spec_generation)
             return task
@@ -261,16 +311,116 @@ class TonySession:
     def cluster_spec_json(self) -> Optional[str]:
         """JSON {jobtype: ["host:port", ...]} over registered tasks, or None
         while the barrier is open (TonySession.getClusterSpec,
-        TonySession.java:226-246)."""
+        TonySession.java:226-246). The render is cached per generation /
+        registration state: at width 1k every barrier poll re-rendering
+        O(width) JSON was the AM's hottest needless loop."""
         with self._lock:
             if not self.all_tasks_registered():
                 return None
-            spec: dict[str, list[str]] = {}
-            for job, tasks in self.job_tasks.items():
-                entries = [t.host_port for t in tasks if t.task_id in self._registered]
-                if entries:
-                    spec[job] = entries
-            return json.dumps(spec)
+            if self._spec_cache is None:
+                spec: dict[str, list[str]] = {}
+                for job, tasks in self.job_tasks.items():
+                    entries = [t.host_port for t in tasks
+                               if t.task_id in self._registered]
+                    if entries:
+                        spec[job] = entries
+                self._spec_cache = json.dumps(spec)
+                self.spec_stats["renders"] += 1
+            return self._spec_cache
+
+    def _invalidate_spec_cache(self) -> None:
+        self._spec_cache = None
+        self._diff_cache.clear()
+
+    def spec_diff_since(self, from_generation: int
+                        ) -> tuple[Optional[dict], bool]:
+        """Generation-keyed spec diff for an executor that already holds
+        `from_generation`: returns (diff, refetch_needed).
+
+        diff = {"generation": current, "changed": {job: {index: host_port}}}
+        covering every bump in (from_generation, current] — O(changed
+        tasks) bytes instead of the O(width) full spec. Piggybacked on
+        heartbeat responses by the AM.
+
+        (None, False) while up to date OR while the barrier is still open
+        (the executor keeps waiting — the diff arrives on a later
+        heartbeat); (None, True) when the diff window no longer covers
+        from_generation (or it never held a rendered spec) and the
+        executor must fall back to a full fetch."""
+        with self._lock:
+            current = self.spec_generation
+            if from_generation >= current:
+                return None, False
+            if from_generation < 1:
+                return None, True
+            if not self.all_tasks_registered():
+                # barrier open: the replacement hasn't registered yet, so
+                # there is no complete spec to diff against — not a
+                # refetch verdict, just "not yet"
+                return None, False
+            cached = self._diff_cache.get(from_generation)
+            if cached is not None:
+                diff, nbytes = cached
+            else:
+                changed_ids: set[str] = set()
+                for gen in range(from_generation + 1, current + 1):
+                    ids = self._gen_changes.get(gen)
+                    if ids is None:
+                        # bump fell out of the retained window
+                        return None, True
+                    changed_ids |= ids
+                # a rebind since the last bump (no generation of its own):
+                # a trailing survivor's full fetch would have picked it up
+                # from the re-rendered spec, so the diff must carry it too
+                changed_ids |= self._pending_rebinds
+                changed: dict[str, dict[str, str]] = {}
+                for tid in sorted(changed_ids):
+                    task = self.get_task_by_id(tid)
+                    if task is None or tid not in self._registered:
+                        return None, True
+                    changed.setdefault(task.job_name, {})[
+                        str(task.index)] = task.host_port
+                diff = {"generation": current, "changed": changed}
+                # serialize ONCE for byte accounting — at width 1k the
+                # same cached diff is served to ~width survivors and a
+                # per-serve json.dumps would sit on the heartbeat hot path
+                nbytes = len(json.dumps(diff))
+                self._diff_cache[from_generation] = (diff, nbytes)
+            self.spec_stats["diff_serves"] += 1
+            self.spec_stats["diff_bytes"] += nbytes
+            return diff, False
+
+    def heartbeat_spec_fields(self, exec_generation: int) -> dict:
+        """The spec-related fields a heartbeat RESPONSE carries for an
+        executor reporting the generation of the spec it holds — the ONE
+        implementation of the piggyback protocol, shared by the AM's
+        handler and the bench's control-plane harness so the bench always
+        measures the protocol production runs:
+
+        - spec_ready: barrier state (lets the register poll back off hard
+          and still fetch within ~one heartbeat of the gang completing);
+        - spec_diff: generation-keyed diff when the executor trails the
+          current generation and the window covers it;
+        - spec_refetch: the executor's generation fell outside the diff
+          window — it must fall back to a full fetch."""
+        fields = {"spec_ready": self.all_tasks_registered()}
+        if 0 < exec_generation < self.spec_generation:
+            diff, refetch = self.spec_diff_since(exec_generation)
+            if diff is not None:
+                fields["spec_diff"] = diff
+            elif refetch:
+                fields["spec_refetch"] = True
+        return fields
+
+    def note_full_serve(self, spec: str) -> None:
+        """Account a full O(width) spec payload handed to a caller outside
+        register_worker_spec (e.g. get_cluster_spec) — under the session
+        lock so concurrent gRPC handler threads never lose an increment
+        (the bench's spec_bytes and the chaos e2e's exact full_serves
+        count read these)."""
+        with self._lock:
+            self.spec_stats["full_serves"] += 1
+            self.spec_stats["full_bytes"] += len(spec)
 
     # ------------------------------------------------------------------
     # policy predicates

@@ -14,7 +14,7 @@ Rules:
 - value <= 0 entries (wedged-tunnel fallback headlines pin value to 0.0)
   are markers, not measurements — skipped both as baseline and as the
   judged entry;
-- direction comes from the unit: seconds/ms are lower-is-better,
+- direction comes from the unit: seconds/ms/bytes are lower-is-better,
   everything else (MFU %, tokens/sec) higher-is-better.
 
 Run: python tools/bench_compare.py [--threshold-pct 2]
@@ -31,7 +31,7 @@ import sys
 DEFAULT_HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_history.jsonl")
 
-LOWER_IS_BETTER_UNITS = ("s", "ms", "sec", "seconds")
+LOWER_IS_BETTER_UNITS = ("s", "ms", "sec", "seconds", "bytes", "b")
 
 
 def load_history(path: str) -> list[dict]:
