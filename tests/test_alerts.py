@@ -715,34 +715,13 @@ def test_cli_alerts_missing_bundle(tmp_path, capsys):
 # static checks (tier-1 CI hygiene)
 # ---------------------------------------------------------------------------
 
-_PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "tony_tpu")
-
-
-def _source(*rel):
-    with open(os.path.join(_PKG_ROOT, *rel), "r", encoding="utf-8") as f:
-        return f.read()
-
-
 def test_every_rule_id_literal_is_registered():
-    """No silently-dead rules: every quoted built-in rule id appearing
-    in the control-plane sources must be a key of BUILTIN_RULES — a
-    renamed or removed rule cannot leave a dangling reference that
-    no engine would ever evaluate."""
-    import re
-    sources = ["am/application_master.py", "portal/server.py",
-               "portal/__main__.py", "cli/__main__.py",
-               "observability/alerts.py", "observability/fleet.py"]
-    referenced = set()
-    for rel in sources:
-        referenced |= set(re.findall(
-            r"[\"']((?:train|serve|fleet)\.[a-z0-9_]+)[\"']",
-            _source(*rel.split("/"))))
-    unknown = sorted(referenced - set(A.BUILTIN_RULES))
-    assert not unknown, (
-        "rule-id literals not registered in alerts.BUILTIN_RULES "
-        f"(silently dead): {unknown}")
-    # and the table itself stays honest: every entry is buildable from
+    """No silently-dead rules. The literal⊆BUILTIN_RULES sweep is now a
+    tonylint rule (tools/tonylint/rules_legacy.py `alert-rule-registry`);
+    the buildable-table half stays here (it constructs an engine)."""
+    from tools.tonylint import findings_for
+    assert findings_for("alert-rule-registry") == []
+    # the table itself stays honest: every entry is buildable from
     # a conf that enables everything
     from tony_tpu.conf import TonyConfiguration, keys as K
     conf = TonyConfiguration()
@@ -760,28 +739,11 @@ def test_every_rule_id_literal_is_registered():
 
 def test_alert_engine_never_touches_the_hot_loop():
     """The acceptance bound: the engine runs only on the AM monitor
-    cadence and the portal fleet-scan cadence. No module on the trainer/
-    executor/serving hot paths may import or evaluate it."""
-    hot_paths = []
-    for sub in ("train", "executor"):
-        for dirpath, _, files in os.walk(os.path.join(_PKG_ROOT, sub)):
-            hot_paths += [os.path.join(dirpath, f) for f in sorted(files)
-                          if f.endswith(".py")]
-    hot_paths += [os.path.join(_PKG_ROOT, "serve", f)
-                  for f in ("engine.py", "frontend.py", "__main__.py")]
-    offenders = []
-    for path in hot_paths:
-        with open(path, "r", encoding="utf-8") as f:
-            src = f.read()
-        if "observability.alerts" in src or "AlertEngine" in src \
-                or "import alerts" in src:
-            offenders.append(os.path.relpath(path, _PKG_ROOT))
-    assert not offenders, (
-        "alerting reached a hot-loop module (the engine must run only "
-        f"on monitor/fleet cadence): {offenders}")
-    # positive control: the two sanctioned evaluate() call sites exist
-    assert "_check_alerts" in _source("am", "application_master.py")
-    assert "alert_engine.evaluate" in _source("observability", "fleet.py")
+    cadence and the portal fleet-scan cadence. Now a tonylint rule
+    (`alert-hot-loop`, incl. the two sanctioned-call-site positive
+    controls)."""
+    from tools.tonylint import findings_for
+    assert findings_for("alert-hot-loop") == []
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import threading
 import time
 import urllib.request
@@ -299,31 +298,15 @@ def test_fleet_families_roundtrip_with_labels():
 
 
 # ---------------------------------------------------------------------------
-# tier-1 static check: the AM's job gauges vs fleet's aggregation map
+# tier-1 static check: the AM's job gauges vs fleet's aggregation map —
+# migrated to tonylint (tools/tonylint/rules_legacy.py `gauge-registry`:
+# AM tony_job_* literals ⊆ fleet.JOB_GAUGES, f-string names rejected,
+# STEP_TIME_GAUGES consistency)
 # ---------------------------------------------------------------------------
 
 def test_every_am_job_gauge_is_in_the_fleet_aggregation_map():
-    """Every `tony_job_*` gauge name the AM source mentions must be a
-    key of fleet.JOB_GAUGES — otherwise the fleet /metrics silently
-    drops it from the cross-job view. Interpolated names (f-strings)
-    are rejected outright: job gauges must be literal, registered
-    names (fleet.STEP_TIME_GAUGES exists for exactly this reason)."""
-    am_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "tony_tpu", "am",
-        "application_master.py")
-    with open(am_path, "r", encoding="utf-8") as f:
-        source = f.read()
-    names = set(re.findall(r"tony_job_[a-z0-9_{}]+", source))
-    interpolated = sorted(n for n in names if "{" in n)
-    assert not interpolated, (
-        "f-string-assembled job gauge names in the AM — register a "
-        f"literal name in fleet.JOB_GAUGES instead: {interpolated}")
-    missing = sorted(names - set(fleet.JOB_GAUGES))
-    assert not missing, (
-        "tony_job_* gauges the AM exports but fleet.JOB_GAUGES does not "
-        f"aggregate (the fleet /metrics would drop them): {missing}")
-    # ...and the step-time helper map stays consistent with it
-    assert set(fleet.STEP_TIME_GAUGES.values()) <= set(fleet.JOB_GAUGES)
+    from tools.tonylint import findings_for
+    assert findings_for("gauge-registry") == []
 
 
 # ---------------------------------------------------------------------------

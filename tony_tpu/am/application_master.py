@@ -97,16 +97,16 @@ class MetricsStore(MetricsServiceHandler):
 
     def __init__(self, low_util_intervals: int = 24,
                  history_points: int = 512):
-        self._metrics: dict[str, dict[int, list[dict]]] = {}
-        self._low_util_count: dict[tuple[str, int], int] = {}
-        self._low_util_flagged: set[tuple[str, int]] = set()
-        self._had_util: set[tuple[str, int]] = set()
+        self._metrics: dict[str, dict[int, list[dict]]] = {}  # guarded-by: _lock
+        self._low_util_count: dict[tuple[str, int], int] = {}  # guarded-by: _lock
+        self._low_util_flagged: set[tuple[str, int]] = set()  # guarded-by: _lock
+        self._had_util: set[tuple[str, int]] = set()  # guarded-by: _lock
         self._low_util_intervals = low_util_intervals
         self._history_points = history_points
         # (task_type, index) -> {metric name: TimeSeries}
-        self._series: dict[tuple[str, int], dict] = {}
+        self._series: dict[tuple[str, int], dict] = {}  # guarded-by: _lock
         # last task attempt a push arrived from (Prometheus label)
-        self._attempts: dict[tuple[str, int], int] = {}
+        self._attempts: dict[tuple[str, int], int] = {}  # guarded-by: _lock
         # spans piggybacked on metrics pushes land here (the AM wires its
         # SpanStore.add in); None drops them (standalone store in tests)
         self.span_sink = None
@@ -179,6 +179,7 @@ class MetricsStore(MetricsServiceHandler):
             psink(task_type, index, profile_done)
         return {}
 
+    # holds: _lock (only update_metrics calls this, under the store lock)
     def _track_utilization(self, task_type: str, index: int,
                            metrics: list[dict]) -> None:
         # TPU_UTILIZATION is the LAST sample — tracking the monotonic MAX
@@ -358,7 +359,7 @@ class ApplicationMaster(ClusterServiceHandler):
         self.metrics_store.profile_sink = self._on_profile_captured
         # relaunch downtime: per-slot clock from the relaunch decision to
         # the re-completed gang barrier; counts AGAINST job goodput
-        self._relaunch_pending_since: dict[str, float] = {}
+        self._relaunch_pending_since: dict[str, float] = {}  # guarded-by: _lock
         self._relaunch_downtime_s = 0.0
         # checkpoint-then-evict preemption (cluster/arbiter.py's
         # eviction edge): set once by request_preemption — {reason,
@@ -381,7 +382,7 @@ class ApplicationMaster(ClusterServiceHandler):
         # relaunch decision — the replacement's pushes overwrite the
         # MetricsStore slot, and a killed attempt's hour of training must
         # not vanish from the job's wall/productive accounting
-        self._goodput_archive: dict[str, dict[str, float]] = {}
+        self._goodput_archive: dict[str, dict[str, float]] = {}  # guarded-by: _lock
         self.slo = SloWatchdog(
             step_regression_pct=conf.get_int(
                 K.SLO_STEP_TIME_REGRESSION_PCT, 0),
@@ -442,17 +443,17 @@ class ApplicationMaster(ClusterServiceHandler):
         self._log_tail_bytes = conf.get_int(K.LOGS_TAIL_BYTES, 65536)
         self._log_chunk_bytes = conf.get_int(K.LOGS_CHUNK_BYTES, 32768)
         self._diag_lines = conf.get_int(K.LOGS_DIAGNOSTICS_LINES, 200)
-        self._log_addrs: dict[str, tuple[int, str]] = {}
+        self._log_addrs: dict[str, tuple[int, str]] = {}  # guarded-by: _lock
         # follow-mode polls arrive every ~500 ms per follower: reuse ONE
         # channel per (task, attempt, addr) instead of a fresh TCP+HTTP/2
         # handshake per chunk; displaced entries are closed
-        self._log_clients: dict[str, tuple[int, str, object]] = {}
+        self._log_clients: dict[str, tuple[int, str, object]] = {}  # guarded-by: _lock
         # (task_id, attempt) -> failure record; first observer wins (one
         # crash has up to three observers — result RPC, completion
         # callback, heartbeat expiry — and the executor's own redacted
         # report is the best evidence, so it is recorded before the
         # relaunch decision runs)
-        self._failure_records: dict[tuple[str, int], dict] = {}
+        self._failure_records: dict[tuple[str, int], dict] = {}  # guarded-by: _lock
         self._root_span = None
         self._rendezvous_span = None
         # (task_id, attempt) -> open task span (allocation → completion)
@@ -474,21 +475,21 @@ class ApplicationMaster(ClusterServiceHandler):
         self._model_params: Optional[str] = None
         self._single_node = conf.get_bool(K.APPLICATION_SINGLE_NODE, False)
         # container bookkeeping: container_id -> (task, session_id at launch)
-        self._launched: dict[str, tuple[Task, int]] = {}
-        self._finished_containers: set[str] = set()
-        self._session_containers: dict[int, list[str]] = {}
+        self._launched: dict[str, tuple[Task, int]] = {}  # guarded-by: _lock
+        self._finished_containers: set[str] = set()  # guarded-by: _lock
+        self._session_containers: dict[int, list[str]] = {}  # guarded-by: _lock
         # task-attempt fault tolerance: cumulative tracked-task failures
         # across attempts AND sessions (feeds the
         # tony.application.max-total-task-failures circuit breaker)
-        self._total_task_failures = 0
+        self._total_task_failures = 0  # guarded-by: _lock
         self._alloc_timeout_ms = conf.get_time_ms(
             K.CONTAINER_ALLOCATION_TIMEOUT, 15 * 60 * 1000)
         self._lock = threading.RLock()
-        self._tb_url = ""
+        self._tb_url = ""  # guarded-by: _lock
         # serving endpoints announced via register_serving_endpoint:
         # task_id -> url (serve/ subsystem; surfaced in task infos and as
         # SERVING_ENDPOINT_REGISTERED history events)
-        self._serving_endpoints: dict[str, str] = {}
+        self._serving_endpoints: dict[str, str] = {}  # guarded-by: _lock
         self._wake = threading.Event()   # kick the monitor loop early
         # timings (reference cadences, TonyConfigurationKeys.java:143-150)
         self._hb_interval_ms = conf.get_time_ms(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
@@ -1166,7 +1167,8 @@ class ApplicationMaster(ClusterServiceHandler):
         # point — from here on register_worker_spec validates ids against
         # THIS session
         self.hb_monitor.clear()
-        self._session_containers.setdefault(self._session_id, [])
+        with self._lock:
+            self._session_containers.setdefault(self._session_id, [])
         self.scheduler = TaskScheduler(self.session,
                                        _Requestor(self.backend, self))
 
@@ -1432,6 +1434,7 @@ class ApplicationMaster(ClusterServiceHandler):
                     "gracefully, %d force-stopped (%d ms)", drained,
                     killed, drain_ms)
 
+    # holds: _lock (see docstring — callers own the AM lock)
     def _close_relaunch_downtime(self) -> None:
         """Fold every open relaunch gap into the accumulated downtime
         (caller holds the AM lock, or the app is single-threadedly
@@ -1819,10 +1822,12 @@ class ApplicationMaster(ClusterServiceHandler):
     def _write_status(self, status: str, message: Optional[str]) -> None:
         path = os.path.join(self.app_dir, C.AM_STATUS_FILE)
         tmp = path + ".tmp"
+        with self._lock:
+            tb_url = self._tb_url
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump({"status": status, "message": message,
                        "app_id": self.app_id,
-                       "tb_url": self._tb_url,
+                       "tb_url": tb_url,
                        "completed": int(time.time() * 1000)}, f)
         os.replace(tmp, path)
 
@@ -1838,7 +1843,8 @@ class ApplicationMaster(ClusterServiceHandler):
             try:
                 client.close()
             except Exception:  # noqa: BLE001
-                pass
+                LOG.debug("log client close failed at teardown",
+                          exc_info=True)
         if self._metrics_http is not None:
             self._metrics_http.stop()
             self._metrics_http = None
@@ -1856,7 +1862,7 @@ class ApplicationMaster(ClusterServiceHandler):
         # ApplicationMaster.java:738-739)
         from tony_tpu.conf.keys import command_key
         command = (self.conf.get_str(command_key("am"))
-                   or self.conf.get_str("tony.task.command")
+                   or self.conf.get_str(K.TASK_COMMAND)
                    or os.environ.get(C.TASK_COMMAND, ""))
         if not command:
             LOG.warning("single-node/preprocess mode with no task command")
@@ -1882,7 +1888,8 @@ class ApplicationMaster(ClusterServiceHandler):
             from tony_tpu.utils.ports import reserve_port
             reservation = reserve_port()
             env[C.TB_PORT] = str(reservation.port)
-            self._tb_url = f"http://{self.host}:{reservation.port}"
+            with self._lock:
+                self._tb_url = f"http://{self.host}:{reservation.port}"
         stdout_path = os.path.join(log_dir, "stdout")
         scan_from = 0
         try:
@@ -2026,7 +2033,7 @@ class ApplicationMaster(ClusterServiceHandler):
             command = req.command or f"{sys.executable} -m tony_tpu.serve"
         else:
             command = req.command \
-                or self.conf.get_str("tony.task.command") \
+                or self.conf.get_str(K.TASK_COMMAND) \
                 or os.environ.get(C.TASK_COMMAND, "")
         env[C.TASK_COMMAND] = command
         # user-supplied pass-through env (tony.execution.env k=v list)
@@ -2386,14 +2393,15 @@ class ApplicationMaster(ClusterServiceHandler):
                         and f"{info.get('name')}:{info.get('index')}"
                         in idle):
                     info["low_utilization"] = True
-        if self._tb_url:
-            infos.append({"name": "tensorboard", "index": 0,
-                          "url": self._tb_url, "status": "RUNNING"})
-        # live serving endpoints ride the same status channel the
-        # reference used for the TB URL, so clients/proxies discover the
-        # inference endpoint without parsing history
         with self._lock:
+            tb_url = self._tb_url
+            # live serving endpoints ride the same status channel the
+            # reference used for the TB URL, so clients/proxies discover
+            # the inference endpoint without parsing history
             endpoints = sorted(self._serving_endpoints.items())
+        if tb_url:
+            infos.append({"name": "tensorboard", "index": 0,
+                          "url": tb_url, "status": "RUNNING"})
         for i, (task_id, url) in enumerate(endpoints):
             infos.append({"name": "serving-endpoint", "index": i,
                           "task_id": task_id, "url": url,
@@ -2466,8 +2474,10 @@ class ApplicationMaster(ClusterServiceHandler):
             self.backend.stop_container(cid)
 
     def register_tensorboard_url(self, req: dict) -> dict:
-        self._tb_url = req.get("url", "")
-        LOG.info("TensorBoard registered at %s", self._tb_url)
+        url = req.get("url", "")
+        with self._lock:
+            self._tb_url = url
+        LOG.info("TensorBoard registered at %s", url)
         return {}
 
     def register_serving_endpoint(self, req: dict) -> dict:
@@ -2622,6 +2632,11 @@ class ApplicationMaster(ClusterServiceHandler):
         # gossiped address actually changes.
         log_addr = str(req.get("log_addr", "") or "")
         if log_addr:
+            # deliberate lock-free pre-check: the address is identical on
+            # every ping after the first, and W heartbeats/interval must
+            # not serialize on the AM lock to discover that (PR 11); the
+            # write below re-checks under the lock
+            # tony: disable=guarded-by -- lock-free heartbeat fast path
             known = self._log_addrs.get(req["task_id"])
             if known is None or known != (max(attempt, 0), log_addr):
                 with self._lock:
@@ -2824,7 +2839,7 @@ class ApplicationMaster(ClusterServiceHandler):
             try:
                 stale[2].close()
             except Exception:  # noqa: BLE001
-                pass
+                LOG.debug("displaced log client close failed", exc_info=True)
         return client
 
     def read_task_logs(self, req: dict) -> dict:

@@ -72,6 +72,7 @@ class LivelinessMonitor:
         # The expiry callback reports WHICH attempt went silent, so a
         # stale expiry racing a relaunch can be fenced instead of judging
         # the healthy replacement by the dead attempt's silence.
+        # guarded-by: _locks
         self._shards: list[dict[str, tuple[float, int]]] = [
             {} for _ in range(self.num_shards)]
         self._locks = [threading.Lock() for _ in range(self.num_shards)]
@@ -156,7 +157,14 @@ class LivelinessMonitor:
             return self._shards[idx].get(task_id)
 
     def __len__(self) -> int:
-        return sum(len(s) for s in self._shards)
+        # per-shard locks: a concurrent register/expiry resizing a shard
+        # dict mid-iteration raced this unlocked sum (caught by tonylint's
+        # guarded-by pass)
+        total = 0
+        for idx in range(self.num_shards):
+            with self._locks[idx]:
+                total += len(self._shards[idx])
+        return total
 
     def clear(self) -> None:
         for idx in range(self.num_shards):

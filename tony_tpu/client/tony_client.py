@@ -117,7 +117,7 @@ class TonyClient:
             self.conf.set(K.EXECUTION_ENV, entry, "cli")
         self.task_command = self._build_task_command(args)
         if self.task_command:
-            self.conf.set("tony.task.command", self.task_command, "cli")
+            self.conf.set(K.TASK_COMMAND, self.task_command, "cli")
         self.validate_conf()
 
     def _build_task_command(self, args) -> str:

@@ -12,6 +12,7 @@ from tony_tpu.conf import keys as K
 # Keys that intentionally have NO default (user- or system-supplied only).
 # Mirrors the reference's configurationPropsToSkipCompare set.
 NO_DEFAULT_KEYS = frozenset({
+    K.TASK_COMMAND,
     K.APPLICATION_NODE_LABEL,
     K.APPLICATION_RESUMED_FROM,
     K.APPLICATION_PREEMPTED_AT_MS,

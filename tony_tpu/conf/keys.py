@@ -62,6 +62,11 @@ AM_RPC_WORKERS = "tony.am.rpc-workers"
 AM_LIVELINESS_SHARDS = "tony.am.liveliness-shards"
 
 # --- task / containers ---------------------------------------------------
+# default task command when no per-jobtype tony.<jobtype>.command is set
+# (the CLI's positional task command lands here; registered late — it
+# rode as a bare literal in client/AM until tonylint's
+# config-key-registry rule flushed it out)
+TASK_COMMAND = "tony.task.command"
 TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
 TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
 # task-attempt budget: total attempts (first run + relaunches) a tracked

@@ -43,6 +43,9 @@ def main() -> int:
             "SIGTERM — stopping user process and exiting")
         try:
             executor._terminate_user_proc()
+        # signal handler mid-os._exit: logging here may deadlock on the
+        # logging module's own lock, so this swallow stays silent
+        # tony: disable=thread-hygiene -- no logging inside a signal handler
         except Exception:  # noqa: BLE001 — nothing must block the exit
             pass
         os._exit(C.EXIT_KILLED_BY_AM & 0xFF)

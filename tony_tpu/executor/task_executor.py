@@ -189,7 +189,8 @@ class Heartbeater(threading.Thread):
                         try:
                             self._on_fatal()
                         except Exception:  # noqa: BLE001
-                            pass
+                            LOG.debug("on_fatal hook failed before exit",
+                                      exc_info=True)
                     os._exit(C.EXIT_HEARTBEAT_FAILURE)
 
 

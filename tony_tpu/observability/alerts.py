@@ -518,7 +518,10 @@ class FileSink:
 
     def deliver(self, payload: dict) -> bool:
         try:
-            line = json.dumps(payload, sort_keys=True)
+            # defense in depth: the engine redacts before enqueue, but the
+            # sink is the egress boundary — re-redacting is idempotent and
+            # keeps the invariant local (tonylint: redact-on-egress)
+            line = json.dumps(redact_payload(payload), sort_keys=True)
             with self._lock, open(self.path, "a", encoding="utf-8") as f:
                 f.write(line + "\n")
             return True
@@ -545,7 +548,9 @@ class WebhookSink:
 
     def deliver(self, payload: dict) -> bool:
         import urllib.request
-        data = json.dumps(payload, sort_keys=True).encode("utf-8")
+        # defense in depth at the egress boundary (see FileSink.deliver)
+        data = json.dumps(redact_payload(payload),
+                          sort_keys=True).encode("utf-8")
         for attempt in range(self.retries + 1):
             try:
                 req = urllib.request.Request(

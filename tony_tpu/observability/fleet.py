@@ -225,13 +225,13 @@ class FleetRegistry:
         self._max_jobs = max(1, int(max_jobs))
         self._refresh_interval_s = max(0.0, refresh_interval_ms / 1000.0)
         self._clock = clock
-        self._jobs: dict[str, dict] = {}
+        self._jobs: dict[str, dict] = {}  # guarded-by: _lock
         # app ids whose NON-LOST terminal state has been observed: their
         # jobstate files are immutable, so the scan never refetches them
         # — even after the bounded job map evicts the entry itself.
         # Ids only (bytes per job), insertion-ordered, capped well above
         # the job bound; falling off the memo merely costs a refetch.
-        self._settled: dict[str, bool] = {}
+        self._settled: dict[str, bool] = {}  # guarded-by: _lock
         self._settled_cap = max(1000, 50 * self._max_jobs)
         self._last_refresh = 0.0
         from tony_tpu.observability.metrics import TimeSeries
@@ -267,6 +267,7 @@ class FleetRegistry:
             # scan must stay O(n), not O(n²))
             self._evict_locked()
 
+    # holds: _lock (the _locked suffix is the caller contract)
     def _demote_and_evict_locked(self) -> None:
         now_ms = int(self._clock() * 1000)
         for job in self._jobs.values():
@@ -277,6 +278,7 @@ class FleetRegistry:
                 job["demoted_ms"] = now_ms
         self._evict_locked()
 
+    # holds: _lock (the _locked suffix is the caller contract)
     def _evict_locked(self) -> None:
         while len(self._jobs) > self._max_jobs:
             # one victim per overflow: non-live first, then oldest

@@ -150,11 +150,15 @@ class MetricsRegistry:
     (name, labels). Rendered to Prometheus families or a JSON snapshot."""
 
     def __init__(self):
-        self._metrics: dict[tuple, _Metric] = {}
+        self._metrics: dict[tuple, _Metric] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _get(self, cls, name: str, labels: dict):
         key = (name, _label_key(labels))
+        # lock-free fast path: after first creation every caller hits this
+        # read; dict get on an existing key is safe under the GIL and the
+        # slow path below re-checks under the lock
+        # tony: disable=guarded-by -- double-checked create-on-first-use
         m = self._metrics.get(key)
         if m is None:
             with self._lock:

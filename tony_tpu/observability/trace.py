@@ -22,6 +22,7 @@ way — tracing must never become the memory leak it exists to find.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
@@ -30,6 +31,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Union
 
 from tony_tpu.observability.metrics import REGISTRY
+
+LOG = logging.getLogger(__name__)
 
 # env contract (rendered by the AM / executor, read by children)
 TRACE_ID_ENV = "TONY_TRACE_ID"
@@ -166,7 +169,7 @@ class SpanRecorder:
             try:
                 sink([d])
             except Exception:  # noqa: BLE001 — tracing never fails the host
-                pass
+                LOG.debug("span sink failed", exc_info=True)
             return
         with self._lock:
             if len(self._finished) >= self._max:

@@ -150,33 +150,34 @@ class TonySession:
         self.training_finished = False    # failure short-circuit flag
         self.final_status = FinalStatus.UNDEFINED
         self.final_message: Optional[str] = None
-        self._registered: dict[str, str] = {}   # task_id -> host:port
+        self._registered: dict[str, str] = {}  # task_id -> host:port  # guarded-by: _lock
         # cluster-spec generation: bumped whenever a task's registration is
         # invalidated for relaunch. Executors compare it against the
         # generation their running spec came from; a newer generation means
         # "re-enter the rendezvous barrier" (without restarting containers).
-        self.spec_generation = 1
+        self.spec_generation = 1  # guarded-by: _lock
         # coalesced control plane: the rendered cluster-spec JSON is cached
         # per (generation, registration state) — barrier release and
         # get_cluster_spec serve the SAME string to every caller instead of
         # an O(width) json.dumps per poll. Invalidation points: any
         # registration change and every generation bump.
-        self._spec_cache: Optional[str] = None
+        self._spec_cache: Optional[str] = None  # guarded-by: _lock
         # generation -> task_ids whose registration was invalidated at the
         # bump TO that generation (the diff material); bounded to
         # SPEC_DIFF_WINDOW bumps
-        self._gen_changes: OrderedDict[int, set[str]] = OrderedDict()
+        self._gen_changes: OrderedDict[int, set[str]] = OrderedDict()  # guarded-by: _lock
         # from_generation -> (rendered diff dict, serialized byte size)
         # for the CURRENT generation (cleared with the spec cache)
-        self._diff_cache: dict[int, tuple[dict, int]] = {}
+        self._diff_cache: dict[int, tuple[dict, int]] = {}  # guarded-by: _lock
         # tasks that re-registered at a NEW host:port without a relaunch
         # (no generation bump): folded into the next bump's diff material
         # so survivors patching by diff still pick up the rebind
-        self._pending_rebinds: set[str] = set()
+        self._pending_rebinds: set[str] = set()  # guarded-by: _lock
         # control-plane self-accounting (the bench's spec_bytes_sent and
         # the chaos e2e's zero-full-refetch assertion read these):
         # renders = distinct O(width) json.dumps calls; full/diff serves
         # count payloads actually handed to a caller.
+        # guarded-by: _lock
         self.spec_stats = {"renders": 0, "full_serves": 0, "full_bytes": 0,
                            "diff_serves": 0, "diff_bytes": 0}
         self._lock = threading.RLock()
@@ -217,6 +218,10 @@ class TonySession:
     # ------------------------------------------------------------------
     # rendezvous
     # ------------------------------------------------------------------
+    # inner primitive: the only RPC entry is
+    # register_worker_spec_with_generation below, which fences the attempt
+    # under the same lock acquisition before delegating here
+    # tony: disable=attempt-fencing -- fenced by the _with_generation wrapper
     def register_worker_spec(self, task_id: str, host_port: str) -> Optional[str]:
         """Record a worker's host:port. Returns the full cluster-spec JSON once
         ALL expected tasks have registered, else None — the gang barrier
@@ -328,6 +333,7 @@ class TonySession:
                 self.spec_stats["renders"] += 1
             return self._spec_cache
 
+    # holds: _lock (every caller invalidates under the session lock)
     def _invalidate_spec_cache(self) -> None:
         self._spec_cache = None
         self._diff_cache.clear()
@@ -403,14 +409,19 @@ class TonySession:
           current generation and the window covers it;
         - spec_refetch: the executor's generation fell outside the diff
           window — it must fall back to a full fetch."""
-        fields = {"spec_ready": self.all_tasks_registered()}
-        if 0 < exec_generation < self.spec_generation:
-            diff, refetch = self.spec_diff_since(exec_generation)
-            if diff is not None:
-                fields["spec_diff"] = diff
-            elif refetch:
-                fields["spec_refetch"] = True
-        return fields
+        # under the session lock (RLock): the generation read and the
+        # diff render must see one consistent state — an unlocked read
+        # here raced relaunch_task's bump+invalidate (caught by tonylint's
+        # guarded-by pass)
+        with self._lock:
+            fields = {"spec_ready": self.all_tasks_registered()}
+            if 0 < exec_generation < self.spec_generation:
+                diff, refetch = self.spec_diff_since(exec_generation)
+                if diff is not None:
+                    fields["spec_diff"] = diff
+                elif refetch:
+                    fields["spec_refetch"] = True
+            return fields
 
     def note_full_serve(self, spec: str) -> None:
         """Account a full O(width) spec payload handed to a caller outside

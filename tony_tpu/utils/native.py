@@ -43,6 +43,9 @@ def native_binary(name: str) -> Optional[str]:
             _build_failed = True
             return None
         try:
+            # serializing the one-time native build IS this lock's
+            # purpose; no control-plane path shares it
+            # tony: disable=no-blocking-under-lock -- build lock, not control plane
             subprocess.run(["make", "-s"], cwd=NATIVE_DIR, check=True,
                            capture_output=True, timeout=120)
         except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:

@@ -15,6 +15,7 @@ import time
 
 VERSION = "0.1.0"
 
+# tony: disable=config-key-registry -- metadata-stamp prefix, not a conf key
 _KEY_PREFIX = "tony.version"
 
 

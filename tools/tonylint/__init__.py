@@ -1,0 +1,45 @@
+"""tonylint — project-specific static analysis for TonY-TPU's
+control-plane invariants (lock discipline, attempt fencing, config-key
+registry, redaction on egress, thread hygiene, + the migrated legacy
+checks). See docs/STATIC_ANALYSIS.md for the rule catalog.
+
+Run:  python -m tools.tonylint [tony_tpu/] [--changed] [--json]
+Test: tests/test_lint.py runs the same engine in-process (tier-1).
+"""
+
+from tools.tonylint.engine import (Finding, Project, Report, Rule,
+                                   apply_baseline, lint_repo, load_baseline,
+                                   run_rules, save_baseline)
+from tools.tonylint.rules import default_rules
+
+__all__ = ["Finding", "Project", "Report", "Rule", "apply_baseline",
+           "default_rules", "findings_for", "lint_repo", "load_baseline",
+           "repo_root", "run_rules", "save_baseline"]
+
+import functools as _functools
+import os as _os
+
+
+def repo_root() -> str:
+    return _os.path.dirname(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+
+
+@_functools.lru_cache(maxsize=1)
+def _repo_report() -> Report:
+    """One shared full-rule pass over the repo at HEAD. The four
+    migrated wrapper tests each ask for one rule id; without the cache
+    each call would re-parse all ~110 files (~0.6 s apiece of identical
+    tier-1 work). Runs WITHOUT the baseline: the wrappers are the
+    tier-1 hard assertions the pre-migration regex checks were — a
+    baseline entry must not be able to satisfy them."""
+    return lint_repo(repo_root(), baseline_path=_os.devnull)
+
+
+def findings_for(*rule_ids: str) -> list[str]:
+    """Rendered findings of the named rule(s) over the repo at HEAD —
+    the one-line wrapper surface the migrated legacy tests call
+    (tests/test_logs.py, tests/test_fleet.py, tests/test_alerts.py)."""
+    wanted = set(rule_ids)
+    return [f.render() for f in _repo_report().findings
+            if f.rule in wanted]

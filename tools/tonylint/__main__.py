@@ -1,0 +1,102 @@
+"""CLI: python -m tools.tonylint [paths...] [options]
+
+Exit codes: 0 clean (new findings == 0 and baseline not stale),
+1 findings / stale baseline, 2 usage error. The nonzero-on-new-findings
+contract makes it gate-able exactly like tools/bench_compare.py.
+
+Pre-commit fast path:
+    python -m tools.tonylint --changed
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from tools.tonylint import default_rules, lint_repo, repo_root, save_baseline
+from tools.tonylint.engine import BASELINE_FILE, GitError
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.tonylint",
+        description="TonY-TPU control-plane static analysis "
+                    "(docs/STATIC_ANALYSIS.md)")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="package dirs/files to scan (default: tony_tpu)")
+    parser.add_argument("--changed", action="store_true",
+                        help="per-file rules only visit files touched per "
+                             "git (project-wide rules always run)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--rules", default="",
+                        help="comma list of rule ids to run (default: all)")
+    parser.add_argument("--list", action="store_true", dest="list_rules",
+                        help="list rule ids and exit")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: {BASELINE_FILE})")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to the current findings "
+                             "(add one-line justifications by hand; it may "
+                             "only shrink afterwards)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: autodetected)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.id:24s} {rule.description}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    packages = [p.rstrip("/") for p in args.paths] or ["tony_tpu"]
+    wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+    rule_filter = (lambda r: r.id in wanted) if wanted else None
+
+    if args.update_baseline and (args.changed or wanted or args.paths):
+        # a subset scan would overwrite the baseline with only the
+        # subset's buckets, silently deleting every other file's /
+        # rule's accepted debt (a positional path is the same subset
+        # trap as --changed/--rules)
+        print("tonylint: --update-baseline rewrites the WHOLE baseline "
+              "and needs a full default scan — drop --changed/--rules/"
+              "paths", file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    try:
+        if args.update_baseline:
+            # run WITHOUT a baseline so every finding lands in the new one
+            report = lint_repo(root, packages=packages, changed=False,
+                               baseline_path=os.devnull,
+                               rule_filter=rule_filter)
+            path = args.baseline or os.path.join(root, BASELINE_FILE)
+            save_baseline(path, report.findings)
+            print(f"baseline written: {path} "
+                  f"({len(report.findings)} entr(y/ies))")
+            return 0
+
+        report = lint_repo(root, packages=packages, changed=args.changed,
+                           baseline_path=args.baseline,
+                           rule_filter=rule_filter)
+    except GitError as exc:
+        # never report "clean" because git failed — the pre-commit gate
+        # must fail loudly, not check zero files
+        print(f"tonylint: {exc}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - t0
+    if args.as_json:
+        payload = report.to_dict()
+        payload["elapsed_s"] = round(elapsed, 3)
+        print(json.dumps(payload, indent=2))
+    else:
+        print(report.render())
+        print(f"({elapsed:.2f}s)")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,456 @@
+"""tonylint engine: rule registry, file model, suppressions, baseline.
+
+TonY's control plane earns its reliability from conventions the compiler
+never checks — attempt-fenced RPC mutations, lock-guarded shared state on
+the AM/session/liveliness hot paths, `redact()` on every egress, a
+`tony.*` config registry that must stay in sync with its docs. This
+module is the machinery those conventions are enforced with; the rules
+themselves live in the sibling ``rules_*`` modules.
+
+Design points:
+
+- Files are parsed ONCE (``ast`` + ``tokenize``) into :class:`PyFile`;
+  every rule shares the parse. The whole-repo pass must stay inside the
+  tier-1 test budget (<10 s — it is a test, tests/test_lint.py).
+- Suppression is per line: ``# tony: disable=<rule-id>[,<rule-id>...]``
+  on the offending line or the line directly above, optionally followed
+  by ``-- <justification>``. Rule authors never special-case call sites;
+  the justification lives next to the code it excuses.
+- The baseline (tools/lint_baseline.json) may only shrink: a finding
+  count above its entry fails the run (new debt), and an entry above the
+  actual count ALSO fails the run (stale — shrink the file). An empty
+  baseline is the steady state.
+- ``--changed`` restricts per-file rules to files touched per git;
+  project-wide rules (registry/coverage checks) always run — they are
+  cross-file by nature and cheap.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import subprocess
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+# comment grammars (shared by the engine and several rules)
+DISABLE_RE = re.compile(r"tony:\s*disable=([a-z0-9_,\-*]+)")
+GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+HOLDS_RE = re.compile(r"holds:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+BASELINE_FILE = os.path.join("tools", "lint_baseline.json")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline bucket: line numbers drift under unrelated edits, so
+        baselined debt is counted per (file, rule), not per line."""
+        return f"{self.path}::{self.rule}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class PyFile:
+    """One parsed source file: AST + per-line comments + suppressions."""
+
+    def __init__(self, root: str, relpath: str, source: str):
+        self.root = root
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        # line -> comment text (sans '#'), via tokenize so strings that
+        # merely contain '#' are never misread as comments
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except tokenize.TokenError:
+            pass
+        # line -> set of disabled rule ids ('*' disables everything)
+        self.suppressions: dict[int, set[str]] = {}
+        for line, text in self.comments.items():
+            m = DISABLE_RE.search(text)
+            if m:
+                ids = {part.strip() for part in m.group(1).split(",")
+                       if part.strip()}
+                self.suppressions[line] = ids
+
+    def comment_near(self, line: int, back: int = 1) -> str:
+        """The comment on `line` plus up to `back` lines above, joined —
+        the print-ban's legacy `log-ok` escape looks 2 lines back."""
+        parts = [self.comments.get(n, "")
+                 for n in range(max(1, line - back), line + 1)]
+        return " ".join(p for p in parts if p)
+
+    def is_comment_line(self, line: int) -> bool:
+        if not (1 <= line <= len(self.lines)):
+            return False
+        return self.lines[line - 1].lstrip().startswith("#")
+
+    def annotation_at(self, line: int) -> str:
+        """Comment attached to the statement starting at `line`: its own
+        trailing comment, or a comment-ONLY line directly above. A
+        trailing comment of the PREVIOUS statement never leaks down."""
+        parts = [self.comments.get(line, "")]
+        if self.is_comment_line(line - 1):
+            parts.insert(0, self.comments.get(line - 1, ""))
+        return " ".join(p for p in parts if p)
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        candidates = [line]
+        if self.is_comment_line(line - 1):
+            candidates.append(line - 1)
+        for n in candidates:
+            ids = self.suppressions.get(n)
+            if ids and (rule_id in ids or "*" in ids):
+                return True
+        return False
+
+
+class Project:
+    """The unit a lint run sees: every parsed file under the scanned
+    package root(s), plus read access to sibling files (docs, conf)."""
+
+    def __init__(self, root: str, rel_files: Iterable[str],
+                 sources: Optional[dict[str, str]] = None):
+        self.root = root
+        self.files: list[PyFile] = []
+        self.errors: list[Finding] = []
+        # per-file rules in --changed mode only visit this subset;
+        # project-wide rules always see .files in full
+        self.changed_only: Optional[set[str]] = None
+        for rel in sorted(set(rel_files)):
+            try:
+                if sources is not None and rel in sources:
+                    src = sources[rel]
+                else:
+                    with open(os.path.join(root, rel), "r",
+                              encoding="utf-8") as f:
+                        src = f.read()
+                self.files.append(PyFile(root, rel, src))
+            except (OSError, SyntaxError, ValueError) as exc:
+                self.errors.append(Finding(
+                    "parse-error", rel.replace(os.sep, "/"), 1,
+                    f"could not parse: {exc}"))
+
+    def scan_files(self) -> list[PyFile]:
+        """Files a PER-FILE rule should visit (honors --changed)."""
+        if self.changed_only is None:
+            return self.files
+        return [pf for pf in self.files if pf.relpath in self.changed_only]
+
+    def file(self, relpath: str) -> Optional[PyFile]:
+        rel = relpath.replace(os.sep, "/")
+        for pf in self.files:
+            if pf.relpath == rel:
+                return pf
+        return None
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        try:
+            with open(os.path.join(self.root, relpath), "r",
+                      encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+class Rule:
+    """Base rule. Subclasses set `id`/`description` and implement
+    `run(project)`. `project_wide` rules ignore --changed restriction
+    (cross-file registry/coverage checks — they are cheap and a change
+    anywhere can break them)."""
+
+    id: str = ""
+    description: str = ""
+    project_wide: bool = False
+
+    def run(self, project: Project) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    # convenience for per-file AST rules
+    def files(self, project: Project) -> list[PyFile]:
+        return project.files if self.project_wide else project.scan_files()
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by rules
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'time.sleep' for Attribute chains, 'sleep' for bare Names, ''
+    otherwise. Subscripts are transparent (self._locks[i] -> self._locks)."""
+    parts: list[str] = []
+    while True:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return ".".join(reversed(parts)) if parts else ""
+
+
+def iter_class_defs(tree: ast.Module) -> Iterable[ast.ClassDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def iter_functions(node: ast.AST) -> Iterable[ast.FunctionDef]:
+    for child in ast.walk(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield child
+
+
+def is_trivial_body(fn: ast.FunctionDef) -> bool:
+    """Docstring-only / pass / Ellipsis — an abstract declaration, not an
+    implementation (rpc/service.py's handler interfaces)."""
+    for stmt in fn.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        if isinstance(stmt, ast.Raise):  # raise NotImplementedError
+            continue
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> dict[str, dict]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return dict(data.get("entries", {}))
+
+
+def save_baseline(path: str, findings: list[Finding],
+                  why: str = "baselined at introduction") -> None:
+    """Rewrite the baseline to the current findings. A surviving
+    bucket keeps its hand-written `why` — the documented workflow adds
+    justifications by hand after generation, and a later legitimate
+    rewrite (debt shrank elsewhere) must not erase them."""
+    existing = load_baseline(path)
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    entries = {key: {"count": n,
+                     "why": existing.get(key, {}).get("why", why)}
+               for key, n in sorted(counts.items())}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: list[Finding], baseline: dict[str, dict],
+                   judgeable: Optional[Callable[[str], bool]] = None
+                   ) -> tuple[list[Finding], list[str]]:
+    """Split findings into (new, stale-baseline-entries).
+
+    Per (file, rule) bucket: up to `count` findings are accepted debt;
+    any excess is NEW. A bucket whose actual count fell BELOW its entry
+    is STALE — the baseline must shrink with the debt, or deleted debt
+    could silently regrow inside the old budget. `judgeable` limits the
+    stale check to keys the run could actually observe: a --changed or
+    --rules subset run never visited the other buckets, so a zero count
+    there means "not scanned", not "fixed"."""
+    by_key: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_key.setdefault(f.key, []).append(f)
+    new: list[Finding] = []
+    stale: list[str] = []
+    for key, fs in sorted(by_key.items()):
+        budget = int(baseline.get(key, {}).get("count", 0))
+        if len(fs) > budget:
+            new.extend(fs[budget:])
+    for key, entry in sorted(baseline.items()):
+        if judgeable is not None and not judgeable(key):
+            continue
+        actual = len(by_key.get(key, []))
+        if actual < int(entry.get("count", 0)):
+            stale.append(
+                f"{key}: baseline allows {entry.get('count')} but only "
+                f"{actual} remain — shrink tools/lint_baseline.json")
+    return new, stale
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def discover_files(root: str, packages: Iterable[str]) -> list[str]:
+    rels: list[str] = []
+    for pkg in packages:
+        base = os.path.join(root, pkg)
+        if os.path.isfile(base) and base.endswith(".py"):
+            rels.append(os.path.relpath(base, root))
+            continue
+        for dirpath, dirnames, files in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    rels.append(os.path.relpath(
+                        os.path.join(dirpath, name), root))
+    return rels
+
+
+class GitError(RuntimeError):
+    """--changed could not determine the touched set. Raised (never
+    swallowed): a pre-commit gate that silently checks zero files
+    because git failed would pass exactly when it must not."""
+
+
+def changed_files(root: str) -> set[str]:
+    """Root-relative paths touched vs HEAD (staged + unstaged +
+    untracked) — the `--changed` pre-commit fast path. `--relative`
+    makes diff paths relative to `root` (not the git toplevel), so a
+    project nested below the toplevel still matches its relpaths —
+    otherwise the gate would silently check zero files and pass."""
+    out: set[str] = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--relative", "HEAD", "--"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError) as exc:
+        raise GitError(f"git unavailable for --changed: {exc}") from exc
+    for proc in (diff, untracked):
+        if proc.returncode != 0:
+            err = (proc.stderr.strip() or "no output").splitlines()[0]
+            raise GitError(
+                f"git failed for --changed (rc={proc.returncode}): {err}")
+        out |= {line.strip().replace(os.sep, "/")
+                for line in proc.stdout.splitlines() if line.strip()}
+    return out
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)   # new (unbaselined)
+    baselined: int = 0
+    stale_baseline: list[str] = field(default_factory=list)
+    suppressed: int = 0
+    checked_files: int = 0
+    rules: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.stale_baseline
+
+    def to_dict(self) -> dict:
+        return {"ok": self.ok,
+                "findings": [f.to_dict() for f in self.findings],
+                "baselined": self.baselined,
+                "stale_baseline": self.stale_baseline,
+                "suppressed": self.suppressed,
+                "checked_files": self.checked_files,
+                "rules": self.rules}
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines += [f"stale baseline: {s}" for s in self.stale_baseline]
+        status = "clean" if self.ok else f"{len(self.findings)} finding(s)"
+        if self.stale_baseline:
+            status += f", {len(self.stale_baseline)} stale baseline entr(y/ies)"
+        lines.append(
+            f"tonylint: {status} over {self.checked_files} file(s) "
+            f"({self.suppressed} suppressed, {self.baselined} baselined)")
+        return "\n".join(lines)
+
+
+def run_rules(project: Project, rules: list[Rule],
+              baseline: Optional[dict[str, dict]] = None) -> Report:
+    report = Report(rules=[r.id for r in rules],
+                    checked_files=len(project.files))
+    raw: list[Finding] = list(project.errors)
+    for rule in rules:
+        try:
+            found = list(rule.run(project))
+        except Exception as exc:  # noqa: BLE001 — a crashed rule (e.g. a
+            # registry rule importing a syntax-broken live module) must
+            # surface as a finding in the report, never as a traceback
+            # that eats the report for --json consumers / pre-commit
+            raw.append(Finding(
+                rule.id, f"<rule:{rule.id}>", 1,
+                f"rule crashed: {exc!r} — fix the rule or the tree it "
+                f"inspects"))
+            continue
+        for finding in found:
+            pf = project.file(finding.path)
+            if pf is not None and pf.is_suppressed(finding.rule, finding.line):
+                report.suppressed += 1
+                continue
+            raw.append(finding)
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+    if baseline:
+        rule_by_id = {r.id: r for r in rules}
+
+        def judgeable(key: str) -> bool:
+            path, _, rule_id = key.rpartition("::")
+            rule = rule_by_id.get(rule_id)
+            if rule is None:     # rule not in this run (--rules subset)
+                return False
+            if (project.changed_only is not None and not rule.project_wide
+                    and path not in project.changed_only):
+                return False     # per-file rule never visited this file
+            return True
+
+        new, stale = apply_baseline(raw, baseline, judgeable)
+        report.baselined = len(raw) - len(new)
+        report.findings = new
+        report.stale_baseline = stale
+    else:
+        report.findings = raw
+    return report
+
+
+def lint_repo(root: str, rules: Optional[list[Rule]] = None,
+              packages: Iterable[str] = ("tony_tpu",),
+              changed: bool = False,
+              baseline_path: Optional[str] = None,
+              rule_filter: Optional[Callable[[Rule], bool]] = None) -> Report:
+    """The one entry point the CLI, the tier-1 test, and the migrated
+    legacy-check wrappers all share."""
+    from tools.tonylint.rules import default_rules
+    rules = list(rules if rules is not None else default_rules())
+    if rule_filter is not None:
+        rules = [r for r in rules if rule_filter(r)]
+    project = Project(root, discover_files(root, packages))
+    if changed:
+        project.changed_only = changed_files(root)
+    baseline = load_baseline(
+        baseline_path if baseline_path is not None
+        else os.path.join(root, BASELINE_FILE))
+    return run_rules(project, rules, baseline)

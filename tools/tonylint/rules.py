@@ -1,0 +1,29 @@
+"""The shipped rule set. Order is display order in `--list`."""
+
+from __future__ import annotations
+
+from tools.tonylint.engine import Rule
+from tools.tonylint.rules_conf import ConfigKeyRegistryRule
+from tools.tonylint.rules_legacy import (AlertHotLoopRule,
+                                         AlertRuleRegistryRule,
+                                         GaugeRegistryRule, PrintBanRule,
+                                         RendererCoverageRule)
+from tools.tonylint.rules_locks import GuardedByRule, NoBlockingUnderLockRule
+from tools.tonylint.rules_rpc import AttemptFencingRule, RedactOnEgressRule
+from tools.tonylint.rules_threads import ThreadHygieneRule
+
+
+def default_rules() -> list[Rule]:
+    return [
+        GuardedByRule(),
+        NoBlockingUnderLockRule(),
+        AttemptFencingRule(),
+        RedactOnEgressRule(),
+        ConfigKeyRegistryRule(),
+        ThreadHygieneRule(),
+        PrintBanRule(),
+        GaugeRegistryRule(),
+        RendererCoverageRule(),
+        AlertRuleRegistryRule(),
+        AlertHotLoopRule(),
+    ]

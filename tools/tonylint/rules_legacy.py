@@ -1,0 +1,218 @@
+"""Rules migrated from the three scattered regex checks that predate
+tonylint (tests/test_logs.py, tests/test_fleet.py, tests/test_alerts.py).
+The tests still exist as one-line wrappers over these rules, so tier-1
+coverage is unchanged — the implementation just moved where scopes and
+suppressions exist.
+
+- print-ban: control-plane processes log through observability/logs.py
+  so records carry the {app_id, task, attempt, trace_id} stamp; a bare
+  print() bypasses it. Deliberate raw-stdout markers keep their legacy
+  `log-ok:` escape (line or two lines above).
+- gauge-registry: every tony_job_* gauge the AM exports must be a key
+  of fleet.JOB_GAUGES (else fleet /metrics silently drops it), and
+  gauge names must be literals, never f-strings.
+- renderer-coverage: every events.schema.EventType has a renderer that
+  produces text even on an empty payload.
+- alert-rule-registry: every quoted built-in rule-id literal resolves in
+  alerts.BUILTIN_RULES (no silently-dead rules).
+- alert-hot-loop: the alert engine may only run on the AM monitor /
+  portal fleet-scan cadences — hot-loop modules must not import it, and
+  the two sanctioned call sites must exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from tools.tonylint.engine import Finding, Project, PyFile, Rule
+
+PRINT_BAN_DIRS = ("tony_tpu/am/", "tony_tpu/executor/", "tony_tpu/rpc/",
+                  "tony_tpu/portal/", "tony_tpu/serve/")
+
+AM_FILE = "tony_tpu/am/application_master.py"
+FLEET_FILE = "tony_tpu/observability/fleet.py"
+RENDER_FILE = "tony_tpu/events/render.py"
+ALERTS_FILE = "tony_tpu/observability/alerts.py"
+GAUGE_RE = re.compile(r"^tony_job_[a-z0-9_]+$")
+RULE_ID_RE = re.compile(r"^(?:train|serve|fleet)\.[a-z0-9_]+$")
+ALERT_RULE_SOURCES = (AM_FILE, "tony_tpu/portal/server.py",
+                      "tony_tpu/portal/__main__.py",
+                      "tony_tpu/cli/__main__.py", ALERTS_FILE, FLEET_FILE)
+ALERT_HOT_PATHS = ("tony_tpu/train/", "tony_tpu/executor/",
+                   "tony_tpu/serve/engine.py", "tony_tpu/serve/frontend.py",
+                   "tony_tpu/serve/__main__.py")
+
+
+class PrintBanRule(Rule):
+    id = "print-ban"
+    description = ("no bare print() in control-plane modules — use the "
+                   "structured logger, or tag a deliberate stdout marker "
+                   "with `log-ok:`")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            if not pf.relpath.startswith(PRINT_BAN_DIRS):
+                continue
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    if "log-ok" in pf.comment_near(node.lineno, back=2):
+                        continue
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        "bare print() in a control-plane module — log "
+                        "through observability/logs.py (or tag a "
+                        "deliberate marker with a `log-ok:` comment)")
+
+
+class GaugeRegistryRule(Rule):
+    id = "gauge-registry"
+    description = ("AM tony_job_* gauge literals must be keys of "
+                   "fleet.JOB_GAUGES, and never f-string-assembled")
+    project_wide = True
+
+    def __init__(self, job_gauges: Optional[set] = None,
+                 step_time_gauges: Optional[dict] = None):
+        # injectable for fixture tests; defaults import the live tables
+        self._job_gauges = job_gauges
+        self._step_time_gauges = step_time_gauges
+
+    def _tables(self) -> tuple[set, dict]:
+        if self._job_gauges is not None:
+            return set(self._job_gauges), dict(self._step_time_gauges or {})
+        from tony_tpu.observability import fleet
+        return set(fleet.JOB_GAUGES), dict(fleet.STEP_TIME_GAUGES)
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        pf = project.file(AM_FILE)
+        if pf is None:
+            return
+        job_gauges, step_time = self._tables()
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if GAUGE_RE.match(node.value) \
+                        and node.value not in job_gauges:
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        f'"{node.value}" is exported by the AM but not '
+                        f"aggregated by fleet.JOB_GAUGES — the fleet "
+                        f"/metrics would silently drop it")
+            elif isinstance(node, ast.JoinedStr):
+                if any(isinstance(p, ast.Constant)
+                       and "tony_job_" in str(p.value)
+                       for p in node.values):
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        "f-string-assembled tony_job_* gauge name — "
+                        "register a literal in fleet.JOB_GAUGES instead "
+                        "(fleet.STEP_TIME_GAUGES exists for this)")
+        extra = set(step_time.values()) - job_gauges
+        if extra:
+            yield Finding(
+                self.id, FLEET_FILE, 1,
+                f"fleet.STEP_TIME_GAUGES values missing from "
+                f"fleet.JOB_GAUGES: {sorted(extra)}")
+
+
+class RendererCoverageRule(Rule):
+    id = "renderer-coverage"
+    description = ("every events.schema.EventType has a renderer that "
+                   "produces text on an empty payload")
+    project_wide = True
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        if project.file(RENDER_FILE) is None:
+            return
+        from tony_tpu.events.render import RENDERERS, render_event
+        from tony_tpu.events.schema import EventType
+        for etype in EventType:
+            if etype not in RENDERERS:
+                yield Finding(
+                    self.id, RENDER_FILE, 1,
+                    f"event type {etype.value} has no renderer — the "
+                    f"portal/CLI timeline would show raw payload dicts")
+                continue
+            try:
+                ok = bool(render_event(etype.value, {}))
+            except Exception as exc:  # noqa: BLE001 — the finding IS the report
+                yield Finding(
+                    self.id, RENDER_FILE, 1,
+                    f"renderer for {etype.value} raised on an empty "
+                    f"payload: {exc!r}")
+                continue
+            if not ok:
+                yield Finding(
+                    self.id, RENDER_FILE, 1,
+                    f"renderer for {etype.value} returns empty text on an "
+                    f"empty payload")
+
+
+class AlertRuleRegistryRule(Rule):
+    id = "alert-rule-registry"
+    description = ("every quoted built-in alert rule-id literal must be a "
+                   "key of alerts.BUILTIN_RULES (no silently-dead rules)")
+    project_wide = True
+
+    def __init__(self, builtin_rules: Optional[set] = None):
+        self._builtin = builtin_rules
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        if self._builtin is not None:
+            builtin = set(self._builtin)
+        else:
+            if project.file(ALERTS_FILE) is None:
+                return
+            from tony_tpu.observability.alerts import BUILTIN_RULES
+            builtin = set(BUILTIN_RULES)
+        for rel in ALERT_RULE_SOURCES:
+            pf = project.file(rel)
+            if pf is None:
+                continue
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and RULE_ID_RE.match(node.value) \
+                        and node.value not in builtin:
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        f'rule-id literal "{node.value}" is not registered '
+                        f"in alerts.BUILTIN_RULES — no engine would ever "
+                        f"evaluate it (silently dead)")
+
+
+class AlertHotLoopRule(Rule):
+    id = "alert-hot-loop"
+    description = ("the alert engine runs only on the AM monitor / portal "
+                   "fleet-scan cadence — hot-loop modules must not reach it")
+    project_wide = True
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        am = project.file(AM_FILE)
+        fleet = project.file(FLEET_FILE)
+        if am is None or fleet is None:
+            return
+        for pf in project.files:
+            if not (pf.relpath.startswith(ALERT_HOT_PATHS[:2])
+                    or pf.relpath in ALERT_HOT_PATHS[2:]):
+                continue
+            for marker in ("observability.alerts", "AlertEngine",
+                           "import alerts"):
+                if marker in pf.source:
+                    yield Finding(
+                        self.id, pf.relpath, 1,
+                        f"hot-loop module references {marker!r} — alert "
+                        f"evaluation must stay on the monitor/fleet-scan "
+                        f"cadence")
+                    break
+        # positive controls: the two sanctioned evaluate() call sites
+        if "_check_alerts" not in am.source:
+            yield Finding(self.id, AM_FILE, 1,
+                          "sanctioned AM call site _check_alerts is gone — "
+                          "alert evaluation lost its monitor-cadence home")
+        if "alert_engine.evaluate" not in fleet.source:
+            yield Finding(self.id, FLEET_FILE, 1,
+                          "sanctioned fleet call site alert_engine.evaluate "
+                          "is gone — fleet-scope rules are never evaluated")

@@ -1,0 +1,239 @@
+"""Lock-discipline rules: guarded-by and no-blocking-under-lock.
+
+The control plane's shared state (session task table, AM bookkeeping
+dicts, liveliness shards, metrics stores) is protected by per-object
+``threading.Lock``/``RLock`` fields by convention — PR 11's
+``note_full_serve`` fix was exactly a missed-lock increment caught late
+in review. These rules turn the convention into a checked annotation:
+
+``# guarded-by: _lock`` on the attribute's assignment line declares
+that, within the class, every other read/write of ``self.<attr>`` must
+sit lexically inside ``with self._lock`` (subscripted lock tables like
+``with self._locks[idx]`` match their ``_locks`` attribute). A method
+whose ``def`` line carries ``# holds: _lock`` is treated as entered
+with the lock already held (documented caller contract, e.g. the AM's
+``_close_relaunch_downtime``).
+
+``no-blocking-under-lock`` flags calls that sleep or do I/O while a
+``with <...lock...>`` body is open — the liveliness sweep, heartbeat
+handlers, and monitor loop all contend on these locks, so one
+``time.sleep`` under them stalls W tasks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from tools.tonylint.engine import (Finding, GUARDED_BY_RE, HOLDS_RE, Project,
+                                   PyFile, Rule, dotted_name, iter_class_defs)
+
+# dirs whose shared state carries guarded-by annotations (ISSUE scope:
+# the AM/session/liveliness hot paths + the observability stores the
+# monitor loop and RPC handlers share; executor has its own small locks)
+GUARDED_DIRS = ("tony_tpu/session/", "tony_tpu/am/", "tony_tpu/observability/",
+                "tony_tpu/executor/")
+
+# fully-qualified calls that block: sleeping, subprocess, sockets, HTTP
+BLOCKING_DOTTED = {
+    "time.sleep", "sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "socket.create_connection",
+    "urllib.request.urlopen", "urlopen",
+}
+# method names that are RPC / process-control round-trips regardless of
+# receiver (backend container ops fork/TERM processes; the cluster/metrics
+# client methods are network RPCs with retries)
+BLOCKING_METHODS = {
+    "stop_container", "start_container",
+    "task_executor_heartbeat", "register_execution_result",
+    "register_worker_spec", "update_metrics", "read_task_logs", "read_log",
+    "request_preemption",
+}
+
+
+def _lock_attr_of(expr: ast.AST) -> Optional[str]:
+    """The lock-ish attribute a with-item guards on, or None.
+
+    Matches `self.X` / `self.X[i]` / bare `X` / `X[i]` where the name
+    contains "lock" (``_lock``, ``_locks``, ``_respec_lock``...) —
+    and `threading.Lock()` style inline constructions are ignored.
+
+    A lock reached through ANOTHER object (`self.peer._lock`,
+    `registry._lock`) returns its full dotted path: it still counts as
+    "a lock is held" for no-blocking-under-lock, but a dotted path can
+    never equal a `guarded-by: <attr>` identifier — holding the wrong
+    object's same-named lock must not silence guarded-by."""
+    node = expr
+    if isinstance(node, ast.withitem):
+        node = node.context_expr
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and "lock" in node.attr.lower():
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        return dotted_name(node) or node.attr
+    if isinstance(node, ast.Name) and "lock" in node.id.lower():
+        return node.id
+    return None
+
+
+class _LockTrackingVisitor(ast.NodeVisitor):
+    """Shared traversal: maintains the set of lock attribute names whose
+    `with` body lexically encloses the current node. Nested function
+    definitions reset the held set — a closure runs after the lock is
+    long released."""
+
+    def __init__(self, held: Optional[set[str]] = None):
+        self.held: set[str] = set(held or ())
+
+    def visit_With(self, node: ast.With) -> None:
+        locks = {name for item in node.items
+                 for name in [_lock_attr_of(item)] if name}
+        added = locks - self.held
+        self.held |= added
+        for item in node.items:
+            self.visit(item)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= added
+
+    visit_AsyncWith = visit_With
+
+    def _visit_nested_def(self, node: ast.AST) -> None:
+        saved, self.held = self.held, set()
+        self.generic_visit(node)
+        self.held = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_nested_def(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_nested_def(node)
+
+
+class _GuardedAccessVisitor(_LockTrackingVisitor):
+    def __init__(self, rule_id: str, pf: PyFile, guarded: dict[str, str],
+                 held: set[str], out: list[Finding]):
+        super().__init__(held)
+        self.rule_id = rule_id
+        self.pf = pf
+        self.guarded = guarded
+        self.out = out
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and node.attr in self.guarded):
+            lock = self.guarded[node.attr]
+            if lock not in self.held:
+                self.out.append(Finding(
+                    self.rule_id, self.pf.relpath, node.lineno,
+                    f"self.{node.attr} is `# guarded-by: {lock}` but is "
+                    f"accessed outside `with self.{lock}`"))
+        self.generic_visit(node)
+
+
+class GuardedByRule(Rule):
+    id = "guarded-by"
+    description = ("attributes annotated `# guarded-by: <lock>` may only be "
+                   "read/written inside `with self.<lock>` (method-level "
+                   "`# holds: <lock>` documents a caller-holds contract)")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            if not pf.relpath.startswith(GUARDED_DIRS):
+                continue
+            yield from self._check_file(pf)
+
+    def _check_file(self, pf: PyFile) -> Iterable[Finding]:
+        for cls in iter_class_defs(pf.tree):
+            guarded: dict[str, str] = {}     # attr -> lock attr
+            # collect annotations: `self.X = ... # guarded-by: _lock`
+            # (attribute assignment inside a method, typically __init__)
+            # or a class-level `X = ... / X: T = ...` with the comment
+            for node in ast.walk(cls):
+                if not hasattr(node, "lineno"):
+                    continue
+                # the annotation sits on the assignment line or on its own
+                # comment line directly above (long constructions wrap)
+                m = GUARDED_BY_RE.search(pf.annotation_at(node.lineno))
+                if not m:
+                    continue
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for tgt in targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            guarded[tgt.attr] = m.group(1)
+                        elif isinstance(tgt, ast.Name):
+                            guarded[tgt.id] = m.group(1)
+            if not guarded:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                # only __init__ is exempt (construction precedes sharing);
+                # a method that RE-declares an annotated attribute is
+                # checked like any other — resetting guarded state
+                # without the lock is exactly the bug class this catches
+                if fn.name == "__init__":
+                    continue
+                held: set[str] = set()
+                hm = HOLDS_RE.search(pf.annotation_at(fn.lineno))
+                if hm:
+                    held.add(hm.group(1))
+                out: list[Finding] = []
+                visitor = _GuardedAccessVisitor(self.id, pf, guarded, held,
+                                                out)
+                for stmt in fn.body:
+                    visitor.visit(stmt)
+                yield from out
+
+
+class _BlockingCallVisitor(_LockTrackingVisitor):
+    def __init__(self, rule_id: str, pf: PyFile, out: list[Finding]):
+        super().__init__()
+        self.rule_id = rule_id
+        self.pf = pf
+        self.out = out
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            name = dotted_name(node.func)
+            tail = name.rsplit(".", 1)[-1]
+            parts = name.split(".")
+            # `self.foo()` is a local method, not an RPC — but
+            # `self.backend.stop_container()` / `client.heartbeat()` are
+            remote_method = (tail in BLOCKING_METHODS and len(parts) >= 2
+                             and not (len(parts) == 2 and parts[0] == "self"))
+            blocking = (name in BLOCKING_DOTTED
+                        or (name.startswith(("time.", "subprocess.",
+                                             "socket."))
+                            and tail in {d.rsplit(".", 1)[-1]
+                                         for d in BLOCKING_DOTTED})
+                        or remote_method)
+            if blocking:
+                locks = ", ".join(sorted(self.held))
+                self.out.append(Finding(
+                    self.rule_id, self.pf.relpath, node.lineno,
+                    f"blocking call {name}() inside `with {locks}` — "
+                    f"sleeps/subprocess/RPC must not run under a "
+                    f"control-plane lock"))
+        self.generic_visit(node)
+
+
+class NoBlockingUnderLockRule(Rule):
+    id = "no-blocking-under-lock"
+    description = ("time.sleep / subprocess / socket / RPC round-trips must "
+                   "not execute lexically inside a `with <lock>` body")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            out: list[Finding] = []
+            _BlockingCallVisitor(self.id, pf, out).visit(pf.tree)
+            yield from out

@@ -1,0 +1,141 @@
+"""Control-plane RPC rules: attempt-fencing and redact-on-egress.
+
+attempt-fencing: a task relaunch bumps the slot's attempt; every RPC
+mutation path that a superseded (zombie) executor can still reach must
+compare the caller's attempt against the slot's before mutating —
+otherwise a zombie re-fills the rendezvous barrier it was evicted from,
+keeps the replacement's liveliness entry fresh, or completes the
+replacement with its own stale result (PR 2/11's fencing story). The
+rule requires an ``attempt`` comparison in the named handler bodies.
+
+redact-on-egress: anything that leaves the process boundary toward an
+operator surface — webhook POSTs, sink files, live log-tail chunks —
+must flow through ``logs.redact`` (PR 6/9). The rule finds egress
+functions (urlopen/Request with a payload, ``*Sink`` delivery methods,
+the log-tail readers) and requires a redact call in their bodies.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.tonylint.engine import (Finding, Project, PyFile, Rule,
+                                   dotted_name, is_trivial_body,
+                                   iter_class_defs)
+
+# RPC mutation paths a superseded attempt can reach. These names are the
+# contract: a new fenced handler gets added here when it grows a
+# per-task mutation (see docs/STATIC_ANALYSIS.md).
+FENCED_HANDLERS = (
+    "register_worker_spec",
+    "register_worker_spec_with_generation",
+    "register_execution_result",
+    "task_executor_heartbeat",
+)
+# handler IMPLEMENTATIONS only: rpc/client.py's same-named methods are
+# serialization stubs (they SEND the attempt; the server compares it)
+FENCED_DIRS = ("tony_tpu/am/", "tony_tpu/session/", "tony_tpu/rpc/service.py")
+
+EGRESS_DIRS = ("tony_tpu/",)
+# log-tail payload producers (observability/logs.py): every chunk these
+# return crosses the RPC boundary into CLI/portal output
+LOG_TAIL_READERS = {("LogTail", "read"), ("LogTail", "tail_lines")}
+
+
+def _mentions_attempt(node: ast.AST) -> bool:
+    for child in ast.walk(node):
+        if isinstance(child, ast.Name) and "attempt" in child.id:
+            return True
+        if isinstance(child, ast.Attribute) and "attempt" in child.attr:
+            return True
+    return False
+
+
+class AttemptFencingRule(Rule):
+    id = "attempt-fencing"
+    description = ("RPC handlers that mutate per-task state "
+                   f"({', '.join(FENCED_HANDLERS)}) must compare an "
+                   "`attempt` before mutating")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            if not pf.relpath.startswith(FENCED_DIRS):
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name not in FENCED_HANDLERS:
+                    continue
+                if is_trivial_body(node):
+                    continue  # abstract interface declaration
+                fenced = any(
+                    isinstance(child, ast.Compare)
+                    and _mentions_attempt(child)
+                    for child in ast.walk(node))
+                if not fenced:
+                    yield Finding(
+                        self.id, pf.relpath, node.lineno,
+                        f"{node.name}() mutates per-task state but never "
+                        f"compares an attempt — a superseded (zombie) "
+                        f"executor could mutate the replacement's slot")
+
+
+def _calls_redact(fn: ast.AST) -> bool:
+    for child in ast.walk(fn):
+        if isinstance(child, ast.Call):
+            name = dotted_name(child.func)
+            if "redact" in name:
+                return True
+    return False
+
+
+def _is_egress_fn(fn: ast.FunctionDef, cls_name: str) -> str:
+    """Non-empty reason string when `fn` writes data across the process
+    boundary toward an operator surface."""
+    if cls_name.endswith("Sink") and fn.name in ("deliver", "write", "emit"):
+        return f"{cls_name}.{fn.name} is a delivery sink"
+    if (cls_name, fn.name) in LOG_TAIL_READERS:
+        return f"{cls_name}.{fn.name} produces log-tail payloads"
+    for child in ast.walk(fn):
+        if not isinstance(child, ast.Call):
+            continue
+        name = dotted_name(child.func)
+        tail = name.rsplit(".", 1)[-1]
+        has_data = any(kw.arg == "data" for kw in child.keywords)
+        if tail == "urlopen" and (has_data or len(child.args) > 1):
+            return "posts a payload via urlopen"
+        if tail == "Request" and name.startswith("urllib") and has_data:
+            return "builds an HTTP request with a payload"
+    return ""
+
+
+class RedactOnEgressRule(Rule):
+    id = "redact-on-egress"
+    description = ("webhook/file-sink payloads and log-tail chunks must "
+                   "flow through logs.redact / redact_payload")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            if not pf.relpath.startswith(EGRESS_DIRS):
+                continue
+            for cls in iter_class_defs(pf.tree):
+                for fn in cls.body:
+                    if isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        yield from self._check(pf, fn, cls.name)
+            # module-level functions
+            for fn in pf.tree.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check(pf, fn, "")
+
+    def _check(self, pf: PyFile, fn: ast.FunctionDef,
+               cls_name: str) -> Iterable[Finding]:
+        reason = _is_egress_fn(fn, cls_name)
+        if reason and not _calls_redact(fn):
+            yield Finding(
+                self.id, pf.relpath, fn.lineno,
+                f"{fn.name}() {reason} but never calls redact() / "
+                f"redact_payload() — secrets could cross the egress "
+                f"boundary unredacted")

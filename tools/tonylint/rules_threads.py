@@ -1,0 +1,237 @@
+"""thread-hygiene: control-plane threads must be reapable and loud.
+
+A non-daemon thread nobody joins wedges AM/executor shutdown (the
+tier-1 suite's leak detector exists because exactly this bit PR 1);
+a bare ``except:`` or a silently-swallowed exception in a control-plane
+thread turns a real fault into an unexplained hang. Three checks:
+
+- every ``threading.Thread(...)`` construction passes ``daemon=...``,
+  sets ``<target>.daemon = True`` / ``setDaemon(True)`` after
+  construction, or its target is ``.join()``-ed somewhere in the same
+  module; a class subclassing ``threading.Thread`` must set ``daemon``
+  in its body;
+- no bare ``except:`` (it catches SystemExit/KeyboardInterrupt and hides
+  shutdown);
+- an ``except`` whose body is ONLY ``pass``/``continue`` must log
+  instead (or carry a justification suppression) — a handler that sets
+  a flag or returns a fallback is deliberate and is left alone.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.tonylint.engine import (Finding, Project, PyFile, Rule,
+                                   dotted_name)
+
+THREAD_DIRS = ("tony_tpu/am/", "tony_tpu/executor/", "tony_tpu/rpc/",
+               "tony_tpu/session/", "tony_tpu/observability/",
+               "tony_tpu/cluster/", "tony_tpu/portal/", "tony_tpu/serve/",
+               "tony_tpu/events/")
+
+
+def _is_thread_join_shape(node: ast.Call) -> bool:
+    """Distinguish Thread.join from str.join by call shape: str.join
+    REQUIRES exactly one iterable positional arg, Thread.join takes
+    nothing or a numeric timeout (positional or keyword). So
+    `sep.join(parts)` is never evidence, while `t.join()`,
+    `t.join(2.0)` and `t.join(timeout=x)` are."""
+    if not node.args:
+        return True
+    if len(node.args) == 1 and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, (int, float)):
+        return True
+    return False
+
+
+def _module_has_thread_join(pf: PyFile) -> bool:
+    """True when the module contains a `.join()` call whose receiver can
+    be a thread. A textual `".join(" in source` check is defeated by any
+    `", ".join(...)` — string joins (constant receivers, or any
+    variable receiver called with an iterable arg: see
+    `_is_thread_join_shape`) and path joins (os.path/posixpath/ntpath)
+    are excluded by AST shape instead."""
+    for node in ast.walk(pf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Constant):          # ", ".join(...)
+            continue
+        if isinstance(recv, ast.JoinedStr):          # f"{sep}".join(...)
+            continue
+        name = dotted_name(node.func)
+        if name.startswith(("os.path.", "posixpath.", "ntpath.",
+                            "shlex.", "str.")):
+            continue
+        if not _is_thread_join_shape(node):          # sep.join(parts)
+            continue
+        return True
+    return False
+
+
+def _class_sets_daemon(node: ast.ClassDef) -> bool:
+    """True when the class body assigns `daemon`/`self.daemon` or passes
+    a `daemon=` keyword (e.g. to super().__init__) — AST shape, so a
+    comment merely mentioning 'daemon' does not satisfy the check."""
+    for child in ast.walk(node):
+        if isinstance(child, (ast.Assign, ast.AnnAssign)):
+            targets = (child.targets if isinstance(child, ast.Assign)
+                       else [child.target])
+            for tgt in targets:
+                if (isinstance(tgt, ast.Name) and tgt.id == "daemon") \
+                        or (isinstance(tgt, ast.Attribute)
+                            and tgt.attr == "daemon"):
+                    return True
+        elif isinstance(child, ast.Call):
+            if any(kw.arg == "daemon" for kw in child.keywords):
+                return True
+    return False
+
+
+def _assign_target_names(assign: ast.Assign) -> set[str]:
+    names: set[str] = set()
+    for tgt in assign.targets:
+        if isinstance(tgt, ast.Attribute):
+            names.add(tgt.attr)
+        elif isinstance(tgt, ast.Name):
+            names.add(tgt.id)
+    return names
+
+
+def _thread_target_daemonized(pf: PyFile, assign: ast.Assign) -> bool:
+    """True when the Thread assigned here is made a daemon after
+    construction — `t = Thread(...)` + `t.daemon = True` (or the legacy
+    `t.setDaemon(True)`), the stdlib's own documented idiom. Only a
+    literal True counts: `t.daemon = False` is an explicit non-daemon."""
+    names = _assign_target_names(assign)
+    if not names:
+        return False
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and node.value.value is True):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"
+                        and isinstance(tgt.value, (ast.Attribute, ast.Name))):
+                    recv = tgt.value
+                    tail = (recv.attr if isinstance(recv, ast.Attribute)
+                            else recv.id)
+                    if tail in names:
+                        return True
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setDaemon"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value is True):
+            recv = node.func.value
+            tail = (recv.attr if isinstance(recv, ast.Attribute)
+                    else recv.id if isinstance(recv, ast.Name) else None)
+            if tail in names:
+                return True
+    return False
+
+
+def _thread_target_joined(pf: PyFile, assign: ast.Assign) -> bool:
+    """True when the Thread assigned here is `.join()`-ed in the same
+    module — `self._thread = Thread(...)` + `self._thread.join()`. The
+    evidence is a Call node whose receiver's trailing name matches the
+    assignment target (AST shape: a comment or log string mentioning
+    `.join(` does not count)."""
+    names = _assign_target_names(assign)
+    if not names:
+        return False
+    for node in ast.walk(pf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Subscript):   # self._threads[i].join()
+            recv = recv.value
+        tail = (recv.attr if isinstance(recv, ast.Attribute)
+                else recv.id if isinstance(recv, ast.Name) else None)
+        if tail in names:
+            return True
+    return False
+
+
+class ThreadHygieneRule(Rule):
+    id = "thread-hygiene"
+    description = ("threads must be daemon or provably joined; no bare "
+                   "except; swallowed exceptions in control-plane code "
+                   "must log")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            if not pf.relpath.startswith(THREAD_DIRS):
+                continue
+            parent_of: dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(pf.tree):
+                for child in ast.iter_child_nodes(node):
+                    parent_of[child] = node
+            for node in ast.walk(pf.tree):
+                if isinstance(node, ast.Call) \
+                        and dotted_name(node.func) in ("threading.Thread",
+                                                       "Thread"):
+                    yield from self._check_thread(pf, node, parent_of)
+                elif isinstance(node, ast.ClassDef):
+                    yield from self._check_thread_subclass(pf, node)
+                elif isinstance(node, ast.ExceptHandler):
+                    yield from self._check_handler(pf, node)
+
+    def _check_thread(self, pf: PyFile, node: ast.Call,
+                      parent_of: dict) -> Iterable[Finding]:
+        if any(kw.arg == "daemon" for kw in node.keywords):
+            return
+        parent = parent_of.get(node)
+        # X = Thread(...) (possibly behind an Attribute target):
+        # joined, or daemonized after construction?
+        if isinstance(parent, ast.Assign) and (
+                _thread_target_joined(pf, parent)
+                or _thread_target_daemonized(pf, parent)):
+            return
+        yield Finding(
+            self.id, pf.relpath, node.lineno,
+            "threading.Thread(...) is neither daemon=... nor provably "
+            "joined in this module — a leaked non-daemon thread wedges "
+            "shutdown")
+
+    def _check_thread_subclass(self, pf: PyFile,
+                               node: ast.ClassDef) -> Iterable[Finding]:
+        subclasses = any(
+            dotted_name(base) in ("threading.Thread", "Thread")
+            for base in node.bases)
+        if not subclasses:
+            return
+        if not _class_sets_daemon(node) and not _module_has_thread_join(pf):
+            yield Finding(
+                self.id, pf.relpath, node.lineno,
+                f"class {node.name}(threading.Thread) never sets daemon "
+                f"and instances are never joined in this module")
+
+    def _check_handler(self, pf: PyFile,
+                       node: ast.ExceptHandler) -> Iterable[Finding]:
+        if node.type is None:
+            yield Finding(
+                self.id, pf.relpath, node.lineno,
+                "bare `except:` — catches SystemExit/KeyboardInterrupt; "
+                "catch Exception (and log) instead")
+            return
+        # only BROAD catches must log: `except OSError: pass` on a
+        # best-effort cleanup path is deliberate; `except Exception: pass`
+        # hides faults the control plane should at least whisper about
+        broad = any(
+            isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+            for n in ast.walk(node.type))
+        swallowed = all(isinstance(stmt, (ast.Pass, ast.Continue))
+                        for stmt in node.body)
+        if broad and swallowed:
+            yield Finding(
+                self.id, pf.relpath, node.lineno,
+                "broad exception swallowed without logging in "
+                "control-plane code — log at debug level or add a "
+                "justified suppression")
