@@ -627,6 +627,9 @@ def _make_cp_handler(session, monitor, on_result=None):
         def request_preemption(self, req):
             return {"error": "control-plane harness"}
 
+        def request_rolling_update(self, req):
+            return {"error": "control-plane harness"}
+
     return _Handler()
 
 
@@ -1228,6 +1231,9 @@ def control_plane_main() -> None:
               f"ok={real_rows[-1]['ok']}")
     widest = rows[-1] if rows else {}
     result = {"metric": "control_plane", "backend": "cpu",
+              # not a fallback: this metric never touches the chip
+              "tpu_unavailable_reason": "not-applicable: orchestrator "
+                                        "metric (cpu by contract)",
               "spec_bytes_sent": widest.get("spec", {}).get("bytes_sent"),
               "hb_p95_ms": widest.get("heartbeat_p95_ms"),
               "control_plane": {"widths": rows, "real": real_rows}}
@@ -1251,6 +1257,9 @@ def control_plane_main() -> None:
         ):
             if value:
                 _append_history({"metric": metric, "backend": "cpu",
+                                 "tpu_unavailable_reason":
+                                     "not-applicable: orchestrator "
+                                     "metric (cpu by contract)",
                                  "value": value, "unit": unit,
                                  "width": widest.get("width"),
                                  "vs_baseline": 0.0})
@@ -1556,6 +1565,15 @@ def _emit(result: dict) -> None:
         "backend",
         "cpu" if str(result.get("device", "")).lower() in ("cpu", "")
         else "tpu")
+    # ...and EVERY line says why the chip is absent when it is: empty on
+    # an on-chip measurement, the wedge diagnosis on a fallback (set by
+    # _to_cpu_fallback), an explicit marker when an off-chip line reached
+    # here without one — a consumer never has to infer the reason from
+    # which fields happen to exist (the r04-r05 blind-trajectory mode)
+    result.setdefault(
+        "tpu_unavailable_reason",
+        "" if result["backend"] == "tpu"
+        else "unspecified cpu-backend measurement")
     _append_history(result)
     line = json.dumps(result, separators=(",", ":"))
     for key in drop_order:
@@ -1764,13 +1782,30 @@ def main() -> None:
     probe_deadline = float(os.environ.get(
         "TONY_BENCH_PROBE_SEC", max(90.0, 0.2 * BUDGET_SEC)))
     probe_deadline = max(15.0, min(probe_deadline, 0.3 * usable))
-    p_out, p_err, p_state, p_clean = _supervise(
-        [sys.executable, os.path.abspath(__file__), "--probe"],
-        probe_deadline)
-    probe_ok = p_clean and "PROBE-OK" in p_out
-    if not probe_ok:
-        diags.append(_diag(p_err, p_state, "pre-probe"))
+    # The probe itself retries with backoff: a single slow import or a
+    # lingering tunnel claim from a previous SIGKILLed run must not
+    # shrink the whole TPU schedule to the one-short-attempt path. The
+    # retry is budget-aware — it only runs when the usable window still
+    # fits probe + attempt + fallback after the backoff.
+    probe_ok = False
+    for p_attempt in (1, 2):
+        p_out, p_err, p_state, p_clean = _supervise(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            probe_deadline)
+        probe_ok = p_clean and "PROBE-OK" in p_out
+        if probe_ok:
+            break
+        diags.append(_diag(p_err, p_state, f"pre-probe attempt {p_attempt}"))
         print(f"[bench parent] {diags[-1]}", file=sys.stderr, flush=True)
+        remaining = usable - (time.monotonic() - t_start)
+        if p_attempt == 2 or remaining < 2.5 * probe_deadline + 60.0:
+            break
+        # a SIGKILLed probe's tunnel claim lingers (r5 evidence): give it
+        # a beat to lapse before the second — and last — probe try
+        backoff = 20.0 if "timed out after" in p_state else 5.0
+        print(f"[bench parent] probe retry in {backoff:.0f}s",
+              file=sys.stderr, flush=True)
+        time.sleep(backoff)
 
     # Attempt 1 + retry on the real accelerator. A failed probe does NOT
     # skip TPU entirely (the probe is advisory and could itself be a

@@ -309,5 +309,47 @@ def test_history_append_and_regression_verdict(_isolated_bench_paths,
                    threshold_pct=2.0)[0]["regression"] is False
 
 
+def test_fleet_headlines_append_and_compare_round_trip(tmp_path,
+                                                       monkeypatch):
+    """serve_bench --fleet's two headlines ride the same history →
+    bench_compare gate as bench.py's: the throughput entry (tok/s)
+    judges higher-is-better, the TTFT tail entry (unit "s") judges
+    lower-is-better, and both carry the commit stamp + the cpu-by-
+    contract tpu_unavailable_reason marker."""
+    import tools.serve_bench as sb
+    from tools.bench_compare import compare, load_history
+
+    hist = tmp_path / "bench_history.jsonl"
+    monkeypatch.setattr(sb, "HISTORY_PATH", str(hist))
+    monkeypatch.setattr(sb, "_commit_stamp", lambda: "fleethead")
+    sb.append_history({"metric": "serving_fleet_tokens_per_sec",
+                       "value": 400.0, "unit": "tok/s", "replicas": 4})
+    sb.append_history({"metric": "serving_fleet_ttft_p95_s",
+                       "value": 0.10, "unit": "s", "replicas": 4})
+    # a later, worse run: slower fleet AND a fatter TTFT tail
+    sb.append_history({"metric": "serving_fleet_tokens_per_sec",
+                       "value": 300.0, "unit": "tok/s", "replicas": 4})
+    sb.append_history({"metric": "serving_fleet_ttft_p95_s",
+                       "value": 0.15, "unit": "s", "replicas": 4})
+    entries = load_history(str(hist))
+    assert len(entries) == 4
+    assert all(e["commit"] == "fleethead" and e["backend"] == "cpu"
+               and e["tpu_unavailable_reason"].startswith("not-applicable")
+               for e in entries)
+    verdicts = {v["metric"]: v for v in compare(entries, threshold_pct=2.0)}
+    assert verdicts["serving_fleet_tokens_per_sec"]["regression"] is True
+    assert verdicts["serving_fleet_ttft_p95_s"]["regression"] is True
+    # ...and an IMPROVED run passes both gates (ttft lower = better)
+    sb.append_history({"metric": "serving_fleet_tokens_per_sec",
+                       "value": 450.0, "unit": "tok/s", "replicas": 4})
+    sb.append_history({"metric": "serving_fleet_ttft_p95_s",
+                       "value": 0.08, "unit": "s", "replicas": 4})
+    verdicts = {v["metric"]: v
+                for v in compare(load_history(str(hist)),
+                                 threshold_pct=2.0)}
+    assert verdicts["serving_fleet_tokens_per_sec"]["regression"] is False
+    assert verdicts["serving_fleet_ttft_p95_s"]["regression"] is False
+
+
 if __name__ == "__main__":
     sys.exit(0)

@@ -448,6 +448,9 @@ class _HarnessHandler(ClusterServiceHandler):
     def request_preemption(self, req):
         return {"error": "harness"}
 
+    def request_rolling_update(self, req):
+        return {"error": "harness"}
+
 
 @chaos
 def test_width256_relaunch_propagates_via_diffs_alone():

@@ -302,7 +302,7 @@ def test_job_page_renders_serving_endpoint(tmp_path):
     finally:
         server.stop()
     assert status == 200
-    assert "Serving endpoints" in body
+    assert "Serving fleet" in body
     assert "http://hostB:9900" in body
     # linked THROUGH the configured proxy, raw URL stays visible as text
     assert 'href="http://gateway:7000"' in body

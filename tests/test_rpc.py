@@ -95,6 +95,13 @@ class FakeClusterHandler(ClusterServiceHandler):
                 "grace_ms": int(req.get("grace_ms", 0) or 30_000),
                 "deadline_ms": int(req.get("grace_ms", 0) or 30_000)}
 
+    def request_rolling_update(self, req):
+        self.rollouts = getattr(self, "rollouts", [])
+        self.rollouts.append(req)
+        return {"app_id": "fake-app",
+                "generation": int(req.get("generation", 0) or 1),
+                "replicas": 0}
+
 
 class FakeMetricsHandler(MetricsServiceHandler):
     def __init__(self):

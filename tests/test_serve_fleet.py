@@ -1,0 +1,626 @@
+"""Serving-fleet tests: least-loaded router, connection draining,
+dead-endpoint eviction, rolling updates, and the arbiter-backed
+autoscaler (serve/router.py + serve/autoscaler.py + the AM wiring).
+
+The load-bearing contracts, each pinned here:
+
+- **draining chaos e2e**: a replica preempted mid-stream finishes its
+  in-flight streamed request (zero client-visible errors) while the
+  router fails new traffic over to the survivors;
+- **SIGKILL eviction**: a replica dying without a drain (host loss) is
+  marked DOWN within the probe-derived latency bound and re-admits
+  itself when it comes back;
+- **autoscaler through the arbiter**: a sustained SLI breach files the
+  replica ask THROUGH the admission arbiter and the AUTOSCALE_DECISION
+  event carries the arbiter's verdict (event-pinned acceptance).
+
+Real engines/frontends where streams matter; stub HTTP replicas where
+only the routing table is under test. All CPU-backend, tier-1 fast.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax
+
+from tony_tpu.models.llama import get_config, llama_init
+from tony_tpu.serve.router import (
+    DOWN, DRAINING, UP, FleetRouter, endpoints_from_task_infos,
+)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = get_config("tiny")
+    return llama_init(cfg, jax.random.PRNGKey(0)), cfg
+
+
+def _prompts(cfg, lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[int(t) for t in rng.randint(0, cfg.vocab_size, size=n)]
+            for n in lengths]
+
+
+def _post(port, payload, path="/v1/generate", timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get(port, path, timeout=10):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout).read())
+
+
+# ---------------------------------------------------------------------------
+# stub replica: a real HTTP server with a scriptable load snapshot
+# ---------------------------------------------------------------------------
+
+class _StubReplica:
+    """Answers /v1/load from a mutable dict and /v1/generate with a
+    canned body naming itself — enough surface to test the routing
+    table without paying for a model."""
+
+    def __init__(self, name: str, port: int = 0, **load):
+        self.name = name
+        self.load = {"queue_depth": 0, "slots_free": 4, "active_slots": 0,
+                     "n_slots": 4, "draining": False,
+                     "weights_generation": 0, **load}
+        self.requests = 0
+        self.status_code = 200
+        stub = self
+
+        class _H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if code == 429:
+                    self.send_header("Retry-After", "1")
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") == "/v1/load":
+                    return self._json(dict(stub.load))
+                self._json({"error": "nope"}, 404)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                self.rfile.read(n)
+                if self.path.rstrip("/") == "/v1/drain":
+                    stub.load["draining"] = True
+                    return self._json(dict(stub.load))
+                stub.requests += 1
+                if stub.status_code != 200:
+                    return self._json({"error": "shed"}, stub.status_code)
+                self._json({"served_by": stub.name})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), _H)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def kill(self):
+        """SIGKILL equivalent: the socket goes away with no drain."""
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _router(eps, **kw):
+    kw.setdefault("probe_ttl_ms", 30)
+    kw.setdefault("probe_timeout_ms", 500)
+    rtr = FleetRouter(eps, port=0, host="127.0.0.1", **kw)
+    rtr.start()
+    return rtr
+
+
+# ---------------------------------------------------------------------------
+# routing table semantics (stub replicas)
+# ---------------------------------------------------------------------------
+
+def test_least_loaded_routing_prefers_shallow_queue_then_free_slots():
+    a = _StubReplica("a", queue_depth=5, slots_free=0)
+    b = _StubReplica("b", queue_depth=0, slots_free=1)
+    c = _StubReplica("c", queue_depth=0, slots_free=4)
+    rtr = _router([a.url, b.url, c.url])
+    try:
+        got = json.loads(_post(rtr.port, {"prompt": [1]}).read())
+        assert got["served_by"] == "c"          # empty queue, most slots
+        c.load.update(queue_depth=9)
+        time.sleep(0.3)     # several prober sweeps, even under load
+        got = json.loads(_post(rtr.port, {"prompt": [1]}).read())
+        assert got["served_by"] == "b"
+    finally:
+        rtr.stop()
+        for s in (a, b, c):
+            s.kill()
+
+
+def test_429_spillover_retries_next_least_loaded_and_fleet_wide_429():
+    a = _StubReplica("a", slots_free=4)
+    b = _StubReplica("b", slots_free=2)
+    a.status_code = 429                         # the preferred pick sheds
+    rtr = _router([a.url, b.url], spillover_retries=2)
+    try:
+        got = json.loads(_post(rtr.port, {"prompt": [1]}).read())
+        assert got["served_by"] == "b"          # spilled, not failed
+        assert rtr.stats["spillovers_429"] == 1
+        b.status_code = 429                     # whole fleet sheds
+        time.sleep(0.3)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(rtr.port, {"prompt": [1]})
+        assert e.value.code == 429              # the fleet-wide answer
+        assert e.value.headers.get("Retry-After")
+    finally:
+        rtr.stop()
+        a.kill()
+        b.kill()
+
+
+def test_draining_replica_excluded_from_new_sends():
+    a = _StubReplica("a", slots_free=4)
+    b = _StubReplica("b", slots_free=1)
+    rtr = _router([a.url, b.url])
+    try:
+        assert json.loads(
+            _post(rtr.port, {"prompt": [1]}).read())["served_by"] == "a"
+        a.load["draining"] = True
+        time.sleep(0.3)
+        for _ in range(3):
+            got = json.loads(_post(rtr.port, {"prompt": [1]}).read())
+            assert got["served_by"] == "b"
+        states = {e["url"]: e["state"] for e in rtr.endpoints()}
+        assert states[a.url] == DRAINING and states[b.url] == UP
+    finally:
+        rtr.stop()
+        a.kill()
+        b.kill()
+
+
+def test_sigkilled_replica_evicted_within_latency_bound_and_readmits():
+    """Dead-endpoint eviction latency: after a SIGKILL-style death the
+    router marks the replica DOWN within dead_after_failures probes of
+    the TTL cadence — pinned at <2s with a 30ms TTL — and traffic keeps
+    flowing through the survivor with zero client-visible errors. A
+    replacement on the same port re-admits itself on one good probe."""
+    a = _StubReplica("a", slots_free=4)
+    b = _StubReplica("b", slots_free=2)
+    rtr = _router([a.url, b.url], dead_after_failures=2,
+                  probe_timeout_ms=200)
+    try:
+        assert json.loads(
+            _post(rtr.port, {"prompt": [1]}).read())["served_by"] == "a"
+        port = a.port
+        a.kill()
+        t0 = time.monotonic()
+        # traffic through the dead window: every request must succeed
+        # (connect failure -> failover to b), never a 5xx to the client
+        evicted_at = None
+        while time.monotonic() - t0 < 5.0:
+            got = json.loads(_post(rtr.port, {"prompt": [1]}).read())
+            assert got["served_by"] == "b"
+            states = {e["url"]: e["state"] for e in rtr.endpoints()}
+            if states[a.url] == DOWN:
+                evicted_at = time.monotonic() - t0
+                break
+            time.sleep(0.02)
+        assert evicted_at is not None, "dead replica never marked DOWN"
+        assert evicted_at < 2.0, \
+            f"eviction took {evicted_at:.2f}s (bound: 2s)"
+        # resurrection on the same port: the background prober keeps
+        # sweeping DOWN endpoints, so one good probe re-admits the
+        # replica — no traffic required (requests here just observe)
+        a2 = _StubReplica("a2", port=port, slots_free=9)
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                _post(rtr.port, {"prompt": [1]}).read()
+                states = {e["url"]: e["state"] for e in rtr.endpoints()}
+                if states[a2.url] == UP:
+                    break
+                time.sleep(0.05)
+            assert states[a2.url] == UP, "revived replica never re-admitted"
+        finally:
+            a2.kill()
+    finally:
+        rtr.stop()
+        b.kill()
+
+
+def test_endpoint_set_diff_merge_keeps_probe_state_and_drops_removed():
+    a = _StubReplica("a")
+    b = _StubReplica("b")
+    rtr = _router([a.url])
+    try:
+        assert rtr.probe(a.url) is not None
+        infos = [
+            {"name": "serving-endpoint", "task_id": "serving:0",
+             "url": a.url, "generation": 2, "draining": False},
+            {"name": "serving-endpoint", "task_id": "serving:1",
+             "url": b.url, "generation": 2, "draining": True},
+            {"name": "tensorboard", "url": "http://tb:1"},   # not serving
+        ]
+        rtr.set_endpoints(endpoints_from_task_infos(infos))
+        eps = {e["url"]: e for e in rtr.endpoints()}
+        assert set(eps) == {a.url, b.url}
+        assert eps[a.url]["generation"] == 2
+        assert eps[a.url]["load"] is not None       # probe state survived
+        assert eps[b.url]["state"] == DRAINING      # AM drain hint honored
+        rtr.set_endpoints([{"url": a.url, "task_id": "serving:0",
+                            "generation": 2}])
+        assert [e["url"] for e in rtr.endpoints()] == [a.url]
+    finally:
+        rtr.stop()
+        a.kill()
+        b.kill()
+
+
+def test_am_rolling_update_cycles_one_replica_at_a_time(tmp_path):
+    """The AM's rolling-update state machine: request_rolling_update
+    bumps the weights epoch and arms the rollout; each monitor pass
+    drains ONE replica's endpoint, force-relaunches it, and only
+    advances once the replacement re-registers healthy at the new
+    generation — finishing with ROLLING_UPDATE_COMPLETED ok=True."""
+    am, events = _fleet_am(tmp_path)
+    for i, t in enumerate(am.session.job_tasks["serving"]):
+        t.container_id = f"c{i}"
+        am.register_serving_endpoint(
+            {"task_id": t.task_id, "url": f"http://h:{9000 + i}"})
+
+    resp = am.request_rolling_update({"requested_by": "test"})
+    assert resp == {"app_id": "app_fleet_1", "generation": 1,
+                    "replicas": 2}
+    from tony_tpu.events.schema import EventType
+    assert [e.type for e in events
+            if e.type == EventType.ROLLING_UPDATE_STARTED]
+    # idempotent while in flight
+    assert am.request_rolling_update({})["duplicate"] is True
+
+    # pass 1: serving:0 drains, relaunches (its dead attempt's endpoint
+    # leaves the set with its container), rollout waits on it
+    am._check_rolling_update()
+    assert "serving:0" not in am._serving_endpoints
+    assert am.scheduler.replacements == ["serving"]
+    am._check_rolling_update()      # still waiting — no replacement yet
+    assert am._serving_endpoints["serving:1"]["draining"] is False
+    # replacement re-registers (no explicit generation -> AM epoch 1)
+    am.register_serving_endpoint(
+        {"task_id": "serving:0", "url": "http://h:9100"})
+    assert am._serving_endpoints["serving:0"]["generation"] == 1
+
+    # pass 2 notices the healthy gen-1 replica, cycles serving:1
+    am._check_rolling_update()
+    assert "serving:1" not in am._serving_endpoints
+    am.register_serving_endpoint(
+        {"task_id": "serving:1", "url": "http://h:9101"})
+    am._check_rolling_update()      # serving:1 healthy -> rollout done
+    done = [e for e in events
+            if e.type == EventType.ROLLING_UPDATE_COMPLETED]
+    assert len(done) == 1
+    assert done[0].payload.ok is True
+    assert done[0].payload.replicas_updated == 2
+    assert done[0].payload.generation == 1
+
+
+# ---------------------------------------------------------------------------
+# draining chaos e2e: preemption mid-stream with REAL engines
+# ---------------------------------------------------------------------------
+
+def test_preempted_replica_finishes_inflight_stream_zero_errors(model):
+    """The acceptance chaos e2e: two live replicas behind the router, a
+    streamed request in flight on one, then that replica is preempted
+    (drain). The open stream runs to completion token by token — zero
+    client-visible errors — while new traffic fails over to the
+    survivor; the drained engine reports empty once the stream ends."""
+    from tony_tpu.serve.engine import ContinuousBatchingEngine
+    from tony_tpu.serve.frontend import ServeFrontend
+
+    params, cfg = model
+    prompts = _prompts(cfg, (6, 5, 7), seed=11)
+    engines, fronts = [], []
+    for _ in range(2):
+        e = ContinuousBatchingEngine(params, cfg, n_slots=1,
+                                     token_budget=48, queue_depth=8)
+        e.start()
+        f = ServeFrontend(e, port=0, host="127.0.0.1")
+        f.start()
+        engines.append(e)
+        fronts.append(f)
+    rtr = _router([f"http://127.0.0.1:{f.port}" for f in fronts],
+                  spillover_retries=1)
+    try:
+        # warmup (compile) outside the measured chaos
+        json.loads(_post(rtr.port,
+                         {"prompt": prompts[2], "max_new_tokens": 2},
+                         timeout=120).read())
+
+        tokens, errors = [], []
+        started = threading.Event()
+
+        def stream():
+            try:
+                with _post(rtr.port, {"prompt": prompts[0],
+                                      "max_new_tokens": 24,
+                                      "stream": True},
+                           timeout=120) as r:
+                    for line in r:
+                        rec = json.loads(line)
+                        if "token" in rec:
+                            tokens.append(rec["token"])
+                            started.set()
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(repr(e))
+                started.set()
+
+        th = threading.Thread(target=stream, daemon=True)
+        th.start()
+        assert started.wait(timeout=120), "stream never produced a token"
+
+        # preempt the replica holding the stream: drain it mid-flight
+        victim = next(i for i, e in enumerate(engines)
+                      if e.load()["active_slots"] > 0)
+        survivor = 1 - victim
+        drained = json.loads(_post(fronts[victim].port, {},
+                                   path="/v1/drain").read())
+        assert drained["draining"]
+
+        # new traffic fails over to the survivor (the prober notices the
+        # drain within a sweep) and NEVER errors; the drained replica
+        # takes no new sends
+        time.sleep(0.3)
+        before = engines[victim].load()
+        for p in (prompts[1], prompts[2]):
+            got = json.loads(_post(rtr.port,
+                                   {"prompt": p, "max_new_tokens": 3},
+                                   timeout=120).read())
+            assert len(got["tokens"]) == 3
+        assert engines[survivor].stats.requests_submitted >= 2
+        assert engines[victim].stats.requests_submitted \
+            == before["active_slots"] + engines[victim].stats.requests_finished
+
+        # the preempted stream runs to completion: all 24 tokens, no error
+        th.join(timeout=120)
+        assert not th.is_alive(), "in-flight stream wedged after drain"
+        assert errors == [], f"client saw errors across the drain: {errors}"
+        assert len(tokens) == 24
+        assert engines[victim].wait_drained(30.0), \
+            "drained engine still holds work after its stream finished"
+        # direct submits to the draining replica answer 503 + the header
+        # (the machine-readable drain contract the router keys off)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(fronts[victim].port,
+                  {"prompt": prompts[1], "max_new_tokens": 2})
+        assert e.value.code == 503
+        assert e.value.headers.get("X-Tony-Draining") == "1"
+    finally:
+        rtr.stop()
+        for f in fronts:
+            f.stop()
+        for e in engines:
+            e.stop()
+
+
+def test_engine_load_snapshot_shape_and_drain_flag(model):
+    """Satellite pin: /v1/load is the router's probe — queue depth, free
+    slots, draining, weights generation — and never requires the
+    metrics render."""
+    from tony_tpu.serve.engine import ContinuousBatchingEngine
+    from tony_tpu.serve.frontend import ServeFrontend
+
+    params, cfg = model
+    engine = ContinuousBatchingEngine(params, cfg, n_slots=3,
+                                      token_budget=16, queue_depth=8,
+                                      weights_generation=7)
+    frontend = ServeFrontend(engine, port=0, host="127.0.0.1")
+    frontend.start()
+    try:
+        load = _get(frontend.port, "/v1/load")
+        assert load == {"ok": True, "queue_depth": 0, "slots_free": 3,
+                        "active_slots": 0, "n_slots": 3,
+                        "draining": False, "weights_generation": 7}
+        # a queued (not stepping) request shows up in the snapshot
+        engine.submit(_prompts(cfg, (4,), seed=3)[0], 2)
+        load = _get(frontend.port, "/v1/load")
+        assert load["queue_depth"] == 1
+        engine.begin_drain()
+        assert _get(frontend.port, "/v1/load")["draining"] is True
+    finally:
+        frontend.stop()
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: hysteresis/cooldown + the arbiter-backed ask (event-pinned)
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_hysteresis_cooldown_and_windowed_reject_rate():
+    from tony_tpu.serve.autoscaler import AutoscalerConfig, ReplicaAutoscaler
+
+    cfg = AutoscalerConfig(min_replicas=1, max_replicas=4,
+                           queue_depth_up=8, reject_rate_up_pct=1.0,
+                           occupancy_down_pct=30, hysteresis_passes=2,
+                           cooldown_ms=10_000)
+    sc = ReplicaAutoscaler(cfg)
+    hot = {"ttft_p95_s": 0.0, "queue_depth": 40.0, "occupancy_pct": 100.0,
+           "submitted_total": 100.0, "rejected_total": 0.0}
+    # pass 1 breaches but hysteresis holds; pass 2 fires
+    assert sc.evaluate(hot, 2, now_ms=0)["action"] == "hold"
+    v = sc.evaluate(hot, 2, now_ms=1000)
+    assert v["action"] == "up" and v["target"] == 3
+    sc.note_scaled(1000)
+    # cooldown suppresses the action, not the streak accounting
+    assert sc.evaluate(hot, 3, now_ms=2000)["reason"] == "cooldown"
+    assert sc.evaluate(hot, 3, now_ms=3000)["action"] == "hold"
+    v = sc.evaluate(hot, 3, now_ms=12_000)      # cooldown over -> fires
+    assert v["action"] == "up" and v["target"] == 4
+    sc.note_scaled(12_000)
+    # windowed reject rate: cumulative counters' inter-pass delta
+    sc2 = ReplicaAutoscaler(AutoscalerConfig(hysteresis_passes=1,
+                                             cooldown_ms=0,
+                                             queue_depth_up=0))
+    calm = {"queue_depth": 0.0, "occupancy_pct": 90.0,
+            "submitted_total": 1000.0, "rejected_total": 10.0}
+    assert sc2.evaluate(calm, 2, 0)["action"] == "hold"  # first pass: no delta
+    burst = dict(calm, submitted_total=1080.0, rejected_total=30.0)
+    v = sc2.evaluate(burst, 2, 1000)            # 20/(80+20) = 20% > 1%
+    assert v["action"] == "up" and "reject rate" in v["reason"]
+    # scale-down only below occupancy floor with an empty queue
+    sc3 = ReplicaAutoscaler(AutoscalerConfig(hysteresis_passes=1,
+                                             cooldown_ms=0))
+    idle = {"queue_depth": 0.0, "occupancy_pct": 5.0,
+            "submitted_total": 0.0, "rejected_total": 0.0}
+    sc3.evaluate(idle, 3, 0)
+    v = sc3.evaluate(idle, 3, 1000)
+    assert v["action"] == "down" and v["target"] == 2
+    assert sc3.evaluate(idle, 1, 2000)["action"] == "hold"  # min_replicas
+
+
+def _fleet_am(tmp_path, **extra_conf):
+    """An in-process AM with a 2-replica serving jobtype, stub backend/
+    scheduler, and an event recorder — the harness for the autoscaler
+    and rolling-update state machines."""
+    from tony_tpu.am.application_master import ApplicationMaster
+    from tony_tpu.conf import TonyConfiguration
+    from tony_tpu.session.session import TonySession
+
+    class _StubBackend:
+        def start(self):
+            ...
+
+        def stop_container(self, cid):
+            ...
+
+        def release_container(self, cid):
+            ...
+
+        def request_containers(self, *a, **k):
+            ...
+
+    class _StubScheduler:
+        def __init__(self):
+            self.scale_ups = []
+            self.replacements = []
+
+        def schedule_scale_up(self, job_name):
+            self.scale_ups.append(job_name)
+
+        def schedule_replacement(self, job_name):
+            self.replacements.append(job_name)
+
+    conf = TonyConfiguration()
+    for k, v in {"tony.serving.instances": 2, **extra_conf}.items():
+        conf.set(k, v, "test")
+    am = ApplicationMaster(conf, "app_fleet_1", str(tmp_path),
+                           backend=_StubBackend())
+    am.session = TonySession(conf, session_id=0)
+    am.scheduler = _StubScheduler()
+    events = []
+    am.event_handler.emit = events.append
+    return am, events
+
+
+def test_scaled_down_replica_does_not_trip_relaunch_barrier(tmp_path):
+    """A serving replica's clean exit (autoscaler scale-down) is
+    routine fleet lifecycle: it must NOT count toward the
+    completed-peer relaunch barrier, or one scale-down would disable
+    crash relaunches for the whole application. A completed GANG peer
+    still blocks — serving is the only barrier-exempt jobtype."""
+    am, _ = _fleet_am(tmp_path, **{"tony.worker.instances": 2,
+                                   "tony.task.max-task-attempts": 3})
+    am.session.on_task_completed("serving", 1, 0)   # scale-down exit
+    worker = am.session.get_task("worker", 0)
+    worker.container_id = "cw"
+    assert am._maybe_relaunch_task(worker, "crash") is True, \
+        "a completed serving replica must not block gang relaunches"
+    # the REAL barrier is untouched: a completed worker peer blocks
+    am2, _ = _fleet_am(tmp_path / "b", **{"tony.worker.instances": 2,
+                                          "tony.task.max-task-attempts": 3})
+    am2.session.on_task_completed("worker", 1, 0)
+    w0 = am2.session.get_task("worker", 0)
+    w0.container_id = "cw0"
+    assert am2._maybe_relaunch_task(w0, "crash") is False
+
+
+def test_scale_up_ask_preempts_lower_priority_trainer_via_arbiter():
+    """The PR-10 integration contract: a serving scale-up's chip ask is
+    judged against the live fleet book — on a full cluster it names a
+    lower-priority trainer as the checkpoint-then-evict victim rather
+    than queueing the fleet into starvation."""
+    from tony_tpu.conf import TonyConfiguration
+    from tony_tpu.observability.fleet import job_summary
+    from tony_tpu.serve.autoscaler import replica_ask_verdict
+
+    conf = TonyConfiguration()
+    conf.set("tony.arbiter.total-tpus", 8, "test")
+    conf.set("tony.arbiter.preemption-enabled", True, "test")
+    fleet = [job_summary("trainer_lowpri", "b", "default", "RUNNING",
+                         allocated_chips=8, priority=-1,
+                         started_ms=1000)]
+    d = replica_ask_verdict(conf, "serve_app", chips=4,
+                            fleet_summaries=fleet, priority=5)
+    assert d.action == "preempt"
+    assert [v.app_id for v in d.victims] == ["trainer_lowpri"]
+    # chips == 0 (CPU/dev fleet): trivially admits, arbiter or not
+    assert replica_ask_verdict(conf, "serve_app", chips=0,
+                               fleet_summaries=fleet).action == "admit"
+
+
+def test_am_autoscaler_files_arbiter_backed_ask_and_grows_the_gang(
+        tmp_path):
+    """Event-pinned acceptance: sustained SLI breach -> the AM's monitor
+    pass emits AUTOSCALE_DECISION carrying the arbiter's verdict, adds a
+    serving task slot, and requests exactly one container through the
+    scheduler; the cooldown stops a second ask on the very next pass."""
+    from tony_tpu.events.schema import EventType
+
+    am, events = _fleet_am(
+        tmp_path,
+        **{"tony.autoscaler.enabled": True,
+           "tony.autoscaler.hysteresis-passes": 1,
+           "tony.autoscaler.max-replicas": 4,
+           "tony.autoscaler.queue-depth-up": 8})
+    assert am.autoscaler is not None, \
+        "serving jobtype + enabled flag must arm the autoscaler"
+    am.metrics_store.update_metrics({
+        "task_type": "serving", "index": 0, "metrics": [
+            {"name": "SERVING_QUEUE_DEPTH", "value": 40.0},
+            {"name": "SERVING_SLOT_OCCUPANCY_PCT", "value": 100.0},
+            {"name": "SERVING_TTFT_P95_S", "value": 0.4},
+            {"name": "SERVING_SUBMITTED_TOTAL", "value": 50.0},
+            {"name": "SERVING_REJECTED_TOTAL", "value": 0.0}]})
+
+    before = len(am.session.job_tasks["serving"])
+    am._check_autoscaler()
+    decisions = [e for e in events
+                 if e.type == EventType.AUTOSCALE_DECISION]
+    assert len(decisions) == 1, "the ask must be event-pinned"
+    p = decisions[0].payload
+    assert p.direction == "up" and p.to_replicas == before + 1
+    assert p.arbiter_action == "admit"      # 0-chip dev ask: fits whole
+    assert p.queue_depth == 40.0            # the SLI evidence rides along
+    assert len(am.session.job_tasks["serving"]) == before + 1
+    assert am.scheduler.scale_ups == ["serving"]
+    # cooldown: the immediately-following pass must NOT ask again
+    am._check_autoscaler()
+    assert len([e for e in events
+                if e.type == EventType.AUTOSCALE_DECISION]) == 1

@@ -41,8 +41,9 @@ from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
     AlertFiring, AlertResolved, ApplicationFinished, ApplicationInited,
-    DiagnosticsReady, Event, EventType, Preempted, PreemptionRequested,
-    ProfileCaptured, Resumed, ServingEndpointRegistered, SloViolation,
+    AutoscaleDecision, DiagnosticsReady, Event, EventType, Preempted,
+    PreemptionRequested, ProfileCaptured, Resumed, RollingUpdateCompleted,
+    RollingUpdateStarted, ServingEndpointRegistered, SloViolation,
     StragglerCleared, StragglerDetected, TaskFinished, TaskRelaunched,
     TaskStarted,
 )
@@ -487,9 +488,41 @@ class ApplicationMaster(ClusterServiceHandler):
         self._lock = threading.RLock()
         self._tb_url = ""  # guarded-by: _lock
         # serving endpoints announced via register_serving_endpoint:
-        # task_id -> url (serve/ subsystem; surfaced in task infos and as
-        # SERVING_ENDPOINT_REGISTERED history events)
-        self._serving_endpoints: dict[str, str] = {}  # guarded-by: _lock
+        # task_id -> {"url", "generation", "draining"} (serve/ subsystem;
+        # surfaced in task infos — the fleet router's endpoint-set source
+        # — and as SERVING_ENDPOINT_REGISTERED history events). generation
+        # is the weights rollout epoch; draining means "stop new sends,
+        # in-flight finishes" (relaunch/preemption/scale-down ahead).
+        self._serving_endpoints: dict[str, dict] = {}  # guarded-by: _lock
+        # serving-fleet lifecycle: the AM-side weights epoch new
+        # registrations are stamped with (request_rolling_update bumps
+        # it), the in-flight rollout state machine (one replica at a
+        # time; advanced by _check_rolling_update on the monitor
+        # cadence), and the SLI-driven replica autoscaler (evaluated by
+        # _check_autoscaler; None unless enabled AND a serving jobtype
+        # exists — non-serving jobs pay nothing)
+        self._weights_generation = 0  # guarded-by: _lock
+        self._rolling: Optional[dict] = None  # guarded-by: _lock
+        # autoscale slots awaiting their first allocation: task_id ->
+        # abandon deadline (monotonic). A scale-up that never allocates
+        # is dropped by _check_scaleup_timeouts — never app-fatal.
+        self._pending_scaleups: dict[str, float] = {}  # guarded-by: _lock
+        # edge-dedup for arbiter-queued scale-ups (monitor thread only):
+        # one event per queued episode, not one per pass
+        self._autoscale_queued = False
+        self.autoscaler = None
+        if conf.get_bool(K.AUTOSCALER_ENABLED, False):
+            try:
+                from tony_tpu.session.requests import \
+                    parse_container_requests
+                if C.SERVING_JOB_NAME in parse_container_requests(conf):
+                    from tony_tpu.serve.autoscaler import (
+                        AutoscalerConfig, ReplicaAutoscaler,
+                    )
+                    self.autoscaler = ReplicaAutoscaler(
+                        AutoscalerConfig.from_conf(conf))
+            except Exception:  # noqa: BLE001 — scaling must not block boot
+                LOG.exception("autoscaler init failed; disabled")
         self._wake = threading.Event()   # kick the monitor loop early
         # timings (reference cadences, TonyConfigurationKeys.java:143-150)
         self._hb_interval_ms = conf.get_time_ms(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
@@ -1333,6 +1366,9 @@ class ApplicationMaster(ClusterServiceHandler):
             self._check_slo()
             self._check_stragglers()
             self._check_alerts()
+            self._check_scaleup_timeouts()
+            self._check_autoscaler()
+            self._check_rolling_update()
             self._publish_fleet_state()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
@@ -1568,6 +1604,318 @@ class ApplicationMaster(ClusterServiceHandler):
         if self.alert_engine is None:
             return {"error": "alerting disabled (tony.alerts.enabled)"}
         return self.alert_engine.bundle()
+
+    # ------------------------------------------------------------------
+    # serving-fleet lifecycle: autoscaler + rolling weight updates
+    # ------------------------------------------------------------------
+    def _serving_replicas(self) -> list[Task]:
+        """Live (launched-or-launching, not completed) serving tasks."""
+        session = self.session
+        if session is None:
+            return []
+        return [t for t in session.job_tasks.get(C.SERVING_JOB_NAME, [])
+                if not t.completed]
+
+    def _check_autoscaler(self) -> None:
+        """One autoscaler pass (monitor-loop cadence — the engine's only
+        call site): aggregate the per-replica SERVING_* gauges into the
+        fleet SLIs, ask the decision engine, and execute — a scale-up's
+        chip ask goes THROUGH the admission arbiter first (it may
+        checkpoint-then-evict a lower-priority job), a scale-down drains
+        one replica and returns its chips. Every executed or
+        arbiter-queued decision is event-pinned with the SLI evidence."""
+        scaler = self.autoscaler
+        session = self.session
+        with self._lock:
+            rolling = self._rolling
+        if (scaler is None or session is None
+                or self._preemption is not None or rolling is not None
+                or session.final_status != FinalStatus.UNDEFINED):
+            return
+        try:
+            from tony_tpu.serve.autoscaler import aggregate_serving_slis
+            replicas = self._serving_replicas()
+            slis = aggregate_serving_slis(
+                self.metrics_store.latest_gauges(),
+                live_task_ids={t.task_id for t in replicas})
+            if slis is None:
+                return      # no replica has pushed serving metrics yet
+            verdict = scaler.evaluate(slis, len(replicas),
+                                      time.time() * 1000.0)
+            if verdict["action"] != "up":
+                # the scale-up pressure (if any) broke: a future queued
+                # verdict is a fresh episode worth a fresh event
+                self._autoscale_queued = False
+            if verdict["action"] == "hold":
+                return
+            ev = verdict["slis"]
+            if verdict["action"] == "up":
+                chips = session.requests[C.SERVING_JOB_NAME].tpus
+                decision = self._autoscale_arbiter(chips)
+                if decision.action == "queue":
+                    # event + warning on the EDGE into the queued state
+                    # only: under sustained overload this branch runs
+                    # every monitor pass for hours, and per-pass
+                    # duplicates would bloat history/timelines the way
+                    # the alert engine's pending->firing dedup exists
+                    # to prevent
+                    if not self._autoscale_queued:
+                        self._autoscale_queued = True
+                        self.event_handler.emit(Event(
+                            EventType.AUTOSCALE_DECISION,
+                            AutoscaleDecision(
+                                C.SERVING_JOB_NAME, "up", len(replicas),
+                                len(replicas) + 1, chips=chips,
+                                arbiter_action=decision.action,
+                                victims=[],
+                                reason=verdict["reason"], **ev)))
+                        LOG.warning("autoscale up blocked by the "
+                                    "arbiter: %s", decision.reason)
+                    return      # no cooldown: re-ask next pass
+                self._autoscale_queued = False
+                self.event_handler.emit(Event(
+                    EventType.AUTOSCALE_DECISION,
+                    AutoscaleDecision(
+                        C.SERVING_JOB_NAME, "up", len(replicas),
+                        len(replicas) + 1, chips=chips,
+                        arbiter_action=decision.action,
+                        victims=[v.app_id for v in decision.victims],
+                        reason=verdict["reason"], **ev)))
+                if decision.victims:
+                    from tony_tpu.cluster.arbiter import execute_preemption
+                    grace = self.conf.get_time_ms(K.ARBITER_GRACE_MS,
+                                                  30_000)
+                    execute_preemption(
+                        decision.victims, grace_ms=grace,
+                        reason=f"preempted to scale {self.app_id} "
+                               f"serving to {len(replicas) + 1} replicas",
+                        requested_by="autoscaler")
+                self._scale_serving_up()
+                scaler.note_scaled(time.time() * 1000.0)
+            else:
+                victim = self._scale_serving_down()
+                if victim is None:
+                    return
+                self.event_handler.emit(Event(
+                    EventType.AUTOSCALE_DECISION,
+                    AutoscaleDecision(
+                        C.SERVING_JOB_NAME, "down", len(replicas),
+                        len(replicas) - 1,
+                        reason=verdict["reason"], **ev)))
+                scaler.note_scaled(time.time() * 1000.0)
+        except Exception:  # noqa: BLE001 — scaling must never kill the AM
+            LOG.exception("autoscaler check failed")
+
+    def _autoscale_arbiter(self, chips: int):
+        """One replica's chip ask against the live fleet book: synced
+        from the shared registry when one is configured (so the ask is
+        judged against EVERY running job, and a preempt verdict can name
+        a real victim), else against an empty book — where chips == 0
+        (CPU/dev) the ask trivially admits either way."""
+        from tony_tpu.serve.autoscaler import replica_ask_verdict
+        summaries = None
+        location = self.conf.get_str(K.HISTORY_STORE_LOCATION, "") \
+            or self.conf.get_str(K.STAGING_LOCATION, "")
+        if location and chips > 0:
+            try:
+                from tony_tpu.observability.fleet import FleetRegistry
+                registry = FleetRegistry(location=location)
+                registry.refresh(force=True)
+                summaries = [s for s in registry.live_jobs()
+                             if s.get("app_id") != self.app_id]
+            except Exception:  # noqa: BLE001 — degraded book beats no scale
+                LOG.warning("fleet registry unavailable for the "
+                            "autoscale ask", exc_info=True)
+        return replica_ask_verdict(
+            self.conf, self.app_id, chips, fleet_summaries=summaries,
+            queue=self.conf.get_str(K.APPLICATION_QUEUE, "default"),
+            user=os.environ.get("USER", ""),
+            priority=self.conf.get_int(K.APPLICATION_PRIORITY, 0))
+
+    def _scale_serving_up(self) -> Optional[Task]:
+        """Add one serving replica: append a task slot and request one
+        container at the serving jobtype's priority (the allocation
+        matches the unassigned slot through the same unique-priority
+        path as a first launch). The new slot gets its OWN allocation
+        clock (_check_scaleup_timeouts) — an optional extra replica
+        that never allocates is abandoned, it must not re-arm the
+        application-fatal registration deadline."""
+        session = self.session
+        with self._lock:
+            task = session.add_task_instance(C.SERVING_JOB_NAME)
+            if task is None:
+                return None
+            if self._alloc_timeout_ms > 0:
+                self._pending_scaleups[task.task_id] = (
+                    time.monotonic() + self._alloc_timeout_ms / 1000.0)
+        LOG.info("autoscale: adding serving replica %s", task.task_id)
+        self.scheduler.schedule_scale_up(C.SERVING_JOB_NAME)
+        self._wake.set()
+        return task
+
+    def _check_scaleup_timeouts(self) -> None:
+        """Abandon scale-up slots whose container never arrived inside
+        the allocation window: pop the slot (a late allocation is
+        released by the no-matching-task path) so the fleet returns to
+        its previous size and the autoscaler may re-ask — the whole
+        application must never fail over an OPTIONAL extra replica."""
+        session = self.session
+        if session is None:
+            return
+        with self._lock:
+            pending = list(self._pending_scaleups.items())
+        now = time.monotonic()
+        for task_id, deadline in pending:
+            task = session.get_task_by_id(task_id)
+            if task is None or task.container_id:
+                with self._lock:
+                    self._pending_scaleups.pop(task_id, None)
+                continue
+            if now <= deadline:
+                continue
+            with self._lock:
+                self._pending_scaleups.pop(task_id, None)
+            if session.remove_task_instance(C.SERVING_JOB_NAME, task_id):
+                LOG.warning("autoscale: abandoning scale-up %s (no "
+                            "allocation inside the window)", task_id)
+
+    def _scale_serving_down(self) -> Optional[Task]:
+        """Remove one serving replica: highest-index live replica is
+        connection-drained (endpoint marked draining so the router stops
+        new sends NOW; the container stop's SIGTERM has the engine
+        finish in-flight work inside the term-grace window) and its
+        clean exit completes the slot."""
+        replicas = [t for t in self._serving_replicas() if t.container_id]
+        if len(replicas) <= 1:
+            return None
+        victim = max(replicas, key=lambda t: t.index)
+        with self._lock:
+            self._mark_endpoint_draining(victim.task_id)
+        # no liveliness expiry mid-drain: the stop is deliberate
+        self.hb_monitor.unregister(victim.task_id)
+        LOG.info("autoscale: draining serving replica %s (container %s)",
+                 victim.task_id, victim.container_id)
+        self.backend.stop_container(victim.container_id)
+        return victim
+
+    def request_rolling_update(self, req: dict) -> dict:
+        """Operator ask: zero-downtime rolling weight update over the
+        serving replicas. Bumps the AM's weights epoch and arms the
+        one-replica-at-a-time state machine _check_rolling_update
+        advances on the monitor cadence. Idempotent while in flight."""
+        session = self.session
+        if session is None:
+            return {"error": "no active session"}
+        replicas = [t for t in self._serving_replicas()
+                    if t.container_id]
+        if not replicas:
+            return {"error": "no running serving replicas to update"}
+        requested_by = str(req.get("requested_by", "") or "operator")
+        with self._lock:
+            if self._rolling is not None:
+                r = self._rolling
+                return {"app_id": self.app_id, "duplicate": True,
+                        "generation": r["generation"],
+                        "replicas": len(r["pending"])
+                        + (1 if r["current"] else 0)}
+            generation = int(req.get("generation", 0) or 0) \
+                or self._weights_generation + 1
+            self._weights_generation = generation
+            self._rolling = {
+                "generation": generation,
+                "pending": sorted((t.task_id for t in replicas),
+                                  key=lambda tid: int(
+                                      tid.rpartition(":")[2])),
+                "current": None,
+                "updated": 0,
+                "started": time.monotonic(),
+                "since": time.monotonic(),
+            }
+        LOG.info("rolling update to weights generation %d over %d "
+                 "serving replica(s)", generation, len(replicas))
+        self.event_handler.emit(Event(
+            EventType.ROLLING_UPDATE_STARTED,
+            RollingUpdateStarted(self.app_id, generation, len(replicas),
+                                 requested_by=requested_by)))
+        self._wake.set()
+        return {"app_id": self.app_id, "generation": generation,
+                "replicas": len(replicas)}
+
+    def _check_rolling_update(self) -> None:
+        """One rollout pass (monitor-loop cadence): advance the
+        one-replica-at-a-time state machine — mark the next replica's
+        endpoint draining, relaunch it through the (budget-exempt)
+        relaunch machinery, and only move on once its replacement
+        re-registered a healthy endpoint at the new generation. A
+        replica that never comes back inside the allocation window
+        abandons the rollout loudly instead of wedging it."""
+        with self._lock:
+            ru = self._rolling
+        session = self.session
+        if ru is None or session is None or self._preemption is not None:
+            return
+        try:
+            now = time.monotonic()
+            if ru["current"] is not None:
+                with self._lock:
+                    rec = self._serving_endpoints.get(ru["current"])
+                healthy = (rec is not None and not rec.get("draining")
+                           and rec.get("generation", 0)
+                           >= ru["generation"])
+                if healthy:
+                    ru["updated"] += 1
+                    ru["current"] = None
+                    ru["since"] = now
+                elif (self._alloc_timeout_ms > 0
+                        and now - ru["since"]
+                        > self._alloc_timeout_ms / 1000.0):
+                    self._finish_rolling_update(
+                        ok=False,
+                        message=f"replica {ru['current']} never came "
+                                f"back healthy")
+                    return
+                else:
+                    return      # still waiting on the replacement
+            if not ru["pending"]:
+                self._finish_rolling_update(ok=True)
+                return
+            task_id = ru["pending"].pop(0)
+            task = session.get_task_by_id(task_id)
+            if task is None or task.completed or not task.container_id:
+                return          # scaled away mid-rollout; next pass
+            with self._lock:
+                self._mark_endpoint_draining(task_id)
+            if self._maybe_relaunch_task(
+                    task,
+                    f"rolling update to weights generation "
+                    f"{ru['generation']}",
+                    count_failure=False, force=True):
+                ru["current"] = task_id
+                ru["since"] = now
+            else:
+                self._finish_rolling_update(
+                    ok=False,
+                    message=f"could not relaunch {task_id}")
+        except Exception:  # noqa: BLE001 — rollout must never kill the AM
+            LOG.exception("rolling-update check failed")
+
+    def _finish_rolling_update(self, ok: bool, message: str = "") -> None:
+        with self._lock:
+            ru, self._rolling = self._rolling, None
+        if ru is None:
+            return
+        duration_ms = int((time.monotonic() - ru["started"]) * 1000)
+        (LOG.info if ok else LOG.error)(
+            "rolling update to generation %d %s: %d replica(s) updated "
+            "in %d ms %s", ru["generation"],
+            "completed" if ok else "FAILED", ru["updated"], duration_ms,
+            message)
+        self.event_handler.emit(Event(
+            EventType.ROLLING_UPDATE_COMPLETED,
+            RollingUpdateCompleted(self.app_id, ru["generation"],
+                                   replicas_updated=ru["updated"],
+                                   ok=ok, duration_ms=duration_ms,
+                                   message=message)))
 
     def _build_skew_state(self) -> None:
         """(Re)construct the skew tracker + straggler analyzer from the
@@ -2122,6 +2470,7 @@ class ApplicationMaster(ClusterServiceHandler):
         self.hb_monitor.unregister(task.task_id)
         self.metrics_store.clear_utilization_state(task.job_name, task.index)
         self._clear_profile_request(task.task_id)
+        self._drop_serving_endpoint(task.task_id)
         self._task_span_end(
             task.task_id, observed_attempt,
             "OK" if exit_code in (0, C.EXIT_KILLED_BY_AM) else "ERROR",
@@ -2214,7 +2563,8 @@ class ApplicationMaster(ClusterServiceHandler):
 
     def _maybe_relaunch_task(self, task: Task, reason: str,
                              observed_attempt: int = -1,
-                             count_failure: bool = True) -> bool:
+                             count_failure: bool = True,
+                             force: bool = False) -> bool:
         """The relaunch decision path: on a tracked task's crash or
         heartbeat expiry, stop only that container, recycle the slot
         (bumping the cluster-spec generation so survivors re-rendezvous
@@ -2262,15 +2612,23 @@ class ApplicationMaster(ClusterServiceHandler):
                 return True
             if not session.is_tracked(task.job_name) or task.completed:
                 return False
-            if session.num_completed_tracked_tasks() > 0:
+            # force marks an OPERATOR-lifecycle relaunch (rolling weight
+            # update): not a failure, so neither the attempt budget nor
+            # the completed-peer barrier concern applies — serving
+            # replicas rendezvous independently and the replacement is
+            # the whole point
+            if not force and session.num_completed_barrier_tasks() > 0:
                 # a completed peer cannot re-enter the barrier, so the
                 # replacement would rendezvous against its dead endpoint
-                # and hang — once any tracked task has finished, failures
-                # fall back to the session-level recovery ladder
+                # and hang — once any tracked GANG task has finished,
+                # failures fall back to the session-level recovery
+                # ladder. Completed serving replicas don't count: they
+                # never rendezvous, and an autoscaler scale-down exits
+                # one cleanly as routine lifecycle
                 LOG.warning("not relaunching %s (%s): %d tracked peer(s) "
                             "already completed and cannot re-join the gang",
                             task.task_id, reason,
-                            session.num_completed_tracked_tasks())
+                            session.num_completed_barrier_tasks())
                 return False
             # count_failure=False marks a non-failure relaunch (straggler
             # remediation): it still spends the attempt budget below, but
@@ -2279,15 +2637,19 @@ class ApplicationMaster(ClusterServiceHandler):
             if count_failure:
                 self._total_task_failures += 1
             max_attempts = session.max_task_attempts(task.job_name)
-            if task.attempt + 1 >= max_attempts:
+            # failure attempts only: attempts consumed by rolling-update
+            # (force) relaunches incremented `attempt` for fencing but
+            # must not spend the crash budget
+            failure_attempts = task.attempt - task.lifecycle_relaunches
+            if not force and failure_attempts + 1 >= max_attempts:
                 if max_attempts > 1:
                     LOG.error("task %s failed (%s) with its attempt budget "
                               "exhausted (%d/%d)", task.task_id, reason,
-                              task.attempt + 1, max_attempts)
+                              failure_attempts + 1, max_attempts)
                 return False
             max_total = self.conf.get_int(
                 K.APPLICATION_MAX_TOTAL_TASK_FAILURES, -1)
-            if 0 <= max_total < self._total_task_failures:
+            if not force and 0 <= max_total < self._total_task_failures:
                 LOG.error("task %s failed (%s) but the application already "
                           "saw %d task failures (circuit breaker: %d) — not "
                           "relaunching", task.task_id, reason,
@@ -2297,6 +2659,10 @@ class ApplicationMaster(ClusterServiceHandler):
             old_url = task.url
             if session.relaunch_task(task.job_name, task.index) is None:
                 return False
+            if force:
+                # this attempt belongs to an operator lifecycle (rolling
+                # update), not a failure — exclude it from the budget
+                task.lifecycle_relaunches += 1
             # the dead attempt must not linger in liveliness or wedge
             # detection; the replacement re-registers under the same id
             self.hb_monitor.unregister(task.task_id)
@@ -2338,6 +2704,9 @@ class ApplicationMaster(ClusterServiceHandler):
         # and stop_container may block on process teardown
         if old_cid:
             self.backend.stop_container(old_cid)
+        # the superseded attempt's serving endpoint dies with its
+        # container; the replacement re-registers its own
+        self._drop_serving_endpoint(task.task_id)
         # relaunch supersession: the dead attempt's logs are evidence —
         # aggregate them into history NOW (its dir name is attempt-unique,
         # so the replacement can never overwrite them)
@@ -2402,10 +2771,13 @@ class ApplicationMaster(ClusterServiceHandler):
         if tb_url:
             infos.append({"name": "tensorboard", "index": 0,
                           "url": tb_url, "status": "RUNNING"})
-        for i, (task_id, url) in enumerate(endpoints):
+        for i, (task_id, rec) in enumerate(endpoints):
             infos.append({"name": "serving-endpoint", "index": i,
-                          "task_id": task_id, "url": url,
-                          "status": "RUNNING"})
+                          "task_id": task_id, "url": rec["url"],
+                          "generation": rec.get("generation", 0),
+                          "draining": bool(rec.get("draining")),
+                          "status": ("DRAINING" if rec.get("draining")
+                                     else "RUNNING")})
         return infos
 
     def get_cluster_spec(self, req: dict) -> dict:
@@ -2481,9 +2853,14 @@ class ApplicationMaster(ClusterServiceHandler):
         return {}
 
     def register_serving_endpoint(self, req: dict) -> dict:
-        """A serving task's HTTP frontend announced its live endpoint:
-        record it (task infos) and persist it to history so the portal job
-        page can render the URL after the AM is gone."""
+        """A serving task's HTTP frontend announced its live endpoint
+        (or, with draining=true, its impending drain): record it (task
+        infos — the fleet router's endpoint-set source) and persist it
+        to history so the portal job page can render the URL after the
+        AM is gone. A registration with no explicit weights_generation
+        is stamped with the AM's current epoch: any freshly (re)started
+        replica restored the newest promoted checkpoint, which is
+        exactly what the epoch names."""
         task_id = str(req.get("task_id", ""))
         url = str(req.get("url", ""))
         if not task_id or not url:
@@ -2493,15 +2870,41 @@ class ApplicationMaster(ClusterServiceHandler):
             index = int(idx)
         except ValueError:
             name, index = task_id, 0
+        explicit_gen = int(req.get("weights_generation", 0) or 0)
+        draining = bool(req.get("draining"))
         with self._lock:
             known = self._serving_endpoints.get(task_id)
-            self._serving_endpoints[task_id] = url
-        if known != url:
-            LOG.info("serving endpoint registered: %s -> %s", task_id, url)
+            generation = explicit_gen or self._weights_generation
+            if draining and known is not None:
+                # a drain announcement keeps the recorded generation:
+                # the replica is going away, not changing weights
+                generation = known.get("generation", generation)
+            self._serving_endpoints[task_id] = {
+                "url": url, "generation": generation,
+                "draining": draining}
+        if draining:
+            LOG.info("serving endpoint draining: %s (%s)", task_id, url)
+            return {}
+        if known is None or known.get("url") != url \
+                or known.get("draining"):
+            LOG.info("serving endpoint registered: %s -> %s "
+                     "(weights generation %d)", task_id, url, generation)
             self.event_handler.emit(Event(
                 EventType.SERVING_ENDPOINT_REGISTERED,
                 ServingEndpointRegistered(name, index, url)))
         return {}
+
+    # holds: _lock (callers mark drains under the AM lock)
+    def _mark_endpoint_draining(self, task_id: str) -> None:
+        rec = self._serving_endpoints.get(task_id)
+        if rec is not None:
+            rec["draining"] = True
+
+    def _drop_serving_endpoint(self, task_id: str) -> None:
+        """A serving task completed: its endpoint leaves the set (the
+        router's next poll stops considering it entirely)."""
+        with self._lock:
+            self._serving_endpoints.pop(task_id, None)
 
     def register_execution_result(self, req: dict) -> dict:
         """Executor-reported exit code. Unregisters the task from the HB
@@ -2537,6 +2940,7 @@ class ApplicationMaster(ClusterServiceHandler):
                      exit_code)
             self.hb_monitor.unregister(task_id)
             self._clear_profile_request(task_id)
+            self._drop_serving_endpoint(task_id)
             self._task_span_end(task_id,
                                 attempt if attempt >= 0 else task.attempt,
                                 "OK", reason="preempted")
@@ -2582,6 +2986,7 @@ class ApplicationMaster(ClusterServiceHandler):
             return {}
         self.hb_monitor.unregister(task_id)
         self._clear_profile_request(task_id)
+        self._drop_serving_endpoint(task_id)
         session.on_task_completed(req["job_name"], int(req["job_index"]),
                                   exit_code,
                                   preempted=(draining
@@ -2717,6 +3122,12 @@ class ApplicationMaster(ClusterServiceHandler):
                 "requested_ms": int(time.time() * 1000),
                 "deadline": time.monotonic() + grace_ms / 1000.0,
             }
+            # connection draining: every serving endpoint flips to
+            # draining in the same breath, so an external fleet router
+            # polling task infos stops new sends while the replicas
+            # finish their in-flight streams inside the grace window
+            for task_id in list(self._serving_endpoints):
+                self._mark_endpoint_draining(task_id)
         LOG.warning("preemption requested by %s (%d ms grace): %s",
                     requested_by, grace_ms, reason or "unspecified")
         self.event_handler.emit(Event(

@@ -30,6 +30,13 @@
              location (the jobstate.json registry every AM publishes
              into): per-job state/chips/goodput plus per-queue
              quota-utilization rollups. `--once` prints one frame.
+- router   — serving fleet router (serve/router.py): one front door
+             spreading /v1/generate least-loaded across the app's
+             registered serving endpoints, with 429 spill-over,
+             connection draining, and dead-endpoint eviction.
+- rollout  — zero-downtime rolling weight update over a running app's
+             serving replicas (request_rolling_update RPC): drain one,
+             relaunch on the latest checkpoint, wait healthy, repeat.
 """
 
 from __future__ import annotations
@@ -43,7 +50,7 @@ from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
          "{submit|local|notebook|profile|logs|diagnose|stragglers"
-         "|alerts|top|preempt|arbiter} [args...]")
+         "|alerts|top|preempt|arbiter|router|rollout} [args...]")
 
 
 def _am_client(app_dir: str):
@@ -700,6 +707,122 @@ def arbiter(argv: list[str]) -> int:
     return 0
 
 
+def router(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli router <app_dir> [--port N]` (or
+    `--endpoints url1,url2` standalone) — stand up the serving fleet
+    router (serve/router.py): one front door spreading /v1/generate
+    least-loaded across the app's registered serving endpoints, with
+    429 spill-over, connection draining, and dead-endpoint eviction.
+    Orchestrated mode polls the AM's task infos so endpoint
+    registrations, drain marks, and rolling-update generation bumps
+    reach the router live."""
+    import argparse
+    import threading
+    import time
+
+    from tony_tpu.conf import TonyConfiguration, keys as K
+    from tony_tpu.serve.router import AmEndpointWatcher, FleetRouter
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli router")
+    parser.add_argument("app_dir", nargs="?", default="",
+                        help="the application dir the client created "
+                             "(holds the amhostport file)")
+    parser.add_argument("--endpoints", default="",
+                        help="comma-separated replica URLs (standalone "
+                             "mode, no AM)")
+    parser.add_argument("--port", type=int, default=-1,
+                        help="router HTTP port (-1 = "
+                             "tony.serving.fleet.router-port)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--poll-ms", type=int, default=1000,
+                        help="AM endpoint-set poll cadence")
+    parser.add_argument("--probe-ttl-ms", type=int, default=-1,
+                        help="load-probe cache TTL (-1 = "
+                             "tony.serving.fleet.probe-ttl-ms)")
+    parser.add_argument("--spillover-retries", type=int, default=-1,
+                        help="429/5xx spill-over retries (-1 = "
+                             "tony.serving.fleet.spillover-retries)")
+    args = parser.parse_args(argv)
+    if not args.app_dir and not args.endpoints:
+        print("router: need an app_dir or --endpoints", file=sys.stderr)
+        return 2
+    conf = TonyConfiguration()
+    port = args.port if args.port >= 0 \
+        else conf.get_int(K.SERVING_FLEET_ROUTER_PORT, 0)
+    rtr = FleetRouter(
+        endpoints=[u for u in args.endpoints.split(",") if u],
+        port=port, host=args.host,
+        probe_ttl_ms=(args.probe_ttl_ms if args.probe_ttl_ms >= 0 else
+                      conf.get_time_ms(K.SERVING_FLEET_PROBE_TTL_MS,
+                                       500)),
+        probe_timeout_ms=conf.get_time_ms(
+            K.SERVING_FLEET_PROBE_TIMEOUT_MS, 1000),
+        spillover_retries=(args.spillover_retries
+                           if args.spillover_retries >= 0 else
+                           conf.get_int(
+                               K.SERVING_FLEET_SPILLOVER_RETRIES, 2)),
+        dead_after_failures=conf.get_int(
+            K.SERVING_FLEET_DEAD_AFTER_FAILURES, 2))
+    watcher = None
+    client = None
+    if args.app_dir:
+        client, err = _am_client(args.app_dir)
+        if err:
+            print(err, file=sys.stderr)
+            return 1
+        watcher = AmEndpointWatcher(rtr, client,
+                                    interval_s=args.poll_ms / 1000.0)
+        watcher.start()
+    rtr.start()
+    # log-ok: greppable bring-up marker (mirrors SERVING_UP)
+    print(f"ROUTER_UP http://127.0.0.1:{rtr.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        if client is not None:
+            client.close()
+        rtr.stop()
+    return 0
+
+
+def rollout(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli rollout <app_dir> [--generation N]` —
+    zero-downtime rolling weight update over a running app's serving
+    replicas: one at a time, each endpoint drains (router stops new
+    sends, in-flight requests finish), relaunches restoring the latest
+    promoted checkpoint, and the rollout advances only once the
+    replacement re-registers healthy at the new generation."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli rollout")
+    parser.add_argument("app_dir",
+                        help="the application dir the client created "
+                             "(holds the amhostport file)")
+    parser.add_argument("--generation", type=int, default=0,
+                        help="weights epoch the updated replicas serve "
+                             "(0 = bump the AM's epoch by one)")
+    args = parser.parse_args(argv)
+    client, err = _am_client(args.app_dir)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
+    try:
+        resp = client.request_rolling_update(generation=args.generation,
+                                             requested_by="operator")
+    except Exception as e:  # noqa: BLE001 — operator tool, report and exit
+        print(f"request_rolling_update failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    print(json.dumps(resp or {}, indent=1))
+    return 0 if not (resp or {}).get("error") else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     logging.basicConfig(
@@ -739,6 +862,10 @@ def main(argv: list[str] | None = None) -> int:
         return preempt(rest)
     if cmd == "arbiter":
         return arbiter(rest)
+    if cmd == "router":
+        return router(rest)
+    if cmd == "rollout":
+        return rollout(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

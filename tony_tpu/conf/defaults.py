@@ -178,6 +178,25 @@ DEFAULTS = {
     K.SERVING_TOKEN_BUDGET: 2048,
     K.SERVING_QUEUE_DEPTH: 64,
     K.SERVING_PORT: 0,           # 0 = executor-assigned $SERVING_PORT
+    # serving fleet router (serve/router.py)
+    K.SERVING_FLEET_ROUTER_PORT: 0,           # 0 = ephemeral
+    K.SERVING_FLEET_PROBE_TTL_MS: 500,
+    K.SERVING_FLEET_PROBE_TIMEOUT_MS: 1000,
+    K.SERVING_FLEET_SPILLOVER_RETRIES: 2,
+    K.SERVING_FLEET_DEAD_AFTER_FAILURES: 2,
+    # must fit inside tony.task.term-grace-ms (15 s default) so the
+    # executor's KILL never lands before the drain finishes
+    K.SERVING_FLEET_DRAIN_TIMEOUT_MS: 10_000,
+    # serving autoscaler (serve/autoscaler.py); opt-in
+    K.AUTOSCALER_ENABLED: False,
+    K.AUTOSCALER_MIN_REPLICAS: 1,
+    K.AUTOSCALER_MAX_REPLICAS: 4,
+    K.AUTOSCALER_TTFT_P95_UP_MS: 0,           # 0 = signal disabled
+    K.AUTOSCALER_QUEUE_DEPTH_UP: 8,
+    K.AUTOSCALER_REJECT_RATE_UP_PCT: 1.0,
+    K.AUTOSCALER_OCCUPANCY_DOWN_PCT: 30,
+    K.AUTOSCALER_HYSTERESIS_PASSES: 3,
+    K.AUTOSCALER_COOLDOWN_MS: 60_000,
 
     # docker
     K.DOCKER_ENABLED: False,

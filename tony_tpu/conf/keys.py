@@ -157,6 +157,51 @@ SERVING_QUEUE_DEPTH = "tony.serving.queue-depth"
 # ($SERVING_PORT), so the cluster-spec entry is the live endpoint
 SERVING_PORT = "tony.serving.port"
 
+# --- serving fleet (serve/router.py): one front door over N replicas ----
+# router HTTP port (0 = ephemeral); the router spreads /v1/generate
+# least-loaded across the endpoints registered via
+# register_serving_endpoint, with 429 spill-over and connection draining
+SERVING_FLEET_ROUTER_PORT = "tony.serving.fleet.router-port"
+# TTL on the router's cached per-replica /v1/load probes: within the
+# TTL, routing a request costs ZERO extra RPCs
+SERVING_FLEET_PROBE_TTL_MS = "tony.serving.fleet.probe-ttl-ms"
+# per-probe timeout (also the deadness-detection latency floor)
+SERVING_FLEET_PROBE_TIMEOUT_MS = "tony.serving.fleet.probe-timeout-ms"
+# additional replicas tried when the least-loaded pick answers 429/5xx
+# or is unreachable, before the client sees the failure
+SERVING_FLEET_SPILLOVER_RETRIES = "tony.serving.fleet.spillover-retries"
+# consecutive probe/send failures before a replica is marked DOWN and
+# evicted from routing (it re-admits on the first successful probe)
+SERVING_FLEET_DEAD_AFTER_FAILURES = \
+    "tony.serving.fleet.dead-after-failures"
+# bound on the in-flight drain a SIGTERMed serving replica waits out
+# before stopping (connection-draining contract; must fit inside
+# tony.task.term-grace-ms or the executor's KILL cuts streams mid-token)
+SERVING_FLEET_DRAIN_TIMEOUT_MS = "tony.serving.fleet.drain-timeout-ms"
+
+# --- autoscaler (serve/autoscaler.py): SLI-driven replica scaling -------
+# master switch: the AM evaluates the serving-fleet autoscaler on its
+# monitor cadence when the application carries a serving jobtype
+AUTOSCALER_ENABLED = "tony.autoscaler.enabled"
+# replica-count bounds the autoscaler may move within
+AUTOSCALER_MIN_REPLICAS = "tony.autoscaler.min-replicas"
+AUTOSCALER_MAX_REPLICAS = "tony.autoscaler.max-replicas"
+# scale-up signals (0 disables a signal): fleet TTFT p95 ceiling,
+# per-replica engine queue-depth ceiling, windowed 429 reject-rate
+# budget — the same SLIs the PR-9 burn-rate alert rules watch
+AUTOSCALER_TTFT_P95_UP_MS = "tony.autoscaler.ttft-p95-up-ms"
+AUTOSCALER_QUEUE_DEPTH_UP = "tony.autoscaler.queue-depth-up"
+AUTOSCALER_REJECT_RATE_UP_PCT = "tony.autoscaler.reject-rate-up-pct"
+# scale-down signal: mean slot occupancy below this (with an empty
+# queue and zero rejects) marks the fleet oversized
+AUTOSCALER_OCCUPANCY_DOWN_PCT = "tony.autoscaler.occupancy-down-pct"
+# hysteresis: a signal must hold for this many consecutive monitor
+# passes before any action — one slow request never scales the fleet
+AUTOSCALER_HYSTERESIS_PASSES = "tony.autoscaler.hysteresis-passes"
+# cooldown after any executed action: no second action within this
+# window, so scale-up/scale-down can never flap against each other
+AUTOSCALER_COOLDOWN_MS = "tony.autoscaler.cooldown-ms"
+
 # --- observability (observability/ subsystem) ----------------------------
 # per-gauge timeseries ring buffer in the AM's MetricsStore: max points
 # kept per (task, metric); on overflow the buffer compacts (drops every
@@ -359,7 +404,7 @@ RESERVED_SEGMENTS = frozenset({
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
     "profiling", "slo", "logs", "straggler", "fleet", "alerts",
-    "arbiter", "checkpoint",
+    "arbiter", "checkpoint", "autoscaler",
 })
 
 

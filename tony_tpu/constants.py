@@ -44,6 +44,9 @@ TB_PORT = "TB_PORT"                  # TensorBoard port, chief only
 # must bind. Rendered by runtimes.render_framework_env from the task's own
 # cluster-spec entry, so the endpoint the AM gossips IS the live server.
 SERVING_PORT = "SERVING_PORT"
+# weights rollout epoch a serving replica announces with its endpoint
+# (rolling updates; 0/absent = the AM stamps its current epoch)
+SERVING_WEIGHTS_GENERATION = "TONY_SERVING_WEIGHTS_GENERATION"
 
 # PyTorch (reference: Constants.java:50-54, Utils.parseClusterSpecForPytorch)
 INIT_METHOD = "INIT_METHOD"          # tcp://<worker0 host:port>

@@ -129,6 +129,38 @@ def _resumed(p: dict) -> str:
             f"{p.get('requested_chips', 0)} chips)")
 
 
+def _autoscale_decision(p: dict) -> str:
+    arb = p.get("arbiter_action", "")
+    victims = p.get("victims") or []
+    tail = ""
+    if arb:
+        tail = f" [arbiter: {arb}"
+        if victims:
+            tail += f", victims {', '.join(victims)}"
+        tail += "]"
+    return (f"autoscale {p.get('direction', '?')}: "
+            f"{p.get('job_type', 'serving')} "
+            f"{p.get('from_replicas', '?')} -> "
+            f"{p.get('to_replicas', '?')} replicas "
+            f"({p.get('reason', '') or 'unspecified'}){tail}")
+
+
+def _rolling_update_started(p: dict) -> str:
+    return (f"rolling update to weights generation "
+            f"{p.get('generation', '?')} started on "
+            f"{p.get('replicas', 0)} serving replica(s) "
+            f"(requested by {p.get('requested_by', '') or 'operator'})")
+
+
+def _rolling_update_completed(p: dict) -> str:
+    status = "completed" if p.get("ok", True) else "FAILED"
+    tail = f": {p['message']}" if p.get("message") else ""
+    return (f"rolling update to weights generation "
+            f"{p.get('generation', '?')} {status} — "
+            f"{p.get('replicas_updated', 0)} replica(s) updated in "
+            f"{p.get('duration_ms', 0)} ms{tail}")
+
+
 RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.APPLICATION_INITED: _application_inited,
     EventType.APPLICATION_FINISHED: _application_finished,
@@ -146,6 +178,9 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.PREEMPTION_REQUESTED: _preemption_requested,
     EventType.PREEMPTED: _preempted,
     EventType.RESUMED: _resumed,
+    EventType.AUTOSCALE_DECISION: _autoscale_decision,
+    EventType.ROLLING_UPDATE_STARTED: _rolling_update_started,
+    EventType.ROLLING_UPDATE_COMPLETED: _rolling_update_completed,
 }
 
 

@@ -33,6 +33,9 @@ class EventType(str, enum.Enum):
     PREEMPTION_REQUESTED = "PREEMPTION_REQUESTED"
     PREEMPTED = "PREEMPTED"
     RESUMED = "RESUMED"
+    AUTOSCALE_DECISION = "AUTOSCALE_DECISION"
+    ROLLING_UPDATE_STARTED = "ROLLING_UPDATE_STARTED"
+    ROLLING_UPDATE_COMPLETED = "ROLLING_UPDATE_COMPLETED"
 
 
 @dataclass
@@ -239,6 +242,56 @@ class Resumed:
 
 
 @dataclass
+class AutoscaleDecision:
+    """No reference equivalent: the AM's serving-fleet autoscaler
+    (serve/autoscaler.py) converted the burn-rate SLIs into a replica
+    action. The SLI evidence and the admission arbiter's verdict travel
+    with the event — a scale-up's chip ask goes THROUGH the arbiter
+    (cluster/arbiter.py), so `arbiter_action` records whether it fit
+    whole (admit), required checkpoint-then-evicting the `victims`
+    (preempt), or waits (queue); scale-down returns chips to the pool
+    and carries no arbiter verdict."""
+    job_type: str               # the scaled jobtype ("serving")
+    direction: str              # "up" | "down"
+    from_replicas: int
+    to_replicas: int
+    chips: int = 0              # one replica's chip ask (up only)
+    arbiter_action: str = ""    # admit | preempt | queue ("" for down)
+    victims: list[str] = field(default_factory=list)
+    reason: str = ""
+    ttft_p95_s: float = 0.0
+    queue_depth: float = 0.0
+    reject_rate_pct: float = 0.0
+    occupancy_pct: float = 0.0
+
+
+@dataclass
+class RollingUpdateStarted:
+    """No reference equivalent: a zero-downtime rolling weight update
+    began — serving replicas are cycled one at a time (drain old →
+    relaunch → wait healthy) so the fleet never drops below N-1
+    capacity and no in-flight request is cut. `generation` is the
+    weights epoch the updated replicas will serve."""
+    application_id: str
+    generation: int
+    replicas: int               # serving replicas in the rollout set
+    requested_by: str = ""
+
+
+@dataclass
+class RollingUpdateCompleted:
+    """The rollout finished (ok) or was abandoned (a replacement never
+    came healthy inside the window). `replicas_updated` made it to the
+    new generation either way."""
+    application_id: str
+    generation: int
+    replicas_updated: int = 0
+    ok: bool = True
+    duration_ms: int = 0
+    message: str = ""
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -264,13 +317,18 @@ _PAYLOADS = {
     EventType.PREEMPTION_REQUESTED: PreemptionRequested,
     EventType.PREEMPTED: Preempted,
     EventType.RESUMED: Resumed,
+    EventType.AUTOSCALE_DECISION: AutoscaleDecision,
+    EventType.ROLLING_UPDATE_STARTED: RollingUpdateStarted,
+    EventType.ROLLING_UPDATE_COMPLETED: RollingUpdateCompleted,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 TaskFinished, TaskRelaunched, ServingEndpointRegistered,
                 ProfileCaptured, SloViolation, DiagnosticsReady,
                 StragglerDetected, StragglerCleared, AlertFiring,
-                AlertResolved, PreemptionRequested, Preempted, Resumed]
+                AlertResolved, PreemptionRequested, Preempted, Resumed,
+                AutoscaleDecision, RollingUpdateStarted,
+                RollingUpdateCompleted]
 
 
 @dataclass

@@ -1010,6 +1010,11 @@ _TIMELINE_EVENTS = {
     "PREEMPTION_REQUESTED": "warning",
     "PREEMPTED": "warning",
     "RESUMED": "info",
+    # serving fleet lifecycle (serve/autoscaler.py + rolling updates):
+    # scale actions and weight rollouts explain serving-SLI inflections
+    "AUTOSCALE_DECISION": "info",
+    "ROLLING_UPDATE_STARTED": "info",
+    "ROLLING_UPDATE_COMPLETED": "info",
 }
 
 

@@ -314,6 +314,12 @@ class _Handler(BaseHTTPRequestHandler):
                     job_id, md.status == "RUNNING"))
             if what == "timeline":
                 return self._json(self._incident_timeline(job_id))
+            if what == "serving":
+                # the fleet-serving panel's data: live endpoint set
+                # (url/generation/draining) for RUNNING jobs, history
+                # events otherwise
+                return self._json(self._serving_bundle(
+                    job_id, md.status == "RUNNING"))
         if len(parts) == 4 and parts[0] == "jobs" and parts[2] == "logs":
             # /api/jobs/:id/logs/:task[?stream=&offset=&max_bytes=&follow]
             # — one bounded chunk; followers poll with the returned
@@ -388,6 +394,54 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(chunk)
         self._json({"error": f"no logs available for {task} ({stream})"},
                    404)
+
+    def _serving_bundle(self, job_id: str, running: bool) -> dict:
+        """Serving fleet view: a RUNNING job's live endpoint set — url,
+        weights generation, draining state — proxied off its AM's task
+        infos (the same set the fleet router consumes), with rollout/
+        autoscale context from the event log; otherwise the last
+        registration events from history. Degrades silently."""
+        endpoints: list[dict] = []
+        source = "history"
+        am = self.cache.get_am_info(job_id) if running else {}
+        if running and am.get("host") and am.get("rpc_port") \
+                and not am.get("security_enabled"):
+            from tony_tpu.rpc.client import ClusterServiceClient
+            from tony_tpu.serve.router import endpoints_from_task_infos
+            client = ClusterServiceClient(str(am["host"]),
+                                          int(am["rpc_port"]))
+            try:
+                # operator plane: fail FAST to the history fallback (the
+                # get_skew/get_alerts proxy discipline) — a page render
+                # must never ride the full client retry ladder against a
+                # dead AM
+                infos = client.call("get_task_infos", {}, retries=1,
+                                    timeout_sec=10.0,
+                                    wait_for_ready=False)
+                endpoints = endpoints_from_task_infos(infos or [])
+                source = "live"
+            except Exception:  # noqa: BLE001 — degrade to history
+                LOG.debug("live serving proxy to the AM failed",
+                          exc_info=True)
+            finally:
+                client.close()
+        if not endpoints:
+            by_task: dict[tuple, dict] = {}
+            for ev in self.cache.get_events(job_id):
+                if ev["type"] == "SERVING_ENDPOINT_REGISTERED":
+                    p = ev["payload"]
+                    by_task[(p.get("task_type"), p.get("task_index"))] = {
+                        "url": p.get("url", ""),
+                        "task_id": f'{p.get("task_type", "serving")}:'
+                                   f'{p.get("task_index", 0)}',
+                        "generation": 0, "draining": False}
+            endpoints = list(by_task.values())
+        scaling = [ev for ev in self.cache.get_events(job_id)
+                   if ev["type"] in ("AUTOSCALE_DECISION",
+                                     "ROLLING_UPDATE_STARTED",
+                                     "ROLLING_UPDATE_COMPLETED")]
+        return {"endpoints": endpoints, "source": source,
+                "scaling_events": scaling[-20:]}
 
     def _skew_bundle(self, job_id: str, running: bool) -> dict:
         """Live-then-history skew bundle: a RUNNING job's bundle comes
@@ -693,7 +747,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._html(f"events — {job_id}",
                    self._diagnostics_html(job_id)
                    + self._alerts_html(job_id)
-                   + self._serving_endpoints_html(job_id, events)
+                   + self._serving_endpoints_html(job_id)
                    + self._skew_html(job_id)
                    + self._goodput_html(job_id)
                    + self._timeline_html(job_id)
@@ -1015,39 +1069,49 @@ class _Handler(BaseHTTPRequestHandler):
                 f"<th>Timeline ({extent} ms)</th></tr>"
                 + "".join(rows) + "</table>")
 
-    def _serving_endpoints_html(self, job_id: str, events: list) -> str:
-        """Registered serving endpoints as links above the event table —
-        previously a serving job's page showed nothing actionable. With
-        tony.proxy.url configured the link goes THROUGH the authenticated
-        proxy (the raw in-cluster address stays visible as text, since
-        the browser usually can't reach it directly)."""
-        # last event per task wins: a relaunched serving task re-registers
-        # at a fresh port, and the dead predecessor's URL must not render
-        # next to the live one
-        by_task: dict[tuple, dict] = {}
-        for ev in events:
-            if ev["type"] == "SERVING_ENDPOINT_REGISTERED":
-                p = ev["payload"]
-                by_task[(p.get("task_type"), p.get("task_index"))] = p
-        endpoints = list(by_task.values())
+    def _serving_endpoints_html(self, job_id: str) -> str:
+        """Fleet serving panel: the replica set with its live state —
+        weights generation and DRAINING badge (the fleet router's view)
+        — plus the recent autoscale/rolling-update lifecycle. With
+        tony.proxy.url configured the link goes THROUGH the
+        authenticated proxy (the raw in-cluster address stays visible
+        as text, since the browser usually can't reach it directly)."""
+        md = self.cache.get_metadata(job_id)
+        bundle = self._serving_bundle(
+            job_id, md is not None and md.status == "RUNNING")
+        endpoints = bundle.get("endpoints") or []
         if not endpoints:
             return ""
         proxy = str(self.cache.get_config(job_id).get(
             "tony.proxy.url", "") or "")
         items = []
         for p in endpoints:
-            task = html.escape(f'{p.get("task_type", "serving")}:'
-                               f'{p.get("task_index", 0)}')
+            task = html.escape(str(p.get("task_id", "serving:0")))
             url = str(p.get("url", ""))
+            badge = ""
+            if p.get("draining"):
+                badge = ' <b style="color:#c0392b">[DRAINING]</b>'
+            gen = int(p.get("generation", 0) or 0)
+            gen_txt = f" (weights gen {gen})" if gen > 0 else ""
             if proxy:
                 items.append(
                     f'<li>{task}: <a href="{html.escape(proxy)}">'
-                    f'{html.escape(url)}</a> (via proxy)</li>')
+                    f'{html.escape(url)}</a> (via proxy)'
+                    f'{gen_txt}{badge}</li>')
             else:
                 items.append(f'<li>{task}: <a href="{html.escape(url)}">'
-                             f'{html.escape(url)}</a></li>')
-        return ("<h3>Serving endpoints</h3><ul>"
-                + "".join(items) + "</ul>")
+                             f'{html.escape(url)}</a>{gen_txt}{badge}</li>')
+        out = [f"<h3>Serving fleet ({bundle.get('source', 'history')})"
+               "</h3><ul>" + "".join(items) + "</ul>"]
+        scaling = bundle.get("scaling_events") or []
+        if scaling:
+            from tony_tpu.events.render import render_event
+            out.append("<p>recent fleet lifecycle:</p><ul>")
+            for ev in scaling[-8:]:
+                out.append("<li>" + html.escape(render_event(
+                    ev["type"], ev["payload"])) + "</li>")
+            out.append("</ul>")
+        return "".join(out)
 
     def _config(self, job_id: str) -> None:
         conf = self.cache.get_config(job_id)

@@ -173,11 +173,32 @@ class ClusterServiceClient(_JsonRpcClient):
     def register_tensorboard_url(self, task_id: str, url: str) -> None:
         self.call("register_tensorboard_url", {"task_id": task_id, "url": url})
 
-    def register_serving_endpoint(self, task_id: str, url: str) -> None:
+    def register_serving_endpoint(self, task_id: str, url: str,
+                                  weights_generation: int = 0,
+                                  draining: bool = False) -> None:
         """A serving task announces its live HTTP endpoint (serve/):
-        recorded by the AM in history + task infos."""
-        self.call("register_serving_endpoint",
-                  {"task_id": task_id, "url": url})
+        recorded by the AM in history + task infos. `weights_generation`
+        stamps the rollout epoch this replica serves (0 = the AM's
+        current epoch); `draining=True` re-registers the endpoint as
+        connection-draining (relaunch/preemption ahead) so the fleet
+        router stops routing new requests to it."""
+        req = {"task_id": task_id, "url": url}
+        if weights_generation > 0:
+            req["weights_generation"] = int(weights_generation)
+        if draining:
+            req["draining"] = True
+        self.call("register_serving_endpoint", req)
+
+    def request_rolling_update(self, generation: int = 0,
+                               requested_by: str = "operator") -> dict:
+        """Begin a zero-downtime rolling weight update over this app's
+        serving replicas (cli rollout verb). Client-plane: never a task
+        token."""
+        return self.call("request_rolling_update",
+                         {"generation": int(generation),
+                          "requested_by": requested_by},
+                         retries=1, timeout_sec=10.0,
+                         wait_for_ready=False)
 
     def register_execution_result(self, exit_code: int, job_name: str,
                                   job_index: int, session_id: int,

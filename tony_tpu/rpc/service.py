@@ -47,6 +47,7 @@ CLUSTER_METHODS = (
     "get_skew",
     "get_alerts",
     "request_preemption",
+    "request_rolling_update",
 )
 METRICS_METHODS = ("update_metrics",)
 TASK_LOG_METHODS = ("read_log",)
@@ -97,9 +98,14 @@ class ClusterServiceHandler(abc.ABC):
 
     @abc.abstractmethod
     def register_serving_endpoint(self, req: dict) -> dict:
-        """req: {task_id, url} -> {}. A serving task's HTTP frontend came
-        up at `url`; the AM records it (history event + task infos) so the
-        portal/proxy/client can reach the endpoint."""
+        """req: {task_id, url, weights_generation?, draining?} -> {}. A
+        serving task's HTTP frontend came up at `url` (or, with
+        draining=true, announced it is connection-draining ahead of a
+        relaunch/preemption); the AM records it (history event + task
+        infos) so the portal/proxy/fleet router can reach — or route
+        around — the endpoint. weights_generation stamps the weight
+        rollout epoch this replica serves (0 = the AM's current
+        epoch)."""
 
     @abc.abstractmethod
     def register_execution_result(self, req: dict) -> dict:
@@ -161,6 +167,19 @@ class ClusterServiceHandler(abc.ABC):
         containers still running at the deadline are force-stopped.
         Idempotent: a second request returns the in-flight drain's
         deadline. Client-plane only; task tokens fail closed."""
+
+    @abc.abstractmethod
+    def request_rolling_update(self, req: dict) -> dict:
+        """Operator/client plane: req {generation?, requested_by?} ->
+        {app_id, generation, replicas} (or {error}). Begin a
+        zero-downtime rolling weight update over the serving replicas:
+        one at a time, each endpoint is marked draining (the fleet
+        router stops new sends), its container relaunches (restoring
+        the latest promoted checkpoint), and the rollout advances only
+        once the replacement re-registers healthy at the new
+        generation. generation 0 = bump the AM's epoch by one.
+        Idempotent while a rollout is in flight (returns the in-flight
+        one). Client-plane only; task tokens fail closed."""
 
     @abc.abstractmethod
     def request_profile(self, req: dict) -> dict:

@@ -60,6 +60,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--eos-id", type=int, default=-1,
                    help="eos token id latching a row (-1 = none)")
+    p.add_argument("--weights-generation", type=int, default=0,
+                   help="weights rollout epoch this replica serves "
+                        "(0 = $TONY_SERVING_WEIGHTS_GENERATION, else "
+                        "the AM stamps its current epoch)")
     return p
 
 
@@ -97,10 +101,12 @@ def _load_model(args):
     return params, config
 
 
-def _register_endpoint(url: str, env) -> None:
-    """Tell the AM where this server listens (no-op outside the
-    orchestrator). Same lazily-available env contract as the trainer's
-    metrics reporter."""
+def _register_endpoint(url: str, env, weights_generation: int = 0,
+                       draining: bool = False) -> None:
+    """Tell the AM where this server listens — or, with draining=True,
+    that it is connection-draining ahead of shutdown, so the fleet
+    router stops new sends (no-op outside the orchestrator). Same
+    lazily-available env contract as the trainer's metrics reporter."""
     from tony_tpu import constants as C
     host, port = env.get(C.AM_HOST), env.get(C.AM_PORT)
     if not host or not port:
@@ -110,10 +116,17 @@ def _register_endpoint(url: str, env) -> None:
     task_id = f"{env.get(C.JOB_NAME, 'serving')}:{env.get(C.TASK_INDEX, '0')}"
     token = env.get(TOKEN_ENV) or None
     client = ClusterServiceClient(host, int(port), auth_token=token,
-                                  task_auth_id=task_id if token else None)
+                                  task_auth_id=task_id if token else None,
+                                  # the drain announcement runs inside
+                                  # the TERM grace window: one fast try,
+                                  # never a retry ladder
+                                  retries=1 if draining else 10)
     try:
-        client.register_serving_endpoint(task_id, url)
-        LOG.info("registered serving endpoint %s with the AM", url)
+        client.register_serving_endpoint(
+            task_id, url, weights_generation=weights_generation,
+            draining=draining)
+        LOG.info("registered serving endpoint %s with the AM%s", url,
+                 " (draining)" if draining else "")
     except Exception:  # noqa: BLE001 — registration is observability
         LOG.exception("failed to register serving endpoint")
     finally:
@@ -150,6 +163,8 @@ def main(argv=None) -> int:
         args.token_budget or conf.get_int(K.SERVING_TOKEN_BUDGET, 2048),
         config.max_seq)
 
+    weights_generation = args.weights_generation \
+        or int(env.get(C.SERVING_WEIGHTS_GENERATION, "0") or 0)
     from tony_tpu.serve.engine import ContinuousBatchingEngine
     from tony_tpu.serve.frontend import ServeFrontend
     engine = ContinuousBatchingEngine(
@@ -157,7 +172,8 @@ def main(argv=None) -> int:
         queue_depth=queue_depth, temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p,
         eos_id=args.eos_id if args.eos_id >= 0 else None,
-        quant_cache=args.quant_cache)
+        quant_cache=args.quant_cache,
+        weights_generation=weights_generation)
     # per-request trace spans: each finished request becomes a
     # `serve_request` span (queue_wait/prefill/decode attrs) on the same
     # job waterfall the trainer's phases render into. Only when a trace
@@ -199,7 +215,7 @@ def main(argv=None) -> int:
     # log-ok: greppable bring-up marker on RAW stdout (e2e tests + bench
     # drivers grep for it; it must not be wrapped in a JSON log line)
     print(f"SERVING_UP {url}", flush=True)
-    _register_endpoint(url, env)
+    _register_endpoint(url, env, weights_generation=weights_generation)
 
     from tony_tpu.train.metrics import ServingMetricsReporter
     reporter = ServingMetricsReporter(
@@ -220,6 +236,26 @@ def main(argv=None) -> int:
     try:
         stop.wait()
     finally:
+        # connection draining (the fleet contract): refuse new work,
+        # announce the drain to the AM (router stops new sends), finish
+        # in-flight streams inside a bound that fits the executor's
+        # TERM→KILL grace, THEN tear down — a relaunch/preemption/
+        # scale-down never cuts a client mid-token
+        engine.begin_drain()
+        _register_endpoint(url, env,
+                           weights_generation=weights_generation,
+                           draining=True)
+        drain_s = conf.get_time_ms(K.SERVING_FLEET_DRAIN_TIMEOUT_MS,
+                                   10_000) / 1000.0
+        if not engine.wait_drained(drain_s):
+            LOG.warning("drain window (%.1fs) expired with work still "
+                        "in flight", drain_s)
+        else:
+            # the engine finished into the handles; give the handler
+            # threads a beat to flush the final chunks down their
+            # (daemonic) sockets before the server closes
+            import time as _time
+            _time.sleep(0.2)
         reporter.close()
         frontend.stop()
         engine.stop()

@@ -67,6 +67,12 @@ class QueueFullError(RuntimeError):
     the frontend maps this to HTTP 429."""
 
 
+class DrainingError(RuntimeError):
+    """The engine is draining (connection-draining contract, serve/router):
+    in-flight requests finish, NEW submissions are refused — the frontend
+    maps this to HTTP 503 and the fleet router routes around it."""
+
+
 class BudgetExceededError(ValueError):
     """prompt + max_new_tokens exceeds the engine's per-slot token budget —
     a permanent rejection (429 retries would never help); HTTP 400."""
@@ -280,7 +286,8 @@ class ContinuousBatchingEngine:
                  queue_depth: int = 64, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0,
                  eos_id: Optional[int] = None, quant_cache: bool = False,
-                 seed: int = 0, queue_token_budget: int = 0):
+                 seed: int = 0, queue_token_budget: int = 0,
+                 weights_generation: int = 0):
         if token_budget <= 0:
             token_budget = config.max_seq
         if token_budget > config.max_seq:
@@ -318,6 +325,16 @@ class ContinuousBatchingEngine:
         self._lock = threading.Lock()
         self._work = threading.Event()      # submit() kicks the loop
         self._stop = threading.Event()
+        # connection draining (fleet router contract): once set, submit()
+        # refuses new work with DrainingError while in-flight requests run
+        # to completion. An Event, not a locked bool: the router's load
+        # probe reads it lock-free.
+        self._draining = threading.Event()
+        # weight-rollout epoch this replica serves (0 = unversioned): the
+        # rolling-update coordinator admits a new-generation replica and
+        # drains the old one; the load snapshot carries it so the router
+        # can tell the two apart
+        self.weights_generation = int(weights_generation)
         self._thread: Optional[threading.Thread] = None
         self.stats = EngineStats()
         # observability hook: called (outside the engine lock) with each
@@ -362,6 +379,10 @@ class ContinuousBatchingEngine:
             raise BudgetExceededError(
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"the per-slot token budget {self.token_budget}")
+        if self._draining.is_set():
+            # draining precedes stop: in-flight work finishes, new work is
+            # refused so the router fails it over to a healthy replica
+            raise DrainingError("engine is draining")
         with self._lock:
             if self._stop.is_set():
                 raise RuntimeError("engine is stopped")
@@ -392,6 +413,61 @@ class ContinuousBatchingEngine:
     def active_slots(self) -> int:
         with self._lock:
             return sum(1 for s in self._slots if s.active)
+
+    # -- draining + load probe ------------------------------------------
+    def begin_drain(self) -> None:
+        """Enter the draining state: in-flight requests (and anything
+        already queued) run to completion, new submissions raise
+        DrainingError. Idempotent; the load snapshot flips `draining`
+        immediately so the router's next probe routes around this
+        replica."""
+        if not self._draining.is_set():
+            LOG.info("engine draining: refusing new work, %d pending / "
+                     "%d active to finish", len(self._pending),
+                     sum(1 for s in self._slots if s.active))
+        self._draining.set()
+        self._work.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drained(self) -> bool:
+        """True once a draining engine holds no pending or in-flight
+        work — the point where a relaunch/preemption may stop it without
+        failing any request."""
+        with self._lock:
+            idle = not self._pending
+        return idle and not any(s.active for s in self._slots)
+
+    def wait_drained(self, timeout: float) -> bool:
+        """Bounded wait for drained() — the shutdown path's in-flight
+        grace. Polling, not a condition: drain is a rare lifecycle edge
+        and the stepper must never pay for its bookkeeping."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.drained():
+                return True
+            time.sleep(0.02)
+        return self.drained()
+
+    def load(self) -> dict:
+        """The router's load probe: queue depth, free slots, draining
+        state, weights generation. Deliberately LOCK-FREE — this is
+        served per probe per router while the stepper holds the engine
+        busy, and a momentarily stale count only costs one slightly
+        uneven routing decision, never correctness (len() and attribute
+        reads are atomic under the GIL; the hot path gains nothing to
+        contend with)."""
+        active = sum(1 for s in self._slots if s.handle is not None)
+        return {
+            "queue_depth": len(self._pending),
+            "slots_free": max(0, self.n_slots - active),
+            "active_slots": active,
+            "n_slots": self.n_slots,
+            "draining": self._draining.is_set(),
+            "weights_generation": self.weights_generation,
+        }
 
     # -- stepping -------------------------------------------------------
     def step(self) -> bool:
@@ -574,6 +650,8 @@ class ContinuousBatchingEngine:
                 "ttft_p95_s": _percentile(self.stats.ttft_s, 0.95),
                 "itl_p50_ms": None,
                 "token_budget": self.token_budget,
+                "draining": self._draining.is_set(),
+                "weights_generation": self.weights_generation,
             }
             itl = _percentile(self.stats.itl_s, 0.50)
             if itl is not None:

@@ -35,12 +35,13 @@ import json
 import logging
 import os
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from tony_tpu.serve.engine import (
-    BudgetExceededError, ContinuousBatchingEngine, QueueFullError,
+    BudgetExceededError, ContinuousBatchingEngine, DrainingError,
+    QueueFullError,
 )
 
 LOG = logging.getLogger(__name__)
@@ -115,6 +116,12 @@ class _Handler(BaseHTTPRequestHandler):
         path = parsed.path.rstrip("/")
         if path == "/healthz":
             return self._json({"ok": True})
+        if path == "/v1/load":
+            # the fleet router's probe: a lock-free engine snapshot
+            # (queue depth, free slots, draining, weights generation) —
+            # deliberately NOT /v1/metrics, whose full percentile render
+            # takes the engine lock per scrape
+            return self._json({"ok": True, **self.engine.load()})
         if path in ("/v1/metrics", "/metrics"):
             if path == "/metrics" or self._wants_prometheus(parsed.query):
                 from tony_tpu.observability.prometheus import CONTENT_TYPE
@@ -144,6 +151,25 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         path = urlparse(self.path).path.rstrip("/")
+        if path == "/v1/drain":
+            # operator plane: begin connection draining (in-flight
+            # requests finish, new submissions answer 503). Idempotent —
+            # the response is the post-drain load snapshot so the caller
+            # can poll queue_depth/active_slots down to zero. Drain is
+            # irreversible (it precedes a stop), so on a secured cluster
+            # it demands the task token — the request-plane endpoints
+            # stay open, but anonymous traffic must not be able to take
+            # the replica out of rotation (request_preemption parity).
+            self._drain_body()
+            import os
+
+            from tony_tpu.security.tokens import TOKEN_ENV
+            token = os.environ.get(TOKEN_ENV)
+            if token and self.headers.get(
+                    "Authorization", "") != f"Bearer {token}":
+                return self._error(403, "drain requires the task token")
+            self.engine.begin_drain()
+            return self._json({"ok": True, **self.engine.load()})
         if path != "/v1/generate":
             # consume the body before answering: HTTP/1.1 keep-alive
             # would otherwise parse the unread bytes as the next request
@@ -177,6 +203,11 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, str(e))
         except QueueFullError as e:
             return self._error(429, str(e), {"Retry-After": "1"})
+        except DrainingError as e:
+            # the connection-draining contract: the router treats this as
+            # "stop sending here" and fails the request over — the header
+            # makes the state machine-readable without re-probing
+            return self._error(503, str(e), {"X-Tony-Draining": "1"})
         except RuntimeError as e:           # engine stopped
             return self._error(503, str(e))
         if req.get("stream"):
@@ -260,8 +291,9 @@ class ServeFrontend:
     def __init__(self, engine: ContinuousBatchingEngine, port: int = 0,
                  host: str = "0.0.0.0"):
         self.engine = engine
+        from tony_tpu.serve.router import BurstBacklogHTTPServer
         handler = type("BoundHandler", (_Handler,), {"engine": engine})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = BurstBacklogHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="serve-http", daemon=True)

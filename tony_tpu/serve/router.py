@@ -1,0 +1,548 @@
+"""Fleet router: one HTTP front door over N serving replicas.
+
+One serving instance hard-caps throughput at its `n_slots` decode slots;
+`tony.serving.instances > 1` gives N independent endpoints (each
+registered with the AM via register_serving_endpoint). This module
+promotes that set to a **fleet**: a router that spreads `/v1/generate`
+across the replicas so clients see one endpoint whose capacity is the
+sum of the parts — the serving-side half of the MPMD-specialization
+story (arxiv 2412.14374) and, per arxiv 2011.03641's rule, built so the
+routing layer is never the reason decode slots idle:
+
+- **Least-loaded routing.** Replicas are ranked by live
+  ``(queue_depth, -slots_free)`` read off each engine's lock-free
+  ``/v1/load`` probe. A background prober keeps every endpoint's
+  snapshot fresher than the TTL (``tony.serving.fleet.probe-ttl-ms``),
+  so routing a request adds ZERO RPCs — the request path only ever
+  reads the cache, at any traffic rate (a lazy probe-on-request design
+  taxes exactly the low-rate requests that can least absorb it).
+- **Streaming passthrough.** ``stream=true`` responses are relayed
+  line-by-line as they arrive (the chunked JSON-lines framing is
+  preserved end to end), so the router adds no time-to-first-token
+  buffering.
+- **429 spill-over.** A replica answering 429 (bounded queue full) gets
+  its load probe invalidated and the request retries on the
+  next-least-loaded replica, up to ``spillover-retries`` times; only
+  when the WHOLE fleet sheds does the client see a 429.
+- **Connection draining.** A replica whose probe reports
+  ``draining: true`` (relaunch, preemption drain, rolling update, or
+  scale-down) stops receiving new sends immediately; its in-flight
+  requests — including open token streams — run to completion through
+  the sockets they already hold. Zero client-visible errors across a
+  replica drain is the contract (pinned by the chaos e2e).
+- **Dead-endpoint eviction.** ``dead-after-failures`` consecutive
+  probe/send failures mark a replica DOWN (SIGKILL, host loss); it
+  keeps being probed at the TTL cadence and re-admits itself the
+  moment a probe succeeds.
+
+The endpoint set is dynamic: ``set_endpoints`` diff-merges a new set
+(probe state survives for unchanged URLs), which is how the
+generation-bumped set from the AM — polled off ``get_task_infos``, the
+same channel the serving endpoints already ride — reaches the router
+without restarts. The AM's rolling-update state machine
+(application_master._check_rolling_update) builds the zero-downtime
+weight rollout on exactly these primitives: mark draining, relaunch,
+wait for the healthy re-registration at the new generation.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import urlparse
+
+LOG = logging.getLogger(__name__)
+
+# generous per-request relay ceiling (matches the frontend's stream stall
+# guard): deadness is detected by probes/connect failures, not by
+# starving a slow-but-live token stream
+RELAY_TIMEOUT_SEC = 300.0
+
+UP = "UP"
+DRAINING = "DRAINING"
+DOWN = "DOWN"
+
+
+class BurstBacklogHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a listen backlog sized for bursts: the
+    stdlib default of 5 overflows under a few dozen concurrent opens
+    and the spilled SYNs come back 1s/3s later (kernel retransmit) —
+    which reads as a fabricated multi-second TTFT tail. Shared by the
+    router's front door and the serving frontend (the router opens one
+    fresh connection per relayed request, so both sides burst
+    together)."""
+    request_queue_size = 128
+    daemon_threads = True
+
+
+@dataclass
+class Endpoint:
+    """One replica in the router's table: identity + cached probe state."""
+    url: str                    # http://host:port
+    task_id: str = ""
+    generation: int = 0         # weights/rollout generation (AM-stamped)
+    draining_hint: bool = False   # AM-side drain mark (endpoint set)
+    # probe cache (guarded by the router lock; the cached dict itself is
+    # read-only once stored)
+    load: Optional[dict] = None
+    probed_at: float = 0.0
+    failures: int = 0           # consecutive probe/send failures
+    sent: int = 0               # requests routed here (stats)
+
+    def state(self, dead_after: int) -> str:
+        if self.failures >= dead_after:
+            return DOWN
+        if self.draining_hint or bool((self.load or {}).get("draining")):
+            return DRAINING
+        return UP
+
+    def to_dict(self, dead_after: int) -> dict:
+        return {"url": self.url, "task_id": self.task_id,
+                "generation": self.generation,
+                "draining": self.draining_hint,
+                "state": self.state(dead_after),
+                "failures": self.failures, "sent": self.sent,
+                "load": self.load}
+
+
+def _normalize(spec) -> Endpoint:
+    if isinstance(spec, str):
+        return Endpoint(url=spec.rstrip("/"))
+    return Endpoint(url=str(spec.get("url", "")).rstrip("/"),
+                    task_id=str(spec.get("task_id", "") or ""),
+                    generation=int(spec.get("generation", 0) or 0),
+                    draining_hint=bool(spec.get("draining")))
+
+
+def endpoints_from_task_infos(infos: list[dict]) -> list[dict]:
+    """The AM's get_task_infos carries one `serving-endpoint` entry per
+    registered replica (url + generation + draining) — the fleet
+    router's endpoint-set source for orchestrated runs."""
+    return [{"url": i.get("url", ""), "task_id": i.get("task_id", ""),
+             "generation": int(i.get("generation", 0) or 0),
+             "draining": bool(i.get("draining"))}
+            for i in infos
+            if i.get("name") == "serving-endpoint" and i.get("url")]
+
+
+class FleetRouter:
+    """Least-loaded HTTP router over a dynamic serving-endpoint set.
+
+    Thread model: handler threads (one per in-flight client request)
+    share the endpoint table under one lock; the lock is held only for
+    table reads/updates — never across a probe or a relay, so a slow
+    replica cannot serialize the fleet.
+    """
+
+    def __init__(self, endpoints=(), port: int = 0,
+                 host: str = "0.0.0.0",
+                 probe_ttl_ms: int = 500,
+                 probe_timeout_ms: int = 1000,
+                 spillover_retries: int = 2,
+                 dead_after_failures: int = 2):
+        self.probe_ttl_s = max(probe_ttl_ms, 1) / 1000.0
+        self.probe_timeout_s = max(probe_timeout_ms, 50) / 1000.0
+        self.spillover_retries = max(0, spillover_retries)
+        self.dead_after_failures = max(1, dead_after_failures)
+        self._lock = threading.Lock()
+        self._endpoints: dict[str, Endpoint] = {}  # guarded-by: _lock
+        self._probing: set[str] = set()            # guarded-by: _lock
+        # router-level counters (guarded-by: _lock)
+        self.stats = {"requests_routed": 0, "requests_failed": 0,
+                      "spillovers_429": 0, "failovers_error": 0,
+                      "probe_failures": 0, "dead_evictions": 0,
+                      "set_updates": 0}
+        self.set_endpoints(list(endpoints))
+        handler = type("BoundRouterHandler", (_RouterHandler,),
+                       {"router": self})
+        self._httpd = BurstBacklogHTTPServer((host, port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="serve-router", daemon=True)
+        self._prober_stop = threading.Event()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        name="router-prober", daemon=True)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+        self._prober.start()
+        LOG.info("fleet router on port %d over %d endpoint(s)", self.port,
+                 len(self.endpoints()))
+
+    def stop(self) -> None:
+        self._prober_stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._prober.join(timeout=5.0)
+
+    def _probe_loop(self) -> None:
+        """Background probe refresh: every endpoint's snapshot is kept
+        fresher than the TTL so the ROUTING path never pays a probe RPC
+        (the design contract), drains/deaths are noticed without
+        needing traffic, and a DOWN replica re-admits itself the moment
+        it answers again. Endpoints refresh concurrently — one wedged
+        replica's timeout must not stale the others' snapshots."""
+        while not self._prober_stop.is_set():
+            with self._lock:
+                now = time.monotonic()
+                # one in-flight probe per endpoint, ever: a wedged
+                # replica (connect hangs to its timeout) must not
+                # accumulate a pile of stuck probe threads sweep after
+                # sweep — that pile IS load on the host the live
+                # replicas are sharing
+                due = [ep.url for ep in self._endpoints.values()
+                       if now - ep.probed_at >= self.probe_ttl_s / 2
+                       and ep.url not in self._probing]
+                self._probing.update(due)
+            for url in due:
+                threading.Thread(target=self._probe_once, args=(url,),
+                                 daemon=True).start()
+            self._prober_stop.wait(max(self.probe_ttl_s / 4, 0.01))
+
+    def _probe_once(self, url: str) -> None:
+        try:
+            self.probe(url, force=True)
+        finally:
+            with self._lock:
+                self._probing.discard(url)
+
+    # -- endpoint set ---------------------------------------------------
+    def set_endpoints(self, specs: list) -> None:
+        """Install a new endpoint set (diff-merge: probe state survives
+        for URLs present in both sets). This is the generation-bumped
+        set from the AM — a removed replica stops receiving new sends
+        instantly; its in-flight relays finish on their own sockets."""
+        fresh = {}
+        with self._lock:
+            for spec in specs:
+                ep = _normalize(spec)
+                if not ep.url:
+                    continue
+                known = self._endpoints.get(ep.url)
+                if known is not None:
+                    known.task_id = ep.task_id or known.task_id
+                    known.generation = ep.generation
+                    known.draining_hint = ep.draining_hint
+                    fresh[ep.url] = known
+                else:
+                    fresh[ep.url] = ep
+            self._endpoints = fresh
+            self.stats["set_updates"] += 1
+
+    def remove_endpoint(self, url: str) -> None:
+        with self._lock:
+            self._endpoints.pop(url.rstrip("/"), None)
+
+    def endpoints(self) -> list[dict]:
+        with self._lock:
+            return [ep.to_dict(self.dead_after_failures)
+                    for ep in self._endpoints.values()]
+
+    # -- load probe -----------------------------------------------------
+    def probe(self, url: str, force: bool = False) -> Optional[dict]:
+        """TTL-cached `/v1/load` read for one endpoint. Returns the load
+        dict, or None when the replica is unreachable (failure counted
+        toward dead-endpoint eviction)."""
+        with self._lock:
+            ep = self._endpoints.get(url.rstrip("/"))
+            if ep is None:
+                return None
+            now = time.monotonic()
+            if not force and now - ep.probed_at < self.probe_ttl_s:
+                return ep.load
+        try:
+            with urllib.request.urlopen(ep.url + "/v1/load",
+                                        timeout=self.probe_timeout_s) as r:
+                load = json.loads(r.read().decode("utf-8"))
+        except Exception:  # noqa: BLE001 — any probe failure = unreachable
+            self._note_failure(ep, "probe")
+            return None
+        with self._lock:
+            if ep.failures >= self.dead_after_failures:
+                LOG.info("endpoint %s back up (probe ok)", ep.url)
+            ep.load = load
+            ep.probed_at = time.monotonic()
+            ep.failures = 0
+        return load
+
+    def _note_failure(self, ep: Endpoint, kind: str) -> None:
+        with self._lock:
+            ep.failures += 1
+            ep.probed_at = time.monotonic()
+            ep.load = None
+            self.stats["probe_failures"] += 1
+            if ep.failures == self.dead_after_failures:
+                self.stats["dead_evictions"] += 1
+                LOG.warning("endpoint %s marked DOWN after %d consecutive "
+                            "%s failure(s)", ep.url, ep.failures, kind)
+
+    def invalidate(self, url: str) -> None:
+        """Drop the cached probe for one endpoint (a 429/503 response is
+        newer information than any cached snapshot)."""
+        with self._lock:
+            ep = self._endpoints.get(url.rstrip("/"))
+            if ep is not None:
+                ep.probed_at = 0.0
+
+    # -- routing --------------------------------------------------------
+    def candidates(self) -> list[Endpoint]:
+        """UP endpoints, least-loaded first: sort by (queue_depth,
+        -slots_free) off the prober-maintained snapshots — the request
+        path only READS the cache, it never pays a probe RPC (the one
+        exception: a just-installed endpoint nobody has probed yet gets
+        a one-time inline bootstrap probe). DOWN endpoints stay in the
+        prober's sweep so they re-admit themselves; a DRAINING endpoint
+        is excluded from new sends entirely."""
+        with self._lock:
+            eps = list(self._endpoints.values())
+        ranked = []
+        for ep in eps:
+            load = ep.load
+            if load is None and ep.probed_at == 0.0:
+                load = self.probe(ep.url)       # bring-up bootstrap only
+            if ep.state(self.dead_after_failures) != UP or load is None:
+                continue
+            ranked.append((int(load.get("queue_depth", 0)),
+                           -int(load.get("slots_free", 0)), ep.url, ep))
+        ranked.sort(key=lambda t: t[:3])
+        return [t[3] for t in ranked]
+
+    def fleet_load(self) -> dict:
+        """Aggregate load over UP+DRAINING replicas (the router's own
+        /v1/load — a fleet of routers can stack), read off the cached
+        snapshots."""
+        totals = {"queue_depth": 0, "slots_free": 0, "active_slots": 0,
+                  "n_slots": 0}
+        states = {UP: 0, DRAINING: 0, DOWN: 0}
+        with self._lock:
+            eps = list(self._endpoints.values())
+        for ep in eps:
+            load = ep.load
+            state = ep.state(self.dead_after_failures)
+            states[state] += 1
+            if load is not None and state != DOWN:
+                for key in totals:
+                    totals[key] += int(load.get(key, 0) or 0)
+        return {**totals, "endpoints_up": states[UP],
+                "endpoints_draining": states[DRAINING],
+                "endpoints_down": states[DOWN],
+                "draining": states[UP] == 0 and states[DRAINING] > 0}
+
+    # -- relay ----------------------------------------------------------
+    # tony: disable=redact-on-egress -- data-plane relay: the payload is the client's own /v1/generate body, verbatim
+    def relay(self, body: bytes, send_response: Callable) -> None:
+        """Route one /v1/generate body: try replicas least-loaded first,
+        spilling over on 429/5xx/transport errors. `send_response(status,
+        headers, upstream_or_bytes)` is the handler-side writer —
+        streaming is detected off the upstream Transfer-Encoding, never
+        by parsing the request body."""
+        tried: list[str] = []
+        last_429 = None
+        last_err: Optional[str] = None
+        for _ in range(1 + self.spillover_retries):
+            picks = [ep for ep in self.candidates()
+                     if ep.url not in tried]
+            if not picks:
+                break
+            ep = picks[0]
+            tried.append(ep.url)
+            req = urllib.request.Request(
+                ep.url + "/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                resp = urllib.request.urlopen(req,
+                                              timeout=RELAY_TIMEOUT_SEC)
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                if e.code == 429:
+                    # this replica is shedding: newer info than the cached
+                    # probe — invalidate and spill to the next-least-loaded
+                    last_429 = (e.code, dict(e.headers), payload)
+                    self.invalidate(ep.url)
+                    with self._lock:
+                        self.stats["spillovers_429"] += 1
+                    continue
+                if e.code in (500, 502, 503, 504):
+                    # draining/stopped/broken replica: fail over; a
+                    # draining 503 is not a deadness signal, just a
+                    # routing miss — re-probe will see draining=true
+                    self.invalidate(ep.url)
+                    last_err = f"{ep.url} answered {e.code}"
+                    with self._lock:
+                        self.stats["failovers_error"] += 1
+                    continue
+                # 4xx contract errors (400 bad request) are the CLIENT's:
+                # no replica would answer differently — relay verbatim
+                with self._lock:
+                    ep.sent += 1
+                    self.stats["requests_routed"] += 1
+                send_response(e.code, dict(e.headers), payload)
+                return
+            except Exception as e:  # noqa: BLE001 — transport failure
+                self._note_failure(ep, "send")
+                last_err = f"{ep.url} unreachable: {e}"
+                with self._lock:
+                    self.stats["failovers_error"] += 1
+                continue
+            with self._lock:
+                ep.sent += 1
+                self.stats["requests_routed"] += 1
+            send_response(resp.status, dict(resp.headers), resp)
+            return
+        with self._lock:
+            self.stats["requests_failed"] += 1
+        if last_429 is not None:
+            code, headers, payload = last_429
+            send_response(code, {"Retry-After":
+                                 headers.get("Retry-After", "1")}, payload)
+            return
+        detail = last_err or "no serving replica available"
+        send_response(503, {}, json.dumps(
+            {"error": f"fleet unavailable: {detail}",
+             "tried": tried}).encode("utf-8") + b"\n")
+
+    def bundle(self) -> dict:
+        """The /v1/fleet surface: endpoint table + router counters."""
+        with self._lock:
+            stats = dict(self.stats)
+        return {"endpoints": self.endpoints(), "stats": stats,
+                "load": self.fleet_load()}
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: FleetRouter                   # injected by FleetRouter
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        LOG.debug("router: " + fmt, *args)
+
+    def _json(self, obj, code: int = 200, extra: Optional[dict] = None
+              ) -> None:
+        data = (json.dumps(obj) + "\n").encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        path = urlparse(self.path).path.rstrip("/")
+        if path == "/healthz":
+            load = self.router.fleet_load()
+            return self._json({"ok": load["endpoints_up"] > 0, **load})
+        if path == "/v1/load":
+            return self._json({"ok": True, **self.router.fleet_load()})
+        if path == "/v1/fleet":
+            return self._json(self.router.bundle())
+        self._json({"error": "not found"}, 404)
+
+    def do_POST(self):  # noqa: N802
+        path = urlparse(self.path).path.rstrip("/")
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(length) if length > 0 else b""
+        if path != "/v1/generate":
+            return self._json({"error": "not found"}, 404)
+        self.router.relay(body, self._send_relayed)
+
+    def _send_relayed(self, status: int, headers: dict, payload) -> None:
+        """Write one upstream response through: bytes verbatim, file-like
+        bodies relayed line-by-line under chunked framing (streaming
+        passthrough — no buffering between replica and client)."""
+        chunked = str(headers.get("Transfer-Encoding", "")
+                      ).lower() == "chunked"
+        if isinstance(payload, (bytes, bytearray)):
+            self.send_response(status)
+            self.send_header("Content-Type",
+                             headers.get("Content-Type",
+                                         "application/json"))
+            self.send_header("Content-Length", str(len(payload)))
+            for k in ("Retry-After", "X-Tony-Draining"):
+                if headers.get(k):
+                    self.send_header(k, headers[k])
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        # file-like upstream (urllib response). Non-chunked: relay with
+        # Content-Length. Chunked: re-chunk line-by-line as data arrives.
+        if not chunked:
+            data = payload.read()
+            self.send_response(status)
+            self.send_header("Content-Type",
+                             headers.get("Content-Type",
+                                         "application/json"))
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        self.send_response(status)
+        self.send_header("Content-Type",
+                         headers.get("Content-Type", "application/json"))
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            for line in payload:      # urllib decodes upstream chunking
+                self.wfile.write(f"{len(line):x}\r\n".encode("ascii")
+                                 + line + b"\r\n")
+                self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            # client gone mid-stream: close our side; the replica's own
+            # broken-pipe handling cancels the request
+            self.close_connection = True
+        finally:
+            try:
+                payload.close()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                LOG.debug("upstream close failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# AM-backed endpoint watcher (orchestrated runs)
+# ---------------------------------------------------------------------------
+
+class AmEndpointWatcher:
+    """Polls the AM's get_task_infos for the serving-endpoint set and
+    diff-merges it into the router — endpoint registrations, drain marks
+    and generation bumps reach the router at the poll cadence without
+    the router ever becoming a control-plane participant."""
+
+    def __init__(self, router: FleetRouter, client,
+                 interval_s: float = 1.0):
+        self.router = router
+        self.client = client
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="router-am-watch",
+                                        daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def poll_once(self) -> int:
+        infos = self.client.get_task_infos()
+        eps = endpoints_from_task_infos(infos or [])
+        self.router.set_endpoints(eps)
+        return len(eps)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — AM mid-boot/restart
+                LOG.debug("endpoint poll failed", exc_info=True)
+            self._stop.wait(self.interval_s)

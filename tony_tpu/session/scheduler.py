@@ -106,6 +106,19 @@ class TaskScheduler:
         self.requestor.request_containers(
             dataclasses.replace(request, num_instances=1))
 
+    def schedule_scale_up(self, job_name: str) -> None:
+        """Request ONE container for a freshly ADDED task slot
+        (serving-fleet scale-up — session.add_task_instance appended the
+        slot): unlike schedule_replacement, the expected-task count grows,
+        so the rendezvous barrier waits for the newcomer too."""
+        request = self.session.requests[job_name]
+        LOG.info("requesting 1 extra %s instance (priority %d, now %d "
+                 "expected)", job_name, request.priority,
+                 self.session.num_expected_tasks + 1)
+        self.session.num_expected_tasks += 1
+        self.requestor.request_containers(
+            dataclasses.replace(request, num_instances=1))
+
     def register_dependency_completed(self, job_name: str) -> None:
         """One instance of `job_name` completed: decrement counters; release
         any job whose dependencies are all done

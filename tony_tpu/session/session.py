@@ -64,6 +64,12 @@ class Task:
         self.index = index
         self.session_id = session_id
         self.attempt = 0            # bumped by reset_for_relaunch
+        # attempts consumed by OPERATOR lifecycle (rolling weight
+        # updates), not failures: the attempt number still increments
+        # (zombie fencing needs it) but these never count against the
+        # failure budget — `cli rollout` twice must not eat a replica's
+        # crash-relaunch allowance
+        self.lifecycle_relaunches = 0
         self.host: str = ""
         self.port: int = -1
         self.container_id: str = ""
@@ -281,6 +287,50 @@ class TonySession:
             return (self.register_worker_spec(task_id, host_port),
                     self.spec_generation, task is not None)
 
+    def add_task_instance(self, job_name: str) -> Optional[Task]:
+        """Append ONE fresh task slot to a jobtype (serving-fleet
+        scale-up): the new slot matches its allocation through the same
+        unique-priority path as a first launch, and the barrier re-opens
+        until it registers (num_expected_tasks is bumped by the
+        scheduler's schedule_scale_up, which requests the container).
+        The request's instance count is kept in step so later
+        parse-derived views agree with the live table."""
+        with self._lock:
+            req = self.requests.get(job_name)
+            tasks = self.job_tasks.get(job_name)
+            if req is None or tasks is None:
+                LOG.error("cannot scale unknown jobtype %r", job_name)
+                return None
+            task = Task(job_name, len(tasks), self.session_id)
+            tasks.append(task)
+            req.num_instances += 1
+            self._invalidate_spec_cache()
+            LOG.info("added task slot %s (now %d %s instance(s))",
+                     task.task_id, req.num_instances, job_name)
+            return task
+
+    def remove_task_instance(self, job_name: str, task_id: str) -> bool:
+        """Abandon a never-launched trailing slot (a scale-up whose
+        container never arrived): the inverse of add_task_instance.
+        Refuses anything that ever held a container or registered — a
+        live replica leaves through the normal completion path."""
+        with self._lock:
+            tasks = self.job_tasks.get(job_name) or []
+            if not tasks:
+                return False
+            task = tasks[-1]
+            if (task.task_id != task_id or task.container_id
+                    or task.task_id in self._registered):
+                return False
+            tasks.pop()
+            self.requests[job_name].num_instances -= 1
+            self.num_expected_tasks -= 1
+            self._invalidate_spec_cache()
+            LOG.warning("abandoned task slot %s (allocation never "
+                        "arrived; now %d %s instance(s))", task_id,
+                        self.requests[job_name].num_instances, job_name)
+            return True
+
     def relaunch_task(self, job_name: str, index: int) -> Optional[Task]:
         """Invalidate a task's registration and recycle its slot for a
         replacement attempt. Bumps the cluster-spec generation so surviving
@@ -461,6 +511,17 @@ class TonySession:
 
     def num_completed_tracked_tasks(self) -> int:
         return sum(1 for j, tasks in self.job_tasks.items() if self.is_tracked(j)
+                   for t in tasks if t.completed)
+
+    def num_completed_barrier_tasks(self) -> int:
+        """Completed tracked tasks that are part of the gang RENDEZVOUS
+        — the relaunch barrier's input. Serving replicas are excluded:
+        they serve independently, never re-enter the barrier, and a
+        scaled-down replica's clean exit is routine fleet lifecycle
+        that must not disable crash relaunches for the rest of the
+        application."""
+        return sum(1 for j, tasks in self.job_tasks.items()
+                   if self.is_tracked(j) and j != C.SERVING_JOB_NAME
                    for t in tasks if t.completed)
 
     def all_tracked_tasks_completed(self) -> bool:
