@@ -630,6 +630,9 @@ def _make_cp_handler(session, monitor, on_result=None):
         def request_rolling_update(self, req):
             return {"error": "control-plane harness"}
 
+        def request_resize(self, req):
+            return {"error": "control-plane harness"}
+
     return _Handler()
 
 
@@ -789,9 +792,14 @@ def _control_plane_width(width: int, history_points: int = 64,
 
     def _survive(i):
         t1 = time.monotonic()
+        # a real survivor reports its OWN attempt (the storm victim sits
+        # at attempt N after N relaunch rounds; a hardcoded 0 would be
+        # zombie-fenced out of the diff protocol, correctly)
+        task = session.get_task_by_id(f"worker:{i}")
         resp = cluster[i % n_clients].call(
             "task_executor_heartbeat",
-            {"task_id": f"worker:{i}", "task_attempt": 0,
+            {"task_id": f"worker:{i}",
+             "task_attempt": task.attempt if task is not None else 0,
              "spec_generation": held_gen[i]},
             retries=1, timeout_sec=10.0)
         with hb_lock:
@@ -803,7 +811,8 @@ def _control_plane_width(width: int, history_points: int = 64,
             return
         held_gen[i] = diff["generation"]
         if i in sample:
-            sample[i] = apply_spec_diff(sample[i], diff["changed"])
+            sample[i] = apply_spec_diff(sample[i], diff["changed"],
+                                        diff.get("removed"))
 
     victim = 0
     for r in range(1, relaunch_rounds + 1):
@@ -830,6 +839,51 @@ def _control_plane_width(width: int, history_points: int = 64,
     # spec at rendezvous AND after every relaunch generation
     spec_bytes_full_equiv = (1 + relaunch_rounds) * width \
         * len(full_spec_json)
+
+    # ---- elastic resize roundtrip (cluster/elastic.py's control-plane
+    # cost): grow width -> width+K (newcomers register, every survivor
+    # converges via one membership diff), then shrink back (trailing
+    # slots removed, survivors converge via a removal diff) — the
+    # control-plane half of the resize round trip, with the quiesce/
+    # checkpoint time excluded by construction (stub tasks own no user
+    # process). Target: seconds — gated via bench_history as
+    # control_plane_resize_roundtrip.
+    k_resize = max(4, width // 16)
+    resize_t0 = time.monotonic()
+    added = []
+    for _ in range(k_resize):
+        t = session.add_task_instance("worker")
+        session.num_expected_tasks += 1   # the scheduler's role, inlined
+        added.append(t)
+    session.resize_bump_generation({t.task_id for t in added}, {})
+    _parallel(lambda i: cluster[i % n_clients].call(
+        "register_worker_spec",
+        {"task_id": f"worker:{i}", "spec": f"grown{i}:1",
+         "task_attempt": 0}), range(width, width + k_resize))
+    grow_registered = session.all_tasks_registered()
+    _parallel(_survive, range(width))
+    grow_s = time.monotonic() - resize_t0
+    shrink_t0 = time.monotonic()
+    removed = session.remove_task_slots("worker", k_resize)
+    session.resize_bump_generation(
+        set(), {"worker": {t.index for t in removed}})
+    for t in removed:
+        monitor.unregister(t.task_id)
+    _parallel(_survive, range(width))
+    shrink_s = time.monotonic() - shrink_t0
+    resize_roundtrip_s = time.monotonic() - resize_t0
+    resized_spec = session.cluster_spec_json() or "{}"
+    resize_checks = {
+        "grow_registered": grow_registered,
+        "shrunk_registered": session.all_tasks_registered(),
+        "slots_removed": len(removed) == k_resize,
+        "survivor_generations": all(
+            held_gen[i] == session.spec_generation
+            for i in range(width)),
+        "sample_specs": all(json.dumps(s) == resized_spec
+                            for s in sample.values()),
+    }
+    resize_converged = all(resize_checks.values())
 
     # decimation-boundedness drive: 3x the ring capacity of samples per
     # task through the REAL store path (in-process — the wire above
@@ -888,7 +942,8 @@ def _control_plane_width(width: int, history_points: int = 64,
     bounded = (max_points <= history_points
                and len(spans) <= max_spans
                and skew_bounded
-               and diff_converged)
+               and diff_converged
+               and resize_converged)
     hb_sorted = sorted(hb_times)
     out = {
         "width": width,
@@ -909,6 +964,14 @@ def _control_plane_width(width: int, history_points: int = 64,
             "fanout_reduction_x": round(
                 spec_bytes_full_equiv / max(1, spec_bytes_sent), 1),
             "diff_converged": diff_converged,
+        },
+        "resize": {
+            "delta_tasks": k_resize,
+            "grow_s": round(grow_s, 3),
+            "shrink_s": round(shrink_s, 3),
+            "roundtrip_s": round(resize_roundtrip_s, 3),
+            "converged": resize_converged,
+            "checks": resize_checks,
         },
         "rss_mb": _rss_mb(),
         "span_store": {"held": len(spans), "dropped": spans.dropped,
@@ -1204,6 +1267,7 @@ def control_plane_main() -> None:
     width's spec_bytes_sent / hb_p95_ms at top level; appends gated
     entries (control_plane_spec_bytes [bytes], control_plane_hb_p95
     [ms], control_plane_all_registered [s],
+    control_plane_resize_roundtrip [s],
     control_plane_real_all_running [s] — all lower-is-better) to
     tools/bench_history.jsonl for tools/bench_compare.py. Exits
     non-zero if AM-side state is unbounded, the diff protocol failed to
@@ -1218,7 +1282,8 @@ def control_plane_main() -> None:
         _mark(f"width {width}: all-registered "
               f"{rows[-1]['submit_to_all_registered_s']}s rss "
               f"{rows[-1]['rss_mb']}MB bounded={rows[-1]['bounded']} "
-              f"spec-fanout-x{rows[-1]['spec']['fanout_reduction_x']}")
+              f"spec-fanout-x{rows[-1]['spec']['fanout_reduction_x']} "
+              f"resize-roundtrip {rows[-1]['resize']['roundtrip_s']}s")
     real_rows = []
     for width in [int(w) for w in os.environ.get(
             "TONY_CP_REAL_WIDTHS", "48,256,1024").split(",") if w.strip()]:
@@ -1251,6 +1316,8 @@ def control_plane_main() -> None:
                  widest.get("heartbeat_p95_ms"), "ms"),
                 ("control_plane_all_registered",
                  widest.get("submit_to_all_registered_s"), "s"),
+                ("control_plane_resize_roundtrip",
+                 widest.get("resize", {}).get("roundtrip_s"), "s"),
                 ("control_plane_real_all_running",
                  (real_rows[-1].get("submit_to_all_running_s")
                   if real_rows else None), "s"),

@@ -1,0 +1,38 @@
+"""Elastic-resize gang member (tests/test_elastic.py membership e2e).
+
+Every start appends a marker line {attempt, generation, spec_width} to
+$MARKER_DIR/<job>_<idx> — spec_width is the gang width the rendered
+CLUSTER_SPEC carried, so the test can prove each user-process
+generation ran against the resized membership. Behavior: install a
+SIGTERM handler that exits 0 promptly (the quiesce drain's graceful
+path — a real Trainer would emergency-checkpoint here), then loop until
+$MARKER_DIR/done exists (the test's finish signal) and exit 0.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+job = os.environ["JOB_NAME"]
+index = int(os.environ["TASK_INDEX"])
+attempt = int(os.environ.get("TASK_ATTEMPT", "0"))
+generation = int(os.environ.get("SPEC_GENERATION", "0"))
+marker_dir = os.environ["MARKER_DIR"]
+spec = json.loads(os.environ.get("CLUSTER_SPEC", "{}") or "{}")
+spec_width = len(spec.get(job, []))
+
+os.makedirs(marker_dir, exist_ok=True)
+with open(os.path.join(marker_dir, f"{job}_{index}"), "a") as f:
+    f.write(json.dumps({"attempt": attempt, "generation": generation,
+                        "spec_width": spec_width}) + "\n")
+
+signal.signal(signal.SIGTERM, lambda s, fr: sys.exit(0))
+
+deadline = time.monotonic() + 120
+while time.monotonic() < deadline:
+    if os.path.isfile(os.path.join(marker_dir, "done")):
+        raise SystemExit(0)
+    time.sleep(0.05)
+raise SystemExit(1)

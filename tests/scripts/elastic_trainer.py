@@ -1,0 +1,75 @@
+"""Fixture: elastic-resize target (tests/test_elastic.py chaos e2e).
+
+A real sharded Trainer (mnist MLP; only w0 is fsdp-sharded — 784 splits
+evenly at widths 1..8, so the SAME script runs pre- and post-resize)
+that checkpoints every step and runs long enough for a mid-run quiesce
+to land. Each user-process generation writes its OWN report
+(`<name>_s<resumed_from>-<stopped_at>.json`) carrying the segment's
+per-step losses and the mesh width it trained at, so the e2e can stitch
+the full trajectory back together and compare it bit-for-bit against
+the checkpoint-stop-restart (evict-and-resume) twin at the same width
+schedule. On SIGTERM (the resize quiesce) the Trainer's emergency path
+commits one synchronous checkpoint and exits EXIT_PREEMPTED; the
+executor's armed respec relaunches this script against the new mesh."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ["TONY_REPO_ROOT"])
+
+import optax  # noqa: E402
+
+from tony_tpu.models.mnist import mnist_init, mnist_loss  # noqa: E402
+from tony_tpu.train.data import synthetic_mnist  # noqa: E402
+from tony_tpu.train.trainer import Trainer, TrainerConfig  # noqa: E402
+
+ckpt_dir = os.environ["CKPT_DIR"]
+report_dir = os.environ.get("REPORT_DIR", ckpt_dir)
+report_name = os.environ.get("REPORT_NAME", "report")
+total = int(os.environ.get("TOTAL_STEPS", "500"))
+# the evict-and-resume twin stops EARLY at a resize boundary but must
+# run the identical optimizer: the LR schedule's horizon comes from
+# TOTAL_STEPS, the stopping point from STOP_AT_STEP
+stop = int(os.environ.get("STOP_AT_STEP") or 0) or total
+
+# only w0 (784 x 300) shards along the mesh: 784 divides evenly at every
+# width this e2e resizes through, and the resharding restore still has
+# real multi-shard work to do
+param_axes = {"w0": ("embed", None), "w1": (None, None),
+              "w2": (None, None), "b0": (None,), "b1": (None,),
+              "b2": (None,)}
+
+schedule = optax.warmup_cosine_decay_schedule(0.0, 1e-2, 1, max(total, 2))
+trainer = Trainer(
+    loss_fn=mnist_loss, init_fn=mnist_init,
+    data_iter=synthetic_mnist(32),
+    config=TrainerConfig(num_steps=stop, log_every=1,
+                         checkpoint_every=1, checkpoint_dir=ckpt_dir,
+                         optimizer=optax.adamw(schedule,
+                                               weight_decay=0.01),
+                         prefetch_depth=0),
+    param_axes=param_axes)
+trainer.setup()
+resumed_from = trainer.step
+mesh_width = int(trainer.mesh.devices.size)
+
+rc = 0
+try:
+    trainer.run()
+except SystemExit as e:                      # the quiesce/preempt exit
+    rc = int(e.code or 0)
+
+os.makedirs(report_dir, exist_ok=True)
+name = f"{report_name}_s{resumed_from:04d}-{trainer.step:04d}.json"
+with open(os.path.join(report_dir, name), "w") as f:
+    json.dump({"resumed_from": resumed_from,
+               "stopped_at": trainer.step,
+               "mesh_width": mesh_width,
+               "preempted": trainer.preempted,
+               "losses": [[m["step"], m["loss"]]
+                          for m in trainer.metrics_history
+                          if "loss" in m]}, f)
+print(f"elastic trainer segment {resumed_from}->{trainer.step} at mesh "
+      f"width {mesh_width} (preempted={trainer.preempted}, rc={rc})",
+      flush=True)
+sys.exit(rc)

@@ -111,6 +111,56 @@ def test_spec_diff_single_and_multi_bump_union():
     assert s.spec_diff_since(0) == (None, True)
 
 
+def test_spec_diff_membership_size_changes_and_window_fallback():
+    """Elastic resize (cluster/elastic.py): a diff spanning generations
+    where indices were ADDED and REMOVED must serve the correct
+    membership delta — not just host:port rebinds — converge
+    bit-identically with the full render, and still fall back to a
+    refetch verdict once the bumps leave the retained window."""
+    from tony_tpu.session.session import SPEC_DIFF_WINDOW
+
+    s = _session(4)
+    for i in range(4):
+        s.register_worker_spec(f"worker:{i}", f"h{i}:{1000 + i}")
+    base = json.loads(s.cluster_spec_json())
+    g0 = s.spec_generation
+    # grow 4 -> 6: the two new slots register, one bump carries them
+    for _ in range(2):
+        t = s.add_task_instance("worker")
+        s.num_expected_tasks += 1
+        s.register_worker_spec(t.task_id, f"n{t.index}:{2000 + t.index}")
+    s.resize_bump_generation({"worker:4", "worker:5"}, {})
+    diff, refetch = s.spec_diff_since(g0)
+    assert not refetch
+    assert diff["changed"] == {"worker": {"4": "n4:2004", "5": "n5:2005"}}
+    assert "removed" not in diff
+    grown = apply_spec_diff(base, diff["changed"], diff.get("removed"))
+    assert json.dumps(grown) == s.cluster_spec_json()
+    g1 = s.spec_generation
+    # shrink 6 -> 3: trailing slots leave; the diff carries the removal
+    removed = s.remove_task_slots("worker", 3)
+    s.resize_bump_generation(set(), {"worker": {t.index for t in removed}})
+    diff, refetch = s.spec_diff_since(g1)
+    assert not refetch
+    assert diff["changed"] == {}
+    assert diff["removed"] == {"worker": [3, 4, 5]}
+    shrunk = apply_spec_diff(grown, diff["changed"], diff.get("removed"))
+    assert json.dumps(shrunk) == s.cluster_spec_json()
+    # a straggler spanning BOTH bumps: add-then-remove nets out, the
+    # genuinely-removed index survives as a removal
+    both, refetch = s.spec_diff_since(g0)
+    assert not refetch
+    assert both["changed"] == {}
+    assert sorted(both["removed"]["worker"]) == [3, 4, 5]
+    assert json.dumps(apply_spec_diff(base, both["changed"],
+                                      both.get("removed"))) \
+        == s.cluster_spec_json()
+    # outside the retained window: refetch, exactly like rebind diffs
+    for _ in range(SPEC_DIFF_WINDOW + 1):
+        s.resize_bump_generation(set(), {})
+    assert s.spec_diff_since(g0) == (None, True)
+
+
 def test_rebind_without_relaunch_rides_next_diff():
     """An executor re-registering at a NEW host:port without a relaunch
     bumps no generation, so no diff can carry the rebind on its own — it
@@ -449,6 +499,9 @@ class _HarnessHandler(ClusterServiceHandler):
         return {"error": "harness"}
 
     def request_rolling_update(self, req):
+        return {"error": "harness"}
+
+    def request_resize(self, req):
         return {"error": "harness"}
 
 

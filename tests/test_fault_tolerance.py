@@ -450,7 +450,8 @@ def test_executor_bounded_rerendezvous_gives_up(monkeypatch):
     monkeypatch.setattr(ex, "register_and_get_cluster_spec", fake_register)
     monkeypatch.setattr(ex, "_execute", fake_execute)
     monkeypatch.setattr(ex, "_report",
-                        lambda rc, barrier_timeout=False, preempted=False:
+                        lambda rc, barrier_timeout=False, preempted=False,
+                        resized=False:
                         reported.append((rc, barrier_timeout)))
     assert ex.run() == C.EXIT_FAILURE
     assert regs["n"] == 4                # 1 initial + 3 bounded rounds
@@ -623,7 +624,8 @@ def test_executor_releases_port_on_rendezvous_timeout(monkeypatch):
     monkeypatch.setattr(ex, "localize_resources", lambda: None)
     monkeypatch.setattr(ex, "register_and_get_cluster_spec", lambda: None)
     monkeypatch.setattr(ex, "_report",
-                        lambda rc, barrier_timeout=False, preempted=False: reported.append(
+                        lambda rc, barrier_timeout=False, preempted=False,
+                        resized=False: reported.append(
                             (rc, barrier_timeout)))
     assert ex.run() == C.EXIT_RENDEZVOUS_TIMEOUT
     assert reported == [(C.EXIT_RENDEZVOUS_TIMEOUT, True)]
@@ -653,7 +655,8 @@ def test_executor_respec_loop_restarts_user_process(monkeypatch):
     monkeypatch.setattr(ex, "register_and_get_cluster_spec", fake_register)
     monkeypatch.setattr(ex, "_execute", fake_execute)
     monkeypatch.setattr(ex, "_report",
-                        lambda rc, barrier_timeout=False, preempted=False:
+                        lambda rc, barrier_timeout=False, preempted=False,
+                        resized=False:
                         calls["reported"].append(rc))
     assert ex.run() == 0
     assert calls["reg"] == 2 and calls["exec"] == 2
@@ -683,7 +686,8 @@ def test_executor_probes_generation_after_collateral_exit(monkeypatch):
     monkeypatch.setattr(ex, "register_and_get_cluster_spec", fake_register)
     monkeypatch.setattr(ex, "_execute", fake_execute)
     monkeypatch.setattr(ex, "_report",
-                        lambda rc, barrier_timeout=False, preempted=False:
+                        lambda rc, barrier_timeout=False, preempted=False,
+                        resized=False:
                         calls["reported"].append(rc))
     monkeypatch.setattr(ex.client, "task_executor_heartbeat",
                         lambda tid, att=-1: {"spec_generation": 2})
@@ -703,7 +707,8 @@ def test_executor_genuine_failure_is_still_reported(monkeypatch):
                                  or {"worker": ["localhost:1"]}))
     monkeypatch.setattr(ex, "_execute", lambda env, t: 1)
     monkeypatch.setattr(ex, "_report",
-                        lambda rc, barrier_timeout=False, preempted=False:
+                        lambda rc, barrier_timeout=False, preempted=False,
+                        resized=False:
                         reported.append((rc, barrier_timeout)))
     monkeypatch.setattr(ex.client, "task_executor_heartbeat",
                         lambda tid, att=-1: {"spec_generation": 1})

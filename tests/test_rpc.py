@@ -95,6 +95,14 @@ class FakeClusterHandler(ClusterServiceHandler):
                 "grace_ms": int(req.get("grace_ms", 0) or 30_000),
                 "deadline_ms": int(req.get("grace_ms", 0) or 30_000)}
 
+    def request_resize(self, req):
+        self.resizes = getattr(self, "resizes", [])
+        self.resizes.append(req)
+        return {"app_id": "fake-app",
+                "job_name": req.get("job_name", "worker"),
+                "from_width": 2,
+                "to_width": int(req.get("width", 0) or 0)}
+
     def request_rolling_update(self, req):
         self.rollouts = getattr(self, "rollouts", [])
         self.rollouts.append(req)
@@ -146,9 +154,15 @@ def test_all_methods_round_trip(cluster):
                                 "job_index": 1, "session_id": 0,
                                 "task_attempt": -1,
                                 "barrier_timeout": False,
-                                "preempted": False}]
+                                "preempted": False,
+                                "resized": False}]
     c.task_executor_heartbeat("worker:1")
     assert handler.heartbeats == ["worker:1"]
+    resp = c.request_resize(job_name="worker", width=4,
+                            requested_by="operator")
+    assert resp["to_width"] == 4
+    assert handler.resizes[0]["width"] == 4
+    assert handler.resizes[0]["session_attempt"] == -1
     resp = c.request_preemption(grace_ms=5000, reason="drain",
                                 requested_by="operator")
     assert resp["grace_ms"] == 5000

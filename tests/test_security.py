@@ -58,6 +58,12 @@ class FakeHandler:
     def request_preemption(self, req):
         return {"app_id": "fake", "grace_ms": 1000, "deadline_ms": 1000}
 
+    def request_rolling_update(self, req):
+        return {"app_id": "fake", "generation": 1, "replicas": 0}
+
+    def request_resize(self, req):
+        return {"app_id": "fake", "from_width": 1, "to_width": 1}
+
 
 def test_token_file_roundtrip_and_mode(tmp_path):
     token = generate_token()

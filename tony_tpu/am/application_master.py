@@ -524,6 +524,14 @@ class ApplicationMaster(ClusterServiceHandler):
             except Exception:  # noqa: BLE001 — scaling must not block boot
                 LOG.exception("autoscaler init failed; disabled")
         self._wake = threading.Event()   # kick the monitor loop early
+        # elastic gang resize (cluster/elastic.py): grow/shrink the
+        # RUNNING training gang in place — quiesce → in-place emergency
+        # checkpoint → membership change behind a generation bump →
+        # survivors re-rendezvous via spec diffs → reshard-restore.
+        # Always constructed (cheap); tony.elastic.enabled gates every
+        # trigger inside it.
+        from tony_tpu.cluster.elastic import ElasticCoordinator
+        self.elastic = ElasticCoordinator(self)
         # timings (reference cadences, TonyConfigurationKeys.java:143-150)
         self._hb_interval_ms = conf.get_time_ms(K.TASK_HEARTBEAT_INTERVAL_MS, 1000)
         self._max_missed_hb = conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS, 25)
@@ -698,6 +706,10 @@ class ApplicationMaster(ClusterServiceHandler):
                 "help": "", "samples": [(labels, float(
                     self._preempt_count
                     + (1 if self._preemption is not None else 0)))]})
+            families.append({
+                "name": "tony_job_resizes_total", "type": "gauge",
+                "help": "", "samples": [(labels, float(
+                    self.elastic.resizes_total))]})
         families += REGISTRY.families()
         return render(families)
 
@@ -720,7 +732,8 @@ class ApplicationMaster(ClusterServiceHandler):
         per_task.update(self.metrics_store.latest_gauges())
         return aggregate_goodput(
             per_task, relaunch_downtime_s=downtime,
-            preemption_downtime_s=self._preemption_downtime_s)
+            preemption_downtime_s=self._preemption_downtime_s,
+            resize_downtime_s=self.elastic.downtime_s())
 
     def fleet_summary(self, state: str) -> dict:
         """The compact jobstate entry this AM contributes to the live
@@ -765,6 +778,7 @@ class ApplicationMaster(ClusterServiceHandler):
         preemptions = self._preempt_count \
             + (1 if self._preemption is not None else 0)
         gauges["tony_job_preemptions_total"] = float(preemptions)
+        gauges["tony_job_resizes_total"] = float(self.elastic.resizes_total)
         for q, gauge_name in fleet.STEP_TIME_GAUGES.items():
             if q in self._step_time_quantiles:
                 gauges[gauge_name] = self._step_time_quantiles[q]
@@ -778,10 +792,39 @@ class ApplicationMaster(ClusterServiceHandler):
         if tps:
             serving_tps = round(sum(tps), 3)
         from tony_tpu.conf.queues import app_priority
+        # elastic width surface: current vs requested gang width (a
+        # resize in flight shows its target fleet-wide), the resize
+        # count, and the reclaim floor the arbiter's
+        # reclaim-instead-of-evict verdict needs. requested_chips comes
+        # from the LIVE session (a resize moves it off the frozen conf).
+        width_fields = self.elastic.width_fields(gang_width)
+        elastic_job = ""
+        elastic_min_chips = 0
+        elastic_width = 0
+        elastic_cpt = 0
+        if self.elastic.enabled and session is not None:
+            elastic_job = self.elastic._default_job() or ""
+            if elastic_job:
+                req = session.requests[elastic_job]
+                elastic_width = req.num_instances
+                elastic_cpt = max(1, req.tpus)
+                elastic_min_chips = self.elastic.min_width * elastic_cpt
+        requested_chips = (sum(r.num_instances * r.tpus
+                               for r in session.requests.values())
+                           if session is not None
+                           else total_requested_tpus(self.conf))
         return fleet.job_summary(
             self.app_id, self.metadata.user, app_queue(self.conf), state,
             gang_width=gang_width,
-            requested_chips=total_requested_tpus(self.conf),
+            requested_width=width_fields["requested_width"],
+            resizes=self.elastic.resizes_total,
+            elastic_job=elastic_job,
+            elastic_width=elastic_width,
+            elastic_chips_per_task=elastic_cpt,
+            elastic_min_width=width_fields["elastic_min_width"],
+            elastic_max_width=width_fields["elastic_max_width"],
+            elastic_min_chips=elastic_min_chips,
+            requested_chips=requested_chips,
             allocated_chips=allocated,
             started_ms=self.metadata.started,
             goodput_pct=goodput_pct, mfu_pct=mfu,
@@ -1369,6 +1412,7 @@ class ApplicationMaster(ClusterServiceHandler):
             self._check_scaleup_timeouts()
             self._check_autoscaler()
             self._check_rolling_update()
+            self.elastic.check()
             self._publish_fleet_state()
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
@@ -1652,13 +1696,17 @@ class ApplicationMaster(ClusterServiceHandler):
             if verdict["action"] == "up":
                 chips = session.requests[C.SERVING_JOB_NAME].tpus
                 decision = self._autoscale_arbiter(chips)
-                if decision.action == "queue":
-                    # event + warning on the EDGE into the queued state
-                    # only: under sustained overload this branch runs
-                    # every monitor pass for hours, and per-pass
-                    # duplicates would bloat history/timelines the way
-                    # the alert engine's pending->firing dedup exists
-                    # to prevent
+                if decision.action in ("queue", "reclaim"):
+                    # neither verdict has freed chips YET: a reclaim
+                    # shrinks elastic victims in place and the chips
+                    # only exist once the registry shows them gone —
+                    # deliver it and re-ask next pass, exactly like the
+                    # preempt-then-re-ask flow. Event + warning on the
+                    # EDGE into the blocked state only: under sustained
+                    # overload this branch runs every monitor pass for
+                    # hours, and per-pass duplicates would bloat
+                    # history/timelines the way the alert engine's
+                    # pending->firing dedup exists to prevent.
                     if not self._autoscale_queued:
                         self._autoscale_queued = True
                         self.event_handler.emit(Event(
@@ -1667,10 +1715,29 @@ class ApplicationMaster(ClusterServiceHandler):
                                 C.SERVING_JOB_NAME, "up", len(replicas),
                                 len(replicas) + 1, chips=chips,
                                 arbiter_action=decision.action,
-                                victims=[],
+                                victims=[a.app_id for a, _
+                                         in decision.reclaims],
                                 reason=verdict["reason"], **ev)))
-                        LOG.warning("autoscale up blocked by the "
-                                    "arbiter: %s", decision.reason)
+                        LOG.warning("autoscale up %s by the arbiter: %s",
+                                    "waits on an elastic reclaim"
+                                    if decision.action == "reclaim"
+                                    else "blocked", decision.reason)
+                    # the reclaim DELIVERY re-sends every pass (like the
+                    # preempt branch re-executing each pass): a victim
+                    # whose cooldown refused the first ask, or a
+                    # transient RPC failure, must not stall the scale-up
+                    # forever — in-flight resizes dedup as `duplicate`
+                    if decision.reclaims:
+                        from tony_tpu.cluster.arbiter import \
+                            execute_reclaims
+                        execute_reclaims(
+                            decision.reclaims,
+                            grace_ms=self.conf.get_time_ms(
+                                K.ARBITER_GRACE_MS, 30_000),
+                            reason=f"reclaimed to scale "
+                                   f"{self.app_id} serving to "
+                                   f"{len(replicas) + 1} replicas",
+                            requested_by="autoscaler")
                     return      # no cooldown: re-ask next pass
                 self._autoscale_queued = False
                 self.event_handler.emit(Event(
@@ -2081,6 +2148,9 @@ class ApplicationMaster(ClusterServiceHandler):
         for cid in cids:
             self.backend.stop_container(cid)
         self.hb_monitor.clear()
+        # an in-flight resize dies with the session: the retry rebuilds
+        # the gang at the frozen conf's width
+        self.elastic.reset()
         # fresh gang, fresh skew books: the dead session's latches,
         # startup flags, and declined-remediation slots must not carry
         # into the retry (the task-relaunch path clears per-slot; a
@@ -2370,6 +2440,11 @@ class ApplicationMaster(ClusterServiceHandler):
         # (ApplicationMaster.java:753-764)
         if self._model_params is not None:
             env[C.MODEL_PARAMS] = self._model_params
+        # elastic resize: a container launched mid- or post-resize must
+        # run the CURRENT width's mesh, not the frozen conf's
+        mesh_override = self.elastic.mesh_override()
+        if mesh_override:
+            env[C.ELASTIC_MESH_SHAPE] = mesh_override
         # per-jobtype command override, else the global task command —
         # except `serving`, whose workload is built in: it runs the serve/
         # subsystem's server (knobs from tony.serving.*) unless
@@ -2436,6 +2511,22 @@ class ApplicationMaster(ClusterServiceHandler):
             # the attempt this completion belongs to, captured while the
             # container ownership check above still holds
             observed_attempt = task.attempt
+        # elastic resize: an exit of a container the coordinator released
+        # (shrink victim / rolled-back grow slot) is routine lifecycle —
+        # its slot left (or never joined) the gang table, so it must not
+        # complete, fail, or relaunch anything. Logs still aggregate:
+        # the drained attempt's output is evidence.
+        if self.elastic.is_released_container(container_id):
+            LOG.info("container %s of %s exited after elastic release "
+                     "(rc=%d)", container_id, task.task_id, exit_code)
+            self.hb_monitor.unregister(task.task_id)
+            self.metrics_store.clear_utilization_state(task.job_name,
+                                                       task.index)
+            self._task_span_end(task.task_id, observed_attempt, "OK",
+                                reason="resized away")
+            self._aggregate_task_container(task)
+            self._wake.set()
+            return
         # an exit observed while a preemption drain is in flight is the
         # drain completing (or the deadline force-stop), never a fault:
         # no failure record, no relaunch, and the completion below is
@@ -2928,6 +3019,31 @@ class ApplicationMaster(ClusterServiceHandler):
                      task.attempt)
             return {}
         exit_code = int(req["exit_code"])
+        # elastic shrink: a release victim's exit is the slot LEAVING the
+        # gang — terminal, never a fault: no failure record, no relaunch
+        # budget, and the slot is NOT completed (the coordinator removes
+        # it from the table once every member quiesced). Acknowledged
+        # only while a resize actually names this task a victim; a
+        # release racing a resize abort means the slot STAYS — relaunch
+        # it through the budget-exempt lifecycle path so the gang heals.
+        if req.get("resized") and task is not None:
+            if self.elastic.note_released(task_id, task.container_id):
+                LOG.info("task %s released for elastic shrink (rc=%d)",
+                         task_id, exit_code)
+                self.hb_monitor.unregister(task_id)
+                self._clear_profile_request(task_id)
+                self._drop_serving_endpoint(task_id)
+                self._task_span_end(
+                    task_id, attempt if attempt >= 0 else task.attempt,
+                    "OK", reason="resized away")
+                self._wake.set()
+                return {}
+            if self._maybe_relaunch_task(
+                    task, "elastic release raced a resize abort",
+                    observed_attempt=(attempt if attempt >= 0
+                                      else task.attempt),
+                    count_failure=False, force=True):
+                return {}
         # checkpoint-then-evict drain: the executor TERMed its user
         # process on the drain ask and the trainer emergency-checkpointed
         # — terminal, not a fault: no failure record, no relaunch budget,
@@ -3065,6 +3181,26 @@ class ApplicationMaster(ClusterServiceHandler):
         if session is not None:
             exec_gen = int(req.get("spec_generation", -1) or -1)
             resp.update(session.heartbeat_spec_fields(exec_gen))
+        # elastic resize: while a quiesce (or corrective revert) is in
+        # flight, the resize ask rides every member's heartbeat and the
+        # executor's quiesce ack rides back — the coordinator gates the
+        # membership change on every ack, so a new-width trainer can
+        # never restore before the in-place checkpoint committed.
+        # Lock-free `active` pre-check: a resize almost never exists and
+        # W pings/interval must not pay for the one that doesn't.
+        if self.elastic.active:
+            ask = self.elastic.heartbeat_fields(req["task_id"])
+            if ask:
+                resp["resize"] = ask
+            ack = int(req.get("resize_ack", 0) or 0)
+            if ack > 0:
+                self.elastic.note_quiesced(req["task_id"], ack)
+            # the generation a survivor reports holding is the evidence
+            # it re-rendezvoused: the coordinator closes the resize (and
+            # its downtime clock) on the gang being BACK, not merely on
+            # the membership books changing
+            self.elastic.note_generation(
+                req["task_id"], int(req.get("spec_generation", 0) or 0))
         # checkpoint-then-evict: the drain ask rides every heartbeat
         # while a preemption is in flight (resends are harmless — the
         # executor's drain is one-shot); grace_ms is the REMAINING
@@ -3140,6 +3276,26 @@ class ApplicationMaster(ClusterServiceHandler):
         self._wake.set()
         return {"app_id": self.app_id, "grace_ms": grace_ms,
                 "deadline_ms": grace_ms}
+
+    def request_resize(self, req: dict) -> dict:
+        """Arbiter/operator ask: elastic gang resize — grow/shrink the
+        running gang in place (cluster/elastic.py state machine).
+        Attempt-fenced: a resize aimed at a superseded session attempt
+        (the asker read a stale registry entry across an AM session
+        retry) must not fire on the retry's fresh gang — task ids and
+        widths repeat across session attempts, so the ask names the
+        attempt it was computed against."""
+        session = self.session
+        if session is None:
+            return {"error": "no active session"}
+        session_attempt = int(req.get("session_attempt", -1))
+        if session_attempt >= 0 and session_attempt != session.session_id:
+            LOG.warning("rejecting resize aimed at superseded session "
+                        "attempt %d (now %d)", session_attempt,
+                        session.session_id)
+            return {"error": f"stale session attempt {session_attempt} "
+                             f"(current {session.session_id})"}
+        return self.elastic.request_resize(req)
 
     def _schedule_preempt_if_testing(self) -> None:
         """TEST_TASK_PREEMPT='after_ms[#grace_ms]': the AM preempts

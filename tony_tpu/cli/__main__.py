@@ -37,6 +37,10 @@
 - rollout  — zero-downtime rolling weight update over a running app's
              serving replicas (request_rolling_update RPC): drain one,
              relaunch on the latest checkpoint, wait healthy, repeat.
+- resize   — elastic gang resize (request_resize RPC): grow/shrink a
+             running app's training gang in place — quiesce, in-place
+             emergency checkpoint, generation-bumped re-rendezvous,
+             reshard-restore; no evict, no resubmit.
 """
 
 from __future__ import annotations
@@ -50,7 +54,7 @@ from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
          "{submit|local|notebook|profile|logs|diagnose|stragglers"
-         "|alerts|top|preempt|arbiter|router|rollout} [args...]")
+         "|alerts|top|preempt|resize|arbiter|router|rollout} [args...]")
 
 
 def _am_client(app_dir: str):
@@ -468,7 +472,7 @@ def _render_fleet_frame(view) -> str:
     lines.append(f"fleet @ {view.location} — {len(live)} live job(s), "
                  f"{sum(chips_of(j) for j in live)} chip(s) in use")
     header = (f"{'APP':<36} {'QUEUE':<10} {'USER':<10} {'STATE':<9} "
-              f"{'W':>3} {'CHIPS':>5} {'GOOD%':>6} {'MFU%':>6} "
+              f"{'W':>7} {'CHIPS':>5} {'GOOD%':>6} {'MFU%':>6} "
               f"{'STRAG':>5} {'ALRT':>4} {'TOK/S':>7} {'HB':>5}")
     lines.append(header)
     for j in jobs:
@@ -478,12 +482,17 @@ def _render_fleet_frame(view) -> str:
         def _pct(v):
             return "-" if v is None else f"{float(v):.1f}"
 
+        # elastic width surface: "cur>req" while a resize is in flight
+        # (requested width diverges from current), bare width otherwise
+        cur_w = int(j.get("gang_width", 0) or 0)
+        req_w = int(j.get("requested_width", cur_w) or cur_w)
+        width_cell = f"{cur_w}>{req_w}" if req_w != cur_w else str(cur_w)
         lines.append(
             f"{str(j.get('app_id', ''))[:36]:<36} "
             f"{str(j.get('queue', ''))[:10]:<10} "
             f"{str(j.get('user', ''))[:10]:<10} "
             f"{str(j.get('state', '?')):<9} "
-            f"{int(j.get('gang_width', 0) or 0):>3} "
+            f"{width_cell:>7} "
             f"{chips_of(j):>5} "
             f"{_pct(j.get('goodput_pct')):>6} "
             f"{_pct(j.get('mfu_pct')):>6} "
@@ -645,17 +654,82 @@ def preempt(argv: list[str]) -> int:
     return 0 if not (resp or {}).get("error") else 1
 
 
+def resize(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli resize <app_dir> <job> <width>
+    [--tpus-per-task N] [--grace-ms N] [--reason ...]` — elastic gang
+    resize: grow/shrink a RUNNING application's training gang in place
+    (request_resize RPC): the gang quiesces, emergency-checkpoints in
+    place, re-renders its cluster spec at the new width behind a
+    generation bump, and reshard-restores — no evict, no resubmit.
+    `width` is the jobtype's task-instance count; `--tpus-per-task`
+    instead re-meshes the chips of a fixed-membership gang (pass width
+    0 with it)."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli resize")
+    parser.add_argument("app_dir",
+                        help="the application dir the client created "
+                             "(holds the amhostport file)")
+    # job is REQUIRED on the CLI: with both positionals optional,
+    # `cli resize <app> 8` would silently bind job="8" and drop the
+    # width. (The RPC itself still accepts an empty job_name — the AM
+    # then picks the widest tracked training jobtype.)
+    parser.add_argument("job",
+                        help="the elastic jobtype (e.g. worker)")
+    parser.add_argument("width", nargs="?", type=int, default=0,
+                        help="target task-instance count (0 with "
+                             "--tpus-per-task)")
+    parser.add_argument("--tpus-per-task", type=int, default=0,
+                        help="re-mesh the per-task chip count instead "
+                             "of changing membership")
+    parser.add_argument("--grace-ms", type=int, default=0,
+                        help="quiesce/checkpoint window (0 = "
+                             "tony.elastic.quiesce-grace-ms)")
+    parser.add_argument("--session-attempt", type=int, default=-1,
+                        help="fence the ask to one AM session attempt "
+                             "(-1 = current)")
+    parser.add_argument("--reason", default="operator resize")
+    args = parser.parse_args(argv)
+    if not args.width and not args.tpus_per_task:
+        print("resize: pass a width or --tpus-per-task", file=sys.stderr)
+        return 2
+    client, err = _am_client(args.app_dir)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
+    try:
+        resp = client.request_resize(
+            job_name=args.job, width=args.width,
+            tpus_per_task=args.tpus_per_task, grace_ms=args.grace_ms,
+            reason=args.reason, requested_by="operator",
+            session_attempt=args.session_attempt)
+    except Exception as e:  # noqa: BLE001 — operator tool, report and exit
+        print(f"request_resize failed: {e}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+    print(json.dumps(resp or {}, indent=1))
+    return 0 if not (resp or {}).get("error") else 1
+
+
 def arbiter(argv: list[str]) -> int:
     """`python -m tony_tpu.cli arbiter <staging-location> --chips N
-    [--queue q --user u --priority p] [--queues-conf file] [--evict]`
-    — one gang-admission verdict against the LIVE fleet registry:
-    prints admit / queue / preempt (with the minimal victim set); with
-    --evict, delivers request_preemption to each victim's AM."""
+    [--queue q --user u --priority p] [--queues-conf file] [--evict]
+    [--offer-idle N]` — one gang-admission verdict against the LIVE
+    fleet registry: prints admit / reclaim (elastic jobs shrink in
+    place, preferred) / queue / preempt (with the minimal victim set);
+    with --evict, delivers request_resize shrinks to reclaim victims
+    and request_preemption to eviction victims. `--offer-idle N` is the
+    offer loop's edge instead: hand N idle chips to RUNNING elastic
+    jobs that can widen (the jobs the annotated
+    fleet.chips_idle_while_queued alert names)."""
     import argparse
     import json
 
     from tony_tpu.cluster.arbiter import (
-        Arbiter, GangAsk, execute_preemption,
+        Arbiter, GangAsk, execute_preemption, execute_reclaims,
+        offer_idle_chips,
     )
     from tony_tpu.conf import TonyConfiguration
     from tony_tpu.observability.fleet import FleetRegistry
@@ -664,8 +738,12 @@ def arbiter(argv: list[str]) -> int:
     parser.add_argument("location",
                         help="staging-store location the fleet registry "
                              "scans (tony.staging.location)")
-    parser.add_argument("--chips", type=int, required=True,
+    parser.add_argument("--chips", type=int, default=0,
                         help="the gang's summed chip ask (all-or-nothing)")
+    parser.add_argument("--offer-idle", type=int, default=0,
+                        help="offer this many idle chips to widenable "
+                             "elastic jobs (request_resize grow) instead "
+                             "of judging an ask")
     parser.add_argument("--queue", default="default")
     parser.add_argument("--user", default="")
     parser.add_argument("--priority", type=int, default=0)
@@ -682,25 +760,43 @@ def arbiter(argv: list[str]) -> int:
     conf = TonyConfiguration()
     if args.queues_conf:
         conf.merge_file(args.queues_conf, "arbiter-cli")
-    arb = Arbiter.from_conf(conf)
     registry = FleetRegistry(location=args.location)
     registry.refresh(force=True)
+    if args.offer_idle > 0:
+        delivered = offer_idle_chips(
+            registry.live_jobs(), args.offer_idle,
+            reason=f"operator offer of {args.offer_idle} idle chip(s)",
+            requested_by="arbiter")
+        print(json.dumps({"action": "offer", "offered": delivered},
+                         indent=1))
+        return 0
+    if args.chips <= 0:
+        print("arbiter: need --chips (or --offer-idle)", file=sys.stderr)
+        return 2
+    arb = Arbiter.from_conf(conf)
     arb.sync_from_fleet(registry.live_jobs())
     ask = GangAsk(app_id=args.app_id, chips=args.chips, queue=args.queue,
                   user=args.user, priority=args.priority)
     decision = arb.decide(ask)
     out = {"action": decision.action, "reason": decision.reason,
            "victims": [v.app_id for v in decision.victims],
+           "reclaims": [(a.app_id, chips)
+                        for a, chips in decision.reclaims],
            "free_chips": (arb.free_chips() if arb.total_chips > 0
                           else None),
            "total_chips": arb.total_chips or None,
            "running": sorted(arb.running)}
+    from tony_tpu.conf import keys as K
+    grace_ms = args.grace_ms or conf.get_time_ms(K.ARBITER_GRACE_MS,
+                                                 30_000)
+    if decision.action == "reclaim" and args.evict:
+        out["reclaimed"] = execute_reclaims(
+            decision.reclaims, grace_ms=grace_ms,
+            reason=f"reclaimed to admit {args.app_id} "
+                   f"(priority {args.priority}, {args.chips} chips)")
     if decision.action == "preempt" and args.evict:
-        from tony_tpu.conf import keys as K
         out["evicted"] = execute_preemption(
-            decision.victims,
-            grace_ms=args.grace_ms
-            or conf.get_time_ms(K.ARBITER_GRACE_MS, 30_000),
+            decision.victims, grace_ms=grace_ms,
             reason=f"preempted to admit {args.app_id} "
                    f"(priority {args.priority}, {args.chips} chips)")
     print(json.dumps(out, indent=1))
@@ -860,6 +956,8 @@ def main(argv: list[str] | None = None) -> int:
         return top(rest)
     if cmd == "preempt":
         return preempt(rest)
+    if cmd == "resize":
+        return resize(rest)
     if cmd == "arbiter":
         return arbiter(rest)
     if cmd == "router":

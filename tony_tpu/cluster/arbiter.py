@@ -48,6 +48,11 @@ LOG = logging.getLogger(__name__)
 ADMIT = "admit"
 QUEUE = "queue"
 PREEMPT = "preempt"
+# elastic reclaim (cluster/elastic.py): the ask fits after SHRINKING one
+# or more running elastic jobs toward their tony.elastic.min-width —
+# chips flow without any job losing its containers, so a reclaim is
+# strictly preferred over checkpoint-then-evicting anything whole
+RECLAIM = "reclaim"
 
 
 @dataclass
@@ -60,6 +65,16 @@ class GangAsk:
     priority: int = 0
     started_ms: int = 0
     am_addr: str = ""           # victim control plane (fleet registry)
+    # elastic surface (cluster/elastic.py): the resizable jobtype, ITS
+    # OWN shape (gang_width spans every tracked jobtype — a serving
+    # replica's chips must never blend into a worker slice's size), and
+    # the reclaim floor in chips ("" / 0 = not elastic — never
+    # reclaimed, only evicted whole)
+    elastic_job: str = ""
+    elastic_min_chips: int = 0
+    gang_width: int = 0
+    elastic_width: int = 0
+    elastic_cpt: int = 0        # chips per task of the elastic jobtype
 
     @classmethod
     def from_summary(cls, summary: dict) -> "GangAsk":
@@ -72,18 +87,53 @@ class GangAsk:
             user=str(summary.get("user", "") or ""),
             priority=int(summary.get("priority", 0) or 0),
             started_ms=int(summary.get("started_ms", 0) or 0),
-            am_addr=str(summary.get("am_addr", "") or ""))
+            am_addr=str(summary.get("am_addr", "") or ""),
+            elastic_job=str(summary.get("elastic_job", "") or ""),
+            elastic_min_chips=int(summary.get("elastic_min_chips", 0)
+                                  or 0),
+            gang_width=int(summary.get("gang_width", 0) or 0),
+            elastic_width=int(summary.get("elastic_width", 0) or 0),
+            elastic_cpt=int(summary.get("elastic_chips_per_task", 0)
+                            or 0))
+
+    @property
+    def chips_per_task(self) -> int:
+        """Reclaim granularity: an elastic shrink returns whole task
+        slices of the ELASTIC jobtype, never fractions of one. The
+        blended chips//gang_width ratio is only the fallback for
+        summaries that predate the scoped fields."""
+        if self.elastic_cpt > 0:
+            return self.elastic_cpt
+        return max(1, self.chips // max(1, self.gang_width))
+
+    @property
+    def reclaimable_chips(self) -> int:
+        """Chips an elastic shrink could free without dropping this job
+        below its declared floor (whole chips_per_task slices only) —
+        bounded by the elastic jobtype's OWN chips, not the app total."""
+        if not self.elastic_job or self.elastic_min_chips <= 0:
+            return 0
+        elastic_chips = (self.elastic_width * self.elastic_cpt
+                         if self.elastic_width > 0 and self.elastic_cpt > 0
+                         else self.chips)
+        room = max(0, min(self.chips, elastic_chips)
+                   - self.elastic_min_chips)
+        return room - room % self.chips_per_task
 
 
 @dataclass
 class Decision:
-    """decide()'s verdict: ADMIT (fits now), PREEMPT (fits after
-    evicting `victims`, already policy-minimal), or QUEUE (cannot fit
-    whole even with every eligible victim gone — the ask waits; nothing
-    is partially granted)."""
+    """decide()'s verdict: ADMIT (fits now), RECLAIM (fits after
+    shrinking the elastic jobs in `reclaims` toward their floors — no
+    job loses its containers), PREEMPT (fits after evicting `victims`,
+    already policy-minimal), or QUEUE (cannot fit whole even with every
+    eligible victim gone — the ask waits; nothing is partially
+    granted). Reclaim is judged FIRST: taking a slice from an elastic
+    job is always preferred over fully evicting anything."""
     action: str
     reason: str = ""
     victims: list = field(default_factory=list)   # [GangAsk]
+    reclaims: list = field(default_factory=list)  # [(GangAsk, chips)]
 
     @property
     def admitted(self) -> bool:
@@ -135,43 +185,59 @@ class Arbiter:
     def release(self, app_id: str) -> None:
         self.running.pop(app_id, None)
 
-    def used_chips(self, exclude: frozenset = frozenset()) -> int:
-        return sum(a.chips for a in self.running.values()
+    # `reduced` maps app_id -> chips an elastic reclaim would take away;
+    # the job keeps running at (chips - reduction) everywhere usage is
+    # charged — the arbiter's model of a shrink-in-place
+    def _chips_held(self, a: GangAsk, reduced: dict) -> int:
+        return max(0, a.chips - int(reduced.get(a.app_id, 0)))
+
+    def used_chips(self, exclude: frozenset = frozenset(),
+                   reduced: Optional[dict] = None) -> int:
+        reduced = reduced or {}
+        return sum(self._chips_held(a, reduced)
+                   for a in self.running.values()
                    if a.app_id not in exclude)
 
-    def free_chips(self, exclude: frozenset = frozenset()) -> int:
+    def free_chips(self, exclude: frozenset = frozenset(),
+                   reduced: Optional[dict] = None) -> int:
         if self.total_chips <= 0:
             return 1 << 30
-        return self.total_chips - self.used_chips(exclude)
+        return self.total_chips - self.used_chips(exclude, reduced)
 
     # -- constraints ---------------------------------------------------
-    def _queue_usage(self, queue: str, exclude: frozenset) -> int:
+    def _queue_usage(self, queue: str, exclude: frozenset,
+                     reduced: Optional[dict] = None) -> int:
         """Chips running in `queue` or any of its descendants (usage
         charges every ancestor, so a parent's view sums its subtree)."""
+        reduced = reduced or {}
         total = 0
         for a in self.running.values():
             if a.app_id in exclude:
                 continue
             if queue in queue_ancestry(a.queue, self.queues):
-                total += a.chips
+                total += self._chips_held(a, reduced)
         return total
 
-    def _user_usage(self, queue: str, user: str,
-                    exclude: frozenset) -> int:
-        return sum(a.chips for a in self.running.values()
+    def _user_usage(self, queue: str, user: str, exclude: frozenset,
+                    reduced: Optional[dict] = None) -> int:
+        reduced = reduced or {}
+        return sum(self._chips_held(a, reduced)
+                   for a in self.running.values()
                    if a.app_id not in exclude and a.user == user
                    and queue in queue_ancestry(a.queue, self.queues))
 
-    def _constraint_violation(self, ask: GangAsk,
-                              exclude: frozenset) -> Optional[str]:
+    def _constraint_violation(self, ask: GangAsk, exclude: frozenset,
+                              reduced: Optional[dict] = None
+                              ) -> Optional[str]:
         """First violated constraint for granting `ask` with `exclude`d
-        jobs gone, or None when it fits whole."""
+        jobs gone and `reduced` jobs shrunk, or None when it fits
+        whole."""
         if self.queues and ask.queue not in self.queues:
             return (f"unknown queue {ask.queue!r} (configured: "
                     f"{sorted(self.queues)})")
-        if self.free_chips(exclude) < ask.chips:
+        if self.free_chips(exclude, reduced) < ask.chips:
             return (f"pool: {ask.chips} chips asked, "
-                    f"{max(0, self.free_chips(exclude))} free of "
+                    f"{max(0, self.free_chips(exclude, reduced))} free of "
                     f"{self.total_chips}")
         for level in queue_ancestry(ask.queue, self.queues):
             spec = self.queues.get(level)
@@ -180,13 +246,13 @@ class Arbiter:
             cap = (spec.capacity_chips(self.total_chips, self.queues)
                    if self.total_chips > 0 and spec.capacity_share >= 0
                    else (1 << 30))
-            used = self._queue_usage(level, exclude)
+            used = self._queue_usage(level, exclude, reduced)
             if used + ask.chips > cap:
                 return (f"queue {level!r} capacity: {used} running + "
                         f"{ask.chips} asked > {cap} chips "
                         f"({spec.capacity_share:g}% share)")
             if spec.max_tpus_per_user >= 0 and ask.user:
-                uused = self._user_usage(level, ask.user, exclude)
+                uused = self._user_usage(level, ask.user, exclude, reduced)
                 if uused + ask.chips > spec.max_tpus_per_user:
                     return (f"user {ask.user!r} quota in queue "
                             f"{level!r}: {uused} running + {ask.chips} "
@@ -195,10 +261,22 @@ class Arbiter:
 
     # -- decisions -----------------------------------------------------
     def decide(self, ask: GangAsk) -> Decision:
-        """Pure verdict for one gang ask against the current book."""
+        """Pure verdict for one gang ask against the current book.
+        Elastic reclaim is judged BEFORE full eviction: shrinking a
+        lower-priority elastic job toward its floor keeps it running,
+        so it is always preferred over checkpoint-then-evicting a
+        non-elastic job whole."""
         violation = self._constraint_violation(ask, frozenset())
         if violation is None:
             return Decision(ADMIT, "fits whole")
+        reclaims = self._select_reclaims(ask)
+        if reclaims is not None:
+            return Decision(
+                RECLAIM,
+                f"fits after reclaiming "
+                f"{[(a.app_id, c) for a, c in reclaims]} chips from "
+                f"elastic job(s) ({violation})",
+                reclaims=reclaims)
         victims = self._select_victims(ask)
         if victims is not None:
             return Decision(
@@ -217,6 +295,53 @@ class Arbiter:
         if decision.admitted:
             self.running[ask.app_id] = ask
         return decision
+
+    def _select_reclaims(self, ask: GangAsk
+                         ) -> Optional[list[tuple[GangAsk, int]]]:
+        """Reclaim-only plan: shrink lower-priority ELASTIC jobs toward
+        their tony.elastic.min-width floors (whole chips-per-task
+        slices, lowest-priority-first, youngest-first within a
+        priority) until the ask fits whole; a reverse pass then hands
+        back any slice the later picks made unnecessary, so the plan is
+        minimal under the policy order. None = no reclaim-only plan
+        satisfies the ask (full eviction is judged next)."""
+        if not self.preemption_enabled:
+            return None
+        eligible = sorted(
+            (a for a in self.running.values()
+             if a.priority < ask.priority and a.reclaimable_chips > 0),
+            key=lambda a: (a.priority, -a.started_ms))
+        if not eligible:
+            return None
+        reductions: dict[str, int] = {}
+        order: list[GangAsk] = []
+        for a in eligible:
+            if self._constraint_violation(ask, frozenset(),
+                                          reductions) is None:
+                break
+            reductions[a.app_id] = a.reclaimable_chips
+            order.append(a)
+        if self._constraint_violation(ask, frozenset(),
+                                      reductions) is not None:
+            return None
+        # minimality: hand slices back newest-pick-first, one
+        # chips-per-task step at a time — no elastic job shrinks further
+        # than the final plan actually needs
+        for a in reversed(order):
+            step = a.chips_per_task
+            while reductions.get(a.app_id, 0) > 0:
+                trial = dict(reductions)
+                trial[a.app_id] -= step
+                if trial[a.app_id] <= 0:
+                    trial.pop(a.app_id)
+                if self._constraint_violation(ask, frozenset(),
+                                              trial) is None:
+                    reductions = trial
+                else:
+                    break
+        plan = [(a, reductions[a.app_id]) for a in order
+                if reductions.get(a.app_id, 0) > 0]
+        return plan or None
 
     def _select_victims(self, ask: GangAsk) -> Optional[list[GangAsk]]:
         """Minimal preemption set under the policy order: only jobs with
@@ -290,6 +415,110 @@ def execute_preemption(victims: list[GangAsk], grace_ms: int = 0,
         finally:
             client.close()
     return reached
+
+
+def execute_reclaims(reclaims: list, grace_ms: int = 0, reason: str = "",
+                     requested_by: str = "arbiter",
+                     auth_token: Optional[str] = None) -> list[str]:
+    """Deliver the reclaim half of a RECLAIM verdict: each elastic
+    victim's AM gets a request_resize shrinking it by the reclaimed
+    slice (sized via elastic.reclaim_rpc_args — whole task slices for
+    multi-task gangs, a re-mesh for single-task ones). The sibling of
+    execute_preemption, but nobody loses their containers. Returns the
+    app ids actually reached."""
+    from tony_tpu.cluster.elastic import reclaim_rpc_args
+    from tony_tpu.rpc.client import ClusterServiceClient
+    reached = []
+    for victim, chips in reclaims:
+        summary = {"gang_width": victim.gang_width, "app_id": victim.app_id,
+                   "allocated_chips": victim.chips,
+                   "elastic_job": victim.elastic_job,
+                   "elastic_width": victim.elastic_width,
+                   "elastic_chips_per_task": victim.elastic_cpt}
+        args = reclaim_rpc_args(summary, int(chips))
+        host, _, port = victim.am_addr.rpartition(":")
+        if args is None or not host or not port.isdigit():
+            LOG.warning("reclaim victim %s not reachable/sizable "
+                        "(am_addr=%r) — skipping", victim.app_id,
+                        victim.am_addr)
+            continue
+        client = ClusterServiceClient(host, int(port),
+                                      auth_token=auth_token)
+        try:
+            resp = client.request_resize(
+                grace_ms=grace_ms, reason=reason,
+                requested_by=requested_by, **args)
+            if not (resp or {}).get("error"):
+                reached.append(victim.app_id)
+                LOG.info("reclaim of %d chip(s) delivered to %s (%s)",
+                         chips, victim.app_id, victim.am_addr)
+            else:
+                LOG.warning("reclaim refused by %s: %s", victim.app_id,
+                            resp.get("error"))
+        except Exception:  # noqa: BLE001 — a dead AM releases via LOST
+            LOG.warning("could not reach reclaim victim %s at %s",
+                        victim.app_id, victim.am_addr, exc_info=True)
+        finally:
+            client.close()
+    return reached
+
+
+def offer_idle_chips(summaries: list[dict], idle_chips: int,
+                     reason: str = "", requested_by: str = "arbiter",
+                     auth_token: Optional[str] = None) -> list[dict]:
+    """The offer loop's delivery edge: hand `idle_chips` spare chips to
+    RUNNING elastic jobs that can widen (the candidates the annotated
+    `fleet.chips_idle_while_queued` alert names), widest-headroom
+    first. Each offer is a request_resize GROW against the job's AM;
+    the AM's own validation (bounds, cooldown, competing lifecycle) is
+    the final arbiter. Returns [{app_id, job_name, width}] actually
+    delivered."""
+    from tony_tpu.cluster.elastic import find_widenable
+    from tony_tpu.observability.fleet import chips_of
+    from tony_tpu.rpc.client import ClusterServiceClient
+    delivered = []
+    remaining = int(idle_chips)
+    for s in find_widenable(summaries):
+        if remaining <= 0:
+            break
+        # the ELASTIC jobtype's own shape (blended gang_width/chips_of
+        # would mis-size grows for mixed train+serve apps), with the
+        # blended ratio as the legacy-summary fallback
+        width = int(s.get("elastic_width", 0) or 0) \
+            or int(s.get("gang_width", 0) or 0)
+        cpt = int(s.get("elastic_chips_per_task", 0) or 0) \
+            or max(1, chips_of(s) // max(1, width))
+        grow = remaining // cpt
+        max_width = int(s.get("elastic_max_width", 0) or 0)
+        if max_width:
+            grow = min(grow, max_width - width)
+        if width <= 0 or grow <= 0:
+            continue
+        host, _, port = str(s.get("am_addr", "")).rpartition(":")
+        if not host or not port.isdigit():
+            continue
+        client = ClusterServiceClient(host, int(port),
+                                      auth_token=auth_token)
+        try:
+            resp = client.request_resize(
+                job_name=str(s.get("elastic_job", "")),
+                width=width + grow,
+                reason=reason or f"offer: {remaining} idle chip(s)",
+                requested_by=requested_by)
+            if not (resp or {}).get("error"):
+                delivered.append({"app_id": s.get("app_id"),
+                                  "job_name": s.get("elastic_job"),
+                                  "width": width + grow})
+                remaining -= grow * cpt
+            else:
+                LOG.info("offer refused by %s: %s", s.get("app_id"),
+                         resp.get("error"))
+        except Exception:  # noqa: BLE001 — an offer is best-effort
+            LOG.warning("could not offer chips to %s", s.get("app_id"),
+                        exc_info=True)
+        finally:
+            client.close()
+    return delivered
 
 
 def resume_conf_overrides(preempted_summary: dict) -> dict[str, str]:

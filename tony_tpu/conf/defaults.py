@@ -164,6 +164,13 @@ DEFAULTS = {
     K.ARBITER_TOTAL_TPUS: 0,          # 0 = sum of declared queue quotas
     K.ARBITER_GRACE_MS: 30_000,
     K.ARBITER_PREEMPTION_ENABLED: True,
+
+    # elastic gang resize (cluster/elastic.py)
+    K.ELASTIC_ENABLED: False,
+    K.ELASTIC_MIN_WIDTH: 1,
+    K.ELASTIC_MAX_WIDTH: 0,           # 0 = unbounded
+    K.ELASTIC_COOLDOWN_MS: 60_000,
+    K.ELASTIC_QUIESCE_GRACE_MS: 30_000,
     # fleet registry / chip-hour accounting (observability/fleet.py)
     K.FLEET_PUBLISH_INTERVAL_MS: 5000,
     K.FLEET_STALE_AFTER_MS: 30_000,

@@ -344,6 +344,30 @@ ARBITER_GRACE_MS = "tony.arbiter.grace-ms"
 # asks that don't fit whole simply queue (admission stays gang-atomic)
 ARBITER_PREEMPTION_ENABLED = "tony.arbiter.preemption-enabled"
 
+# --- elastic gang resize (cluster/elastic.py) ----------------------------
+# master switch: this application's training gang may be grown/shrunk in
+# place (quiesce → in-place checkpoint → re-render the cluster spec at
+# the new width behind a generation bump → reshard-restore → resume)
+# by the arbiter, an operator (`cli resize`), or a reclaim-instead-of-
+# evict verdict. Off (the default), request_resize answers an error and
+# the arbiter never selects this job for a reclaim.
+ELASTIC_ENABLED = "tony.elastic.enabled"
+# the narrowest gang width (task instances of the elastic jobtype) a
+# reclaim/shrink may drain this job down to — the job's floor in the
+# arbiter's reclaim-instead-of-evict victim selection
+ELASTIC_MIN_WIDTH = "tony.elastic.min-width"
+# the widest gang width a grow/offer may reach; 0 = unbounded
+ELASTIC_MAX_WIDTH = "tony.elastic.max-width"
+# minimum gap between two ARBITER-triggered resizes (offer/reclaim);
+# operator request_resize asks are exempt — a human override must never
+# be refused because an automatic resize just happened
+ELASTIC_COOLDOWN_MS = "tony.elastic.cooldown-ms"
+# quiesce window: how long the gang gets to stop its user processes and
+# commit the in-place emergency checkpoint before the resize is
+# abandoned (survivors self-heal back to the old width; the application
+# never fails over a resize)
+ELASTIC_QUIESCE_GRACE_MS = "tony.elastic.quiesce-grace-ms"
+
 # --- proxy ---------------------------------------------------------------
 # externally reachable base URL of an authenticated tony_tpu.proxy fronting
 # in-cluster HTTP endpoints (serving, notebook, TB). When set, the portal
@@ -404,7 +428,7 @@ RESERVED_SEGMENTS = frozenset({
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
     "profiling", "slo", "logs", "straggler", "fleet", "alerts",
-    "arbiter", "checkpoint", "autoscaler",
+    "arbiter", "checkpoint", "autoscaler", "elastic",
 })
 
 

@@ -71,6 +71,12 @@ TPU_MESH_SHAPE = "TPU_MESH_SHAPE"    # e.g. "2,2,1" — job-requested mesh axes
 TPU_MESH_AXES = "TPU_MESH_AXES"      # e.g. "dp,fsdp,tp"
 TPU_SLICE_ID = "TPU_SLICE_ID"        # multi-slice (DCN) slice index
 TPU_NUM_SLICES = "TPU_NUM_SLICES"
+# elastic gang resize (cluster/elastic.py): the mesh shape the CURRENT
+# width implies, overriding the frozen conf's TPU_MESH_SHAPE in every
+# (re)launched user process env. Rendered by the AM into containers
+# launched mid-resize; survivors receive the same value on the
+# heartbeat-piggybacked resize ask.
+ELASTIC_MESH_SHAPE = "TONY_ELASTIC_MESH_SHAPE"
 
 # Observability (observability/ subsystem): trace context rendered into
 # every child process env — trace_id = app_id; the parent span id is the

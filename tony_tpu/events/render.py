@@ -161,6 +161,48 @@ def _rolling_update_completed(p: dict) -> str:
             f"{p.get('duration_ms', 0)} ms{tail}")
 
 
+def _resize_requested(p: dict) -> str:
+    chips = ""
+    if p.get("from_chips") or p.get("to_chips"):
+        chips = (f" ({p.get('from_chips', 0)} -> "
+                 f"{p.get('to_chips', 0)} chips)")
+    return (f"elastic resize requested for "
+            f"{p.get('application_id', '?')}: "
+            f"{p.get('job_type', '?')} width {p.get('from_width', '?')} "
+            f"-> {p.get('to_width', '?')}{chips} by "
+            f"{p.get('requested_by', '') or 'operator'} "
+            f"({p.get('grace_ms', 0)} ms quiesce grace): "
+            f"{p.get('reason', '') or 'unspecified'}")
+
+
+def _resize_started(p: dict) -> str:
+    return (f"elastic resize started: {p.get('job_type', '?')} "
+            f"{p.get('from_width', '?')} -> {p.get('to_width', '?')} — "
+            f"quiescing {p.get('members', 0)} task(s) for the in-place "
+            f"checkpoint")
+
+
+def _resize_completed(p: dict) -> str:
+    delta = ""
+    if p.get("added_tasks"):
+        delta = f", +{p['added_tasks']} task(s)"
+    elif p.get("removed_tasks"):
+        delta = f", -{p['removed_tasks']} task(s)"
+    return (f"elastic resize completed: {p.get('job_type', '?')} "
+            f"{p.get('from_width', '?')} -> {p.get('to_width', '?')} in "
+            f"{p.get('duration_ms', 0)} ms{delta} — gang re-rendezvoused "
+            f"at the new width")
+
+
+def _resize_failed(p: dict) -> str:
+    tail = (" (rolled back to the old width)" if p.get("rolled_back")
+            else "")
+    return (f"elastic resize FAILED: {p.get('job_type', '?')} "
+            f"{p.get('from_width', '?')} -> {p.get('to_width', '?')} "
+            f"after {p.get('duration_ms', 0)} ms{tail}: "
+            f"{p.get('reason', '') or 'unspecified'}")
+
+
 RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.APPLICATION_INITED: _application_inited,
     EventType.APPLICATION_FINISHED: _application_finished,
@@ -181,6 +223,10 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.AUTOSCALE_DECISION: _autoscale_decision,
     EventType.ROLLING_UPDATE_STARTED: _rolling_update_started,
     EventType.ROLLING_UPDATE_COMPLETED: _rolling_update_completed,
+    EventType.RESIZE_REQUESTED: _resize_requested,
+    EventType.RESIZE_STARTED: _resize_started,
+    EventType.RESIZE_COMPLETED: _resize_completed,
+    EventType.RESIZE_FAILED: _resize_failed,
 }
 
 

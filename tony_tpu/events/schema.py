@@ -36,6 +36,10 @@ class EventType(str, enum.Enum):
     AUTOSCALE_DECISION = "AUTOSCALE_DECISION"
     ROLLING_UPDATE_STARTED = "ROLLING_UPDATE_STARTED"
     ROLLING_UPDATE_COMPLETED = "ROLLING_UPDATE_COMPLETED"
+    RESIZE_REQUESTED = "RESIZE_REQUESTED"
+    RESIZE_STARTED = "RESIZE_STARTED"
+    RESIZE_COMPLETED = "RESIZE_COMPLETED"
+    RESIZE_FAILED = "RESIZE_FAILED"
 
 
 @dataclass
@@ -292,6 +296,73 @@ class RollingUpdateCompleted:
 
 
 @dataclass
+class ResizeRequested:
+    """No reference equivalent (the reference's gang width was frozen at
+    submit): an elastic resize was asked of a RUNNING gang — by the
+    admission arbiter (idle-chip offer / reclaim-instead-of-evict), an
+    operator (`cli resize` → request_resize RPC), or a test hook. The
+    gang will quiesce, emergency-checkpoint in place, re-render its
+    cluster spec at the new width behind a generation bump, and
+    reshard-restore — no eviction, no resubmit."""
+    application_id: str
+    job_type: str               # the elastic jobtype being resized
+    from_width: int             # task instances before
+    to_width: int               # task instances after
+    from_chips: int = 0         # summed chips before (width x tpus/task)
+    to_chips: int = 0
+    reason: str = ""
+    requested_by: str = ""      # "arbiter" | "operator" | "test"
+    grace_ms: int = 0           # quiesce window
+
+
+@dataclass
+class ResizeStarted:
+    """The resize state machine left IDLE: the quiesce ask is riding
+    every member's heartbeat from here on — user processes TERM,
+    trainers commit the in-place emergency checkpoint, and executors
+    hold at the re-rendezvous barrier (containers stay alive)."""
+    application_id: str
+    job_type: str
+    from_width: int
+    to_width: int
+    members: int = 0            # tasks being quiesced (whole gang)
+
+
+@dataclass
+class ResizeCompleted:
+    """The gang re-rendezvoused at the new width: membership changed
+    (tasks added/removed, or per-task chips re-meshed), the generation-
+    bumped spec propagated via heartbeat diffs, and training resumed
+    from the quiesce checkpoint. `duration_ms` is the resize round-trip
+    (request → barrier re-closed) the goodput ledger prices as the
+    `resize` phase."""
+    application_id: str
+    job_type: str
+    from_width: int
+    to_width: int
+    duration_ms: int = 0
+    added_tasks: int = 0
+    removed_tasks: int = 0
+
+
+@dataclass
+class ResizeFailed:
+    """The resize did not complete: the quiesce window expired, a grow's
+    new containers never registered inside the window (rolled_back=True:
+    the added slots were abandoned and the gang settled back at the old
+    width — mirroring the autoscaler's abandoned scale-up), or
+    validation failed mid-flight. The application itself keeps running
+    either way — a resize is never allowed to fail the app."""
+    application_id: str
+    job_type: str
+    from_width: int
+    to_width: int
+    reason: str = ""
+    rolled_back: bool = False
+    duration_ms: int = 0
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -320,6 +391,10 @@ _PAYLOADS = {
     EventType.AUTOSCALE_DECISION: AutoscaleDecision,
     EventType.ROLLING_UPDATE_STARTED: RollingUpdateStarted,
     EventType.ROLLING_UPDATE_COMPLETED: RollingUpdateCompleted,
+    EventType.RESIZE_REQUESTED: ResizeRequested,
+    EventType.RESIZE_STARTED: ResizeStarted,
+    EventType.RESIZE_COMPLETED: ResizeCompleted,
+    EventType.RESIZE_FAILED: ResizeFailed,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
@@ -328,7 +403,8 @@ Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 StragglerDetected, StragglerCleared, AlertFiring,
                 AlertResolved, PreemptionRequested, Preempted, Resumed,
                 AutoscaleDecision, RollingUpdateStarted,
-                RollingUpdateCompleted]
+                RollingUpdateCompleted, ResizeRequested, ResizeStarted,
+                ResizeCompleted, ResizeFailed]
 
 
 @dataclass

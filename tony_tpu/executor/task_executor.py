@@ -58,13 +58,28 @@ def heartbeat_jitter_sec(task_index: int, interval_sec: float) -> float:
         * max(0.0, interval_sec)
 
 
-def apply_spec_diff(spec: dict, changed: dict) -> dict:
+def apply_spec_diff(spec: dict, changed: dict,
+                    removed: Optional[dict] = None) -> dict:
     """Patch a held cluster spec with a generation-keyed diff
-    ({jobtype: {index: host_port}}) — the executor-side half of the
-    heartbeat-piggybacked spec-diff protocol. Returns a NEW dict whose
+    ({jobtype: {index: host_port}} plus, for membership shrinks,
+    {jobtype: [removed indices]}) — the executor-side half of the
+    heartbeat-piggybacked spec-diff protocol. Removals apply first (the
+    session only ever removes TRAILING slots, so surviving entries keep
+    their indices); `changed` then rebinds/extends, so a grow's new
+    indices append past the current width. Returns a NEW dict whose
     JSON render is bit-identical to the AM's full render at the diff's
     generation (same job order, same entry order by index)."""
     out = {job: list(entries) for job, entries in spec.items()}
+    for job, idxs in (removed or {}).items():
+        entries = out.get(job)
+        if not entries:
+            continue
+        gone = {int(i) for i in idxs}
+        entries = [e for i, e in enumerate(entries) if i not in gone]
+        if entries:
+            out[job] = entries
+        else:
+            del out[job]
     for job, updates in (changed or {}).items():
         entries = out.setdefault(job, [])
         for idx_s, host_port in updates.items():
@@ -89,7 +104,7 @@ class Heartbeater(threading.Thread):
                  on_profile=None, log_addr: str = "", on_drain=None,
                  jitter_sec: float = 0.0, gen_source=None,
                  on_spec_diff=None, on_spec_ready=None,
-                 on_spec_refetch=None,
+                 on_spec_refetch=None, on_resize=None, ack_source=None,
                  failure_budget: int = C.MAX_CONSECUTIVE_FAILED_HEARTBEATS):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
@@ -120,6 +135,12 @@ class Heartbeater(threading.Thread):
         # the heartbeat response (the AM never opens a connection TO a
         # container — asks always ride this channel)
         self._on_drain = on_drain
+        # elastic gang resize: the quiesce/release ask rides the same
+        # channel; ack_source reports the newest resize id this executor
+        # has fully quiesced for (user process exited, emergency
+        # checkpoint committed) back to the AM on every ping
+        self._on_resize = on_resize
+        self._ack_source = ack_source
         # heartbeat-piggybacked on-demand profiler ask (observability/
         # perf.py): the executor relays it to the trainer via a cwd file
         self._on_profile = on_profile
@@ -149,10 +170,12 @@ class Heartbeater(threading.Thread):
                 continue
             try:
                 held_gen = int(self._gen_source()) if self._gen_source else -1
+                ack = int(self._ack_source()) if self._ack_source else 0
                 resp = self._client.task_executor_heartbeat(
                     self._task_id, self._task_attempt,
                     log_addr=self._log_addr,
-                    spec_generation=held_gen)
+                    spec_generation=held_gen,
+                    resize_ack=ack)
                 self._consecutive_failures = 0
                 generation = (resp or {}).get("spec_generation")
                 if generation and self._on_generation is not None:
@@ -175,6 +198,9 @@ class Heartbeater(threading.Thread):
                 drain = (resp or {}).get("drain")
                 if drain and self._on_drain is not None:
                     self._on_drain(drain)
+                resize = (resp or {}).get("resize")
+                if resize and self._on_resize is not None:
+                    self._on_resize(resize)
             except Exception:  # noqa: BLE001
                 self._consecutive_failures += 1
                 LOG.warning("heartbeat failed (%d consecutive)",
@@ -253,6 +279,20 @@ class TaskExecutor:
         # report a PREEMPTED (not failed) result
         self._drain_requested = False
         self._drain_lock = threading.Lock()
+        # elastic gang resize state (cluster/elastic.py): the newest
+        # resize ask id this executor has acted on (one-shot TERM per
+        # id), the id it has fully QUIESCED for (user process exited —
+        # emergency checkpoint committed — gossiped back to the AM on
+        # every heartbeat), whether this slot is a shrink victim being
+        # released (the run loop then reports a `resized` terminal
+        # result instead of re-entering the barrier), and the mesh
+        # shape the current width implies (overrides the frozen conf's
+        # TPU_MESH_SHAPE in every (re)launched user process env —
+        # containers launched mid-resize get it via TONY_ELASTIC_MESH_SHAPE)
+        self._resize_seen_id = 0      # guarded-by: _drain_lock
+        self._resize_ack = 0
+        self._resize_release = False
+        self._mesh_override = e.get(C.ELASTIC_MESH_SHAPE) or None  # guarded-by: _drain_lock
         self.host = current_host()
         self.port = 0
         self.tb_port: Optional[int] = None
@@ -420,6 +460,8 @@ class TaskExecutor:
                 on_profile=self._on_profile_request,
                 log_addr=self.log_addr,
                 on_drain=self._on_drain_request,
+                on_resize=self._on_resize_request,
+                ack_source=lambda: self._resize_ack,
                 jitter_sec=heartbeat_jitter_sec(self.task_index,
                                                 self.hb_interval_sec),
                 gen_source=lambda: self._spec_generation,
@@ -545,7 +587,8 @@ class TaskExecutor:
                     diff = None
             if diff is not None:
                 patched = apply_spec_diff(self._cluster_spec,
-                                          diff.get("changed") or {})
+                                          diff.get("changed") or {},
+                                          diff.get("removed") or {})
                 gen = int(diff["generation"])
                 with self._respec_lock:
                     self._spec_generation = gen
@@ -621,6 +664,75 @@ class TaskExecutor:
         threading.Thread(
             target=lambda: self._terminate_user_proc(grace),
             name="drain", daemon=True).start()
+
+    def _on_resize_request(self, ask: dict) -> None:
+        """Elastic gang resize: the heartbeat response carried the AM's
+        quiesce (or release) ask. One-shot PER RESIZE ID — the ask rides
+        every heartbeat while the resize is in flight, and a rollback's
+        corrective ask arrives under a fresh id, re-triggering the same
+        TERM→grace→relaunch cycle against the reverted width.
+
+        Survivors: arm a barrier re-entry (exactly the peer-relaunch
+        respec path — container and localized resources stay alive),
+        record the new width's mesh override, and TERM the user process
+        group so the trainer commits its in-place emergency checkpoint
+        inside the grace window. Once the process has exited the resize
+        id is acked back to the AM on the next heartbeat — the signal
+        the coordinator gates the membership change on, so a new-width
+        trainer can never restore before the quiesce checkpoint
+        committed.
+
+        Shrink victims (`release: true`): same TERM→checkpoint drain,
+        but the run loop then BREAKS and reports a `resized` terminal
+        result instead of re-entering the barrier — the slot is leaving
+        the gang."""
+        try:
+            rid = int(ask.get("id", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        if rid <= 0:
+            return
+        with self._drain_lock:
+            if rid <= self._resize_seen_id:
+                return
+            self._resize_seen_id = rid
+            mesh = ask.get("mesh_shape")
+            if mesh is not None:
+                self._mesh_override = str(mesh) or None
+            release = bool(ask.get("release"))
+            if release:
+                self._resize_release = True
+        raw = ask.get("grace_ms")
+        grace = (self._term_grace_sec if raw is None
+                 else max(0, int(raw)) / 1000.0)
+        LOG.warning("elastic resize ask %d (%s): %s — TERM→%.0fs "
+                    "grace→%s", rid,
+                    ask.get("reason", "") or "unspecified",
+                    "releasing this slot" if release
+                    else "quiescing for re-rendezvous", grace,
+                    "report" if release else "re-enter barrier")
+        if not release:
+            # survivor: the re-entry must be armed BEFORE the process
+            # dies, so the run loop re-rendezvouses instead of probing
+            # the (not yet bumped) generation and reporting a failure
+            with self._respec_lock:
+                self._respec_pending = True
+        threading.Thread(
+            target=lambda: self._quiesce_for_resize(rid, grace),
+            name="resize-quiesce", daemon=True).start()
+
+    def _quiesce_for_resize(self, rid: int, grace_sec: float) -> None:
+        """Helper thread (never the heartbeater — it must keep pinging
+        so the AM sees this task alive while it quiesces): TERM the
+        user process group, wait out the emergency-checkpoint grace,
+        then publish the ack the heartbeater gossips to the AM. With no
+        process running (still at the barrier) the TERM is a no-op and
+        the ack is immediate. Monotonic: a slow older quiesce thread
+        finishing late must never roll the ack back over a newer
+        (corrective-revert) resize id's."""
+        self._terminate_user_proc(grace_sec)
+        with self._drain_lock:
+            self._resize_ack = max(self._resize_ack, rid)
 
     def _take_respec(self) -> bool:
         with self._respec_lock:
@@ -824,6 +936,14 @@ class TaskExecutor:
                 env[C.IS_CHIEF] = str(self.is_chief).lower()
                 env[C.TASK_ATTEMPT] = str(self.task_attempt)
                 env[C.SPEC_GENERATION] = str(self._spec_generation)
+                # elastic resize: the current width's mesh shape wins
+                # over the frozen conf's TPU_MESH_SHAPE (delivered on
+                # the resize ask for survivors, via container env for
+                # tasks launched mid-resize)
+                with self._drain_lock:
+                    mesh_override = self._mesh_override
+                if mesh_override:
+                    env[C.TPU_MESH_SHAPE] = mesh_override
                 # checkpoint retention knob for the trainer's GC
                 # (tony.checkpoint.keep; train/checkpoint.py prunes
                 # committed steps past it after each commit)
@@ -864,6 +984,15 @@ class TaskExecutor:
                     # emergency checkpoint — this exit is the drain
                     # completing, never a fault and never a re-rendezvous
                     LOG.info("user process drained for preemption "
+                             "(rc=%d)", exit_code)
+                    break
+                if self._resize_release:
+                    # elastic shrink victim: the slot is leaving the
+                    # gang — the emergency checkpoint is committed, so
+                    # report a `resized` terminal result (never a fault,
+                    # never a re-rendezvous) and let the AM remove the
+                    # slot and stop this container
+                    LOG.info("user process released for elastic shrink "
                              "(rc=%d)", exit_code)
                     break
                 if not respec and exit_code != 0:
@@ -928,7 +1057,8 @@ class TaskExecutor:
             # fault — flag it so the AM spends no relaunch budget on it
             # (a superseded attempt's report is attempt-fenced anyway)
             self._report(exit_code, barrier_timeout=rendezvous_gave_up,
-                         preempted=self._drain_requested)
+                         preempted=self._drain_requested,
+                         resized=self._resize_release)
             return exit_code
         finally:
             # every exit path — including the rendezvous-timeout returns
@@ -969,10 +1099,10 @@ class TaskExecutor:
             # found no live process) and this launch — take the fresh
             # process down so the respec loop re-enters the barrier
             self._kill_user_proc()
-        if self._drain_requested:
-            # a drain ask landed before this launch (e.g. while still at
-            # the barrier): there is no progress to checkpoint — stop
-            # the fresh process so the drain completes immediately
+        if self._drain_requested or self._resize_release:
+            # a drain/release ask landed before this launch (e.g. while
+            # still at the barrier): there is no progress to checkpoint
+            # — stop the fresh process so the drain completes immediately
             self._kill_user_proc()
         from tony_tpu.executor.gpu_metrics import maybe_gpu_sampler
         from tony_tpu.executor.task_monitor import default_tpu_sampler
@@ -1025,18 +1155,19 @@ class TaskExecutor:
             self._kill_user_proc()
 
     def _report(self, exit_code: int, barrier_timeout: bool = False,
-                preempted: bool = False) -> None:
+                preempted: bool = False, resized: bool = False) -> None:
         if self.heartbeater is not None:
             self.heartbeater.stop()
         self._push_spans()
         # a failing exit ships its own post-mortem: classified signature +
         # redacted tail ride the result RPC, so the AM's diagnostics
         # bundle works even when it can't reach this container's files
-        # (off-host backends). A preempted drain is not a failure — no
-        # post-mortem to ship.
+        # (off-host backends). A preempted drain / elastic-shrink release
+        # is not a failure — no post-mortem to ship.
         diagnostics = None
-        if not preempted and exit_code not in (C.EXIT_SUCCESS,
-                                               C.EXIT_KILLED_BY_AM):
+        if not preempted and not resized \
+                and exit_code not in (C.EXIT_SUCCESS,
+                                      C.EXIT_KILLED_BY_AM):
             diagnostics = self._failure_diagnostics(exit_code)
         try:
             self.client.register_execution_result(
@@ -1044,6 +1175,7 @@ class TaskExecutor:
                 task_attempt=self.task_attempt,
                 barrier_timeout=barrier_timeout,
                 preempted=preempted,
+                resized=resized,
                 diagnostics=diagnostics)
         except Exception:  # noqa: BLE001
             LOG.exception("failed to register execution result")

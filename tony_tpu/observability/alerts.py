@@ -400,10 +400,15 @@ def idle_chips_rule(*, severity="warning", for_ms: int = -1) -> AlertRule:
     to fix."""
 
     def evaluate(ctx: AlertContext) -> list:
+        from tony_tpu.cluster.elastic import find_widenable
         from tony_tpu.observability.fleet import quota_utilization
         jobs = [j for j in ctx.fleet.get("jobs", [])
                 if j.get("state") == "RUNNING"]
         util = quota_utilization(ctx.fleet.get("queues", {}), jobs)
+        # the arbiter's offer loop acts on the PAYLOAD: which elastic
+        # job could absorb the idle chips, and how many there are —
+        # computed once per pass, not per queued job
+        widenable = find_widenable(jobs)
         obs = []
         for j in jobs:
             requested = int(j.get("requested_chips", 0) or 0)
@@ -416,13 +421,32 @@ def idle_chips_rule(*, severity="warning", for_ms: int = -1) -> AlertRule:
             used = int(bucket.get("chips_in_use", 0) or 0)
             if cap and used >= cap:
                 continue        # the queue genuinely has no headroom
+            idle = max(0, cap - used) if cap else requested
             app = str(j.get("app_id", "?"))
+            annotations = {"idle_chips": idle, "queue": q}
+            candidate = next(
+                (w for w in widenable if w.get("app_id") != app), None)
+            widen_note = ""
+            if candidate is not None:
+                annotations["widenable_job"] = str(
+                    candidate.get("app_id", ""))
+                annotations["widenable_jobtype"] = str(
+                    candidate.get("elastic_job", ""))
+                annotations["widenable_width"] = int(
+                    candidate.get("gang_width", 0) or 0)
+                annotations["widenable_max_width"] = int(
+                    candidate.get("elastic_max_width", 0) or 0)
+                widen_note = (f"; elastic job "
+                              f"{annotations['widenable_job']} could "
+                              f"widen to absorb them")
             obs.append({"key": f"job:{app}", "value": float(requested),
                         "threshold": 0.0,
+                        "annotations": annotations,
                         "message": f"job {app} has waited for "
                                    f"{requested} chip(s) with none "
                                    f"allocated while queue {q} has "
-                                   f"headroom"})
+                                   f"{idle} idle chip(s) of headroom"
+                                   f"{widen_note}"})
         return obs
 
     return AlertRule("fleet.chips_idle_while_queued", evaluate,

@@ -82,6 +82,7 @@ JOB_GAUGES = {
     "tony_job_straggler_count": "straggler_count",
     "tony_job_alerts_firing": "alerts_firing",
     "tony_job_preemptions_total": "preemptions",
+    "tony_job_resizes_total": "resizes",
     "tony_job_step_time_p50_ms": "step_time_p50_ms",
     "tony_job_step_time_p95_ms": "step_time_p95_ms",
     "tony_job_step_time_p99_ms": "step_time_p99_ms",
@@ -106,6 +107,14 @@ def job_summary(app_id: str, user: str, queue: str, state: str, *,
                 alerts_firing: int = 0,
                 serving_tokens_per_sec: Optional[float] = None,
                 preemptions: int = 0,
+                resizes: int = 0,
+                requested_width: Optional[int] = None,
+                elastic_job: str = "",
+                elastic_width: int = 0,
+                elastic_chips_per_task: int = 0,
+                elastic_min_width: int = 0,
+                elastic_max_width: int = 0,
+                elastic_min_chips: int = 0,
                 priority: int = 0,
                 am_addr: str = "",
                 gauges: Optional[dict] = None,
@@ -120,6 +129,23 @@ def job_summary(app_id: str, user: str, queue: str, state: str, *,
         "queue": queue or "default",
         "state": state,
         "gang_width": int(gang_width),
+        # elastic width surface: requested_width diverges from
+        # gang_width while a resize is in flight (the fleet table and
+        # `cli top` render "cur>req"); elastic_* name the resizable
+        # jobtype and the floor/ceiling the arbiter's offer/reclaim
+        # verdicts respect (elastic_job == "" means not elastic)
+        "requested_width": int(requested_width if requested_width
+                               is not None else gang_width),
+        "resizes": int(resizes),
+        "elastic_job": elastic_job,
+        # the ELASTIC jobtype's own shape (gang_width spans every
+        # tracked jobtype — reclaim arithmetic must never blend a
+        # serving replica's chips into a worker slice's size)
+        "elastic_width": int(elastic_width),
+        "elastic_chips_per_task": int(elastic_chips_per_task),
+        "elastic_min_width": int(elastic_min_width),
+        "elastic_max_width": int(elastic_max_width),
+        "elastic_min_chips": int(elastic_min_chips),
         "requested_chips": int(requested_chips),
         "allocated_chips": int(allocated_chips),
         "started_ms": int(started_ms),

@@ -117,7 +117,7 @@ def tokens_in_batch(batch) -> int:
 PHASES = (
     "init", "localization", "rendezvous_wait", "compile", "train_step",
     "input_stall", "checkpoint_save", "checkpoint_restore", "eval",
-    "relaunch_downtime", "idle",
+    "relaunch_downtime", "resize", "idle",
 )
 
 GOODPUT_METRIC_PREFIX = "GOODPUT_"
@@ -257,21 +257,24 @@ def parse_goodput_gauges(gauges: dict[str, float]) -> Optional[dict]:
 
 def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
                       relaunch_downtime_s: float = 0.0,
-                      preemption_downtime_s: float = 0.0) -> dict:
+                      preemption_downtime_s: float = 0.0,
+                      resize_downtime_s: float = 0.0) -> dict:
     """Fold per-task ledgers + AM-side relaunch downtime into the job
     view flushed as `goodput.json`:
 
     {"tasks": {task_id: {"phases", "wall_s", "mfu_pct"?,
                          "tokens_per_sec_per_chip"?}},
      "job": {"goodput_pct", "productive_s", "wall_s",
-             "relaunch_downtime_s", "preemption_downtime_s"}}
+             "relaunch_downtime_s", "preemption_downtime_s",
+             "resize_downtime_s"}}
 
     goodput_pct = productive train-step seconds / (summed task wall +
-    relaunch downtime + preemption downtime) — downtime the
-    fault-tolerance layer spent between attempts, and the
+    relaunch downtime + preemption downtime + resize downtime) —
+    downtime the fault-tolerance layer spent between attempts, the
     eviction→resume gap a checkpoint-then-evict preemption cost this
-    job's lineage, both count AGAINST goodput even though no task
-    process existed to observe them."""
+    job's lineage, and the quiesce→re-rendezvous gap of every elastic
+    resize (the `resize` phase), all count AGAINST goodput even though
+    no task process existed to observe them."""
     tasks: dict[str, dict] = {}
     productive = 0.0
     wall_total = 0.0
@@ -290,7 +293,7 @@ def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
         productive += sum(entry["phases"].get(p, 0.0)
                           for p in PRODUCTIVE_PHASES)
     denom = wall_total + max(0.0, relaunch_downtime_s) \
-        + max(0.0, preemption_downtime_s)
+        + max(0.0, preemption_downtime_s) + max(0.0, resize_downtime_s)
     return {
         "tasks": tasks,
         "job": {
@@ -301,6 +304,7 @@ def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
             "relaunch_downtime_s": round(max(0.0, relaunch_downtime_s), 4),
             "preemption_downtime_s": round(
                 max(0.0, preemption_downtime_s), 4),
+            "resize_downtime_s": round(max(0.0, resize_downtime_s), 4),
         },
     }
 

@@ -643,12 +643,19 @@ class _Handler(BaseHTTPRequestHandler):
                 state = html.escape(str(j.get("state", "?")))
                 age_s = max(0.0, (_now_ms() - int(
                     j.get("heartbeat_ms", 0) or 0)) / 1000.0)
+                # elastic width surface: "cur &gt; req" (highlighted)
+                # while a resize is in flight, bare width otherwise
+                cur_w = int(j.get("gang_width", 0) or 0)
+                req_w = int(j.get("requested_width", cur_w) or cur_w)
+                width_cell = (f'<b style="color:#b8860b">{cur_w}'
+                              f'&nbsp;&rarr;&nbsp;{req_w}</b>'
+                              if req_w != cur_w else str(cur_w))
                 rows.append([
                     f'<a href="/jobs/{app}{qs}">{app}</a>',
                     html.escape(str(j.get("queue", ""))),
                     html.escape(str(j.get("user", ""))),
                     f'<span class="{state}">{state}</span>',
-                    str(j.get("gang_width", 0)),
+                    width_cell,
                     str(chips_of(j)),
                     ("-" if j.get("goodput_pct") is None
                      else f"{j['goodput_pct']:.1f}%"),
@@ -748,11 +755,77 @@ class _Handler(BaseHTTPRequestHandler):
                    self._diagnostics_html(job_id)
                    + self._alerts_html(job_id)
                    + self._serving_endpoints_html(job_id)
+                   + self._width_timeline_html(events)
                    + self._skew_html(job_id)
                    + self._goodput_html(job_id)
                    + self._timeline_html(job_id)
                    + self._waterfall_html(job_id)
                    + _table(["Time", "Event", "Summary", "Payload"], rows))
+
+    @staticmethod
+    def _width_timeline_html(events: list[dict]) -> str:
+        """Gang-width timeline: the width step-function the RESIZE_*
+        events describe (elastic resizes, cluster/elastic.py), rendered
+        as an inline SVG next to a transition table. Empty string for
+        jobs that never resized — static gangs stay clean."""
+        points: list[tuple[int, int]] = []   # (ts_ms, width after)
+        rows = []
+        started_ms = 0
+        for ev in events:
+            etype = ev.get("type")
+            p = ev.get("payload") or {}
+            ts = int(ev.get("timestamp", 0) or 0)
+            if etype == "APPLICATION_INITED" and not started_ms:
+                started_ms = ts
+            # the timeline tracks the ELASTIC jobtype's width, so it
+            # seeds from the first resize's from_width (num_tasks spans
+            # every jobtype — mixed units would draw phantom changes)
+            if not points and str(etype).startswith("RESIZE_"):
+                points.append((started_ms or ts,
+                               int(p.get("from_width", 0) or 0)))
+            if etype == "RESIZE_COMPLETED":
+                points.append((ts, int(p.get("to_width", 0) or 0)))
+                rows.append([_fmt_ts(ts), "completed",
+                             f"{p.get('from_width', '?')} &rarr; "
+                             f"{p.get('to_width', '?')}",
+                             f"{int(p.get('duration_ms', 0) or 0)} ms"])
+            elif etype == "RESIZE_FAILED":
+                rows.append([
+                    _fmt_ts(ts),
+                    '<span style="color:#c0392b">failed'
+                    + (" (rolled back)" if p.get("rolled_back") else "")
+                    + "</span>",
+                    f"{p.get('from_width', '?')} &rarr; "
+                    f"{p.get('to_width', '?')}",
+                    html.escape(str(p.get("reason", "")))])
+        if not rows:
+            return ""
+        out = ["<h3>Gang width timeline</h3>"]
+        widths = [w for _, w in points if w > 0]
+        if len(points) >= 2 and widths:
+            w_px, h_px = 480, 60
+            t0, t1 = points[0][0], points[-1][0]
+            extent = max(1, t1 - t0)
+            peak = max(widths)
+            coords = []
+            prev_w = None
+            for ts, w in points:
+                x = w_px * (ts - t0) / extent
+                y = h_px - h_px * w / (1.2 * peak)
+                if prev_w is not None:
+                    # step function: hold the previous width until the
+                    # resize lands
+                    coords.append(f"{x:.1f},{h_px - h_px * prev_w / (1.2 * peak):.1f}")
+                coords.append(f"{x:.1f},{y:.1f}")
+                prev_w = w
+            out.append(
+                f'<p>gang width over time (peak {peak})</p>'
+                f'<svg width="{w_px}" height="{h_px}" '
+                'style="border:1px solid #ccc">'
+                f'<polyline points="{" ".join(coords)}" fill="none" '
+                'stroke="#b8860b" stroke-width="2"></polyline></svg>')
+        out.append(_table(["Time", "Resize", "Width", "Detail"], rows))
+        return "".join(out)
 
     def _diagnostics_html(self, job_id: str) -> str:
         """Root-cause panel for failed jobs (the diagnostics.json bundle
@@ -811,8 +884,8 @@ class _Handler(BaseHTTPRequestHandler):
         "input_stall": "#e69138", "checkpoint_save": "#6fa8dc",
         "checkpoint_restore": "#9fc5e8", "eval": "#46bdc6",
         "localization": "#b7b7b7", "rendezvous_wait": "#ffd966",
-        "relaunch_downtime": "#cc0000", "init": "#cccccc",
-        "idle": "#efefef",
+        "relaunch_downtime": "#cc0000", "resize": "#b8860b",
+        "init": "#cccccc", "idle": "#efefef",
     }
 
     # severity → display color on the alert/timeline panels

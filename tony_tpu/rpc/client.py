@@ -205,6 +205,7 @@ class ClusterServiceClient(_JsonRpcClient):
                                   task_attempt: int = -1,
                                   barrier_timeout: bool = False,
                                   preempted: bool = False,
+                                  resized: bool = False,
                                   diagnostics: Optional[dict] = None
                                   ) -> None:
         """barrier_timeout marks a gang-rendezvous timeout: an allocation
@@ -224,7 +225,8 @@ class ClusterServiceClient(_JsonRpcClient):
             "job_index": job_index, "session_id": session_id,
             "task_attempt": task_attempt,
             "barrier_timeout": barrier_timeout,
-            "preempted": preempted}
+            "preempted": preempted,
+            "resized": resized}
         if diagnostics:
             req["diagnostics"] = diagnostics
         self.call("register_execution_result", req)
@@ -235,7 +237,8 @@ class ClusterServiceClient(_JsonRpcClient):
     def task_executor_heartbeat(self, task_id: str,
                                 task_attempt: int = -1,
                                 log_addr: str = "",
-                                spec_generation: int = -1) -> dict:
+                                spec_generation: int = -1,
+                                resize_ack: int = 0) -> dict:
         # liveness signal: one attempt, short deadline, no wait_for_ready —
         # the Heartbeater counts consecutive failures and kills the executor
         # when the AM is gone (reference: TaskExecutor.java:358-368; with
@@ -249,13 +252,41 @@ class ClusterServiceClient(_JsonRpcClient):
         # behind the AM's generation receives the generation-keyed spec
         # DIFF in the response instead of ever re-fetching the full
         # O(width) spec (coalesced control plane).
+        # resize_ack (>0) gossips the newest elastic-resize id this
+        # executor has fully quiesced for (user process exited, emergency
+        # checkpoint committed) — the coordinator's membership-change gate
         req = {"task_id": task_id, "task_attempt": task_attempt}
         if log_addr:
             req["log_addr"] = log_addr
         if spec_generation > 0:
             req["spec_generation"] = spec_generation
+        if resize_ack > 0:
+            req["resize_ack"] = resize_ack
         return self.call("task_executor_heartbeat", req,
                          retries=1, timeout_sec=5.0, wait_for_ready=False)
+
+    def request_resize(self, job_name: str = "", width: int = 0,
+                       tpus_per_task: int = 0, grace_ms: int = 0,
+                       reason: str = "",
+                       requested_by: str = "operator",
+                       session_attempt: int = -1) -> dict:
+        """Elastic gang resize (cluster/elastic.py + `cli resize`):
+        grow/shrink a RUNNING gang in place — quiesce → in-place
+        emergency checkpoint → re-render the cluster spec at the new
+        width behind a generation bump → reshard-restore → resume.
+        `width` changes the jobtype's task-instance count; alternatively
+        `tpus_per_task` re-meshes the chips of a fixed-membership gang.
+        `session_attempt` (>= 0) fences the ask to one AM session
+        attempt — a resize aimed at a superseded session must not fire
+        on its retry. Client-plane: never a task token."""
+        return self.call("request_resize",
+                         {"job_name": job_name, "width": int(width),
+                          "tpus_per_task": int(tpus_per_task),
+                          "grace_ms": int(grace_ms), "reason": reason,
+                          "requested_by": requested_by,
+                          "session_attempt": int(session_attempt)},
+                         retries=1, timeout_sec=10.0,
+                         wait_for_ready=False)
 
     def request_preemption(self, grace_ms: int = 0, reason: str = "",
                            requested_by: str = "operator") -> dict:

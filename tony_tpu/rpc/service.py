@@ -48,6 +48,7 @@ CLUSTER_METHODS = (
     "get_alerts",
     "request_preemption",
     "request_rolling_update",
+    "request_resize",
 )
 METRICS_METHODS = ("update_metrics",)
 TASK_LOG_METHODS = ("read_log",)
@@ -180,6 +181,23 @@ class ClusterServiceHandler(abc.ABC):
         generation. generation 0 = bump the AM's epoch by one.
         Idempotent while a rollout is in flight (returns the in-flight
         one). Client-plane only; task tokens fail closed."""
+
+    @abc.abstractmethod
+    def request_resize(self, req: dict) -> dict:
+        """Arbiter/operator plane: req {job_name?, width?, tpus_per_task?,
+        grace_ms?, reason?, requested_by?, session_attempt?} ->
+        {app_id, job_name, from_width, to_width, ...} (or {error}).
+        Begin an in-place elastic gang resize (cluster/elastic.py):
+        quiesce the gang (trainers emergency-checkpoint within the
+        grace window, containers stay alive), change membership
+        (session.add_task_instance / trailing-slot removal) or re-mesh
+        per-task chips, bump the cluster-spec generation so survivors
+        re-rendezvous via heartbeat spec diffs, and resume from the
+        quiesce checkpoint via the resharding restore. Idempotent while
+        a resize is in flight (returns the in-flight one); a
+        session_attempt >= 0 that doesn't match the CURRENT session
+        attempt is rejected. Client-plane only; task tokens fail
+        closed."""
 
     @abc.abstractmethod
     def request_profile(self, req: dict) -> dict:

@@ -168,10 +168,12 @@ class TonySession:
         # an O(width) json.dumps per poll. Invalidation points: any
         # registration change and every generation bump.
         self._spec_cache: Optional[str] = None  # guarded-by: _lock
-        # generation -> task_ids whose registration was invalidated at the
-        # bump TO that generation (the diff material); bounded to
+        # generation -> {"changed": task_ids whose registration was
+        # invalidated (or freshly added) at the bump TO that generation,
+        # "removed": {job: {indices}} membership the bump REMOVED (elastic
+        # shrink — trailing slots only)} — the diff material; bounded to
         # SPEC_DIFF_WINDOW bumps
-        self._gen_changes: OrderedDict[int, set[str]] = OrderedDict()  # guarded-by: _lock
+        self._gen_changes: OrderedDict[int, dict] = OrderedDict()  # guarded-by: _lock
         # from_generation -> (rendered diff dict, serialized byte size)
         # for the CURRENT generation (cleared with the spec cache)
         self._diff_cache: dict[int, tuple[dict, int]] = {}  # guarded-by: _lock
@@ -343,25 +345,86 @@ class TonySession:
                 return None
             self._registered.pop(task.task_id, None)
             task.reset_for_relaunch()
-            self.spec_generation += 1
             # diff material: survivors holding the previous generation get
             # {this task: replacement host:port} piggybacked on heartbeats
             # once the barrier re-closes, instead of re-fetching the full
             # O(width) spec
-            self._gen_changes[self.spec_generation] = \
-                {task.task_id} | self._pending_rebinds
-            self._pending_rebinds = set()
-            while len(self._gen_changes) > SPEC_DIFF_WINDOW:
-                self._gen_changes.popitem(last=False)
-            self._invalidate_spec_cache()
+            self._bump_generation({task.task_id}, {})
             LOG.info("task %s recycled for attempt %d (spec generation %d)",
                      task.task_id, task.attempt, self.spec_generation)
             return task
+
+    # holds: _lock (every generation bump happens under the session lock)
+    def _bump_generation(self, changed_ids: set[str],
+                         removed: dict[str, set[int]]) -> int:
+        """Advance the spec generation recording its diff material:
+        `changed_ids` (relaunched/rebound/added tasks whose host:port a
+        survivor must pick up) and `removed` membership (elastic shrink;
+        trailing indices only). Pending rebinds fold in, the retained
+        window trims, and the render/diff caches invalidate."""
+        self.spec_generation += 1
+        self._gen_changes[self.spec_generation] = {
+            "changed": set(changed_ids) | self._pending_rebinds,
+            "removed": {job: set(idxs) for job, idxs in removed.items()
+                        if idxs},
+        }
+        self._pending_rebinds = set()
+        while len(self._gen_changes) > SPEC_DIFF_WINDOW:
+            self._gen_changes.popitem(last=False)
+        self._invalidate_spec_cache()
+        return self.spec_generation
+
+    def resize_bump_generation(self, changed_ids: set[str],
+                               removed: dict[str, set[int]]) -> int:
+        """Elastic-resize edge: one atomic generation bump covering a
+        membership change (added task ids and/or removed trailing
+        indices). Survivors holding the previous generation receive the
+        membership delta as a heartbeat-piggybacked diff once the
+        barrier closes at the new width."""
+        with self._lock:
+            return self._bump_generation(changed_ids, removed)
+
+    def remove_task_slots(self, job_name: str, count: int) -> list[Task]:
+        """Elastic shrink: pop `count` TRAILING slots of a jobtype —
+        containers stopped (or stopping) by the caller; registrations
+        and expected-task accounting leave with them. Unlike
+        remove_task_instance (the autoscaler's never-launched abandon
+        path) this removes slots that ran: the elastic coordinator has
+        already drained their user processes. Returns the removed tasks
+        (highest index first). The caller owns the generation bump."""
+        removed: list[Task] = []
+        with self._lock:
+            tasks = self.job_tasks.get(job_name)
+            req = self.requests.get(job_name)
+            if tasks is None or req is None:
+                return removed
+            for _ in range(max(0, count)):
+                if len(tasks) <= 1:
+                    break   # never shrink a jobtype to zero
+                task = tasks.pop()
+                self._registered.pop(task.task_id, None)
+                req.num_instances -= 1
+                self.num_expected_tasks -= 1
+                removed.append(task)
+            if removed:
+                self._invalidate_spec_cache()
+                LOG.info("removed %d trailing %s slot(s) (now %d "
+                         "instance(s))", len(removed), job_name,
+                         req.num_instances)
+        return removed
 
     def all_tasks_registered(self) -> bool:
         with self._lock:
             return (self.num_expected_tasks > 0
                     and len(self._registered) >= self.num_expected_tasks)
+
+    def is_task_registered(self, task_id: str) -> bool:
+        """Whether ONE task currently holds a barrier registration —
+        the elastic grow's rollback clock watches the ADDED slots
+        specifically (an unrelated survivor relaunch also reopens the
+        barrier and must not be read as 'the grow failed')."""
+        with self._lock:
+            return task_id in self._registered
 
     def cluster_spec_json(self) -> Optional[str]:
         """JSON {jobtype: ["host:port", ...]} over registered tasks, or None
@@ -393,10 +456,15 @@ class TonySession:
         """Generation-keyed spec diff for an executor that already holds
         `from_generation`: returns (diff, refetch_needed).
 
-        diff = {"generation": current, "changed": {job: {index: host_port}}}
-        covering every bump in (from_generation, current] — O(changed
-        tasks) bytes instead of the O(width) full spec. Piggybacked on
-        heartbeat responses by the AM.
+        diff = {"generation": current, "changed": {job: {index: host_port}},
+        "removed": {job: [indices]}?} covering every bump in
+        (from_generation, current] — O(changed tasks) bytes instead of
+        the O(width) full spec. Piggybacked on heartbeat responses by
+        the AM. Membership changes ride it too (elastic resize): an
+        added task appears under `changed` at its new index, a shrunk-
+        away trailing slot under `removed`; the walk is generation-
+        ordered, so an index removed then re-added across the window
+        nets out to its newest state.
 
         (None, False) while up to date OR while the barrier is still open
         (the executor keeps waiting — the diff arrives on a later
@@ -419,12 +487,29 @@ class TonySession:
                 diff, nbytes = cached
             else:
                 changed_ids: set[str] = set()
+                removed: dict[str, set[int]] = {}
                 for gen in range(from_generation + 1, current + 1):
-                    ids = self._gen_changes.get(gen)
-                    if ids is None:
+                    entry = self._gen_changes.get(gen)
+                    if entry is None:
                         # bump fell out of the retained window
                         return None, True
-                    changed_ids |= ids
+                    # generation order matters: a later removal voids an
+                    # earlier change of the same index, a later re-add
+                    # voids an earlier removal
+                    for job, idxs in entry.get("removed", {}).items():
+                        bucket = removed.setdefault(job, set())
+                        for i in idxs:
+                            bucket.add(i)
+                            changed_ids.discard(f"{job}:{i}")
+                    for tid in entry.get("changed", ()):
+                        changed_ids.add(tid)
+                        name, _, idx_s = tid.rpartition(":")
+                        bucket = removed.get(name)
+                        if bucket:
+                            try:
+                                bucket.discard(int(idx_s))
+                            except ValueError:
+                                pass
                 # a rebind since the last bump (no generation of its own):
                 # a trailing survivor's full fetch would have picked it up
                 # from the re-rendered spec, so the diff must carry it too
@@ -437,6 +522,11 @@ class TonySession:
                     changed.setdefault(task.job_name, {})[
                         str(task.index)] = task.host_port
                 diff = {"generation": current, "changed": changed}
+                removed_out = {job: sorted(idxs)
+                               for job, idxs in sorted(removed.items())
+                               if idxs}
+                if removed_out:
+                    diff["removed"] = removed_out
                 # serialize ONCE for byte accounting — at width 1k the
                 # same cached diff is served to ~width survivors and a
                 # per-serve json.dumps would sit on the heartbeat hot path

@@ -32,6 +32,9 @@ FENCED_HANDLERS = (
     "register_worker_spec_with_generation",
     "register_execution_result",
     "task_executor_heartbeat",
+    # elastic resize: an ask computed against a stale registry entry
+    # must not fire on a superseded session attempt's fresh gang
+    "request_resize",
 )
 # handler IMPLEMENTATIONS only: rpc/client.py's same-named methods are
 # serialization stubs (they SEND the attempt; the server compares it)
