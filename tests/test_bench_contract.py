@@ -351,5 +351,54 @@ def test_fleet_headlines_append_and_compare_round_trip(tmp_path,
     assert verdicts["serving_fleet_ttft_p95_s"]["regression"] is False
 
 
+@pytest.mark.warmpool
+def test_coldstart_headline_units_gate_lower_is_better():
+    """The cold-start demolition's two new headlines —
+    control_plane_real_all_running and resize_grow_latency — carry unit
+    "s" so bench_compare judges them lower-is-better, and value<=0
+    fallback markers are skipped both as baseline and as the judged
+    entry."""
+    from tools.bench_compare import compare
+
+    for metric in ("control_plane_real_all_running", "resize_grow_latency"):
+        fast = {"metric": metric, "value": 3.2, "unit": "s",
+                "backend": "cpu", "width": 256, "warm_pool": True}
+        slow = {"metric": metric, "value": 4.5, "unit": "s",
+                "backend": "cpu", "width": 256, "warm_pool": True}
+        # got slower later → regression
+        v = compare([fast, slow], threshold_pct=2.0)
+        assert len(v) == 1 and v[0]["regression"] is True, metric
+        # got faster later → pass
+        v = compare([slow, fast], threshold_pct=2.0)
+        assert v[0]["regression"] is False, metric
+        # a value<=0 marker (failed/withheld run) never judges...
+        marker = {"metric": metric, "value": 0.0, "unit": "s",
+                  "backend": "cpu"}
+        v = compare([fast, slow, marker], threshold_pct=2.0)
+        assert v[0]["regression"] is True     # latest MEASURABLE judged
+        # ...and never serves as a flattering baseline
+        v = compare([marker, slow], threshold_pct=2.0)
+        assert v[0]["regression"] is False
+        assert v[0].get("note") == "no prior baseline"
+
+
+@pytest.mark.warmpool
+def test_cp_disclosure_stamps_warm_fields():
+    """Every control-plane bench line discloses whether it rode the warm
+    pool and what the caches did — a warm headline that hid its lease
+    and hit counts would be indistinguishable from a cold one."""
+    row = {"warm": True, "warm_leases": 4, "warm_misses": 1,
+           "spawn_s": 0.202, "loc_cache_hits": 256, "loc_cache_misses": 0,
+           "submit_to_all_running_s": 3.9}
+    d = bench._cp_disclosure(row, cold_baseline_s=4.4)
+    assert d == {"warm_pool": True, "warm_leases": 4, "warm_misses": 1,
+                 "spawn_s": 0.202, "loc_cache_hits": 256,
+                 "loc_cache_misses": 0, "cold_baseline_s": 4.4}
+    # cold rows disclose too (warm_pool False, no baseline field)
+    d = bench._cp_disclosure({"warm": False, "spawn_s": 0.6})
+    assert d["warm_pool"] is False
+    assert "cold_baseline_s" not in d
+
+
 if __name__ == "__main__":
     sys.exit(0)

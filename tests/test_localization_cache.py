@@ -1,0 +1,258 @@
+"""Content-addressed localization cache + persistent compile-cache
+wiring (the other two legs of the cold-start demolition).
+
+Pins the cache's correctness invariants — identical bytes land once
+machine-wide, materialization is a hardlink, a killed fetch never leaves
+a torn blob or a lying marker — plus the atomic store fetch idiom and
+the `tony.executor.jax-cache-dir` → $TONY_JAX_CACHE_DIR env render the
+trainer/serving engine consume.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.utils.localization import (
+    LocalizationCache, localize_resource,
+)
+
+pytestmark = pytest.mark.warmpool
+
+
+@pytest.fixture
+def cache(tmp_path):
+    return LocalizationCache(str(tmp_path / "cache"))
+
+
+def _write(tmp_path, name: str, data: bytes) -> str:
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def test_identical_bytes_stored_once(cache, tmp_path):
+    a = _write(tmp_path, "a.bin", b"same-bytes")
+    b = _write(tmp_path, "b.bin", b"same-bytes")
+    other = _write(tmp_path, "c.bin", b"different")
+    blob_a = cache.get_or_add_file(a)       # miss
+    blob_b = cache.get_or_add_file(b)       # hit: same digest
+    blob_c = cache.get_or_add_file(other)   # miss
+    assert blob_a == blob_b != blob_c
+    assert len(os.listdir(cache.by_digest)) == 2
+    assert (cache.hits, cache.misses) == (1, 2)
+
+
+def test_materialize_is_hardlink_and_overwrites_stale(cache, tmp_path):
+    src = _write(tmp_path, "res.bin", b"payload")
+    blob = cache.get_or_add_file(src)
+    dest_dir = str(tmp_path / "container")
+    os.makedirs(dest_dir)
+    stale = os.path.join(dest_dir, "res.bin")
+    with open(stale, "wb") as f:
+        f.write(b"stale-from-a-previous-attempt")
+    out = cache.materialize(blob, dest_dir, "res.bin")
+    assert out == stale
+    assert os.stat(out).st_ino == os.stat(blob).st_ino   # hardlink
+    with open(out, "rb") as f:
+        assert f.read() == b"payload"
+    # no tmp debris from the atomic link+rename
+    assert not glob.glob(os.path.join(dest_dir, "*.link-tmp-*"))
+
+
+def test_concurrent_materialize_same_dest_is_safe(cache, tmp_path):
+    """The width-k regression this fixes: k executors run as THREADS of
+    one pool process, all materializing the same resource to the same
+    path. Every thread must succeed (no tmp-name collision, no
+    delete-under-a-neighbor) and the final file must be whole."""
+    src = _write(tmp_path, "res.bin", b"x" * 65536)
+    blob = cache.get_or_add_file(src)
+    dest_dir = str(tmp_path / "shared_container")
+    errors = []
+
+    def _one():
+        try:
+            cache.materialize(blob, dest_dir, "res.bin")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=_one) for _ in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    with open(os.path.join(dest_dir, "res.bin"), "rb") as f:
+        assert f.read() == b"x" * 65536
+
+
+def test_stat_memo_hashes_each_source_once(cache, tmp_path, monkeypatch):
+    """Digest memoization by (dev, ino, size, mtime_ns): hashing the
+    source costs more than the copy the cache saves, so a width-k gang
+    re-localizing one resource must sha256 it exactly once machine-wide
+    — and an edited source (new mtime) must be re-hashed, never served
+    stale."""
+    from tony_tpu.utils import localization as loc
+
+    real = loc._sha256_file
+    hashed = []
+    monkeypatch.setattr(loc, "_sha256_file",
+                        lambda p: (hashed.append(p), real(p))[1])
+    src = _write(tmp_path, "big.bin", b"r" * 4096)
+    blob1 = cache.get_or_add_file(src)
+    for _ in range(8):                       # the rest of the gang
+        assert cache.get_or_add_file(src) == blob1
+    assert len(hashed) == 1
+    assert cache.hits == 8
+
+    # a rewritten source is a different stat identity: re-hash, new blob
+    os.utime(src, ns=(1, 1))   # force a distinct mtime_ns
+    with open(src, "wb") as f:
+        f.write(b"s" * 4096)
+    blob2 = cache.get_or_add_file(src)
+    assert blob2 != blob1 and len(hashed) == 2
+
+
+def test_uri_fetched_once_machine_wide(cache):
+    calls = []
+
+    def fetcher(uri, dest):
+        calls.append(uri)
+        with open(dest, "wb") as f:
+            f.write(b"remote-bytes")
+
+    blob1 = cache.get_or_fetch_uri("gs://bucket/res", fetcher)
+    blob2 = cache.get_or_fetch_uri("gs://bucket/res", fetcher)
+    assert blob1 == blob2 and calls == ["gs://bucket/res"]
+    with open(blob1, "rb") as f:
+        assert f.read() == b"remote-bytes"
+
+
+def test_failed_fetch_leaves_no_marker_no_blob(cache):
+    def broken(uri, dest):
+        with open(dest, "wb") as f:
+            f.write(b"half-")
+        raise OSError("connection reset")
+
+    with pytest.raises(OSError):
+        cache.get_or_fetch_uri("gs://bucket/flaky", broken)
+    # nothing cached, nothing torn: the next attempt refetches
+    assert os.listdir(cache.by_uri) == []
+    assert os.listdir(cache.by_digest) == []
+    assert not glob.glob(os.path.join(cache.root, ".fetch-tmp-*"))
+
+    def working(uri, dest):
+        with open(dest, "wb") as f:
+            f.write(b"whole")
+
+    blob = cache.get_or_fetch_uri("gs://bucket/flaky", working)
+    with open(blob, "rb") as f:
+        assert f.read() == b"whole"
+
+
+def test_localize_resource_through_cache_dedups_copies(cache, tmp_path):
+    src = _write(tmp_path, "data.txt", b"training-data")
+    d1, d2 = str(tmp_path / "c1"), str(tmp_path / "c2")
+    out1 = localize_resource(src, d1, cache=cache)
+    out2 = localize_resource(src, d2, cache=cache)
+    # both containers see the file; bytes exist once (3 links: blob + 2)
+    assert os.stat(out1).st_ino == os.stat(out2).st_ino
+    assert os.stat(out1).st_nlink == 3
+    assert cache.hits >= 1
+
+
+def test_from_conf_gating(tmp_path):
+    conf = TonyConfiguration()
+    assert LocalizationCache.from_conf(conf) is None   # default off
+    conf.set(K.LOCALIZATION_CACHE_ENABLED, True, "test")
+    conf.set(K.LOCALIZATION_CACHE_DIR, str(tmp_path / "locs"), "test")
+    cache = LocalizationCache.from_conf(conf)
+    assert cache is not None
+    assert cache.root == str(tmp_path / "locs")
+
+
+def test_local_store_fetch_is_atomic(tmp_path):
+    from tony_tpu.storage import LocalDirStore
+
+    store = LocalDirStore(str(tmp_path / "store"))
+    uri = store.put(_write(tmp_path, "src.bin", b"stored-bytes"), "src.bin")
+    dest = str(tmp_path / "out" / "src.bin")
+    got = store.fetch(uri, dest)
+    assert got == dest
+    with open(dest, "rb") as f:
+        assert f.read() == b"stored-bytes"
+    # the download-to-tmp + rename idiom leaves no debris
+    assert not glob.glob(f"{dest}.fetch-tmp-*")
+    assert not glob.glob(os.path.join(str(tmp_path / "store"),
+                                      "*.put-tmp-*"))
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA compile cache wiring
+# ---------------------------------------------------------------------------
+
+class _FakeJaxConfig:
+    def __init__(self):
+        self.calls = {}
+
+    def update(self, key, value):
+        self.calls[key] = value
+
+
+class _FakeJax:
+    def __init__(self):
+        self.config = _FakeJaxConfig()
+
+
+def test_compile_cache_env_rendered_into_user_env():
+    """tony.executor.jax-cache-dir lands in EVERY framework's user env
+    as $TONY_JAX_CACHE_DIR — the trainer/serving engine pick it up."""
+    from tony_tpu.executor.runtimes import render_framework_env
+
+    spec = {"worker": ["h0:1000", "h1:1001"]}
+    conf = TonyConfiguration()
+    env = render_framework_env("jax", spec, "worker", 0, conf)
+    assert C.JAX_CACHE_DIR not in env                  # knob unset
+    conf.set(K.EXECUTOR_JAX_CACHE_DIR, "/var/cache/tony-jax", "test")
+    env = render_framework_env("jax", spec, "worker", 0, conf)
+    assert env[C.JAX_CACHE_DIR] == "/var/cache/tony-jax"
+    # framework-independent: tensorflow tasks get it too
+    env = render_framework_env("tensorflow", spec, "worker", 1, conf)
+    assert env[C.JAX_CACHE_DIR] == "/var/cache/tony-jax"
+
+
+def test_maybe_enable_compile_cache_honors_env(tmp_path, monkeypatch):
+    from tony_tpu.utils.compilecache import maybe_enable_compile_cache
+
+    cache_dir = str(tmp_path / "jax_cache")
+    monkeypatch.setenv(C.JAX_CACHE_DIR, cache_dir)
+    jax = _FakeJax()
+    assert maybe_enable_compile_cache(jax_module=jax) == cache_dir
+    assert jax.config.calls["jax_compilation_cache_dir"] == cache_dir
+    assert os.path.isdir(cache_dir)
+
+    # unset → disabled, jax untouched
+    monkeypatch.delenv(C.JAX_CACHE_DIR)
+    jax2 = _FakeJax()
+    assert maybe_enable_compile_cache(jax_module=jax2) is None
+    assert jax2.config.calls == {}
+
+
+def test_maybe_enable_compile_cache_never_raises(tmp_path, monkeypatch):
+    """The cache is an optimization, never a dependency: a jax that
+    refuses the config keys degrades to a warning, not a crash."""
+    from tony_tpu.utils.compilecache import maybe_enable_compile_cache
+
+    class _Refusing:
+        class config:  # noqa: N801 — mimics jax.config
+            @staticmethod
+            def update(key, value):
+                raise ValueError("unknown config")
+
+    monkeypatch.setenv(C.JAX_CACHE_DIR, str(tmp_path / "d"))
+    assert maybe_enable_compile_cache(jax_module=_Refusing()) is None

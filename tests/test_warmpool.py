@@ -1,0 +1,270 @@
+"""Warm executor pool (cluster/warmpool.py): lease/bind fencing.
+
+The cold-start demolition's sharpest edge is correctness, not speed: a
+leased warm process must be indistinguishable from a cold spawn to the
+application that binds it. These tests pin the fence — nonce-mismatched
+binds are refused, stale app-A env never survives into an app-B bind, a
+SIGKILLed warm child is evicted (never reused) and its replacement lease
+re-binds cleanly, and a dead pool degrades to the cold path without
+failing the task.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.cluster.warmpool import (
+    EXIT_BIND_REJECTED, WARM_READY_LINE, WarmExecutorPool,
+)
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.observability.metrics import REGISTRY
+
+pytestmark = pytest.mark.warmpool
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), "scripts")
+
+
+def _counter(name: str, **labels) -> float:
+    return REGISTRY.counter(name, **labels).value
+
+
+def _write_probe(tmp_path) -> str:
+    """A script-entry module that reports what the bound child actually
+    became: cwd, argv, and the identity env after scrub + re-apply."""
+    path = tmp_path / "probe_mod.py"
+    path.write_text(textwrap.dedent("""
+        import json, os, sys
+
+        def probe_main():
+            out = {"cwd": os.getcwd(), "argv": sys.argv[1:],
+                   "env": {k: os.environ.get(k, "")
+                           for k in ("TONY_STALE_A", "TONY_TRACE_ID",
+                                     "JOB_NAME")}}
+            print("PROBE " + json.dumps(out), flush=True)
+            return 0
+    """))
+    return str(path)
+
+
+def _lease_probe(pool, tmp_path, env, argv=()):
+    """Lease a warm child bound to the probe module; returns (probe
+    dict, exit code, pid)."""
+    proc = pool.lease_and_bind(
+        env=env, cwd=str(tmp_path), entry="script",
+        script_path=_write_probe(tmp_path), script_func="probe_main",
+        argv=["probe"] + list(argv))
+    assert proc is not None, "warm lease missed with a warmed pool"
+    line, deadline = "", time.monotonic() + 20
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line or line.startswith("PROBE "):
+            break
+    assert line.startswith("PROBE "), f"no probe output, got {line!r}"
+    rc = proc.wait(timeout=20)
+    return json.loads(line.split(" ", 1)[1]), rc, proc.pid
+
+
+@pytest.fixture
+def pool():
+    pools = []
+
+    def make(size=1, ttl_ms=300_000):
+        p = WarmExecutorPool(size=size, ttl_ms=ttl_ms)
+        pools.append(p)
+        p.start()
+        assert p.wait_ready(timeout=60.0), "pool never warmed"
+        return p
+
+    yield make
+    for p in pools:
+        p.stop()
+
+
+def test_lease_binds_fresh_identity_and_scrubs_stale(pool, tmp_path,
+                                                     monkeypatch):
+    """The attempt-fence env contract: stale app-A identity inherited at
+    fork (TONY_* + task identity vars) is scrubbed before the app-B spec
+    env lands — the bound child sees ONLY the fresh values, exactly like
+    a cold spawn."""
+    monkeypatch.setenv("TONY_STALE_A", "app-a-secret")
+    monkeypatch.setenv("JOB_NAME", "app-a-worker")
+    p = pool(size=1)
+    hits0 = _counter("tony_warmpool_lease_total", outcome="hit")
+    probe, rc, _ = _lease_probe(
+        p, tmp_path,
+        env={"TONY_TRACE_ID": "trace-b", "JOB_NAME": "worker-b"},
+        argv=["x", "y"])
+    assert rc == 0
+    assert probe["env"]["TONY_STALE_A"] == ""       # scrubbed
+    assert probe["env"]["JOB_NAME"] == "worker-b"   # re-supplied, not stale
+    assert probe["env"]["TONY_TRACE_ID"] == "trace-b"
+    assert probe["cwd"] == str(tmp_path)
+    assert probe["argv"] == ["x", "y"]
+    assert _counter("tony_warmpool_lease_total", outcome="hit") == hits0 + 1
+
+
+def test_bind_refused_on_nonce_mismatch():
+    """A crossed pipe can never bind a foreign spec: the child refuses
+    any bind that does not echo its own fork-time nonce."""
+    env = dict(os.environ)
+    env[C.WARMPOOL_NONCE] = "the-real-nonce"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tony_tpu.cluster.warmpool"], env=env,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    try:
+        assert proc.stdout.readline().strip() == WARM_READY_LINE
+        proc.stdin.write(json.dumps(
+            {"nonce": "forged", "entry": "executor", "env": {}}) + "\n")
+        proc.stdin.close()
+        assert proc.wait(timeout=20) == EXIT_BIND_REJECTED
+    finally:
+        proc.kill()
+
+
+def test_bind_refused_on_garbage_and_clean_exit_on_eof():
+    env = dict(os.environ)
+    env[C.WARMPOOL_NONCE] = "n1"
+    for payload, expected in (("not json at all\n", EXIT_BIND_REJECTED),
+                              ("", 0)):   # EOF = pool retirement
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tony_tpu.cluster.warmpool"], env=env,
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True)
+        try:
+            assert proc.stdout.readline().strip() == WARM_READY_LINE
+            if payload:
+                proc.stdin.write(payload)
+            proc.stdin.close()
+            assert proc.wait(timeout=20) == expected
+        finally:
+            proc.kill()
+
+
+@pytest.mark.chaos
+def test_sigkilled_warm_child_evicted_replacement_fenced(pool, tmp_path):
+    """Chaos acceptance: SIGKILL an idle warm child; the next lease must
+    evict it (never hand it out), serve a LIVE replacement, and that
+    replacement's bind must still carry the full fence (fresh identity
+    env applied, rc 0)."""
+    p = pool(size=2)
+    victim = p._idle[0].proc
+    os.kill(victim.pid, signal.SIGKILL)
+    victim.wait()
+    dead0 = _counter("tony_warmpool_evictions_total", reason="dead")
+    probe, rc, pid = _lease_probe(
+        p, tmp_path, env={"TONY_TRACE_ID": "trace-after-chaos",
+                          "JOB_NAME": "worker-replacement"})
+    assert rc == 0 and pid != victim.pid
+    assert probe["env"]["TONY_TRACE_ID"] == "trace-after-chaos"
+    assert probe["env"]["JOB_NAME"] == "worker-replacement"
+    assert _counter("tony_warmpool_evictions_total",
+                    reason="dead") >= dead0 + 1
+
+
+def test_exhausted_pool_returns_none_then_recovers(pool, tmp_path):
+    """Every candidate dead → lease returns None (the caller's cold
+    fallback), and the evictions trigger respawns so the pool heals."""
+    p = pool(size=1)
+    os.kill(p._idle[0].proc.pid, signal.SIGKILL)
+    p._idle[0].proc.wait()
+    assert p.lease_and_bind(env={}, cwd=str(tmp_path)) is None
+    # eviction queued a respawn: the pool becomes leasable again
+    assert p.wait_ready(1, timeout=60.0)
+    probe, rc, _ = _lease_probe(p, tmp_path, env={"JOB_NAME": "healed"})
+    assert rc == 0 and probe["env"]["JOB_NAME"] == "healed"
+
+
+def test_ttl_sweep_retires_expired_children(pool):
+    p = pool(size=1, ttl_ms=1)
+    time.sleep(0.05)
+    ttl0 = _counter("tony_warmpool_evictions_total", reason="ttl")
+    p.sweep()
+    assert _counter("tony_warmpool_evictions_total",
+                    reason="ttl") == ttl0 + 1
+
+
+def test_backend_falls_back_to_cold_spawn_on_pool_miss(tmp_path):
+    """LocalClusterBackend + a pool that can never serve (all children
+    killed): launch_container must cold-spawn — the container runs and
+    completes; the pool is an optimization, never a dependency."""
+    from tony_tpu.cluster.backend import Container
+    from tony_tpu.cluster.local import LocalClusterBackend
+
+    p = WarmExecutorPool(size=1)
+    p.start()
+    assert p.wait_ready(timeout=60.0)
+    os.kill(p._idle[0].proc.pid, signal.SIGKILL)
+    p._idle[0].proc.wait()
+    backend = LocalClusterBackend(app_id="t", warmpool=p)
+    done = []
+    backend._on_allocated = lambda c: None
+    backend._on_completed = lambda cid, rc: done.append((cid, rc))
+    try:
+        cwd = str(tmp_path / "c1")
+        container = Container(container_id="c1", host="localhost",
+                              priority=0, memory_mb=0, vcores=0, gpus=0,
+                              tpus=0)
+        # not an executor command on purpose: proves the cold path ran it
+        backend.launch_container(
+            container, [sys.executable, "-c", "print('cold-ok')"],
+            env={}, cwd=cwd)
+        deadline = time.monotonic() + 30
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert done == [("c1", 0)]
+        with open(os.path.join(cwd, "stdout"), "rb") as f:
+            assert b"cold-ok" in f.read()
+    finally:
+        backend.stop()
+
+
+def test_from_conf_gating():
+    from tony_tpu.cluster import warmpool as wp
+
+    conf = TonyConfiguration()
+    assert wp.from_conf(conf) is None          # default: disabled
+    conf.set(K.WARMPOOL_ENABLED, True, "test")
+    conf.set(K.WARMPOOL_SIZE, 2, "test")
+    p = wp.from_conf(conf)
+    try:
+        assert isinstance(p, WarmExecutorPool) and p.size == 2
+    finally:
+        p.stop()
+
+
+def test_e2e_job_leases_warm_executors(tmp_path):
+    """Full chain with tony.warmpool.enabled: client → AM → backend
+    leases warm executors → user scripts succeed. The AM's backend log
+    proves at least one container actually rode a warm lease (the AM is
+    a subprocess, so its registry is not visible here)."""
+    from tony_tpu.client.tony_client import TonyClient
+
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_WORKDIR, str(tmp_path), "test")
+    conf.set(K.AM_MONITOR_INTERVAL_MS, 100, "test")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "test")
+    conf.set(K.TASK_MAX_MISSED_HEARTBEATS, 25, "test")
+    conf.set(K.TASK_METRICS_INTERVAL_MS, 500, "test")
+    conf.set(K.TASK_REGISTRATION_TIMEOUT_SEC, 60, "test")
+    conf.set(K.CONTAINER_ALLOCATION_TIMEOUT, 60_000, "test")
+    conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 3000, "test")
+    conf.set(K.WARMPOOL_ENABLED, True, "test")
+    conf.set(K.WARMPOOL_SIZE, 2, "test")
+    client = TonyClient(conf)
+    client.init(["--executes", os.path.join(SCRIPTS, "exit_0.py"),
+                 "--conf", "tony.worker.instances=2"])
+    client.run()
+    assert client.final_status == "SUCCEEDED"
+    with open(os.path.join(client.app_dir, C.AM_STDERR), "rb") as f:
+        am_log = f.read().decode("utf-8", "replace")
+    assert "leased warm executor" in am_log

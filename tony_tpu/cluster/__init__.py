@@ -28,8 +28,13 @@ def backend_from_conf(conf, app_id: str) -> ClusterBackend:
         # container group mid-grace would cut the trainer's emergency
         # checkpoint short and orphan the own-session user process
         grace = conf.get_time_ms(K.TASK_TERM_GRACE_MS, 15_000) / 1000.0
+        # warm executor pool (tony.warmpool.enabled): pre-imported
+        # processes launch_container leases instead of cold-spawning —
+        # elastic grow and autoscale slots ride the same path for free
+        from tony_tpu.cluster import warmpool as wp
         return LocalClusterBackend(app_id=app_id,
-                                   stop_grace_sec=grace + 5.0)
+                                   stop_grace_sec=grace + 5.0,
+                                   warmpool=wp.from_conf(conf))
     if kind == "remote":
         from tony_tpu.cluster.remote import (
             ExecTransport, SSHTransport, parse_nodes,

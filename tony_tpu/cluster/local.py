@@ -31,14 +31,18 @@ LOG = logging.getLogger(__name__)
 
 class LocalClusterBackend(ClusterBackend):
     def __init__(self, app_id: str = "local", capacity: int = 0,
-                 stop_grace_sec: float = 0.0):
+                 stop_grace_sec: float = 0.0, warmpool=None):
         """capacity > 0 caps concurrently-allocated containers (MiniCluster's
         bounded NodeManagers); 0 = unbounded. stop_grace_sec > 0 widens
         the TERM→KILL escalation past the default (backend_from_conf
         sizes it to outlast tony.task.term-grace-ms, so an emergency
-        checkpoint is never SIGKILLed mid-write)."""
+        checkpoint is never SIGKILLed mid-write). warmpool (a started
+        cluster.warmpool.WarmExecutorPool) makes launch_container LEASE
+        pre-imported executor processes instead of cold-spawning; a miss
+        or a dead warm child falls back to the cold path transparently."""
         self._app_id = app_id
         self._capacity = capacity
+        self._warmpool = warmpool
         if stop_grace_sec > 0:
             self.STOP_GRACE_SEC = stop_grace_sec   # instance override
         self._seq = 0
@@ -101,15 +105,24 @@ class LocalClusterBackend(ClusterBackend):
                          env: Mapping[str, str], cwd: str) -> None:
         os.makedirs(cwd, exist_ok=True)
         container.log_dir = cwd
-        stdout = open(os.path.join(cwd, "stdout"), "ab")
-        stderr = open(os.path.join(cwd, "stderr"), "ab")
-        full_env = dict(os.environ)
-        full_env.update({k: str(v) for k, v in env.items()})
-        command = self._maybe_docker_wrap(container.container_id, command,
-                                          env, cwd)
-        proc = subprocess.Popen(
-            command, env=full_env, cwd=cwd, stdout=stdout, stderr=stderr,
-            start_new_session=True)  # own pgid → we can kill the whole tree
+        proc = self._try_warm_lease(command, env, cwd)
+        if proc is not None:
+            stdout = stderr = None   # the warm child opens its own files
+            LOG.info("leased warm executor for %s pid=%d",
+                     container.container_id, proc.pid)
+        else:
+            stdout = open(os.path.join(cwd, "stdout"), "ab")
+            stderr = open(os.path.join(cwd, "stderr"), "ab")
+            full_env = dict(os.environ)
+            full_env.update({k: str(v) for k, v in env.items()})
+            command = self._maybe_docker_wrap(container.container_id,
+                                              command, env, cwd)
+            proc = subprocess.Popen(
+                command, env=full_env, cwd=cwd, stdout=stdout,
+                stderr=stderr,
+                start_new_session=True)  # own pgid → kill the whole tree
+            LOG.info("launched %s pid=%d cmd=%s", container.container_id,
+                     proc.pid, " ".join(command[:4]))
         with self._lock:
             self._procs[container.container_id] = proc
         waiter = threading.Thread(
@@ -118,8 +131,25 @@ class LocalClusterBackend(ClusterBackend):
             name=f"wait-{container.container_id}", daemon=True)
         waiter.start()
         self._waiters.append(waiter)
-        LOG.info("launched %s pid=%d cmd=%s", container.container_id,
-                 proc.pid, " ".join(command[:4]))
+
+    def _try_warm_lease(self, command: list[str], env: Mapping[str, str],
+                        cwd: str):
+        """Lease from the warm pool when this launch is a plain (non-
+        docker) `python -m tony_tpu.executor` — anything else (custom
+        commands, docker containers) always cold-spawns. The leased
+        child re-binds via its stdin spec: fresh task env (token,
+        TONY_TRACE_ID), cwd, and the container's stdout/stderr files."""
+        from tony_tpu.cluster.docker import ENV_CONTAINER_TYPE
+        if self._warmpool is None:
+            return None
+        if list(command[-2:]) != ["-m", "tony_tpu.executor"]:
+            return None
+        if env.get(ENV_CONTAINER_TYPE) == "docker":
+            return None
+        return self._warmpool.lease_and_bind(
+            env={k: str(v) for k, v in env.items()}, cwd=cwd,
+            stdout_path=os.path.join(cwd, "stdout"),
+            stderr_path=os.path.join(cwd, "stderr"))
 
     def _maybe_docker_wrap(self, cid: str, command: list[str],
                            env: Mapping[str, str], cwd: str) -> list[str]:
@@ -147,8 +177,14 @@ class LocalClusterBackend(ClusterBackend):
     def _wait_container(self, cid: str, proc: subprocess.Popen,
                         stdout, stderr) -> None:
         rc = proc.wait()
-        stdout.close()
-        stderr.close()
+        # warm-leased containers have no parent-side log files (the
+        # child dup2'ed its own); close whatever this side holds
+        for f in (stdout, stderr, proc.stdout, proc.stdin):
+            try:
+                if f:
+                    f.close()
+            except OSError:
+                pass
         with self._lock:
             was_killed = cid in self._killed
         exit_code = EXIT_KILLED_BY_AM if was_killed else rc
@@ -226,6 +262,8 @@ class LocalClusterBackend(ClusterBackend):
 
     def stop(self) -> None:
         self._stopping = True
+        if self._warmpool is not None:
+            self._warmpool.stop()
         with self._lock:
             procs = list(self._procs.values())
             cids = list(self._procs)

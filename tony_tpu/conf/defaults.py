@@ -219,6 +219,18 @@ DEFAULTS = {
     K.CLUSTER_NODE_ROOT: "",     # "" = /tmp/tony_tpu/<app_id> on each node
     K.STAGING_LOCATION: "",      # "" = <app_dir>/staging (shared filesystem)
 
+    # warm executor pool (cluster/warmpool.py); opt-in
+    K.WARMPOOL_ENABLED: False,
+    K.WARMPOOL_SIZE: 4,
+    K.WARMPOOL_TTL_MS: 300_000,
+
+    # content-addressed localization cache (utils/localization.py); opt-in
+    K.LOCALIZATION_CACHE_ENABLED: False,
+    K.LOCALIZATION_CACHE_DIR: "",   # "" = <tmp>/tony_loc_cache
+
+    # persistent XLA compile cache dir rendered into user envs; "" = off
+    K.EXECUTOR_JAX_CACHE_DIR: "",
+
     # misc
     K.PYTHON_BINARY_PATH: "",
 }

@@ -401,6 +401,32 @@ CLUSTER_SSH_OPTS = "tony.cluster.ssh-opts"    # extra ssh flags (spaces split)
 # --- staging store (HDFS upload/localize equivalent, TonyClient.java:519-590)
 STAGING_LOCATION = "tony.staging.location"    # ""=<app_dir>/staging | dir | gs://
 
+# --- warm executor pool (cluster/warmpool.py) ----------------------------
+# Pre-forked, pre-imported executor processes the local backend leases
+# instead of cold-spawning: a lease re-binds the warm process to its
+# container via a one-shot stdin spec (fresh task token, env,
+# TONY_TRACE_ID — the same attempt fence a cold launch gets). A miss
+# falls back to cold spawn; a crashed/poisoned warm proc is evicted,
+# never reused.
+WARMPOOL_ENABLED = "tony.warmpool.enabled"
+WARMPOOL_SIZE = "tony.warmpool.size"          # idle warm procs kept ready
+WARMPOOL_TTL_MS = "tony.warmpool.ttl-ms"      # idle proc retired past this age
+
+# --- localization cache (utils/localization.py) --------------------------
+# Content-addressed machine-wide resource cache: bytes fetched once per
+# digest into cache-dir (atomic tmp+rename), then hardlinked/copied into
+# each container dir — the Nth job (and every elastic-grow slot) skips
+# the fetch.
+LOCALIZATION_CACHE_ENABLED = "tony.localization.cache-enabled"
+LOCALIZATION_CACHE_DIR = "tony.localization.cache-dir"  # ""=/tmp/tony_loc_cache
+
+# --- executor-rendered user-env knobs ------------------------------------
+# Persistent XLA compile cache dir rendered into every trainer/serving
+# user env as $TONY_JAX_CACHE_DIR (train/trainer.py + serve honor it via
+# utils/compilecache.py); "" disables. The Nth identical trainer skips
+# its cold XLA compile.
+EXECUTOR_JAX_CACHE_DIR = "tony.executor.jax-cache-dir"
+
 # --- misc ----------------------------------------------------------------
 SRC_DIR = "tony.srcdir"
 PYTHON_VENV = "tony.python.venv"
@@ -428,7 +454,8 @@ RESERVED_SEGMENTS = frozenset({
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
     "profiling", "slo", "logs", "straggler", "fleet", "alerts",
-    "arbiter", "checkpoint", "autoscaler", "elastic",
+    "arbiter", "checkpoint", "autoscaler", "elastic", "warmpool",
+    "localization", "executor",
 })
 
 

@@ -95,6 +95,17 @@ TONY_GOODPUT_SEED = "TONY_GOODPUT_SEED"
 # beyond this count after each successful commit (train/checkpoint.py
 # prune_checkpoints; 0 = keep everything)
 CHECKPOINT_KEEP = "TONY_CHECKPOINT_KEEP"
+# persistent XLA compile cache dir (tony.executor.jax-cache-dir rendered
+# into every trainer/serving user env; utils/compilecache.py applies it
+# before the first jit so the Nth identical trainer skips the cold
+# compile — empty/absent = no persistent cache)
+JAX_CACHE_DIR = "TONY_JAX_CACHE_DIR"
+# warm-pool bind fence (cluster/warmpool.py): the pool stamps a
+# per-child nonce into the child env at fork and every stdin bind spec
+# must echo it — a spec written by anything other than THIS child's
+# pool (a stale pipe, a crossed fd after re-exec) is rejected, the
+# process-identity half of the task-token attempt fence
+WARMPOOL_NONCE = "TONY_WARMPOOL_NONCE"
 
 # Paths handed to AM / executor processes via env
 TONY_CONF_PATH = "TONY_CONF_PATH"    # abs path of the frozen tony-final.json

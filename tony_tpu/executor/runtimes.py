@@ -170,4 +170,11 @@ def render_framework_env(framework: str, cluster_spec: ClusterSpec,
         if 0 <= index < len(entries):
             env.setdefault(C.SERVING_PORT,
                            entries[index].rpartition(":")[2])
+    # persistent XLA compile cache (tony.executor.jax-cache-dir) lands
+    # in EVERY framework's user env — trainer and serving engine honor
+    # it via utils/compilecache.py before their first jit, so the Nth
+    # identical process skips the cold compile
+    jax_cache_dir = conf.get_str(K.EXECUTOR_JAX_CACHE_DIR, "")
+    if jax_cache_dir:
+        env.setdefault(C.JAX_CACHE_DIR, jax_cache_dir)
     return env

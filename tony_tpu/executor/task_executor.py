@@ -860,8 +860,15 @@ class TaskExecutor:
         (Utils.extractResources + addResources, util/Utils.java:506-550,
         699-712): the src zip unpacks in place so `python train.py` resolves,
         the venv unpacks under ./venv, archives expand, files copy in."""
+        # content-addressed cache (tony.localization.cache-*): remote
+        # fetches happen once machine-wide, plain files hardlink out of
+        # the digest store — the Nth job (and every elastic-grow slot)
+        # skips the fetch entirely. None = disabled = per-container copy.
+        from tony_tpu.utils.localization import LocalizationCache
+        self._loc_cache = LocalizationCache.from_conf(self.conf)
         src_zip, src_fetched = fetch_remote_spec(
-            self.conf.get_str(K.SRC_DIR), os.getcwd())
+            self.conf.get_str(K.SRC_DIR), os.getcwd(),
+            cache=self._loc_cache)
         if src_zip and src_zip.endswith(".zip") and os.path.exists(src_zip):
             unzip(src_zip, os.getcwd())
             if src_fetched:
@@ -869,7 +876,8 @@ class TaskExecutor:
         venv = self.conf.get_str(K.PYTHON_VENV)
         if venv:
             path, venv_fetched = fetch_remote_spec(venv.split("#", 1)[0],
-                                                   os.getcwd())
+                                                   os.getcwd(),
+                                                   cache=self._loc_cache)
             if path and path.endswith(".zip") and os.path.exists(path):
                 unzip(path, os.path.join(os.getcwd(), "venv"))
                 if venv_fetched:
@@ -878,7 +886,7 @@ class TaskExecutor:
                  + self.conf.get_strings(K.CONTAINERS_RESOURCES))
         for spec in specs:
             try:
-                localize_resource(spec, os.getcwd())
+                localize_resource(spec, os.getcwd(), cache=self._loc_cache)
             except FileNotFoundError:
                 LOG.error("resource missing at localization time: %s", spec)
                 raise
@@ -901,8 +909,17 @@ class TaskExecutor:
         # needs to tail
         self._start_log_service()
         loc_t0 = time.monotonic()
-        with self.tracer.span("executor_localization"):
+        loc_span = self.tracer.start("executor_localization")
+        ok = False
+        try:
             self.localize_resources()
+            ok = True
+        finally:
+            cache = getattr(self, "_loc_cache", None)
+            self.tracer.end(loc_span, "OK" if ok else "ERROR", attrs={
+                "cache_hits": cache.hits if cache else 0,
+                "cache_misses": cache.misses if cache else 0,
+            })
         self._goodput_seed["localization"] = time.monotonic() - loc_t0
         self.setup_ports()
         try:

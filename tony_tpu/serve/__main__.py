@@ -71,6 +71,12 @@ def _load_model(args):
     import jax
     import jax.numpy as jnp
 
+    # persistent XLA compile cache ($TONY_JAX_CACHE_DIR rendered into
+    # the serving user env): applied before any device work so replica
+    # N skips replica 0's cold prefill/decode compile
+    from tony_tpu.utils.compilecache import maybe_enable_compile_cache
+    maybe_enable_compile_cache(jax_module=jax)
+
     from tony_tpu.models.moe import is_moe_preset
 
     if is_moe_preset(args.config):

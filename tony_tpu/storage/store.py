@@ -23,6 +23,15 @@ import logging
 import os
 import shutil
 import subprocess
+import uuid
+
+
+def _tmp_suffix() -> str:
+    """Unique per use: executors can run as threads of one pool
+    process, so pid-only tmp names collide on concurrent same-dest
+    puts/fetches and break the tmp+rename atomicity."""
+    return f"{os.getpid()}-{uuid.uuid4().hex[:12]}"
+
 
 LOG = logging.getLogger(__name__)
 
@@ -82,7 +91,7 @@ class LocalDirStore(StagingStore):
             # fleet registry's jobstate scan, the durable accounting)
             # must never observe a half-written file — GCS puts are
             # server-side atomic, the local twin has to earn it
-            tmp = f"{dest}.put-tmp-{os.getpid()}"
+            tmp = f"{dest}.put-tmp-{_tmp_suffix()}"
             shutil.copy2(local_path, tmp)
             os.replace(tmp, dest)
         return dest
@@ -92,7 +101,16 @@ class LocalDirStore(StagingStore):
         os.makedirs(os.path.dirname(os.path.abspath(dest_path)),
                     exist_ok=True)
         if os.path.abspath(src) != os.path.abspath(dest_path):
-            shutil.copy2(src, dest_path)
+            # download-to-tmp + rename, same idiom as put(): an executor
+            # killed mid-fetch must never leave a torn file that the
+            # localization cache (or a retry) would then serve as whole
+            tmp = f"{dest_path}.fetch-tmp-{_tmp_suffix()}"
+            try:
+                shutil.copy2(src, tmp)
+                os.replace(tmp, dest_path)
+            finally:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
         return dest_path
 
     def exists(self, uri: str) -> bool:
@@ -163,7 +181,15 @@ class GCSStore(StagingStore):
     def fetch(self, uri: str, dest_path: str) -> str:
         os.makedirs(os.path.dirname(os.path.abspath(dest_path)),
                     exist_ok=True)
-        self._run("cp", uri, dest_path)
+        # atomic like LocalDirStore.fetch: gsutil writes dest in place,
+        # so a killed download would otherwise leave a torn file
+        tmp = f"{dest_path}.fetch-tmp-{_tmp_suffix()}"
+        try:
+            self._run("cp", uri, tmp)
+            os.replace(tmp, dest_path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         return dest_path
 
     def exists(self, uri: str) -> bool:

@@ -177,6 +177,12 @@ class Trainer:
 
     def _setup_inner(self) -> None:
         maybe_initialize_distributed()
+        # persistent XLA compile cache ($TONY_JAX_CACHE_DIR, rendered by
+        # the executor from tony.executor.jax-cache-dir): applied before
+        # any jit below, so the Nth identical trainer skips the cold
+        # compile — the warm-bring-up third of the cold-start work
+        from tony_tpu.utils.compilecache import maybe_enable_compile_cache
+        maybe_enable_compile_cache(jax_module=jax)
         # device evidence AFTER distributed init — jax.devices() here
         # would otherwise initialize the local backend first and make a
         # later jax.distributed.initialize() raise on multi-worker runs

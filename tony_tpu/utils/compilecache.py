@@ -1,0 +1,49 @@
+"""Persistent XLA compile cache: honor $TONY_JAX_CACHE_DIR in user
+processes.
+
+Through the axon tunnel a cold llama3_1b_proxy train-step compile costs
+~135s (r5 evidence: tools/bench_diag.log) — most of a container's
+bring-up. The cache dir knob (`tony.executor.jax-cache-dir`) is rendered
+into every trainer/serving user env by the executor
+(executor/runtimes.py); this helper applies it right before the first
+jit, so the Nth identical trainer skips the cold compile. One shared
+implementation for the trainer, the serving engine, and bench children
+— the setup that used to live only in bench.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LOG = logging.getLogger(__name__)
+
+
+def maybe_enable_compile_cache(jax_module=None,
+                               cache_dir: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at $TONY_JAX_CACHE_DIR
+    (or an explicit `cache_dir`). Returns the directory applied, None
+    when unset or when jax refuses — the cache is an optimization,
+    never a dependency, so every failure is a log line, not an error."""
+    from tony_tpu import constants as C
+
+    d = cache_dir if cache_dir is not None else os.environ.get(
+        C.JAX_CACHE_DIR, "")
+    if not d:
+        return None
+    try:
+        jax = jax_module
+        if jax is None:
+            import jax  # noqa: F811 — deferred: callers may be jax-free
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # cache even fast compiles (a 1k-wide gang recompiling 0.6 s
+        # kernels still serializes on the tunnel) and any entry size
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        LOG.info("persistent XLA compile cache at %s", d)
+        return d
+    except Exception as e:  # noqa: BLE001
+        LOG.warning("persistent compile cache unavailable: %s: %s",
+                    type(e).__name__, e)
+        return None
