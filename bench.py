@@ -1383,6 +1383,106 @@ def _cp_disclosure(row: dict, cold_baseline_s=None) -> dict:
     return d
 
 
+def _am_recovery_disclosure(row: dict) -> dict:
+    """Recovery-leg disclosure stamped onto the control_plane_am_recovery
+    history entry: a recovery-time headline means nothing without how
+    much of the gang it actually recovered — an AM that 'recovered' fast
+    by relaunching everyone would otherwise look like a win."""
+    return {"adopted": row.get("adopted", 0),
+            "lost": row.get("lost", 0),
+            "replayed_records": row.get("replayed_records", 0),
+            "relaunches": row.get("relaunches", 0),
+            "kill_after_ms": row.get("kill_after_ms", 0)}
+
+
+def _control_plane_am_recovery(width: int, kill_after_ms: int = 4000,
+                               run_sec: float = 25.0) -> dict:
+    """`bench.py --control-plane` AM-kill leg: run a REAL width-k gang
+    through the full client -> supervised AM -> executor chain, SIGKILL
+    the AM mid-run (the TEST_AM_KILL hook, same one the chaos suite
+    drives), and let am/supervisor.py relaunch it: the new attempt
+    replays the journal and every orphaned executor re-registers through
+    the adoption barrier. The measured number is the AM_RECOVERY_COMPLETED
+    event's downtime_ms — wall clock from the kill until the last live
+    executor was adopted, i.e. how long the control plane was actually
+    gone — lower is better. `ok` demands the job SUCCEEDED with the
+    whole gang adopted and ZERO relaunches: a "recovery" that relaunched
+    user processes is the failure mode this subsystem exists to avoid,
+    and must never become a baseline."""
+    import shutil
+    import tempfile
+
+    from tony_tpu import constants as TC
+    from tony_tpu.client.tony_client import TonyClient
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.configuration import TonyConfiguration
+    from tony_tpu.events.handler import parse_events
+    from tony_tpu.events.schema import EventType
+
+    workdir = tempfile.mkdtemp(prefix="tony_cp_amkill_")
+    conf = TonyConfiguration()
+    conf.set(K.CLUSTER_WORKDIR, workdir, "bench")
+    conf.set(K.instances_key("worker"), width, "bench")
+    # test-scale cadences (the chaos suite's fast_conf shape): 200 ms
+    # heartbeats, orphan after 2 strikes, AM-side expiry window 5 s —
+    # liveliness clocks restart fresh per adopted member, so the window
+    # only has to cover steady-state jitter, not the outage itself
+    conf.set(K.AM_MONITOR_INTERVAL_MS, 100, "bench")
+    conf.set(K.TASK_HEARTBEAT_INTERVAL_MS, 200, "bench")
+    conf.set(K.TASK_MAX_MISSED_HEARTBEATS, 25, "bench")
+    conf.set(K.TASK_HB_FAILURE_BUDGET, 2, "bench")
+    conf.set(K.AM_ORPHAN_GRACE_MS, 120_000, "bench")
+    conf.set(K.TASK_REGISTRATION_TIMEOUT_SEC, 120, "bench")
+    conf.set(K.CONTAINER_ALLOCATION_TIMEOUT, 120_000, "bench")
+    conf.set(K.AM_STOP_POLL_TIMEOUT_MS, 3000, "bench")
+    # the survivability knobs under test: supervised restart + journal
+    conf.set(K.AM_MAX_ATTEMPTS, 3, "bench")
+    conf.set(K.AM_RETRY_BACKOFF_BASE_MS, 250, "bench")
+    conf.set(K.AM_RETRY_BACKOFF_MAX_MS, 500, "bench")
+    # user processes are plain sleeps long enough to span the outage:
+    # adoption only counts executors whose user process never died
+    conf.set(K.TASK_COMMAND, f"exec sleep {run_sec}", "bench")
+
+    hook = f"{kill_after_ms}#0"      # kill AM process-attempt 0 only
+    saved = os.environ.get(TC.TEST_AM_KILL)
+    os.environ[TC.TEST_AM_KILL] = hook
+    row = {"width": width, "kill_after_ms": kill_after_ms, "ok": False}
+    client = TonyClient(conf)
+    try:
+        client.init([])
+        client.run()
+    finally:
+        if saved is None:
+            os.environ.pop(TC.TEST_AM_KILL, None)
+        else:
+            os.environ[TC.TEST_AM_KILL] = saved
+    row["final_status"] = client.final_status
+    hist_base = os.path.join(client.app_dir, TC.HISTORY_DIR_NAME)
+    finals = [os.path.join(d, f) for d, _, files in os.walk(hist_base)
+              for f in files if f.endswith(TC.HISTORY_SUFFIX)]
+    if client.final_status == "SUCCEEDED" and len(finals) == 1:
+        events = parse_events(finals[0])
+        completed = [e.payload for e in events
+                     if e.type == EventType.AM_RECOVERY_COMPLETED]
+        row["relaunches"] = sum(
+            1 for e in events if e.type == EventType.TASK_RELAUNCHED)
+        if completed:
+            rec = completed[-1]
+            row.update({
+                "recovery_s": round(rec.downtime_ms / 1000.0, 3),
+                "downtime_ms": rec.downtime_ms,
+                "adoption_ms": rec.duration_ms,
+                "adopted": rec.adopted,
+                "lost": rec.lost,
+                "replayed_records": rec.replayed_records,
+                "am_attempt": rec.am_attempt,
+            })
+            row["ok"] = (rec.adopted >= width and rec.lost == 0
+                         and row["relaunches"] == 0)
+    shutil.rmtree(workdir, ignore_errors=True)
+    return row
+
+
 def control_plane_main() -> None:
     """`python bench.py --control-plane`: the control-plane harness —
     the synthetic-width stub storm at gang widths {48, 256, 1024}
@@ -1392,18 +1492,24 @@ def control_plane_main() -> None:
     fork+import per pool process, per-container resource copies) and a
     WARM leg (pre-warmed cluster/warmpool.py executor pool + pre-seeded
     content-addressed localization cache), plus a resize-grow leg
-    (+widest/8 executors, warm vs cold) modeling the elastic grow path.
+    (+widest/8 executors, warm vs cold) modeling the elastic grow path,
+    plus an AM-KILL leg (TONY_CP_RECOVERY_WIDTH, default 8; "" skips)
+    that SIGKILLs a live gang's AM and times the supervised-restart ->
+    journal-replay -> adoption recovery.
     Emits ONE JSON line with a `control_plane` block and the widest
     width's spec_bytes_sent / hb_p95_ms at top level; appends gated
     entries (control_plane_spec_bytes [bytes], control_plane_hb_p95
     [ms], control_plane_all_registered [s],
     control_plane_resize_roundtrip [s],
     control_plane_real_all_running [s] — the WARM number, appended only
-    when it beat the same run's cold leg — and resize_grow_latency [s],
-    same rule — all lower-is-better) to tools/bench_history.jsonl for
+    when it beat the same run's cold leg — resize_grow_latency [s],
+    same rule — and control_plane_am_recovery [s], appended only when
+    the WHOLE gang was adopted with zero relaunches — all
+    lower-is-better) to tools/bench_history.jsonl for
     tools/bench_compare.py. Exits non-zero if AM-side state is
-    unbounded, the diff protocol failed to converge, or any real gang
-    (either leg) never reached all-running."""
+    unbounded, the diff protocol failed to converge, any real gang
+    (either leg) never reached all-running, or the AM-kill leg failed
+    to recover the full gang."""
     import shutil
     import tempfile
 
@@ -1462,6 +1568,20 @@ def control_plane_main() -> None:
         grow = {"grow_n": grow_n, "cold": grow_cold, "warm": grow_warm}
     if cache_root:
         shutil.rmtree(cache_root, ignore_errors=True)
+    # AM-kill recovery leg: kill the control plane of a live gang and
+    # time the supervised-restart -> journal-replay -> adoption path
+    # (TONY_CP_RECOVERY_WIDTH overrides the width; "" skips the leg)
+    recovery = None
+    rec_width = os.environ.get("TONY_CP_RECOVERY_WIDTH", "8").strip()
+    if rec_width:
+        _mark(f"control-plane AM-kill recovery leg: width {rec_width}")
+        recovery = _control_plane_am_recovery(int(rec_width))
+        _mark(f"am-kill width {recovery['width']}: recovery "
+              f"{recovery.get('recovery_s')}s adopted "
+              f"{recovery.get('adopted')}/{recovery['width']} lost "
+              f"{recovery.get('lost')} replayed "
+              f"{recovery.get('replayed_records')} relaunches "
+              f"{recovery.get('relaunches')} ok={recovery['ok']}")
     widest = rows[-1] if rows else {}
     result = {"metric": "control_plane", "backend": "cpu",
               # not a fallback: this metric never touches the chip
@@ -1470,12 +1590,14 @@ def control_plane_main() -> None:
               "spec_bytes_sent": widest.get("spec", {}).get("bytes_sent"),
               "hb_p95_ms": widest.get("heartbeat_p95_ms"),
               "control_plane": {"widths": rows, "real": real_rows,
-                                "grow": grow}}
+                                "grow": grow, "recovery": recovery}}
     unbounded = [r["width"] for r in rows if not r["bounded"]]
     real_failed = [r["width"] for r in real_rows
                    if not (r["cold"]["ok"] and r["warm"]["ok"])]
     if grow and not (grow["cold"]["ok"] and grow["warm"]["ok"]):
         real_failed.append(f"grow+{grow['grow_n']}")
+    if recovery is not None and not recovery["ok"]:
+        real_failed.append(f"am-kill@{recovery['width']}")
     # gated history entries: a future chatty regression (spec fan-out,
     # heartbeat tail, rendezvous latency) fails bench_compare loudly.
     # Only a PASSING run may append — a diverged/failed run's numbers
@@ -1530,14 +1652,25 @@ def control_plane_main() -> None:
             else:
                 _mark(f"grow warm leg did not beat cold ({wv}s vs {cv}s)"
                       f" — resize_grow_latency headline withheld")
+        if recovery is not None and recovery["ok"] \
+                and recovery.get("recovery_s"):
+            # the gate above already proved adopted == width, lost == 0,
+            # zero relaunches — only a FULL recovery's time is a baseline
+            _append_history({**base,
+                             "metric": "control_plane_am_recovery",
+                             "value": recovery["recovery_s"], "unit": "s",
+                             "width": recovery["width"],
+                             **_am_recovery_disclosure(recovery)})
     if unbounded:
         result["error"] = (f"span/metrics/skew/spec-diff state unbounded "
                            f"or diverged at width(s) {unbounded} — "
                            f"decimation, the skew sketches, or the diff "
                            f"protocol regressed")
     if real_failed:
-        result["real_error"] = (f"real-executor gang(s) at width(s) "
-                                f"{real_failed} never reached all-running")
+        result["real_error"] = (f"real-executor leg(s) {real_failed} "
+                                f"failed: gang never reached all-running, "
+                                f"or the AM-kill leg did not recover the "
+                                f"full gang relaunch-free")
     line = json.dumps(result)
     if len(line) > 4000:
         # keep the driver-facing line bounded; full rows went to stderr

@@ -104,6 +104,35 @@ class CrashAM(Injection):
         return {C.TEST_AM_CRASH: "1"}
 
 
+class KillAM(Injection):
+    """SIGKILL the AM process `after_ms` after prepare() — no _finish, no
+    status.json, executors left running. With tony.am.max-attempts > 1
+    the supervisor relaunches the AM, which replays the journal and
+    adopts the orphaned gang (AM hook TEST_AM_KILL). `attempt` pins the
+    kill to one AM process attempt (default 0: only the first AM dies,
+    the recovered attempt survives)."""
+
+    def __init__(self, after_ms: int, attempt: int = 0):
+        self.after_ms, self.attempt = after_ms, attempt
+
+    def env(self) -> dict:
+        return {C.TEST_AM_KILL: f"{self.after_ms}#{self.attempt}"}
+
+
+class HangAM(Injection):
+    """SIGSTOP the AM `after_ms` after prepare() and SIGCONT it
+    `hang_ms` later — the wedged-not-dead control plane. Executors
+    exhaust their heartbeat budget, go orphan, and must re-attach to the
+    SAME address once the AM thaws (AM hook TEST_AM_HANG)."""
+
+    def __init__(self, after_ms: int, hang_ms: int, attempt: int = 0):
+        self.after_ms, self.hang_ms, self.attempt = after_ms, hang_ms, attempt
+
+    def env(self) -> dict:
+        return {C.TEST_AM_HANG:
+                f"{self.after_ms}#{self.hang_ms}#{self.attempt}"}
+
+
 class TerminateWorkers(Injection):
     """The AM kills every worker container once the chief registers
     (TEST_WORKER_TERMINATION, ApplicationMaster.java:1204-1215)."""

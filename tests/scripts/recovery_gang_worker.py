@@ -1,0 +1,65 @@
+"""Fixture: AM-crash survivability gang member (tests/test_recovery.py).
+
+Every start appends a {attempt, generation} line to
+$MARKER_DIR/<job>_<idx> (the chaos harness's relaunch ground truth) and
+then emits a fully deterministic per-step loss trajectory to
+$MARKER_DIR/loss_<job>_<idx> — loss is a pure function of (step, task
+index), so two runs of the same gang produce bit-identical loss files
+no matter how long an AM outage stalled the middle of one of them.
+
+Knobs (env):
+- RECOVERY_STEPS       total steps (default 8)
+- RECOVERY_STEP_SLEEP  seconds slept per step (default 0.05)
+- CHAOS_RECOVERY_HOLD  path: at the halfway step, poll until this file
+  exists (bounded) — the disturbed run's way of parking the gang
+  mid-training while the AM is killed, recovered, and the adoption
+  barrier drains. Unset (the undisturbed twin) → no hold, same output.
+
+SIGTERM (the executor's TERM→emergency-checkpoint→KILL ladder) writes
+$MARKER_DIR/ckpt_<job>_<idx> — the "emergency checkpoint" evidence the
+orphan-grace self-fence test asserts — then exits.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+job = os.environ["JOB_NAME"]
+index = int(os.environ["TASK_INDEX"])
+attempt = int(os.environ.get("TASK_ATTEMPT", "0"))
+generation = int(os.environ.get("SPEC_GENERATION", "0"))
+marker_dir = os.environ["MARKER_DIR"]
+steps = int(os.environ.get("RECOVERY_STEPS", "8"))
+step_sleep = float(os.environ.get("RECOVERY_STEP_SLEEP", "0.05"))
+hold_file = os.environ.get("CHAOS_RECOVERY_HOLD", "")
+
+os.makedirs(marker_dir, exist_ok=True)
+with open(os.path.join(marker_dir, f"{job}_{index}"), "a") as f:
+    f.write(json.dumps({"attempt": attempt, "generation": generation}) + "\n")
+
+
+def _on_term(signum, frame):
+    with open(os.path.join(marker_dir, f"ckpt_{job}_{index}"), "w") as fh:
+        fh.write(json.dumps({"attempt": attempt, "emergency": True}) + "\n")
+    sys.exit(0)
+
+
+signal.signal(signal.SIGTERM, _on_term)
+
+loss_path = os.path.join(marker_dir, f"loss_{job}_{index}")
+with open(loss_path, "a") as f:
+    for step in range(steps):
+        if hold_file and step == steps // 2:
+            deadline = time.monotonic() + 180
+            while not os.path.exists(hold_file) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+        # pure function of (step, index): bit-identical across runs
+        loss = round(1.0 / (step + 1) + index * 1e-3, 9)
+        f.write(f"{step} {loss:.9f}\n")
+        f.flush()
+        time.sleep(step_sleep)
+
+raise SystemExit(0)

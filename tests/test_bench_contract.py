@@ -382,6 +382,49 @@ def test_coldstart_headline_units_gate_lower_is_better():
         assert v[0].get("note") == "no prior baseline"
 
 
+@pytest.mark.recovery
+def test_am_recovery_headline_gate_lower_is_better():
+    """The AM-kill leg's control_plane_am_recovery headline carries unit
+    "s" so bench_compare judges it lower-is-better (recovery got SLOWER
+    later = regression), and value<=0 markers from failed/withheld runs
+    never judge and never serve as a baseline."""
+    from tools.bench_compare import compare
+
+    fast = {"metric": "control_plane_am_recovery", "value": 3.1,
+            "unit": "s", "backend": "cpu", "width": 8,
+            "adopted": 8, "lost": 0, "replayed_records": 25}
+    slow = dict(fast, value=5.0)
+    v = compare([fast, slow], threshold_pct=2.0)
+    assert len(v) == 1 and v[0]["regression"] is True
+    v = compare([slow, fast], threshold_pct=2.0)
+    assert v[0]["regression"] is False
+    marker = dict(fast, value=0.0)
+    v = compare([fast, slow, marker], threshold_pct=2.0)
+    assert v[0]["regression"] is True       # latest MEASURABLE judged
+    v = compare([marker, slow], threshold_pct=2.0)
+    assert v[0]["regression"] is False
+    assert v[0].get("note") == "no prior baseline"
+
+
+@pytest.mark.recovery
+def test_am_recovery_disclosure_stamps_adoption_fields():
+    """Every control_plane_am_recovery history entry discloses what the
+    recovery actually did — a fast downtime number that relaunched the
+    gang (or replayed an empty journal) must be distinguishable from a
+    genuine full adoption."""
+    row = {"width": 8, "kill_after_ms": 4000, "recovery_s": 3.102,
+           "adopted": 8, "lost": 0, "replayed_records": 25,
+           "relaunches": 0, "am_attempt": 1}
+    d = bench._am_recovery_disclosure(row)
+    assert d == {"adopted": 8, "lost": 0, "replayed_records": 25,
+                 "relaunches": 0, "kill_after_ms": 4000}
+    # a degraded run's entry would say so on its face
+    d = bench._am_recovery_disclosure({"adopted": 6, "lost": 2,
+                                       "relaunches": 2})
+    assert d["lost"] == 2 and d["relaunches"] == 2
+    assert d["replayed_records"] == 0
+
+
 @pytest.mark.warmpool
 def test_cp_disclosure_stamps_warm_fields():
     """Every control-plane bench line discloses whether it rode the warm

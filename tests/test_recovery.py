@@ -1,0 +1,757 @@
+"""AM-crash survivability: journal replay, supervised restart, adoption.
+
+The tentpole's three legs, each pinned at its own layer:
+
+- **journal** (am/journal.py): attempt-stamped WAL units — roundtrip,
+  torn tail, attempt fencing, snapshot+incremental, session rollover,
+  discard;
+- **supervised restart** (am/supervisor.py): the relaunch-until-verdict
+  loop against a scripted fake AM process;
+- **orphan mode + adoption** (executor/task_executor.py): budget-
+  exhausted heartbeater enters orphan mode instead of os._exit, the
+  re-attach swaps RPC clients, and the grace expiry self-fences through
+  the TERM→emergency-checkpoint→KILL ladder;
+
+then proven whole on the real client → supervisor → AM → executor →
+user-python chain: SIGKILL the AM mid-training at width 64 and the
+restarted attempt adopts every live executor with ZERO user-process
+relaunches and a loss trajectory bit-identical to an undisturbed twin.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from tony_tpu import constants as C
+from tony_tpu.am import journal as J
+from tony_tpu.am import supervisor as sup
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.events.handler import EventHandler, parse_events
+from tony_tpu.events.history import JobMetadata
+from tony_tpu.events.render import render_event
+from tony_tpu.events.schema import (
+    AmRecoveryCompleted, AmRecoveryStarted, Event, EventType, TaskStarted,
+)
+from tony_tpu.executor import task_executor as te
+from tony_tpu.observability import fleet
+
+from tests.chaos import ChaosRun, HangAM, KillAM, script
+
+recovery = pytest.mark.recovery
+chaos = pytest.mark.chaos
+pytestmark = recovery
+
+
+# ---------------------------------------------------------------------------
+# journal units: the WAL a fresh AM attempt replays
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_restores_tasks_endpoints_clocks(tmp_path):
+    j = J.ControlPlaneJournal(str(tmp_path))
+    j.append(J.REC_SESSION, session_id=1, expected=2,
+             instances={"worker": 2})
+    j.append(J.REC_CONTAINER, task_id="worker:0", attempt=0,
+             container_id="c1", host="h1")
+    j.append(J.REC_REGISTER, task_id="worker:0", attempt=0,
+             host_port="h1:10", generation=1)
+    j.append(J.REC_REGISTER, task_id="worker:1", attempt=0,
+             host_port="h2:11", generation=1)
+    j.append(J.REC_ENDPOINT, task_id="worker:0", url="http://h1:9",
+             generation=1)
+    j.append(J.REC_CLOCK, am_downtime_s=1.5, relaunch_downtime_s=0.5)
+    j.append(J.REC_COMPLETED, task_id="worker:1", attempt=0, exit_code=0,
+             status="SUCCEEDED")
+    j.close()
+
+    st = J.replay(str(tmp_path))
+    assert st.session_id == 1 and st.num_expected == 2
+    assert st.instances == {"worker": 2}
+    assert st.replayed_records == 7
+    assert st.tasks["worker:0"]["host_port"] == "h1:10"
+    assert st.tasks["worker:0"]["container_id"] == "c1"
+    assert st.tasks["worker:1"]["completed"] is True
+    # the adoption barrier's membership: registered ∧ not terminal
+    assert set(st.live_tasks()) == {"worker:0"}
+    assert st.endpoints["worker:0"]["url"] == "http://h1:9"
+    assert st.clocks["am_downtime_s"] == 1.5
+    assert st.clocks["relaunch_downtime_s"] == 0.5
+    assert st.last_ts_ms > 0
+    # dict roundtrip (the snapshot's serialization)
+    st2 = J.RecoveredState.from_dict(st.to_dict())
+    assert st2.to_dict() == st.to_dict()
+
+
+def test_journal_torn_tail_keeps_prefix(tmp_path):
+    j = J.ControlPlaneJournal(str(tmp_path))
+    j.append(J.REC_SESSION, session_id=1, expected=1)
+    j.append(J.REC_REGISTER, task_id="worker:0", attempt=0,
+             host_port="h:1", generation=1)
+    j.close()
+    # a SIGKILL mid-append leaves a torn record: the scan must keep the
+    # durable prefix and drop only the tail
+    with open(J.journal_path(str(tmp_path)), "a", encoding="utf-8") as f:
+        f.write('{"type": "register", "task_id": "worker:1", "ho')
+    st = J.replay(str(tmp_path))
+    assert st.replayed_records == 2
+    assert set(st.tasks) == {"worker:0"}
+
+
+def test_journal_attempt_fencing_drops_stale_records(tmp_path):
+    j = J.ControlPlaneJournal(str(tmp_path))
+    j.append(J.REC_SESSION, session_id=1, expected=1)
+    j.append(J.REC_REGISTER, task_id="worker:0", attempt=0,
+             host_port="old:1", generation=1)
+    j.append(J.REC_RELAUNCH, task_id="worker:0", attempt=1, generation=2)
+    # a stale attempt-0 record written by a zombie must not resurrect
+    # the voided registration
+    j.append(J.REC_REGISTER, task_id="worker:0", attempt=0,
+             host_port="zombie:1", generation=1)
+    j.close()
+    st = J.replay(str(tmp_path))
+    t = st.tasks["worker:0"]
+    assert t["attempt"] == 1
+    assert t["host_port"] == ""          # relaunch voided it; fence held
+    assert st.spec_generation == 2
+    assert st.live_tasks() == {}
+
+
+def test_journal_snapshot_plus_incremental(tmp_path):
+    j = J.ControlPlaneJournal(str(tmp_path), snapshot_every=3)
+    j.append(J.REC_SESSION, session_id=1, expected=2,
+             instances={"worker": 2})
+    j.append(J.REC_REGISTER, task_id="worker:0", attempt=0,
+             host_port="h1:10", generation=1)
+    j.append(J.REC_REGISTER, task_id="worker:1", attempt=0,
+             host_port="h2:11", generation=1)
+    # the third append crossed snapshot_every: state compacted, WAL reset
+    assert os.path.exists(J.snapshot_path(str(tmp_path)))
+    assert os.path.getsize(J.journal_path(str(tmp_path))) == 0
+    # incremental records after the snapshot layer on top of it
+    j.append(J.REC_RELAUNCH, task_id="worker:1", attempt=1, generation=2)
+    j.close()
+    st = J.replay(str(tmp_path))
+    assert st.tasks["worker:0"]["host_port"] == "h1:10"
+    assert st.tasks["worker:1"]["attempt"] == 1
+    assert st.spec_generation == 2
+    assert set(st.live_tasks()) == {"worker:0"}
+
+
+def test_journal_session_rollover_clears_tasks_keeps_clocks(tmp_path):
+    j = J.ControlPlaneJournal(str(tmp_path))
+    j.append(J.REC_SESSION, session_id=1, expected=1)
+    j.append(J.REC_REGISTER, task_id="worker:0", attempt=0,
+             host_port="h:1", generation=1)
+    j.append(J.REC_CLOCK, am_downtime_s=2.0)
+    j.append(J.REC_SESSION, session_id=2, expected=1)
+    j.close()
+    st = J.replay(str(tmp_path))
+    assert st.session_id == 2
+    assert st.tasks == {}                 # the retry voided registrations
+    assert st.clocks["am_downtime_s"] == 2.0   # downtime carries across
+
+
+def test_journal_discard_removes_both_files(tmp_path):
+    j = J.ControlPlaneJournal(str(tmp_path), snapshot_every=1)
+    j.append(J.REC_SESSION, session_id=1, expected=1)
+    j.append(J.REC_REGISTER, task_id="worker:0", attempt=0,
+             host_port="h:1", generation=1)
+    assert J.has_journal(str(tmp_path))
+    j.discard()
+    assert not J.has_journal(str(tmp_path))
+    assert not os.path.exists(J.journal_path(str(tmp_path)))
+    assert not os.path.exists(J.snapshot_path(str(tmp_path)))
+
+
+def test_recovery_events_render():
+    line = render_event(EventType.AM_RECOVERY_STARTED,
+                        {"application_id": "app_1", "am_attempt": 1,
+                         "live_tasks": 64, "replayed_records": 130})
+    assert "recover" in line.lower() and "64" in line
+    line = render_event(EventType.AM_RECOVERY_COMPLETED,
+                        {"application_id": "app_1", "am_attempt": 1,
+                         "adopted": 63, "lost": 1, "replayed_records": 130,
+                         "duration_ms": 1200, "downtime_ms": 4000})
+    assert "63" in line and "1" in line
+
+
+# ---------------------------------------------------------------------------
+# supervisor units: relaunch until a verdict, never past max-attempts
+# ---------------------------------------------------------------------------
+
+class _FakeAmPopen:
+    """Scripted stand-in for the `python -m tony_tpu.am` child."""
+
+    launches: list = []        # (attempt_env, rc) per launch
+    script: list = []          # rc queue
+    write_status_at: set = ()  # launch ordinals that leave status.json
+    status_path = ""
+
+    def __init__(self, argv, env=None, **kw):
+        ordinal = len(_FakeAmPopen.launches)
+        self._rc = _FakeAmPopen.script[ordinal]
+        _FakeAmPopen.launches.append((env.get(C.AM_ATTEMPT), self._rc))
+        if ordinal in _FakeAmPopen.write_status_at:
+            with open(_FakeAmPopen.status_path, "w") as f:
+                f.write("{}")
+
+    def wait(self):
+        return self._rc
+
+    def poll(self):
+        return self._rc
+
+    def send_signal(self, sig):
+        pass
+
+
+def _sup_conf(max_attempts: int) -> TonyConfiguration:
+    conf = TonyConfiguration()
+    conf.set(K.AM_MAX_ATTEMPTS, max_attempts, "test")
+    conf.set(K.AM_RETRY_BACKOFF_BASE_MS, 1, "test")
+    conf.set(K.AM_RETRY_BACKOFF_MAX_MS, 2, "test")
+    return conf
+
+
+def _supervise_scripted(tmp_path, monkeypatch, script_rcs, max_attempts,
+                        write_status_at=()):
+    _FakeAmPopen.launches = []
+    _FakeAmPopen.script = list(script_rcs)
+    _FakeAmPopen.write_status_at = set(write_status_at)
+    _FakeAmPopen.status_path = os.path.join(str(tmp_path), C.AM_STATUS_FILE)
+    monkeypatch.setattr(sup.subprocess, "Popen", _FakeAmPopen)
+    return sup.supervise("app_sup", str(tmp_path),
+                         conf=_sup_conf(max_attempts))
+
+
+def test_supervisor_relaunches_crashed_am_with_attempt_env(tmp_path,
+                                                           monkeypatch):
+    rc = _supervise_scripted(tmp_path, monkeypatch, [1, 137, 0],
+                             max_attempts=3)
+    assert rc == 0
+    # every relaunch carried the next TONY_AM_ATTEMPT — the env the AM
+    # keys journal replay on
+    assert [a for a, _ in _FakeAmPopen.launches] == ["0", "1", "2"]
+
+
+def test_supervisor_stops_at_max_attempts(tmp_path, monkeypatch):
+    rc = _supervise_scripted(tmp_path, monkeypatch, [1, 1], max_attempts=2)
+    assert rc == 1
+    assert len(_FakeAmPopen.launches) == 2
+
+
+def test_supervisor_respects_status_json_verdict(tmp_path, monkeypatch):
+    """A non-zero AM exit AFTER status.json exists is an application
+    outcome (e.g. FAILED), not an AM crash — no relaunch."""
+    rc = _supervise_scripted(tmp_path, monkeypatch, [3], max_attempts=5,
+                             write_status_at={0})
+    assert rc == 3
+    assert len(_FakeAmPopen.launches) == 1
+
+
+# ---------------------------------------------------------------------------
+# heartbeater orphan-hook units
+# ---------------------------------------------------------------------------
+
+class _HbClientStub:
+    def __init__(self, fail: bool):
+        self.fail = fail
+        self.pings = 0
+        self.calls: list = []
+
+    def task_executor_heartbeat(self, *a, **kw):
+        if self.fail:
+            raise ConnectionError("AM is gone")
+        self.pings += 1
+        return {}
+
+    def call(self, method, req=None, **kw):
+        self.calls.append((method, req, kw))
+        if self.fail:
+            raise ConnectionError("AM is gone")
+        return {}
+
+
+def test_heartbeater_orphan_hook_resets_budget_and_resumes():
+    dead, live = _HbClientStub(fail=True), _HbClientStub(fail=False)
+    hb = te.Heartbeater(dead, "worker:0", interval_sec=0.01,
+                        failure_budget=2)
+    hooks = []
+
+    def on_orphaned():
+        hooks.append(1)
+        hb.swap_client(live)     # "a recovered AM adopted us"
+        return True
+
+    hb._on_orphaned = on_orphaned
+    hb.start()
+    deadline = time.monotonic() + 10
+    while live.pings < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    hb.stop()
+    # (Heartbeater shadows Thread._stop with an Event, so join() is
+    # unusable — stop() + the polled condition above is the sync point)
+    # one orphan episode, then heartbeats resumed on the swapped client
+    assert hooks == [1]
+    assert live.pings >= 3
+
+
+def test_heartbeater_exits_when_orphan_hook_gives_up(monkeypatch):
+    exits, fatals = [], []
+    monkeypatch.setattr(te.os, "_exit", lambda code: exits.append(code))
+    hb = te.Heartbeater(_HbClientStub(fail=True), "worker:0",
+                        interval_sec=0.01, failure_budget=2,
+                        on_fatal=lambda: fatals.append(1),
+                        on_orphaned=lambda: False)
+    hb.start()
+    deadline = time.monotonic() + 10
+    while not exits and time.monotonic() < deadline:
+        time.sleep(0.02)
+    hb.stop()
+    assert exits and exits[0] == C.EXIT_HEARTBEAT_FAILURE
+    # the hook already self-fenced the user process; on_fatal still runs
+    # as the last-resort kill on this path
+    assert fatals
+
+
+def test_heartbeater_without_hook_keeps_reference_self_destruct(monkeypatch):
+    exits = []
+    monkeypatch.setattr(te.os, "_exit", lambda code: exits.append(code))
+    hb = te.Heartbeater(_HbClientStub(fail=True), "worker:0",
+                        interval_sec=0.01, failure_budget=1)
+    hb.start()
+    deadline = time.monotonic() + 10
+    while not exits and time.monotonic() < deadline:
+        time.sleep(0.02)
+    hb.stop()
+    assert exits and exits[0] == C.EXIT_HEARTBEAT_FAILURE
+
+
+# ---------------------------------------------------------------------------
+# executor orphan-mode units
+# ---------------------------------------------------------------------------
+
+def _executor(tmp_path) -> te.TaskExecutor:
+    env = {C.JOB_NAME: "worker", C.TASK_INDEX: "0",
+           C.AM_HOST: "127.0.0.1", C.AM_PORT: "1",
+           C.TONY_APP_DIR: str(tmp_path)}
+    return te.TaskExecutor(env=env, client=_HbClientStub(fail=True),
+                           metrics_client=object())
+
+
+def test_orphan_grace_expiry_self_fences_with_checkpoint_ladder(tmp_path):
+    """No AM ever publishes an address: the orphan must fence itself
+    through _terminate_user_proc (TERM→checkpoint→KILL), report the
+    heartbeat-failure verdict best-effort, and return False so the
+    heartbeater exits the process."""
+    ex = _executor(tmp_path)
+    ex._orphan_grace_sec = 0.4
+    calls = []
+    ex._terminate_user_proc = lambda: calls.append("term")
+    t0 = time.monotonic()
+    assert ex._on_hb_orphaned() is False
+    assert time.monotonic() - t0 >= 0.4
+    assert calls == ["term"]
+    # the terminal verdict was attempted fail-fast (one attempt, short
+    # deadline — a dead AM must not hold the fence open for minutes)
+    method, req, kw = ex.client.calls[-1]
+    assert method == "register_execution_result"
+    assert req["exit_code"] == C.EXIT_HEARTBEAT_FAILURE
+    assert kw.get("retries") == 1 and kw.get("wait_for_ready") is False
+
+
+def test_orphan_ignores_malformed_hostport_file(tmp_path):
+    ex = _executor(tmp_path)
+    ex._orphan_grace_sec = 0.3
+    ex._terminate_user_proc = lambda: None
+    # a torn amhostport (no port yet) must not be dialed
+    with open(os.path.join(str(tmp_path), C.AM_HOSTPORT_FILE), "w") as f:
+        f.write("hostonly-no-colon")
+    assert ex._on_hb_orphaned() is False
+    assert ex._orphan_reattach("host:notaport") is False
+
+
+def test_orphan_reattach_swaps_clients_attempt_fenced(tmp_path,
+                                                      monkeypatch):
+    """A successful re-registration swaps both the executor's and the
+    heartbeater's channel to the recovered AM and closes the dead one."""
+    registered, made = [], []
+
+    class _FakeChannel:
+        def __init__(self, host, port, auth_token=None, task_auth_id=None):
+            self.addr = (host, port)
+            self.closed = False
+            made.append(self)
+
+        def call(self, method, req, **kw):
+            registered.append((method, req))
+            return {"spec": None}     # recovering AM: barrier open
+
+        def close(self):
+            self.closed = True
+
+    monkeypatch.setattr(te, "ClusterServiceClient", _FakeChannel)
+    ex = _executor(tmp_path)
+    old_client = ex.client
+
+    class _Closeable:
+        closed = False
+
+        def close(self):
+            self.closed = True
+
+    ex.client = _Closeable()
+    ex.heartbeater = te.Heartbeater(ex.client, "worker:0",
+                                    interval_sec=60)
+    assert ex._orphan_reattach("127.0.0.1:5123") is True
+    assert made and made[0].addr == ("127.0.0.1", 5123)
+    assert ex.client is made[0]
+    assert ex.heartbeater._client is made[0]
+    # the re-registration is attempt-stamped (the recovering AM fences on it)
+    method, req = registered[0]
+    assert method == "register_worker_spec"
+    assert req["task_id"] == "worker:0" and req["task_attempt"] == 0
+    del old_client
+
+
+# ---------------------------------------------------------------------------
+# history + fleet across an AM restart
+# ---------------------------------------------------------------------------
+
+def test_event_handler_resume_yields_single_jhist(tmp_path):
+    """Attempt 0 crashes mid-history; the recovered attempt adopts the
+    .inprogress file and the application still ends with EXACTLY ONE
+    .jhist carrying both attempts' events."""
+    md0 = JobMetadata(application_id="app_r", started=1000, user="alice")
+    h0 = EventHandler(str(tmp_path), md0)
+    h0.start()
+    h0.emit(Event(EventType.TASK_STARTED,
+                  TaskStarted("worker", 0, "h1", "c1"), timestamp=1001))
+    inprog = glob.glob(os.path.join(str(tmp_path),
+                                    f"*{C.HISTORY_INPROGRESS_SUFFIX}"))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if inprog and os.path.getsize(inprog[0]) > 0:
+            break
+        time.sleep(0.02)
+        inprog = glob.glob(os.path.join(str(tmp_path),
+                                        f"*{C.HISTORY_INPROGRESS_SUFFIX}"))
+    # h0 is now abandoned (SIGKILL) — no stop(), file left in progress
+
+    md1 = JobMetadata(application_id="app_r", started=9999, user="")
+    h1 = EventHandler(str(tmp_path), md1, resume=True)
+    h1.start()
+    h1.emit(Event(EventType.AM_RECOVERY_STARTED,
+                  AmRecoveryStarted("app_r", am_attempt=1, live_tasks=1),
+                  timestamp=2000))
+    final = h1.stop("SUCCEEDED")
+
+    finals = glob.glob(os.path.join(str(tmp_path), f"*.{C.HISTORY_SUFFIX}")) \
+        or glob.glob(os.path.join(str(tmp_path), "*.jhist"))
+    assert len(finals) == 1
+    assert not glob.glob(os.path.join(str(tmp_path),
+                                      f"*{C.HISTORY_INPROGRESS_SUFFIX}"))
+    types = [e.type for e in parse_events(final)]
+    assert EventType.TASK_STARTED in types
+    assert EventType.AM_RECOVERY_STARTED in types
+    # the adopted metadata kept attempt 0's start stamp in the file name
+    assert "1000" in os.path.basename(final)
+
+
+class _FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, s: float) -> None:
+        self.t += s
+
+
+def test_fleet_lost_job_refolds_to_running_on_recovered_heartbeat():
+    """Satellite: an AM outage demotes the job to LOST in the fleet
+    registry; the RECOVERING attempt's first jobstate republish (fresh
+    heartbeat stamp) must fold it straight back — LOST is a presumption,
+    not a terminal verdict."""
+    clock = _FakeClock(1000.0)
+    reg = fleet.FleetRegistry(stale_after_ms=2000, clock=clock)
+    reg.observe(fleet.job_summary(
+        "app_a", "alice", "default", "RUNNING", gang_width=64,
+        requested_chips=64, started_ms=990_000,
+        heartbeat_ms=int(clock() * 1000)))
+    clock.tick(5.0)          # the crash: heartbeats stop
+    reg.refresh(force=True)
+    assert reg.jobs()[0]["state"] == fleet.LOST_STATE
+    # recovered attempt re-binds and republishes immediately (flap guard)
+    reg.observe(fleet.job_summary(
+        "app_a", "alice", "default", "RUNNING", gang_width=64,
+        requested_chips=64, started_ms=990_000,
+        heartbeat_ms=int(clock() * 1000)))
+    reg.refresh(force=True)
+    assert reg.jobs()[0]["state"] == "RUNNING"
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e helpers
+# ---------------------------------------------------------------------------
+
+def _pids_matching(token: str, scope: str, exclude: str = "") -> list:
+    """PIDs whose /proc cmdline contains the exact argv `token` plus the
+    `scope` substring (the run's tmp dir — keeps parallel test runs on a
+    shared box out of each other's blast radius)."""
+    out = []
+    for p in os.listdir("/proc"):
+        if not p.isdigit():
+            continue
+        try:
+            with open(f"/proc/{p}/cmdline", "rb") as f:
+                args = f.read().decode("utf-8", "replace").split("\0")
+        except OSError:
+            continue
+        if token in args and any(scope in a for a in args) \
+                and (not exclude or exclude not in args):
+            out.append(int(p))
+    return out
+
+
+def _procs_with_cwd_under(root: str) -> list:
+    out = []
+    for p in os.listdir("/proc"):
+        if not p.isdigit():
+            continue
+        try:
+            cwd = os.readlink(f"/proc/{p}/cwd")
+        except OSError:
+            continue
+        if cwd.startswith(root):
+            out.append(int(p))
+    return out
+
+
+def _wait_until(pred, timeout_sec: float, what: str) -> None:
+    deadline = time.monotonic() + timeout_sec
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out after {timeout_sec}s waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: orphan grace expiry WITHOUT a supervisor (max-attempts=1)
+# ---------------------------------------------------------------------------
+
+@chaos
+def test_orphan_grace_self_fence_without_supervisor(tmp_path):
+    """SIGKILL the AM with tony.am.max-attempts=1: nobody restarts it.
+    Executors must go orphan (user processes untouched), wait out the
+    full tony.am.orphan-grace-ms, then self-fence through the TERM →
+    emergency-checkpoint → KILL ladder — the trainers' SIGTERM traps
+    prove the checkpoint window was honored, and no orphan process may
+    outlive the grace."""
+    run = ChaosRun(tmp_path, seed=21)
+    run.run(
+        ["--executes", script("recovery_gang_worker.py"),
+         "--conf", "tony.worker.instances=2"],
+        injections=[KillAM(after_ms=2500)],
+        conf_overrides={
+            K.TASK_HB_FAILURE_BUDGET: 2,
+            K.AM_ORPHAN_GRACE_MS: 2500,
+        },
+        extra_env={"RECOVERY_STEPS": "600", "RECOVERY_STEP_SLEEP": "0.05"})
+    # no supervisor, no status.json: the client reports the AM crash
+    assert run.final_status == "FAILED", run.all_logs()
+    assert "exited unexpectedly" in run.final_message
+
+    # both trainers were TERMed inside the ladder and wrote their
+    # emergency-checkpoint markers before exiting
+    _wait_until(
+        lambda: all(os.path.isfile(os.path.join(run.marker_dir,
+                                                f"ckpt_worker_{i}"))
+                    for i in range(2)),
+        45, "orphan self-fence checkpoint markers")
+    for i in range(2):
+        with open(os.path.join(run.marker_dir, f"ckpt_worker_{i}")) as f:
+            assert json.loads(f.read())["emergency"] is True
+    # every orphan fenced itself: nothing is left running under this app
+    _wait_until(lambda: not _procs_with_cwd_under(str(tmp_path)),
+                30, "orphaned executor/user processes to exit")
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: wedged-not-dead AM (SIGSTOP/SIGCONT) — re-attach, same address
+# ---------------------------------------------------------------------------
+
+@chaos
+def test_hung_am_thaws_and_orphans_reattach_same_address(tmp_path):
+    """SIGSTOP the AM mid-training: executors exhaust the heartbeat
+    budget, orphan, and keep re-dialing the UNCHANGED amhostport until
+    the thawed AM answers. No relaunch, no second user-process start,
+    the job still succeeds."""
+    run = ChaosRun(tmp_path, seed=22)
+    run.run(
+        ["--executes", script("recovery_gang_worker.py"),
+         "--conf", "tony.worker.instances=2"],
+        injections=[HangAM(after_ms=2000, hang_ms=3000)],
+        conf_overrides={
+            K.TASK_HB_FAILURE_BUDGET: 2,
+            K.AM_ORPHAN_GRACE_MS: 60_000,
+            # AM-side expiry window 0.2s * 60 = 12s: the silent stretch
+            # (hang + orphan re-dial backoff) must not expire anyone
+            K.TASK_MAX_MISSED_HEARTBEATS: 60,
+        },
+        extra_env={"RECOVERY_STEPS": "200", "RECOVERY_STEP_SLEEP": "0.05"})
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+    assert run.relaunches() == [], run.all_logs()
+    for i in range(2):
+        assert run.markers("worker", i) == [{"attempt": 0, "generation": 1}]
+    run.history_events()      # exactly one .jhist
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: the headline — SIGKILL the AM at width 64 mid-training
+# ---------------------------------------------------------------------------
+
+def _recovery_argv(width: int, extra: "list | None" = None) -> list:
+    return (["--executes", script("recovery_gang_worker.py"),
+             "--conf", f"tony.worker.instances={width}"] + (extra or []))
+
+
+_W64_CONF = {
+    # one core hosts ~130 processes: 1s heartbeats keep the AM's inbox
+    # (and the box) sane; the expiry window scales with it
+    K.TASK_HEARTBEAT_INTERVAL_MS: 1000,
+    K.TASK_MAX_MISSED_HEARTBEATS: 25,
+    K.TASK_REGISTRATION_TIMEOUT_SEC: 300,
+    K.CONTAINER_ALLOCATION_TIMEOUT: 300_000,
+}
+
+
+@chaos
+def test_am_kill_at_width64_adopts_gang_zero_relaunches_bit_identical(
+        tmp_path):
+    """The tentpole, end to end at width 64 on the real process chain:
+
+    1. all 64 trainers launch and park at their mid-training hold;
+    2. the AM is SIGKILLed (found via /proc, exact argv match — never
+       the supervisor);
+    3. the supervisor relaunches it; the new attempt replays the
+       journal, enters RECOVERING, republishes amhostport;
+    4. every orphaned executor re-attaches; AM_RECOVERY_COMPLETED
+       reports adopted=64, lost=0;
+    5. the hold releases, training finishes, the job SUCCEEDS with
+       ZERO user-process relaunches and a loss trajectory bit-identical
+       to an undisturbed twin run.
+    """
+    width = 64
+    disturbed_dir = tmp_path / "disturbed"
+    twin_dir = tmp_path / "twin"
+    disturbed_dir.mkdir()
+    twin_dir.mkdir()
+    release = str(tmp_path / "release")
+
+    run = ChaosRun(disturbed_dir, seed=23)
+    watcher_err: list = []
+
+    def _watcher():
+        try:
+            # (1) every trainer is past the barrier and parked at its hold
+            _wait_until(
+                lambda: all(os.path.isfile(
+                    os.path.join(run.marker_dir, f"worker_{i}"))
+                    for i in range(width)),
+                240, "all width-64 start markers")
+            # (2) SIGKILL the AM — exact argv token, supervisor excluded
+            pids = _pids_matching("tony_tpu.am", str(disturbed_dir),
+                                  exclude="tony_tpu.am.supervisor")
+            assert len(pids) == 1, f"expected one AM, found {pids}"
+            os.kill(pids[0], signal.SIGKILL)
+            # (3+4) the recovered attempt finishes adopting the gang
+            def _recovered():
+                for p in glob.glob(os.path.join(
+                        str(disturbed_dir), "**",
+                        f"*{C.HISTORY_INPROGRESS_SUFFIX}"),
+                        recursive=True):
+                    try:
+                        for e in parse_events(p):
+                            if e.type == EventType.AM_RECOVERY_COMPLETED:
+                                return True
+                    except Exception:  # noqa: BLE001 — torn mid-write line
+                        pass
+                return False
+            _wait_until(_recovered, 180, "AM_RECOVERY_COMPLETED in history")
+        except BaseException as exc:  # noqa: BLE001
+            watcher_err.append(exc)
+        finally:
+            # (5) always release the gang, pass or fail — no wedged run
+            with open(release, "w") as f:
+                f.write("go")
+
+    watcher = threading.Thread(target=_watcher, daemon=True)
+    watcher.start()
+    run.run(
+        _recovery_argv(width,
+                       ["--conf", "tony.am.max-attempts=3",
+                        "--conf", "tony.am.retry-backoff-base-ms=250",
+                        "--conf", "tony.am.retry-backoff-max-ms=500"]),
+        conf_overrides=dict(_W64_CONF, **{
+            K.TASK_HB_FAILURE_BUDGET: 2,
+            K.AM_ORPHAN_GRACE_MS: 120_000,
+        }),
+        extra_env={"RECOVERY_STEPS": "8", "RECOVERY_STEP_SLEEP": "0.05",
+                   "CHAOS_RECOVERY_HOLD": release})
+    watcher.join(timeout=30)
+    assert not watcher_err, watcher_err
+
+    assert run.final_status == "SUCCEEDED", run.all_logs()
+
+    # zero user-process relaunches: every slot started EXACTLY once, on
+    # attempt 0 against the restored generation
+    for i in range(width):
+        assert run.markers("worker", i) == \
+            [{"attempt": 0, "generation": 1}], f"worker:{i} relaunched"
+    assert run.relaunches() == [], run.all_logs()
+
+    # the recovery ledger: one restart, the whole gang adopted
+    started = run.events_of_type(EventType.AM_RECOVERY_STARTED)
+    completed = run.events_of_type(EventType.AM_RECOVERY_COMPLETED)
+    assert len(started) == 1 and len(completed) == 1
+    assert started[0].payload.am_attempt == 1
+    assert started[0].payload.replayed_records > 0
+    assert completed[0].payload.adopted == width
+    assert completed[0].payload.lost == 0
+    assert completed[0].payload.replayed_records > 0
+    assert completed[0].payload.downtime_ms > 0
+
+    # exactly one .jhist despite two AM attempts (resumed history)
+    run.history_events()
+
+    # goodput ledger charges the outage to the new am_downtime phase
+    with open(os.path.join(run.app_history_dir(), C.GOODPUT_FILE)) as f:
+        goodput = json.load(f)
+    assert goodput["job"]["am_downtime_s"] > 0
+
+    # the undisturbed twin: same trainer, same steps, no kill, no hold
+    twin = ChaosRun(twin_dir, seed=23)
+    twin.run(_recovery_argv(width), conf_overrides=dict(_W64_CONF),
+             extra_env={"RECOVERY_STEPS": "8", "RECOVERY_STEP_SLEEP": "0.05"})
+    assert twin.final_status == "SUCCEEDED", twin.all_logs()
+
+    # bit-identical loss trajectories, every rank
+    for i in range(width):
+        with open(os.path.join(run.marker_dir, f"loss_worker_{i}"),
+                  "rb") as f:
+            disturbed_loss = f.read()
+        with open(os.path.join(twin.marker_dir, f"loss_worker_{i}"),
+                  "rb") as f:
+            twin_loss = f.read()
+        assert disturbed_loss == twin_loss, \
+            f"worker:{i} loss diverged across the AM outage"
+    assert twin.relaunches() == []

@@ -37,15 +37,16 @@ from tony_tpu.cluster import Container, backend_from_conf
 from tony_tpu.cluster.backend import ClusterBackend
 from tony_tpu.cluster.docker import docker_env
 from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.am import journal as J
 from tony_tpu.events.handler import EventHandler
 from tony_tpu.events.history import JobMetadata
 from tony_tpu.events.schema import (
-    AlertFiring, AlertResolved, ApplicationFinished, ApplicationInited,
-    AutoscaleDecision, DiagnosticsReady, Event, EventType, Preempted,
-    PreemptionRequested, ProfileCaptured, Resumed, RollingUpdateCompleted,
-    RollingUpdateStarted, ServingEndpointRegistered, SloViolation,
-    StragglerCleared, StragglerDetected, TaskFinished, TaskRelaunched,
-    TaskStarted,
+    AlertFiring, AlertResolved, AmRecoveryCompleted, AmRecoveryStarted,
+    ApplicationFinished, ApplicationInited, AutoscaleDecision,
+    DiagnosticsReady, Event, EventType, Preempted, PreemptionRequested,
+    ProfileCaptured, Resumed, RollingUpdateCompleted, RollingUpdateStarted,
+    ServingEndpointRegistered, SloViolation, StragglerCleared,
+    StragglerDetected, TaskFinished, TaskRelaunched, TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor, auto_liveliness_shards
 from tony_tpu.rpc.service import (
@@ -565,9 +566,33 @@ class ApplicationMaster(ClusterServiceHandler):
         hist_base = conf.get_str(K.HISTORY_INTERMEDIATE) or os.path.join(
             self.app_dir, C.HISTORY_DIR_NAME)
         self.history_dir = os.path.join(hist_base, app_id)
+        # AM crash survivability (am/journal.py + am/supervisor.py): a
+        # supervised restart sets TONY_AM_ATTEMPT > 0; a journal left in
+        # the app dir means the predecessor died mid-lifecycle — replay
+        # it and ADOPT the still-running gang instead of relaunching it
+        self._am_attempt = int(os.environ.get(C.AM_ATTEMPT, "0") or 0)
+        journal_enabled = conf.get_bool(K.AM_JOURNAL_ENABLED, True)
+        self.journal = J.ControlPlaneJournal(
+            self.app_dir, am_attempt=self._am_attempt,
+            snapshot_every=conf.get_int(K.AM_JOURNAL_SNAPSHOT_EVERY, 256),
+            enabled=journal_enabled)
+        self._recovering = (self._am_attempt > 0 and journal_enabled
+                            and J.has_journal(self.app_dir))
+        # adoption barrier: {pending, adopted, deadline, started,
+        # replayed, pre_downtime_ms} while a recovery is in flight
+        self._recovery: Optional[dict] = None  # guarded-by: _lock
+        self._recovery_settle_ms = conf.get_time_ms(
+            K.AM_RECOVERY_SETTLE_MS, 30_000)
+        # control-plane downtime: the am_downtime goodput phase — wall
+        # clock with no AM alive (crash → journal replay) plus the
+        # adoption barrier window, priced against job goodput like
+        # relaunch/preemption/resize downtime
+        self._am_downtime_s = 0.0
+        self._last_clock_rec: dict = {}
         self.metadata = JobMetadata(application_id=app_id,
                                     started=int(time.time() * 1000))
-        self.event_handler = EventHandler(self.history_dir, self.metadata)
+        self.event_handler = EventHandler(self.history_dir, self.metadata,
+                                          resume=self._am_attempt > 0)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -621,6 +646,13 @@ class ApplicationMaster(ClusterServiceHandler):
             f.write(f"{self.host}:{self.rpc_port}")
         os.replace(tmp, hostport_path)
         LOG.info("AM RPC serving at %s:%d", self.host, self.rpc_port)
+        if self._recovering:
+            # flap guard: the registry entry went LOST with the crashed
+            # AM's heartbeat — republish on the NEW address immediately
+            # (RECOVERING, not RUNNING: running is gated on the adoption
+            # barrier), so the fleet refolds LOST→RECOVERING→RUNNING
+            # instead of dropping the job
+            self._publish_fleet_state("RECOVERING", force=True)
 
     def _write_am_info(self) -> None:
         """Publish this AM's RPC address into the history dir so the
@@ -729,11 +761,17 @@ class ApplicationMaster(ClusterServiceHandler):
             # superseded attempts appear as their own "<task>@aN" entries
             # so their wall/productive time stays in the job totals
             per_task = dict(self._goodput_archive)
+            # AM downtime: folded crash gaps + the in-flight adoption
+            # barrier at its elapsed-so-far, mirroring the relaunch clock
+            am_downtime = self._am_downtime_s
+            if self._recovery is not None:
+                am_downtime += now - self._recovery["started"]
         per_task.update(self.metrics_store.latest_gauges())
         return aggregate_goodput(
             per_task, relaunch_downtime_s=downtime,
             preemption_downtime_s=self._preemption_downtime_s,
-            resize_downtime_s=self.elastic.downtime_s())
+            resize_downtime_s=self.elastic.downtime_s(),
+            am_downtime_s=am_downtime)
 
     def fleet_summary(self, state: str) -> dict:
         """The compact jobstate entry this AM contributes to the live
@@ -1184,6 +1222,7 @@ class ApplicationMaster(ClusterServiceHandler):
         Returns overall success."""
         self.prepare()
         self._schedule_preempt_if_testing()
+        self._schedule_am_chaos_if_testing()
         # TEST_AM_CRASH: die before doing anything useful, simulating an AM
         # container crash (reference: ApplicationMaster.java:337-342)
         if os.environ.get(C.TEST_AM_CRASH):
@@ -1237,6 +1276,20 @@ class ApplicationMaster(ClusterServiceHandler):
         self._preprocess_exit_code = 0
         self._preprocess_finished = False
         self._model_params: str | None = None
+        # AM crash recovery: a supervised restart replays the journal
+        # BEFORE the session is built — the journaled session id must
+        # seed the new TonySession or every adopted executor would be
+        # fenced out as a stale-session registration
+        recovered: Optional[J.RecoveredState] = None
+        if self._recovering and attempt == 0:
+            recovered = J.replay(self.app_dir)
+            if recovered.replayed_records == 0 and not recovered.tasks:
+                LOG.warning("AM attempt %d found an empty journal — "
+                            "starting a fresh session", self._am_attempt)
+                recovered = None
+            else:
+                self._session_id = max(self._session_id,
+                                       recovered.session_id)
         self.session = TonySession(self.conf, session_id=self._session_id)
         # wipe liveliness entries a stale executor's in-flight
         # registration may have planted between _reset()'s clear and this
@@ -1260,7 +1313,7 @@ class ApplicationMaster(ClusterServiceHandler):
             self._unsatisfiable_request = "queue-quota"
             return False
 
-        if attempt == 0:
+        if attempt == 0 and self._am_attempt == 0:
             self.event_handler.emit(Event(
                 EventType.APPLICATION_INITED,
                 ApplicationInited(self.app_id,
@@ -1287,8 +1340,8 @@ class ApplicationMaster(ClusterServiceHandler):
                             requested_chips=total_requested_tpus(
                                 self.conf))))
 
-        if self._single_node or self.conf.get_bool(
-                K.APPLICATION_ENABLE_PREPROCESS, False):
+        if recovered is None and (self._single_node or self.conf.get_bool(
+                K.APPLICATION_ENABLE_PREPROCESS, False)):
             self._do_preprocessing_job(attempt)
             if self._single_node:
                 ok = self._preprocess_exit_code == 0
@@ -1328,7 +1381,22 @@ class ApplicationMaster(ClusterServiceHandler):
                     "+".join(r.job_name for r in tracked), str(e))
                 return False
 
-        self.scheduler.schedule_tasks()
+        if recovered is not None and self._adopt_recovered(recovered):
+            # live-gang adoption: the executors are still running (the
+            # backend launched them in their own sessions) — nothing is
+            # scheduled; RUNNING is gated on the adoption barrier and
+            # lost members are relaunched through the normal budget path
+            pass
+        else:
+            self.scheduler.schedule_tasks()
+            # journal the session start AFTER scheduling (the scheduler
+            # owns num_expected_tasks) — the first record a recovering
+            # attempt replays
+            self.journal.append(
+                J.REC_SESSION, session_id=self._session_id,
+                expected=self.session.num_expected_tasks,
+                instances={name: req.num_instances
+                           for name, req in self.session.requests.items()})
         self._rendezvous_span_start(f"session-{self._session_id}")
         if not self.scheduler.dependency_check_passed:
             return False
@@ -1343,6 +1411,187 @@ class ApplicationMaster(ClusterServiceHandler):
             time.monotonic() + self._alloc_timeout_ms / 1000.0
             if self._alloc_timeout_ms > 0 else None)
         return self._monitor()
+
+    # ------------------------------------------------------------------
+    # AM crash recovery: journal replay + live-gang adoption
+    # ------------------------------------------------------------------
+    def _adopt_recovered(self, state: "J.RecoveredState") -> bool:
+        """Apply a replayed journal to the fresh session and arm the
+        adoption barrier. Returns True when at least one journaled task
+        was still live at crash time (a gang worth adopting); False
+        falls back to scheduling a fresh gang."""
+        session = self.session
+        live = state.live_tasks()
+        if not live:
+            LOG.warning("journal replay found no live tasks — scheduling "
+                        "a fresh gang")
+            return False
+        session.restore_for_recovery(state.num_expected,
+                                     state.spec_generation,
+                                     state.instances)
+        adopted_live: list[tuple[str, int]] = []
+        for task_id, rec in sorted(state.tasks.items()):
+            task = session.adopt_task(
+                task_id, rec.get("host_port", ""),
+                int(rec.get("attempt", 0)),
+                container_id=rec.get("container_id", ""),
+                host=rec.get("host", ""),
+                lifecycle_relaunches=int(rec.get("lifecycle_relaunches",
+                                                 0)),
+                completed=bool(rec.get("completed")),
+                exit_code=int(rec.get("exit_code", 0)))
+            if task is not None and task_id in live:
+                adopted_live.append((task_id, task.attempt))
+        if not adopted_live:
+            return False
+        # control-plane downtime so far: last journal stamp → now (the
+        # gap no AM existed); the adoption-barrier window is added when
+        # the barrier completes (_check_recovery)
+        pre_downtime_s = 0.0
+        if state.last_ts_ms > 0:
+            pre_downtime_s = max(
+                0.0, time.time() * 1000 - state.last_ts_ms) / 1000.0
+        with self._lock:
+            self._am_downtime_s += pre_downtime_s
+            self._am_downtime_s += float(
+                state.clocks.get("am_downtime_s", 0.0))
+            self._relaunch_downtime_s = max(
+                self._relaunch_downtime_s,
+                float(state.clocks.get("relaunch_downtime_s", 0.0)))
+            self._preemption_downtime_s = max(
+                self._preemption_downtime_s,
+                float(state.clocks.get("preemption_downtime_s", 0.0)))
+            for task_id, rec in state.endpoints.items():
+                self._serving_endpoints[task_id] = dict(rec)
+            if state.preemption:
+                # the predecessor crashed mid-drain: resume the
+                # checkpoint-then-evict with a FRESH grace window (the
+                # old monotonic deadline died with the old process)
+                grace_ms = int(state.preemption.get("grace_ms", 0)
+                               or 30_000)
+                self._preemption = {
+                    "reason": state.preemption.get("reason", ""),
+                    "grace_ms": grace_ms,
+                    "requested_by": state.preemption.get(
+                        "requested_by", ""),
+                    "requested": time.monotonic(),
+                    "requested_ms": int(state.preemption.get(
+                        "requested_ms", 0)) or int(time.time() * 1000),
+                    "deadline": time.monotonic() + grace_ms / 1000.0,
+                }
+            self._recovery = {
+                "pending": {tid for tid, _ in adopted_live},
+                "adopted": set(),
+                "deadline": (time.monotonic()
+                             + self._recovery_settle_ms / 1000.0),
+                "started": time.monotonic(),
+                "replayed": state.replayed_records,
+                "pre_downtime_ms": int(pre_downtime_s * 1000),
+            }
+        if state.resize:
+            LOG.warning("in-flight elastic resize did not survive the AM "
+                        "crash; the gang stays at its current width")
+        # liveliness restarts with a fresh clock per adopted member: an
+        # orphaned executor heartbeats into the void until it polls the
+        # new amhostport, so its clock starts at re-bind, not at crash
+        for task_id, task_attempt in adopted_live:
+            self.hb_monitor.register(task_id, task_attempt)
+        self.journal.seed(state)
+        LOG.warning("AM attempt %d recovering: %d journal record(s) "
+                    "replayed, %d live task(s) to adopt, %.1f s downtime "
+                    "before this attempt", self._am_attempt,
+                    state.replayed_records, len(adopted_live),
+                    pre_downtime_s)
+        self.event_handler.emit(Event(
+            EventType.AM_RECOVERY_STARTED,
+            AmRecoveryStarted(self.app_id, self._am_attempt,
+                              live_tasks=len(adopted_live),
+                              replayed_records=state.replayed_records,
+                              journal_path=self.journal.path)))
+        return True
+
+    def _note_recovery_adoption(self, task_id: str, attempt: int) -> None:
+        """An adopted executor showed up (re-registration or heartbeat)
+        at the journaled attempt: drain it from the adoption barrier."""
+        with self._lock:
+            rec = self._recovery
+            if rec is None or task_id not in rec["pending"]:
+                return
+            session = self.session
+            task = (session.get_task_by_id(task_id)
+                    if session is not None else None)
+            if task is not None and attempt >= 0 \
+                    and attempt != task.attempt:
+                return      # superseded attempt cannot satisfy the barrier
+            rec["pending"].discard(task_id)
+            rec["adopted"].add(task_id)
+            remaining = len(rec["pending"])
+        LOG.info("recovery: adopted %s (attempt %d), %d member(s) "
+                 "pending", task_id, max(attempt, 0), remaining)
+        self._wake.set()
+
+    def _check_recovery(self) -> None:
+        """One adoption-barrier pass (monitor-loop cadence): complete the
+        recovery when every adopted member re-attached, or at the settle
+        deadline — stragglers that never re-attached are relaunched
+        through the normal budget machinery."""
+        with self._lock:
+            rec = self._recovery
+            if rec is None:
+                return
+            pending = set(rec["pending"])
+            deadline = rec["deadline"]
+        if pending and time.monotonic() <= deadline:
+            return
+        session = self.session
+        stragglers: list[Task] = []
+        if pending and session is not None:
+            for task_id in sorted(pending):
+                task = session.get_task_by_id(task_id)
+                if task is not None and not task.completed:
+                    stragglers.append(task)
+        with self._lock:
+            rec, self._recovery = self._recovery, None
+            if rec is None:
+                return
+            adopted = len(rec["adopted"])
+            lost = len(rec["pending"])
+            elapsed_s = time.monotonic() - rec["started"]
+            self._am_downtime_s += elapsed_s
+            downtime_ms = rec["pre_downtime_ms"] + int(elapsed_s * 1000)
+            replayed = rec["replayed"]
+        for task in stragglers:
+            self._maybe_relaunch_task(
+                task, "executor lost across AM restart",
+                observed_attempt=task.attempt)
+        (LOG.info if lost == 0 else LOG.warning)(
+            "AM recovery complete: %d executor(s) adopted, %d lost, "
+            "%d ms control-plane downtime", adopted, lost, downtime_ms)
+        self.event_handler.emit(Event(
+            EventType.AM_RECOVERY_COMPLETED,
+            AmRecoveryCompleted(self.app_id, self._am_attempt,
+                                adopted=adopted, lost=lost,
+                                replayed_records=replayed,
+                                duration_ms=int(elapsed_s * 1000),
+                                downtime_ms=downtime_ms)))
+        # the barrier is down: the registry entry folds RECOVERING back
+        # into RUNNING immediately (flap guard — no throttle window)
+        self._publish_fleet_state(force=True)
+
+    def _journal_clocks(self) -> None:
+        """Journal the goodput downtime clocks when they moved (monitor
+        cadence) — the phase ledger a recovering attempt restores."""
+        with self._lock:
+            clocks = {
+                "relaunch_downtime_s": round(self._relaunch_downtime_s, 3),
+                "preemption_downtime_s": round(
+                    self._preemption_downtime_s, 3),
+                "resize_downtime_s": round(self.elastic.downtime_s(), 3),
+                "am_downtime_s": round(self._am_downtime_s, 3),
+            }
+        if clocks != self._last_clock_rec:
+            self._last_clock_rec = clocks
+            self.journal.append(J.REC_CLOCK, **clocks)
 
     def _monitor(self) -> bool:
         """The monitor loop (ApplicationMaster.monitor,
@@ -1406,6 +1655,7 @@ class ApplicationMaster(ClusterServiceHandler):
                     # any in-flight relaunch gap closes here: the gang is
                     # whole again, downtime stops accruing
                     self._close_relaunch_downtime()
+            self._check_recovery()
             self._check_slo()
             self._check_stragglers()
             self._check_alerts()
@@ -1413,7 +1663,13 @@ class ApplicationMaster(ClusterServiceHandler):
             self._check_autoscaler()
             self._check_rolling_update()
             self.elastic.check()
-            self._publish_fleet_state()
+            self._journal_clocks()
+            # RUNNING is gated on the adoption barrier: while a recovery
+            # is in flight the registry shows RECOVERING
+            with self._lock:
+                in_recovery = self._recovery is not None
+            self._publish_fleet_state(
+                "RECOVERING" if in_recovery else "RUNNING")
             total = session.total_tracked_tasks()
             if total > 0 and session.num_completed_tracked_tasks() >= total:
                 if self._preemption is not None:
@@ -2231,6 +2487,9 @@ class ApplicationMaster(ClusterServiceHandler):
         self._write_status(
             status,
             self.session.final_message if self.session else None)
+        # the verdict is on disk: nothing is left to recover, so a later
+        # supervisor attempt must not replay this application's journal
+        self.journal.discard()
         # give the client a moment to observe the terminal state and send
         # finish_application (ApplicationMaster.stop poll,
         # ApplicationMaster.java:669-710)
@@ -2378,6 +2637,10 @@ class ApplicationMaster(ClusterServiceHandler):
             self._session_containers.setdefault(
                 session.session_id, []).append(container.container_id)
             self._task_span_start(task, container)
+        self.journal.append(
+            J.REC_CONTAINER, task_id=task.task_id,
+            container_id=container.container_id, host=container.host,
+            attempt=task.attempt, session_id=session.session_id)
         req = session.requests[task.job_name]
         env = self._container_env(task, req, container)
         cmd = [sys.executable, "-m", "tony_tpu.executor"]
@@ -2570,6 +2833,10 @@ class ApplicationMaster(ClusterServiceHandler):
                                   preempted=(draining
                                              and exit_code not in
                                              (0, C.EXIT_KILLED_BY_AM)))
+        self.journal.append(
+            J.REC_COMPLETED, task_id=task.task_id,
+            attempt=observed_attempt, exit_code=exit_code,
+            status=task.status.value)
         # incremental log aggregation: this container's streams are final
         # — copy them into history NOW, so an AM crash/kill -9 after this
         # point no longer loses the logs (previously aggregation only
@@ -2793,6 +3060,9 @@ class ApplicationMaster(ClusterServiceHandler):
                         new_generation, old_cid or "<none>")
         # outside the AM lock: container stop + event emit don't need it,
         # and stop_container may block on process teardown
+        self.journal.append(
+            J.REC_RELAUNCH, task_id=task.task_id, attempt=new_attempt,
+            generation=new_generation, lifecycle=force, reason=reason)
         if old_cid:
             self.backend.stop_container(old_cid)
         # the superseded attempt's serving endpoint dies with its
@@ -2918,6 +3188,15 @@ class ApplicationMaster(ClusterServiceHandler):
         if accepted and sid in (session.session_id, -1) and task is not None:
             self.hb_monitor.register(
                 req["task_id"], attempt if attempt >= 0 else task.attempt)
+            self.journal.append(
+                J.REC_REGISTER, task_id=req["task_id"],
+                host_port=str(req.get("spec", "") or ""),
+                attempt=attempt if attempt >= 0 else task.attempt,
+                session_id=session.session_id, generation=generation)
+            # an orphaned executor re-registering after an AM restart is
+            # the adoption barrier's primary drain path
+            self._note_recovery_adoption(
+                req["task_id"], attempt if attempt >= 0 else task.attempt)
         # TEST hook: simulate chief-worker termination once the chief shows up
         # (reference: killChiefWorkerIfTesting, ApplicationMaster.java:1204-1215)
         if (os.environ.get(C.TEST_WORKER_TERMINATION)
@@ -2973,6 +3252,8 @@ class ApplicationMaster(ClusterServiceHandler):
             self._serving_endpoints[task_id] = {
                 "url": url, "generation": generation,
                 "draining": draining}
+        self.journal.append(J.REC_ENDPOINT, task_id=task_id, url=url,
+                            generation=generation, draining=draining)
         if draining:
             LOG.info("serving endpoint draining: %s (%s)", task_id, url)
             return {}
@@ -2990,12 +3271,18 @@ class ApplicationMaster(ClusterServiceHandler):
         rec = self._serving_endpoints.get(task_id)
         if rec is not None:
             rec["draining"] = True
+            self.journal.append(
+                J.REC_ENDPOINT, task_id=task_id, url=rec.get("url", ""),
+                generation=int(rec.get("generation", 0)), draining=True)
 
     def _drop_serving_endpoint(self, task_id: str) -> None:
         """A serving task completed: its endpoint leaves the set (the
         router's next poll stops considering it entirely)."""
         with self._lock:
-            self._serving_endpoints.pop(task_id, None)
+            existed = self._serving_endpoints.pop(task_id, None) is not None
+        if existed:
+            self.journal.append(J.REC_ENDPOINT, task_id=task_id,
+                                removed=True)
 
     def register_execution_result(self, req: dict) -> dict:
         """Executor-reported exit code. Unregisters the task from the HB
@@ -3108,6 +3395,11 @@ class ApplicationMaster(ClusterServiceHandler):
                                   preempted=(draining
                                              and exit_code not in
                                              (0, C.EXIT_KILLED_BY_AM)))
+        if task is not None:
+            self.journal.append(
+                J.REC_COMPLETED, task_id=task_id,
+                attempt=attempt if attempt >= 0 else task.attempt,
+                exit_code=exit_code, status=task.status.value)
         self._wake.set()
         return {}
 
@@ -3145,6 +3437,14 @@ class ApplicationMaster(ClusterServiceHandler):
                 # the replacement's liveliness entry fresh (and must never
                 # be handed a spec diff — it has no live spec to patch)
                 return {"spec_generation": generation}
+        # AM recovery: an adopted executor's first heartbeat at the
+        # journaled attempt satisfies the adoption barrier (it never
+        # re-registers when its old AM address still resolves — the
+        # TEST_AM_HANG thaw case). Lock-free pre-check: recovery is
+        # almost never in flight and W pings/interval must not pay for it.
+        # tony: disable=guarded-by -- lock-free heartbeat fast path
+        if self._recovery is not None:
+            self._note_recovery_adoption(req["task_id"], attempt)
         # live-tail surface: remember where this attempt's TaskLogService
         # listens (attempt-fenced above — a zombie's address can never
         # displace the replacement's). Lock-free fast path: the address is
@@ -3266,6 +3566,10 @@ class ApplicationMaster(ClusterServiceHandler):
                 self._mark_endpoint_draining(task_id)
         LOG.warning("preemption requested by %s (%d ms grace): %s",
                     requested_by, grace_ms, reason or "unspecified")
+        self.journal.append(
+            J.REC_PREEMPTION, reason=reason, grace_ms=grace_ms,
+            requested_by=requested_by,
+            requested_ms=int(time.time() * 1000))
         self.event_handler.emit(Event(
             EventType.PREEMPTION_REQUESTED,
             PreemptionRequested(self.app_id, reason=reason,
@@ -3295,7 +3599,13 @@ class ApplicationMaster(ClusterServiceHandler):
                         session.session_id)
             return {"error": f"stale session attempt {session_attempt} "
                              f"(current {session.session_id})"}
-        return self.elastic.request_resize(req)
+        resp = self.elastic.request_resize(req)
+        if "error" not in resp and not resp.get("duplicate"):
+            self.journal.append(
+                J.REC_RESIZE,
+                ask={k: v for k, v in req.items()
+                     if isinstance(v, (str, int, float, bool))})
+        return resp
 
     def _schedule_preempt_if_testing(self) -> None:
         """TEST_TASK_PREEMPT='after_ms[#grace_ms]': the AM preempts
@@ -3320,6 +3630,61 @@ class ApplicationMaster(ClusterServiceHandler):
                  "requested_by": "test"}))
         timer.daemon = True
         timer.start()
+
+    def _schedule_am_chaos_if_testing(self) -> None:
+        """AM-process chaos hooks (tests/chaos.py KillAM / HangAM):
+
+        TEST_AM_KILL='after_ms[#attempt]' — SIGKILL our own process
+        after_ms after prepare(), only when this is AM process attempt
+        `attempt` (default 0), exercising the supervised-restart +
+        journal-replay + live-gang-adoption path end to end.
+
+        TEST_AM_HANG='after_ms#hang_ms[#attempt]' — SIGSTOP the AM for
+        hang_ms then SIGCONT it, via a detached shell (a thread of a
+        fully-stopped process cannot CONT itself): executors exhaust
+        their heartbeat budget, enter orphan mode, and must re-attach to
+        the SAME address once the AM thaws — no restart involved."""
+        kill_spec = os.environ.get(C.TEST_AM_KILL)
+        if kill_spec:
+            try:
+                parts = kill_spec.split("#")
+                after_s = int(parts[0]) / 1000.0
+                at_attempt = int(parts[1]) if len(parts) > 1 else 0
+            except (ValueError, IndexError):
+                LOG.error("bad TEST_AM_KILL spec: %r", kill_spec)
+            else:
+                if self._am_attempt == at_attempt:
+                    import signal
+                    LOG.warning("TEST hook: SIGKILL this AM (attempt %d) "
+                                "in %d ms", self._am_attempt,
+                                int(after_s * 1000))
+                    timer = threading.Timer(
+                        after_s,
+                        lambda: os.kill(os.getpid(), signal.SIGKILL))
+                    timer.daemon = True
+                    timer.start()
+        hang_spec = os.environ.get(C.TEST_AM_HANG)
+        if hang_spec:
+            try:
+                parts = hang_spec.split("#")
+                after_s = int(parts[0]) / 1000.0
+                hang_s = int(parts[1]) / 1000.0
+                at_attempt = int(parts[2]) if len(parts) > 2 else 0
+            except (ValueError, IndexError):
+                LOG.error("bad TEST_AM_HANG spec: %r", hang_spec)
+            else:
+                if self._am_attempt == at_attempt:
+                    import subprocess
+                    LOG.warning("TEST hook: SIGSTOP this AM in %d ms for "
+                                "%d ms", int(after_s * 1000),
+                                int(hang_s * 1000))
+                    subprocess.Popen(
+                        ["/bin/sh", "-c",
+                         f"sleep {after_s}; kill -STOP {os.getpid()}; "
+                         f"sleep {hang_s}; kill -CONT {os.getpid()}"],
+                        start_new_session=True,
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL)
 
     # an in-flight profiler ask older than this is considered lost (the
     # trainer's start_trace failed, or the profile_done push was dropped)

@@ -74,6 +74,7 @@ class TonyClient:
         self.task_command = ""
         self._am_proc: Optional[subprocess.Popen] = None
         self._rpc: Optional[ClusterServiceClient] = None
+        self._rpc_hostport = ""      # amhostport content the channel targets
         self._auth_token: Optional[str] = None
         self._listeners: list[ClientListener] = []
         self._last_infos: dict[str, str] = {}
@@ -229,13 +230,23 @@ class TonyClient:
         am_stderr = open(os.path.join(self.app_dir, C.AM_STDERR), "ab")
         env = dict(os.environ)
         env["PYTHONPATH"] = framework_pythonpath()
+        # tony.am.max-attempts > 1: launch through the supervisor, which
+        # relaunches a crashed AM process with journal replay + gang
+        # adoption (am/supervisor.py — the local substrate's stand-in for
+        # the reference's YARN-managed AM retry). Same process group and
+        # stdio files, so kill()/monitor()'s process-died logic is
+        # unchanged: the supervisor exits only once the AM's lifecycle is
+        # truly over.
+        module = ("tony_tpu.am.supervisor"
+                  if self.conf.get_int(K.AM_MAX_ATTEMPTS, 1) > 1
+                  else "tony_tpu.am")
         self._am_proc = subprocess.Popen(
-            [sys.executable, "-m", "tony_tpu.am",
+            [sys.executable, "-m", module,
              "--app_id", self.app_id, "--app_dir", self.app_dir],
             stdout=am_stdout, stderr=am_stderr, env=env,
             start_new_session=True)
-        LOG.info("submitted %s (AM pid %d), app dir %s",
-                 self.app_id, self._am_proc.pid, self.app_dir)
+        LOG.info("submitted %s (%s pid %d), app dir %s",
+                 self.app_id, module, self._am_proc.pid, self.app_dir)
         return self.app_id
 
     def _process_final_conf(self) -> None:
@@ -303,7 +314,11 @@ class TonyClient:
                     LOG.error(self.final_message)
                     return False
                 continue
-            if self._rpc is None and os.path.exists(hostport_path):
+            if os.path.exists(hostport_path):
+                # content-change-aware: a recovering AM attempt re-binds
+                # on a fresh port and rewrites amhostport — the client
+                # must follow it or every RPC after an AM restart times
+                # out against the dead address
                 self._init_rpc(hostport_path)
             self._update_task_infos()
             time.sleep(0.2)
@@ -318,16 +333,27 @@ class TonyClient:
             return None
 
     def _init_rpc(self, hostport_path: str) -> None:
-        """(TonyClient.initRpcClientAndLogAMUrl, TonyClient.java:922-943)."""
+        """(TonyClient.initRpcClientAndLogAMUrl, TonyClient.java:922-943).
+        Idempotent per address: re-reads amhostport and rebuilds the
+        channel only when the content changed (AM recovery re-bind)."""
         try:
             with open(hostport_path, "r", encoding="utf-8") as f:
                 hostport = f.read().strip()
+            if not hostport or hostport == self._rpc_hostport:
+                return
             host, _, port = hostport.rpartition(":")
-            self._rpc = ClusterServiceClient(host, int(port), retries=2,
-                                             retry_sleep_sec=0.2,
-                                             timeout_sec=5.0,
-                                             auth_token=self._auth_token)
-            LOG.info("AM RPC at %s", hostport)
+            rpc = ClusterServiceClient(host, int(port), retries=2,
+                                       retry_sleep_sec=0.2,
+                                       timeout_sec=5.0,
+                                       auth_token=self._auth_token)
+            if self._rpc is not None:
+                LOG.info("AM re-bound: RPC %s -> %s", self._rpc_hostport,
+                         hostport)
+                self._rpc.close()
+            else:
+                LOG.info("AM RPC at %s", hostport)
+            self._rpc = rpc
+            self._rpc_hostport = hostport
         except (OSError, ValueError):
             LOG.warning("could not read AM hostport yet")
 

@@ -78,10 +78,19 @@ DEFAULTS = {
     # auto_rpc_workers, am/liveliness.py auto_liveliness_shards)
     K.AM_RPC_WORKERS: 0,
     K.AM_LIVELINESS_SHARDS: 0,
+    # AM crash survivability (am/supervisor.py + am/journal.py);
+    # 1 = unsupervised single process (an AM crash fails the app)
+    K.AM_MAX_ATTEMPTS: 1,
+    K.AM_ORPHAN_GRACE_MS: 30_000,
+    K.AM_JOURNAL_ENABLED: True,
+    K.AM_JOURNAL_SNAPSHOT_EVERY: 256,
+    K.AM_RECOVERY_SETTLE_MS: 30_000,
 
     # task cadences (reference: TonyConfigurationKeys.java:143-150)
     K.TASK_HEARTBEAT_INTERVAL_MS: 1000,
     K.TASK_MAX_MISSED_HEARTBEATS: 25,
+    # reference MAX_CONSECUTIVE_FAILED_HEARTBEATS (TaskExecutor.java:36)
+    K.TASK_HB_FAILURE_BUDGET: 5,
     # fault tolerance: 1 attempt = the reference's all-or-nothing behavior;
     # raise to enable single-task relaunch without full-gang teardown
     K.TASK_MAX_TASK_ATTEMPTS: 1,

@@ -60,6 +60,28 @@ AM_STOP_POLL_TIMEOUT_MS = "tony.am.stop-poll-timeout-ms"
 # examines one shard per tick) — auto is min(16, width//64)
 AM_RPC_WORKERS = "tony.am.rpc-workers"
 AM_LIVELINESS_SHARDS = "tony.am.liveliness-shards"
+# AM crash survivability (am/journal.py + am/supervisor.py): total AM
+# PROCESS attempts (first launch + supervised relaunches). > 1 makes the
+# client spawn the supervisor, which relaunches a crashed AM with the
+# session-retry jittered backoff; each new attempt replays the
+# control-plane journal and adopts the still-running gang. 1 = today's
+# single-process behavior (an AM crash fails the application).
+AM_MAX_ATTEMPTS = "tony.am.max-attempts"
+# how long an orphaned executor (heartbeat budget exhausted, user process
+# untouched) polls the app dir for a new AM address before gracefully
+# self-fencing through the TERM→emergency-checkpoint→KILL ladder
+AM_ORPHAN_GRACE_MS = "tony.am.orphan-grace-ms"
+# write-ahead journal of control-plane state (registrations/attempts/
+# generations, endpoints, preemption/resize in-flight state, downtime
+# clocks) in the app dir — the replay source for a recovering AM attempt
+AM_JOURNAL_ENABLED = "tony.am.journal-enabled"
+# incremental records appended before the journal is compacted into a
+# tmp+rename snapshot (bounds replay length and journal file size)
+AM_JOURNAL_SNAPSHOT_EVERY = "tony.am.journal-snapshot-every"
+# adoption barrier: how long a RECOVERING AM waits for every journaled
+# live task to re-register before declaring the rest lost (and spending
+# relaunch budget on them)
+AM_RECOVERY_SETTLE_MS = "tony.am.recovery-settle-ms"
 
 # --- task / containers ---------------------------------------------------
 # default task command when no per-jobtype tony.<jobtype>.command is set
@@ -69,6 +91,13 @@ AM_LIVELINESS_SHARDS = "tony.am.liveliness-shards"
 TASK_COMMAND = "tony.task.command"
 TASK_HEARTBEAT_INTERVAL_MS = "tony.task.heartbeat-interval-ms"
 TASK_MAX_MISSED_HEARTBEATS = "tony.task.max-missed-heartbeats"
+# consecutive failed heartbeats before an executor stops trusting its AM
+# address (the reference's hard-coded MAX_CONSECUTIVE_FAILED_HEARTBEATS=5,
+# TaskExecutor.java:36). Exhaustion no longer os._exit()s: the executor
+# enters ORPHAN mode — user process untouched — and polls for a
+# recovering AM within tony.am.orphan-grace-ms before self-fencing
+# through the TERM→emergency-checkpoint→KILL ladder.
+TASK_HB_FAILURE_BUDGET = "tony.task.hb-failure-budget"
 # task-attempt budget: total attempts (first run + relaunches) a tracked
 # task slot gets before its failure fails the session; 1 = no relaunch
 # (today's all-or-nothing behavior). Per-jobtype override:

@@ -27,6 +27,12 @@ SPEC_GENERATION = "SPEC_GENERATION"  # cluster-spec generation the user
                                      # process was launched against (bumped
                                      # on every task relaunch)
 TASK_COMMAND = "TASK_COMMAND"        # the user command this executor runs
+AM_ATTEMPT = "TONY_AM_ATTEMPT"       # AM process attempt number, set by the
+                                     # supervisor (am/supervisor.py) on every
+                                     # relaunch; attempt > 0 replays the
+                                     # control-plane journal and RECOVERs
+                                     # (ATTEMPT_NUMBER is taken: it carries
+                                     # the SESSION id into container envs)
 MODEL_PARAMS = "MODEL_PARAMS"        # preprocess-scraped params injected into
                                      # every task env (Constants.java:84,
                                      # ApplicationMaster.java:753-764)
@@ -143,6 +149,14 @@ AM_METRICS_PORT_FILE = "am-metrics-port"  # bound /metrics scrape port
 AM_INFO_FILE = "am.json"             # {host, rpc_port} in the history dir, so
                                      # the portal can reach a RUNNING job's AM
                                      # (POST /api/jobs/:id/profile)
+AM_JOURNAL_FILE = "journal.jsonl"    # append-only fsync'd write-ahead journal
+                                     # of control-plane state (am/journal.py):
+                                     # a recovering AM attempt replays it into
+                                     # a fresh TonySession and adopts the
+                                     # still-running gang
+AM_JOURNAL_SNAPSHOT_FILE = "journal-snapshot.json"  # tmp+rename compacted
+                                     # journal prefix; replay = snapshot +
+                                     # incremental records after it
 PROFILE_REQUEST_FILE = "profile_request.json"  # executor-written, trainer-read
                                      # (heartbeat-piggybacked request_profile)
 PROFILES_DIR_NAME = "profiles"       # trace artifacts: container cwd + history
@@ -240,6 +254,18 @@ TEST_TRAINER_STEP_DELAY = "TEST_TRAINER_STEP_DELAY"
 # the rendered per-process form of the hook above (ms per step; unset or
 # 0 = no delay) — read by the trainer hot loop's test seam
 TRAINER_STEP_DELAY_MS = "TONY_TRAINER_STEP_DELAY_MS"
+# AM crash injection (chaos harness): the AM SIGKILLs its own process
+# `after_ms` after prepare() — no teardown, no history flush, nothing; the
+# supervisor (am/supervisor.py) relaunches it and the new attempt replays
+# the control-plane journal. Format: "after_ms[#attempt]" — the kill fires
+# only on the named AM attempt (default 0), so the recovered attempt runs
+# clean.
+TEST_AM_KILL = "TEST_AM_KILL"
+# AM hang injection: SIGSTOP the AM `after_ms` after prepare() for
+# `hang_ms`, then SIGCONT — executors see heartbeat timeouts, enter orphan
+# mode, find the SAME amhostport, and resume once the AM thaws (recovery
+# without a restart). Format: "after_ms#hang_ms[#attempt]".
+TEST_AM_HANG = "TEST_AM_HANG"
 # seed for jittered backoff/injection randomness so chaos failures replay
 # exactly (propagates into AM + executor child processes)
 TEST_SEED = "TONY_TEST_SEED"

@@ -20,13 +20,15 @@ from typing import Optional
 from tony_tpu.events.schema import Event
 from tony_tpu.events.history import (
     JobMetadata, inprogress_file_name, history_file_name,
+    parse_history_file_name,
 )
 
 LOG = logging.getLogger(__name__)
 
 
 class EventHandler:
-    def __init__(self, history_dir: str, metadata: JobMetadata):
+    def __init__(self, history_dir: str, metadata: JobMetadata,
+                 resume: bool = False):
         self._dir = history_dir
         self._metadata = metadata
         self._queue: "queue.Queue[Optional[Event]]" = queue.Queue()
@@ -35,9 +37,29 @@ class EventHandler:
         self._started = False
         self._stopped = False
         os.makedirs(self._dir, exist_ok=True)
+        if resume:
+            # AM crash recovery: adopt the previous attempt's in-progress
+            # file so the job ends with exactly ONE .jhist. The original
+            # `started`/user are encoded in the file name — restore them
+            # into our metadata so the final rename matches the history
+            # this file already holds.
+            self._adopt_inprogress()
         self._inprogress_path = os.path.join(self._dir,
                                              inprogress_file_name(metadata))
         self._file = open(self._inprogress_path, "a", encoding="utf-8")
+
+    def _adopt_inprogress(self) -> None:
+        for name in sorted(os.listdir(self._dir)):
+            if not name.endswith(".inprogress"):
+                continue
+            try:
+                md = parse_history_file_name(name)
+            except ValueError:
+                continue
+            if md.application_id == self._metadata.application_id:
+                self._metadata.started = md.started
+                self._metadata.user = md.user
+                return
 
     # -- producer side ----------------------------------------------------
     def emit(self, event: Event) -> None:

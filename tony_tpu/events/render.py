@@ -203,6 +203,24 @@ def _resize_failed(p: dict) -> str:
             f"{p.get('reason', '') or 'unspecified'}")
 
 
+def _am_recovery_started(p: dict) -> str:
+    return (f"AM recovery started (process attempt "
+            f"{p.get('am_attempt', '?')}) for "
+            f"{p.get('application_id', '?')}: replayed "
+            f"{p.get('replayed_records', 0)} journal record(s), awaiting "
+            f"adoption of {p.get('live_tasks', 0)} live task(s)")
+
+
+def _am_recovery_completed(p: dict) -> str:
+    lost = p.get("lost", 0)
+    tail = f", {lost} lost to relaunch" if lost else ""
+    return (f"AM recovery completed (process attempt "
+            f"{p.get('am_attempt', '?')}): {p.get('adopted', 0)} task(s) "
+            f"adopted{tail} in {p.get('duration_ms', 0)} ms "
+            f"({p.get('downtime_ms', 0)} ms control-plane downtime, "
+            f"{p.get('replayed_records', 0)} record(s) replayed)")
+
+
 RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.APPLICATION_INITED: _application_inited,
     EventType.APPLICATION_FINISHED: _application_finished,
@@ -227,6 +245,8 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.RESIZE_STARTED: _resize_started,
     EventType.RESIZE_COMPLETED: _resize_completed,
     EventType.RESIZE_FAILED: _resize_failed,
+    EventType.AM_RECOVERY_STARTED: _am_recovery_started,
+    EventType.AM_RECOVERY_COMPLETED: _am_recovery_completed,
 }
 
 

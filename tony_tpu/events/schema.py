@@ -40,6 +40,8 @@ class EventType(str, enum.Enum):
     RESIZE_STARTED = "RESIZE_STARTED"
     RESIZE_COMPLETED = "RESIZE_COMPLETED"
     RESIZE_FAILED = "RESIZE_FAILED"
+    AM_RECOVERY_STARTED = "AM_RECOVERY_STARTED"
+    AM_RECOVERY_COMPLETED = "AM_RECOVERY_COMPLETED"
 
 
 @dataclass
@@ -363,6 +365,41 @@ class ResizeFailed:
 
 
 @dataclass
+class AmRecoveryStarted:
+    """No reference equivalent in the event log (the reference's AM
+    retry was visible only as a YARN attempt counter): a supervised AM
+    relaunch (am/supervisor.py) or a thawed hang replayed the
+    control-plane journal (am/journal.py) and entered RECOVERING — the
+    gang's user processes are still running, orphaned executors are
+    polling the staging dir for the new address, and the AM now gates
+    RUNNING on the adoption barrier (`live_tasks` re-registrations or
+    the tony.am.recovery-settle-ms deadline)."""
+    application_id: str
+    am_attempt: int             # the recovering AM PROCESS attempt
+    live_tasks: int = 0         # journaled live tasks awaiting adoption
+    replayed_records: int = 0   # journal records folded into the session
+    journal_path: str = ""
+
+
+@dataclass
+class AmRecoveryCompleted:
+    """The adoption barrier closed: every journaled live task
+    re-registered attempt-fenced (`adopted`) or missed the settle
+    deadline and was relaunched through the normal budget (`lost`).
+    `downtime_ms` — last journal record before the crash → barrier
+    closed — is what the goodput ledger prices as the `am_downtime`
+    phase against goodput_pct."""
+    application_id: str
+    am_attempt: int
+    adopted: int = 0
+    lost: int = 0
+    replayed_records: int = 0
+    duration_ms: int = 0        # recovery start → barrier closed
+    downtime_ms: int = 0        # crash (last journal stamp) → barrier closed
+    span_ids: list[str] = field(default_factory=list)
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -395,6 +432,8 @@ _PAYLOADS = {
     EventType.RESIZE_STARTED: ResizeStarted,
     EventType.RESIZE_COMPLETED: ResizeCompleted,
     EventType.RESIZE_FAILED: ResizeFailed,
+    EventType.AM_RECOVERY_STARTED: AmRecoveryStarted,
+    EventType.AM_RECOVERY_COMPLETED: AmRecoveryCompleted,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
@@ -404,7 +443,8 @@ Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 AlertResolved, PreemptionRequested, Preempted, Resumed,
                 AutoscaleDecision, RollingUpdateStarted,
                 RollingUpdateCompleted, ResizeRequested, ResizeStarted,
-                ResizeCompleted, ResizeFailed]
+                ResizeCompleted, ResizeFailed, AmRecoveryStarted,
+                AmRecoveryCompleted]
 
 
 @dataclass

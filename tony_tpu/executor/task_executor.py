@@ -38,7 +38,9 @@ from tony_tpu.conf import TonyConfiguration, keys as K
 from tony_tpu.executor.runtimes import render_framework_env
 from tony_tpu.executor.task_monitor import TaskMonitor
 from tony_tpu.rpc.client import ClusterServiceClient, MetricsServiceClient
-from tony_tpu.utils.common import current_host, pick_free_port
+from tony_tpu.utils.common import (
+    current_host, equal_jitter_backoff_sec, pick_free_port,
+)
 from tony_tpu.utils.fs import unzip
 from tony_tpu.utils.localization import (
     fetch_remote_spec, localize_resource,
@@ -105,7 +107,8 @@ class Heartbeater(threading.Thread):
                  jitter_sec: float = 0.0, gen_source=None,
                  on_spec_diff=None, on_spec_ready=None,
                  on_spec_refetch=None, on_resize=None, ack_source=None,
-                 failure_budget: int = C.MAX_CONSECUTIVE_FAILED_HEARTBEATS):
+                 failure_budget: int = C.MAX_CONSECUTIVE_FAILED_HEARTBEATS,
+                 on_orphaned=None):
         super().__init__(name="heartbeater", daemon=True)
         self._client = client
         self._task_id = task_id
@@ -130,6 +133,15 @@ class Heartbeater(threading.Thread):
         self._log_addr = log_addr
         self._interval = interval_sec
         self._on_fatal = on_fatal  # kill the user process before we die
+        # AM-crash survivability: when the budget exhausts, give the
+        # executor a chance to go ORPHAN (user process untouched,
+        # backoff-poll staging for a recovered AM, re-register) instead
+        # of self-destructing. The hook returns True once a (new or
+        # thawed) AM has adopted us — the failure counter resets and
+        # heartbeating resumes against the swapped client; False means
+        # the orphan grace expired and the executor already self-fenced
+        # through the TERM→checkpoint→KILL ladder.
+        self._on_orphaned = on_orphaned
         self._on_generation = on_generation
         # checkpoint-then-evict: a preemption drain ask piggybacked on
         # the heartbeat response (the AM never opens a connection TO a
@@ -156,6 +168,11 @@ class Heartbeater(threading.Thread):
 
     def stop(self) -> None:
         self._stop.set()
+
+    def swap_client(self, client: ClusterServiceClient) -> None:
+        """Re-point heartbeats at a recovered AM. Called from the orphan
+        hook, which runs ON this thread — no lock needed."""
+        self._client = client
 
     def run(self) -> None:
         if self._jitter_sec and self._stop.wait(self._jitter_sec):
@@ -206,9 +223,23 @@ class Heartbeater(threading.Thread):
                 LOG.warning("heartbeat failed (%d consecutive)",
                             self._consecutive_failures)
                 if self._consecutive_failures >= self._failure_budget:
-                    # the AM is unreachable: take the user process down with
-                    # us — there is no NodeManager to reap the tree here —
-                    # then exit (TaskExecutor.java:358-368)
+                    if self._on_orphaned is not None:
+                        LOG.error("%d consecutive heartbeat failures — the "
+                                  "AM is unreachable; entering orphan mode",
+                                  self._consecutive_failures)
+                        adopted = False
+                        try:
+                            adopted = bool(self._on_orphaned())
+                        except Exception:  # noqa: BLE001
+                            LOG.exception("orphan recovery hook failed")
+                        if adopted:
+                            self._consecutive_failures = 0
+                            continue
+                    # no orphan hook (or the grace expired and the hook
+                    # already self-fenced the user process through the
+                    # TERM→checkpoint→KILL ladder): take the user process
+                    # down with us — there is no NodeManager to reap the
+                    # tree here — then exit (TaskExecutor.java:358-368)
                     LOG.error("%d consecutive heartbeat failures — exiting",
                               self._consecutive_failures)
                     if self._on_fatal is not None:
@@ -268,6 +299,20 @@ class TaskExecutor:
             K.TASK_METRICS_INTERVAL_MS, 5000) / 1000.0
         self.registration_timeout_sec = self.conf.get_int(
             K.TASK_REGISTRATION_TIMEOUT_SEC, 300)
+        # heartbeat self-destruct budget: an explicitly configured
+        # tony.task.hb-failure-budget wins; otherwise the class attr
+        # stands so multi-executor harnesses (bench --cp-pool) can still
+        # widen it process-wide
+        if self.conf.source_of(K.TASK_HB_FAILURE_BUDGET) \
+                not in ("default", "unset"):
+            self.HB_FAILURE_BUDGET = max(1, self.conf.get_int(
+                K.TASK_HB_FAILURE_BUDGET,
+                C.MAX_CONSECUTIVE_FAILED_HEARTBEATS))
+        # orphan mode: how long a heartbeat-starved executor keeps the
+        # user process alive while polling staging for a recovered AM
+        # before self-fencing (TERM→emergency-checkpoint→KILL)
+        self._orphan_grace_sec = self.conf.get_time_ms(
+            K.AM_ORPHAN_GRACE_MS, 30_000) / 1000.0
         # TERM→KILL grace on every user-process termination path
         # (tony.task.term-grace-ms), sized to cover the trainer's
         # emergency checkpoint; proc.wait returns the moment the
@@ -315,6 +360,11 @@ class TaskExecutor:
                                       task_auth_id=task_auth)
         self.heartbeater: Optional[Heartbeater] = None
         self.monitor: Optional[TaskMonitor] = None
+        # set when an orphan re-attached to a recovered AM: the metrics
+        # channel still dials the dead attempt's port (only relaunched
+        # containers get the new one rendered into their env), so span
+        # pushes are skipped rather than spent on a doomed retry ladder
+        self._metrics_stale = False
         self._user_proc = None
         # lifecycle tracing (observability/trace.py): context arrives in
         # the env the AM rendered (parent = this attempt's AM task span);
@@ -468,7 +518,8 @@ class TaskExecutor:
                 on_spec_diff=self._on_spec_diff,
                 on_spec_ready=self._spec_ready_event.set,
                 on_spec_refetch=self._on_spec_refetch,
-                failure_budget=self.HB_FAILURE_BUDGET)
+                failure_budget=self.HB_FAILURE_BUDGET,
+                on_orphaned=self._on_hb_orphaned)
             self.heartbeater.start()
         host_port = f"{self.host}:{self.port}"
         LOG.info("registering %s at %s (attempt %d)", self.task_id,
@@ -1093,7 +1144,11 @@ class TaskExecutor:
     def _push_spans(self) -> None:
         """Best-effort ship of finished spans to the AM's SpanStore over
         the metrics RPC (phase boundaries only — never the hot path)."""
-        if not self.tracer.enabled:
+        if not self.tracer.enabled or self._metrics_stale:
+            # an adopted orphan's metrics channel still points at the
+            # dead AM attempt: pushing would grind through the retry
+            # ladder and starve whatever liveness-critical call comes
+            # next (the result report has a 25s expiry window to beat)
             return
         spans = self.tracer.drain()
         if not spans:
@@ -1170,6 +1225,116 @@ class TaskExecutor:
             proc.wait(timeout=grace_sec)
         except Exception:  # noqa: BLE001 — TimeoutExpired and friends
             self._kill_user_proc()
+
+    # ------------------------------------------------------------------
+    # AM-crash survivability: orphan mode (docs/FAULT_TOLERANCE.md)
+    # ------------------------------------------------------------------
+    def _on_hb_orphaned(self) -> bool:
+        """Heartbeat budget exhausted: the AM crashed or wedged. Instead
+        of the reference's immediate self-destruct
+        (TaskExecutor.java:358-368) the executor goes ORPHAN: the user
+        process keeps training while this (heartbeater) thread
+        backoff-polls the app staging dir for an AM address — a
+        supervised restart republishes `amhostport` on its new port; a
+        merely hung AM (SIGSTOP) keeps the old address and answers once
+        it thaws — and re-registers attempt-fenced. Returns True once
+        adopted (clients swapped, heartbeats resume). If no AM adopts us
+        within tony.am.orphan-grace-ms, the user process is self-fenced
+        through the normal TERM→emergency-checkpoint→KILL ladder (no
+        orphaned gang member burning a TPU slice forever, and no bare
+        os._exit losing the trainer's emergency checkpoint) and False is
+        returned — the heartbeater then exits the process."""
+        import random
+        rng = random.Random(f"orphan:{self.task_id}:{self.task_attempt}")
+        grace_sec = self._orphan_grace_sec
+        deadline = time.monotonic() + grace_sec
+        hostport_path = os.path.join(self.app_dir, C.AM_HOSTPORT_FILE)
+        LOG.warning("orphaned: polling %s for up to %.1f s for a live AM "
+                    "(user process untouched)", hostport_path, grace_sec)
+        exponent = 0
+        while time.monotonic() < deadline:
+            addr = ""
+            try:
+                with open(hostport_path, "r", encoding="utf-8") as f:
+                    addr = f.read().strip()
+            except OSError:
+                pass
+            if addr and ":" in addr and self._orphan_reattach(addr):
+                return True
+            sleep = equal_jitter_backoff_sec(0.5, 5.0, exponent, rng)
+            exponent += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            time.sleep(min(sleep, remaining))
+        LOG.error("no AM adopted this executor within the %.1f s orphan "
+                  "grace — self-fencing (TERM→checkpoint→KILL)", grace_sec)
+        self._terminate_user_proc()
+        try:
+            # best-effort, fail-FAST: if an AM came back at the last
+            # moment this records the terminal verdict, but a still-dead
+            # AM must not hold the fence open through the client's
+            # default retry ladder (~minutes) — one attempt, short
+            # deadline, then exit
+            self.client.call(
+                "register_execution_result",
+                {"exit_code": C.EXIT_HEARTBEAT_FAILURE,
+                 "job_name": self.job_name,
+                 "job_index": self.task_index,
+                 "session_id": self.session_id,
+                 "task_attempt": self.task_attempt},
+                retries=1, timeout_sec=5.0, wait_for_ready=False)
+        except Exception:  # noqa: BLE001
+            LOG.debug("orphan self-fence result report failed",
+                      exc_info=True)
+        return False
+
+    def _orphan_reattach(self, addr: str) -> bool:
+        """One fast re-adoption attempt against `addr` — possibly the
+        SAME address we already held (a thawed AM). A fresh channel
+        re-registers this task attempt-fenced (a recovering AM drains
+        its adoption barrier on exactly this call; a zombie superseded
+        attempt gets an open barrier and is fenced by later heartbeats).
+        On success the executor's and heartbeater's clients swap to the
+        new channel. The metrics channel is NOT rebound — the recovered
+        AM's metrics port is only rendered into relaunched containers,
+        so adopted executors push metrics best-effort until then."""
+        host, _, port_s = addr.rpartition(":")
+        try:
+            port = int(port_s)
+        except ValueError:
+            return False
+        candidate = ClusterServiceClient(
+            host, port, auth_token=self._task_token,
+            task_auth_id=self.task_id if self._task_token else None)
+        try:
+            candidate.call(
+                "register_worker_spec",
+                {"task_id": self.task_id,
+                 "spec": f"{self.host}:{self.port}",
+                 "session_id": self.session_id,
+                 "task_attempt": self.task_attempt},
+                retries=1, timeout_sec=5.0, wait_for_ready=False)
+        except Exception:  # noqa: BLE001 — not up yet; the poll retries
+            try:
+                candidate.close()
+            except Exception:  # noqa: BLE001
+                LOG.debug("candidate channel close failed", exc_info=True)
+            return False
+        old = self.client
+        self.client = candidate
+        self._metrics_stale = True
+        if self.heartbeater is not None:
+            self.heartbeater.swap_client(candidate)
+        if old is not None and old is not candidate:
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001
+                LOG.debug("stale channel close failed", exc_info=True)
+        LOG.warning("re-registered %s (attempt %d) with the AM at %s — "
+                    "adopted; resuming heartbeats", self.task_id,
+                    self.task_attempt, addr)
+        return True
 
     def _report(self, exit_code: int, barrier_timeout: bool = False,
                 preempted: bool = False, resized: bool = False) -> None:

@@ -258,7 +258,8 @@ def parse_goodput_gauges(gauges: dict[str, float]) -> Optional[dict]:
 def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
                       relaunch_downtime_s: float = 0.0,
                       preemption_downtime_s: float = 0.0,
-                      resize_downtime_s: float = 0.0) -> dict:
+                      resize_downtime_s: float = 0.0,
+                      am_downtime_s: float = 0.0) -> dict:
     """Fold per-task ledgers + AM-side relaunch downtime into the job
     view flushed as `goodput.json`:
 
@@ -266,15 +267,17 @@ def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
                          "tokens_per_sec_per_chip"?}},
      "job": {"goodput_pct", "productive_s", "wall_s",
              "relaunch_downtime_s", "preemption_downtime_s",
-             "resize_downtime_s"}}
+             "resize_downtime_s", "am_downtime_s"}}
 
     goodput_pct = productive train-step seconds / (summed task wall +
-    relaunch downtime + preemption downtime + resize downtime) —
-    downtime the fault-tolerance layer spent between attempts, the
-    eviction→resume gap a checkpoint-then-evict preemption cost this
-    job's lineage, and the quiesce→re-rendezvous gap of every elastic
-    resize (the `resize` phase), all count AGAINST goodput even though
-    no task process existed to observe them."""
+    relaunch downtime + preemption downtime + resize downtime + AM
+    downtime) — downtime the fault-tolerance layer spent between
+    attempts, the eviction→resume gap a checkpoint-then-evict
+    preemption cost this job's lineage, the quiesce→re-rendezvous gap
+    of every elastic resize (the `resize` phase), and the control-plane
+    blackout of an AM crash→adoption-barrier recovery (the
+    `am_downtime` phase), all count AGAINST goodput even though no
+    task process existed (or no AM was listening) to observe them."""
     tasks: dict[str, dict] = {}
     productive = 0.0
     wall_total = 0.0
@@ -293,7 +296,8 @@ def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
         productive += sum(entry["phases"].get(p, 0.0)
                           for p in PRODUCTIVE_PHASES)
     denom = wall_total + max(0.0, relaunch_downtime_s) \
-        + max(0.0, preemption_downtime_s) + max(0.0, resize_downtime_s)
+        + max(0.0, preemption_downtime_s) + max(0.0, resize_downtime_s) \
+        + max(0.0, am_downtime_s)
     return {
         "tasks": tasks,
         "job": {
@@ -305,6 +309,7 @@ def aggregate_goodput(per_task_gauges: dict[str, dict[str, float]],
             "preemption_downtime_s": round(
                 max(0.0, preemption_downtime_s), 4),
             "resize_downtime_s": round(max(0.0, resize_downtime_s), 4),
+            "am_downtime_s": round(max(0.0, am_downtime_s), 4),
         },
     }
 

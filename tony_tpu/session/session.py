@@ -354,6 +354,68 @@ class TonySession:
                      task.task_id, task.attempt, self.spec_generation)
             return task
 
+    # ------------------------------------------------------------------
+    # AM crash recovery (journal replay; see am/journal.py)
+    # ------------------------------------------------------------------
+    def restore_for_recovery(self, num_expected: int, spec_generation: int,
+                             instances: Optional[dict[str, int]] = None
+                             ) -> None:
+        """Rebuild scheduler-owned shape from a journal replay: the
+        expected-task count (normally bumped only as the scheduler
+        submits jobs — recovery never re-schedules an adopted gang) and
+        the cluster-spec generation (so survivors' heartbeat-held
+        generations stay meaningful across the AM restart). `instances`
+        resizes jobtype tables that an elastic resize or autoscale grew/
+        shrank after submit, so adopted task ids land in real slots."""
+        with self._lock:
+            for job, want in (instances or {}).items():
+                tasks = self.job_tasks.get(job)
+                req = self.requests.get(job)
+                if tasks is None or req is None or want < 1:
+                    continue
+                while len(tasks) < want:
+                    tasks.append(Task(job, len(tasks), self.session_id))
+                while len(tasks) > want:
+                    tasks.pop()
+                req.num_instances = want
+            self.num_expected_tasks = num_expected
+            self.spec_generation = max(self.spec_generation,
+                                       spec_generation)
+            self._invalidate_spec_cache()
+
+    def adopt_task(self, task_id: str, host_port: str, attempt: int,
+                   container_id: str = "", host: str = "",
+                   lifecycle_relaunches: int = 0, completed: bool = False,
+                   exit_code: int = 0) -> Optional[Task]:
+        """Fold one journaled task back into the table without touching
+        its (still-running) container: restore attempt/address/container
+        identity and re-close its barrier registration. Completed tasks
+        replay their terminal result too — they stay registered exactly
+        as they would have in the crashed AM, so the barrier math and
+        the final-status aggregation are unchanged by recovery."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if task is None:
+                LOG.warning("journal names unknown task %s; dropping",
+                            task_id)
+                return None
+            task.attempt = attempt
+            task.lifecycle_relaunches = lifecycle_relaunches
+            if container_id:
+                task.container_id = container_id
+            if host:
+                task.host = host
+            if host_port:
+                task.set_host_port(host_port)
+                self._registered[task_id] = task.host_port
+            if completed:
+                task.set_exit_status(exit_code)
+            else:
+                task.completed = False
+                task.status = TaskStatus.RUNNING
+            self._invalidate_spec_cache()
+            return task
+
     # holds: _lock (every generation bump happens under the session lock)
     def _bump_generation(self, changed_ids: set[str],
                          removed: dict[str, set[int]]) -> int:
