@@ -443,5 +443,81 @@ def test_cp_disclosure_stamps_warm_fields():
     assert "cold_baseline_s" not in d
 
 
+@pytest.mark.kv
+def test_prefix_reuse_headlines_gate_units_and_disclosure(tmp_path,
+                                                          monkeypatch):
+    """serve_bench --prefix-reuse appends ONLY a strict double win (ON
+    beats OFF on throughput AND TTFT), every line carries the KV
+    hit-rate disclosure next to the number it justifies, and the two
+    headlines ride the same bench_compare gate: tok/s judged
+    higher-is-better, unit "s" judged lower-is-better."""
+    import tools.serve_bench as sb
+    from tools.bench_compare import compare, load_history
+
+    on = {"tokens_per_sec": 120.0, "ttft_p95_s": 0.040,
+          "kv_hit_rate_pct": 55.4, "requests_errored": 0}
+    off = {"tokens_per_sec": 100.0, "ttft_p95_s": 0.050,
+           "requests_errored": 0}
+    entries = sb.build_prefix_history_entries(on, off, "bench_350m", 0.6)
+    assert [e["metric"] for e in entries] == [
+        "serving_prefix_tokens_per_sec", "serving_prefix_ttft_p95_s"]
+    assert entries[0]["unit"] == "tok/s" and entries[0]["value"] == 120.0
+    assert entries[1]["unit"] == "s" and entries[1]["value"] == 0.040
+    for e in entries:
+        # the disclosure contract: hit rate + baseline on EVERY line
+        assert e["kv_hit_rate_pct"] == 55.4
+        assert e["reuse_ratio"] == 0.6
+        assert e["baseline_tokens_per_sec"] == 100.0
+        assert e["baseline_ttft_p95_s"] == 0.050
+        assert e["model"] == "bench_350m"
+
+    # the gate: a tps win with a ttft LOSS appends nothing (and vice
+    # versa) — half-wins would poison the baseline for later commits
+    assert sb.build_prefix_history_entries(
+        {**on, "ttft_p95_s": 0.060}, off, "bench_350m", 0.6) == []
+    assert sb.build_prefix_history_entries(
+        {**on, "tokens_per_sec": 90.0}, off, "bench_350m", 0.6) == []
+    # degenerate measurements and errored rounds append nothing
+    assert sb.build_prefix_history_entries(
+        {**on, "tokens_per_sec": 0.0}, off, "bench_350m", 0.6) == []
+    assert sb.build_prefix_history_entries(
+        on, {**off, "ttft_p95_s": 0.0}, "bench_350m", 0.6) == []
+    assert sb.build_prefix_history_entries(
+        {**on, "requests_errored": 2}, off, "bench_350m", 0.6) == []
+    assert sb.build_prefix_history_entries(
+        on, {**off, "requests_errored": 1}, "bench_350m", 0.6) == []
+
+    # append → bench_compare round trip: a later WORSE run regresses on
+    # both gates, a later better run passes both
+    hist = tmp_path / "bench_history.jsonl"
+    monkeypatch.setattr(sb, "HISTORY_PATH", str(hist))
+    monkeypatch.setattr(sb, "_commit_stamp", lambda: "prefixhead")
+    for e in entries:
+        sb.append_history(e)
+    worse = sb.build_prefix_history_entries(
+        {"tokens_per_sec": 101.0, "ttft_p95_s": 0.049,
+         "kv_hit_rate_pct": 12.0, "requests_errored": 0},
+        off, "bench_350m", 0.6)
+    for e in worse:
+        sb.append_history(e)
+    loaded = load_history(str(hist))
+    assert len(loaded) == 4
+    assert all(e["commit"] == "prefixhead" and e["backend"] == "cpu"
+               for e in loaded)
+    verdicts = {v["metric"]: v for v in compare(loaded, threshold_pct=2.0)}
+    assert verdicts["serving_prefix_tokens_per_sec"]["regression"] is True
+    assert verdicts["serving_prefix_ttft_p95_s"]["regression"] is True
+    for e in sb.build_prefix_history_entries(
+            {"tokens_per_sec": 130.0, "ttft_p95_s": 0.035,
+             "kv_hit_rate_pct": 60.0, "requests_errored": 0},
+            off, "bench_350m", 0.6):
+        sb.append_history(e)
+    verdicts = {v["metric"]: v
+                for v in compare(load_history(str(hist)),
+                                 threshold_pct=2.0)}
+    assert verdicts["serving_prefix_tokens_per_sec"]["regression"] is False
+    assert verdicts["serving_prefix_ttft_p95_s"]["regression"] is False
+
+
 if __name__ == "__main__":
     sys.exit(0)

@@ -1,0 +1,490 @@
+"""Paged prefix-shared KV cache + disaggregation tests (serve/kvcache.py).
+
+The load-bearing contracts, in order of blast radius:
+
+- **Bit-identity.** Prefix sharing ON (paged gather + suffix-only
+  prefill) produces the SAME greedy token streams as sharing OFF and as
+  the offline `generate()` oracle, under staggered arrivals and slot
+  recycling, with zero decode-step recompiles after warmup — reuse is a
+  pure latency optimization, never a numerics fork.
+- **Pool invariants.** Page ids always partition into
+  {scratch} ∪ free ∪ indexed; parent child-refcounts match live
+  children; pinned/interior nodes are never evicted — chaos-checked
+  under random register/match/evict interleavings.
+- **Migration.** pack/unpack is a byte-exact roundtrip and a prefill →
+  decode handoff continues the greedy stream bit-identically to
+  decoding locally.
+- **Routing/scaling.** Prefix affinity prefers the advertising replica
+  but NEVER overrides draining/dead/decode-role filtering; page-pool
+  headroom scales the load score; prefill/decode pools file DISTINCT
+  arbiter book entries and fold only their own pool's SLIs.
+
+All CPU-backend, tier-1 fast.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models.generate import generate
+from tony_tpu.models.llama import get_config, llama_init
+from tony_tpu.serve.engine import (
+    ContinuousBatchingEngine, decode_step_cache_size,
+)
+from tony_tpu.serve.kvcache import (
+    KVPagePool, SCRATCH_PAGE, chain_hashes, pack_migration,
+    unpack_migration,
+)
+
+pytestmark = pytest.mark.kv
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = get_config("tiny")
+    return llama_init(cfg, jax.random.PRNGKey(0)), cfg
+
+
+def _prompts(cfg, lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[int(t) for t in rng.randint(0, cfg.vocab_size, size=n)]
+            for n in lengths]
+
+
+def _oracle(params, cfg, prompt, n, **kw):
+    out = generate(params, cfg, jnp.asarray([prompt], jnp.int32), n, **kw)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _drain(engine, handles, max_steps=300):
+    for _ in range(max_steps):
+        if all(h.done.is_set() for h in handles):
+            return
+        engine.step()
+    raise AssertionError("engine did not finish the workload")
+
+
+# ---------------------------------------------------------------------------
+# chain hashes
+# ---------------------------------------------------------------------------
+
+def test_chain_hashes_identify_full_prefixes():
+    """Equal hash[i] ⇔ equal tokens[0:(i+1)*P]: the chain makes block
+    identity transitive, so a mid-prompt divergence changes EVERY later
+    hash — never just the diverged block's."""
+    a = list(range(40))
+    b = list(range(40))
+    b[17] = 999                          # diverge inside block 4 (P=4)
+    ha, hb = chain_hashes(a, 4), chain_hashes(b, 4)
+    assert len(ha) == len(hb) == 10
+    assert ha[:4] == hb[:4]
+    assert all(x != y for x, y in zip(ha[4:], hb[4:]))
+    # deterministic across calls (never Python hash(), which is salted)
+    assert chain_hashes(a, 4) == ha
+    # only COMPLETE blocks are hashed; degenerate page sizes are empty
+    assert len(chain_hashes(a[:7], 4)) == 1
+    assert chain_hashes(a, 0) == []
+
+
+# ---------------------------------------------------------------------------
+# pool refcount / COW invariants
+# ---------------------------------------------------------------------------
+
+def _register_chain(pool, hashes):
+    """Seal a full chain into the index (test-side stand-in for
+    _seal_prefix's bookkeeping)."""
+    parent = ""
+    for depth, digest in enumerate(hashes, start=1):
+        if digest in pool._nodes:
+            parent = digest
+            continue
+        pid = pool.allocate()
+        if pid is None:
+            return
+        pool.register(parent, digest, pid, depth)
+        parent = digest
+
+
+def test_pool_refcount_pinning_and_eviction_order(model):
+    _, cfg = model
+    pool = KVPagePool(cfg, token_budget=32, page_size=4, n_pages=6,
+                      n_slots=1)
+    assert pool.pages_total == 5                 # scratch excluded
+    ha = chain_hashes(list(range(12)), 4)        # 3-block chain
+    hb = chain_hashes([7] * 8, 4)                # 2-block chain
+    _register_chain(pool, ha)
+    _register_chain(pool, hb)
+    pool.check_invariants()
+    assert pool.pages_used == 5 and pool.pages_free == 0
+
+    # match pins the deepest node; its ancestors are held by child refs
+    ids, depth = pool.match(ha)
+    assert depth == 3 and len(ids) == 3
+    assert pool._nodes[ha[2]].pins == 1
+    # a shared shorter prefix matches the same pages
+    ids2, depth2 = pool.match(chain_hashes(list(range(8)), 4))
+    assert depth2 == 2 and ids2 == ids[:2]
+    pool.unpin(ha[1])
+
+    # chain A is fully pinned-or-interior; only chain B's leaf (then its
+    # parent, once it becomes a leaf) is evictable
+    assert pool.evictable_pages() == 1
+    assert pool.headroom_pages() == 1
+    p1 = pool.allocate()                         # LRU leaf hb[1] evicted
+    assert p1 is not None and pool.evicted_pages == 1
+    assert hb[1] not in pool._nodes and hb[0] in pool._nodes
+    p2 = pool.allocate()                         # hb[0] is a leaf now
+    assert p2 is not None and hb[0] not in pool._nodes
+    held = [p1, p2]                              # checked out mid-admission
+    # what remains is pinned/interior: allocation fails CLEANLY
+    assert pool.allocate() is None
+    pool.unpin(ha[2])
+    held.append(pool.allocate())
+    assert held[-1] is not None                  # leaf freed by unpin
+    pool._free.extend(held)                      # return them unused
+    pool.check_invariants()
+    # advertised snapshot tracks the live index
+    assert set(pool.advertised) == set(pool._nodes)
+
+
+def test_pool_eviction_chaos_invariants_hold(model):
+    """Random register/match/unpin/allocate interleavings on a tiny
+    pool: the partition + refcount invariants hold after EVERY op and
+    counters stay monotonic."""
+    _, cfg = model
+    pool = KVPagePool(cfg, token_budget=64, page_size=4, n_pages=9,
+                      n_slots=1)
+    rng = np.random.RandomState(1234)
+    pinned: list[str] = []
+    last_evicted = 0
+    for _ in range(400):
+        op = rng.randint(0, 4)
+        if op == 0:                              # register a random chain
+            prompt = [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                                  size=rng.randint(4, 24))]
+            _register_chain(pool, chain_hashes(prompt, 4))
+        elif op == 1:                            # match (pins deepest)
+            prompt = [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                                  size=rng.randint(4, 24))]
+            hashes = chain_hashes(prompt, 4)
+            _, depth = pool.match(hashes)
+            if depth:
+                pinned.append(hashes[depth - 1])
+        elif op == 2 and pinned:                 # release an old pin
+            pool.unpin(pinned.pop(rng.randint(0, len(pinned))))
+        else:                                    # allocate under pressure
+            pid = pool.allocate()
+            if pid is not None:
+                assert pid != SCRATCH_PAGE
+                pool._free.append(pid)           # return it unused
+        pool.check_invariants()
+        assert pool.evicted_pages >= last_evicted
+        last_evicted = pool.evicted_pages
+    for digest in pinned:
+        pool.unpin(digest)
+    pool.check_invariants()
+    assert pool.sealed_pages > 0 and pool.evicted_pages > 0
+
+
+# ---------------------------------------------------------------------------
+# ON-vs-OFF bit-identity + zero decode recompiles
+# ---------------------------------------------------------------------------
+
+def test_prefix_sharing_on_equals_off_staggered_zero_decode_recompiles(
+        model):
+    """The tentpole contract: sharing ON (paged gather + suffix-only
+    prefill) emits the SAME greedy streams as sharing OFF and as the
+    offline oracle, under staggered arrivals + slot recycling, while
+    the persistent decode step never recompiles — and the pool really
+    did serve hits (this is not a vacuous all-miss pass)."""
+    params, cfg = model
+    shared = _prompts(cfg, (8,), seed=5)[0]      # two full 4-token blocks
+    tails = _prompts(cfg, (5, 3, 9, 1, 6), seed=6)
+    prompts = [shared + t for t in tails] + _prompts(cfg, (7,), seed=7)
+
+    outs = {}
+    for sharing in (False, True):
+        engine = ContinuousBatchingEngine(
+            params, cfg, n_slots=2, token_budget=32, queue_depth=16,
+            prefix_sharing=sharing, kv_page_size=4)
+        warm = engine.submit(prompts[0], 2)
+        _drain(engine, [warm])
+        decode_compiles = decode_step_cache_size()
+        # staggered: two in, step a few times, then the rest
+        handles = [engine.submit(p, 4) for p in prompts[:2]]
+        for _ in range(3):
+            engine.step()
+        handles += [engine.submit(p, 4) for p in prompts[2:]]
+        _drain(engine, handles)
+        assert decode_step_cache_size() == decode_compiles, \
+            f"decode step recompiled (sharing={sharing})"
+        outs[sharing] = [h.tokens for h in handles]
+        if sharing:
+            pool = engine.kv_pool
+            pool.check_invariants()
+            assert pool.req_hits >= len(tails) - 1
+            assert pool.hit_tokens >= 8 * (len(tails) - 1)
+            # the probe surfaces the reuse the router keys off
+            load = engine.load()
+            assert load["kv_page_size"] == 4
+            assert load["kv_pages_total"] > 0
+            assert load["prefix_hashes"]
+            assert engine.snapshot()["kv_hit_total"] == pool.hit_tokens
+
+    assert outs[True] == outs[False]
+    for toks, p in zip(outs[True], prompts):
+        assert toks == _oracle(params, cfg, p, 4)
+
+
+# ---------------------------------------------------------------------------
+# migration: wire format + prefill→decode handoff equivalence
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_migration_roundtrip_and_validation():
+    meta = {"prompt": [1, 2, 3], "max_new_tokens": 4, "pos": 3,
+            "tok0": 9, "emitted": 1}
+    leaves = {"k": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+              "v": np.ones((2, 3, 4), np.int8)}
+    body = pack_migration(meta, leaves)
+    header, out = unpack_migration(body)
+    assert {k: header[k] for k in meta} == meta
+    assert set(out) == {"k", "v"}
+    for name in leaves:
+        assert out[name].dtype == leaves[name].dtype
+        np.testing.assert_array_equal(out[name], leaves[name])
+    with pytest.raises(ValueError):
+        unpack_migration(body[:len(body) - 5])   # truncated blob
+    with pytest.raises(ValueError):
+        unpack_migration(b"not-json\n" + b"x" * 8)
+    with pytest.raises(ValueError):
+        unpack_migration(b"no header separator at all")
+
+
+@pytest.mark.parametrize("quant", [False, True],
+                         ids=["fp-cache", "int8-cache"])
+def test_migrate_roundtrip_bit_identical_to_local_decode(model, quant):
+    """A prefill-role admission that migrates out, framed through the
+    wire format and installed on a second engine, continues the greedy
+    stream bit-identically to the offline oracle — tok0 from the
+    prefill side, the rest from the decode side, no token lost or
+    doubled. Holds for the int8 quant cache too (the quantized bytes
+    travel verbatim)."""
+    params, cfg = model
+    prompts = _prompts(cfg, (9, 6), seed=11)
+    max_new = 5
+    pre = ContinuousBatchingEngine(params, cfg, n_slots=2,
+                                   token_budget=32, queue_depth=8,
+                                   quant_cache=quant, role="prefill")
+    dec = ContinuousBatchingEngine(params, cfg, n_slots=2,
+                                   token_budget=32, queue_depth=8,
+                                   quant_cache=quant, role="decode")
+    for p in prompts:
+        h_pre = pre.submit(p, max_new, migrate_out=True)
+        _drain(pre, [h_pre])
+        assert h_pre.finish_reason == "migrated"
+        assert h_pre.migration is not None
+        # over the wire: JSON header + raw leaf bytes, byte-exact
+        body = pack_migration(h_pre.migration["meta"],
+                              h_pre.migration["leaves"])
+        header, leaves = unpack_migration(body)
+        h_dec = dec.submit_migration(header, leaves)
+        _drain(dec, [h_dec])
+        assert h_dec.finish_reason == "length"
+        full = h_pre.tokens + h_dec.tokens
+        assert len(full) == max_new
+        assert full == _oracle(params, cfg, p, max_new,
+                               quant_cache=quant)
+    assert pre.stats.migrated_out == len(prompts)
+    assert dec.stats.migrated_in == len(prompts)
+    assert pre.snapshot()["migrated_out_total"] == len(prompts)
+
+
+def test_submit_migration_validates_layout_and_pos(model):
+    params, cfg = model
+    dec = ContinuousBatchingEngine(params, cfg, n_slots=1,
+                                   token_budget=32, queue_depth=4)
+    from tony_tpu.serve.engine import BudgetExceededError
+    good = {name: np.zeros((cfg.n_layers, cfg.n_kv_heads, 3,
+                            cfg.head_dim), np.asarray(arr).dtype)
+            for name, arr in dec._cache.items()}
+    meta = {"prompt": [1, 2, 3], "max_new_tokens": 2, "pos": 3, "tok0": 1}
+    with pytest.raises(BudgetExceededError):    # pos != prompt length
+        dec.submit_migration({**meta, "pos": 2}, good)
+    with pytest.raises(BudgetExceededError):    # missing leaves
+        dec.submit_migration(meta, {"k": good["k"]})
+    with pytest.raises(BudgetExceededError):    # budget overflow
+        dec.submit_migration({**meta, "max_new_tokens": 64}, good)
+
+
+# ---------------------------------------------------------------------------
+# router: affinity vs draining precedence + headroom-scaled load score
+# ---------------------------------------------------------------------------
+
+def _fake_endpoint(router, url, load, draining=False, role="",
+                   failures=0):
+    from tony_tpu.serve.router import Endpoint
+    ep = Endpoint(url=url, draining_hint=draining, role=role)
+    ep.load = dict(load)
+    ep.probed_at = 1.0        # cached snapshot: no bootstrap probe RPC
+    ep.failures = failures
+    with router._lock:
+        router._endpoints[url] = ep
+    return ep
+
+
+def test_router_affinity_prefers_advertiser_never_overrides_draining():
+    """The deepest advertised prefix match wins the ranking, but the
+    state filter runs FIRST: a draining or dead replica advertising the
+    whole prompt is never picked, and decode-role replicas take no
+    /v1/generate traffic at all."""
+    from tony_tpu.serve.router import FleetRouter
+    router = FleetRouter(dead_after_failures=2)
+    prompt = list(range(24))
+    hashes = chain_hashes(prompt, 4)
+    base = {"queue_depth": 0, "slots_free": 2, "n_slots": 2,
+            "active_slots": 0, "draining": False, "kv_page_size": 4}
+    # busy but advertising the full prefix
+    _fake_endpoint(router, "http://affin:1",
+                   {**base, "queue_depth": 3, "slots_free": 1,
+                    "prefix_hashes": hashes})
+    # idle, no index
+    _fake_endpoint(router, "http://idle:1", base)
+    # advertises everything, but draining — excluded entirely
+    _fake_endpoint(router, "http://drain:1",
+                   {**base, "prefix_hashes": hashes}, draining=True)
+    # advertises everything, but dead — excluded entirely
+    _fake_endpoint(router, "http://dead:1",
+                   {**base, "prefix_hashes": hashes}, failures=99)
+    # decode-role replicas only take /v1/migrate handoffs
+    _fake_endpoint(router, "http://decode:1",
+                   {**base, "prefix_hashes": hashes, "role": "decode"})
+
+    ranked = router._ranked(prompt)
+    assert [ep.url for ep, _ in ranked] == ["http://affin:1",
+                                            "http://idle:1"]
+    assert ranked[0][1] == len(hashes)           # full-depth match
+    assert ranked[1][1] == 0
+    # no prompt → pure least-loaded order, same exclusions
+    assert [ep.url for ep in router.candidates()] == ["http://idle:1",
+                                                      "http://affin:1"]
+    # a shared leading block still hits (chain prefix semantics)...
+    assert router._ranked(list(range(8)) + [999] * 16)[0][1] == 2
+    # ...a divergent prompt falls back least-loaded with zero depth
+    cold = router._ranked([999] * 24)
+    assert [ep.url for ep, d in cold] == ["http://idle:1",
+                                          "http://affin:1"]
+    assert all(d == 0 for _, d in cold)
+    router._httpd.server_close()
+
+
+def test_load_score_scales_with_kv_headroom():
+    """Satellite (c): /v1/load's page-pool headroom feeds the routing
+    score — equal slots_free, exhausted pool loses to healthy pool; a
+    poolless replica is unscaled."""
+    from tony_tpu.serve.router import _effective_slots
+    assert _effective_slots({"slots_free": 4}) == 4.0
+    full = _effective_slots({"slots_free": 4, "kv_pages_headroom": 8,
+                             "kv_pages_total": 8})
+    starved = _effective_slots({"slots_free": 4, "kv_pages_headroom": 0,
+                                "kv_pages_total": 8})
+    assert full == 4.0 and starved == 2.0
+    assert _effective_slots({"slots_free": 4, "kv_pages_headroom": 4,
+                             "kv_pages_total": 8}) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# role-split autoscaling: per-pool SLIs + distinct arbiter book entries
+# ---------------------------------------------------------------------------
+
+def test_aggregate_slis_fold_per_pool_and_carry_itl():
+    from tony_tpu.serve.autoscaler import aggregate_serving_slis
+    gauges = {
+        "serving:0": {"SERVING_QUEUE_DEPTH": 6, "SERVING_TTFT_P95_S": 0.9,
+                      "SERVING_ITL_P50_MS": 40.0,
+                      "SERVING_SLOT_OCCUPANCY_PCT": 90},
+        "serving:1": {"SERVING_QUEUE_DEPTH": 1, "SERVING_TTFT_P95_S": 0.1,
+                      "SERVING_ITL_P50_MS": 160.0,
+                      "SERVING_SLOT_OCCUPANCY_PCT": 40},
+        "serving:2": {"SERVING_QUEUE_DEPTH": 2, "SERVING_TTFT_P95_S": 0.2,
+                      "SERVING_SLOT_OCCUPANCY_PCT": 50},
+    }
+    roles = {"serving:0": "prefill", "serving:1": "decode"}
+    # serving:2 has no role → "both": counts toward EVERY pool
+    pre = aggregate_serving_slis(gauges, roles=roles, role="prefill")
+    assert pre["queue_depth"] == 8.0
+    assert pre["ttft_p95_s"] == 0.9
+    assert pre["itl_p50_ms"] == 40.0
+    dec = aggregate_serving_slis(gauges, roles=roles, role="decode")
+    assert dec["queue_depth"] == 3.0
+    assert dec["itl_p50_ms"] == 160.0
+    # whole-fleet fold (no role) sees the max ITL across pools
+    assert aggregate_serving_slis(gauges)["itl_p50_ms"] == 160.0
+
+
+def test_itl_signal_scales_decode_pool_up():
+    """The decode pool's up-signal: inter-token latency breaching
+    itl-p50-up-ms drives an UP verdict even with an empty queue and a
+    healthy TTFT (the prefill-side signal)."""
+    from tony_tpu.serve.autoscaler import (
+        AutoscalerConfig, ReplicaAutoscaler, UP,
+    )
+    cfg = AutoscalerConfig(itl_p50_up_ms=100.0, queue_depth_up=0,
+                           reject_rate_up_pct=0, occupancy_down_pct=0,
+                           hysteresis_passes=2, cooldown_ms=0,
+                           max_replicas=4)
+    scaler = ReplicaAutoscaler(cfg)
+    slis = {"itl_p50_ms": 150.0, "ttft_p95_s": 0.01, "queue_depth": 0,
+            "occupancy_pct": 80}
+    assert scaler.evaluate(slis, 2, 0.0)["action"] == "hold"   # streak 1
+    verdict = scaler.evaluate(slis, 2, 1.0)
+    assert verdict["action"] == UP
+    assert "itl_p50" in verdict["reason"]
+
+
+def test_role_split_asks_are_distinct_arbiter_book_entries(monkeypatch):
+    """A prefill pool's queued ask must never shadow a decode ask: the
+    two pools file under role-suffixed app_ids."""
+    from tony_tpu.cluster import arbiter as arb_mod
+    from tony_tpu.conf import TonyConfiguration
+    from tony_tpu.serve.autoscaler import replica_ask_verdict
+    seen = []
+
+    def fake_decide(self, ask):
+        seen.append(ask.app_id)
+        return arb_mod.Decision(action="ADMIT")
+
+    monkeypatch.setattr(arb_mod.Arbiter, "decide", fake_decide)
+    conf = TonyConfiguration()
+    for role in ("prefill", "decode", None):
+        d = replica_ask_verdict(conf, "app_1", chips=0, role=role)
+        assert d.action == "ADMIT"
+    assert seen == ["app_1/serving-scaleup-prefill",
+                    "app_1/serving-scaleup-decode",
+                    "app_1/serving-scaleup"]
+    assert len(set(seen)) == 3
+
+
+# ---------------------------------------------------------------------------
+# event surface
+# ---------------------------------------------------------------------------
+
+def test_serving_migrated_event_schema_and_renderer():
+    """SERVING_MIGRATED parses through the payload registry and renders
+    human-readably (the all-EventTypes renderer-coverage pin lives in
+    test_logs.py; this pins the CONTENT)."""
+    from tony_tpu.events.render import render_event
+    from tony_tpu.events.schema import EventType, ServingMigrated
+    import dataclasses
+    p = dataclasses.asdict(ServingMigrated("serving", 2,
+                                           "http://d:8100", count=3))
+    line = render_event(EventType.SERVING_MIGRATED, p)
+    assert "serving:2" in line and "http://d:8100" in line
+    assert "3 requests" in line
+    single = dataclasses.asdict(ServingMigrated("serving", 0,
+                                                "http://d:8100"))
+    assert "requests" not in render_event(EventType.SERVING_MIGRATED,
+                                          single)

@@ -46,6 +46,9 @@ class FakeHandler:
     def request_profile(self, req):
         return {"request_id": "fake"}
 
+    def report_serving_migrated(self, req):
+        return {}
+
     def get_skew(self, req):
         return {"stragglers": []}
 

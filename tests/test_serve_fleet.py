@@ -437,7 +437,8 @@ def test_engine_load_snapshot_shape_and_drain_flag(model):
         load = _get(frontend.port, "/v1/load")
         assert load == {"ok": True, "queue_depth": 0, "slots_free": 3,
                         "active_slots": 0, "n_slots": 3,
-                        "draining": False, "weights_generation": 7}
+                        "draining": False, "weights_generation": 7,
+                        "role": "both", "token_budget": 16}
         # a queued (not stepping) request shows up in the snapshot
         engine.submit(_prompts(cfg, (4,), seed=3)[0], 2)
         load = _get(frontend.port, "/v1/load")

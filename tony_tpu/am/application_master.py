@@ -30,7 +30,7 @@ import random
 import sys
 import threading
 import time
-from typing import Optional
+from typing import Any, Optional
 
 from tony_tpu import constants as C
 from tony_tpu.cluster import Container, backend_from_conf
@@ -45,7 +45,8 @@ from tony_tpu.events.schema import (
     ApplicationFinished, ApplicationInited, AutoscaleDecision,
     DiagnosticsReady, Event, EventType, Preempted, PreemptionRequested,
     ProfileCaptured, Resumed, RollingUpdateCompleted, RollingUpdateStarted,
-    ServingEndpointRegistered, SloViolation, StragglerCleared,
+    ServingEndpointRegistered, ServingMigrated, SloViolation,
+    StragglerCleared,
     StragglerDetected, TaskFinished, TaskRelaunched, TaskStarted,
 )
 from tony_tpu.am.liveliness import LivelinessMonitor, auto_liveliness_shards
@@ -509,8 +510,17 @@ class ApplicationMaster(ClusterServiceHandler):
         # is dropped by _check_scaleup_timeouts — never app-fatal.
         self._pending_scaleups: dict[str, float] = {}  # guarded-by: _lock
         # edge-dedup for arbiter-queued scale-ups (monitor thread only):
-        # one event per queued episode, not one per pass
-        self._autoscale_queued = False
+        # one event per queued episode per pool, not one per pass.
+        # Keys are pool roles ("" = undisaggregated fleet).
+        self._autoscale_queued: set[str] = set()
+        # disaggregated fleets: per-pool hysteresis/cooldown machines
+        # ("prefill"/"decode"), lazily built off the shared config —
+        # prefill pressure must not half-arm a decode scale-up
+        self._role_scalers: dict[str, Any] = {}
+        # autoscaled serving slots pinned to a disaggregation pool:
+        # task_id -> role, injected as TONY_SERVING_ROLE into the
+        # container env so the scaled-up replica joins the RIGHT pool
+        self._scaleup_roles: dict[str, str] = {}  # guarded-by: _lock
         self.autoscaler = None
         if conf.get_bool(K.AUTOSCALER_ENABLED, False):
             try:
@@ -1923,7 +1933,14 @@ class ApplicationMaster(ClusterServiceHandler):
         chip ask goes THROUGH the admission arbiter first (it may
         checkpoint-then-evict a lower-priority job), a scale-down drains
         one replica and returns its chips. Every executed or
-        arbiter-queued decision is event-pinned with the SLI evidence."""
+        arbiter-queued decision is event-pinned with the SLI evidence.
+
+        Disaggregated fleets (any endpoint registered with a
+        prefill/decode role) split into per-pool passes: each pool's
+        SLIs fold over ITS replicas only and feed a pool-private
+        hysteresis/cooldown machine, so TTFT burn grows the prefill
+        pool while ITL/occupancy pressure grows the decode pool —
+        independently, never through one shared streak."""
         scaler = self.autoscaler
         session = self.session
         with self._lock:
@@ -1935,101 +1952,143 @@ class ApplicationMaster(ClusterServiceHandler):
         try:
             from tony_tpu.serve.autoscaler import aggregate_serving_slis
             replicas = self._serving_replicas()
-            slis = aggregate_serving_slis(
-                self.metrics_store.latest_gauges(),
-                live_task_ids={t.task_id for t in replicas})
-            if slis is None:
-                return      # no replica has pushed serving metrics yet
-            verdict = scaler.evaluate(slis, len(replicas),
-                                      time.time() * 1000.0)
-            if verdict["action"] != "up":
-                # the scale-up pressure (if any) broke: a future queued
-                # verdict is a fresh episode worth a fresh event
-                self._autoscale_queued = False
-            if verdict["action"] == "hold":
+            gauges = self.metrics_store.latest_gauges()
+            with self._lock:
+                roles = {tid: (rec.get("role") or "both")
+                         for tid, rec in self._serving_endpoints.items()}
+            pools = sorted({r for r in roles.values()
+                            if r in ("prefill", "decode")})
+            if not pools:
+                slis = aggregate_serving_slis(
+                    gauges, live_task_ids={t.task_id for t in replicas})
+                if slis is not None:
+                    self._autoscale_pool(scaler, "", replicas, slis)
                 return
-            ev = verdict["slis"]
-            if verdict["action"] == "up":
-                chips = session.requests[C.SERVING_JOB_NAME].tpus
-                decision = self._autoscale_arbiter(chips)
-                if decision.action in ("queue", "reclaim"):
-                    # neither verdict has freed chips YET: a reclaim
-                    # shrinks elastic victims in place and the chips
-                    # only exist once the registry shows them gone —
-                    # deliver it and re-ask next pass, exactly like the
-                    # preempt-then-re-ask flow. Event + warning on the
-                    # EDGE into the blocked state only: under sustained
-                    # overload this branch runs every monitor pass for
-                    # hours, and per-pass duplicates would bloat
-                    # history/timelines the way the alert engine's
-                    # pending->firing dedup exists to prevent.
-                    if not self._autoscale_queued:
-                        self._autoscale_queued = True
-                        self.event_handler.emit(Event(
-                            EventType.AUTOSCALE_DECISION,
-                            AutoscaleDecision(
-                                C.SERVING_JOB_NAME, "up", len(replicas),
-                                len(replicas) + 1, chips=chips,
-                                arbiter_action=decision.action,
-                                victims=[a.app_id for a, _
-                                         in decision.reclaims],
-                                reason=verdict["reason"], **ev)))
-                        LOG.warning("autoscale up %s by the arbiter: %s",
-                                    "waits on an elastic reclaim"
-                                    if decision.action == "reclaim"
-                                    else "blocked", decision.reason)
-                    # the reclaim DELIVERY re-sends every pass (like the
-                    # preempt branch re-executing each pass): a victim
-                    # whose cooldown refused the first ask, or a
-                    # transient RPC failure, must not stall the scale-up
-                    # forever — in-flight resizes dedup as `duplicate`
-                    if decision.reclaims:
-                        from tony_tpu.cluster.arbiter import \
-                            execute_reclaims
-                        execute_reclaims(
-                            decision.reclaims,
-                            grace_ms=self.conf.get_time_ms(
-                                K.ARBITER_GRACE_MS, 30_000),
-                            reason=f"reclaimed to scale "
-                                   f"{self.app_id} serving to "
-                                   f"{len(replicas) + 1} replicas",
-                            requested_by="autoscaler")
-                    return      # no cooldown: re-ask next pass
-                self._autoscale_queued = False
-                self.event_handler.emit(Event(
-                    EventType.AUTOSCALE_DECISION,
-                    AutoscaleDecision(
-                        C.SERVING_JOB_NAME, "up", len(replicas),
-                        len(replicas) + 1, chips=chips,
-                        arbiter_action=decision.action,
-                        victims=[v.app_id for v in decision.victims],
-                        reason=verdict["reason"], **ev)))
-                if decision.victims:
-                    from tony_tpu.cluster.arbiter import execute_preemption
-                    grace = self.conf.get_time_ms(K.ARBITER_GRACE_MS,
-                                                  30_000)
-                    execute_preemption(
-                        decision.victims, grace_ms=grace,
-                        reason=f"preempted to scale {self.app_id} "
-                               f"serving to {len(replicas) + 1} replicas",
-                        requested_by="autoscaler")
-                self._scale_serving_up()
-                scaler.note_scaled(time.time() * 1000.0)
-            else:
-                victim = self._scale_serving_down()
-                if victim is None:
-                    return
-                self.event_handler.emit(Event(
-                    EventType.AUTOSCALE_DECISION,
-                    AutoscaleDecision(
-                        C.SERVING_JOB_NAME, "down", len(replicas),
-                        len(replicas) - 1,
-                        reason=verdict["reason"], **ev)))
-                scaler.note_scaled(time.time() * 1000.0)
+            for pool in pools:
+                pool_replicas = [
+                    t for t in replicas
+                    if roles.get(t.task_id, "both") in (pool, "both")]
+                slis = aggregate_serving_slis(
+                    gauges,
+                    live_task_ids={t.task_id for t in pool_replicas},
+                    roles=roles, role=pool)
+                if slis is None:
+                    continue    # pool hasn't pushed serving metrics yet
+                self._autoscale_pool(self._role_scaler(pool), pool,
+                                     pool_replicas, slis)
         except Exception:  # noqa: BLE001 — scaling must never kill the AM
             LOG.exception("autoscaler check failed")
 
-    def _autoscale_arbiter(self, chips: int):
+    def _role_scaler(self, role: str):
+        """Per-pool decision machine, lazily built off the shared
+        config. The base self.autoscaler keeps serving undisaggregated
+        fleets so their streak/cooldown state survives a transient
+        role registration."""
+        scaler = self._role_scalers.get(role)
+        if scaler is None:
+            from tony_tpu.serve.autoscaler import ReplicaAutoscaler
+            scaler = ReplicaAutoscaler(self.autoscaler.config)
+            self._role_scalers[role] = scaler
+        return scaler
+
+    def _autoscale_pool(self, scaler, role: str, replicas: list,
+                        slis: dict) -> None:
+        """Evaluate + execute one pool's verdict (role '' = the whole
+        undisaggregated fleet). Scale-up asks ride the arbiter with the
+        pool named in the GangAsk so prefill and decode asks are
+        distinct book entries; scale-down drains a replica of THIS
+        pool."""
+        session = self.session
+        verdict = scaler.evaluate(slis, len(replicas),
+                                  time.time() * 1000.0)
+        if verdict["action"] != "up":
+            # the scale-up pressure (if any) broke: a future queued
+            # verdict is a fresh episode worth a fresh event
+            self._autoscale_queued.discard(role)
+        if verdict["action"] == "hold":
+            return
+        ev = verdict["slis"]
+        pool_name = f"{role} pool" if role else "serving"
+        if verdict["action"] == "up":
+            chips = session.requests[C.SERVING_JOB_NAME].tpus
+            decision = self._autoscale_arbiter(chips, role=role)
+            if decision.action in ("queue", "reclaim"):
+                # neither verdict has freed chips YET: a reclaim
+                # shrinks elastic victims in place and the chips
+                # only exist once the registry shows them gone —
+                # deliver it and re-ask next pass, exactly like the
+                # preempt-then-re-ask flow. Event + warning on the
+                # EDGE into the blocked state only: under sustained
+                # overload this branch runs every monitor pass for
+                # hours, and per-pass duplicates would bloat
+                # history/timelines the way the alert engine's
+                # pending->firing dedup exists to prevent.
+                if role not in self._autoscale_queued:
+                    self._autoscale_queued.add(role)
+                    self.event_handler.emit(Event(
+                        EventType.AUTOSCALE_DECISION,
+                        AutoscaleDecision(
+                            C.SERVING_JOB_NAME, "up", len(replicas),
+                            len(replicas) + 1, chips=chips,
+                            arbiter_action=decision.action,
+                            victims=[a.app_id for a, _
+                                     in decision.reclaims],
+                            reason=verdict["reason"], role=role, **ev)))
+                    LOG.warning("autoscale up %s by the arbiter: %s",
+                                "waits on an elastic reclaim"
+                                if decision.action == "reclaim"
+                                else "blocked", decision.reason)
+                # the reclaim DELIVERY re-sends every pass (like the
+                # preempt branch re-executing each pass): a victim
+                # whose cooldown refused the first ask, or a
+                # transient RPC failure, must not stall the scale-up
+                # forever — in-flight resizes dedup as `duplicate`
+                if decision.reclaims:
+                    from tony_tpu.cluster.arbiter import \
+                        execute_reclaims
+                    execute_reclaims(
+                        decision.reclaims,
+                        grace_ms=self.conf.get_time_ms(
+                            K.ARBITER_GRACE_MS, 30_000),
+                        reason=f"reclaimed to scale "
+                               f"{self.app_id} {pool_name} to "
+                               f"{len(replicas) + 1} replicas",
+                        requested_by="autoscaler")
+                return      # no cooldown: re-ask next pass
+            self._autoscale_queued.discard(role)
+            self.event_handler.emit(Event(
+                EventType.AUTOSCALE_DECISION,
+                AutoscaleDecision(
+                    C.SERVING_JOB_NAME, "up", len(replicas),
+                    len(replicas) + 1, chips=chips,
+                    arbiter_action=decision.action,
+                    victims=[v.app_id for v in decision.victims],
+                    reason=verdict["reason"], role=role, **ev)))
+            if decision.victims:
+                from tony_tpu.cluster.arbiter import execute_preemption
+                grace = self.conf.get_time_ms(K.ARBITER_GRACE_MS,
+                                              30_000)
+                execute_preemption(
+                    decision.victims, grace_ms=grace,
+                    reason=f"preempted to scale {self.app_id} "
+                           f"{pool_name} to "
+                           f"{len(replicas) + 1} replicas",
+                    requested_by="autoscaler")
+            self._scale_serving_up(role)
+            scaler.note_scaled(time.time() * 1000.0)
+        else:
+            victim = self._scale_serving_down(role)
+            if victim is None:
+                return
+            self.event_handler.emit(Event(
+                EventType.AUTOSCALE_DECISION,
+                AutoscaleDecision(
+                    C.SERVING_JOB_NAME, "down", len(replicas),
+                    len(replicas) - 1,
+                    reason=verdict["reason"], role=role, **ev)))
+            scaler.note_scaled(time.time() * 1000.0)
+
+    def _autoscale_arbiter(self, chips: int, role: str = ""):
         """One replica's chip ask against the live fleet book: synced
         from the shared registry when one is configured (so the ask is
         judged against EVERY running job, and a preempt verdict can name
@@ -2053,25 +2112,32 @@ class ApplicationMaster(ClusterServiceHandler):
             self.conf, self.app_id, chips, fleet_summaries=summaries,
             queue=self.conf.get_str(K.APPLICATION_QUEUE, "default"),
             user=os.environ.get("USER", ""),
-            priority=self.conf.get_int(K.APPLICATION_PRIORITY, 0))
+            priority=self.conf.get_int(K.APPLICATION_PRIORITY, 0),
+            role=role or None)
 
-    def _scale_serving_up(self) -> Optional[Task]:
+    def _scale_serving_up(self, role: str = "") -> Optional[Task]:
         """Add one serving replica: append a task slot and request one
         container at the serving jobtype's priority (the allocation
         matches the unassigned slot through the same unique-priority
         path as a first launch). The new slot gets its OWN allocation
         clock (_check_scaleup_timeouts) — an optional extra replica
         that never allocates is abandoned, it must not re-arm the
-        application-fatal registration deadline."""
+        application-fatal registration deadline. A non-empty `role`
+        pins the replica to that disaggregation pool: the launch env
+        carries TONY_SERVING_ROLE so it boots straight into the pool
+        that asked for it (env beats the fleet-wide conf default)."""
         session = self.session
         with self._lock:
             task = session.add_task_instance(C.SERVING_JOB_NAME)
             if task is None:
                 return None
+            if role:
+                self._scaleup_roles[task.task_id] = role
             if self._alloc_timeout_ms > 0:
                 self._pending_scaleups[task.task_id] = (
                     time.monotonic() + self._alloc_timeout_ms / 1000.0)
-        LOG.info("autoscale: adding serving replica %s", task.task_id)
+        LOG.info("autoscale: adding serving replica %s%s", task.task_id,
+                 f" ({role} pool)" if role else "")
         self.scheduler.schedule_scale_up(C.SERVING_JOB_NAME)
         self._wake.set()
         return task
@@ -2102,13 +2168,21 @@ class ApplicationMaster(ClusterServiceHandler):
                 LOG.warning("autoscale: abandoning scale-up %s (no "
                             "allocation inside the window)", task_id)
 
-    def _scale_serving_down(self) -> Optional[Task]:
+    def _scale_serving_down(self, role: str = "") -> Optional[Task]:
         """Remove one serving replica: highest-index live replica is
         connection-drained (endpoint marked draining so the router stops
         new sends NOW; the container stop's SIGTERM has the engine
         finish in-flight work inside the term-grace window) and its
-        clean exit completes the slot."""
+        clean exit completes the slot. A non-empty `role` restricts the
+        victim to THAT disaggregation pool — a decode verdict must
+        never drain a prefill replica."""
         replicas = [t for t in self._serving_replicas() if t.container_id]
+        if role:
+            with self._lock:
+                roles = {tid: (rec.get("role") or "both")
+                         for tid, rec in self._serving_endpoints.items()}
+            replicas = [t for t in replicas
+                        if roles.get(t.task_id, "both") in (role, "both")]
         if len(replicas) <= 1:
             return None
         victim = max(replicas, key=lambda t: t.index)
@@ -2717,6 +2791,12 @@ class ApplicationMaster(ClusterServiceHandler):
         # training script.
         if task.job_name == C.SERVING_JOB_NAME:
             command = req.command or f"{sys.executable} -m tony_tpu.serve"
+            # a pool-pinned autoscale replica boots into the pool that
+            # asked for it (env beats tony.serving.role's fleet default)
+            with self._lock:
+                scaleup_role = self._scaleup_roles.get(task.task_id, "")
+            if scaleup_role:
+                env[C.SERVING_ROLE] = scaleup_role
         else:
             command = req.command \
                 or self.conf.get_str(K.TASK_COMMAND) \
@@ -3137,6 +3217,7 @@ class ApplicationMaster(ClusterServiceHandler):
                           "task_id": task_id, "url": rec["url"],
                           "generation": rec.get("generation", 0),
                           "draining": bool(rec.get("draining")),
+                          "role": rec.get("role", ""),
                           "status": ("DRAINING" if rec.get("draining")
                                      else "RUNNING")})
         return infos
@@ -3242,18 +3323,24 @@ class ApplicationMaster(ClusterServiceHandler):
             name, index = task_id, 0
         explicit_gen = int(req.get("weights_generation", 0) or 0)
         draining = bool(req.get("draining"))
+        role = str(req.get("role", "") or "")
         with self._lock:
             known = self._serving_endpoints.get(task_id)
             generation = explicit_gen or self._weights_generation
-            if draining and known is not None:
-                # a drain announcement keeps the recorded generation:
-                # the replica is going away, not changing weights
-                generation = known.get("generation", generation)
+            if known is not None:
+                if draining:
+                    # a drain announcement keeps the recorded generation:
+                    # the replica is going away, not changing weights
+                    generation = known.get("generation", generation)
+                # a re-registration without an explicit role keeps the
+                # recorded pool membership (drain asks omit it)
+                role = role or known.get("role", "")
             self._serving_endpoints[task_id] = {
                 "url": url, "generation": generation,
-                "draining": draining}
+                "draining": draining, "role": role}
         self.journal.append(J.REC_ENDPOINT, task_id=task_id, url=url,
-                            generation=generation, draining=draining)
+                            generation=generation, draining=draining,
+                            role=role)
         if draining:
             LOG.info("serving endpoint draining: %s (%s)", task_id, url)
             return {}
@@ -3266,6 +3353,26 @@ class ApplicationMaster(ClusterServiceHandler):
                 ServingEndpointRegistered(name, index, url)))
         return {}
 
+    def report_serving_migrated(self, req: dict) -> dict:
+        """Telemetry from a prefill-role replica: it handed `count`
+        request(s)' KV prefix + sampler state to the decode replica at
+        target_url over /v1/migrate. Emits SERVING_MIGRATED into job
+        history so operators can audit disaggregation traffic."""
+        task_id = str(req.get("task_id", ""))
+        target_url = str(req.get("target_url", ""))
+        if not task_id or not target_url:
+            return {}
+        name, _, idx = task_id.rpartition(":")
+        try:
+            index = int(idx)
+        except ValueError:
+            name, index = task_id, 0
+        count = max(1, int(req.get("count", 1) or 1))
+        self.event_handler.emit(Event(
+            EventType.SERVING_MIGRATED,
+            ServingMigrated(name, index, target_url, count)))
+        return {}
+
     # holds: _lock (callers mark drains under the AM lock)
     def _mark_endpoint_draining(self, task_id: str) -> None:
         rec = self._serving_endpoints.get(task_id)
@@ -3273,7 +3380,8 @@ class ApplicationMaster(ClusterServiceHandler):
             rec["draining"] = True
             self.journal.append(
                 J.REC_ENDPOINT, task_id=task_id, url=rec.get("url", ""),
-                generation=int(rec.get("generation", 0)), draining=True)
+                generation=int(rec.get("generation", 0)), draining=True,
+                role=rec.get("role", ""))
 
     def _drop_serving_endpoint(self, task_id: str) -> None:
         """A serving task completed: its endpoint leaves the set (the

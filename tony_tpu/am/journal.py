@@ -172,6 +172,7 @@ class RecoveredState:
                     "url": rec.get("url", ""),
                     "generation": int(rec.get("generation", 0)),
                     "draining": bool(rec.get("draining", False)),
+                    "role": rec.get("role", ""),
                 }
         elif rtype == REC_PREEMPTION:
             self.preemption = None if rec.get("cleared") else {

@@ -803,6 +803,50 @@ def arbiter(argv: list[str]) -> int:
     return 0
 
 
+def _router_status(url: str) -> int:
+    """One-shot fleet table off a running router's /v1/fleet bundle:
+    per replica — role, health state, queue/slots, paged-KV page
+    occupancy and prefix hit rate (from the cached /v1/load probes) —
+    plus the router's routing counters incl. prefix-affinity hit/miss."""
+    import json as _json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/v1/fleet",
+                                    timeout=5.0) as resp:
+            bundle = _json.loads(resp.read().decode("utf-8"))
+    except Exception as exc:  # noqa: BLE001 — operator-facing one-liner
+        print(f"router: /v1/fleet unreachable at {url}: {exc}",
+              file=sys.stderr)
+        return 1
+    print(f"{'ENDPOINT':<28} {'ROLE':<8} {'STATE':<9} {'QUEUE':>5} "
+          f"{'FREE':>4} {'KV-OCC%':>7} {'KV-HIT%':>7}")
+    for ep in bundle.get("endpoints") or []:
+        load = ep.get("load") or {}
+        occ = hit = "-"
+        total = float(load.get("kv_pages_total", 0) or 0)
+        if total > 0:
+            free = float(load.get("kv_pages_free", 0) or 0)
+            occ = f"{100.0 * (1.0 - free / total):.1f}"
+            hit = f"{float(load.get('kv_hit_rate_pct', 0) or 0):.1f}"
+        role = str(ep.get("role", "") or load.get("role", "") or "both")
+        print(f"{ep.get('url', ''):<28} {role:<8} "
+              f"{ep.get('state', '?'):<9} "
+              f"{int(load.get('queue_depth', 0) or 0):>5} "
+              f"{int(load.get('slots_free', 0) or 0):>4} "
+              f"{occ:>7} {hit:>7}")
+    stats = bundle.get("stats") or {}
+    hits = int(stats.get("affinity_hits", 0) or 0)
+    misses = int(stats.get("affinity_misses", 0) or 0)
+    routed = hits + misses
+    pct = f" ({100.0 * hits / routed:.1f}%)" if routed else ""
+    print(f"routed={stats.get('requests_routed', 0)} "
+          f"failed={stats.get('requests_failed', 0)} "
+          f"spillovers={stats.get('spillovers_429', 0)} "
+          f"affinity hits={hits} misses={misses}{pct}")
+    return 0
+
+
 def router(argv: list[str]) -> int:
     """`python -m tony_tpu.cli router <app_dir> [--port N]` (or
     `--endpoints url1,url2` standalone) — stand up the serving fleet
@@ -838,7 +882,13 @@ def router(argv: list[str]) -> int:
     parser.add_argument("--spillover-retries", type=int, default=-1,
                         help="429/5xx spill-over retries (-1 = "
                              "tony.serving.fleet.spillover-retries)")
+    parser.add_argument("--status", default="",
+                        help="one-shot: render a RUNNING router's "
+                             "/v1/fleet table (pass the router URL) "
+                             "and exit")
     args = parser.parse_args(argv)
+    if args.status:
+        return _router_status(args.status)
     if not args.app_dir and not args.endpoints:
         print("router: need an app_dir or --endpoints", file=sys.stderr)
         return 2

@@ -194,6 +194,12 @@ DEFAULTS = {
     K.SERVING_TOKEN_BUDGET: 2048,
     K.SERVING_QUEUE_DEPTH: 64,
     K.SERVING_PORT: 0,           # 0 = executor-assigned $SERVING_PORT
+    K.SERVING_ROLE: "both",      # "both" | "prefill" | "decode"
+    K.SERVING_MIGRATE_TO: "",    # "" = discover decode endpoints via AM
+    # paged prefix-shared KV cache (serve/kvcache.py)
+    K.SERVING_KV_PREFIX_SHARING: False,
+    K.SERVING_KV_PAGE_SIZE: 16,
+    K.SERVING_KV_PAGES: 0,       # 0 = auto-size from slots x budget
     # serving fleet router (serve/router.py)
     K.SERVING_FLEET_ROUTER_PORT: 0,           # 0 = ephemeral
     K.SERVING_FLEET_PROBE_TTL_MS: 500,
@@ -210,6 +216,7 @@ DEFAULTS = {
     K.AUTOSCALER_TTFT_P95_UP_MS: 0,           # 0 = signal disabled
     K.AUTOSCALER_QUEUE_DEPTH_UP: 8,
     K.AUTOSCALER_REJECT_RATE_UP_PCT: 1.0,
+    K.AUTOSCALER_ITL_P50_UP_MS: 0,            # 0 = signal disabled
     K.AUTOSCALER_OCCUPANCY_DOWN_PCT: 30,
     K.AUTOSCALER_HYSTERESIS_PASSES: 3,
     K.AUTOSCALER_COOLDOWN_MS: 60_000,

@@ -185,6 +185,25 @@ SERVING_QUEUE_DEPTH = "tony.serving.queue-depth"
 # explicit HTTP port; 0 = the executor-assigned rendezvous port
 # ($SERVING_PORT), so the cluster-spec entry is the live endpoint
 SERVING_PORT = "tony.serving.port"
+# disaggregated serving role: "both" (default, monolithic replica),
+# "prefill" (admission-heavy; hands decode off over /v1/migrate), or
+# "decode" (accepts /v1/migrate installs; excluded from /v1/generate
+# routing). Overridable per replica via $TONY_SERVING_ROLE.
+SERVING_ROLE = "tony.serving.role"
+# decode-replica base URLs (comma-separated) a prefill replica migrates
+# to; empty = discover decode-role endpoints from the AM endpoint set
+SERVING_MIGRATE_TO = "tony.serving.migrate-to"
+
+# --- serving paged KV cache (serve/kvcache.py): prefix sharing ----------
+# master switch: paged prefix-shared admission (OFF keeps the admission
+# path byte-identical to the pre-paging engine)
+SERVING_KV_PREFIX_SHARING = "tony.serving.kv.prefix-sharing"
+# tokens per KV page (the prefix-match granularity; capped at the token
+# budget)
+SERVING_KV_PAGE_SIZE = "tony.serving.kv.page-size"
+# device page-pool size incl. the reserved scratch page; 0 = auto
+# (1 + n_slots * token_budget / page_size — every slot can seal fully)
+SERVING_KV_PAGES = "tony.serving.kv.pages"
 
 # --- serving fleet (serve/router.py): one front door over N replicas ----
 # router HTTP port (0 = ephemeral); the router spreads /v1/generate
@@ -221,6 +240,10 @@ AUTOSCALER_MAX_REPLICAS = "tony.autoscaler.max-replicas"
 AUTOSCALER_TTFT_P95_UP_MS = "tony.autoscaler.ttft-p95-up-ms"
 AUTOSCALER_QUEUE_DEPTH_UP = "tony.autoscaler.queue-depth-up"
 AUTOSCALER_REJECT_RATE_UP_PCT = "tony.autoscaler.reject-rate-up-pct"
+# decode-pool up-signal for role-split (prefill/decode) fleets: fleet
+# ITL p50 ceiling in ms (0 disables). With roles present, TTFT burn
+# asks for prefill replicas while ITL/occupancy asks for decode ones.
+AUTOSCALER_ITL_P50_UP_MS = "tony.autoscaler.itl-p50-up-ms"
 # scale-down signal: mean slot occupancy below this (with an empty
 # queue and zero rejects) marks the fleet oversized
 AUTOSCALER_OCCUPANCY_DOWN_PCT = "tony.autoscaler.occupancy-down-pct"

@@ -53,6 +53,9 @@ SERVING_PORT = "SERVING_PORT"
 # weights rollout epoch a serving replica announces with its endpoint
 # (rolling updates; 0/absent = the AM stamps its current epoch)
 SERVING_WEIGHTS_GENERATION = "TONY_SERVING_WEIGHTS_GENERATION"
+# per-replica disaggregation role override ("prefill"|"decode"|"both");
+# absent = tony.serving.role from the frozen conf
+SERVING_ROLE = "TONY_SERVING_ROLE"
 
 # PyTorch (reference: Constants.java:50-54, Utils.parseClusterSpecForPytorch)
 INIT_METHOD = "INIT_METHOD"          # tcp://<worker0 host:port>

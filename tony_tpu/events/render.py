@@ -50,6 +50,13 @@ def _serving_endpoint(p: dict) -> str:
             f"{p.get('task_index', '?')} up at {p.get('url', '?')}")
 
 
+def _serving_migrated(p: dict) -> str:
+    n = p.get("count", 1) or 1
+    tail = f" ({n} requests)" if n != 1 else ""
+    return (f"prefill {p.get('task_type', '?')}:{p.get('task_index', '?')} "
+            f"migrated KV to decode at {p.get('target_url', '?')}{tail}")
+
+
 def _profile_captured(p: dict) -> str:
     return (f"profile {p.get('request_id', '?')} captured on "
             f"{p.get('task_type', '?')}:{p.get('task_index', '?')} "
@@ -228,6 +235,7 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.TASK_FINISHED: _task_finished,
     EventType.TASK_RELAUNCHED: _task_relaunched,
     EventType.SERVING_ENDPOINT_REGISTERED: _serving_endpoint,
+    EventType.SERVING_MIGRATED: _serving_migrated,
     EventType.PROFILE_CAPTURED: _profile_captured,
     EventType.SLO_VIOLATION: _slo_violation,
     EventType.DIAGNOSTICS_READY: _diagnostics_ready,

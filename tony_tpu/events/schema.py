@@ -23,6 +23,7 @@ class EventType(str, enum.Enum):
     TASK_FINISHED = "TASK_FINISHED"
     TASK_RELAUNCHED = "TASK_RELAUNCHED"
     SERVING_ENDPOINT_REGISTERED = "SERVING_ENDPOINT_REGISTERED"
+    SERVING_MIGRATED = "SERVING_MIGRATED"
     PROFILE_CAPTURED = "PROFILE_CAPTURED"
     SLO_VIOLATION = "SLO_VIOLATION"
     DIAGNOSTICS_READY = "DIAGNOSTICS_READY"
@@ -94,6 +95,19 @@ class ServingEndpointRegistered:
     task_type: str
     task_index: int
     url: str
+
+
+@dataclass
+class ServingMigrated:
+    """Prefill/decode disaggregation hand-off: a prefill-role serving
+    replica finished a request's prompt pass and shipped the KV prefix
+    + sampler state to a decode-role replica over /v1/migrate. History
+    carries it so operators can audit disaggregation traffic (which
+    prefill fed which decode, how often) after the fleet is gone."""
+    task_type: str
+    task_index: int
+    target_url: str
+    count: int = 1
 
 
 @dataclass
@@ -269,6 +283,7 @@ class AutoscaleDecision:
     queue_depth: float = 0.0
     reject_rate_pct: float = 0.0
     occupancy_pct: float = 0.0
+    role: str = ""              # disaggregation pool ("" = whole fleet)
 
 
 @dataclass
@@ -415,6 +430,7 @@ _PAYLOADS = {
     EventType.TASK_FINISHED: TaskFinished,
     EventType.TASK_RELAUNCHED: TaskRelaunched,
     EventType.SERVING_ENDPOINT_REGISTERED: ServingEndpointRegistered,
+    EventType.SERVING_MIGRATED: ServingMigrated,
     EventType.PROFILE_CAPTURED: ProfileCaptured,
     EventType.SLO_VIOLATION: SloViolation,
     EventType.DIAGNOSTICS_READY: DiagnosticsReady,
@@ -438,6 +454,7 @@ _PAYLOADS = {
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 TaskFinished, TaskRelaunched, ServingEndpointRegistered,
+                ServingMigrated,
                 ProfileCaptured, SloViolation, DiagnosticsReady,
                 StragglerDetected, StragglerCleared, AlertFiring,
                 AlertResolved, PreemptionRequested, Preempted, Resumed,

@@ -59,6 +59,23 @@ def _table(headers: list[str], rows: list[list[str]]) -> str:
     return f"<table><tr>{head}</tr>{body}</table>"
 
 
+def _probe_serving_load(url: str, timeout: float = 1.5) -> Optional[dict]:
+    """One bounded /v1/load probe against a serving replica (the same
+    snapshot the fleet router polls) for the job page's paged-KV panel.
+    Any failure degrades to None — a page render never blocks on a sick
+    replica."""
+    if not url:
+        return None
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/v1/load",
+                                    timeout=timeout) as resp:
+            load = json.loads(resp.read().decode("utf-8"))
+        return load if isinstance(load, dict) else None
+    except Exception:  # noqa: BLE001 — panel extras are best-effort
+        return None
+
+
 def _now_ms() -> int:
     import time
     return int(time.time() * 1000)
@@ -425,6 +442,22 @@ class _Handler(BaseHTTPRequestHandler):
                           exc_info=True)
             finally:
                 client.close()
+        if endpoints and source == "live":
+            # one short /v1/load probe per replica (capped — a page
+            # render must stay bounded on wide fleets) for the paged-KV
+            # panel: page occupancy + prefix hit rate + live role
+            for p in endpoints[:8]:
+                load = _probe_serving_load(p.get("url", ""))
+                if not load:
+                    continue
+                p["role"] = p.get("role") or str(load.get("role", ""))
+                total = float(load.get("kv_pages_total", 0) or 0)
+                if total > 0:
+                    free = float(load.get("kv_pages_free", 0) or 0)
+                    p["kv_occupancy_pct"] = round(
+                        100.0 * (1.0 - free / total), 1)
+                    p["kv_hit_rate_pct"] = float(
+                        load.get("kv_hit_rate_pct", 0.0) or 0.0)
         if not endpoints:
             by_task: dict[tuple, dict] = {}
             for ev in self.cache.get_events(job_id):
@@ -1164,6 +1197,15 @@ class _Handler(BaseHTTPRequestHandler):
             badge = ""
             if p.get("draining"):
                 badge = ' <b style="color:#c0392b">[DRAINING]</b>'
+            role = str(p.get("role", "") or "")
+            if role and role != "both":
+                badge = (f' <b style="color:#2471a3">'
+                         f'[{html.escape(role.upper())}]</b>') + badge
+            if p.get("kv_occupancy_pct") is not None:
+                badge += (f" — KV pages "
+                          f"{float(p['kv_occupancy_pct']):g}% full, "
+                          f"prefix hit rate "
+                          f"{float(p.get('kv_hit_rate_pct', 0)):g}%")
             gen = int(p.get("generation", 0) or 0)
             gen_txt = f" (weights gen {gen})" if gen > 0 else ""
             if proxy:

@@ -175,19 +175,36 @@ class ClusterServiceClient(_JsonRpcClient):
 
     def register_serving_endpoint(self, task_id: str, url: str,
                                   weights_generation: int = 0,
-                                  draining: bool = False) -> None:
+                                  draining: bool = False,
+                                  role: str = "") -> None:
         """A serving task announces its live HTTP endpoint (serve/):
         recorded by the AM in history + task infos. `weights_generation`
         stamps the rollout epoch this replica serves (0 = the AM's
         current epoch); `draining=True` re-registers the endpoint as
         connection-draining (relaunch/preemption ahead) so the fleet
-        router stops routing new requests to it."""
+        router stops routing new requests to it; `role` names the
+        disaggregation pool ("prefill"/"decode"/"both", empty = both)
+        so router and autoscaler can treat the pools independently."""
         req = {"task_id": task_id, "url": url}
         if weights_generation > 0:
             req["weights_generation"] = int(weights_generation)
         if draining:
             req["draining"] = True
+        if role:
+            req["role"] = str(role)
         self.call("register_serving_endpoint", req)
+
+    def report_serving_migrated(self, task_id: str, target_url: str,
+                                count: int = 1) -> None:
+        """Telemetry: this prefill replica handed `count` request(s)'
+        KV prefix + sampler state to the decode replica at target_url
+        (/v1/migrate). The AM emits SERVING_MIGRATED into job history.
+        Fire-and-forget: one attempt, short timeout — a lost report
+        only costs an event line."""
+        self.call("report_serving_migrated",
+                  {"task_id": task_id, "target_url": target_url,
+                   "count": int(count)},
+                  retries=1, timeout_sec=5.0, wait_for_ready=False)
 
     def request_rolling_update(self, generation: int = 0,
                                requested_by: str = "operator") -> dict:

@@ -49,6 +49,7 @@ CLUSTER_METHODS = (
     "request_preemption",
     "request_rolling_update",
     "request_resize",
+    "report_serving_migrated",
 )
 METRICS_METHODS = ("update_metrics",)
 TASK_LOG_METHODS = ("read_log",)
@@ -99,14 +100,17 @@ class ClusterServiceHandler(abc.ABC):
 
     @abc.abstractmethod
     def register_serving_endpoint(self, req: dict) -> dict:
-        """req: {task_id, url, weights_generation?, draining?} -> {}. A
+        """req: {task_id, url, weights_generation?, draining?, role?}
+        -> {}. A
         serving task's HTTP frontend came up at `url` (or, with
         draining=true, announced it is connection-draining ahead of a
         relaunch/preemption); the AM records it (history event + task
         infos) so the portal/proxy/fleet router can reach — or route
         around — the endpoint. weights_generation stamps the weight
         rollout epoch this replica serves (0 = the AM's current
-        epoch)."""
+        epoch). role names the disaggregation pool this replica works
+        in ("prefill" | "decode" | "both"; empty = both) so the
+        router/autoscaler can treat the pools independently."""
 
     @abc.abstractmethod
     def register_execution_result(self, req: dict) -> dict:
@@ -207,6 +211,15 @@ class ClusterServiceHandler(abc.ABC):
         profiler trace for N steps; the ask rides the task's next
         heartbeat. Idempotent: a second request while one is in flight
         for the same task returns the in-flight request_id."""
+
+    def report_serving_migrated(self, req: dict) -> dict:
+        """req: {task_id, target_url, count?} -> {}. A prefill replica
+        handed a request's KV prefix + sampler state to a decode
+        replica at target_url over /v1/migrate; the AM records the
+        hand-off in job history (SERVING_MIGRATED) so operators can see
+        disaggregation traffic. Non-abstract with a no-op default: the
+        verb is telemetry-only and older handler stubs keep working."""
+        return {}
 
 
 class MetricsServiceHandler(abc.ABC):

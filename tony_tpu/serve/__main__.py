@@ -64,6 +64,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="weights rollout epoch this replica serves "
                         "(0 = $TONY_SERVING_WEIGHTS_GENERATION, else "
                         "the AM stamps its current epoch)")
+    p.add_argument("--role", default="",
+                   choices=("", "both", "prefill", "decode"),
+                   help="disaggregated serving role "
+                        "('' = $TONY_SERVING_ROLE, else tony.serving.role)")
+    p.add_argument("--migrate-to", default="",
+                   help="comma-separated decode-replica base URLs a "
+                        "prefill replica hands decode work to "
+                        "('' = tony.serving.migrate-to)")
+    p.add_argument("--prefix-sharing", default="",
+                   choices=("", "on", "off"),
+                   help="paged prefix-shared KV admission "
+                        "('' = tony.serving.kv.prefix-sharing)")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="tokens per KV page "
+                        "(0 = tony.serving.kv.page-size)")
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="device page-pool size incl. scratch "
+                        "(0 = tony.serving.kv.pages, 0 = auto)")
     return p
 
 
@@ -108,7 +126,7 @@ def _load_model(args):
 
 
 def _register_endpoint(url: str, env, weights_generation: int = 0,
-                       draining: bool = False) -> None:
+                       draining: bool = False, role: str = "") -> None:
     """Tell the AM where this server listens — or, with draining=True,
     that it is connection-draining ahead of shutdown, so the fleet
     router stops new sends (no-op outside the orchestrator). Same
@@ -130,13 +148,43 @@ def _register_endpoint(url: str, env, weights_generation: int = 0,
     try:
         client.register_serving_endpoint(
             task_id, url, weights_generation=weights_generation,
-            draining=draining)
+            draining=draining, role=role)
         LOG.info("registered serving endpoint %s with the AM%s", url,
                  " (draining)" if draining else "")
     except Exception:  # noqa: BLE001 — registration is observability
         LOG.exception("failed to register serving endpoint")
     finally:
         client.close()
+
+
+def _migrated_reporter(env):
+    """Hook(target_url) for the frontend: report each prefill→decode
+    handoff to the AM (SERVING_MIGRATED event on the job page) without
+    ever blocking the relay path. None outside the orchestrator."""
+    from tony_tpu import constants as C
+    host, port = env.get(C.AM_HOST), env.get(C.AM_PORT)
+    if not host or not port:
+        return None
+    from tony_tpu.rpc.client import ClusterServiceClient
+    from tony_tpu.security.tokens import TOKEN_ENV
+    task_id = f"{env.get(C.JOB_NAME, 'serving')}:{env.get(C.TASK_INDEX, '0')}"
+    token = env.get(TOKEN_ENV) or None
+
+    def report(target_url: str) -> None:
+        def _send() -> None:
+            client = ClusterServiceClient(
+                host, int(port), auth_token=token,
+                task_auth_id=task_id if token else None, retries=1)
+            try:
+                client.report_serving_migrated(task_id, target_url)
+            except Exception:  # noqa: BLE001 — observability only
+                LOG.debug("report_serving_migrated failed", exc_info=True)
+            finally:
+                client.close()
+        threading.Thread(target=_send, name="migrate-report",
+                         daemon=True).start()
+
+    return report
 
 
 def main(argv=None) -> int:
@@ -171,6 +219,21 @@ def main(argv=None) -> int:
 
     weights_generation = args.weights_generation \
         or int(env.get(C.SERVING_WEIGHTS_GENERATION, "0") or 0)
+    # disaggregation role: flag > $TONY_SERVING_ROLE > tony.serving.role —
+    # the per-replica env override is how the AM's role-split autoscaler
+    # steers a scaled-up instance into the thinner pool
+    role = args.role or env.get(C.SERVING_ROLE, "") \
+        or conf.get(K.SERVING_ROLE, "both") or "both"
+    if args.prefix_sharing:
+        prefix_sharing = args.prefix_sharing == "on"
+    else:
+        prefix_sharing = conf.get_bool(K.SERVING_KV_PREFIX_SHARING, False)
+    kv_page_size = args.kv_page_size \
+        or conf.get_int(K.SERVING_KV_PAGE_SIZE, 16)
+    kv_pages = args.kv_pages or conf.get_int(K.SERVING_KV_PAGES, 0)
+    migrate_to = args.migrate_to or conf.get(K.SERVING_MIGRATE_TO, "") or ""
+    migrate_targets = [u.strip() for u in migrate_to.split(",")
+                       if u.strip()]
     from tony_tpu.serve.engine import ContinuousBatchingEngine
     from tony_tpu.serve.frontend import ServeFrontend
     engine = ContinuousBatchingEngine(
@@ -179,7 +242,9 @@ def main(argv=None) -> int:
         top_k=args.top_k, top_p=args.top_p,
         eos_id=args.eos_id if args.eos_id >= 0 else None,
         quant_cache=args.quant_cache,
-        weights_generation=weights_generation)
+        weights_generation=weights_generation,
+        prefix_sharing=prefix_sharing, kv_page_size=kv_page_size,
+        kv_pages=kv_pages, role=role)
     # per-request trace spans: each finished request becomes a
     # `serve_request` span (queue_wait/prefill/decode attrs) on the same
     # job waterfall the trainer's phases render into. Only when a trace
@@ -213,7 +278,9 @@ def main(argv=None) -> int:
         engine.on_request_finished = _record_request_span
 
     engine.start()
-    frontend = ServeFrontend(engine, port=port, host=args.host)
+    frontend = ServeFrontend(engine, port=port, host=args.host,
+                             migrate_targets=migrate_targets,
+                             on_migrated=_migrated_reporter(env))
     frontend.start()
 
     from tony_tpu.utils.common import current_host
@@ -221,7 +288,8 @@ def main(argv=None) -> int:
     # log-ok: greppable bring-up marker on RAW stdout (e2e tests + bench
     # drivers grep for it; it must not be wrapped in a JSON log line)
     print(f"SERVING_UP {url}", flush=True)
-    _register_endpoint(url, env, weights_generation=weights_generation)
+    _register_endpoint(url, env, weights_generation=weights_generation,
+                       role=role)
 
     from tony_tpu.train.metrics import ServingMetricsReporter
     reporter = ServingMetricsReporter(
@@ -250,7 +318,7 @@ def main(argv=None) -> int:
         engine.begin_drain()
         _register_endpoint(url, env,
                            weights_generation=weights_generation,
-                           draining=True)
+                           draining=True, role=role)
         drain_s = conf.get_time_ms(K.SERVING_FLEET_DRAIN_TIMEOUT_MS,
                                    10_000) / 1000.0
         if not engine.wait_drained(drain_s):

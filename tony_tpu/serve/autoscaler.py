@@ -41,6 +41,7 @@ class AutoscalerConfig:
     min_replicas: int = 1
     max_replicas: int = 4
     ttft_p95_up_ms: float = 0.0        # scale up when TTFT p95 exceeds
+    itl_p50_up_ms: float = 0.0         # ... or inter-token latency exceeds
     queue_depth_up: float = 8.0        # ... or per-replica queue exceeds
     reject_rate_up_pct: float = 1.0    # ... or 429 rate (windowed) exceeds
     occupancy_down_pct: float = 30.0   # scale down below this occupancy
@@ -54,6 +55,8 @@ class AutoscalerConfig:
             max_replicas=conf.get_int(K.AUTOSCALER_MAX_REPLICAS, 4),
             ttft_p95_up_ms=float(
                 conf.get_time_ms(K.AUTOSCALER_TTFT_P95_UP_MS, 0)),
+            itl_p50_up_ms=float(
+                conf.get_time_ms(K.AUTOSCALER_ITL_P50_UP_MS, 0)),
             queue_depth_up=float(
                 conf.get_int(K.AUTOSCALER_QUEUE_DEPTH_UP, 8)),
             reject_rate_up_pct=conf.get_float(
@@ -119,6 +122,7 @@ class ReplicaAutoscaler:
         cfg = self.config
         reject_pct = self.reject_rate_pct(slis)
         ttft_ms = float(slis.get("ttft_p95_s", 0) or 0) * 1000.0
+        itl_ms = float(slis.get("itl_p50_ms", 0) or 0)
         queue_per_replica = (float(slis.get("queue_depth", 0) or 0)
                              / max(1, replicas))
         occupancy = float(slis.get("occupancy_pct", 0) or 0)
@@ -131,6 +135,9 @@ class ReplicaAutoscaler:
         if cfg.ttft_p95_up_ms > 0 and ttft_ms > cfg.ttft_p95_up_ms:
             up_reasons.append(
                 f"ttft_p95 {ttft_ms:.0f}ms > {cfg.ttft_p95_up_ms:.0f}ms")
+        if cfg.itl_p50_up_ms > 0 and itl_ms > cfg.itl_p50_up_ms:
+            up_reasons.append(
+                f"itl_p50 {itl_ms:.1f}ms > {cfg.itl_p50_up_ms:.0f}ms")
         if cfg.queue_depth_up > 0 and queue_per_replica > cfg.queue_depth_up:
             up_reasons.append(
                 f"queue/replica {queue_per_replica:.1f} > "
@@ -173,7 +180,9 @@ class ReplicaAutoscaler:
 
 def aggregate_serving_slis(latest_gauges: dict,
                            job_name: str = "serving",
-                           live_task_ids: Optional[set] = None
+                           live_task_ids: Optional[set] = None,
+                           roles: Optional[dict] = None,
+                           role: Optional[str] = None
                            ) -> Optional[dict]:
     """Fold the per-replica SERVING_* gauges (MetricsStore
     latest_gauges(): task_id -> {metric: value}) into the fleet SLI
@@ -182,20 +191,32 @@ def aggregate_serving_slis(latest_gauges: dict,
     CURRENT replica set — the store keeps a completed task's last
     gauges forever, and a scaled-down replica's dying snapshot (idle
     occupancy, stale TTFT tail) must not keep skewing every later
-    verdict."""
-    ttft, queues, occ, sub, rej = [], [], [], 0.0, 0.0
+    verdict.
+
+    Disaggregated fleets (prefill/decode roles): pass `roles`
+    (task_id -> role from the AM's endpoint records) and `role` to fold
+    ONLY that pool's replicas — a prefill pool's verdict must not be
+    polluted by decode-side occupancy and vice versa. A replica whose
+    role is unknown/"both" counts toward every pool."""
+    ttft, itl, queues, occ, sub, rej = [], [], [], [], 0.0, 0.0
     seen = False
     for task_id, gauges in latest_gauges.items():
         if not task_id.startswith(f"{job_name}:"):
             continue
         if live_task_ids is not None and task_id not in live_task_ids:
             continue
+        if role:
+            r = (roles or {}).get(task_id, "") or "both"
+            if r not in (role, "both"):
+                continue
         if "SERVING_QUEUE_DEPTH" not in gauges \
                 and "SERVING_TOKENS_PER_SEC" not in gauges:
             continue
         seen = True
         if gauges.get("SERVING_TTFT_P95_S") is not None:
             ttft.append(float(gauges["SERVING_TTFT_P95_S"]))
+        if gauges.get("SERVING_ITL_P50_MS") is not None:
+            itl.append(float(gauges["SERVING_ITL_P50_MS"]))
         queues.append(float(gauges.get("SERVING_QUEUE_DEPTH", 0) or 0))
         occ.append(float(gauges.get("SERVING_SLOT_OCCUPANCY_PCT", 0)
                          or 0))
@@ -205,6 +226,7 @@ def aggregate_serving_slis(latest_gauges: dict,
         return None
     return {
         "ttft_p95_s": max(ttft) if ttft else 0.0,
+        "itl_p50_ms": max(itl) if itl else 0.0,
         "queue_depth": sum(queues),
         "occupancy_pct": sum(occ) / len(occ) if occ else 0.0,
         "submitted_total": sub,
@@ -215,15 +237,20 @@ def aggregate_serving_slis(latest_gauges: dict,
 def replica_ask_verdict(conf, app_id: str, chips: int,
                         fleet_summaries: Optional[list] = None,
                         queue: str = "default", user: str = "",
-                        priority: int = 0):
+                        priority: int = 0, role: Optional[str] = None):
     """One replica's chip ask through the PR-10 arbiter. Returns the
     (pure) Decision; the caller executes preemption / launches. With
     chips == 0 (CPU/dev fleets) the ask trivially admits — the arbiter
-    is authoritative only where chips are modeled."""
+    is authoritative only where chips are modeled. `role` names the
+    disaggregation pool asking (prefill/decode) so the two pools' asks
+    are distinct book entries — a queued prefill ask must not shadow a
+    decode ask, and vice versa."""
     from tony_tpu.cluster.arbiter import Arbiter, GangAsk
     arb = Arbiter.from_conf(conf)
     if fleet_summaries:
         arb.sync_from_fleet(fleet_summaries)
-    ask = GangAsk(app_id=f"{app_id}/serving-scaleup", chips=max(0, chips),
+    suffix = f"-{role}" if role else ""
+    ask = GangAsk(app_id=f"{app_id}/serving-scaleup{suffix}",
+                  chips=max(0, chips),
                   queue=queue, user=user, priority=priority)
     return arb.decide(ask)

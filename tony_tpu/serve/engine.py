@@ -33,6 +33,23 @@ offline greedy. Temperature/top-k/top-p are engine-wide settings (one
 compiled step, not per-request variants); sampled streams draw per-step
 keys and are reproducible per (seed, admission order) but intentionally
 not pinned against the offline oracle.
+
+Prefix sharing (`prefix_sharing=True`, serve/kvcache.py): admission
+first gathers any radix-indexed prefix pages into the slot row
+on-device, then prefills ONLY the unmatched suffix (`_admit_step`'s
+start operand), and seals the newly computed complete blocks back into
+the page pool for the next sharer. Decode is untouched — same one
+persistent step, zero recompiles after warmup. With sharing OFF
+(default) the admission path is byte-identical to the pre-paging
+engine; with sharing ON, greedy token streams are pinned identical
+ON-vs-OFF by tests/test_kvcache.py.
+
+Disaggregation: `role="prefill"` engines admit with `migrate_out=True`
+and, instead of decoding, extract the slot's computed K/V + sampler
+state into `handle.migration` (finish_reason "migrated"); a
+`role="decode"` engine installs it via `submit_migration()` and decodes
+from the exact transplanted bytes — greedy across a migrate is
+bit-identical to decoding locally.
 """
 
 from __future__ import annotations
@@ -56,6 +73,7 @@ from tony_tpu.models.generate import (
     _sample, _warn_moe_below_capacity, decode_step, prefill,
 )
 from tony_tpu.models.llama import LlamaConfig, Params
+from tony_tpu.serve import kvcache as kvc
 
 LOG = logging.getLogger(__name__)
 
@@ -88,7 +106,16 @@ class RequestHandle:
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.tokens: list[int] = []
-        self.finish_reason: Optional[str] = None   # "eos"|"length"|"shutdown"
+        # "eos"|"length"|"shutdown"|"cancelled"|"migrated"
+        self.finish_reason: Optional[str] = None
+        # disaggregation state: migrate_out marks a prefill-role request
+        # whose decode is handed off; on finish_reason "migrated",
+        # `migration` holds {"meta", "leaves"} for pack_migration. On the
+        # decode side, `install` carries the unpacked payload until the
+        # stepper installs it into a slot.
+        self.migrate_out = False
+        self.migration: Optional[dict] = None
+        self.install: Optional[dict] = None
         self.submitted_at = time.monotonic()
         self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
@@ -194,6 +221,10 @@ class EngineStats:
         default_factory=lambda: collections.deque(maxlen=512))
     prefill_s: collections.deque = field(
         default_factory=lambda: collections.deque(maxlen=512))
+    # disaggregation counters: requests handed off to a decode replica
+    # (prefill role) / adopted from a prefill replica (decode role)
+    migrated_out: int = 0
+    migrated_in: int = 0
 
 
 def _percentile(samples, q: float) -> Optional[float]:
@@ -236,23 +267,36 @@ def _decode_sample_step(params: Params, config: LlamaConfig, cache,
 
 
 @partial(jax.jit, static_argnames=("config", "temperature", "top_k",
-                                   "top_p", "quant_cache"),
+                                   "top_p", "quant_cache", "shared"),
          donate_argnames=("cache",))
 def _admit_step(params: Params, config: LlamaConfig, cache,
                 prompt: jax.Array, slot: jax.Array, key: jax.Array,
                 temperature: float, top_k: int, top_p: float,
-                quant_cache: bool):
-    """Admission: prefill one prompt (batch 1) at the full token budget and
-    dynamic_update_slice its K/V (+ scales when int8) into the shared
-    cache's `slot` row. Returns (first sampled token, cache). One compile
-    per distinct prompt length — the slot index is data."""
-    cache_len = cache["k"].shape[3]
-    logits, pc = prefill(params, prompt[None, :], config, cache_len,
-                         quant_cache=quant_cache)
-    out = {}
-    for name, arr in cache.items():
-        row = pc[name].astype(arr.dtype)               # (L, 1, Hkv, S, d)
-        out[name] = lax.dynamic_update_slice_in_dim(arr, row, slot, axis=1)
+                quant_cache: bool, start: jax.Array, shared: bool = False):
+    """Admission: prefill one prompt (batch 1) and write its K/V (+ scales
+    when int8) into the shared cache's `slot` row. Returns (first sampled
+    token, cache). One compile per distinct prompt length — the slot index
+    is data.
+
+    shared=False (the default engine path) is byte-identical to the
+    pre-paging admission: full flash prefill of the whole prompt; `start`
+    is an unused traced scalar. shared=True is the paged path: `prompt`
+    is only the UNMATCHED SUFFIX, `start` the number of prefix tokens
+    whose K/V the page gather already placed in rows [0, start) — the
+    suffix prefill attends to them and writes rows [start, start+W).
+    One compile per distinct suffix length."""
+    if shared:
+        logits, out = kvc.prefill_suffix(params, config, cache, prompt,
+                                         start, slot, quant_cache)
+    else:
+        cache_len = cache["k"].shape[3]
+        logits, pc = prefill(params, prompt[None, :], config, cache_len,
+                             quant_cache=quant_cache)
+        out = {}
+        for name, arr in cache.items():
+            row = pc[name].astype(arr.dtype)           # (L, 1, Hkv, S, d)
+            out[name] = lax.dynamic_update_slice_in_dim(arr, row, slot,
+                                                        axis=1)
     tok0 = _sample(logits, temperature, top_k, key, top_p)[0]
     return tok0, out
 
@@ -287,7 +331,9 @@ class ContinuousBatchingEngine:
                  top_k: int = 0, top_p: float = 1.0,
                  eos_id: Optional[int] = None, quant_cache: bool = False,
                  seed: int = 0, queue_token_budget: int = 0,
-                 weights_generation: int = 0):
+                 weights_generation: int = 0,
+                 prefix_sharing: bool = False, kv_page_size: int = 16,
+                 kv_pages: int = 0, role: str = "both"):
         if token_budget <= 0:
             token_budget = config.max_seq
         if token_budget > config.max_seq:
@@ -312,7 +358,22 @@ class ContinuousBatchingEngine:
         self.top_p = top_p
         self.eos_id = eos_id
         self.quant_cache = quant_cache
+        # disaggregated serving role: "prefill" replicas migrate decode
+        # work out after admission, "decode" replicas accept /v1/migrate
+        # installs, "both" (default) is the classic monolithic replica
+        self.role = role if role in ("prefill", "decode", "both") else "both"
         self._cache = self._empty_cache()
+        # paged prefix-shared KV pool (serve/kvcache.py); None = sharing
+        # OFF, which keeps the admission path byte-identical to the
+        # pre-paging engine
+        self.kv_pool: Optional[kvc.KVPagePool] = None
+        if prefix_sharing:
+            self.kv_pool = kvc.KVPagePool(
+                config, token_budget=self.token_budget,
+                page_size=kv_page_size if kv_page_size > 0 else 16,
+                n_pages=kv_pages, n_slots=n_slots,
+                quant_cache=quant_cache)
+        self.prefix_sharing = self.kv_pool is not None
         self._key = jax.random.PRNGKey(seed)
         # host mirrors of the per-slot device state; re-uploaded per step
         # (a (B,) int32 H2D per token — noise next to the decode itself)
@@ -359,11 +420,16 @@ class ContinuousBatchingEngine:
                 "v": jnp.zeros(shape, c.dtype)}
 
     # -- intake ---------------------------------------------------------
-    def submit(self, prompt: list[int],
-               max_new_tokens: int) -> RequestHandle:
+    def submit(self, prompt: list[int], max_new_tokens: int,
+               migrate_out: bool = False) -> RequestHandle:
         """Enqueue a request. Raises BudgetExceededError when it can never
         fit a slot, QueueFullError when the bounded queue (or its token
-        budget) is full — the backpressure the frontend turns into 429."""
+        budget) is full — the backpressure the frontend turns into 429.
+
+        migrate_out=True (prefill-role frontends): after admission
+        computes the prompt K/V and first token, the request finishes
+        with reason "migrated" and `handle.migration` carries the
+        decode handoff payload instead of decoding locally."""
         if max_new_tokens < 1:
             raise BudgetExceededError("max_new_tokens must be >= 1")
         if not prompt:
@@ -399,6 +465,70 @@ class ContinuousBatchingEngine:
             self.stats.requests_submitted += 1
             handle = RequestHandle(next(self._next_id), list(prompt),
                                    max_new_tokens)
+            handle.migrate_out = bool(migrate_out)
+            self._pending.append(handle)
+            self._pending_tokens += need
+            self.stats.queue_depth_max = max(self.stats.queue_depth_max,
+                                             len(self._pending))
+        self._work.set()
+        return handle
+
+    def submit_migration(self, meta: dict,
+                         leaves: dict[str, np.ndarray]) -> RequestHandle:
+        """Adopt a migrated request from a prefill replica: validate the
+        K/V payload against this engine's cache layout and enqueue it;
+        the stepper installs it into a slot with `install_rows` (no
+        prefill is ever paid here). Same backpressure contract as
+        submit() — 400/429/503 mapping is identical."""
+        prompt = [int(t) for t in meta.get("prompt") or []]
+        max_new = int(meta.get("max_new_tokens", 0))
+        pos = int(meta.get("pos", -1))
+        tok0 = int(meta.get("tok0", -1))
+        if not prompt or max_new < 1:
+            raise BudgetExceededError("invalid migration metadata")
+        if pos != len(prompt):
+            raise BudgetExceededError(
+                f"migration pos {pos} != prompt length {len(prompt)}")
+        need = len(prompt) + max_new
+        if need > self.token_budget:
+            raise BudgetExceededError(
+                f"migrated prompt {len(prompt)} + max_new {max_new} "
+                f"exceeds the per-slot token budget {self.token_budget}")
+        if set(leaves) != set(self._cache):
+            raise BudgetExceededError(
+                f"migration cache layout mismatch: payload "
+                f"{sorted(leaves)}, serving {sorted(self._cache)}")
+        for name, arr in self._cache.items():
+            l, _, h, _, d = arr.shape
+            leaf = leaves[name]
+            if tuple(leaf.shape) != (l, h, pos, d):
+                raise BudgetExceededError(
+                    f"migration leaf {name} shape {tuple(leaf.shape)} != "
+                    f"{(l, h, pos, d)}")
+            if leaf.dtype != arr.dtype:
+                raise BudgetExceededError(
+                    f"migration leaf {name} dtype {leaf.dtype} != "
+                    f"{arr.dtype}")
+        if self._draining.is_set():
+            raise DrainingError("engine is draining")
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("engine is stopped")
+            if len(self._pending) >= self.queue_depth:
+                self.stats.requests_rejected += 1
+                raise QueueFullError(
+                    f"request queue full ({self.queue_depth} pending)")
+            if self._pending_tokens + need > self.queue_token_budget:
+                self.stats.requests_rejected += 1
+                raise QueueFullError(
+                    f"queued token budget exhausted "
+                    f"({self._pending_tokens} of "
+                    f"{self.queue_token_budget} tokens pending)")
+            self.stats.requests_submitted += 1
+            handle = RequestHandle(next(self._next_id), prompt, max_new)
+            handle.install = {"pos": pos, "tok0": tok0,
+                              "emitted": int(meta.get("emitted", 1)),
+                              "leaves": leaves}
             self._pending.append(handle)
             self._pending_tokens += need
             self.stats.queue_depth_max = max(self.stats.queue_depth_max,
@@ -460,14 +590,25 @@ class ContinuousBatchingEngine:
         reads are atomic under the GIL; the hot path gains nothing to
         contend with)."""
         active = sum(1 for s in self._slots if s.handle is not None)
-        return {
+        load = {
             "queue_depth": len(self._pending),
             "slots_free": max(0, self.n_slots - active),
             "active_slots": active,
             "n_slots": self.n_slots,
             "draining": self._draining.is_set(),
             "weights_generation": self.weights_generation,
+            "role": self.role,
+            "token_budget": self.token_budget,
         }
+        pool = self.kv_pool
+        if pool is not None:
+            # page-pool headroom + advertised prefix hashes: the router's
+            # affinity source AND the load-score fix — a replica with
+            # free slots but an exhausted (all-pinned) pool must not look
+            # idle (pool fields are plain ints / an atomically-swapped
+            # tuple, so this stays lock-free)
+            load.update(pool.load_fields())
+        return load
 
     # -- stepping -------------------------------------------------------
     def step(self) -> bool:
@@ -522,7 +663,10 @@ class ContinuousBatchingEngine:
                 handle._finish("cancelled", time.monotonic())
                 admitted = True
                 continue
-            self._admit(free, handle)
+            if handle.install is not None:
+                self._admit_migrated(free, handle)
+            else:
+                self._admit(free, handle)
             admitted = True
 
     def _admit(self, slot: _Slot, handle: RequestHandle) -> None:
@@ -532,12 +676,54 @@ class ContinuousBatchingEngine:
         t_dequeue = time.monotonic()
         handle.queue_wait_s = t_dequeue - handle.submitted_at
         self._key, req_key = jax.random.split(self._key)
-        prompt = jnp.asarray(handle.prompt, jnp.int32)
-        tok0_dev, self._cache = _admit_step(
-            self.params, self.config, self._cache, prompt,
-            jnp.int32(slot.index), req_key, self.temperature, self.top_k,
-            self.top_p, self.quant_cache)
+        pool = self.kv_pool
+        start = 0
+        depth = 0
+        hashes: list[str] = []
+        pinned: Optional[str] = None
+        if pool is not None:
+            # paged admission: gather the longest indexed prefix into the
+            # slot row, prefill only the suffix. The match is capped so at
+            # least one suffix token remains to produce the logits.
+            hashes = kvc.chain_hashes(handle.prompt, pool.page_size)
+            usable = (len(handle.prompt) - 1) // pool.page_size
+            page_ids, depth = pool.match(hashes[:usable])
+            if depth:
+                pinned = hashes[depth - 1]
+                table = np.full((pool.blocks_per_slot,),
+                                kvc.SCRATCH_PAGE, np.int32)
+                table[:depth] = page_ids
+                self._cache = kvc.gather_pages(
+                    self._cache, pool.pool, jnp.asarray(table),
+                    jnp.int32(slot.index))
+                start = depth * pool.page_size
+            suffix = jnp.asarray(handle.prompt[start:], jnp.int32)
+            tok0_dev, self._cache = _admit_step(
+                self.params, self.config, self._cache, suffix,
+                jnp.int32(slot.index), req_key, self.temperature,
+                self.top_k, self.top_p, self.quant_cache,
+                jnp.int32(start), True)
+        else:
+            prompt = jnp.asarray(handle.prompt, jnp.int32)
+            tok0_dev, self._cache = _admit_step(
+                self.params, self.config, self._cache, prompt,
+                jnp.int32(slot.index), req_key, self.temperature,
+                self.top_k, self.top_p, self.quant_cache, jnp.int32(0),
+                False)
         tok0 = int(jax.device_get(tok0_dev))
+        if pool is not None:
+            # the slot now holds the full prompt K/V: seal the complete
+            # blocks the index lacks so the NEXT sharer hits, then
+            # release the admission pin and account the reuse
+            self._seal_prefix(slot, handle, hashes, depth)
+            if pinned is not None:
+                pool.unpin(pinned)
+            pool.hit_tokens += start
+            pool.miss_tokens += len(handle.prompt) - start
+            if start:
+                pool.req_hits += 1
+            else:
+                pool.req_misses += 1
         now = time.monotonic()
         handle.prefill_s = now - t_dequeue
         handle.admitted_at = now
@@ -556,7 +742,110 @@ class ContinuousBatchingEngine:
         LOG.debug("admitted request %d into slot %d (prompt %d, max_new "
                   "%d)", handle.request_id, slot.index, len(handle.prompt),
                   handle.max_new_tokens)
+        if handle.migrate_out:
+            done = ((self.eos_id is not None and tok0 == self.eos_id)
+                    or handle.max_new_tokens <= 1)
+            if not done:
+                # hand the decode off: extract the slot's K/V rows
+                # [0, pos) + sampler state, finish as "migrated", free
+                # the slot immediately (the frontend relays the payload
+                # to a decode replica)
+                handle.migration = self._extract_migration(slot, handle,
+                                                           tok0)
+                with self._lock:
+                    self.stats.migrated_out += 1
+                self._finish_slot(slot, "migrated", now)
+                return
         self._maybe_finish(slot, tok0, now)
+
+    def _seal_prefix(self, slot: _Slot, handle: RequestHandle,
+                     hashes: list[str], depth: int) -> None:
+        """Copy the slot's freshly computed complete blocks beyond the
+        matched depth out into pool pages and index them. Allocation
+        failures (every page pinned/interior) skip sealing — reuse
+        degrades, correctness never."""
+        pool = self.kv_pool
+        n_complete = min(len(handle.prompt) // pool.page_size,
+                         pool.blocks_per_slot)
+        if n_complete <= depth:
+            return
+        table = np.full((pool.blocks_per_slot,), kvc.SCRATCH_PAGE,
+                        np.int32)
+        parent = hashes[depth - 1] if depth else ""
+        newly: list[str] = []
+        for i in range(depth, n_complete):
+            digest = hashes[i]
+            if digest in pool._nodes:
+                parent = digest
+                continue
+            pid = pool.allocate()
+            if pid is None:
+                break
+            pool.register(parent, digest, pid, i + 1)
+            # pin until the bytes are actually sealed: allocate() for a
+            # later block must never evict a just-registered leaf and
+            # hand its page out twice
+            pool.pin(digest)
+            table[i] = pid
+            newly.append(digest)
+            parent = digest
+        if newly:
+            pool.pool = kvc.seal_pages(pool.pool, self._cache,
+                                       jnp.asarray(table),
+                                       jnp.int32(slot.index))
+            for digest in newly:
+                pool.unpin(digest)
+
+    def _extract_migration(self, slot: _Slot, handle: RequestHandle,
+                           tok0: int) -> dict:
+        """Host-side copy of the slot's computed K/V rows [0, pos) plus
+        the sampler state a decode replica needs to continue exactly
+        where this admission stopped (tok0's own K/V is written by the
+        FIRST decode step, there as here)."""
+        leaves = {}
+        for name, arr in self._cache.items():
+            row = np.asarray(jax.device_get(arr[:, slot.index]))
+            leaves[name] = np.ascontiguousarray(row[:, :, :slot.pos])
+        meta = {"prompt": list(handle.prompt),
+                "max_new_tokens": handle.max_new_tokens,
+                "pos": int(slot.pos), "tok0": int(tok0), "emitted": 1}
+        return {"meta": meta, "leaves": leaves}
+
+    def _admit_migrated(self, slot: _Slot, handle: RequestHandle) -> None:
+        """Install a migrated-in request: pad the payload rows to the
+        full budget, one fixed-shape install_rows, resume decode at pos.
+        tok0 was already streamed to the client by the prefill replica —
+        it is NOT re-pushed here; it seeds the next decode step."""
+        t_dequeue = time.monotonic()
+        handle.queue_wait_s = t_dequeue - handle.submitted_at
+        install, handle.install = handle.install, None
+        pos = install["pos"]
+        rows = {}
+        for name, arr in self._cache.items():
+            l, _, h, s, d = arr.shape
+            leaf = install["leaves"][name]
+            full = np.zeros((l, 1, h, s, d), leaf.dtype)
+            full[:, 0, :, :pos, :] = leaf
+            rows[name] = jnp.asarray(full)
+        self._cache = kvc.install_rows(self._cache, rows,
+                                       jnp.int32(slot.index))
+        now = time.monotonic()
+        handle.prefill_s = now - t_dequeue
+        handle.admitted_at = now
+        slot.handle = handle
+        slot.pos = pos
+        slot.emitted = int(install.get("emitted", 1))
+        slot.last_emit_at = now
+        self._pos_np[slot.index] = pos
+        self._tokens_np[slot.index] = int(install["tok0"])
+        with self._lock:
+            self.stats.queue_wait_s.append(handle.queue_wait_s)
+            self.stats.prefill_s.append(handle.prefill_s)
+            self.stats.migrated_in += 1
+        LOG.debug("installed migrated request %d into slot %d (pos %d)",
+                  handle.request_id, slot.index, pos)
+        if slot.emitted >= handle.max_new_tokens:
+            self._finish_slot(slot, "length", now)
 
     def _maybe_finish(self, slot: _Slot, token: int, now: float) -> None:
         """Per-slot eos/length latch + immediate slot recycling."""
@@ -652,7 +941,12 @@ class ContinuousBatchingEngine:
                 "token_budget": self.token_budget,
                 "draining": self._draining.is_set(),
                 "weights_generation": self.weights_generation,
+                "role": self.role,
+                "migrated_out_total": self.stats.migrated_out,
+                "migrated_in_total": self.stats.migrated_in,
             }
+            if self.kv_pool is not None:
+                snap.update(self.kv_pool.stats_fields())
             itl = _percentile(self.stats.itl_s, 0.50)
             if itl is not None:
                 snap["itl_p50_ms"] = itl * 1000.0
@@ -689,6 +983,15 @@ class ContinuousBatchingEngine:
             "prefill_s_p95": "SERVING_PREFILL_P95_S",
             "decode_ms_per_token_p50": "SERVING_DECODE_P50_MS",
             "decode_ms_per_token_p95": "SERVING_DECODE_P95_MS",
+            # paged-KV reuse + disaggregation (absent keys — sharing OFF,
+            # role "both" — are filtered by the None/missing guard below)
+            "kv_hit_total": "SERVING_KV_HIT_TOTAL",
+            "kv_miss_total": "SERVING_KV_MISS_TOTAL",
+            "kv_evict_total": "SERVING_KV_EVICT_TOTAL",
+            "kv_occupancy_pct": "SERVING_KV_OCCUPANCY_PCT",
+            "kv_hit_rate_pct": "SERVING_KV_HIT_RATE_PCT",
+            "migrated_out_total": "SERVING_MIGRATED_OUT_TOTAL",
+            "migrated_in_total": "SERVING_MIGRATED_IN_TOTAL",
         }
         snap = self.snapshot()
         return [{"name": metric, "value": float(snap[key])}

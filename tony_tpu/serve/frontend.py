@@ -27,24 +27,42 @@ Backpressure: the engine's bounded queue + queued-token budget surface as
 HTTP 429 with ``Retry-After`` (clean open-loop shedding); a request that
 can NEVER fit the per-slot token budget is a 400 — retrying it would
 never help.
+
+Disaggregation (serve/kvcache.py wire format): a ``role="decode"``
+replica accepts ``POST /v1/migrate`` — a packed prefill handoff — and
+streams the decoded tokens back as chunked JSON lines. A
+``role="prefill"`` frontend (constructed with ``migrate_targets``)
+admits ``/v1/generate`` work with ``migrate_out=True``, POSTs the
+resulting payload to a decode replica (round-robin, skipping refusals),
+and relays the decode stream to the client behind the first token it
+already holds; if EVERY decode replica refuses, it self-installs and
+finishes locally — a degraded fleet slows down, it never drops work.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
 import threading
+import urllib.error
+import urllib.request
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from tony_tpu.serve import kvcache as kvc
 from tony_tpu.serve.engine import (
     BudgetExceededError, ContinuousBatchingEngine, DrainingError,
     QueueFullError,
 )
 
 LOG = logging.getLogger(__name__)
+
+# round-robin start index across this process's migrate relays, so one
+# prefill replica spreads handoffs over the decode pool
+_MIGRATE_RR = itertools.count()
 
 
 def engine_prometheus_text(engine: ContinuousBatchingEngine) -> str:
@@ -81,6 +99,9 @@ def engine_prometheus_text(engine: ContinuousBatchingEngine) -> str:
     return render(families + REGISTRY.families())
 
 MAX_BODY_BYTES = 8 * 1024 * 1024
+# migration payloads carry real K/V bytes (L*Hkv*pos*hd per leaf), far
+# past the JSON request bound
+MAX_MIGRATE_BYTES = 1024 * 1024 * 1024
 # streaming stall guard: an engine wedged mid-request must not pin the
 # handler thread forever (the engine emits shutdown sentinels on stop, so
 # this only fires on a genuinely hung stepper)
@@ -89,6 +110,8 @@ STREAM_TOKEN_TIMEOUT_SEC = 300.0
 
 class _Handler(BaseHTTPRequestHandler):
     engine: ContinuousBatchingEngine      # injected by ServeFrontend
+    migrate_targets: tuple = ()           # decode-replica base URLs
+    on_migrated = None                    # hook(target_url) per handoff
     protocol_version = "HTTP/1.1"         # keep-alive + chunked streaming
 
     def log_message(self, fmt, *args):    # route through logging
@@ -170,6 +193,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._error(403, "drain requires the task token")
             self.engine.begin_drain()
             return self._json({"ok": True, **self.engine.load()})
+        if path == "/v1/migrate":
+            return self._handle_migrate()
         if path != "/v1/generate":
             # consume the body before answering: HTTP/1.1 keep-alive
             # would otherwise parse the unread bytes as the next request
@@ -197,8 +222,11 @@ class _Handler(BaseHTTPRequestHandler):
                 400, f"engine is configured with temperature="
                      f"{self.engine.temperature}; per-request sampling "
                      f"overrides are not supported")
+        migrate = bool(self.engine.role == "prefill"
+                       and self.migrate_targets)
         try:
-            handle = self.engine.submit(prompt, max_new)
+            handle = self.engine.submit(prompt, max_new,
+                                        migrate_out=migrate)
         except BudgetExceededError as e:
             return self._error(400, str(e))
         except QueueFullError as e:
@@ -210,6 +238,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(503, str(e), {"X-Tony-Draining": "1"})
         except RuntimeError as e:           # engine stopped
             return self._error(503, str(e))
+        if migrate:
+            return self._generate_migrating(handle, req)
         if req.get("stream"):
             return self._stream(handle)
         try:
@@ -283,16 +313,189 @@ class _Handler(BaseHTTPRequestHandler):
             handle.cancel()
             self.close_connection = True
 
+    # -- disaggregation: decode side ------------------------------------
+    def _handle_migrate(self) -> None:
+        """POST /v1/migrate: adopt a prefill replica's handoff (packed
+        K/V + sampler state) and stream the decoded tokens back."""
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length <= 0:
+            return self._error(400, "missing migration body")
+        if length > MAX_MIGRATE_BYTES:
+            self.close_connection = True
+            return self._error(413, "migration payload too large")
+        body = self.rfile.read(length)
+        try:
+            meta, leaves = kvc.unpack_migration(body)
+        except (ValueError, KeyError, TypeError) as e:
+            return self._error(400, f"bad migration payload: {e}")
+        try:
+            handle = self.engine.submit_migration(meta, leaves)
+        except BudgetExceededError as e:
+            return self._error(400, str(e))
+        except QueueFullError as e:
+            return self._error(429, str(e), {"Retry-After": "1"})
+        except DrainingError as e:
+            return self._error(503, str(e), {"X-Tony-Draining": "1"})
+        except RuntimeError as e:
+            return self._error(503, str(e))
+        return self._stream(handle)
+
+    # -- disaggregation: prefill side -----------------------------------
+    def _generate_migrating(self, handle, req: dict) -> None:
+        """Finish a migrate_out admission: wait for the prefill, POST the
+        handoff to a decode replica, relay its stream to the client
+        behind the first token this replica computed. Every decode
+        replica refusing falls back to finishing locally."""
+        try:
+            handle.result(timeout=STREAM_TOKEN_TIMEOUT_SEC)
+        except TimeoutError as e:
+            handle.cancel()
+            return self._error(504, str(e))
+        if handle.finish_reason == "shutdown":
+            return self._error(503, "engine shut down mid-request")
+        if handle.finish_reason != "migrated" or handle.migration is None:
+            # finished at admission (eos / max_new==1): answer directly
+            if req.get("stream"):
+                return self._stream(handle)
+            return self._json({"tokens": list(handle.tokens),
+                               "finish_reason": handle.finish_reason,
+                               "ttft_s": handle.ttft_s})
+        meta = handle.migration["meta"]
+        leaves = handle.migration["leaves"]
+        payload = kvc.pack_migration(meta, leaves)
+        resp = self._post_migration(payload)
+        if resp is not None:
+            return self._finish_migrated(handle, self._lines_from(resp),
+                                         bool(req.get("stream")))
+        # degraded: no decode replica took it — self-install and finish
+        LOG.warning("request %d: no decode replica accepted the "
+                    "migration; finishing locally", handle.request_id)
+        try:
+            local = self.engine.submit_migration(meta, leaves)
+        except (BudgetExceededError, QueueFullError, DrainingError,
+                RuntimeError) as e:
+            return self._error(
+                503, f"migration failed and local fallback refused: {e}")
+        return self._finish_migrated(handle,
+                                     self._lines_from_handle(local),
+                                     bool(req.get("stream")))
+
+    # tony: disable=redact-on-egress -- data-plane handoff: the payload is the request's own K/V bytes + sampler state, verbatim by contract
+    def _post_migration(self, payload: bytes):
+        """Round-robin the decode pool; 4xx/5xx/transport refusals try
+        the next target. Returns the open (streaming) response, or None
+        when every target refused."""
+        targets = [t.rstrip("/") for t in self.migrate_targets if t]
+        if not targets:
+            return None
+        first = next(_MIGRATE_RR) % len(targets)
+        for i in range(len(targets)):
+            base = targets[(first + i) % len(targets)]
+            rq = urllib.request.Request(
+                base + "/v1/migrate", data=payload,
+                headers={"Content-Type": "application/octet-stream"})
+            try:
+                resp = urllib.request.urlopen(
+                    rq, timeout=STREAM_TOKEN_TIMEOUT_SEC)
+            except urllib.error.HTTPError as e:
+                LOG.debug("migrate to %s refused: HTTP %s", base, e.code)
+                e.close()
+                continue
+            except OSError as e:
+                LOG.debug("migrate to %s failed: %s", base, e)
+                continue
+            hook = self.on_migrated
+            if hook is not None:
+                try:
+                    hook(base)
+                except Exception:  # noqa: BLE001 — observability only
+                    LOG.debug("on_migrated hook failed", exc_info=True)
+            return resp
+        return None
+
+    @staticmethod
+    def _lines_from(resp):
+        """JSON objects from a decode replica's chunked line stream."""
+        with resp:
+            for raw in resp:
+                raw = raw.strip()
+                if raw:
+                    yield json.loads(raw)
+
+    @staticmethod
+    def _lines_from_handle(local):
+        """The local-fallback equivalent of the decode line stream."""
+        for token in local.iter_tokens(timeout=STREAM_TOKEN_TIMEOUT_SEC):
+            yield {"token": token}
+        yield {"done": True, "finish_reason": local.finish_reason}
+
+    def _finish_migrated(self, handle, lines, stream: bool) -> None:
+        """Relay the decode-side token lines to the client behind the
+        prefill token. n_tokens/tokens include it; ttft_s is the PREFILL
+        replica's — the client saw its first token before the handoff."""
+        tok0 = handle.tokens[0]
+        tokens = [tok0]
+        finish = "length"
+        if not stream:
+            try:
+                for obj in lines:
+                    if obj.get("done"):
+                        finish = str(obj.get("finish_reason") or finish)
+                        break
+                    tokens.append(int(obj["token"]))
+            except (OSError, ValueError, KeyError, TimeoutError):
+                finish = "migrate_error"
+            return self._json({"tokens": tokens, "finish_reason": finish,
+                               "ttft_s": handle.ttft_s,
+                               "migrated": True})
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "application/json; charset=utf-8")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj) -> None:
+            data = (json.dumps(obj) + "\n").encode("utf-8")
+            self.wfile.write(f"{len(data):x}\r\n".encode("ascii")
+                             + data + b"\r\n")
+
+        try:
+            chunk({"token": tok0})
+            try:
+                for obj in lines:
+                    if obj.get("done"):
+                        finish = str(obj.get("finish_reason") or finish)
+                        break
+                    token = int(obj["token"])
+                    tokens.append(token)
+                    chunk({"token": token})
+            except (OSError, ValueError, KeyError, TimeoutError):
+                finish = "migrate_error"
+            chunk({"done": True, "finish_reason": finish,
+                   "n_tokens": len(tokens), "ttft_s": handle.ttft_s,
+                   "migrated": True})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            LOG.debug("migrated stream aborted (request %d)",
+                      handle.request_id)
+            self.close_connection = True
+
 
 class ServeFrontend:
     """Owns the HTTP server; the engine's lifecycle belongs to the caller
     (serve/__main__ starts the engine loop, tests may drive it manually)."""
 
     def __init__(self, engine: ContinuousBatchingEngine, port: int = 0,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", migrate_targets=(),
+                 on_migrated=None):
         self.engine = engine
         from tony_tpu.serve.router import BurstBacklogHTTPServer
-        handler = type("BoundHandler", (_Handler,), {"engine": engine})
+        handler = type("BoundHandler", (_Handler,), {
+            "engine": engine,
+            "migrate_targets": tuple(migrate_targets or ()),
+            "on_migrated": staticmethod(on_migrated)
+            if on_migrated is not None else None,
+        })
         self._httpd = BurstBacklogHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -89,6 +89,7 @@ class Endpoint:
     task_id: str = ""
     generation: int = 0         # weights/rollout generation (AM-stamped)
     draining_hint: bool = False   # AM-side drain mark (endpoint set)
+    role: str = ""              # ""|"both"|"prefill"|"decode" (AM-stamped)
     # probe cache (guarded by the router lock; the cached dict itself is
     # read-only once stored)
     load: Optional[dict] = None
@@ -103,10 +104,15 @@ class Endpoint:
             return DRAINING
         return UP
 
+    def effective_role(self) -> str:
+        """AM-stamped role, else the replica's own /v1/load claim."""
+        return self.role or str((self.load or {}).get("role", "") or "")
+
     def to_dict(self, dead_after: int) -> dict:
         return {"url": self.url, "task_id": self.task_id,
                 "generation": self.generation,
                 "draining": self.draining_hint,
+                "role": self.effective_role(),
                 "state": self.state(dead_after),
                 "failures": self.failures, "sent": self.sent,
                 "load": self.load}
@@ -118,18 +124,49 @@ def _normalize(spec) -> Endpoint:
     return Endpoint(url=str(spec.get("url", "")).rstrip("/"),
                     task_id=str(spec.get("task_id", "") or ""),
                     generation=int(spec.get("generation", 0) or 0),
-                    draining_hint=bool(spec.get("draining")))
+                    draining_hint=bool(spec.get("draining")),
+                    role=str(spec.get("role", "") or ""))
 
 
 def endpoints_from_task_infos(infos: list[dict]) -> list[dict]:
     """The AM's get_task_infos carries one `serving-endpoint` entry per
-    registered replica (url + generation + draining) — the fleet
+    registered replica (url + generation + draining + role) — the fleet
     router's endpoint-set source for orchestrated runs."""
     return [{"url": i.get("url", ""), "task_id": i.get("task_id", ""),
              "generation": int(i.get("generation", 0) or 0),
-             "draining": bool(i.get("draining"))}
+             "draining": bool(i.get("draining")),
+             "role": str(i.get("role", "") or "")}
             for i in infos
             if i.get("name") == "serving-endpoint" and i.get("url")]
+
+
+def _prefix_match_depth(hashes: list[str], advertised) -> int:
+    """Deepest page-aligned block of `hashes` present in an endpoint's
+    advertised prefix index. Chain hashes make membership of block i
+    imply the whole prefix [0, (i+1)*page_size) once lived there — the
+    deepest hit is the affinity depth."""
+    if not hashes or not advertised:
+        return 0
+    advset = set(advertised)
+    for i in range(len(hashes) - 1, -1, -1):
+        if hashes[i] in advset:
+            return i + 1
+    return 0
+
+
+def _effective_slots(load: dict) -> float:
+    """Load-score capacity of one replica. Slot count alone lies for a
+    paged replica: free slots with an exhausted (no free, no evictable)
+    KV pool means every admission re-prefills at full length — so the
+    page-pool headroom scales the advertised capacity down (to half at
+    zero headroom; replicas without a pool are unscaled)."""
+    slots_free = int(load.get("slots_free", 0) or 0)
+    headroom = load.get("kv_pages_headroom")
+    total = int(load.get("kv_pages_total", 0) or 0)
+    if headroom is None or total <= 0:
+        return float(slots_free)
+    ratio = max(0.0, min(1.0, int(headroom) / total))
+    return slots_free * (0.5 + 0.5 * ratio)
 
 
 class FleetRouter:
@@ -158,7 +195,10 @@ class FleetRouter:
         self.stats = {"requests_routed": 0, "requests_failed": 0,
                       "spillovers_429": 0, "failovers_error": 0,
                       "probe_failures": 0, "dead_evictions": 0,
-                      "set_updates": 0}
+                      "set_updates": 0,
+                      # prefix-affinity outcome per routed request that
+                      # carried at least one complete hashable block
+                      "affinity_hits": 0, "affinity_misses": 0}
         self.set_endpoints(list(endpoints))
         handler = type("BoundRouterHandler", (_RouterHandler,),
                        {"router": self})
@@ -293,16 +333,28 @@ class FleetRouter:
                 ep.probed_at = 0.0
 
     # -- routing --------------------------------------------------------
-    def candidates(self) -> list[Endpoint]:
-        """UP endpoints, least-loaded first: sort by (queue_depth,
-        -slots_free) off the prober-maintained snapshots — the request
-        path only READS the cache, it never pays a probe RPC (the one
-        exception: a just-installed endpoint nobody has probed yet gets
-        a one-time inline bootstrap probe). DOWN endpoints stay in the
-        prober's sweep so they re-admit themselves; a DRAINING endpoint
-        is excluded from new sends entirely."""
+    def candidates(self, prompt: Optional[list] = None) -> list[Endpoint]:
+        """UP endpoints in routing order (see _ranked); `prompt` enables
+        prefix-affinity ranking."""
+        return [ep for ep, _ in self._ranked(prompt)]
+
+    def _ranked(self, prompt: Optional[list] = None
+                ) -> list[tuple["Endpoint", int]]:
+        """UP endpoints as (endpoint, prefix_match_depth), best first:
+        (-match_depth, queue_depth, -effective_slots, url) off the
+        prober-maintained snapshots — the request path only READS the
+        cache, it never pays a probe RPC (the one exception: a
+        just-installed endpoint nobody has probed yet gets a one-time
+        inline bootstrap probe). Affinity (the deepest advertised
+        prefix-index match for `prompt`, hashed per the replica's own
+        kv_page_size) is preferred, falling back least-loaded — but it
+        NEVER overrides the state filter: DOWN endpoints stay in the
+        prober's sweep so they re-admit themselves, a DRAINING endpoint
+        is excluded from new sends entirely, and decode-role replicas
+        only take /v1/migrate handoffs, never /v1/generate."""
         with self._lock:
             eps = list(self._endpoints.values())
+        hash_memo: dict[int, list[str]] = {}
         ranked = []
         for ep in eps:
             load = ep.load
@@ -310,17 +362,29 @@ class FleetRouter:
                 load = self.probe(ep.url)       # bring-up bootstrap only
             if ep.state(self.dead_after_failures) != UP or load is None:
                 continue
-            ranked.append((int(load.get("queue_depth", 0)),
-                           -int(load.get("slots_free", 0)), ep.url, ep))
-        ranked.sort(key=lambda t: t[:3])
-        return [t[3] for t in ranked]
+            if ep.effective_role() == "decode":
+                continue
+            depth = 0
+            if prompt:
+                psize = int(load.get("kv_page_size", 0) or 0)
+                advertised = load.get("prefix_hashes")
+                if psize > 0 and advertised:
+                    if psize not in hash_memo:
+                        from tony_tpu.serve.kvcache import chain_hashes
+                        hash_memo[psize] = chain_hashes(prompt, psize)
+                    depth = _prefix_match_depth(hash_memo[psize],
+                                                advertised)
+            ranked.append((-depth, int(load.get("queue_depth", 0)),
+                           -_effective_slots(load), ep.url, ep, depth))
+        ranked.sort(key=lambda t: t[:4])
+        return [(t[4], t[5]) for t in ranked]
 
     def fleet_load(self) -> dict:
         """Aggregate load over UP+DRAINING replicas (the router's own
         /v1/load — a fleet of routers can stack), read off the cached
         snapshots."""
         totals = {"queue_depth": 0, "slots_free": 0, "active_slots": 0,
-                  "n_slots": 0}
+                  "n_slots": 0, "kv_pages_free": 0, "kv_pages_total": 0}
         states = {UP: 0, DRAINING: 0, DOWN: 0}
         with self._lock:
             eps = list(self._endpoints.values())
@@ -347,12 +411,22 @@ class FleetRouter:
         tried: list[str] = []
         last_429 = None
         last_err: Optional[str] = None
+        # prefix-affinity source: the prompt token ids, parsed once (a
+        # non-JSON or promptless body simply routes least-loaded)
+        prompt: Optional[list] = None
+        try:
+            parsed = json.loads(body.decode("utf-8"))
+            raw = parsed.get("prompt") if isinstance(parsed, dict) else None
+            if isinstance(raw, list):
+                prompt = [int(t) for t in raw]
+        except (ValueError, TypeError, UnicodeDecodeError):
+            prompt = None
         for _ in range(1 + self.spillover_retries):
-            picks = [ep for ep in self.candidates()
+            picks = [(ep, d) for ep, d in self._ranked(prompt)
                      if ep.url not in tried]
             if not picks:
                 break
-            ep = picks[0]
+            ep, match_depth = picks[0]
             tried.append(ep.url)
             req = urllib.request.Request(
                 ep.url + "/v1/generate", data=body,
@@ -384,6 +458,7 @@ class FleetRouter:
                 with self._lock:
                     ep.sent += 1
                     self.stats["requests_routed"] += 1
+                    self._note_affinity(prompt, match_depth)
                 send_response(e.code, dict(e.headers), payload)
                 return
             except Exception as e:  # noqa: BLE001 — transport failure
@@ -395,6 +470,7 @@ class FleetRouter:
             with self._lock:
                 ep.sent += 1
                 self.stats["requests_routed"] += 1
+                self._note_affinity(prompt, match_depth)
             send_response(resp.status, dict(resp.headers), resp)
             return
         with self._lock:
@@ -408,6 +484,18 @@ class FleetRouter:
         send_response(503, {}, json.dumps(
             {"error": f"fleet unavailable: {detail}",
              "tried": tried}).encode("utf-8") + b"\n")
+
+    def _note_affinity(self, prompt: Optional[list],
+                       match_depth: int) -> None:
+        """Affinity outcome counter for one routed request (caller holds
+        the lock). Only requests that COULD match count — a promptless
+        or sub-page body is neither hit nor miss."""
+        if not prompt:
+            return
+        if match_depth > 0:
+            self.stats["affinity_hits"] += 1
+        else:
+            self.stats["affinity_misses"] += 1
 
     def bundle(self) -> dict:
         """The /v1/fleet surface: endpoint table + router counters."""

@@ -30,8 +30,18 @@ tools/bench_compare.py gating — near-linear tokens/sec scaling with a
 p95 TTFT no worse than single-instance at equal per-replica load is the
 acceptance bar.
 
+**Prefix-reuse mode** (``--prefix-reuse``): the paged-KV story. The
+SAME seeded shared-system-prompt workload (``--reuse-ratio`` of
+requests lead with one shared prefix) is fired at an OFF-baseline
+replica and then an ON-candidate replica (``--prefix-sharing on``) in
+one invocation. Headlines ``serving_prefix_tokens_per_sec`` (tok/s,
+higher-is-better) and ``serving_prefix_ttft_p95_s`` (s,
+lower-is-better) are appended to the trajectory ONLY when ON strictly
+beats OFF on both — and every line carries the replica's scraped KV
+hit rate, because a prefix "win" at 0% hit rate is noise.
+
 Run: python tools/serve_bench.py [--requests N] [--rate R] [--slots S]
-     [--fleet [--fleet-replicas 1,2,4]]
+     [--fleet [--fleet-replicas 1,2,4]] [--prefix-reuse]
 """
 
 from __future__ import annotations
@@ -166,14 +176,16 @@ def _await_marker(proc, marker: str, deadline_s: float) -> str:
     raise RuntimeError(f"child never printed {marker}")
 
 
-def _spawn_replica(args, config, register=None) -> "tuple":
+def _spawn_replica(args, config, register=None,
+                   extra_flags=()) -> "tuple":
     """One REAL serving replica: `python -m tony_tpu.serve` in its own
     process (own interpreter, own GIL, own engine thread) — the fleet's
     production shape, so the scaling numbers measure replicas, not N
     engines time-slicing one Python process. `register(proc)` is called
     the moment the child exists (before any waiting), so the caller can
-    kill it on ANY failure path. Returns (proc, url) once the child
-    prints its SERVING_UP marker."""
+    kill it on ANY failure path. `extra_flags` appends serve-CLI flags
+    (the prefix-reuse leg turns the paged KV pool on/off with them).
+    Returns (proc, url) once the child prints its SERVING_UP marker."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"                  # bench contract: CPU
     env.pop("PALLAS_AXON_POOL_IPS", None)         # never claim the tunnel
@@ -184,7 +196,8 @@ def _spawn_replica(args, config, register=None) -> "tuple":
          "--config", args.config, "--port", "0", "--host", "127.0.0.1",
          "--slots", str(args.slots),
          "--token-budget", str(min(args.token_budget, config.max_seq)),
-         "--queue-depth", str(args.queue_depth)],
+         "--queue-depth", str(args.queue_depth),
+         *extra_flags],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         env=env, cwd=os.path.dirname(_TOOLS_DIR))
     if register is not None:
@@ -356,6 +369,209 @@ def _measure_window(base: str, prompts: list, rate: float, args) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# prefix-reuse mode
+# ---------------------------------------------------------------------------
+
+def _scrape_kv_metrics(base_url: str) -> dict:
+    """Read the replica's paged-KV counters off /v1/metrics exactly as a
+    dashboard scraper would — the bench's hit-rate disclosure and the
+    operator's graph must be the same number."""
+    from tony_tpu.observability import prometheus as prom
+    out = {}
+    try:
+        with urllib.request.urlopen(
+                base_url + "/v1/metrics?format=prometheus",
+                timeout=10) as resp:
+            parsed = prom.parse(resp.read().decode("utf-8"))
+        for key in ("kv_hit_rate_pct", "kv_hit_total", "kv_miss_total",
+                    "kv_evict_total", "kv_occupancy_pct"):
+            try:
+                value = prom.get_sample(parsed, f"tony_serving_{key}")
+            except KeyError:
+                continue
+            if value == value:          # skip NaN
+                out[key] = round(value, 3)
+    except Exception as e:  # noqa: BLE001 — disclosure, never fatal
+        out["error"] = str(e)
+    return out
+
+
+def _prefix_prompts(config, args, rng) -> "tuple":
+    """The reuse workload: one seeded shared system prompt; a
+    `--reuse-ratio` fraction of requests lead with it (unique seeded
+    suffix each), the rest are fully unique at the SAME total length —
+    ON and OFF legs see byte-identical traffic, and equal lengths keep
+    the suffix-prefill compile set to two shapes (full-length miss,
+    post-match suffix), paid once in warmup."""
+    shared = [int(t) for t in rng.randint(0, config.vocab_size,
+                                          size=args.shared_prefix_len)]
+    total_len = args.shared_prefix_len + args.prompt_len
+    n_reuse = int(round(args.requests * args.reuse_ratio))
+    prompts = []
+    for i in range(args.requests):
+        if i < n_reuse:
+            suffix = rng.randint(0, config.vocab_size,
+                                 size=args.prompt_len)
+            prompts.append(shared + [int(t) for t in suffix])
+        else:
+            unique = rng.randint(0, config.vocab_size, size=total_len)
+            prompts.append([int(t) for t in unique])
+    # interleave reuse/unique deterministically so reuse traffic spreads
+    # over the window instead of front-loading every hit
+    order = rng.permutation(len(prompts))
+    return [prompts[i] for i in order], shared
+
+
+def _run_prefix_point(config, args, sharing: bool) -> dict:
+    """One leg (pool ON or OFF): a single subprocess replica, the same
+    seeded reuse workload, best-of-rounds window, KV counters scraped
+    off /v1/metrics after the measurement."""
+    import numpy as np
+
+    flags = (("--prefix-sharing", "on",
+              "--kv-page-size", str(args.kv_page_size),
+              *(("--kv-pages", str(args.kv_pages))
+                if args.kv_pages > 0 else ()))
+             if sharing else ("--prefix-sharing", "off"))
+    launched: list = []
+    proc, base = _spawn_replica(args, config,
+                                register=launched.append,
+                                extra_flags=flags)
+    try:
+        rng = np.random.RandomState(args.seed)
+        prompts, shared = _prefix_prompts(config, args, rng)
+        # warmup pays every compile shape up front: a unique full-length
+        # prompt (miss path), then the shared prefix twice — the first
+        # seals its pages, the second takes the hit path and compiles
+        # the short-suffix prefill shape
+        total_len = args.shared_prefix_len + args.prompt_len
+        warm_rng = np.random.RandomState(args.seed + 7919)
+        warm_unique = [int(t) for t in warm_rng.randint(
+            0, config.vocab_size, size=total_len)]
+        warm_shared = shared + [int(t) for t in warm_rng.randint(
+            0, config.vocab_size, size=args.prompt_len)]
+        for prompt in (warm_unique, warm_shared, warm_shared):
+            w = _StreamResult()
+            _stream_request(base, prompt, args.max_new, w)
+            if w.error:
+                raise RuntimeError(f"prefix warmup failed: {w.error}")
+        rounds = []
+        for i in range(max(1, args.fleet_rounds)):
+            rounds.append(_measure_window(base, prompts, args.rate,
+                                          args))
+            kv = _scrape_kv_metrics(base)
+            print(f"[serve_bench]   {'ON ' if sharing else 'OFF'} "
+                  f"round {i + 1}: "
+                  f"{rounds[-1]['tokens_per_sec']} tok/s ttft_p95 "
+                  f"{rounds[-1]['ttft_p95_s']}s "
+                  f"errors {rounds[-1]['requests_errored']} "
+                  f"kv_hit_rate "
+                  f"{kv.get('kv_hit_rate_pct', 0.0)}%",
+                  file=sys.stderr, flush=True)
+        for p in rounds:
+            p.pop("_ttfts")
+            p.pop("_itls")
+        point = min(rounds,
+                    key=lambda p: (p["requests_ok"] == 0,
+                                   p["requests_errored"],
+                                   p["ttft_p95_s"]))
+        point["rounds"] = len(rounds)
+        point.update(_scrape_kv_metrics(base))
+    finally:
+        _stop_replicas(launched)
+    point["prefix_sharing"] = sharing
+    return point
+
+
+def build_prefix_history_entries(on: dict, off: dict, model: str,
+                                 reuse_ratio: float) -> list:
+    """Gate + build the prefix-reuse trajectory entries (pure — pinned
+    by the bench contract tests). Returns [] unless the ON leg strictly
+    beats the OFF leg on BOTH headlines with non-degenerate
+    measurements: appending a losing or zero-valued run would poison
+    the bench_compare baseline for every later commit. Every entry
+    carries the KV hit-rate disclosure next to the number it
+    justifies."""
+    on_tps = float(on.get("tokens_per_sec") or 0)
+    off_tps = float(off.get("tokens_per_sec") or 0)
+    on_ttft = float(on.get("ttft_p95_s") or 0)
+    off_ttft = float(off.get("ttft_p95_s") or 0)
+    if min(on_tps, off_tps, on_ttft, off_ttft) <= 0:
+        return []
+    if on.get("requests_errored") or off.get("requests_errored"):
+        return []
+    if not (on_tps > off_tps and on_ttft < off_ttft):
+        return []
+    disclosure = {
+        "model": model,
+        "reuse_ratio": round(float(reuse_ratio), 3),
+        "kv_hit_rate_pct": float(on.get("kv_hit_rate_pct", 0.0) or 0.0),
+        "baseline_tokens_per_sec": off_tps,
+        "baseline_ttft_p95_s": off_ttft,
+    }
+    return [
+        {"metric": "serving_prefix_tokens_per_sec", "value": on_tps,
+         "unit": "tok/s", **disclosure},
+        {"metric": "serving_prefix_ttft_p95_s", "value": on_ttft,
+         "unit": "s", **disclosure},
+    ]
+
+
+def run_prefix_reuse(args) -> int:
+    """The --prefix-reuse leg: OFF-baseline then ON-candidate, same
+    replica shape, same seeded shared-system-prompt workload. The two
+    headlines land in bench_history.jsonl ONLY when ON strictly wins
+    both (build_prefix_history_entries gates), and the KV hit rate is
+    disclosed on every line — a prefix win at 0% hit rate is noise, not
+    a result."""
+    import signal
+
+    from tony_tpu.models.llama import get_config
+
+    def _term(signum, frame):
+        raise SystemExit(143)
+
+    signal.signal(signal.SIGTERM, _term)
+    config = get_config(args.config)
+    off = _run_prefix_point(config, args, sharing=False)
+    print(f"[serve_bench] prefix OFF: {off['tokens_per_sec']} tok/s, "
+          f"ttft_p95 {off['ttft_p95_s']}s", file=sys.stderr, flush=True)
+    on = _run_prefix_point(config, args, sharing=True)
+    print(f"[serve_bench] prefix ON:  {on['tokens_per_sec']} tok/s, "
+          f"ttft_p95 {on['ttft_p95_s']}s, kv_hit_rate "
+          f"{on.get('kv_hit_rate_pct', 0.0)}%",
+          file=sys.stderr, flush=True)
+    entries = build_prefix_history_entries(on, off, args.config,
+                                           args.reuse_ratio)
+    for entry in entries:
+        append_history(entry)
+    if not entries:
+        print("[serve_bench] prefix-reuse: ON did not strictly beat "
+              "OFF on both headlines — nothing appended",
+              file=sys.stderr, flush=True)
+    result = {
+        "metric": "serving_prefix_tokens_per_sec",
+        "value": on["tokens_per_sec"],
+        "unit": "tok/s",
+        "backend": "cpu",
+        "ttft_p95_s": on["ttft_p95_s"],
+        "kv_hit_rate_pct": float(on.get("kv_hit_rate_pct", 0.0) or 0.0),
+        "reuse_ratio": args.reuse_ratio,
+        "shared_prefix_len": args.shared_prefix_len,
+        "kv_page_size": args.kv_page_size,
+        "appended": len(entries),
+        "on": on, "off": off,
+        "slots": args.slots,
+        "rate_rps": args.rate,
+        "requests": args.requests,
+        "max_new": args.max_new,
+        "model": args.config,
+    }
+    print(json.dumps(result, separators=(",", ":")), flush=True)
+    return 0
+
+
 def run_fleet(args) -> int:
     import signal
 
@@ -451,10 +667,28 @@ def main() -> int:
                              "lowest ttft_p95) is reported")
     parser.add_argument("--probe-ttl-ms", type=int, default=100,
                         help="router load-probe cache TTL in fleet mode")
+    parser.add_argument("--prefix-reuse", action="store_true",
+                        help="prefix-reuse mode: paged-KV OFF baseline "
+                             "vs ON candidate over shared-system-prompt "
+                             "traffic; winning runs append "
+                             "serving_prefix_* headlines")
+    parser.add_argument("--reuse-ratio", type=float, default=0.6,
+                        help="fraction of requests leading with the "
+                             "shared system prompt")
+    parser.add_argument("--shared-prefix-len", type=int, default=32,
+                        help="shared system-prompt length in tokens "
+                             "(page-aligned for full reuse)")
+    parser.add_argument("--kv-page-size", type=int, default=16,
+                        help="KV page size for the ON leg")
+    parser.add_argument("--kv-pages", type=int, default=0,
+                        help="KV pool size for the ON leg (0 = the "
+                             "engine's slots-scaled default)")
     args = parser.parse_args()
     if args.rate is None:
-        args.rate = 12.0 if args.fleet else 20.0
+        args.rate = 12.0 if (args.fleet or args.prefix_reuse) else 20.0
 
+    if args.prefix_reuse:
+        return run_prefix_reuse(args)
     if args.fleet:
         return run_fleet(args)
 
