@@ -519,5 +519,68 @@ def test_prefix_reuse_headlines_gate_units_and_disclosure(tmp_path,
     assert verdicts["serving_prefix_ttft_p95_s"]["regression"] is False
 
 
+@pytest.mark.reqtrace
+def test_ttft_attribution_stamps_are_sum_consistent():
+    """Every serve_bench JSON line's TTFT-attribution disclosure must be
+    sum-consistent AS EMITTED: the rounded components plus unattributed
+    equal the rounded total exactly, so a reader can audit where the p95
+    first-token time went without re-deriving anything."""
+    import tools.serve_bench as sb
+
+    attr = sb.ttft_attribution(0.050, queue_wait_s=0.010,
+                               prefill_s=0.020, route_ms=4.0,
+                               migrate_ms=3.0)
+    keys = {"ttft_attr_route_ms", "ttft_attr_queue_ms",
+            "ttft_attr_prefill_ms", "ttft_attr_migrate_ms",
+            "ttft_attr_decode_ms", "ttft_attr_unattributed_ms",
+            "ttft_attr_total_ms"}
+    assert set(attr) == keys
+    assert attr["ttft_attr_route_ms"] == 4.0
+    assert attr["ttft_attr_queue_ms"] == pytest.approx(10.0)
+    assert attr["ttft_attr_decode_ms"] == pytest.approx(17.0)  # remainder
+    assert attr["ttft_attr_total_ms"] == pytest.approx(54.0)   # route+ttft
+    # the contract: rounded parts sum to the rounded total EXACTLY
+    parts = sum(v for k, v in attr.items() if k != "ttft_attr_total_ms")
+    assert parts == attr["ttft_attr_total_ms"]
+
+    # phase breakdown unknown (fleet path through the router): nothing
+    # is guessed — decode stays 0 and the gap lands in unattributed
+    blind = sb.ttft_attribution(0.050)
+    assert blind["ttft_attr_decode_ms"] == 0.0
+    assert blind["ttft_attr_unattributed_ms"] == pytest.approx(50.0)
+    parts = sum(v for k, v in blind.items() if k != "ttft_attr_total_ms")
+    assert parts == blind["ttft_attr_total_ms"]
+
+    # awkward floats cannot break the emitted-sum identity
+    messy = sb.ttft_attribution(0.0333333, queue_wait_s=0.0111111,
+                                prefill_s=0.0077777, route_ms=1.2345678)
+    parts = sum(v for k, v in messy.items() if k != "ttft_attr_total_ms")
+    assert round(parts, 3) == messy["ttft_attr_total_ms"]
+
+
+@pytest.mark.reqtrace
+@pytest.mark.serving
+def test_serve_bench_single_engine_line_carries_attribution(monkeypatch,
+                                                            capsys):
+    """The single-engine serve_bench JSON line stamps the attribution
+    next to the TTFT it explains (run the smallest real round rather
+    than trusting the helper was wired in)."""
+    import tools.serve_bench as sb
+
+    monkeypatch.setattr(sys, "argv",
+                        ["serve_bench", "--config", "tiny",
+                         "--requests", "4", "--max-new", "4",
+                         "--slots", "2", "--rate", "50"])
+    assert sb.main() == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["metric"] == "serve_tokens_per_sec"
+    assert result["ttft_attr_total_ms"] >= result["ttft_attr_queue_ms"]
+    parts = sum(v for k, v in result.items()
+                if k.startswith("ttft_attr_")
+                and k != "ttft_attr_total_ms")
+    assert parts == pytest.approx(result["ttft_attr_total_ms"], abs=0.01)
+
+
 if __name__ == "__main__":
     sys.exit(0)

@@ -33,7 +33,8 @@ from tools.tonylint.rules_legacy import (AlertHotLoopRule,
                                          GaugeRegistryRule, PrintBanRule,
                                          RendererCoverageRule)
 from tools.tonylint.rules_locks import GuardedByRule, NoBlockingUnderLockRule
-from tools.tonylint.rules_rpc import AttemptFencingRule, RedactOnEgressRule
+from tools.tonylint.rules_rpc import (AttemptFencingRule, RedactOnEgressRule,
+                                      TracePropagationRule)
 from tools.tonylint.rules_threads import ThreadHygieneRule
 
 pytestmark = pytest.mark.lint
@@ -373,6 +374,97 @@ def test_redact_on_egress_suppressed(tmp_path):
         "    def deliver(self, payload):")
     project = _project(tmp_path, {"tony_tpu/observability/s.py": src})
     report = run_rules(project, [RedactOnEgressRule()])
+    assert report.findings == [] and report.suppressed == 1
+
+
+TRACE_EXPORT_OFFENDER = '''
+class ReqCollector:
+    def export(self):
+        return [dict(t) for t in self._done]
+
+
+def write_serving_traces_file(history_dir, traces):
+    with open(history_dir + "/serving_traces.json", "w") as f:
+        f.write(str(traces))
+'''
+
+TRACE_EXPORT_CLEAN = TRACE_EXPORT_OFFENDER.replace(
+    "return [dict(t) for t in self._done]",
+    "return redact_traces([dict(t) for t in self._done])").replace(
+    "f.write(str(traces))",
+    "f.write(str(redact_traces(traces)))")
+
+
+def test_redact_on_egress_covers_trace_export_surfaces(tmp_path):
+    """Collector export/drain snapshots and the serving-traces history
+    sidecar are operator-facing egress: both must redact."""
+    findings = _run(tmp_path,
+                    {"tony_tpu/observability/rt.py": TRACE_EXPORT_OFFENDER},
+                    [RedactOnEgressRule()])
+    assert _rule_ids(findings) == ["redact-on-egress"] * 2
+    msgs = " | ".join(f.message for f in findings)
+    assert "request-trace payloads" in msgs and "sidecar" in msgs
+    assert _run(tmp_path,
+                {"tony_tpu/observability/rt.py": TRACE_EXPORT_CLEAN},
+                [RedactOnEgressRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# trace-propagation
+# ---------------------------------------------------------------------------
+
+TRACE_PROP_OFFENDER = '''
+import urllib.request
+
+
+class Frontend:
+    def post_handoff(self, base, payload):
+        rq = urllib.request.Request(base + "/v1/migrate", data=payload,
+                                    headers={"Content-Type": "a/b"})
+        return urllib.request.urlopen(rq, timeout=5)
+'''
+
+TRACE_PROP_CLEAN = TRACE_PROP_OFFENDER.replace(
+    'headers={"Content-Type": "a/b"}',
+    'headers={"X-Tony-Trace": ctx.header_value()}')
+
+TRACE_PROP_CLEAN_ATTR = TRACE_PROP_OFFENDER.replace(
+    'headers={"Content-Type": "a/b"}',
+    'headers={reqtrace.HEADER: ctx.header_value()}')
+
+
+def test_trace_propagation_fires_on_dropped_header(tmp_path):
+    findings = _run(tmp_path, {"tony_tpu/serve/f.py": TRACE_PROP_OFFENDER},
+                    [TracePropagationRule()])
+    assert _rule_ids(findings) == ["trace-propagation"]
+    assert "/v1/migrate" in findings[0].message
+
+
+def test_trace_propagation_silent_when_header_forwarded(tmp_path):
+    # both spellings satisfy: the literal header name or reqtrace.HEADER
+    assert _run(tmp_path, {"tony_tpu/serve/f.py": TRACE_PROP_CLEAN},
+                [TracePropagationRule()]) == []
+    assert _run(tmp_path, {"tony_tpu/serve/f.py": TRACE_PROP_CLEAN_ATTR},
+                [TracePropagationRule()]) == []
+
+
+def test_trace_propagation_scoped_to_serve_and_data_plane(tmp_path):
+    # outside tony_tpu/serve/: silent (webhook sinks etc. are not hops
+    # of a request trace); non-data-plane URLs: silent
+    assert _run(tmp_path, {"tony_tpu/am/f.py": TRACE_PROP_OFFENDER},
+                [TracePropagationRule()]) == []
+    other = TRACE_PROP_OFFENDER.replace("/v1/migrate", "/v1/load")
+    assert _run(tmp_path, {"tony_tpu/serve/f.py": other},
+                [TracePropagationRule()]) == []
+
+
+def test_trace_propagation_suppressed(tmp_path):
+    src = TRACE_PROP_OFFENDER.replace(
+        '        rq = urllib.request.Request(',
+        '        # tony: disable=trace-propagation -- loopback self-probe\n'
+        '        rq = urllib.request.Request(')
+    project = _project(tmp_path, {"tony_tpu/serve/f.py": src})
+    report = run_rules(project, [TracePropagationRule()])
     assert report.findings == [] and report.suppressed == 1
 
 

@@ -1,0 +1,683 @@
+"""Request-scoped distributed-tracing tests (observability/reqtrace.py
+and its serving-fleet wiring).
+
+The load-bearing contract: one trace context per request, minted at the
+router's ingress (or adopted from the client's X-Tony-Trace header) and
+propagated on every replica-to-replica hop, with ZERO added per-request
+RPCs — hops accumulate in-process, a tail sampler keeps only the traces
+that matter, and export is pull-only (/v1/traces) plus the metrics-RPC
+piggyback. The slow e2e proves the whole story on a real disaggregated
+fleet: router → prefill replica → /v1/migrate → decode replica, one
+stitched trace spanning all three processes, the chaos-delayed decode
+hop dominating, and both offline renderers (cli trace, portal) showing
+the same waterfall.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu import constants as C
+from tony_tpu.models.generate import generate
+from tony_tpu.models.llama import get_config, llama_init
+from tony_tpu.observability import reqtrace
+from tony_tpu.observability.reqtrace import (
+    HEADER, ReqTraceCollector, RequestTrace, TailSampler, TraceContext,
+    adopt_or_mint, attribution_from_handle, parse_header,
+    record_engine_phases, slowest_table, stitch, TtftAttribution,
+)
+
+pytestmark = pytest.mark.reqtrace
+
+TID = "feedface" * 4          # a well-formed 32-hex client trace id
+SPAN = "ab" * 8               # a well-formed 16-hex parent span id
+
+
+# ---------------------------------------------------------------------------
+# header adopt / mint
+# ---------------------------------------------------------------------------
+
+def test_header_roundtrip_with_route_ms():
+    ctx = TraceContext(TID, SPAN, route_ms=12.5)
+    got = parse_header(ctx.header_value())
+    assert (got.trace_id, got.parent_span_id, got.route_ms) == \
+        (TID, SPAN, 12.5)
+
+
+def test_header_omits_route_ms_when_zero():
+    assert TraceContext(TID, SPAN).header_value() == f"{TID}:{SPAN}"
+
+
+def test_garbage_headers_mint_fresh_roots():
+    for bad in (None, "", "   ", "xyz!:-", "GHIJKL:" + SPAN,
+                "a" * 33, f"{TID}:ZZZZ", f"{TID}:{'c' * 17}"):
+        ctx, adopted = adopt_or_mint(bad)
+        assert not adopted
+        assert len(ctx.trace_id) == 32 and ctx.parent_span_id == ""
+    ctx, adopted = adopt_or_mint(f"{TID}:{SPAN}:7.25")
+    assert adopted and ctx.trace_id == TID and ctx.route_ms == 7.25
+
+
+def test_non_numeric_route_ms_degrades_to_zero():
+    ctx = parse_header(f"{TID}:{SPAN}:fast")
+    assert ctx is not None and ctx.route_ms == 0.0
+
+
+# ---------------------------------------------------------------------------
+# tail sampler
+# ---------------------------------------------------------------------------
+
+def test_sampler_unconditional_keeps_beat_the_threshold():
+    s = TailSampler(slow_threshold_ms=1000.0)
+    assert s.keep(1.0, error=True) == "error"
+    assert s.keep(1.0, spilled=True) == "spill"
+    assert s.keep(1.0, migrated=True) == "migrated"
+    assert s.keep(1.0) is None
+
+
+def test_sampler_slowest_k_displaces_the_windows_fastest():
+    now = [0.0]
+    s = TailSampler(slow_threshold_ms=10.0, slowest_k=2,
+                    window_ms=60_000.0, clock=lambda: now[0])
+    assert s.keep(20.0) == "slow"
+    assert s.keep(30.0) == "slow"
+    # window full at k=2; floor is 20 — a 25 displaces it...
+    assert s.keep(25.0) == "slow"
+    # ...and a 15 (above threshold, below the new floor of 25) drops
+    assert s.keep(15.0) is None
+
+
+def test_sampler_window_expiry_refills_the_budget():
+    now = [0.0]
+    s = TailSampler(slow_threshold_ms=10.0, slowest_k=1, window_ms=1000.0,
+                    clock=lambda: now[0])
+    assert s.keep(50.0) == "slow"
+    assert s.keep(12.0) is None          # budget spent, below the floor
+    now[0] = 2.0                          # 2s later: window rolled over
+    assert s.keep(12.0) == "slow"
+
+
+def test_sampler_errors_do_not_consume_the_slow_budget():
+    s = TailSampler(slow_threshold_ms=10.0, slowest_k=1)
+    for _ in range(5):
+        assert s.keep(9999.0, error=True) == "error"
+    assert s.keep(20.0) == "slow"        # slot still free
+
+
+# ---------------------------------------------------------------------------
+# collector: bounding, export vs drain, redaction
+# ---------------------------------------------------------------------------
+
+def _kept_trace(coll, trace_id, duration_ms=50.0):
+    tr = coll.trace(TraceContext(trace_id))
+    return coll.finish(tr, duration_ms, migrated=True)
+
+
+def test_collector_bounded_buffer_drops_oldest():
+    coll = ReqTraceCollector("p", max_traces=2)
+    for tid in ("aa", "bb", "cc"):
+        assert _kept_trace(coll, tid) == "migrated"
+    ids = [t["trace_id"] for t in coll.export()]
+    assert ids == ["bb", "cc"]           # "aa" (oldest) was evicted
+
+
+def test_collector_export_is_nondestructive_drain_is_not():
+    coll = ReqTraceCollector("p")
+    _kept_trace(coll, "aa")
+    assert len(coll.export()) == 1
+    assert len(coll.export()) == 1
+    assert [t["trace_id"] for t in coll.drain()] == ["aa"]
+    assert coll.export() == []
+
+
+def test_disabled_collector_is_a_cheap_noop():
+    coll = ReqTraceCollector("p", enabled=False)
+    assert coll.trace(TraceContext.mint()) is None
+    assert coll.finish(None, 1e9, error=True) is None
+    assert coll.export() == []
+
+
+def test_export_redacts_secret_shaped_hop_attrs():
+    coll = ReqTraceCollector("p", sampler=TailSampler(slow_threshold_ms=0.0))
+    tr = coll.trace(TraceContext(TID))
+    tr.hop("router.route", 0, 5,
+           attrs={"target": "api_key=hunter2hunter2", "attempts": 1})
+    coll.finish(tr, 50.0)
+    attrs = coll.export()[0]["hops"][0]["attrs"]
+    assert "hunter2" not in attrs["target"]
+    assert attrs["attempts"] == 1        # non-strings pass through
+
+
+# ---------------------------------------------------------------------------
+# stitching + the slowest table
+# ---------------------------------------------------------------------------
+
+def _record(trace_id, process, reason, duration, hops):
+    return {"trace_id": trace_id, "request_id": "7", "process": process,
+            "kept_reason": reason, "duration_ms": duration,
+            "hops": [{"trace_id": trace_id, "span_id": sid,
+                      "parent_id": "", "name": name, "process": process,
+                      "start_ms": a, "end_ms": b, "status": "OK",
+                      "attrs": {}} for sid, name, a, b in hops]}
+
+
+def test_stitch_merges_processes_dedupes_spans_ranks_reasons():
+    pre = _record("t1", "prefill:0", "slow", 100.0,
+                  [("s1", "queue_wait", 0, 10),
+                   ("s2", "prefill_suffix", 10, 40)])
+    dec = _record("t1", "decode:0", "migrated", 400.0,
+                  [("s2", "prefill_suffix", 10, 40),   # duplicate span
+                   ("s3", "decode", 40, 400)])
+    other = _record("t2", "prefill:0", "slow", 50.0,
+                    [("s9", "queue_wait", 0, 50)])
+    out = stitch([[pre, other], [dec]])
+    assert [t["trace_id"] for t in out] == ["t1", "t2"]  # slowest first
+    t1 = out[0]
+    assert t1["kept_reason"] == "migrated"               # outranks slow
+    assert t1["duration_ms"] == 400.0                    # max observed
+    assert set(t1["processes"]) == {"prefill:0", "decode:0"}
+    assert [h["span_id"] for h in t1["hops"]] == ["s1", "s2", "s3"]
+
+
+def test_slowest_table_names_the_dominant_hop_and_process():
+    dec = _record("t1", "decode:0", "migrated", 400.0,
+                  [("s1", "queue_wait", 0, 10), ("s2", "decode", 10, 400)])
+    rows = slowest_table(stitch([[dec]]))
+    assert rows[0]["dominant_hop"] == "decode"
+    assert rows[0]["dominant_process"] == "decode:0"
+    assert rows[0]["dominant_ms"] == 390
+    assert rows[0]["hop_count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# engine-phase hop recording + TTFT attribution (duck-typed handles)
+# ---------------------------------------------------------------------------
+
+class _Handle:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _local_handle():
+    return _Handle(submitted_at=100.0, queue_wait_s=0.010,
+                   kv_match_s=0.002, kv_matched_tokens=3,
+                   prefill_s=0.020, prompt=list(range(8)),
+                   first_token_at=100.035, finished_at=100.095,
+                   tokens=[1, 2, 3, 4], finish_reason="length",
+                   ttft_s=0.035, migrated_in=False)
+
+
+def test_record_engine_phases_local_path():
+    trace = RequestTrace(TraceContext(TID), process="p")
+    record_engine_phases(trace, _local_handle())
+    names = [h["name"] for h in trace.hops]
+    assert names == ["queue_wait", "kv_match", "prefill_suffix", "decode"]
+    by = {h["name"]: h for h in trace.hops}
+    assert by["kv_match"]["attrs"]["matched_tokens"] == 3
+    assert by["prefill_suffix"]["attrs"] == {"prompt_tokens": 8,
+                                             "suffix_tokens": 5}
+    dec = by["decode"]["attrs"]
+    assert dec["tokens"] == 4 and dec["finish_reason"] == "length"
+    assert dec["itl_ms"] == pytest.approx(20.0, abs=0.5)
+
+
+def test_record_engine_phases_migrated_in_path():
+    h = _local_handle()
+    h.migrated_in = True
+    h.first_token_at = None              # decode never started here
+    trace = RequestTrace(TraceContext(TID), process="d")
+    record_engine_phases(trace, h)
+    names = [x["name"] for x in trace.hops]
+    assert names == ["queue_wait", "migrate.install"]
+    assert trace.hops[1]["attrs"] == {"pos": 8}
+
+
+def test_attribution_from_handle_decode_is_the_ttft_remainder():
+    comp = attribution_from_handle(_local_handle(), route_ms=4.0)
+    assert comp["route_ms"] == 4.0
+    assert comp["queue_ms"] == pytest.approx(10.0)
+    assert comp["prefill_ms"] == pytest.approx(20.0)
+    assert comp["decode_ms"] == pytest.approx(5.0)   # 35 - 10 - 20
+    h = _local_handle()
+    h.ttft_s = None                       # never produced a token
+    assert attribution_from_handle(h)["decode_ms"] == 0.0
+
+
+def test_ttft_attribution_gauges_only_for_sampled_components():
+    att = TtftAttribution()
+    assert att.gauges() == {}
+    att.record({"queue_ms": 5.0, "prefill_ms": 10.0})
+    g = att.gauges()
+    assert g["ttft_attr_queue_ms_p50"] == 5.0
+    assert g["ttft_attr_prefill_ms_p95"] == 10.0
+    assert not any(k.startswith("ttft_attr_route") for k in g)
+
+
+def test_ttft_attribution_window_is_bounded():
+    att = TtftAttribution(maxlen=4)
+    for v in range(100):
+        att.record({"queue_ms": float(v)})
+    assert att.gauges()["ttft_attr_queue_ms_p50"] >= 96.0
+
+
+# ---------------------------------------------------------------------------
+# metrics-RPC piggyback (zero new channels)
+# ---------------------------------------------------------------------------
+
+def test_reporter_piggybacks_drained_traces_on_the_metrics_push():
+    from tony_tpu.train.metrics import ServingMetricsReporter
+    coll = ReqTraceCollector("prefill:0",
+                             sampler=TailSampler(slow_threshold_ms=0.0))
+    _kept_trace(coll, TID)
+    env = {C.AM_HOST: "127.0.0.1", C.METRICS_RPC_PORT: "1",
+           C.JOB_NAME: "server"}
+    rep = ServingMetricsReporter(
+        lambda: [{"name": "tokens_emitted", "value": 1}], env=env,
+        interval_sec=3600.0, trace_source=coll.drain)
+    pushed = []
+    rep._enqueue = pushed.append
+    rep.report_now()
+    assert pushed[0]["serving_traces"][0]["trace_id"] == TID
+    assert coll.export() == []            # drained, not copied
+    rep.report_now()                      # nothing new: no traces field
+    assert "serving_traces" not in pushed[1]
+
+
+# ---------------------------------------------------------------------------
+# router relay: ingress adoption, route hop, error keeps, /metrics text
+# ---------------------------------------------------------------------------
+
+def test_router_relay_adopts_client_trace_and_keeps_errors():
+    from tony_tpu.serve.router import FleetRouter, router_prometheus_text
+    router = FleetRouter(endpoints=[], port=0, host="127.0.0.1")
+    try:
+        sent = []
+        router.relay(json.dumps({"prompt": [1, 2]}).encode(),
+                     lambda status, headers, body: sent.append(status),
+                     headers={HEADER: f"{TID}:{SPAN}"})
+        assert sent == [503]              # no replica anywhere
+        records = router.collector.export()
+        assert records[0]["trace_id"] == TID
+        assert records[0]["kept_reason"] == "error"
+        hop = records[0]["hops"][0]
+        assert hop["name"] == "router.route"
+        assert hop["status"] == "ERROR"
+        assert hop["attrs"]["http_status"] == 503
+        bundle = router.collect_traces()
+        assert bundle["traces"][0]["trace_id"] == TID
+        assert bundle["pulled"] == {}
+        text = router_prometheus_text(router)
+        assert "tony_router_requests_failed_total 1" in text
+        assert "tony_router_requests_routed_total 0" in text
+    finally:
+        router._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# offline renderers on synthetic records (fast; the e2e re-checks them
+# on real fleet output)
+# ---------------------------------------------------------------------------
+
+def _sidecar_records():
+    pre = _record(TID, "prefill:0", "migrated", 600.0,
+                  [("s1", "queue_wait", 1000, 1010),
+                   ("s2", "prefill_suffix", 1010, 1050),
+                   ("s3", "migrate.transfer", 1050, 1070)])
+    dec = _record(TID, "decode:0", "migrated", 580.0,
+                  [("s4", "migrate.install", 1070, 1090),
+                   ("s5", "decode", 1090, 1600)])
+    return [pre, dec]
+
+
+def test_cli_trace_renders_the_waterfall_offline(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import trace as cli_trace
+    from tony_tpu.events.history import write_serving_traces_file
+    write_serving_traces_file(str(tmp_path), _sidecar_records())
+    assert cli_trace([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "1 sampled request trace(s)" in out
+    assert "waterfall" in out and TID[:12] in out
+    assert "decode [decode:0]" in out
+    assert "dominant: decode (decode:0" in out
+    # --json mode round-trips the stitched bundle
+    assert cli_trace([str(tmp_path), "--json"]) == 0
+    bundle = json.loads(capsys.readouterr().out)
+    assert bundle["slowest"][0]["dominant_process"] == "decode:0"
+    # --trace-id filters; an unmatched prefix is a clean non-zero exit
+    assert cli_trace([str(tmp_path), "--trace-id", "0000"]) == 1
+
+
+def test_cli_trace_missing_sidecar_exits_nonzero(tmp_path, capsys):
+    from tony_tpu.cli.__main__ import trace as cli_trace
+    assert cli_trace([str(tmp_path)]) == 1
+    assert "no serving traces" in capsys.readouterr().err
+
+
+def test_portal_requests_api_and_job_page(tmp_path):
+    from test_portal import make_app_history
+    from tony_tpu.events.history import write_serving_traces_file
+    from tony_tpu.portal.cache import PortalCache
+    from tony_tpu.portal.mover import ensure_history_dirs
+    from tony_tpu.portal.server import PortalServer
+    inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+    ensure_history_dirs(inter, fin)
+    app_dir = make_app_history(inter, "app_rt")
+    write_serving_traces_file(app_dir, _sidecar_records())
+    server = PortalServer(PortalCache(inter, fin), port=0,
+                          host="127.0.0.1")
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}"
+                f"/api/jobs/app_rt/requests") as r:
+            bundle = json.loads(r.read())
+        assert bundle["traces"][0]["trace_id"] == TID
+        assert set(bundle["traces"][0]["processes"]) == \
+            {"prefill:0", "decode:0"}
+        assert bundle["slowest"][0]["dominant_hop"] == "decode"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/jobs/app_rt") as r:
+            page = r.read().decode()
+        assert "Slowest requests" in page
+        assert "Request waterfall" in page
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# single-replica integration: header adoption, /v1/traces pull, gauges
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = get_config("tiny")
+    return llama_init(cfg, jax.random.PRNGKey(0)), cfg
+
+
+def _prompts(cfg, lengths, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[int(t) for t in rng.randint(0, cfg.vocab_size, size=n)]
+            for n in lengths]
+
+
+def _oracle(params, cfg, prompt, n, **kw):
+    out = generate(params, cfg, jnp.asarray([prompt], jnp.int32), n, **kw)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _post_json(url, body, headers=None, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_frontend_traces_one_request_end_to_end(model):
+    from tony_tpu.serve.engine import ContinuousBatchingEngine
+    from tony_tpu.serve.frontend import ServeFrontend, \
+        install_engine_tracing
+    params, cfg = model
+    prompt = _prompts(cfg, (6,), seed=11)[0]
+    engine = ContinuousBatchingEngine(params, cfg, n_slots=2,
+                                      token_budget=32, queue_depth=8)
+    coll = ReqTraceCollector(
+        "replica:0", sampler=TailSampler(slow_threshold_ms=0.0))
+    install_engine_tracing(engine, coll)
+    engine.start()
+    frontend = ServeFrontend(engine, port=0, host="127.0.0.1",
+                             collector=coll)
+    frontend.start()
+    base = f"http://127.0.0.1:{frontend.port}"
+    try:
+        want = _oracle(params, cfg, prompt, 4)
+        resp = json.loads(_post_json(
+            base + "/v1/generate",
+            {"prompt": prompt, "max_new_tokens": 4},
+            headers={HEADER: f"{TID}:{SPAN}"}).read())
+        assert resp["tokens"] == want     # tracing never bends tokens
+        # the engine callback finishes the trace asynchronously
+        deadline = time.time() + 10
+        records = []
+        while time.time() < deadline:
+            records = [t for t in _get_json(base + "/v1/traces")["traces"]
+                       if t["trace_id"] == TID]
+            if records:
+                break
+            time.sleep(0.05)
+        assert records, "adopted trace never reached /v1/traces"
+        names = [h["name"] for h in records[0]["hops"]]
+        assert names == ["queue_wait", "kv_match", "prefill_suffix",
+                         "decode"]
+        assert all(h["parent_id"] == SPAN for h in records[0]["hops"])
+        # the pull surface audits itself: per-path request counts
+        snap = _get_json(base + "/v1/traces")
+        assert snap["process"] == "replica:0"
+        assert snap["http_requests"]["/v1/generate"] == 1
+        assert snap["http_requests"]["/v1/traces"] >= 2
+        # TTFT-attribution gauges joined the metrics snapshot
+        metrics = _get_json(base + "/v1/metrics")
+        assert "ttft_attr_queue_ms_p50" in metrics
+        assert "ttft_attr_prefill_ms_p95" in metrics
+        # a budget-rejected request is an unconditional "error" keep
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(base + "/v1/generate",
+                       {"prompt": prompt, "max_new_tokens": 9999})
+        assert e.value.code == 400
+        deadline = time.time() + 10
+        rejected = []
+        while time.time() < deadline:
+            rejected = [t for t in _get_json(base + "/v1/traces")["traces"]
+                        if t["kept_reason"] == "error"]
+            if rejected:
+                break
+            time.sleep(0.05)
+        assert rejected[0]["hops"][0]["name"] == "frontend.reject"
+    finally:
+        frontend.stop()
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE disaggregated e2e: router → prefill → /v1/migrate → decode, with a
+# chaos-delayed decode step; one trace spans all three processes, the
+# hop-sum matches the client's observed TTFT, tokens stay bit-identical,
+# trace export adds zero per-request RPCs, and both offline renderers
+# show the guilty replica.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_disaggregated_trace_spans_three_processes(model, monkeypatch,
+                                                   tmp_path, capsys):
+    from tony_tpu.serve.engine import ContinuousBatchingEngine
+    from tony_tpu.serve.frontend import ServeFrontend, \
+        install_engine_tracing
+    from tony_tpu.serve.router import FleetRouter
+    params, cfg = model
+    prompt = _prompts(cfg, (8,), seed=3)[0]
+    max_new = 6
+    want = _oracle(params, cfg, prompt, max_new)
+
+    # chaos: every decode step on the DECODE replica sleeps 100 ms (read
+    # once at engine construction, so only this engine is delayed)
+    monkeypatch.setenv(C.TEST_SERVE_DECODE_DELAY, "100")
+    dec_engine = ContinuousBatchingEngine(params, cfg, n_slots=2,
+                                          token_budget=64, queue_depth=8,
+                                          role="decode")
+    monkeypatch.delenv(C.TEST_SERVE_DECODE_DELAY)
+    pre_engine = ContinuousBatchingEngine(params, cfg, n_slots=2,
+                                          token_budget=64, queue_depth=8,
+                                          role="prefill")
+    keep_all = dict(slow_threshold_ms=0.0, slowest_k=64)
+    pre_coll = ReqTraceCollector("prefill:0",
+                                 sampler=TailSampler(**keep_all))
+    dec_coll = ReqTraceCollector("decode:0",
+                                 sampler=TailSampler(**keep_all))
+    install_engine_tracing(pre_engine, pre_coll)
+    install_engine_tracing(dec_engine, dec_coll)
+    dec_engine.start()
+    pre_engine.start()
+    dec_front = ServeFrontend(dec_engine, port=0, host="127.0.0.1",
+                              collector=dec_coll)
+    dec_front.start()
+    dec_url = f"http://127.0.0.1:{dec_front.port}"
+    pre_front = ServeFrontend(pre_engine, port=0, host="127.0.0.1",
+                              migrate_targets=[dec_url],
+                              collector=pre_coll)
+    pre_front.start()
+    pre_url = f"http://127.0.0.1:{pre_front.port}"
+    # the router's own view must be sampled too: it cannot know the
+    # request migrated downstream, so keep-everything is the test's lever
+    router = FleetRouter(
+        endpoints=[{"url": pre_url, "role": "prefill"},
+                   {"url": dec_url, "role": "decode"}],
+        port=0, host="127.0.0.1",
+        collector=ReqTraceCollector("router",
+                                    sampler=TailSampler(**keep_all)))
+    router.start()
+    router_url = f"http://127.0.0.1:{router.port}"
+    try:
+        # warmup absorbs both engines' compiles (and proves the
+        # blocking migrated path while at it)
+        warm = json.loads(_post_json(
+            router_url + "/v1/generate",
+            {"prompt": prompt, "max_new_tokens": 3}).read())
+        assert warm["migrated"] is True
+
+        # measured request: the CLIENT mints the trace id, so adoption
+        # is proven at the router's ingress; stream to observe TTFT
+        req = urllib.request.Request(
+            router_url + "/v1/generate",
+            data=json.dumps({"prompt": prompt, "max_new_tokens": max_new,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     HEADER: f"{TID}:{SPAN}"})
+        t_send = time.monotonic()
+        ttft_s = None
+        toks, done = [], None
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for raw in r:
+                if ttft_s is None:
+                    ttft_s = time.monotonic() - t_send
+                obj = json.loads(raw)
+                if obj.get("done"):
+                    done = obj
+                    break
+                toks.append(int(obj["token"]))
+        client_ttft_ms = 1000.0 * ttft_s
+        # tokens bit-identical to the untraced offline oracle
+        assert toks == want
+        assert done["migrated"] is True and done["n_tokens"] == max_new
+
+        # ZERO per-request trace RPCs: before any operator pull, neither
+        # replica has ever seen a /v1/traces request — only the data
+        # plane (2 generates on prefill, 2 migrates on decode)
+        assert pre_front.request_counts.get("/v1/traces", 0) == 0
+        assert dec_front.request_counts.get("/v1/traces", 0) == 0
+        assert pre_front.request_counts.get("/v1/generate") == 2
+        assert dec_front.request_counts.get("/v1/migrate") == 2
+
+        # pull-and-stitch at the router until all three processes'
+        # views of OUR trace have landed
+        ours = None
+        pulls = 0
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            pulls += 1
+            bundle = _get_json(router_url + "/v1/traces")
+            got = [t for t in bundle["traces"]
+                   if t["trace_id"] == TID]
+            if got and set(got[0]["processes"]) >= \
+                    {"router", "prefill:0", "decode:0"}:
+                ours = got[0]
+                break
+            time.sleep(0.2)
+        assert ours is not None, "stitched trace never spanned the fleet"
+        assert ours["kept_reason"] == "migrated"
+        # every /v1/traces hit on the replicas is one of OUR pulls: the
+        # export path is pull-only, never per-request
+        assert pre_front.request_counts.get("/v1/traces") == pulls
+        assert dec_front.request_counts.get("/v1/traces") == pulls
+        assert set(bundle["pulled"]) == {pre_url, dec_url}
+
+        by_name: dict = {}
+        for h in ours["hops"]:
+            by_name[h["name"]] = by_name.get(h["name"], 0) + \
+                int(h["end_ms"]) - int(h["start_ms"])
+        assert {"router.route", "queue_wait", "kv_match",
+                "prefill_suffix", "migrate.pack", "migrate.transfer",
+                "migrate.install", "decode"} <= set(by_name)
+
+        # TTFT composition: the client saw its first token right after
+        # the migrate handoff — route + queue + kv + prefill + pack +
+        # transfer must reproduce the observed TTFT (10% / 75 ms floor
+        # for scheduler jitter); the decode delay must NOT be in it
+        hop_sum = sum(by_name[n] for n in
+                      ("router.route", "queue_wait", "kv_match",
+                       "prefill_suffix", "migrate.pack",
+                       "migrate.transfer"))
+        assert abs(hop_sum - client_ttft_ms) <= \
+            max(0.10 * client_ttft_ms, 75.0), \
+            f"hop sum {hop_sum:.1f} ms vs client TTFT " \
+            f"{client_ttft_ms:.1f} ms"
+        # the chaos delay lands squarely in decode: ~5 delayed steps
+        assert by_name["decode"] >= 400.0
+        assert by_name["decode"] > 3 * hop_sum
+
+        # the slowest-requests table names the guilty replica
+        row = next(r for r in bundle["slowest"]
+                   if r["trace_id"] == TID)
+        assert row["dominant_hop"] == "decode"
+        assert row["dominant_process"] == "decode:0"
+
+        # both offline renderers consume the drained records: the same
+        # serving_traces.json sidecar path history flushes through
+        from tony_tpu.events.history import write_serving_traces_file
+        records = (pre_coll.drain() + dec_coll.drain()
+                   + router.collector.drain())
+        from test_portal import make_app_history
+        from tony_tpu.portal.cache import PortalCache
+        from tony_tpu.portal.mover import ensure_history_dirs
+        from tony_tpu.portal.server import PortalServer
+        inter, fin = str(tmp_path / "int"), str(tmp_path / "fin")
+        ensure_history_dirs(inter, fin)
+        app_dir = make_app_history(inter, "app_e2e")
+        write_serving_traces_file(app_dir, records)
+
+        from tony_tpu.cli.__main__ import trace as cli_trace
+        assert cli_trace([app_dir, "--trace-id", TID[:8]]) == 0
+        out = capsys.readouterr().out
+        assert "waterfall" in out and TID[:12] in out
+        assert "decode [decode:0]" in out
+
+        portal = PortalServer(PortalCache(inter, fin), port=0,
+                              host="127.0.0.1")
+        portal.start()
+        try:
+            api = _get_json(f"http://127.0.0.1:{portal.port}"
+                            f"/api/jobs/app_e2e/requests")
+            mine = [t for t in api["traces"] if t["trace_id"] == TID]
+            assert mine and set(mine[0]["processes"]) >= \
+                {"router", "prefill:0", "decode:0"}
+        finally:
+            portal.stop()
+    finally:
+        router.stop()
+        pre_front.stop()
+        dec_front.stop()
+        pre_engine.stop()
+        dec_engine.stop()

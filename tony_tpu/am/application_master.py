@@ -116,6 +116,11 @@ class MetricsStore(MetricsServiceHandler):
         # profile-capture completions (update_metrics `profile_done`
         # field) are forwarded here; the AM wires _on_profile_captured
         self.profile_sink = None
+        # tail-sampled serving request traces (update_metrics
+        # `serving_traces` field, observability/reqtrace.py) accumulate
+        # here, bounded, for the serving_traces.json history flush
+        self._serving_traces: list[dict] = []  # guarded-by: _lock
+        self._serving_traces_max = 1024
         # cross-task skew analytics (observability/skew.py): every
         # numeric gauge push is offered to this sink (the SkewTracker's
         # observe_metric — unwatched names are a single dict miss), so
@@ -169,6 +174,16 @@ class MetricsStore(MetricsServiceHandler):
         sink = self.span_sink
         if spans and sink is not None:
             sink(spans)
+        traces = req.get("serving_traces")
+        if traces:
+            with self._lock:
+                self._serving_traces.extend(
+                    t for t in traces if isinstance(t, dict))
+                # bounded like the per-process buffers: keep the NEWEST
+                if len(self._serving_traces) > self._serving_traces_max:
+                    del self._serving_traces[
+                        :len(self._serving_traces)
+                        - self._serving_traces_max]
         # outside the store lock (the tracker has its own): fold watched
         # gauges into the skew windows
         skew_sink = self.skew_sink
@@ -181,6 +196,12 @@ class MetricsStore(MetricsServiceHandler):
         if isinstance(profile_done, dict) and psink is not None:
             psink(task_type, index, profile_done)
         return {}
+
+    def serving_traces(self) -> list[dict]:
+        """The accumulated tail-sampled request traces (already redacted
+        at each replica's drain) — the serving_traces.json source."""
+        with self._lock:
+            return list(self._serving_traces)
 
     # holds: _lock (only update_metrics calls this, under the store lock)
     def _track_utilization(self, task_type: str, index: int,
@@ -946,7 +967,7 @@ class ApplicationMaster(ClusterServiceHandler):
         event log (the portal's waterfall and metrics.json sources)."""
         from tony_tpu.events.history import (
             write_alerts_file, write_goodput_file, write_metrics_file,
-            write_skew_file, write_spans_file,
+            write_serving_traces_file, write_skew_file, write_spans_file,
         )
         try:
             if self._trace_enabled:
@@ -957,6 +978,11 @@ class ApplicationMaster(ClusterServiceHandler):
                 write_spans_file(self.history_dir, self.span_store.to_list())
             write_metrics_file(self.history_dir,
                                self.metrics_store.timeseries_dict())
+            traces = self.metrics_store.serving_traces()
+            if traces:
+                # request traces only exist when a serving jobtype ran —
+                # an empty sidecar would read as "traced, found nothing"
+                write_serving_traces_file(self.history_dir, traces)
             if self._goodput_enabled:
                 write_goodput_file(self.history_dir, self.goodput_dict())
             if self._straggler_enabled:
@@ -1185,7 +1211,8 @@ class ApplicationMaster(ClusterServiceHandler):
             for extra in (C.PORTAL_CONFIG_FILE, C.SPANS_FILE,
                           C.METRICS_FILE, C.GOODPUT_FILE,
                           C.DIAGNOSTICS_FILE, C.SKEW_FILE,
-                          C.JOBSTATE_FILE, C.ALERTS_FILE):
+                          C.JOBSTATE_FILE, C.ALERTS_FILE,
+                          C.SERVING_TRACES_FILE):
                 p = os.path.join(self.history_dir, extra)
                 if os.path.exists(p):
                     store.put(p, f"history/{extra}")

@@ -54,7 +54,8 @@ from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
          "{submit|local|notebook|profile|logs|diagnose|stragglers"
-         "|alerts|top|preempt|resize|arbiter|router|rollout} [args...]")
+         "|alerts|top|preempt|resize|arbiter|router|rollout|trace} "
+         "[args...]")
 
 
 def _am_client(app_dir: str):
@@ -969,6 +970,87 @@ def rollout(argv: list[str]) -> int:
     return 0 if not (resp or {}).get("error") else 1
 
 
+def trace(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli trace <target>` — render the job's
+    tail-sampled serving request traces offline from history (the same
+    serving_traces.json the portal's request panel reads): the
+    slowest-requests table (dominant hop names the guilty replica) plus
+    an ASCII per-hop waterfall of the slowest — or a chosen — trace."""
+    import argparse
+    import json
+
+    from tony_tpu import constants as C
+    from tony_tpu.observability.reqtrace import slowest_table, stitch
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli trace")
+    parser.add_argument("target",
+                        help="app dir, history dir, or a "
+                             "serving_traces.json")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the stitched bundle instead of a "
+                             "summary")
+    parser.add_argument("--trace-id", default="",
+                        help="render only traces whose id starts with "
+                             "this prefix")
+    parser.add_argument("--slowest", type=int, default=10,
+                        help="rows in the slowest-requests table")
+    args = parser.parse_args(argv)
+    raw, searched = _find_history_json(args.target, C.SERVING_TRACES_FILE)
+    if raw is None:
+        print("no serving traces found (searched: "
+              + ", ".join(searched[:4])
+              + "). The job may predate request tracing, never have "
+                "served, or have sampled nothing.", file=sys.stderr)
+        return 1
+    records = [t for t in raw if isinstance(t, dict)] \
+        if isinstance(raw, list) else []
+    stitched = stitch([records])
+    if args.trace_id:
+        stitched = [t for t in stitched
+                    if str(t.get("trace_id", "")).startswith(
+                        args.trace_id)]
+    table = slowest_table(stitched, args.slowest)
+    if args.json:
+        print(json.dumps({"traces": stitched, "slowest": table},
+                         indent=1, sort_keys=True))
+        return 0
+    if not stitched:
+        print("no sampled request traces match")
+        return 1
+    print(f"{len(stitched)} sampled request trace(s); slowest first:")
+    for r in table:
+        print(f"  {r['trace_id'][:12]}  {r['duration_ms']:9.1f} ms  "
+              f"[{r['kept_reason']:8s}]  dominant: {r['dominant_hop']} "
+              f"({r['dominant_process']}, {r['dominant_ms']} ms)  "
+              f"processes: {', '.join(r['processes'])}")
+    # ASCII waterfall of the top trace (slowest, or the --trace-id pick)
+    top = stitched[0]
+    hops = [h for h in top.get("hops") or []
+            if isinstance(h, dict) and h.get("start_ms")]
+    if not hops:
+        return 0
+    t0 = min(int(h["start_ms"]) for h in hops)
+    t1 = max(max(int(h.get("end_ms") or 0), int(h["start_ms"]))
+             for h in hops)
+    extent, cols = max(1, t1 - t0), 40
+    print(f"waterfall — trace {str(top.get('trace_id', ''))[:12]} "
+          f"({top.get('kept_reason', '')}, "
+          f"{float(top.get('duration_ms', 0) or 0):.1f} ms, "
+          f"extent {extent} ms):")
+    for h in hops:
+        start = int(h["start_ms"])
+        end = int(h.get("end_ms") or 0) or start
+        pad = int(cols * (start - t0) / extent)
+        bar = max(1, int(cols * (end - start) / extent))
+        bar = min(bar, cols - min(pad, cols - 1))
+        label = f"{h.get('name', '')} [{h.get('process', '')}]"
+        mark = "!" if h.get("status") == "ERROR" else "#"
+        print(f"  {label:<38.38s} {end - start:>7d} ms "
+              f"|{' ' * pad}{mark * bar}"
+              f"{' ' * (cols - pad - bar)}|")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     logging.basicConfig(
@@ -1014,6 +1096,8 @@ def main(argv: list[str] | None = None) -> int:
         return router(rest)
     if cmd == "rollout":
         return rollout(rest)
+    if cmd == "trace":
+        return trace(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

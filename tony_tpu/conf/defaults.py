@@ -209,6 +209,14 @@ DEFAULTS = {
     # must fit inside tony.task.term-grace-ms (15 s default) so the
     # executor's KILL never lands before the drain finishes
     K.SERVING_FLEET_DRAIN_TIMEOUT_MS: 10_000,
+    # request-scoped tracing (observability/reqtrace.py); on by default —
+    # the unsampled fast path is an in-process append dropped at
+    # completion, so the steady-state cost is noise
+    K.SERVING_TRACE_ENABLED: True,
+    K.SERVING_TRACE_SLOW_THRESHOLD_MS: 1000,
+    K.SERVING_TRACE_SLOWEST_K: 8,
+    K.SERVING_TRACE_WINDOW_MS: 60_000,
+    K.SERVING_TRACE_MAX_TRACES: 256,
     # serving autoscaler (serve/autoscaler.py); opt-in
     K.AUTOSCALER_ENABLED: False,
     K.AUTOSCALER_MIN_REPLICAS: 1,

@@ -227,6 +227,23 @@ SERVING_FLEET_DEAD_AFTER_FAILURES = \
 # tony.task.term-grace-ms or the executor's KILL cuts streams mid-token)
 SERVING_FLEET_DRAIN_TIMEOUT_MS = "tony.serving.fleet.drain-timeout-ms"
 
+# --- serving request tracing (observability/reqtrace.py) ----------------
+# master switch for request-scoped tracing: the X-Tony-Trace context
+# minted at the router (or adopted from the client) and carried through
+# admission, engine phases, and /v1/migrate into the decode replica
+SERVING_TRACE_ENABLED = "tony.serving.trace.enabled"
+# tail-sampling slow gate: completed traces at or above this duration
+# compete for the slowest-k slots per window (errors, 429 spills, and
+# migrated requests are kept unconditionally)
+SERVING_TRACE_SLOW_THRESHOLD_MS = "tony.serving.trace.slow-threshold-ms"
+# slowest-k per sampling window kept above the slow threshold
+SERVING_TRACE_SLOWEST_K = "tony.serving.trace.slowest-k"
+# the rolling sampling window the slowest-k competition runs over
+SERVING_TRACE_WINDOW_MS = "tony.serving.trace.window-ms"
+# bound on sampled traces buffered per process (pull-exported via
+# /v1/traces and drained into history); overflow drops oldest, counted
+SERVING_TRACE_MAX_TRACES = "tony.serving.trace.max-traces"
+
 # --- autoscaler (serve/autoscaler.py): SLI-driven replica scaling -------
 # master switch: the AM evaluates the serving-fleet autoscaler on its
 # monitor cadence when the application carries a serving jobtype

@@ -183,6 +183,12 @@ ALERTS_FILE = "alerts.json"          # alert-engine bundle flushed next to
                                      # transition log; refreshed on every
                                      # transition so the portal's sidecar
                                      # fallback stays live-ish mid-run
+SERVING_TRACES_FILE = "serving_traces.json"  # tail-sampled per-request
+                                     # serving traces (observability/
+                                     # reqtrace.py), piggybacked on the
+                                     # metrics RPC and flushed next to the
+                                     # event log; the portal's request
+                                     # waterfall and `cli trace` render it
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------
@@ -257,6 +263,11 @@ TEST_TRAINER_STEP_DELAY = "TEST_TRAINER_STEP_DELAY"
 # the rendered per-process form of the hook above (ms per step; unset or
 # 0 = no delay) — read by the trainer hot loop's test seam
 TRAINER_STEP_DELAY_MS = "TONY_TRAINER_STEP_DELAY_MS"
+# serving chaos: slow one replica's DECODE by a fixed per-step delay
+# (ms; unset or 0 = none), read once at engine construction — the
+# slow-hop-attribution e2e plants it on one decode replica of a
+# disaggregated fleet and asserts the sampled trace blames that hop
+TEST_SERVE_DECODE_DELAY = "TEST_SERVE_DECODE_DELAY"
 # AM crash injection (chaos harness): the AM SIGKILLs its own process
 # `after_ms` after prepare() — no teardown, no history flush, nothing; the
 # supervisor (am/supervisor.py) relaunches it and the new attempt replays

@@ -156,6 +156,22 @@ def read_alerts_file(history_dir: str) -> dict:
     return out if isinstance(out, dict) else {}
 
 
+def write_serving_traces_file(history_dir: str,
+                              traces: list[dict]) -> None:
+    """traces: tail-sampled per-request serving traces (observability/
+    reqtrace.py record shape — {trace_id, process, kept_reason,
+    duration_ms, hops[]}), already redacted at drain; re-redacted here
+    so the history flush is an egress in its own right."""
+    from tony_tpu.observability.reqtrace import redact_traces
+    _write_json_atomic(os.path.join(history_dir, C.SERVING_TRACES_FILE),
+                       redact_traces(traces))
+
+
+def read_serving_traces_file(history_dir: str) -> list:
+    out = _read_json(os.path.join(history_dir, C.SERVING_TRACES_FILE), [])
+    return out if isinstance(out, list) else []
+
+
 def parse_history_file_name(name: str) -> JobMetadata:
     """Parse either a final or an in-progress history file name back into
     JobMetadata (reference: JobMetadata constructor parsing,

@@ -213,6 +213,13 @@ class PortalCache:
         """Lifecycle spans for the job page's waterfall (spans.json)."""
         return self._get_sidecar(job_id, C.SPANS_FILE, [])
 
+    def get_serving_traces(self, job_id: str) -> list[dict[str, Any]]:
+        """Tail-sampled serving request traces (serving_traces.json
+        sidecar, observability/reqtrace.py record shape) — the job
+        page's request-waterfall + slowest-requests source. [] for
+        jobs that never served."""
+        return self._get_sidecar(job_id, C.SERVING_TRACES_FILE, [])
+
     def get_metrics_timeseries(self, job_id: str) -> dict[str, Any]:
         """Per-gauge trajectories ({task: {metric: [[ts, v], ...]}}) —
         the /jobs/:id/metrics.json payload (metrics.json sidecar)."""

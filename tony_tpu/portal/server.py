@@ -337,6 +337,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # events otherwise
                 return self._json(self._serving_bundle(
                     job_id, md.status == "RUNNING"))
+            if what == "requests":
+                # stitched request traces + the slowest-requests table
+                # (serving_traces.json sidecar, observability/reqtrace)
+                return self._json(self._requests_bundle(job_id))
         if len(parts) == 4 and parts[0] == "jobs" and parts[2] == "logs":
             # /api/jobs/:id/logs/:task[?stream=&offset=&max_bytes=&follow]
             # — one bounded chunk; followers poll with the returned
@@ -475,6 +479,18 @@ class _Handler(BaseHTTPRequestHandler):
                                      "ROLLING_UPDATE_COMPLETED")]
         return {"endpoints": endpoints, "source": source,
                 "scaling_events": scaling[-20:]}
+
+    def _requests_bundle(self, job_id: str) -> dict:
+        """Stitched serving request traces + the slowest-requests table
+        off the serving_traces.json sidecar — per-process sampled
+        records from every replica (and the router) merged by trace_id,
+        so one request's router/prefill/decode hops read as one
+        waterfall."""
+        from tony_tpu.observability.reqtrace import slowest_table, stitch
+        traces = [t for t in self.cache.get_serving_traces(job_id)
+                  if isinstance(t, dict)]
+        stitched = stitch([traces])
+        return {"traces": stitched, "slowest": slowest_table(stitched)}
 
     def _skew_bundle(self, job_id: str, running: bool) -> dict:
         """Live-then-history skew bundle: a RUNNING job's bundle comes
@@ -793,6 +809,7 @@ class _Handler(BaseHTTPRequestHandler):
                    + self._goodput_html(job_id)
                    + self._timeline_html(job_id)
                    + self._waterfall_html(job_id)
+                   + self._requests_html(job_id)
                    + _table(["Time", "Event", "Summary", "Payload"], rows))
 
     @staticmethod
@@ -1174,6 +1191,63 @@ class _Handler(BaseHTTPRequestHandler):
                 '<table class="waterfall"><tr><th>Span</th><th>Duration</th>'
                 f"<th>Timeline ({extent} ms)</th></tr>"
                 + "".join(rows) + "</table>")
+
+    def _requests_html(self, job_id: str) -> str:
+        """Serving request-trace panel: the slowest-requests table
+        (dominant hop names the guilty replica) plus a per-hop waterfall
+        of the slowest stitched trace. Empty string for jobs that never
+        served or sampled nothing — non-serving history stays clean."""
+        bundle = self._requests_bundle(job_id)
+        stitched = bundle.get("traces") or []
+        if not stitched:
+            return ""
+        rows = []
+        for r in bundle.get("slowest") or []:
+            rows.append([
+                html.escape(str(r.get("trace_id", ""))[:12]),
+                f'{float(r.get("duration_ms", 0) or 0):.1f} ms',
+                html.escape(str(r.get("kept_reason", ""))),
+                html.escape(f'{r.get("dominant_hop", "")} '
+                            f'({r.get("dominant_process", "")}, '
+                            f'{r.get("dominant_ms", 0)} ms)'),
+                html.escape(", ".join(r.get("processes") or [])),
+                str(r.get("hop_count", 0)),
+            ])
+        out = ("<h3>Slowest requests</h3>"
+               + _table(["Trace", "Duration", "Kept", "Dominant hop",
+                         "Processes", "Hops"], rows))
+        top = stitched[0]
+        hops = [h for h in top.get("hops") or []
+                if isinstance(h, dict) and h.get("start_ms")]
+        if not hops:
+            return out
+        t0 = min(int(h["start_ms"]) for h in hops)
+        t1 = max(max(int(h.get("end_ms") or 0), int(h["start_ms"]))
+                 for h in hops)
+        extent = max(1, t1 - t0)
+        wrows = []
+        for h in hops:
+            start = int(h["start_ms"])
+            end = int(h.get("end_ms") or 0) or start
+            left = 100.0 * (start - t0) / extent
+            width = max(0.5, 100.0 * (end - start) / extent)
+            color = "#c0392b" if h.get("status") == "ERROR" else "#2e8b57"
+            label = f'{h.get("name", "")} [{h.get("process", "")}]'
+            wrows.append(
+                f"<tr><td>{html.escape(label)}</td>"
+                f"<td>{end - start} ms</td>"
+                f'<td style="min-width:320px"><div class="spanbar" '
+                f'style="margin-left:{left:.2f}%;width:{width:.2f}%;'
+                f'background:{color}" '
+                f'title="{html.escape(str(h.get("status")))}">'
+                f"</div></td></tr>")
+        out += (f'<h3>Request waterfall — '
+                f'{html.escape(str(top.get("trace_id", ""))[:12])} '
+                f'({html.escape(str(top.get("kept_reason", "")))})</h3>'
+                '<table class="waterfall"><tr><th>Hop</th><th>Duration'
+                f"</th><th>Timeline ({extent} ms)</th></tr>"
+                + "".join(wrows) + "</table>")
+        return out
 
     def _serving_endpoints_html(self, job_id: str) -> str:
         """Fleet serving panel: the replica set with its live state —

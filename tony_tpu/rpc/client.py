@@ -378,13 +378,18 @@ class MetricsServiceClient(_JsonRpcClient):
     def update_metrics(self, task_type: str, index: int,
                        metrics: list[dict],
                        spans: Optional[list[dict]] = None,
+                       serving_traces: Optional[list[dict]] = None,
                        attempt: int = -1) -> None:
         """`spans` piggybacks finished lifecycle spans (observability/
-        trace.py) on the metrics channel — no extra RPC surface; `attempt`
-        labels this task attempt in the AM's Prometheus exposition."""
+        trace.py) on the metrics channel — no extra RPC surface;
+        `serving_traces` does the same for tail-sampled request traces
+        (observability/reqtrace.py); `attempt` labels this task attempt
+        in the AM's Prometheus exposition."""
         req = {"task_type": task_type, "index": index, "metrics": metrics}
         if spans:
             req["spans"] = spans
+        if serving_traces:
+            req["serving_traces"] = serving_traces
         if attempt >= 0:
             req["attempt"] = attempt
         self.call("update_metrics", req)

@@ -266,6 +266,11 @@ def main(argv=None) -> int:
             attrs = {"request_id": handle.request_id,
                      "tokens": len(handle.tokens),
                      "finish_reason": handle.finish_reason or ""}
+            # the lifecycle span carries the request trace id, so a
+            # job-waterfall span links to its distributed request trace
+            trace_ctx = getattr(handle, "trace_ctx", None)
+            if trace_ctx is not None:
+                attrs["request_trace_id"] = trace_ctx.trace_id
             for key, value in (("queue_wait_ms", handle.queue_wait_s),
                                ("prefill_ms", handle.prefill_s),
                                ("decode_ms", handle.decode_s)):
@@ -277,10 +282,31 @@ def main(argv=None) -> int:
 
         engine.on_request_finished = _record_request_span
 
+    # request-scoped distributed tracing (observability/reqtrace.py):
+    # tail-sampled per-request hop traces, pull-exported on /v1/traces
+    # and piggybacked on the metrics RPC into serving_traces.json
+    from tony_tpu.observability.reqtrace import (
+        ReqTraceCollector, TailSampler,
+    )
+    from tony_tpu.serve.frontend import install_engine_tracing
+    collector = ReqTraceCollector(
+        process=(f"{env.get(C.JOB_NAME, role or 'serving')}"
+                 f":{env.get(C.TASK_INDEX, str(os.getpid()))}"),
+        sampler=TailSampler(
+            slow_threshold_ms=conf.get_time_ms(
+                K.SERVING_TRACE_SLOW_THRESHOLD_MS, 1000),
+            slowest_k=conf.get_int(K.SERVING_TRACE_SLOWEST_K, 8),
+            window_ms=conf.get_time_ms(K.SERVING_TRACE_WINDOW_MS,
+                                       60_000)),
+        max_traces=conf.get_int(K.SERVING_TRACE_MAX_TRACES, 256),
+        enabled=conf.get_bool(K.SERVING_TRACE_ENABLED, True))
+    install_engine_tracing(engine, collector)
+
     engine.start()
     frontend = ServeFrontend(engine, port=port, host=args.host,
                              migrate_targets=migrate_targets,
-                             on_migrated=_migrated_reporter(env))
+                             on_migrated=_migrated_reporter(env),
+                             collector=collector)
     frontend.start()
 
     from tony_tpu.utils.common import current_host
@@ -291,12 +317,22 @@ def main(argv=None) -> int:
     _register_endpoint(url, env, weights_generation=weights_generation,
                        role=role)
 
+    def _sample_metrics() -> list:
+        # engine gauges + the TTFT-attribution rollup (SERVING_TTFT_
+        # ATTR_<component>_MS_P50/P95) on the same metrics push
+        out = list(engine.metrics())
+        for key, value in collector.attribution.gauges().items():
+            out.append({"name": f"SERVING_{key.upper()}",
+                        "value": float(value)})
+        return out
+
     from tony_tpu.train.metrics import ServingMetricsReporter
     reporter = ServingMetricsReporter(
-        engine.metrics,
+        _sample_metrics,
         interval_sec=conf.get_time_ms(K.TASK_METRICS_INTERVAL_MS,
                                       5000) / 1000.0,
-        span_source=recorder.drain if recorder.enabled else None)
+        span_source=recorder.drain if recorder.enabled else None,
+        trace_source=collector.drain if collector.enabled else None)
     reporter.start()
 
     stop = threading.Event()

@@ -57,6 +57,7 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
+import os
 import queue
 import threading
 import time
@@ -69,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tony_tpu import constants as C
 from tony_tpu.models.generate import (
     _sample, _warn_moe_below_capacity, decode_step, prefill,
 )
@@ -124,6 +126,18 @@ class RequestHandle:
         # queued before a slot freed, and the admission prefill itself
         self.queue_wait_s: Optional[float] = None
         self.prefill_s: Optional[float] = None
+        # prefill-phase split for the request trace: time spent matching/
+        # gathering indexed prefix pages, and how many tokens matched
+        self.kv_match_s: Optional[float] = None
+        self.kv_matched_tokens = 0
+        # True for a /v1/migrate install — its "prefill" is the row
+        # install, traced as migrate.install instead of prefill_suffix
+        self.migrated_in = False
+        # request-trace carrier (observability/reqtrace.py): the frontend
+        # attaches the RequestTrace + TraceContext so completion hooks
+        # can record engine phases onto the SAME cross-process trace
+        self.trace = None
+        self.trace_ctx = None
         self.done = threading.Event()
         self.cancelled = threading.Event()
         self._queue: "queue.Queue" = queue.Queue()
@@ -397,6 +411,15 @@ class ContinuousBatchingEngine:
         # can tell the two apart
         self.weights_generation = int(weights_generation)
         self._thread: Optional[threading.Thread] = None
+        # chaos seam (constants.TEST_SERVE_DECODE_DELAY): a fixed
+        # per-decode-step sleep, read ONCE here so the hot loop's test
+        # hook is a float compare, not an env lookup
+        try:
+            self._test_decode_delay_s = max(0, int(
+                os.environ.get(C.TEST_SERVE_DECODE_DELAY, "0")
+                or 0)) / 1000.0
+        except ValueError:
+            self._test_decode_delay_s = 0.0
         self.stats = EngineStats()
         # observability hook: called (outside the engine lock) with each
         # RequestHandle as it finishes — serve/__main__ turns these into
@@ -631,6 +654,11 @@ class ContinuousBatchingEngine:
             jnp.asarray(self._tokens_np), jnp.asarray(self._pos_np),
             step_key, self.temperature, self.top_k, self.top_p)
         nxt_np = np.asarray(jax.device_get(nxt))
+        if self._test_decode_delay_s > 0:
+            # chaos seam: TEST_SERVE_DECODE_DELAY slows this replica's
+            # decode by a fixed per-step delay — the slow-hop-attribution
+            # e2e's guilty replica
+            time.sleep(self._test_decode_delay_s)
         now = time.monotonic()
         for slot in active:
             token = int(nxt_np[slot.index])
@@ -688,6 +716,7 @@ class ContinuousBatchingEngine:
             hashes = kvc.chain_hashes(handle.prompt, pool.page_size)
             usable = (len(handle.prompt) - 1) // pool.page_size
             page_ids, depth = pool.match(hashes[:usable])
+            handle.kv_match_s = time.monotonic() - t_dequeue
             if depth:
                 pinned = hashes[depth - 1]
                 table = np.full((pool.blocks_per_slot,),
@@ -697,6 +726,8 @@ class ContinuousBatchingEngine:
                     self._cache, pool.pool, jnp.asarray(table),
                     jnp.int32(slot.index))
                 start = depth * pool.page_size
+                handle.kv_matched_tokens = start
+                handle.kv_match_s = time.monotonic() - t_dequeue
             suffix = jnp.asarray(handle.prompt[start:], jnp.int32)
             tok0_dev, self._cache = _admit_step(
                 self.params, self.config, self._cache, suffix,
@@ -818,6 +849,7 @@ class ContinuousBatchingEngine:
         it is NOT re-pushed here; it seeds the next decode step."""
         t_dequeue = time.monotonic()
         handle.queue_wait_s = t_dequeue - handle.submitted_at
+        handle.migrated_in = True
         install, handle.install = handle.install, None
         pos = install["pos"]
         rows = {}

@@ -46,12 +46,14 @@ import json
 import logging
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from tony_tpu.observability import reqtrace
 from tony_tpu.serve import kvcache as kvc
 from tony_tpu.serve.engine import (
     BudgetExceededError, ContinuousBatchingEngine, DrainingError,
@@ -65,11 +67,14 @@ LOG = logging.getLogger(__name__)
 _MIGRATE_RR = itertools.count()
 
 
-def engine_prometheus_text(engine: ContinuousBatchingEngine) -> str:
+def engine_prometheus_text(engine: ContinuousBatchingEngine,
+                           collector=None) -> str:
     """Engine snapshot + this process's health registry as Prometheus
     text exposition — the serving half of the shared encoder contract
     (observability/prometheus.py). Orchestrated runs label every engine
-    gauge with {app_id, task_type, index, attempt} from the task env."""
+    gauge with {app_id, task_type, index, attempt} from the task env.
+    A request-trace collector contributes its TTFT-attribution rollup
+    (serving_ttft_attr_<component>_ms_p50/p95)."""
     from tony_tpu import constants as C
     from tony_tpu.observability.metrics import REGISTRY
     from tony_tpu.observability.prometheus import render, task_metric_name
@@ -96,6 +101,12 @@ def engine_prometheus_text(engine: ContinuousBatchingEngine) -> str:
         name = task_metric_name(f"serving_{key}")
         families.append({"name": name, "type": "gauge", "help": "",
                          "samples": [(labels, float("nan"))]})
+    if collector is not None:
+        for key, value in sorted(collector.attribution.gauges().items()):
+            families.append({
+                "name": task_metric_name(f"serving_{key}"),
+                "type": "gauge", "help": "",
+                "samples": [(labels, float(value))]})
     return render(families + REGISTRY.families())
 
 MAX_BODY_BYTES = 8 * 1024 * 1024
@@ -112,10 +123,19 @@ class _Handler(BaseHTTPRequestHandler):
     engine: ContinuousBatchingEngine      # injected by ServeFrontend
     migrate_targets: tuple = ()           # decode-replica base URLs
     on_migrated = None                    # hook(target_url) per handoff
+    collector = None                      # ReqTraceCollector (optional)
+    # per-path request counts, exported on /v1/traces — the accounting
+    # that lets a test PROVE trace export added no per-request RPCs
+    path_counts: dict = {}
+    path_counts_lock = threading.Lock()
     protocol_version = "HTTP/1.1"         # keep-alive + chunked streaming
 
     def log_message(self, fmt, *args):    # route through logging
         LOG.debug("serve: " + fmt, *args)
+
+    def _count(self, path: str) -> None:
+        with self.path_counts_lock:
+            self.path_counts[path] = self.path_counts.get(path, 0) + 1
 
     # -- plumbing -------------------------------------------------------
     def _json(self, obj, code: int = 200,
@@ -137,8 +157,21 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
         parsed = urlparse(self.path)
         path = parsed.path.rstrip("/")
+        self._count(path)
         if path == "/healthz":
             return self._json({"ok": True})
+        if path == "/v1/traces":
+            # PULL-only trace export: a non-destructive redacted
+            # snapshot of the tail-sampled buffer, plus this process's
+            # per-path request counts so a caller can audit that
+            # tracing itself generated zero extra requests
+            coll = self.collector
+            with self.path_counts_lock:
+                counts = dict(self.path_counts)
+            return self._json({
+                "process": coll.process if coll is not None else "",
+                "traces": coll.export() if coll is not None else [],
+                "http_requests": counts})
         if path == "/v1/load":
             # the fleet router's probe: a lock-free engine snapshot
             # (queue depth, free slots, draining, weights generation) —
@@ -148,14 +181,18 @@ class _Handler(BaseHTTPRequestHandler):
         if path in ("/v1/metrics", "/metrics"):
             if path == "/metrics" or self._wants_prometheus(parsed.query):
                 from tony_tpu.observability.prometheus import CONTENT_TYPE
-                data = engine_prometheus_text(self.engine).encode("utf-8")
+                data = engine_prometheus_text(
+                    self.engine, self.collector).encode("utf-8")
                 self.send_response(200)
                 self.send_header("Content-Type", CONTENT_TYPE)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
                 return
-            return self._json(self.engine.snapshot())
+            snap = dict(self.engine.snapshot())
+            if self.collector is not None:
+                snap.update(self.collector.attribution.gauges())
+            return self._json(snap)
         self._error(404, "not found")
 
     def _wants_prometheus(self, query: str) -> bool:
@@ -174,6 +211,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         path = urlparse(self.path).path.rstrip("/")
+        self._count(path)
         if path == "/v1/drain":
             # operator plane: begin connection draining (in-flight
             # requests finish, new submissions answer 503). Idempotent —
@@ -224,20 +262,36 @@ class _Handler(BaseHTTPRequestHandler):
                      f"overrides are not supported")
         migrate = bool(self.engine.role == "prefill"
                        and self.migrate_targets)
+        # request-scoped trace: adopt the router's (or client's) context
+        # from X-Tony-Trace, or mint a root — hop appends are in-process
+        # list writes, the tail sampler decides keep/drop at completion
+        ctx, _ = reqtrace.adopt_or_mint(
+            self.headers.get(reqtrace.HEADER))
+        t_ingress = time.monotonic()
+        trace = (self.collector.trace(ctx)
+                 if self.collector is not None else None)
         try:
             handle = self.engine.submit(prompt, max_new,
                                         migrate_out=migrate)
         except BudgetExceededError as e:
+            self._finish_rejected(trace, t_ingress, 400)
             return self._error(400, str(e))
         except QueueFullError as e:
+            self._finish_rejected(trace, t_ingress, 429, spilled=True)
             return self._error(429, str(e), {"Retry-After": "1"})
         except DrainingError as e:
             # the connection-draining contract: the router treats this as
             # "stop sending here" and fails the request over — the header
             # makes the state machine-readable without re-probing
+            self._finish_rejected(trace, t_ingress, 503)
             return self._error(503, str(e), {"X-Tony-Draining": "1"})
         except RuntimeError as e:           # engine stopped
+            self._finish_rejected(trace, t_ingress, 503)
             return self._error(503, str(e))
+        if trace is not None:
+            trace.request_id = str(handle.request_id)
+        handle.trace = trace
+        handle.trace_ctx = ctx
         if migrate:
             return self._generate_migrating(handle, req)
         if req.get("stream"):
@@ -254,6 +308,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._json({"tokens": tokens,
                     "finish_reason": handle.finish_reason,
                     "ttft_s": handle.ttft_s})
+
+    def _finish_rejected(self, trace, t_ingress: float, status: int,
+                         spilled: bool = False) -> None:
+        """Sample a request that never got an engine slot: 429 spills
+        and hard errors are unconditional keeps — exactly the traces an
+        operator wants when the fleet is shedding."""
+        if trace is None or self.collector is None:
+            return
+        now = time.monotonic()
+        trace.hop("frontend.reject",
+                  reqtrace.mono_to_wall_ms(t_ingress),
+                  reqtrace.mono_to_wall_ms(now),
+                  attrs={"http_status": status}, status="ERROR")
+        self.collector.finish(trace, (now - t_ingress) * 1000.0,
+                              error=not spilled, spilled=spilled)
 
     def _drain_body(self) -> None:
         """Read and discard the request body (bounded); an oversized one
@@ -328,16 +397,32 @@ class _Handler(BaseHTTPRequestHandler):
             meta, leaves = kvc.unpack_migration(body)
         except (ValueError, KeyError, TypeError) as e:
             return self._error(400, f"bad migration payload: {e}")
+        # the decode replica CONTINUES the prefill replica's trace: the
+        # forwarded X-Tony-Trace parents this process's hops under the
+        # sender's migrate span
+        ctx, _ = reqtrace.adopt_or_mint(
+            self.headers.get(reqtrace.HEADER))
+        t_ingress = time.monotonic()
+        trace = (self.collector.trace(ctx)
+                 if self.collector is not None else None)
         try:
             handle = self.engine.submit_migration(meta, leaves)
         except BudgetExceededError as e:
+            self._finish_rejected(trace, t_ingress, 400)
             return self._error(400, str(e))
         except QueueFullError as e:
+            self._finish_rejected(trace, t_ingress, 429, spilled=True)
             return self._error(429, str(e), {"Retry-After": "1"})
         except DrainingError as e:
+            self._finish_rejected(trace, t_ingress, 503)
             return self._error(503, str(e), {"X-Tony-Draining": "1"})
         except RuntimeError as e:
+            self._finish_rejected(trace, t_ingress, 503)
             return self._error(503, str(e))
+        if trace is not None:
+            trace.request_id = str(handle.request_id)
+        handle.trace = trace
+        handle.trace_ctx = ctx
         return self._stream(handle)
 
     # -- disaggregation: prefill side -----------------------------------
@@ -362,11 +447,34 @@ class _Handler(BaseHTTPRequestHandler):
                                "ttft_s": handle.ttft_s})
         meta = handle.migration["meta"]
         leaves = handle.migration["leaves"]
+        trace = getattr(handle, "trace", None)
+        t_pack = time.monotonic()
         payload = kvc.pack_migration(meta, leaves)
-        resp = self._post_migration(payload)
+        t_packed = time.monotonic()
+        pack_span = None
+        if trace is not None:
+            pack_span = trace.hop(
+                "migrate.pack", reqtrace.mono_to_wall_ms(t_pack),
+                reqtrace.mono_to_wall_ms(t_packed),
+                attrs={"bytes": len(payload)})
+        t_send = time.monotonic()
+        resp, target = self._post_migration(
+            payload, trace=getattr(handle, "trace_ctx", None),
+            parent_span=pack_span)
         if resp is not None:
-            return self._finish_migrated(handle, self._lines_from(resp),
-                                         bool(req.get("stream")))
+            if trace is not None:
+                # transfer = POST issued → response headers back (the
+                # decode replica admitted the handoff); the token relay
+                # after this is the decode hop, recorded on ITS side
+                trace.hop("migrate.transfer",
+                          reqtrace.mono_to_wall_ms(t_send),
+                          reqtrace.mono_to_wall_ms(time.monotonic()),
+                          attrs={"bytes": len(payload),
+                                 "target": str(target)},
+                          parent_id=pack_span)
+            self._finish_migrated(handle, self._lines_from(resp),
+                                  bool(req.get("stream")))
+            return self._finish_out_trace(handle)
         # degraded: no decode replica took it — self-install and finish
         LOG.warning("request %d: no decode replica accepted the "
                     "migration; finishing locally", handle.request_id)
@@ -376,24 +484,41 @@ class _Handler(BaseHTTPRequestHandler):
                 RuntimeError) as e:
             return self._error(
                 503, f"migration failed and local fallback refused: {e}")
-        return self._finish_migrated(handle,
-                                     self._lines_from_handle(local),
-                                     bool(req.get("stream")))
+        self._finish_migrated(handle, self._lines_from_handle(local),
+                              bool(req.get("stream")))
+        return self._finish_out_trace(handle)
+
+    def _finish_out_trace(self, handle) -> None:
+        """Tail-sample a migrated-out request AFTER the decode relay —
+        its duration is the client-observed total, so a slow decode
+        replica shows up in the prefill side's slowest table too."""
+        coll, trace = self.collector, getattr(handle, "trace", None)
+        if coll is None or trace is None:
+            return
+        duration_ms = 1000.0 * (time.monotonic() - handle.submitted_at)
+        coll.finish(trace, duration_ms, migrated=True)
 
     # tony: disable=redact-on-egress -- data-plane handoff: the payload is the request's own K/V bytes + sampler state, verbatim by contract
-    def _post_migration(self, payload: bytes):
+    def _post_migration(self, payload: bytes, trace=None,
+                        parent_span: Optional[str] = None):
         """Round-robin the decode pool; 4xx/5xx/transport refusals try
-        the next target. Returns the open (streaming) response, or None
-        when every target refused."""
+        the next target. Returns (open streaming response, target base),
+        or (None, None) when every target refused. The request trace
+        context rides X-Tony-Trace so the decode replica continues the
+        same trace, parented under this side's migrate.pack span."""
         targets = [t.rstrip("/") for t in self.migrate_targets if t]
         if not targets:
-            return None
+            return None, None
+        headers = {"Content-Type": "application/octet-stream"}
+        if trace is not None:
+            fwd = (trace.child(parent_span, trace.route_ms)
+                   if parent_span else trace)
+            headers[reqtrace.HEADER] = fwd.header_value()
         first = next(_MIGRATE_RR) % len(targets)
         for i in range(len(targets)):
             base = targets[(first + i) % len(targets)]
             rq = urllib.request.Request(
-                base + "/v1/migrate", data=payload,
-                headers={"Content-Type": "application/octet-stream"})
+                base + "/v1/migrate", data=payload, headers=headers)
             try:
                 resp = urllib.request.urlopen(
                     rq, timeout=STREAM_TOKEN_TIMEOUT_SEC)
@@ -410,8 +535,8 @@ class _Handler(BaseHTTPRequestHandler):
                     hook(base)
                 except Exception:  # noqa: BLE001 — observability only
                     LOG.debug("on_migrated hook failed", exc_info=True)
-            return resp
-        return None
+            return resp, base
+        return None, None
 
     @staticmethod
     def _lines_from(resp):
@@ -481,20 +606,59 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
 
 
+def install_engine_tracing(engine: ContinuousBatchingEngine,
+                           collector) -> None:
+    """Compose request-trace recording onto engine.on_request_finished:
+    engine-phase hops off the handle's stamps, the tail-sampling finish,
+    and the TTFT-attribution rollup. A migrated-OUT handle is NOT
+    finished here — the frontend finishes it after the decode relay so
+    its duration is the client-observed total. Chains any hook already
+    installed (serve/__main__'s lifecycle span recorder)."""
+    prev = engine.on_request_finished
+
+    def _on_finished(handle) -> None:
+        trace = getattr(handle, "trace", None)
+        if trace is not None:
+            reqtrace.record_engine_phases(trace, handle)
+            if handle.finish_reason != "migrated":
+                ctx = getattr(handle, "trace_ctx", None)
+                route_ms = ctx.route_ms if ctx is not None else 0.0
+                finished = getattr(handle, "finished_at", None)
+                submitted = getattr(handle, "submitted_at", None)
+                duration_ms = (1000.0 * (finished - submitted)
+                               if finished and submitted else 0.0)
+                collector.finish(
+                    trace, duration_ms,
+                    error=handle.finish_reason in ("error", "shutdown"),
+                    migrated=getattr(handle, "migrated_in", False))
+                collector.attribution.record(
+                    reqtrace.attribution_from_handle(
+                        handle, route_ms=route_ms))
+        if prev is not None:
+            prev(handle)
+
+    engine.on_request_finished = _on_finished
+
+
 class ServeFrontend:
     """Owns the HTTP server; the engine's lifecycle belongs to the caller
     (serve/__main__ starts the engine loop, tests may drive it manually)."""
 
     def __init__(self, engine: ContinuousBatchingEngine, port: int = 0,
                  host: str = "0.0.0.0", migrate_targets=(),
-                 on_migrated=None):
+                 on_migrated=None, collector=None):
         self.engine = engine
+        self.collector = collector
+        self.request_counts: dict = {}
         from tony_tpu.serve.router import BurstBacklogHTTPServer
         handler = type("BoundHandler", (_Handler,), {
             "engine": engine,
             "migrate_targets": tuple(migrate_targets or ()),
             "on_migrated": staticmethod(on_migrated)
             if on_migrated is not None else None,
+            "collector": collector,
+            "path_counts": self.request_counts,
+            "path_counts_lock": threading.Lock(),
         })
         self._httpd = BurstBacklogHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]

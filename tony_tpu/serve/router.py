@@ -58,6 +58,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import urlparse
 
+from tony_tpu.observability import reqtrace
+
 LOG = logging.getLogger(__name__)
 
 # generous per-request relay ceiling (matches the frontend's stream stall
@@ -183,11 +185,17 @@ class FleetRouter:
                  probe_ttl_ms: int = 500,
                  probe_timeout_ms: int = 1000,
                  spillover_retries: int = 2,
-                 dead_after_failures: int = 2):
+                 dead_after_failures: int = 2,
+                 collector=None):
         self.probe_ttl_s = max(probe_ttl_ms, 1) / 1000.0
         self.probe_timeout_s = max(probe_timeout_ms, 50) / 1000.0
         self.spillover_retries = max(0, spillover_retries)
         self.dead_after_failures = max(1, dead_after_failures)
+        # request-trace ingress: the router mints (or adopts) the trace
+        # context every request carries through the fleet; its own
+        # collector tail-samples the route-side view
+        self.collector = (collector if collector is not None
+                          else reqtrace.ReqTraceCollector("router"))
         self._lock = threading.Lock()
         self._endpoints: dict[str, Endpoint] = {}  # guarded-by: _lock
         self._probing: set[str] = set()            # guarded-by: _lock
@@ -402,12 +410,20 @@ class FleetRouter:
 
     # -- relay ----------------------------------------------------------
     # tony: disable=redact-on-egress -- data-plane relay: the payload is the client's own /v1/generate body, verbatim
-    def relay(self, body: bytes, send_response: Callable) -> None:
+    def relay(self, body: bytes, send_response: Callable,
+              headers: Optional[dict] = None) -> None:
         """Route one /v1/generate body: try replicas least-loaded first,
         spilling over on 429/5xx/transport errors. `send_response(status,
         headers, upstream_or_bytes)` is the handler-side writer —
         streaming is detected off the upstream Transfer-Encoding, never
-        by parsing the request body."""
+        by parsing the request body. `headers` are the client's request
+        headers: an X-Tony-Trace there is adopted, otherwise this
+        ingress mints the trace the whole fleet will carry."""
+        ctx, _ = reqtrace.adopt_or_mint(
+            (headers or {}).get(reqtrace.HEADER))
+        t_ingress = time.monotonic()
+        trace = (self.collector.trace(ctx)
+                 if self.collector is not None else None)
         tried: list[str] = []
         last_429 = None
         last_err: Optional[str] = None
@@ -428,9 +444,18 @@ class FleetRouter:
                 break
             ep, match_depth = picks[0]
             tried.append(ep.url)
+            # the route span's id goes on the wire BEFORE the hop is
+            # recorded — the replica's hops parent under it; route_ms
+            # rides the header so the replica's TTFT attribution can
+            # include the router's overhead without cross-host clocks
+            t_send = time.monotonic()
+            route_ms = 1000.0 * (t_send - t_ingress)
+            route_span = reqtrace.new_span_id()
             req = urllib.request.Request(
                 ep.url + "/v1/generate", data=body,
-                headers={"Content-Type": "application/json"})
+                headers={"Content-Type": "application/json",
+                         reqtrace.HEADER: ctx.child(
+                             route_span, route_ms).header_value()})
             try:
                 resp = urllib.request.urlopen(req,
                                               timeout=RELAY_TIMEOUT_SEC)
@@ -460,6 +485,9 @@ class FleetRouter:
                     self.stats["requests_routed"] += 1
                     self._note_affinity(prompt, match_depth)
                 send_response(e.code, dict(e.headers), payload)
+                self._finish_route_trace(
+                    trace, t_ingress, t_send, route_span, ep.url,
+                    match_depth, prompt, tried, e.code)
                 return
             except Exception as e:  # noqa: BLE001 — transport failure
                 self._note_failure(ep, "send")
@@ -472,18 +500,54 @@ class FleetRouter:
                 self.stats["requests_routed"] += 1
                 self._note_affinity(prompt, match_depth)
             send_response(resp.status, dict(resp.headers), resp)
+            # finished AFTER the full relay (including the token
+            # stream): the router-side duration is client-observed
+            self._finish_route_trace(
+                trace, t_ingress, t_send, route_span, ep.url,
+                match_depth, prompt, tried, resp.status)
             return
         with self._lock:
             self.stats["requests_failed"] += 1
         if last_429 is not None:
-            code, headers, payload = last_429
+            code, hdrs_429, payload = last_429
             send_response(code, {"Retry-After":
-                                 headers.get("Retry-After", "1")}, payload)
+                                 hdrs_429.get("Retry-After", "1")}, payload)
+            self._finish_route_trace(trace, t_ingress, time.monotonic(),
+                                     None, "", 0, prompt, tried, 429)
             return
         detail = last_err or "no serving replica available"
         send_response(503, {}, json.dumps(
             {"error": f"fleet unavailable: {detail}",
              "tried": tried}).encode("utf-8") + b"\n")
+        self._finish_route_trace(trace, t_ingress, time.monotonic(),
+                                 None, "", 0, prompt, tried, 503)
+
+    def _finish_route_trace(self, trace, t_ingress: float, t_send: float,
+                            route_span: Optional[str], target: str,
+                            match_depth: int, prompt: Optional[list],
+                            tried: list, status: int) -> None:
+        """Record the router.route hop and tail-sample the route-side
+        trace; route_ms feeds the router's own attribution rollup."""
+        if trace is None or self.collector is None:
+            return
+        route_ms = 1000.0 * (t_send - t_ingress)
+        now = time.monotonic()
+        attrs = {"target": target,
+                 "affinity": (("hit" if match_depth > 0 else "miss")
+                              if prompt else "n/a"),
+                 "match_depth": int(match_depth),
+                 "attempts": len(tried),
+                 "spilled": status == 429,
+                 "failed_over": len(tried) > 1,
+                 "http_status": int(status)}
+        trace.hop("router.route",
+                  reqtrace.mono_to_wall_ms(t_ingress),
+                  reqtrace.mono_to_wall_ms(t_send), attrs=attrs,
+                  status="OK" if status < 500 else "ERROR",
+                  span_id=route_span)
+        self.collector.attribution.record({"route_ms": route_ms})
+        self.collector.finish(trace, 1000.0 * (now - t_ingress),
+                              error=status >= 500, spilled=status == 429)
 
     def _note_affinity(self, prompt: Optional[list],
                        match_depth: int) -> None:
@@ -503,6 +567,61 @@ class FleetRouter:
             stats = dict(self.stats)
         return {"endpoints": self.endpoints(), "stats": stats,
                 "load": self.fleet_load()}
+
+    # -- trace pull + stitch --------------------------------------------
+    def collect_traces(self) -> dict:
+        """The fleet's stitched request traces: this router's own
+        sampled buffer merged with every replica's /v1/traces pull
+        (decode replicas included — routing skips them, tracing must
+        not). Pull-only by construction: replicas are contacted ONLY
+        when an operator asks for this surface, never per request."""
+        with self._lock:
+            urls = list(self._endpoints)
+        lists = [self.collector.export()
+                 if self.collector is not None else []]
+        pulled = {}
+        for url in urls:
+            try:
+                with urllib.request.urlopen(
+                        url + "/v1/traces",
+                        timeout=self.probe_timeout_s) as r:
+                    payload = json.loads(r.read().decode("utf-8"))
+            except Exception:  # noqa: BLE001 — a dead replica has no traces
+                pulled[url] = 0
+                continue
+            traces = payload.get("traces") or []
+            pulled[url] = len(traces)
+            lists.append(traces)
+        stitched = reqtrace.stitch(lists)
+        return {"traces": stitched,
+                "slowest": reqtrace.slowest_table(stitched),
+                "pulled": pulled}
+
+
+def router_prometheus_text(router: FleetRouter) -> str:
+    """The router's /metrics exposition: every stats counter as a
+    tony_router_*_total counter plus the route-overhead percentile
+    gauges — the same shared-encoder contract the serving frontend and
+    the AM use (observability/prometheus.py)."""
+    from tony_tpu.observability.prometheus import render, task_metric_name
+    with router._lock:
+        stats = dict(router.stats)
+    families = []
+    for key in sorted(stats):
+        families.append({
+            "name": task_metric_name(f"router_{key}_total"),
+            "type": "counter", "help": "",
+            "samples": [({}, float(stats[key]))]})
+    if router.collector is not None:
+        gauges = router.collector.attribution.gauges()
+        for tag in ("p50", "p95"):
+            value = gauges.get(f"ttft_attr_route_ms_{tag}")
+            if value is not None:
+                families.append({
+                    "name": task_metric_name(f"router_route_ms_{tag}"),
+                    "type": "gauge", "help": "",
+                    "samples": [({}, float(value))]})
+    return render(families)
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -532,6 +651,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return self._json({"ok": True, **self.router.fleet_load()})
         if path == "/v1/fleet":
             return self._json(self.router.bundle())
+        if path == "/v1/traces":
+            # on-demand stitch: this is the ONE moment replicas are
+            # asked for traces — operator-initiated, never per request
+            return self._json(self.router.collect_traces())
+        if path == "/metrics":
+            from tony_tpu.observability.prometheus import CONTENT_TYPE
+            data = router_prometheus_text(self.router).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
         self._json({"error": "not found"}, 404)
 
     def do_POST(self):  # noqa: N802
@@ -540,7 +672,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(length) if length > 0 else b""
         if path != "/v1/generate":
             return self._json({"error": "not found"}, 404)
-        self.router.relay(body, self._send_relayed)
+        self.router.relay(body, self._send_relayed,
+                          headers=dict(self.headers))
 
     def _send_relayed(self, status: int, headers: dict, payload) -> None:
         """Write one upstream response through: bytes verbatim, file-like

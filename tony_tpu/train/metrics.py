@@ -156,6 +156,8 @@ class TpuMetricsReporter:
                    "metrics": payload.get("metrics", [])}
             if payload.get("spans"):
                 req["spans"] = payload["spans"]
+            if payload.get("serving_traces"):
+                req["serving_traces"] = payload["serving_traces"]
             if payload.get("profile_done"):
                 req["profile_done"] = payload["profile_done"]
             if self._attempt >= 0:
@@ -200,12 +202,16 @@ class ServingMetricsReporter(TpuMetricsReporter):
 
     def __init__(self, sample_fn, env: Optional[dict] = None,
                  interval_sec: Optional[float] = None,
-                 span_source=None):
+                 span_source=None, trace_source=None):
         super().__init__(env=env)
         self._sample_fn = sample_fn
         # optional span drain (a SpanRecorder's .drain): finished
         # per-request serving spans ride the same periodic push
         self._span_source = span_source
+        # optional request-trace drain (a ReqTraceCollector's .drain):
+        # tail-sampled distributed request traces piggyback the same
+        # push — zero new channels, zero per-request RPCs
+        self._trace_source = trace_source
         if interval_sec is None:
             e = env if env is not None else os.environ
             interval_sec = float(e.get("TONY_METRICS_INTERVAL_SEC", "5"))
@@ -241,11 +247,19 @@ class ServingMetricsReporter(TpuMetricsReporter):
                 spans = self._span_source() or []
             except Exception:  # noqa: BLE001
                 LOG.debug("serving span drain failed", exc_info=True)
-        if not metrics and not spans:
+        traces: list[dict] = []
+        if self._trace_source is not None:
+            try:
+                traces = self._trace_source() or []
+            except Exception:  # noqa: BLE001
+                LOG.debug("serving trace drain failed", exc_info=True)
+        if not metrics and not spans and not traces:
             return
         payload: dict = {"metrics": metrics or []}
         if spans:
             payload["spans"] = spans
+        if traces:
+            payload["serving_traces"] = traces
         self._enqueue(payload)
 
     def close(self, timeout: float = 2.0) -> None:

@@ -484,6 +484,46 @@ def _run_prefix_point(config, args, sharing: bool) -> dict:
     return point
 
 
+def ttft_attribution(ttft_s, queue_wait_s=None, prefill_s=None,
+                     route_ms=0.0, migrate_ms=0.0) -> dict:
+    """Pure TTFT-attribution disclosure for one bench line (pinned by
+    the bench contract tests): where the p95 first-token time went, in
+    ms, under the canonical component order (observability/reqtrace
+    COMPONENTS). Sum-consistent BY CONSTRUCTION: the components plus
+    ``ttft_attr_unattributed_ms`` always total ``ttft_attr_total_ms``
+    exactly — decode is the first-token remainder after queue+prefill+
+    migrate when those phases were measured, and anything the bench
+    could not observe (e.g. per-replica phases behind a router) lands
+    in the unattributed bucket instead of being invented."""
+    route = max(0.0, float(route_ms or 0.0))
+    ttft_ms = 1000.0 * float(ttft_s or 0.0)
+    total = route + ttft_ms
+    migrate = max(0.0, float(migrate_ms or 0.0))
+    queue = 1000.0 * float(queue_wait_s) if queue_wait_s is not None \
+        else 0.0
+    prefill = 1000.0 * float(prefill_s) if prefill_s is not None else 0.0
+    if queue_wait_s is not None and prefill_s is not None:
+        decode = max(0.0, ttft_ms - queue - prefill - migrate)
+    else:
+        decode = 0.0
+    unattributed = total - route - queue - prefill - migrate - decode
+    out = {"ttft_attr_route_ms": route,
+           "ttft_attr_queue_ms": queue,
+           "ttft_attr_prefill_ms": prefill,
+           "ttft_attr_migrate_ms": migrate,
+           "ttft_attr_decode_ms": decode,
+           "ttft_attr_unattributed_ms": unattributed,
+           "ttft_attr_total_ms": total}
+    # one rounding pass, remainder-corrected so the rounded values STILL
+    # sum exactly (the contract test checks the emitted numbers)
+    rounded = {k: round(v, 3) for k, v in out.items()}
+    drift = rounded["ttft_attr_total_ms"] - sum(
+        v for k, v in rounded.items() if k != "ttft_attr_total_ms")
+    rounded["ttft_attr_unattributed_ms"] = round(
+        rounded["ttft_attr_unattributed_ms"] + drift, 3)
+    return rounded
+
+
 def build_prefix_history_entries(on: dict, off: dict, model: str,
                                  reuse_ratio: float) -> list:
     """Gate + build the prefix-reuse trajectory entries (pure — pinned
@@ -568,6 +608,7 @@ def run_prefix_reuse(args) -> int:
         "max_new": args.max_new,
         "model": args.config,
     }
+    result.update(ttft_attribution(on["ttft_p95_s"]))
     print(json.dumps(result, separators=(",", ":")), flush=True)
     return 0
 
@@ -621,6 +662,9 @@ def run_fleet(args) -> int:
         "max_new": args.max_new,
         "model": args.config,
     }
+    # client-side view only: per-replica queue/prefill phases are not
+    # visible through the router, so they land in unattributed
+    result.update(ttft_attribution(head["ttft_p95_s"]))
     # two gated trajectory entries: aggregate throughput (higher-is-
     # better) and the fleet TTFT tail (unit "s" → lower-is-better)
     append_history({
@@ -798,6 +842,9 @@ def main() -> int:
         "model": args.config,
         "elapsed_s": round(elapsed, 2),
     }
+    result.update(ttft_attribution(result["ttft_p95_s"],
+                                   snap.get("queue_wait_s_p95"),
+                                   snap.get("prefill_s_p95")))
     print(json.dumps(result, separators=(",", ":")), flush=True)
     return 0
 

@@ -9,7 +9,8 @@ from tools.tonylint.rules_legacy import (AlertHotLoopRule,
                                          GaugeRegistryRule, PrintBanRule,
                                          RendererCoverageRule)
 from tools.tonylint.rules_locks import GuardedByRule, NoBlockingUnderLockRule
-from tools.tonylint.rules_rpc import AttemptFencingRule, RedactOnEgressRule
+from tools.tonylint.rules_rpc import (AttemptFencingRule, RedactOnEgressRule,
+                                      TracePropagationRule)
 from tools.tonylint.rules_threads import ThreadHygieneRule
 
 
@@ -19,6 +20,7 @@ def default_rules() -> list[Rule]:
         NoBlockingUnderLockRule(),
         AttemptFencingRule(),
         RedactOnEgressRule(),
+        TracePropagationRule(),
         ConfigKeyRegistryRule(),
         ThreadHygieneRule(),
         PrintBanRule(),

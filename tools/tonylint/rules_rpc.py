@@ -12,7 +12,16 @@ redact-on-egress: anything that leaves the process boundary toward an
 operator surface — webhook POSTs, sink files, live log-tail chunks —
 must flow through ``logs.redact`` (PR 6/9). The rule finds egress
 functions (urlopen/Request with a payload, ``*Sink`` delivery methods,
-the log-tail readers) and requires a redact call in their bodies.
+the log-tail readers, trace-export surfaces: ``*Collector`` export/
+drain methods and the serving-traces sidecar writer) and requires a
+redact call in their bodies.
+
+trace-propagation: any outbound HTTP request in ``tony_tpu/serve/``
+that targets another replica's data plane (``/v1/generate`` or
+``/v1/migrate`` in the URL) must forward the request-trace header —
+a hop that drops ``X-Tony-Trace`` silently severs the distributed
+trace at that boundary, and the stitched waterfall then blames the
+wrong process for the missing time.
 """
 
 from __future__ import annotations
@@ -101,6 +110,13 @@ def _is_egress_fn(fn: ast.FunctionDef, cls_name: str) -> str:
         return f"{cls_name}.{fn.name} is a delivery sink"
     if (cls_name, fn.name) in LOG_TAIL_READERS:
         return f"{cls_name}.{fn.name} produces log-tail payloads"
+    # trace-export surfaces: pull-endpoint snapshots and the history
+    # sidecar both carry request traces (prompts ride in hop attrs if a
+    # bug ever leaks them) to CLI/portal consumers
+    if cls_name.endswith("Collector") and fn.name in ("export", "drain"):
+        return f"{cls_name}.{fn.name} exports request-trace payloads"
+    if fn.name == "write_serving_traces_file":
+        return "writes the serving-traces history sidecar"
     for child in ast.walk(fn):
         if not isinstance(child, ast.Call):
             continue
@@ -142,3 +158,62 @@ class RedactOnEgressRule(Rule):
                 f"{fn.name}() {reason} but never calls redact() / "
                 f"redact_payload() — secrets could cross the egress "
                 f"boundary unredacted")
+
+
+# replica-to-replica data-plane paths: a request forwarded here is part
+# of ONE client request's distributed trace
+TRACED_PATHS = ("/v1/generate", "/v1/migrate")
+TRACE_DIRS = ("tony_tpu/serve/",)
+
+
+def _builds_traced_request(call: ast.Call) -> str:
+    """The traced path literal when `call` constructs an HTTP request to
+    another replica's data plane, else ''."""
+    name = dotted_name(call.func)
+    if name.rsplit(".", 1)[-1] != "Request":
+        return ""
+    if not call.args:
+        return ""
+    for child in ast.walk(call.args[0]):
+        if isinstance(child, ast.Constant) and isinstance(child.value, str):
+            for path in TRACED_PATHS:
+                if path in child.value:
+                    return path
+    return ""
+
+
+def _forwards_trace_header(fn: ast.AST) -> bool:
+    for child in ast.walk(fn):
+        if isinstance(child, ast.Constant) and child.value == "X-Tony-Trace":
+            return True
+        if isinstance(child, ast.Attribute) and child.attr == "HEADER":
+            return True
+    return False
+
+
+class TracePropagationRule(Rule):
+    id = "trace-propagation"
+    description = ("outbound /v1/generate and /v1/migrate requests in "
+                   "tony_tpu/serve/ must forward the X-Tony-Trace "
+                   "header so the distributed trace survives the hop")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            if not pf.relpath.startswith(TRACE_DIRS):
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for child in ast.walk(node):
+                    if not isinstance(child, ast.Call):
+                        continue
+                    path = _builds_traced_request(child)
+                    if path and not _forwards_trace_header(node):
+                        yield Finding(
+                            self.id, pf.relpath, child.lineno,
+                            f"{node.name}() POSTs {path} to another "
+                            f"replica without forwarding the "
+                            f"X-Tony-Trace header — the distributed "
+                            f"trace is severed at this hop")
+                        break  # one finding per function is enough
