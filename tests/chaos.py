@@ -73,6 +73,21 @@ class SilenceHeartbeats(Injection):
                 f"{self.job}#{self.index}#{self.attempt}"}
 
 
+class WedgeTask(Injection):
+    """One attempt's executor parks its MAIN thread forever in
+    `_tony_test_wedge` right after the gang barrier — alive but making
+    no progress (executor hook TEST_TASK_WEDGE). Combined with
+    SilenceHeartbeats this is the canonical wedge-autopsy case: the AM's
+    expiry path must pull the stack dump and name the parked frame."""
+
+    def __init__(self, job: str, index: int, attempt: "int | str" = 0):
+        self.job, self.index, self.attempt = job, index, attempt
+
+    def env(self) -> dict:
+        return {C.TEST_TASK_WEDGE:
+                f"{self.job}#{self.index}#{self.attempt}"}
+
+
 class MissHeartbeats(Injection):
     """Every executor skips its first `n` heartbeats
     (TEST_TASK_EXECUTOR_NUM_HB_MISS, TaskExecutor.java:334-344)."""

@@ -495,6 +495,9 @@ class _HarnessHandler(ClusterServiceHandler):
     def get_alerts(self, req):
         return {"error": "harness"}
 
+    def get_profile(self, req):
+        return {"error": "harness"}
+
     def request_preemption(self, req):
         return {"error": "harness"}
 

@@ -1,0 +1,400 @@
+"""Always-on control-plane profiler + stall watchdog
+(observability/profiler.py) and the wedge-autopsy path built on it.
+
+Four layers:
+
+1. units — FoldTable boundedness and collapsed-fold format, beacon
+   staleness vs the idle exemption, cross-process stack-dump redaction,
+   dominant-frame selection, the profiler's overhead self-measurement
+   and past-budget throttle, watchdog detect/clear latch semantics;
+2. wiring — ``install_process_profiler`` honors ``tony.profiler.enabled``
+   and ``enable_crash_dumps`` reports its success;
+3. lint fixtures — the ``watchdog-beacon`` and ``process-entry-profiler``
+   rules fire / stay silent / suppress like every other shipped rule;
+4. chaos e2e — a wedged executor (TEST_TASK_WEDGE + silenced
+   heartbeats) is autopsied end to end: diagnostics.json's ``stacks``
+   section names the parked frame and the history carries a latched
+   PROCESS_STALL_DETECTED / _CLEARED pair.
+"""
+
+import inspect
+import random
+import signal
+import threading
+import time
+
+import pytest
+
+from tony_tpu.conf import TonyConfiguration, keys as K
+from tony_tpu.events.schema import EventType
+from tony_tpu.observability.logs import redact
+from tony_tpu.observability.profiler import (
+    DEFAULT_HZ, OTHER_KEY, OVERHEAD_BUDGET_PCT, STALL_CLEARED,
+    STALL_DETECTED, Beacon, FoldTable, SamplingProfiler, StallWatchdog,
+    _reset_beacons, beacons, collect_thread_stacks, dominant_frame,
+    enable_crash_dumps, fold_frames, install_process_profiler,
+    register_beacon,
+)
+
+from tests.chaos import ChaosRun, SilenceHeartbeats, WedgeTask, script
+
+pytestmark = pytest.mark.profiler
+
+
+@pytest.fixture(autouse=True)
+def _fresh_beacon_registry():
+    """The beacon registry is process-global; isolate every test."""
+    _reset_beacons()
+    yield
+    _reset_beacons()
+
+
+# ---------------------------------------------------------------------------
+# FoldTable: bounded collapsed-stack histogram
+# ---------------------------------------------------------------------------
+
+def test_fold_table_bounds_distinct_stacks_and_discloses_drops():
+    table = FoldTable(max_stacks=2)
+    table.add("t;a.f")
+    table.add("t;b.g")
+    for _ in range(3):
+        table.add("t;c.h")        # over the cap: folds into (other)
+    table.add("t;a.f")            # existing key still accumulates at cap
+    snap = table.snapshot()
+    assert snap == {"t;a.f": 2, "t;b.g": 1, OTHER_KEY: 3}
+    assert table.dropped == 3
+
+
+def test_fold_table_folded_is_hottest_first_flamegraph_lines():
+    table = FoldTable()
+    table.add("t;cold.f", 1)
+    table.add("t;hot.g", 5)
+    assert table.folded() == "t;hot.g 5\nt;cold.f 1\n"
+    assert FoldTable().folded() == ""
+
+
+def test_fold_frames_labels_are_module_dot_function_leafward():
+    labels = fold_frames(inspect.currentframe())
+    assert labels[-1] == "test_profiler." \
+        "test_fold_frames_labels_are_module_dot_function_leafward"
+    assert all("." in lab for lab in labels)
+
+
+# ---------------------------------------------------------------------------
+# Beacon: staleness with the idle exemption
+# ---------------------------------------------------------------------------
+
+def test_beacon_staleness_and_idle_exemption():
+    b = Beacon("loop", cadence_sec=1.0)
+    far = time.monotonic() + 100.0
+    # never beaten -> IDLE -> exempt no matter how old
+    assert not b.is_stale(4.0, now=far)
+    b.beat()
+    assert not b.is_stale(4.0, now=time.monotonic())   # fresh
+    assert b.is_stale(4.0, now=far)                    # ACTIVE + old = wedge
+    assert b.age_sec(now=far) > 99.0
+    b.idle()                                           # blocking on work
+    assert not b.is_stale(4.0, now=far)
+
+
+def test_register_beacon_replaces_by_name():
+    first = register_beacon("loop", 1.0)
+    second = register_beacon("loop", 2.0)
+    assert beacons() == [second] and first is not second
+
+
+# ---------------------------------------------------------------------------
+# stack snapshots: redaction + dominant-frame attribution
+# ---------------------------------------------------------------------------
+
+def test_collect_thread_stacks_shape_and_leaf_first_frames():
+    threads = collect_thread_stacks(redactor=None)
+    me = [t for t in threads if t["ident"] == threading.get_ident()]
+    assert len(me) == 1
+    # leaf-first: the capture itself is the leaf, this function is next
+    assert "profiler.py" in me[0]["frames"][0]
+    assert ":collect_thread_stacks" in me[0]["frames"][0]
+    assert ":test_collect_thread_stacks_shape_and_leaf_first_frames" \
+        in me[0]["frames"][1]
+    assert isinstance(me[0]["daemon"], bool)
+
+
+def test_collect_thread_stacks_redacts_on_the_way_out():
+    # default: the shared log redactor (dumps cross process boundaries)
+    sig = inspect.signature(collect_thread_stacks)
+    assert sig.parameters["redactor"].default is redact
+    threads = collect_thread_stacks(redactor=lambda s: "X")
+    assert threads and all(t["name"] == "X" for t in threads)
+    assert all(f == "X" for t in threads for f in t["frames"])
+
+
+def test_dominant_frame_prefers_ident_then_main_then_non_self():
+    threads = [
+        {"name": "tony-profiler", "ident": 1, "frames": ["p.py:1:prof"]},
+        {"name": "MainThread", "ident": 2,
+         "frames": ["m.py:9:leaf", "m.py:1:root"]},
+        {"name": "worker", "ident": 3, "frames": ["w.py:5:spin"]},
+    ]
+    assert dominant_frame(threads, ident=3) == "w.py:5:spin"
+    assert dominant_frame(threads) == "m.py:9:leaf"
+    no_main = [t for t in threads if t["name"] != "MainThread"]
+    assert dominant_frame(no_main) == "w.py:5:spin"   # skips profiler's own
+    assert dominant_frame([]) == ""
+
+
+# ---------------------------------------------------------------------------
+# SamplingProfiler: attribution, self-overhead, past-budget throttle
+# ---------------------------------------------------------------------------
+
+def _park(evt):
+    evt.wait()
+
+
+def test_sampler_attributes_stacks_per_thread_and_excludes_itself():
+    evt = threading.Event()
+    t = threading.Thread(target=_park, name="park-thread", args=(evt,),
+                         daemon=True)
+    t.start()
+    try:
+        prof = SamplingProfiler("unit", rng=random.Random(0))
+        prof.sample_once()          # called inline; the thread never runs
+        folded = prof.folded_text()
+        assert "park-thread;" in folded
+        assert "test_profiler._park" in folded
+        # the sampling thread itself is cost, not workload
+        assert "tony-profiler;" not in folded
+    finally:
+        evt.set()
+        t.join(timeout=5)
+
+
+def test_sampler_measures_its_own_overhead():
+    prof = SamplingProfiler("unit", rng=random.Random(0))
+    assert prof.overhead_pct() == 0.0
+    for _ in range(4):
+        prof.sample_once()
+    snap = prof.snapshot()
+    assert snap["samples"] == 4
+    assert snap["overhead_pct"] > 0.0            # walking frames costs
+    assert snap["overhead_budget_pct"] == OVERHEAD_BUDGET_PCT == 1.0
+    assert snap["hz"] == DEFAULT_HZ
+    assert snap["throttle"] == 1.0               # nowhere near budget
+
+
+def test_sampler_throttles_itself_past_budget_instead_of_blowing_it():
+    # an impossible budget: every sample is over it, so the profiler must
+    # back its own cadence off (doubling, capped) rather than keep paying
+    prof = SamplingProfiler("unit", overhead_budget_pct=0.0,
+                            rng=random.Random(0))
+    base_interval = 1.0 / prof.hz
+    for _ in range(20):
+        prof.sample_once()
+    snap = prof.snapshot()
+    assert 1.0 < snap["throttle"] <= 32.0
+    # the throttle stretches the sampling interval (jitter is +/-25%)
+    assert prof._interval() > base_interval * snap["throttle"] * 0.75 * 0.99
+
+
+# ---------------------------------------------------------------------------
+# StallWatchdog: latched detect/clear pairs, idle loops exempt
+# ---------------------------------------------------------------------------
+
+def test_watchdog_latches_one_detect_then_one_clear():
+    events = []
+    beacon = register_beacon("loop", 0.05)
+    beacon.beat()
+    wd = StallWatchdog("unit-proc", stall_factor=2.0,
+                       event_sink=lambda n, p: events.append((n, p)))
+    far = time.monotonic() + 10.0
+    wd.check_once(now=far)
+    wd.check_once(now=far + 1.0)      # latched: no detect storm
+    assert [n for n, _ in events] == [STALL_DETECTED]
+    name, payload = events[0]
+    assert payload["process"] == "unit-proc"
+    assert payload["beacon"] == "loop"
+    assert payload["stalled_ms"] > payload["cadence_ms"]
+    # the beat came from this thread, so attribution lands on our leaf
+    assert payload["blocking_frame"]
+    assert "loop" in wd.stalled()
+    beacon.beat()                     # progress resumes
+    wd.check_once(now=time.monotonic())
+    assert [n for n, _ in events] == [STALL_DETECTED, STALL_CLEARED]
+    assert events[1][1]["beacon"] == "loop"
+    assert wd.stalled() == {}
+
+
+def test_watchdog_ignores_idle_beacons():
+    events = []
+    beacon = register_beacon("queue-loop", 0.05)
+    beacon.idle()                     # blocked on work arrival, not wedged
+    wd = StallWatchdog("unit-proc",
+                       event_sink=lambda n, p: events.append((n, p)))
+    wd.check_once(now=time.monotonic() + 1000.0)
+    assert events == []
+
+
+def test_watchdog_sink_failure_never_escapes():
+    beacon = register_beacon("loop", 0.05)
+    beacon.beat()
+    wd = StallWatchdog("unit-proc",
+                       event_sink=lambda n, p: 1 / 0)
+    wd.check_once(now=time.monotonic() + 10.0)    # must not raise
+    assert "loop" in wd.stalled()
+
+
+# ---------------------------------------------------------------------------
+# wiring: one-call install + crash dumps
+# ---------------------------------------------------------------------------
+
+def test_install_process_profiler_respects_enabled_flag():
+    conf = TonyConfiguration()
+    conf.set(K.PROFILER_ENABLED, False, "test")
+    assert install_process_profiler("unit", conf=conf) == (None, None)
+
+
+def test_install_process_profiler_returns_running_pair():
+    conf = TonyConfiguration()
+    conf.set(K.PROFILER_HZ, 5, "test")
+    prof, wd = install_process_profiler("unit", conf=conf)
+    try:
+        assert isinstance(prof, SamplingProfiler) and prof.is_alive()
+        assert isinstance(wd, StallWatchdog) and wd.is_alive()
+        assert prof.hz == 5.0
+    finally:
+        prof.stop()
+        wd.stop()
+
+
+def test_enable_crash_dumps_registers_signal():
+    assert enable_crash_dumps(signal.SIGUSR2) is True
+
+
+# ---------------------------------------------------------------------------
+# lint fixtures: the two profiler-coverage rules
+# ---------------------------------------------------------------------------
+
+BEACON_OFFENDER = '''
+import threading
+
+class Pusher(threading.Thread):
+    def run(self):
+        while not self._stop.wait(1.0):
+            self._push_once()
+'''
+
+BEACON_CLEAN = '''
+import threading
+from tony_tpu.observability.profiler import register_beacon
+
+class Pusher(threading.Thread):
+    def run(self):
+        beacon = register_beacon("pusher", 1.0)
+        while not self._stop.wait(1.0):
+            beacon.beat()
+            self._push_once()
+        beacon.idle()
+'''
+
+BEACON_SUPPRESSED = '''
+import threading
+
+class Pusher(threading.Thread):
+    # tony: disable=watchdog-beacon -- the observer cannot watch itself
+    def run(self):
+        while not self._stop.wait(1.0):
+            self._push_once()
+'''
+
+BEACON_TARGET_OFFENDER = '''
+import threading
+
+class Mover:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.move_once()
+'''
+
+
+@pytest.mark.lint
+def test_watchdog_beacon_rule_fixtures(tmp_path):
+    from tests.test_lint import _run
+    from tools.tonylint.rules_profiler import WatchdogBeaconRule
+    findings = _run(tmp_path, {"tony_tpu/am/x.py": BEACON_OFFENDER},
+                    [WatchdogBeaconRule()])
+    assert [f.rule for f in findings] == ["watchdog-beacon"]
+    assert "run()" in findings[0].message
+    findings = _run(tmp_path, {"tony_tpu/am/x.py": BEACON_TARGET_OFFENDER},
+                    [WatchdogBeaconRule()])
+    assert [f.rule for f in findings] == ["watchdog-beacon"]
+    assert _run(tmp_path, {"tony_tpu/am/x.py": BEACON_CLEAN},
+                [WatchdogBeaconRule()]) == []
+    assert _run(tmp_path, {"tony_tpu/am/x.py": BEACON_SUPPRESSED},
+                [WatchdogBeaconRule()]) == []
+
+
+@pytest.mark.lint
+def test_process_entry_profiler_rule_fixtures(tmp_path):
+    from tests.test_lint import _run
+    from tools.tonylint.rules_profiler import ENTRY_FILES, \
+        ProcessEntryProfilerRule
+    wired = ("from tony_tpu.observability.profiler import "
+             "install_process_profiler\n"
+             "install_process_profiler('am')\n")
+    dark = "def main():\n    return 0\n"
+    # one wired entry: only the others are findings
+    findings = _run(tmp_path, {"tony_tpu/am/__main__.py": wired},
+                    [ProcessEntryProfilerRule()])
+    assert len(findings) == len(ENTRY_FILES) - 1
+    assert "tony_tpu/am/__main__.py" not in [f.path for f in findings]
+    # present but dark: flagged by name
+    findings = _run(tmp_path, {"tony_tpu/am/__main__.py": dark},
+                    [ProcessEntryProfilerRule()])
+    am = [f for f in findings if f.path == "tony_tpu/am/__main__.py"]
+    assert len(am) == 1 and "install_process_profiler" in am[0].message
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: the wedge autopsy, end to end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_wedged_executor_autopsy_names_the_blocking_frame(tmp_path):
+    """A worker parks forever post-barrier with its heartbeater silenced
+    — alive but wedged. The AM's expiry path must pull the executor's
+    stack dump over the token-authed log service, put the parked frame
+    into diagnostics.json's `stacks` section, and latch exactly one
+    PROCESS_STALL_DETECTED / _CLEARED pair in history."""
+    run = ChaosRun(tmp_path, seed=21)
+    run.run(
+        ["--executes", script("sleep_30.py"),
+         "--conf", "tony.worker.instances=1",
+         "--conf", "tony.task.max-missed-heartbeats=5",
+         "--conf", "tony.task.max-task-attempts=1"],
+        injections=[WedgeTask("worker", 0, attempt=0),
+                    SilenceHeartbeats("worker", 0, attempt=0)])
+    assert run.final_status == "FAILED", run.all_logs()
+
+    # the autopsy: diagnostics.json carries the wedged executor's stacks
+    diag = run.diagnostics()
+    stacks = diag.get("stacks") or {}
+    assert "worker:0" in stacks, (diag, run.all_logs())
+    rec = stacks["worker:0"]
+    assert rec["reason"].startswith("missed"), rec
+    # not "it missed heartbeats" but WHERE it is stuck, by name
+    assert "_tony_test_wedge" in rec["blocking_frame"], rec
+    assert any("_tony_test_wedge" in f
+               for t in rec["threads"] for f in t["frames"]), rec
+
+    # latched pair in history: one detect naming the frame, one clear
+    det = [e for e in run.events_of_type(EventType.PROCESS_STALL_DETECTED)
+           if e.payload.task_id == "worker:0"]
+    assert len(det) == 1, run.all_logs()
+    assert det[0].payload.process == "executor:worker:0"
+    assert "_tony_test_wedge" in det[0].payload.blocking_frame
+    clr = [e for e in run.events_of_type(EventType.PROCESS_STALL_CLEARED)
+           if e.payload.task_id == "worker:0"]
+    assert len(clr) == 1, run.all_logs()
+    assert clr[0].payload.reason == "teardown"

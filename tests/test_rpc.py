@@ -88,6 +88,9 @@ class FakeClusterHandler(ClusterServiceHandler):
     def get_alerts(self, req):
         return {"firing": [], "log": [], "rules": []}
 
+    def get_profile(self, req):
+        return {"folded": "", "process": "fake"}
+
     def request_preemption(self, req):
         self.preemptions = getattr(self, "preemptions", [])
         self.preemptions.append(req)

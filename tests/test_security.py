@@ -55,6 +55,9 @@ class FakeHandler:
     def get_alerts(self, req):
         return {"firing": [], "log": []}
 
+    def get_profile(self, req):
+        return {"folded": "", "process": "fake"}
+
     def read_task_logs(self, req):
         return {"data": "", "next_offset": 0, "eof": False}
 

@@ -27,7 +27,14 @@ def main(argv: list[str] | None = None) -> int:
     configure_structured_logging(app_id=args.app_id, trace_id=args.app_id)
     conf_path = os.path.join(args.app_dir, C.TONY_FINAL_CONF)
     conf = TonyConfiguration.read(conf_path)
+    # always-on control-plane profiler + stall watchdog + faulthandler
+    # (SIGUSR2 → all-thread dump): the AM adopts the pair so stall
+    # transitions land in the job history and the collapsed-stack
+    # profile flushes to profile.folded at finish
+    from tony_tpu.observability.profiler import install_process_profiler
+    profiler, watchdog = install_process_profiler("am", conf=conf)
     am = ApplicationMaster(conf, app_id=args.app_id, app_dir=args.app_dir)
+    am.adopt_profiler(profiler, watchdog)
 
     # Graceful shutdown on SIGTERM: behave as if the client signaled finish so
     # the monitor loop exits, containers are stopped by _teardown, and the

@@ -44,6 +44,7 @@ from tony_tpu.events.schema import (
     AlertFiring, AlertResolved, AmRecoveryCompleted, AmRecoveryStarted,
     ApplicationFinished, ApplicationInited, AutoscaleDecision,
     DiagnosticsReady, Event, EventType, Preempted, PreemptionRequested,
+    ProcessStallCleared, ProcessStallDetected,
     ProfileCaptured, Resumed, RollingUpdateCompleted, RollingUpdateStarted,
     ServingEndpointRegistered, ServingMigrated, SloViolation,
     StragglerCleared,
@@ -468,6 +469,22 @@ class ApplicationMaster(ClusterServiceHandler):
         self._log_chunk_bytes = conf.get_int(K.LOGS_CHUNK_BYTES, 32768)
         self._diag_lines = conf.get_int(K.LOGS_DIAGNOSTICS_LINES, 200)
         self._log_addrs: dict[str, tuple[int, str]] = {}  # guarded-by: _lock
+        # wedge autopsy (observability/profiler.py): when liveliness
+        # expiry / the registration deadline / recovery settle declares a
+        # task suspect, its executor's redacted all-thread stack dump is
+        # pulled over the SAME token-authed log service and folded into
+        # diagnostics.json — task_id -> {attempt, generated_ms,
+        # blocking_frame, threads}. _remote_stalls latches the
+        # PROCESS_STALL_DETECTED event per task so the history carries
+        # exactly one detect/clear pair per wedge, never a storm.
+        self._task_stacks: dict[str, dict] = {}  # guarded-by: _lock
+        self._remote_stalls: dict[str, dict] = {}  # guarded-by: _lock
+        # in-process continuous profiler + stall watchdog, handed over by
+        # __main__ (or a harness) via adopt_profiler — the AM flushes the
+        # collapsed-stack profile into history at finish and serves it
+        # live over get_profile
+        self._profiler = None
+        self._stall_watchdog = None
         # follow-mode polls arrive every ~500 ms per follower: reuse ONE
         # channel per (task, attempt, addr) instead of a fresh TCP+HTTP/2
         # handshake per chunk; displaced entries are closed
@@ -998,6 +1015,12 @@ class ApplicationMaster(ClusterServiceHandler):
                 write_alerts_file(self.history_dir,
                                   self.alert_engine.bundle())
                 self.alert_engine.drain(timeout_s=3.0)
+            if self._profiler is not None:
+                # the control-plane flamegraph travels with the history:
+                # collapsed-stack text, redacted at flush
+                from tony_tpu.events.history import write_profile_file
+                write_profile_file(self.history_dir,
+                                   self._profiler.folded_text())
         except Exception:  # noqa: BLE001 — observability must not fail _finish
             LOG.exception("failed to flush spans/metrics into history")
 
@@ -1122,6 +1145,152 @@ class ApplicationMaster(ClusterServiceHandler):
                     task_id, max(attempt, 0), reason,
                     record.get("signature", "none"))
 
+    # ------------------------------------------------------------------
+    # continuous profiler + wedge autopsy (observability/profiler.py)
+    # ------------------------------------------------------------------
+    def adopt_profiler(self, profiler, watchdog) -> None:
+        """Adopt the process-wide SamplingProfiler/StallWatchdog pair
+        installed by __main__ (or a test harness): the watchdog's
+        latched stall transitions become history events, the profiler's
+        collapsed-stack table is served live over get_profile and
+        flushed into history as profile.folded at finish."""
+        self._profiler = profiler
+        self._stall_watchdog = watchdog
+        if watchdog is not None:
+            watchdog.set_event_sink(self._on_stall_event)
+
+    def _on_stall_event(self, name: str, payload: dict) -> None:
+        """StallWatchdog sink: a local daemon loop's latched stall
+        transition (detect/clear, never a storm) lands in the event
+        history next to the task lifecycle it wedged."""
+        from tony_tpu.observability.profiler import STALL_DETECTED
+        try:
+            if name == STALL_DETECTED:
+                self.event_handler.emit(Event(
+                    EventType.PROCESS_STALL_DETECTED,
+                    ProcessStallDetected(
+                        process=str(payload.get("process", "am")),
+                        beacon=str(payload.get("beacon", "")),
+                        stalled_ms=float(payload.get("stalled_ms", 0.0)),
+                        cadence_ms=float(payload.get("cadence_ms", 0.0)),
+                        blocking_frame=str(
+                            payload.get("blocking_frame", "")))))
+            else:
+                self.event_handler.emit(Event(
+                    EventType.PROCESS_STALL_CLEARED,
+                    ProcessStallCleared(
+                        process=str(payload.get("process", "am")),
+                        beacon=str(payload.get("beacon", "")),
+                        stalled_ms=float(payload.get("stalled_ms", 0.0)),
+                        blocking_frame=str(
+                            payload.get("blocking_frame", "")),
+                        reason="recovered")))
+        except Exception:  # noqa: BLE001 — observability must not kill the AM
+            LOG.exception("failed to emit stall event")
+
+    def _capture_task_stacks(self, task_id: str, attempt: int,
+                             reason: str) -> Optional[dict]:
+        """Wedge autopsy: pull the suspect executor's redacted all-thread
+        stack dump over its token-authed log service (the read runs on a
+        gRPC worker thread over there, so it answers even while the
+        executor's MAIN thread is parked in the wedged frame). The
+        capture feeds the diagnostics bundle's `stacks` section and
+        latches one PROCESS_STALL_DETECTED event naming the dominant
+        blocking frame. Best-effort: a crashed (vs wedged) executor
+        simply doesn't answer and the autopsy records nothing — the
+        distinction is itself the diagnosis."""
+        with self._lock:
+            entry = self._log_addrs.get(task_id)
+        if entry is None:
+            return None
+        try:
+            client = self._log_client(task_id, entry[0], entry[1])
+            dump = client.read_stacks()
+        except Exception:  # noqa: BLE001 — a crashed executor can't answer
+            LOG.info("stack capture from %s (%s) failed — crashed, not "
+                     "wedged", task_id, entry[1], exc_info=True)
+            return None
+        if not isinstance(dump, dict) or dump.get("error") \
+                or not dump.get("threads"):
+            return None
+        from tony_tpu.observability.profiler import dominant_frame
+        frame = dominant_frame(dump.get("threads") or [])
+        record = {
+            "task_id": task_id, "attempt": max(attempt, 0),
+            "reason": reason,
+            "generated_ms": int(dump.get("generated_ms", 0) or 0),
+            "blocking_frame": frame,
+            "threads": dump.get("threads") or [],
+        }
+        with self._lock:
+            self._task_stacks[task_id] = record
+            already = task_id in self._remote_stalls
+            if not already:
+                self._remote_stalls[task_id] = {
+                    "since_ms": int(time.time() * 1000),
+                    "blocking_frame": frame, "attempt": max(attempt, 0)}
+        if not already:
+            self.event_handler.emit(Event(
+                EventType.PROCESS_STALL_DETECTED,
+                ProcessStallDetected(
+                    process=f"executor:{task_id}",
+                    beacon="task-heartbeat",
+                    stalled_ms=float(self._max_missed_hb
+                                     * self._hb_interval_ms),
+                    cadence_ms=float(self._hb_interval_ms),
+                    blocking_frame=frame,
+                    task_id=task_id, attempt=max(attempt, 0))))
+        LOG.warning("wedge autopsy for %s attempt %d: %d thread(s) "
+                    "captured, blocked in %s", task_id, max(attempt, 0),
+                    len(record["threads"]), frame or "<unknown>")
+        return record
+
+    def _clear_remote_stall(self, task_id: str, reason: str) -> None:
+        """Close a latched remote-stall pair (the slot was relaunched
+        past the wedge, or the session/application is tearing down) —
+        the history must always carry the CLEARED half."""
+        with self._lock:
+            latch = self._remote_stalls.pop(task_id, None)
+        if latch is None:
+            return
+        try:
+            self.event_handler.emit(Event(
+                EventType.PROCESS_STALL_CLEARED,
+                ProcessStallCleared(
+                    process=f"executor:{task_id}",
+                    beacon="task-heartbeat",
+                    stalled_ms=float(
+                        int(time.time() * 1000) - latch["since_ms"]),
+                    blocking_frame=latch.get("blocking_frame", ""),
+                    task_id=task_id,
+                    attempt=int(latch.get("attempt", 0)),
+                    reason=reason)))
+        except Exception:  # noqa: BLE001 — observability must not kill the AM
+            LOG.exception("failed to emit stall-cleared for %s", task_id)
+
+    def _capture_barrier_stacks(self, limit: int = 8) -> None:
+        """Barrier-timeout autopsy: tasks that heartbeated (so their
+        stack-service address is known) but the gang never completed
+        registration — exactly the wedged-in-localization suspects.
+        Bounded: at width 1k the failing session must not serially pull
+        a thousand dumps before it can report."""
+        session = self.session
+        if session is None:
+            return
+        with self._lock:
+            addrs = dict(self._log_addrs)
+        captured = 0
+        for tasks in session.job_tasks.values():
+            for task in tasks:
+                if captured >= limit:
+                    return
+                if task.completed or task.task_id not in addrs:
+                    continue
+                if self._capture_task_stacks(
+                        task.task_id, task.attempt,
+                        "registration deadline expired") is not None:
+                    captured += 1
+
     def _assemble_diagnostics(self, status: str) -> Optional[dict]:
         """The root-cause bundle for a failed/killed job: every failure
         record ordered by observation time, the FIRST one called out as
@@ -1148,6 +1317,13 @@ class ApplicationMaster(ClusterServiceHandler):
             "first_failure": first,
             "failures": records,
         }
+        with self._lock:
+            stacks = dict(self._task_stacks)
+        if stacks:
+            # wedge autopsies: per-task all-thread dumps pulled from
+            # suspect executors, each naming its dominant blocking frame
+            # ("it is stuck in LocalizationCache.materialize")
+            bundle["stacks"] = stacks
         if first is not None:
             # link the failing task's lifecycle spans so the bundle jumps
             # straight into the waterfall (same trace_id = app_id)
@@ -1212,7 +1388,7 @@ class ApplicationMaster(ClusterServiceHandler):
                           C.METRICS_FILE, C.GOODPUT_FILE,
                           C.DIAGNOSTICS_FILE, C.SKEW_FILE,
                           C.JOBSTATE_FILE, C.ALERTS_FILE,
-                          C.SERVING_TRACES_FILE):
+                          C.SERVING_TRACES_FILE, C.PROFILE_FOLDED_FILE):
                 p = os.path.join(self.history_dir, extra)
                 if os.path.exists(p):
                     store.put(p, f"history/{extra}")
@@ -1598,9 +1774,14 @@ class ApplicationMaster(ClusterServiceHandler):
             downtime_ms = rec["pre_downtime_ms"] + int(elapsed_s * 1000)
             replayed = rec["replayed"]
         for task in stragglers:
-            self._maybe_relaunch_task(
-                task, "executor lost across AM restart",
-                observed_attempt=task.attempt)
+            # autopsy first: a straggler that is wedged (vs gone with the
+            # host) still answers read_stacks at its gossiped address
+            self._capture_task_stacks(task.task_id, task.attempt,
+                                      "executor lost across AM restart")
+            if self._maybe_relaunch_task(
+                    task, "executor lost across AM restart",
+                    observed_attempt=task.attempt):
+                self._clear_remote_stall(task.task_id, "relaunched")
         (LOG.info if lost == 0 else LOG.warning)(
             "AM recovery complete: %d executor(s) adopted, %d lost, "
             "%d ms control-plane downtime", adopted, lost, downtime_ms)
@@ -1638,7 +1819,13 @@ class ApplicationMaster(ClusterServiceHandler):
         expire_at = (time.monotonic() + timeout_ms / 1000.0
                      if timeout_ms > 0 else None)
         session = self.session
+        # stall-watchdog beacon: the monitor loop IS the AM's pulse — a
+        # pass wedged inside one of the _check_* calls below freezes
+        # relaunch, preemption, and alerting all at once
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("am-monitor", self._monitor_interval)
         while True:
+            beacon.beat()
             if expire_at is not None and time.monotonic() > expire_at:
                 LOG.error("application timed out")
                 session.set_final_status(FinalStatus.FAILED,
@@ -1674,6 +1861,9 @@ class ApplicationMaster(ClusterServiceHandler):
             if (self._registration_deadline is not None
                     and not session.all_tasks_registered()
                     and time.monotonic() > self._registration_deadline):
+                # barrier-timeout autopsy BEFORE the session is failed:
+                # the suspects are still alive to answer read_stacks
+                self._capture_barrier_stacks()
                 session.set_final_status(
                     FinalStatus.FAILED,
                     "Tasks failed to register within the allocation timeout.")
@@ -1721,6 +1911,9 @@ class ApplicationMaster(ClusterServiceHandler):
                 break
             self._wake.wait(self._monitor_interval)
             self._wake.clear()
+        # a finished monitor is idle, not stalled — park the beacon so
+        # the finish/teardown tail can't trip the watchdog
+        beacon.idle()
         if self._killed_by_client:
             session.set_final_status(FinalStatus.KILLED,
                                      "Application killed by client.")
@@ -2505,6 +2698,13 @@ class ApplicationMaster(ClusterServiceHandler):
         for cid in cids:
             self.backend.stop_container(cid)
         self.hb_monitor.clear()
+        # the dead session's wedges die with its containers: close every
+        # latched stall pair (the captured stacks stay — they are failure
+        # evidence for the final diagnostics bundle)
+        with self._lock:
+            latched = list(self._remote_stalls)
+        for task_id in latched:
+            self._clear_remote_stall(task_id, "teardown")
         # an in-flight resize dies with the session: the retry rebuilds
         # the gang at the frozen conf's width
         self.elastic.reset()
@@ -2558,6 +2758,12 @@ class ApplicationMaster(ClusterServiceHandler):
                             attrs={"final_status": status})
             self._root_span = None
         self._flush_observability()
+        # any still-latched wedge closes here: every detect must have its
+        # clear inside the jhist, even when the wedge killed the job
+        with self._lock:
+            latched = list(self._remote_stalls)
+        for task_id in latched:
+            self._clear_remote_stall(task_id, "teardown")
         # root-cause bundle BEFORE the event log closes: the
         # DIAGNOSTICS_READY event must land inside the jhist
         self._flush_diagnostics(status)
@@ -2992,7 +3198,12 @@ class ApplicationMaster(ClusterServiceHandler):
             # a wedge the liveliness monitor caught: no exit code exists,
             # but the container's files often hold the story (hung
             # collective, stalled input) — snapshot the tail now, before
-            # a relaunch recycles the dir name
+            # a relaunch recycles the dir name. The stack autopsy runs
+            # FIRST: a silent-but-alive executor answers read_stacks and
+            # the dump names the exact frame it is parked in
+            self._capture_task_stacks(
+                task_id, attempt if attempt >= 0 else task.attempt,
+                f"missed {self._max_missed_hb} heartbeats")
             self._record_task_failure(
                 task_id, attempt if attempt >= 0 else task.attempt,
                 f"missed {self._max_missed_hb} heartbeats",
@@ -3018,6 +3229,9 @@ class ApplicationMaster(ClusterServiceHandler):
                 task, f"missed {self._max_missed_hb} heartbeats",
                 observed_attempt=(attempt if attempt >= 0
                                   else task.attempt)):
+            # the wedged attempt is being replaced: close its latched
+            # stall pair so the history reads detect → relaunch → clear
+            self._clear_remote_stall(task_id, "relaunched")
             return
         msg = (f"Task with id [{task_id}] has missed "
                f"[{self._max_missed_hb}] heartbeats. Ending application!")
@@ -3882,6 +4096,19 @@ class ApplicationMaster(ClusterServiceHandler):
         LOG.info("profile requested for %s (%d steps, id %s)", task_id,
                  steps, rid)
         return {"request_id": rid, "task_id": task_id, "num_steps": steps}
+
+    def get_profile(self, req: dict) -> dict:
+        """Operator plane: the AM's own continuous-profile snapshot —
+        sampler counters (rate, overhead, throttle) plus the
+        collapsed-stack `folded` text, the flame renderer's input.
+        Answers an error when no profiler was installed
+        (tony.profiler.enabled=false or a bare harness)."""
+        prof = self._profiler
+        if prof is None:
+            return {"error": "profiler not running"}
+        snap = prof.snapshot()
+        snap["folded"] = prof.folded_text()
+        return snap
 
     def _log_client(self, task_id: str, attempt: int, addr: str):
         """Cached TaskLogServiceClient for one executor's log service,

@@ -172,9 +172,14 @@ class LivelinessMonitor:
                 self._shards[idx].clear()
 
     def _run(self) -> None:
+        # stall-watchdog beacon: a wedged sweep loop means silent tasks
+        # are never expired — exactly the wedge the watchdog must name
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("liveliness-sweep", self._tick_sec)
         last_tick = time.monotonic()
         shard_idx = 0
         while not self._stop.wait(self._tick_sec):
+            beacon.beat()
             now = time.monotonic()
             # sweep lag: how far past the nominal cadence this tick ran
             # (a loaded AM sweeping late ADDS to every detection latency)
@@ -206,3 +211,4 @@ class LivelinessMonitor:
                     self._on_expired(tid, attempt)
                 except Exception:  # noqa: BLE001
                     LOG.exception("expiry callback failed for %s", tid)
+        beacon.idle()

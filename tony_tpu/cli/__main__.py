@@ -41,6 +41,11 @@
              running app's training gang in place — quiesce, in-place
              emergency checkpoint, generation-bumped re-rendezvous,
              reshard-restore; no evict, no resubmit.
+- flame    — render the always-on control-plane profiler's collapsed-
+             stack profile (live from a RUNNING app's AM via the
+             get_profile RPC, or the profile.folded history sidecar)
+             as a sorted hot-stack table; `--folded` emits raw
+             flamegraph.pl / speedscope input.
 """
 
 from __future__ import annotations
@@ -54,8 +59,8 @@ from tony_tpu.cli.notebook_submitter import submit as notebook_submit
 
 USAGE = ("usage: python -m tony_tpu.cli "
          "{submit|local|notebook|profile|logs|diagnose|stragglers"
-         "|alerts|top|preempt|resize|arbiter|router|rollout|trace} "
-         "[args...]")
+         "|alerts|top|preempt|resize|arbiter|router|rollout|trace"
+         "|flame} [args...]")
 
 
 def _am_client(app_dir: str):
@@ -163,40 +168,47 @@ def logs(argv: list[str]) -> int:
         client.close()
 
 
-def _find_history_json(target: str, fname: str):
-    """Resolve a history sidecar (`fname`) from an app dir, a history
-    dir, or a direct file path; returns (dict | None, searched paths)."""
+def _history_candidates(target: str, fname: str) -> list[str]:
+    """Candidate paths for a history sidecar (`fname`) given an app dir,
+    a history dir, or a direct file path."""
     import glob
-    import json
     import os
 
     from tony_tpu import constants as C
 
-    candidates = []
     if os.path.isfile(target):
-        candidates = [target]
-    else:
-        candidates = (
-            [os.path.join(target, fname)]
-            + sorted(glob.glob(os.path.join(
-                target, C.HISTORY_DIR_NAME, "*", fname)))
-            + sorted(glob.glob(os.path.join(target, "*", fname))))
-        # an app dir with a configured tony.history.intermediate keeps
-        # its history elsewhere — follow the frozen conf there
-        frozen = os.path.join(target, C.TONY_FINAL_CONF)
-        if os.path.isfile(frozen):
-            try:
-                from tony_tpu.conf import TonyConfiguration, keys as K
-                intermediate = TonyConfiguration.read(frozen).get_str(
-                    K.HISTORY_INTERMEDIATE, "")
-            except Exception:  # noqa: BLE001 — conf damage ≠ no diagnosis
-                intermediate = ""
-            if intermediate:
-                app_id = os.path.basename(os.path.normpath(target))
-                candidates += (
-                    [os.path.join(intermediate, app_id, fname)]
-                    + sorted(glob.glob(os.path.join(
-                        intermediate, "*", fname))))
+        return [target]
+    candidates = (
+        [os.path.join(target, fname)]
+        + sorted(glob.glob(os.path.join(
+            target, C.HISTORY_DIR_NAME, "*", fname)))
+        + sorted(glob.glob(os.path.join(target, "*", fname))))
+    # an app dir with a configured tony.history.intermediate keeps
+    # its history elsewhere — follow the frozen conf there
+    frozen = os.path.join(target, C.TONY_FINAL_CONF)
+    if os.path.isfile(frozen):
+        try:
+            from tony_tpu.conf import TonyConfiguration, keys as K
+            intermediate = TonyConfiguration.read(frozen).get_str(
+                K.HISTORY_INTERMEDIATE, "")
+        except Exception:  # noqa: BLE001 — conf damage ≠ no diagnosis
+            intermediate = ""
+        if intermediate:
+            app_id = os.path.basename(os.path.normpath(target))
+            candidates += (
+                [os.path.join(intermediate, app_id, fname)]
+                + sorted(glob.glob(os.path.join(
+                    intermediate, "*", fname))))
+    return candidates
+
+
+def _find_history_json(target: str, fname: str):
+    """Resolve a history sidecar (`fname`) from an app dir, a history
+    dir, or a direct file path; returns (dict | None, searched paths)."""
+    import json
+    import os
+
+    candidates = _history_candidates(target, fname)
     for path in candidates:
         if os.path.isfile(path):
             try:
@@ -204,6 +216,25 @@ def _find_history_json(target: str, fname: str):
                     return json.load(f), candidates
             except (OSError, ValueError):
                 continue
+    return None, candidates
+
+
+def _find_history_text(target: str, fname: str):
+    """Like `_find_history_json` but for plain-text sidecars
+    (profile.folded is collapsed-stack lines, not JSON); returns
+    (text | None, searched paths)."""
+    import os
+
+    candidates = _history_candidates(target, fname)
+    for path in candidates:
+        if os.path.isfile(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            if text.strip():
+                return text, candidates
     return None, candidates
 
 
@@ -273,6 +304,18 @@ def diagnose(argv: list[str]) -> int:
             rsig = r.get("signature") or "no signature"
             print(f"  {r.get('task_id', '?')} attempt "
                   f"{r.get('attempt', 0)}: {r.get('reason', '')} ({rsig})")
+    # wedge autopsies: the stacks the AM pulled off suspects before
+    # declaring them dead — the blocking frame names the wedge
+    stacks = bundle.get("stacks") or {}
+    if stacks:
+        print(f"{len(stacks)} wedge autopsy(ies) — stacks captured "
+              "before the task was declared dead:")
+        for task_id in sorted(stacks):
+            rec = stacks[task_id] or {}
+            print(f"  {task_id} attempt {rec.get('attempt', 0)} "
+                  f"({rec.get('reason', '')}): blocked in "
+                  f"{rec.get('blocking_frame') or '?'}")
+        print("  (full per-thread stacks: --json, key 'stacks')")
     return 0
 
 
@@ -894,6 +937,11 @@ def router(argv: list[str]) -> int:
         print("router: need an app_dir or --endpoints", file=sys.stderr)
         return 2
     conf = TonyConfiguration()
+    # the router is a long-running front door: same always-on coverage
+    # as the AM/executor/portal/serve daemons (profiler + stall
+    # watchdog + SIGUSR2 all-thread dump)
+    from tony_tpu.observability.profiler import install_process_profiler
+    install_process_profiler("router", conf=conf)
     port = args.port if args.port >= 0 \
         else conf.get_int(K.SERVING_FLEET_ROUTER_PORT, 0)
     rtr = FleetRouter(
@@ -1051,6 +1099,94 @@ def trace(argv: list[str]) -> int:
     return 0
 
 
+def flame(argv: list[str]) -> int:
+    """`python -m tony_tpu.cli flame <target>` — render the always-on
+    control-plane profiler's collapsed-stack profile as a sorted hot-
+    stack table. For a RUNNING app the AM serves its live fold table
+    over the get_profile RPC (with the self-overhead reading); after
+    finish the profile.folded sidecar in history is read instead.
+    `--folded` dumps the raw collapsed-stack text for flamegraph.pl or
+    speedscope."""
+    import argparse
+    import os
+
+    from tony_tpu import constants as C
+
+    parser = argparse.ArgumentParser(prog="tony_tpu.cli flame")
+    parser.add_argument("target",
+                        help="app dir (live AM or history), history "
+                             "dir, or a profile.folded file")
+    parser.add_argument("--top", type=int, default=25,
+                        help="hot-stack rows to print")
+    parser.add_argument("--folded", action="store_true",
+                        help="dump the raw collapsed-stack text "
+                             "(flamegraph.pl / speedscope input)")
+    args = parser.parse_args(argv)
+
+    text, meta = None, {}
+    # live first: a running AM answers get_profile with its in-memory
+    # fold table plus the self-overhead reading against the <1% budget
+    if os.path.isfile(os.path.join(args.target, C.AM_HOSTPORT_FILE)):
+        client, err = _am_client(args.target)
+        if not err:
+            try:
+                snap = client.get_profile()
+            except Exception:  # noqa: BLE001 — fall back to the sidecar
+                snap = None
+            finally:
+                client.close()
+            if isinstance(snap, dict) and not snap.get("error") \
+                    and snap.get("folded"):
+                text = str(snap["folded"])
+                meta = snap
+    if text is None:
+        text, searched = _find_history_text(args.target,
+                                            C.PROFILE_FOLDED_FILE)
+        if text is None:
+            print("no profile found (searched: " + ", ".join(searched[:4])
+                  + "). The job may predate the control-plane profiler, "
+                    "still be starting, or have tony.profiler.enabled "
+                    "off.", file=sys.stderr)
+            return 1
+    if args.folded:
+        print(text, end="" if text.endswith("\n") else "\n")
+        return 0
+    rows = []
+    for line in text.splitlines():
+        stack, _, count = line.rpartition(" ")
+        if not stack or not count.isdigit():
+            continue
+        rows.append((int(count), stack))
+    if not rows:
+        print("profile is empty (no samples folded yet)", file=sys.stderr)
+        return 1
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    total = sum(c for c, _ in rows)
+    head = (f"{total} samples across {len(rows)} distinct stacks")
+    if meta:
+        head += (f" — live from {meta.get('process', 'am')} @ "
+                 f"{meta.get('hz', '?')} Hz, overhead "
+                 f"{meta.get('overhead_pct', '?')}% "
+                 f"(budget {meta.get('overhead_budget_pct', '?')}%)")
+    print(head)
+    width = 24
+    for count, stack in rows[:max(1, args.top)]:
+        pct = 100.0 * count / total
+        bar = "#" * max(1, int(width * count / rows[0][0]))
+        thread, _, frames = stack.partition(";")
+        # leaf-most frames carry the signal; elide the common trunk
+        tail = frames.split(";")
+        shown = ";".join(tail[-3:]) if frames else "(no frames)"
+        if len(tail) > 3:
+            shown = "...;" + shown
+        print(f"  {pct:5.1f}% {count:>8d}  {bar:<{width}s} "
+              f"[{thread}] {shown}")
+    if len(rows) > args.top:
+        print(f"  ... {len(rows) - args.top} more stacks "
+              f"(--top to widen, --folded for the raw profile)")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     logging.basicConfig(
@@ -1098,6 +1234,8 @@ def main(argv: list[str] | None = None) -> int:
         return rollout(rest)
     if cmd == "trace":
         return trace(rest)
+    if cmd == "flame":
+        return flame(rest)
     print(USAGE, file=sys.stderr)
     return 2
 

@@ -70,7 +70,10 @@ class LocalClusterBackend(ClusterBackend):
                                node_label))
 
     def _dispatch_loop(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("container-dispatch", 0.2)
         while not self._stopping:
+            beacon.beat()
             try:
                 item = self._pending.get(timeout=0.2)
             except queue.Empty:
@@ -79,6 +82,8 @@ class LocalClusterBackend(ClusterBackend):
                 # FIFO within capacity, like the mini cluster's FifoScheduler
                 while (not self._stopping
                        and self._live_container_count() >= self._capacity):
+                    # waiting on capacity is progress, not a wedge
+                    beacon.beat()
                     threading.Event().wait(0.1)
                 if self._stopping:
                     return

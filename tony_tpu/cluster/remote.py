@@ -408,7 +408,10 @@ class RemoteClusterBackend(ClusterBackend):
         self._work.set()
 
     def _dispatch_loop(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("container-dispatch", 1.0)
         while not self._stopping:
+            beacon.beat()
             # clear BEFORE scanning so a state change during the scan
             # re-wakes us instead of being lost
             self._work.clear()

@@ -135,6 +135,13 @@ DEFAULTS = {
     K.GOODPUT_ENABLED: True,
     K.PROFILING_ENABLED: True,
     K.PROFILING_DEFAULT_STEPS: 5,
+    # always-on control-plane profiler + stall watchdog
+    # (observability/profiler.py)
+    K.PROFILER_ENABLED: True,
+    K.PROFILER_HZ: 19.0,               # prime-ish; jittered at runtime
+    K.PROFILER_MAX_STACKS: 2000,
+    K.PROFILER_STALL_FACTOR: 4.0,
+    K.PROFILER_OVERHEAD_BUDGET_PCT: 1.0,
     K.SLO_STEP_TIME_REGRESSION_PCT: 0,   # 0 = step-time check disabled
     K.SLO_GOODPUT_FLOOR_PCT: 0,          # 0 = goodput-floor check disabled
     # live log streaming / diagnostics (observability/logs.py)

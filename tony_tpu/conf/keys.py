@@ -294,6 +294,27 @@ GOODPUT_ENABLED = "tony.goodput.enabled"
 # POST): master switch + trace length when the request doesn't name one
 PROFILING_ENABLED = "tony.profiling.enabled"
 PROFILING_DEFAULT_STEPS = "tony.profiling.default-steps"
+# always-on control-plane profiler + stall watchdog
+# (observability/profiler.py): a daemon sampler walking
+# sys._current_frames() in EVERY long-running process (AM, executor,
+# portal, serve replica, router), folding samples into a bounded
+# collapsed-stack table exported as profile.folded / get_profile /
+# /api/jobs/:id/flame, plus the beacon watchdog that turns a wedged
+# daemon loop into a PROCESS_STALL_DETECTED event with the blocking
+# frame as evidence
+PROFILER_ENABLED = "tony.profiler.enabled"
+# sampling cadence; deliberately prime-ish and jittered +/-25% so the
+# sampler never phase-locks with the 1 s/5 s control-plane loops
+PROFILER_HZ = "tony.profiler.hz"
+# bound on distinct collapsed stacks retained (overflow folds into an
+# "(other)" bucket and is disclosed as dropped_samples)
+PROFILER_MAX_STACKS = "tony.profiler.max-stacks"
+# a progress beacon stale past this factor x its registered cadence is
+# a stall: all-thread capture + latched event pair + tony_stalls_total
+PROFILER_STALL_FACTOR = "tony.profiler.stall-factor"
+# hard self-overhead ceiling (percent of wall time spent sampling);
+# past it the profiler throttles its own cadence rather than blow it
+PROFILER_OVERHEAD_BUDGET_PCT = "tony.profiler.overhead-budget-pct"
 # SLO watchdog (AM monitor loop): WARNING history events + alert gauges
 # when a task's step time regresses past this percentage over its own
 # baseline, or job goodput falls below this floor; 0 disables either check
@@ -522,7 +543,7 @@ RESERVED_SEGMENTS = frozenset({
     "application", "am", "task", "containers", "container", "history",
     "portal", "docker", "tpu", "cluster", "keytab", "python", "srcdir",
     "execution", "other", "queues", "metrics", "trace", "goodput",
-    "profiling", "slo", "logs", "straggler", "fleet", "alerts",
+    "profiling", "profiler", "slo", "logs", "straggler", "fleet", "alerts",
     "arbiter", "checkpoint", "autoscaler", "elastic", "warmpool",
     "localization", "executor",
 })

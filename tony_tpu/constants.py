@@ -189,6 +189,12 @@ SERVING_TRACES_FILE = "serving_traces.json"  # tail-sampled per-request
                                      # metrics RPC and flushed next to the
                                      # event log; the portal's request
                                      # waterfall and `cli trace` render it
+PROFILE_FOLDED_FILE = "profile.folded"  # AM's collapsed-stack profile
+                                     # (flamegraph.pl format, one
+                                     # "thread;frame;... count" line per
+                                     # stack) flushed next to the event
+                                     # log at finish and served live via
+                                     # get_profile / /api/jobs/:id/flame
 CORE_SITE_CONF = "core-site.xml"
 
 # ---------------------------------------------------------------------------
@@ -246,6 +252,13 @@ TEST_TASK_KILL = "TEST_TASK_KILL"
 # process keeps running — exercises the heartbeat-expiry relaunch path.
 # Format: "type#index#attempt".
 TEST_TASK_HB_SILENCE = "TEST_TASK_HB_SILENCE"
+# wedge injection (chaos harness): park one specific task attempt's
+# executor MAIN thread in a recognizably-named function
+# (_tony_test_wedge) right after its log/stack service is up, while its
+# heartbeats are typically silenced alongside via TEST_TASK_HB_SILENCE —
+# the liveliness expiry then autopsies a process that is alive-but-stuck
+# and diagnostics.json names the blocking frame. Format: "type#index#attempt".
+TEST_TASK_WEDGE = "TEST_TASK_WEDGE"
 # preemption injection (chaos harness): the AM preempts ITSELF
 # `after_ms` after prepare(), exactly as if an arbiter's
 # request_preemption RPC had arrived — drain ask rides the heartbeats,

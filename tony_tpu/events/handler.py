@@ -99,9 +99,16 @@ class EventHandler:
 
     # -- consumer side ----------------------------------------------------
     def _run(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        # queue-driven: idle() before the blocking get() so an empty
+        # queue is not a stall; an ACTIVE beacon means _write is wedged
+        beacon = register_beacon("event-writer", 5.0)
         while True:
+            beacon.idle()
             event = self._queue.get()
+            beacon.beat()
             if event is None:
+                beacon.idle()
                 return
             self._write(event)
 

@@ -172,6 +172,31 @@ def read_serving_traces_file(history_dir: str) -> list:
     return out if isinstance(out, list) else []
 
 
+def write_profile_file(history_dir: str, folded: str) -> None:
+    """folded: the sampling profiler's collapsed-stack text
+    (observability/profiler.py FoldTable.folded — one
+    "thread;frame;... count" line per distinct stack, flamegraph.pl
+    format). Redacted at flush: like the serving-traces sidecar, the
+    history write is an egress in its own right. Tmp+rename for the same
+    crash-atomicity as the JSON sidecars."""
+    from tony_tpu.observability.logs import redact
+    path = os.path.join(history_dir, C.PROFILE_FOLDED_FILE)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(redact(str(folded)))
+    os.replace(tmp, path)
+
+
+def read_profile_file(history_dir: str) -> str:
+    try:
+        with open(os.path.join(history_dir, C.PROFILE_FOLDED_FILE),
+                  "r", encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
 def parse_history_file_name(name: str) -> JobMetadata:
     """Parse either a final or an in-progress history file name back into
     JobMetadata (reference: JobMetadata constructor parsing,

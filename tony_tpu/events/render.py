@@ -228,6 +228,21 @@ def _am_recovery_completed(p: dict) -> str:
             f"{p.get('replayed_records', 0)} record(s) replayed)")
 
 
+def _process_stall_detected(p: dict) -> str:
+    where = p.get("task_id") or p.get("process", "?")
+    beacon = f" ({p.get('beacon')} loop)" if p.get("beacon") else ""
+    frame = p.get("blocking_frame") or "unknown frame"
+    return (f"stall detected on {where}{beacon}: no progress for "
+            f"{p.get('stalled_ms', 0)} ms — blocked in {frame}")
+
+
+def _process_stall_cleared(p: dict) -> str:
+    where = p.get("task_id") or p.get("process", "?")
+    return (f"stall cleared on {where} "
+            f"({p.get('reason', '') or 'recovered'}) after "
+            f"{p.get('stalled_ms', 0)} ms")
+
+
 RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.APPLICATION_INITED: _application_inited,
     EventType.APPLICATION_FINISHED: _application_finished,
@@ -255,6 +270,8 @@ RENDERERS: dict[EventType, Callable[[dict], str]] = {
     EventType.RESIZE_FAILED: _resize_failed,
     EventType.AM_RECOVERY_STARTED: _am_recovery_started,
     EventType.AM_RECOVERY_COMPLETED: _am_recovery_completed,
+    EventType.PROCESS_STALL_DETECTED: _process_stall_detected,
+    EventType.PROCESS_STALL_CLEARED: _process_stall_cleared,
 }
 
 

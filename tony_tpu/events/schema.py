@@ -43,6 +43,8 @@ class EventType(str, enum.Enum):
     RESIZE_FAILED = "RESIZE_FAILED"
     AM_RECOVERY_STARTED = "AM_RECOVERY_STARTED"
     AM_RECOVERY_COMPLETED = "AM_RECOVERY_COMPLETED"
+    PROCESS_STALL_DETECTED = "PROCESS_STALL_DETECTED"
+    PROCESS_STALL_CLEARED = "PROCESS_STALL_CLEARED"
 
 
 @dataclass
@@ -415,6 +417,40 @@ class AmRecoveryCompleted:
 
 
 @dataclass
+class ProcessStallDetected:
+    """No reference equivalent: the stall watchdog
+    (observability/profiler.py) latched a wedge — a control-plane
+    process (or one of its registered daemon loops) stopped making
+    progress while staying alive, or a liveliness-expired executor
+    answered a stack pull proving it is blocked rather than dead. The
+    evidence travels with the event: which process/beacon, how long past
+    its cadence, and the dominant blocking frame ("stuck in
+    LocalizationCache.materialize", not "stuck")."""
+    process: str                # "am", "executor:worker:1", "router", ...
+    beacon: str = ""            # stale loop's beacon ("" = whole process)
+    thread_name: str = ""
+    stalled_ms: float = 0.0
+    cadence_ms: float = 0.0
+    blocking_frame: str = ""    # leaf frame of the wedged thread
+    task_id: str = ""           # set when the stall is a remote task's
+    attempt: int = 0
+
+
+@dataclass
+class ProcessStallCleared:
+    """The latched stall released: the beacon beat again, the wedged
+    task's slot was relaunched, or the application tore down (a stall
+    report must never dangle un-cleared in history)."""
+    process: str
+    beacon: str = ""
+    stalled_ms: float = 0.0
+    blocking_frame: str = ""
+    task_id: str = ""
+    attempt: int = 0
+    reason: str = ""            # "recovered" | "relaunched" | "teardown"
+
+
+@dataclass
 class ApplicationFinished:
     """reference: ApplicationFinished.avsc (appId, status, failed tasks, metrics)."""
     application_id: str
@@ -450,6 +486,8 @@ _PAYLOADS = {
     EventType.RESIZE_FAILED: ResizeFailed,
     EventType.AM_RECOVERY_STARTED: AmRecoveryStarted,
     EventType.AM_RECOVERY_COMPLETED: AmRecoveryCompleted,
+    EventType.PROCESS_STALL_DETECTED: ProcessStallDetected,
+    EventType.PROCESS_STALL_CLEARED: ProcessStallCleared,
 }
 
 Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
@@ -461,7 +499,8 @@ Payload = Union[ApplicationInited, ApplicationFinished, TaskStarted,
                 AutoscaleDecision, RollingUpdateStarted,
                 RollingUpdateCompleted, ResizeRequested, ResizeStarted,
                 ResizeCompleted, ResizeFailed, AmRecoveryStarted,
-                AmRecoveryCompleted]
+                AmRecoveryCompleted, ProcessStallDetected,
+                ProcessStallCleared]
 
 
 @dataclass

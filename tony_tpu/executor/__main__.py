@@ -23,6 +23,13 @@ def main() -> int:
     from tony_tpu.observability.logs import configure_structured_logging
     configure_structured_logging()
     executor = TaskExecutor()
+    # continuous profiler + stall watchdog + faulthandler (SIGUSR2 →
+    # all-thread dump): a wedged executor is precisely the process whose
+    # stacks the AM's autopsy pulls, and the local pair names the stall
+    # in this process's own logs too
+    from tony_tpu.observability.profiler import install_process_profiler
+    install_process_profiler(f"executor:{executor.task_id}",
+                             conf=executor.conf)
 
     # Graceful container stop: the backend sends SIGTERM (escalating to
     # SIGKILL) when the AM stops this container — and the substrate

@@ -92,6 +92,15 @@ def apply_spec_diff(spec: dict, changed: dict,
     return out
 
 
+def _tony_test_wedge() -> None:
+    """TEST hook parking frame (TEST_TASK_WEDGE): the chaos harness
+    wedges an executor's MAIN thread here forever so the AM's wedge
+    autopsy has a recognizable blocking function to name in
+    diagnostics.json — the e2e asserts this frame shows up there."""
+    while True:
+        time.sleep(0.25)
+
+
 class Heartbeater(threading.Thread):
     """(reference: TaskExecutor.Heartbeater, TaskExecutor.java:330-370).
 
@@ -175,9 +184,25 @@ class Heartbeater(threading.Thread):
         self._client = client
 
     def run(self) -> None:
+        # stall-watchdog beacon (observability/profiler.py): a heartbeater
+        # that stops iterating — wedged RPC stack, not a crashed thread —
+        # is exactly the loop whose silence kills the task from the AM's
+        # point of view, so its progress is worth watching locally too
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon(f"heartbeater:{self._task_id}",
+                                 self._interval)
+        try:
+            self._run_loop(beacon)
+        finally:
+            # a STOPPED heartbeater is idle, not stalled — park the
+            # beacon so its age stops counting against the watchdog
+            beacon.idle()
+
+    def _run_loop(self, beacon) -> None:
         if self._jitter_sec and self._stop.wait(self._jitter_sec):
             return
         while not self._stop.wait(self._interval):
+            beacon.beat()
             if self._silent:
                 continue
             if self._skip_remaining > 0:
@@ -455,6 +480,21 @@ class TaskExecutor:
         chunk["stream"] = stream
         chunk["task_id"] = self.task_id
         return chunk
+
+    def read_stacks(self, req: dict) -> dict:
+        """TaskLogServiceHandler: redacted all-thread stack snapshot —
+        the wedge-autopsy read surface, served next to read_log on the
+        same token-authed server. It runs on a gRPC worker thread, so it
+        answers even while the MAIN thread is parked in a wedged frame;
+        the AM pulls it when liveliness expiry, a barrier timeout, or
+        the orphan grace fires and folds it into diagnostics.json."""
+        from tony_tpu.observability.profiler import collect_thread_stacks
+        return {
+            "task_id": self.task_id,
+            "attempt": self.task_attempt,
+            "generated_ms": int(time.time() * 1000),
+            "threads": collect_thread_stacks(),
+        }
 
     def _failure_diagnostics(self, exit_code: int) -> dict:
         """Classified + redacted failure summary shipped with the
@@ -830,6 +870,36 @@ class TaskExecutor:
                         self.task_attempt)
         return match
 
+    def _wedge_if_testing(self) -> None:
+        """TEST_TASK_WEDGE='type#index#attempt': park THIS attempt's MAIN
+        thread in _tony_test_wedge forever, right after the gang barrier
+        completes (the log/stack service is already up) — the chaos
+        harness's process wedge (attempt '*' matches every attempt). One
+        direct heartbeat ships the stack service's address first:
+        combined with TEST_TASK_HB_SILENCE the wedged attempt's own
+        heartbeater never will, and without the address the AM's
+        autopsy has nothing to pull."""
+        spec = os.environ.get(C.TEST_TASK_WEDGE)
+        if not spec:
+            return
+        try:
+            jtype, idx, attempt = spec.split("#")
+            match = (jtype == self.job_name and int(idx) == self.task_index
+                     and attempt in ("*", str(self.task_attempt)))
+        except ValueError:
+            LOG.error("bad TEST_TASK_WEDGE spec: %r", spec)
+            return
+        if not match:
+            return
+        LOG.warning("TEST hook: wedging attempt %d in _tony_test_wedge",
+                    self.task_attempt)
+        try:
+            self.client.task_executor_heartbeat(
+                self.task_id, self.task_attempt, log_addr=self.log_addr)
+        except Exception:  # noqa: BLE001 — the wedge must park regardless
+            LOG.warning("wedge hook could not ship the stack-service addr")
+        _tony_test_wedge()
+
     def _schedule_kill_if_testing(self) -> None:
         """TEST_TASK_KILL='type#index#after_ms#attempt': hard-crash THIS
         attempt's container after_ms after its user process launches,
@@ -993,6 +1063,11 @@ class TaskExecutor:
             timeout_ms = self.conf.get_time_ms(K.APPLICATION_TIMEOUT, 0)
             rendezvous_gave_up = False
             while True:
+                # wedge AFTER the barrier: the task is registered (its
+                # liveliness entry exists) and the gang proceeds, so the
+                # AM's heartbeat-expiry autopsy — not the registration
+                # deadline — is what catches the park
+                self._wedge_if_testing()
                 LOG.info("cluster spec (generation %d): %s",
                          self._spec_generation, cluster_spec)
                 env = render_framework_env(self.framework, cluster_spec,

@@ -243,8 +243,12 @@ class TaskMonitor:
         return metrics
 
     def _run(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("task-monitor", self._interval)
         while not self._stop.wait(self._interval):
+            beacon.beat()
             self._sample_and_push()
+        beacon.idle()
         # final push so the AM's TASK_FINISHED event carries the last numbers
         self._sample_and_push()
 

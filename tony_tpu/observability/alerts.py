@@ -823,8 +823,15 @@ class AlertEngine:
 
     def _delivery_loop(self) -> None:
         from tony_tpu.observability.metrics import REGISTRY
+        from tony_tpu.observability.profiler import register_beacon
+        # queue-driven: idle() before the blocking get() so an empty
+        # queue is not a stall; an ACTIVE beacon older than ~4x this
+        # cadence means a sink is wedged mid-delivery
+        beacon = register_beacon("alert-delivery", 30.0)
         while True:
+            beacon.idle()
             payload = self._deliveries.get()
+            beacon.beat()
             if payload is None:
                 return
             try:

@@ -104,6 +104,24 @@ SIGNATURES: tuple[tuple[str, "re.Pattern[str]", str], ...] = (
                 re.IGNORECASE),
      "training diverged (non-finite loss) — lower the LR or enable "
      "gradient clipping; a relaunch will diverge again"),
+    # deadlock before stall (more specific), both before preempted: a
+    # wedged task the AM kills also prints SIGTERM/Killed, and the wedge
+    # — not the kill — is the root cause the operator must chase
+    ("deadlock",
+     re.compile(r"deadlock|would block.*lock|lock ordering"
+                r"|acquire.*already (?:held|locked)", re.IGNORECASE),
+     "threads are mutually blocked on locks — the stacks section of "
+     "diagnostics.json names every thread's blocking frame; fix the "
+     "lock ordering, relaunching only postpones the next wedge"),
+    ("stall",
+     re.compile(r"PROCESS_STALL_DETECTED|stall(?:ed)? (?:detected|for)"
+                r"|watchdog.*(?:stale|wedge)|wedged?\b"
+                r"|missed \d+ heartbeats?|heartbeats? for [\d.]+s",
+                re.IGNORECASE),
+     "the process stopped making progress (wedged, not crashed) — the "
+     "stacks section of diagnostics.json names the blocking frame the "
+     "stall watchdog captured; look there before blaming the kill "
+     "signal"),
     ("preempted",
      re.compile(r"SIGTERM|SIGKILL|Killed\b|preempt(?:ed|ion)"
                 r"|killed by the (?:AM|scheduler)", re.IGNORECASE),

@@ -45,6 +45,11 @@ def main(argv=None) -> int:
     configure_structured_logging()
     conf = TonyConfiguration.read(args.conf) if args.conf \
         else TonyConfiguration()
+    # continuous profiler + stall watchdog + faulthandler (SIGUSR2 →
+    # all-thread dump): the portal is a long-running daemon fleet-wide
+    # operators depend on — it gets the same always-on coverage
+    from tony_tpu.observability.profiler import install_process_profiler
+    install_process_profiler("portal", conf=conf)
     location = (args.history_location or conf.get_str(K.HISTORY_LOCATION)
                 or os.path.expanduser("~/.tony_tpu/history"))
     intermediate = conf.get_str(K.HISTORY_INTERMEDIATE) or os.path.join(

@@ -249,6 +249,17 @@ class PortalCache:
         matched signature, redacted tails. {} for succeeded/old jobs."""
         return self._get_sidecar(job_id, C.DIAGNOSTICS_FILE, {})
 
+    def get_profile_folded(self, job_id: str) -> str:
+        """Collapsed-stack control-plane profile (profile.folded
+        sidecar — plain-text `stack count` lines the AM's sampling
+        profiler flushed at finish; NOT JSON, so it bypasses
+        _get_sidecar). "" for jobs that predate the profiler."""
+        d = self._find_app_dir(job_id)
+        if d is None:
+            return ""
+        from tony_tpu.events.history import read_profile_file
+        return read_profile_file(d)
+
     def get_am_info(self, job_id: str) -> dict[str, Any]:
         """The AM's RPC address ({host, rpc_port}) written into the
         history dir at prepare — how the portal reaches a RUNNING job's

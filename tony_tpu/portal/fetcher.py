@@ -102,8 +102,12 @@ class HistoryStoreFetcher:
         return fetched
 
     def _run(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("history-fetcher", self._interval_sec)
         while not self._stop.wait(self._interval_sec):
+            beacon.beat()
             self.fetch_once()
+        beacon.idle()
 
     def start(self) -> None:
         self._thread.start()

@@ -67,12 +67,16 @@ class HistoryFileMover:
             self._thread.join(timeout=5)
 
     def _loop(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("history-mover", self.interval_s)
         while not self._stop.is_set():
+            beacon.beat()
             try:
                 self.move_once()
             except Exception:  # noqa: BLE001 — keep the daemon alive
                 LOG.exception("history move pass failed")
             self._stop.wait(self.interval_s)
+        beacon.idle()
 
     # -- one pass ----------------------------------------------------------
     def move_once(self) -> list[str]:

@@ -38,12 +38,16 @@ class HistoryFilePurger:
             self._thread.join(timeout=5)
 
     def _loop(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("history-purger", self.interval_s)
         while not self._stop.is_set():
+            beacon.beat()
             try:
                 self.purge_once()
             except Exception:  # noqa: BLE001 — keep the daemon alive
                 LOG.exception("history purge pass failed")
             self._stop.wait(self.interval_s)
+        beacon.idle()
 
     def purge_once(self, now_ms: int | None = None) -> list[str]:
         """Delete expired app dirs; returns the paths removed."""

@@ -341,6 +341,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # stitched request traces + the slowest-requests table
                 # (serving_traces.json sidecar, observability/reqtrace)
                 return self._json(self._requests_bundle(job_id))
+            if what == "flame":
+                # the always-on control-plane profiler's collapsed-stack
+                # profile — live fold table (+ self-overhead reading)
+                # from a RUNNING job's AM, profile.folded sidecar after
+                return self._json(self._flame_bundle(
+                    job_id, md.status == "RUNNING"))
         if len(parts) == 4 and parts[0] == "jobs" and parts[2] == "logs":
             # /api/jobs/:id/logs/:task[?stream=&offset=&max_bytes=&follow]
             # — one bounded chunk; followers poll with the returned
@@ -544,6 +550,34 @@ class _Handler(BaseHTTPRequestHandler):
             bundle = dict(bundle)
             bundle["source"] = "history"
         return bundle
+
+    def _flame_bundle(self, job_id: str, running: bool) -> dict:
+        """Live-then-sidecar collapsed-stack profile: a RUNNING job's
+        AM answers get_profile with its in-memory fold table plus the
+        profiler's self-overhead reading; anything else falls back to
+        the profile.folded text the AM flushed at finish. Degrades
+        silently — the flame panel must never 500 a job page."""
+        am = self.cache.get_am_info(job_id) if running else {}
+        if running and am.get("host") and am.get("rpc_port") \
+                and not am.get("security_enabled"):
+            from tony_tpu.rpc.client import ClusterServiceClient
+            client = ClusterServiceClient(str(am["host"]),
+                                          int(am["rpc_port"]))
+            try:
+                snap = client.get_profile()
+                if isinstance(snap, dict) and not snap.get("error") \
+                        and snap.get("folded"):
+                    snap["source"] = "live"
+                    return snap
+            except Exception:  # noqa: BLE001 — degrade to the sidecar
+                LOG.debug("live profile proxy to the AM failed",
+                          exc_info=True)
+            finally:
+                client.close()
+        folded = self.cache.get_profile_folded(job_id)
+        if folded:
+            return {"folded": folded, "source": "history"}
+        return {}
 
     def _incident_timeline(self, job_id: str) -> list[dict]:
         """Alerts + history events + straggler/SLO detections + the

@@ -342,6 +342,14 @@ class ClusterServiceClient(_JsonRpcClient):
         return self.call("get_alerts", {}, retries=1, timeout_sec=10.0,
                          wait_for_ready=False)
 
+    def get_profile(self) -> dict:
+        """The AM's live sampling-profiler snapshot + collapsed-stack
+        text (observability/profiler.py). Operator plane: the portal's
+        /api/jobs/:id/flame proxy and `cli flame` poll this; the same
+        folded text is flushed to history as profile.folded at finish."""
+        return self.call("get_profile", {}, retries=1, timeout_sec=10.0,
+                         wait_for_ready=False)
+
     def read_task_logs(self, task_id: str = "", stream: str = "stderr",
                        offset: int = -1, max_bytes: int = 0) -> dict:
         """One bounded log chunk for a task (live when running, from
@@ -368,6 +376,14 @@ class TaskLogServiceClient(_JsonRpcClient):
         return self.call("read_log",
                          {"stream": stream, "offset": int(offset),
                           "max_bytes": int(max_bytes)},
+                         retries=1, timeout_sec=5.0, wait_for_ready=False)
+
+    def read_stacks(self) -> dict:
+        """The executor's redacted all-thread stack snapshot — the wedge
+        autopsy read. Same degradation contract as read_log: one
+        attempt, short deadline, because the caller is usually an AM
+        handler deciding a liveliness-expired task's fate."""
+        return self.call("read_stacks", {},
                          retries=1, timeout_sec=5.0, wait_for_ready=False)
 
 

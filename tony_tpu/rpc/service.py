@@ -50,9 +50,10 @@ CLUSTER_METHODS = (
     "request_rolling_update",
     "request_resize",
     "report_serving_migrated",
+    "get_profile",
 )
 METRICS_METHODS = ("update_metrics",)
-TASK_LOG_METHODS = ("read_log",)
+TASK_LOG_METHODS = ("read_log", "read_stacks")
 
 
 def auto_rpc_workers(width: int) -> int:
@@ -221,6 +222,15 @@ class ClusterServiceHandler(abc.ABC):
         verb is telemetry-only and older handler stubs keep working."""
         return {}
 
+    def get_profile(self, req: dict) -> dict:
+        """Operator/client plane: req {} -> the AM's live sampling-profiler
+        snapshot (observability/profiler.py): {process, hz, samples,
+        overhead_pct, ...} plus `folded` — the collapsed-stack text the
+        portal flamegraph / `cli flame` render. Non-abstract with an
+        unsupported default so older handler stubs keep working; the same
+        text is flushed to history as profile.folded at finish."""
+        return {"error": "profiler not available"}
+
 
 class MetricsServiceHandler(abc.ABC):
     @abc.abstractmethod
@@ -237,6 +247,19 @@ class TaskLogServiceHandler(abc.ABC):
         """req: {stream, offset?, max_bytes?} -> {data, offset,
         next_offset, size, eof} — one bounded, redacted chunk. offset < 0
         opens a tail cursor at (size - tail window)."""
+
+    def read_stacks(self, req: dict) -> dict:
+        """req: {} -> {task_id, attempt, generated_ms, threads: [{name,
+        ident, daemon, frames}]} — a redacted all-thread stack snapshot
+        of the executor process (observability/profiler.py
+        collect_thread_stacks). The wedge-autopsy read: when liveliness
+        expiry / barrier timeout / orphan-grace fires, the AM pulls this
+        before recording the failure so diagnostics.json can name the
+        blocking frame. Served from a separate gRPC worker thread, so it
+        answers even while the executor's main thread is wedged.
+        Non-abstract with an unsupported default so minimal handlers
+        (bench pool executors) keep working."""
+        return {"error": "stack dump not available"}
 
 
 def _generic_handler(service_name: str, handler: Any, methods: tuple[str, ...]):

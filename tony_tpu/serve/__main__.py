@@ -202,6 +202,14 @@ def main(argv=None) -> int:
             if conf_path and os.path.exists(conf_path)
             else TonyConfiguration())
 
+    # continuous profiler + stall watchdog + faulthandler (SIGUSR2 →
+    # all-thread dump): a serving replica is a long-running process and
+    # a wedged decode loop should name its blocking frame locally
+    from tony_tpu.observability.profiler import install_process_profiler
+    install_process_profiler(
+        f"serve:{env.get(C.JOB_NAME, 'serving')}"
+        f":{env.get(C.TASK_INDEX, str(os.getpid()))}", conf=conf)
+
     slots = args.slots or conf.get_int(K.SERVING_SLOTS, 4)
     queue_depth = args.queue_depth or conf.get_int(K.SERVING_QUEUE_DEPTH, 64)
     port = args.port

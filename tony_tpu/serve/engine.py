@@ -917,7 +917,12 @@ class ContinuousBatchingEngine:
         self._thread.start()
 
     def _loop(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        # a wedged engine loop means every in-flight request hangs —
+        # cadence is one idle backstop tick, so detection is fast
+        beacon = register_beacon("serve-engine", 1.0)
         while not self._stop.is_set():
+            beacon.beat()
             try:
                 busy = self.step()
             except Exception:  # noqa: BLE001 — a poisoned step must not
@@ -926,6 +931,7 @@ class ContinuousBatchingEngine:
             if not busy:
                 self._work.wait(timeout=0.02)
                 self._work.clear()
+        beacon.idle()
 
     def stop(self) -> None:
         """Stop the loop and fail outstanding work (pending AND in-flight)

@@ -238,7 +238,11 @@ class FleetRouter:
         needing traffic, and a DOWN replica re-admits itself the moment
         it answers again. Endpoints refresh concurrently — one wedged
         replica's timeout must not stale the others' snapshots."""
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("router-prober",
+                                 max(self.probe_ttl_s / 4, 0.01))
         while not self._prober_stop.is_set():
+            beacon.beat()
             with self._lock:
                 now = time.monotonic()
                 # one in-flight probe per endpoint, ever: a wedged
@@ -254,6 +258,7 @@ class FleetRouter:
                 threading.Thread(target=self._probe_once, args=(url,),
                                  daemon=True).start()
             self._prober_stop.wait(max(self.probe_ttl_s / 4, 0.01))
+        beacon.idle()
 
     def _probe_once(self, url: str) -> None:
         try:
@@ -761,9 +766,14 @@ class AmEndpointWatcher:
         return len(eps)
 
     def _loop(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("router-endpoint-watcher",
+                                 self.interval_s)
         while not self._stop.is_set():
+            beacon.beat()
             try:
                 self.poll_once()
             except Exception:  # noqa: BLE001 — AM mid-boot/restart
                 LOG.debug("endpoint poll failed", exc_info=True)
             self._stop.wait(self.interval_s)
+        beacon.idle()

@@ -135,9 +135,16 @@ class TpuMetricsReporter:
                       "(%d dropped so far)", self.dropped)
 
     def _drain(self, q: queue.Queue) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        # queue-driven: idle() before the blocking get() so an empty
+        # queue is not a stall; an ACTIVE beacon means _push is wedged
+        beacon = register_beacon("metrics-push", 10.0)
         while True:
+            beacon.idle()
             item = q.get()
+            beacon.beat()
             if item is _CLOSE:
+                beacon.idle()
                 return
             self._push(item)
 
@@ -228,8 +235,12 @@ class ServingMetricsReporter(TpuMetricsReporter):
         self._sampler.start()
 
     def _sample_loop(self) -> None:
+        from tony_tpu.observability.profiler import register_beacon
+        beacon = register_beacon("serving-metrics", self._interval)
         while not self._sampler_stop.wait(self._interval):
+            beacon.beat()
             self.report_now()
+        beacon.idle()
 
     def report_now(self) -> None:
         """Sample and enqueue once (the sampler's tick; also callable

@@ -9,6 +9,8 @@ from tools.tonylint.rules_legacy import (AlertHotLoopRule,
                                          GaugeRegistryRule, PrintBanRule,
                                          RendererCoverageRule)
 from tools.tonylint.rules_locks import GuardedByRule, NoBlockingUnderLockRule
+from tools.tonylint.rules_profiler import (ProcessEntryProfilerRule,
+                                           WatchdogBeaconRule)
 from tools.tonylint.rules_rpc import (AttemptFencingRule, RedactOnEgressRule,
                                       TracePropagationRule)
 from tools.tonylint.rules_threads import ThreadHygieneRule
@@ -28,4 +30,6 @@ def default_rules() -> list[Rule]:
         RendererCoverageRule(),
         AlertRuleRegistryRule(),
         AlertHotLoopRule(),
+        WatchdogBeaconRule(),
+        ProcessEntryProfilerRule(),
     ]

@@ -1,0 +1,152 @@
+"""profiler coverage: daemon loops beat a beacon; entries install the pair.
+
+The stall watchdog (observability/profiler.py) can only autopsy a wedge
+it can SEE: a daemon worker loop that never registers a progress beacon
+is invisible to it, and a long-running ``__main__`` that skips
+``install_process_profiler`` has no profiler, no watchdog, and no
+SIGUSR2 stack dump at all. Two checks:
+
+- ``watchdog-beacon``: every thread-entry function (a ``target=`` of a
+  ``threading.Thread`` construction, or the ``run()`` of a Thread
+  subclass) that contains a ``while`` loop must carry beacon evidence —
+  a ``register_beacon(...)`` call, or ``.beat(``/``.idle(`` on each
+  iteration. Loops with a legitimate reason to stay dark (the profiler's
+  own threads — the observer cannot watch itself) carry a justified
+  suppression.
+- ``process-entry-profiler``: every long-running process entry (AM,
+  executor, portal, serve replica, and the CLI that hosts the router
+  verb) must call ``install_process_profiler(`` — the one-call wiring
+  for faulthandler + sampling profiler + stall watchdog.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.tonylint.engine import (Finding, Project, PyFile, Rule,
+                                   dotted_name)
+from tools.tonylint.rules_threads import THREAD_DIRS
+
+# the metrics push worker (train/metrics.py) is a control-plane daemon
+# loop living outside THREAD_DIRS
+BEACON_DIRS = THREAD_DIRS + ("tony_tpu/train/",)
+
+# every long-running __main__ the tentpole wires; the CLI is on the
+# list because its `router` verb IS the fleet router daemon
+ENTRY_FILES = (
+    "tony_tpu/am/__main__.py",
+    "tony_tpu/executor/__main__.py",
+    "tony_tpu/portal/__main__.py",
+    "tony_tpu/serve/__main__.py",
+    "tony_tpu/cli/__main__.py",
+)
+
+
+def _thread_target_names(pf: PyFile) -> set[str]:
+    """Trailing names of every ``target=`` passed to a Thread
+    construction in this module (``self._run`` -> ``_run``)."""
+    names: set[str] = set()
+    for node in ast.walk(pf.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func) in ("threading.Thread",
+                                               "Thread")):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            tgt = kw.value
+            if isinstance(tgt, ast.Attribute):
+                names.add(tgt.attr)
+            elif isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+    return names
+
+
+def _has_beacon_evidence(fn: ast.AST) -> bool:
+    """``register_beacon(...)`` or a ``.beat(``/``.idle(`` call anywhere
+    in the function — AST shape, so a comment or string mentioning the
+    beacon protocol does not satisfy the check."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        tail = name.rpartition(".")[2]
+        if tail in ("register_beacon", "beat", "idle"):
+            return True
+    return False
+
+
+def _contains_while(fn: ast.AST) -> bool:
+    return any(isinstance(n, ast.While) for n in ast.walk(fn))
+
+
+class WatchdogBeaconRule(Rule):
+    id = "watchdog-beacon"
+    description = ("daemon worker loops must register a stall-watchdog "
+                   "beacon and beat()/idle() it — a dark loop's wedge "
+                   "is invisible to the autopsy")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for pf in self.files(project):
+            if not pf.relpath.startswith(BEACON_DIRS):
+                continue
+            targets = _thread_target_names(pf)
+            # a `run` method only counts when its class subclasses
+            # Thread — TaskExecutor.run() is a main-thread lifecycle,
+            # not a daemon loop, and must not be dragged in by name
+            candidates: list[ast.FunctionDef] = []
+            for cls in ast.walk(pf.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                if any(dotted_name(b) in ("threading.Thread", "Thread")
+                       for b in cls.bases):
+                    for stmt in cls.body:
+                        if isinstance(stmt, ast.FunctionDef) \
+                                and stmt.name == "run":
+                            candidates.append(stmt)
+            if targets:
+                seen = set(id(fn) for fn in candidates)
+                for node in ast.walk(pf.tree):
+                    if isinstance(node, ast.FunctionDef) \
+                            and node.name in targets \
+                            and node.name != "run" \
+                            and id(node) not in seen:
+                        candidates.append(node)
+            for node in candidates:
+                if not _contains_while(node):
+                    continue
+                if _has_beacon_evidence(node):
+                    continue
+                yield Finding(
+                    self.id, pf.relpath, node.lineno,
+                    f"thread loop {node.name}() never registers a "
+                    f"watchdog beacon (observability/profiler."
+                    f"register_beacon) nor beats one — a wedge here is "
+                    f"invisible to the stall autopsy")
+
+
+class ProcessEntryProfilerRule(Rule):
+    id = "process-entry-profiler"
+    description = ("every long-running __main__ must install the "
+                   "profiler/faulthandler pair "
+                   "(install_process_profiler)")
+    project_wide = True
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for rel in ENTRY_FILES:
+            pf = project.file(rel)
+            if pf is None:
+                yield Finding(
+                    self.id, rel, 1,
+                    "long-running process entry missing from the scan "
+                    "set — was it moved without updating "
+                    "rules_profiler.ENTRY_FILES?")
+                continue
+            if "install_process_profiler(" not in pf.source:
+                yield Finding(
+                    self.id, rel, 1,
+                    "long-running process entry never calls "
+                    "install_process_profiler(...) — no sampling "
+                    "profiler, no stall watchdog, no SIGUSR2 "
+                    "all-thread dump for this process")
